@@ -1,0 +1,22 @@
+"""minio_tpu — a TPU-native erasure-coded object storage framework.
+
+A ground-up rebuild of the capability surface of the reference object store
+(an S3-compatible, erasure-coded distributed store with a QAT-offload fork
+delta) where the entire hot data path — GF(2^8) Reed-Solomon encode /
+reconstruct / heal and bitrot checksumming — runs as batched XLA/Pallas
+kernels on TPU, and the host runtime (S3 API, drive layout, quorum
+semantics, healing, distribution) is built around feeding that device
+pipeline.
+
+Layout:
+    ops/       device kernels + host oracles (GF(2^8) RS, hashing)
+    models/    the flagship jittable pipelines (encode+bitrot, decode, heal)
+    erasure/   streaming erasure codec (block loop, quorum writers/readers)
+    storage/   per-drive layer: xl.meta-style metadata, POSIX backend
+    object/    object engine: sets, zones, multipart, healing
+    s3/        S3 HTTP frontend (SigV4, handlers)
+    parallel/  mesh/sharding: multi-chip encode, batch scheduler
+    utils/     siphash routing, ellipses, byte pools, ...
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,159 @@
+"""GF(2^8) arithmetic for Reed-Solomon erasure coding.
+
+Field: GF(2^8) with the Rijndael-unrelated generator polynomial
+x^8 + x^4 + x^3 + x^2 + 1 (0x11D), generator element 2 — the same field the
+reference's codec dependency (klauspost/reedsolomon, wrapped at
+cmd/erasure-coding.go:56 in the reference tree) is built on, so that shard
+output is byte-identical.
+
+Everything here is numpy on the host: matrix construction, inversion and the
+oracle codec live on CPU; the TPU path (ops/rs_tpu.py) consumes the *binary
+expansion* of these matrices and never does table lookups on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+FIELD_SIZE = 256
+_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """exp/log tables over GF(2^8) with generator 2."""
+    exp = np.zeros(512, dtype=np.uint8)  # doubled for overflow-free mul
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[0:255]
+    log[0] = -255 * 255  # log(0) sentinel: any use yields index < 0 — callers guard
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+# Full 256x256 multiplication table. 64 KiB; used by the host oracle codec and
+# to derive per-constant bit-matrices for the TPU kernels.
+_a = np.arange(256, dtype=np.int32)
+_MUL_TABLE = np.zeros((256, 256), dtype=np.uint8)
+_nz = _a[1:]
+_logs = GF_LOG[_nz][:, None] + GF_LOG[_nz][None, :]
+_MUL_TABLE[1:, 1:] = GF_EXP[_logs % 255]
+del _a, _nz, _logs
+
+# Inverse table: inv[a] = a^(254)
+GF_INV = np.zeros(256, dtype=np.uint8)
+GF_INV[1:] = GF_EXP[(255 - GF_LOG[np.arange(1, 256)]) % 255]
+
+
+def gf_mul(a: int, b: int) -> int:
+    return int(_MUL_TABLE[a, b])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    return int(_MUL_TABLE[a, GF_INV[b]])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n in GF(2^8); matches the reference codec's exponentiation
+    semantics (0**0 == 1, 0**n == 0 for n > 0)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(GF_LOG[a] * n) % 255])
+
+
+def gf_mul_vec(c: int, v: np.ndarray) -> np.ndarray:
+    """Multiply every byte of v by the constant c."""
+    return _MUL_TABLE[c][v]
+
+
+def gf_matmul(m: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product: (r,k) uint8 @ (k,n) uint8 -> (r,n) uint8.
+
+    Host oracle path. XOR-accumulates table-multiplied rows.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    x = np.asarray(x, dtype=np.uint8)
+    r, k = m.shape
+    out = np.zeros((r, x.shape[1]), dtype=np.uint8)
+    for j in range(k):
+        # rows of the constant-multiplication table indexed by m[:, j]
+        out ^= _MUL_TABLE[m[:, j][:, None], x[j][None, :]]
+    return out
+
+
+def gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix via Gauss-Jordan elimination.
+
+    Raises ValueError when singular (mirrors the reference codec's
+    errSingular behavior).
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    if m.shape != (n, n):
+        raise ValueError("matrix must be square")
+    aug = np.zeros((n, 2 * n), dtype=np.uint8)
+    aug[:, :n] = m
+    aug[np.arange(n), n + np.arange(n)] = 1
+
+    for col in range(n):
+        # partial pivot: find a row with nonzero pivot
+        pivot = -1
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot < 0:
+            raise ValueError("singular matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # scale pivot row to 1
+        inv_p = GF_INV[aug[col, col]]
+        aug[col] = _MUL_TABLE[inv_p][aug[col]]
+        # eliminate all other rows
+        col_vals = aug[:, col].copy()
+        col_vals[col] = 0
+        nz = np.nonzero(col_vals)[0]
+        if nz.size:
+            aug[nz] ^= _MUL_TABLE[col_vals[nz][:, None], aug[col][None, :]]
+    return aug[:, n:].copy()
+
+
+@functools.lru_cache(maxsize=512)
+def mul_bitmatrix(c: int) -> np.ndarray:
+    """8x8 GF(2) matrix B of multiplication-by-c: for byte x with bit vector
+    bits(x), bits(c*x) = B @ bits(x) mod 2 (bit 0 = LSB).
+
+    Column p of B is bits(c * 2^p): multiplication by a constant is linear
+    over GF(2), which is what lets the whole RS encode become a single
+    binary matmul on the MXU (see ops/rs_tpu.py).
+    """
+    cols = _MUL_TABLE[c][1 << np.arange(8)]  # c * 2^p for p in 0..7
+    bits = (cols[None, :] >> np.arange(8)[:, None]) & 1  # [q, p] = bit q of c*2^p
+    return bits.astype(np.uint8)
+
+
+def expand_to_gf2(m: np.ndarray) -> np.ndarray:
+    """Expand an (r,k) GF(2^8) matrix into its (r*8, k*8) GF(2) bit-matrix.
+
+    Output layout: row j*8+q is output-bit q of output-byte j; column i*8+p is
+    input-bit p of input-byte i.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    r, k = m.shape
+    out = np.zeros((r * 8, k * 8), dtype=np.uint8)
+    for j in range(r):
+        for i in range(k):
+            out[j * 8:(j + 1) * 8, i * 8:(i + 1) * 8] = mul_bitmatrix(int(m[j, i]))
+    return out

@@ -1,0 +1,99 @@
+"""Reed-Solomon encode/decode matrix construction.
+
+Matches the construction the reference uses (klauspost/reedsolomon `New`
+default path, as wrapped by the reference's cmd/erasure-coding.go:56):
+a (total x data) Vandermonde matrix vm[r, c] = r**c over GF(2^8), made
+systematic by right-multiplying with the inverse of its top (data x data)
+square. The top k rows become the identity; rows k..n-1 are the parity rows.
+
+Decode matrices for arbitrary erasure patterns are derived from the same
+encode matrix and cached per (k, m, missing-bitmask).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+
+
+@functools.lru_cache(maxsize=256)
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    m = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            m[r, c] = gf256.gf_exp(r, c)
+    return m
+
+
+@functools.lru_cache(maxsize=256)
+def encode_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Full (n x k) systematic encode matrix; top k rows are identity."""
+    total = data_shards + parity_shards
+    if data_shards <= 0 or parity_shards <= 0:
+        raise ValueError("data and parity shard counts must be positive")
+    if total > 256:
+        raise ValueError("too many shards: max 256")
+    vm = vandermonde(total, data_shards)
+    top_inv = gf256.gf_mat_inv(vm[:data_shards])
+    em = gf256.gf_matmul(vm, top_inv)
+    # sanity: systematic
+    assert (em[:data_shards] == np.eye(data_shards, dtype=np.uint8)).all()
+    em.setflags(write=False)
+    return em
+
+
+@functools.lru_cache(maxsize=256)
+def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The (m x k) parity rows of the encode matrix."""
+    return encode_matrix(data_shards, parity_shards)[data_shards:]
+
+
+@functools.lru_cache(maxsize=4096)
+def decode_matrix(data_shards: int, parity_shards: int,
+                  present_mask: int) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Matrix reconstructing ALL k data shards from k surviving shards.
+
+    present_mask: bitmask over all n shards; bit i set = shard i readable.
+    Mirrors the reference codec's reconstruction row selection: scan shards
+    in index order and take the first k present ones.
+
+    Returns (D, used) where D is (k x k) over GF(2^8) and `used` lists the
+    k shard indices (in scan order) whose bytes form the input rows.
+    """
+    n = data_shards + parity_shards
+    used: list[int] = []
+    for i in range(n):
+        if present_mask >> i & 1:
+            used.append(i)
+            if len(used) == data_shards:
+                break
+    if len(used) < data_shards:
+        raise ValueError(
+            f"too few shards: need {data_shards}, have {len(used)}")
+    em = encode_matrix(data_shards, parity_shards)
+    sub = em[used]  # (k x k)
+    d = gf256.gf_mat_inv(sub)
+    d.setflags(write=False)
+    return d, tuple(used)
+
+
+@functools.lru_cache(maxsize=4096)
+def recover_matrix(data_shards: int, parity_shards: int,
+                   present_mask: int) -> tuple[np.ndarray, tuple[int, ...], tuple[int, ...]]:
+    """Matrix producing exactly the MISSING shards (data and parity) from k
+    surviving shards — the heal kernel's one-matmul form.
+
+    Returns (R, used, missing): R is (|missing| x k); R @ shards[used]
+    yields shards[missing] in index order.
+    """
+    n = data_shards + parity_shards
+    d, used = decode_matrix(data_shards, parity_shards, present_mask)
+    missing = tuple(i for i in range(n) if not (present_mask >> i & 1))
+    em = encode_matrix(data_shards, parity_shards)
+    # rows of em for missing shards, composed with the data-recovery matrix
+    r = gf256.gf_matmul(em[list(missing)], d)
+    r.setflags(write=False)
+    return r, used, missing

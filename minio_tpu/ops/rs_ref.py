@@ -1,0 +1,86 @@
+"""Host (numpy) reference Reed-Solomon codec — the byte-identity oracle.
+
+This is the CPU fallback and the oracle the TPU kernels (ops/rs_tpu.py) are
+tested against, playing the role the reference's kernel-matrix tests play
+(reference: cmd/erasure-encode_test.go / erasure-decode_test.go matrices).
+
+Shard layout convention everywhere in this framework:
+    a block of `size` bytes splits into k = data_shards shards of
+    shard_len = ceil(size / k) bytes, zero-padded at the tail (same
+    semantics as the reference codec's Split: pad-to-equal-shards).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256, rs_matrix
+
+
+def split(data: bytes | np.ndarray, data_shards: int) -> np.ndarray:
+    """Split a byte block into (k, shard_len) with zero padding."""
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else np.asarray(data, dtype=np.uint8)
+    if buf.size == 0:
+        raise ValueError("cannot split empty data")
+    shard_len = -(-buf.size // data_shards)
+    out = np.zeros((data_shards, shard_len), dtype=np.uint8)
+    out.reshape(-1)[:buf.size] = buf
+    return out
+
+
+def encode(shards: np.ndarray, parity_shards: int) -> np.ndarray:
+    """shards: (k, L) data shards -> (k+m, L) all shards."""
+    k, length = shards.shape
+    pm = rs_matrix.parity_matrix(k, parity_shards)
+    parity = gf256.gf_matmul(pm, shards)
+    return np.concatenate([shards, parity], axis=0)
+
+
+def encode_block(data: bytes | np.ndarray, data_shards: int,
+                 parity_shards: int) -> np.ndarray:
+    return encode(split(data, data_shards), parity_shards)
+
+
+def reconstruct(shards: dict[int, np.ndarray], data_shards: int,
+                parity_shards: int, shard_len: int,
+                data_only: bool = False) -> np.ndarray:
+    """Rebuild missing shards from the survivors.
+
+    shards: {index: bytes-array} of the available shards (each (L,) uint8).
+    Returns the full (n, L) shard matrix.
+    """
+    n = data_shards + parity_shards
+    present = 0
+    for i in shards:
+        present |= 1 << i
+    d, used = rs_matrix.decode_matrix(data_shards, parity_shards, present)
+    stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in used])
+    if stack.shape[1] != shard_len:
+        raise ValueError("shard length mismatch")
+    data = gf256.gf_matmul(d, stack)
+    out = np.zeros((n, shard_len), dtype=np.uint8)
+    out[:data_shards] = data
+    for i, s in shards.items():
+        out[i] = s
+    if not data_only:
+        missing_parity = [i for i in range(data_shards, n) if i not in shards]
+        if missing_parity:
+            pm = rs_matrix.parity_matrix(data_shards, parity_shards)
+            parity = gf256.gf_matmul(pm, data)
+            for i in missing_parity:
+                out[i] = parity[i - data_shards]
+    return out
+
+
+def verify(shards: np.ndarray, data_shards: int) -> bool:
+    """Check parity consistency of a full (n, L) shard matrix."""
+    n = shards.shape[0]
+    pm = rs_matrix.parity_matrix(data_shards, n - data_shards)
+    parity = gf256.gf_matmul(pm, shards[:data_shards])
+    return bool((parity == shards[data_shards:]).all())
+
+
+def join(shards: np.ndarray, data_shards: int, size: int) -> bytes:
+    """Concatenate data shards and trim padding back to `size` bytes."""
+    return shards[:data_shards].reshape(-1)[:size].tobytes()

@@ -1,0 +1,184 @@
+"""GF(2^8) field + RS matrix tests — the algebraic bedrock.
+
+Known-value vectors pin the field to the same polynomial (0x11D, generator 2)
+the reference's codec library uses, so shard bytes are comparable 1:1.
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf256, rs_matrix, rs_ref
+
+
+class TestField:
+    def test_exp_log_roundtrip(self):
+        for a in range(1, 256):
+            assert gf256.GF_EXP[gf256.GF_LOG[a]] == a
+
+    def test_known_products(self):
+        # Classic vectors for poly 0x11D
+        assert gf256.gf_mul(0, 21) == 0
+        assert gf256.gf_mul(1, 21) == 21
+        assert gf256.gf_mul(2, 0x80) == 0x1D  # overflow reduces by 0x11D
+        assert gf256.gf_mul(3, 7) == 9
+        assert gf256.gf_mul(0xFF, 0xFF) == 0xE2
+        # 0x53 * 0xCA == 1 only under the AES polynomial (0x11B); here it must not
+        assert gf256.gf_mul(0x53, 0xCA) != 0x01
+
+    def test_generator_order(self):
+        # 2 generates the multiplicative group: 2^255 == 1, no smaller cycle
+        seen = set()
+        x = 1
+        for _ in range(255):
+            assert x not in seen
+            seen.add(x)
+            x = gf256.gf_mul(x, 2)
+        assert x == 1
+        assert len(seen) == 255
+
+    def test_mul_commutative_distributive(self):
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b, c = (int(v) for v in rng.integers(0, 256, 3))
+            assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+            assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gf_mul(a, int(gf256.GF_INV[a])) == 1
+
+    def test_div(self):
+        rng = np.random.default_rng(1)
+        for _ in range(100):
+            a, b = (int(v) for v in rng.integers(1, 256, 2))
+            q = gf256.gf_div(a, b)
+            assert gf256.gf_mul(q, b) == a
+        with pytest.raises(ZeroDivisionError):
+            gf256.gf_div(5, 0)
+
+    def test_gf_exp_conventions(self):
+        assert gf256.gf_exp(0, 0) == 1  # matches reference codec's galExp
+        assert gf256.gf_exp(0, 5) == 0
+        assert gf256.gf_exp(7, 1) == 7
+        # a^255 == 1 for a != 0
+        for a in (1, 2, 3, 0x1D, 255):
+            assert gf256.gf_exp(a, 255) == 1
+
+
+class TestMatrix:
+    def test_inverse_roundtrip(self):
+        rng = np.random.default_rng(2)
+        for n in (1, 2, 4, 8, 13):
+            # random invertible matrix: try until non-singular
+            while True:
+                m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+                try:
+                    inv = gf256.gf_mat_inv(m)
+                    break
+                except ValueError:
+                    continue
+            eye = gf256.gf_matmul(m, inv)
+            assert (eye == np.eye(n, dtype=np.uint8)).all()
+
+    def test_singular_raises(self):
+        m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+        with pytest.raises(ValueError):
+            gf256.gf_mat_inv(m)
+
+    def test_mul_bitmatrix_linearity(self):
+        rng = np.random.default_rng(3)
+        for _ in range(64):
+            c = int(rng.integers(0, 256))
+            bm = gf256.mul_bitmatrix(c)
+            for _ in range(8):
+                x = int(rng.integers(0, 256))
+                bits_x = (x >> np.arange(8)) & 1
+                bits_y = bm @ bits_x % 2
+                y = int((bits_y << np.arange(8)).sum())
+                assert y == gf256.gf_mul(c, x), (c, x)
+
+    def test_expand_to_gf2(self):
+        rng = np.random.default_rng(4)
+        m = rng.integers(0, 256, (4, 3)).astype(np.uint8)
+        bm = gf256.expand_to_gf2(m)
+        assert bm.shape == (32, 24)
+        x = rng.integers(0, 256, (3, 17)).astype(np.uint8)
+        # bit-expand x: (24, 17)
+        xb = ((x[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(24, 17)
+        yb = bm.astype(np.int64) @ xb % 2
+        y = (yb.reshape(4, 8, 17) << np.arange(8)[None, :, None]).sum(1).astype(np.uint8)
+        assert (y == gf256.gf_matmul(m, x)).all()
+
+
+class TestEncodeMatrix:
+    def test_systematic(self):
+        for k, m in [(2, 1), (4, 2), (12, 4), (16, 16), (8, 8)]:
+            em = rs_matrix.encode_matrix(k, m)
+            assert em.shape == (k + m, k)
+            assert (em[:k] == np.eye(k, dtype=np.uint8)).all()
+
+    def test_known_vandermonde_values(self):
+        vm = rs_matrix.vandermonde(6, 4)
+        assert vm[0].tolist() == [1, 0, 0, 0]
+        assert vm[1].tolist() == [1, 1, 1, 1]
+        assert vm[2].tolist() == [1, 2, 4, 8]
+        assert vm[3].tolist() == [1, 3, 5, 15]
+
+    def test_any_k_rows_invertible(self):
+        # MDS property: every k-subset of encode matrix rows is invertible
+        import itertools
+        k, m = 4, 3
+        em = rs_matrix.encode_matrix(k, m)
+        for rows in itertools.combinations(range(k + m), k):
+            gf256.gf_mat_inv(em[list(rows)])  # must not raise
+
+    def test_decode_matrix_row_selection(self):
+        k, m = 4, 2
+        # shards 1 and 3 missing -> survivors 0,2,4,5; first k = 0,2,4,5
+        mask = 0b110101
+        _, used = rs_matrix.decode_matrix(k, m, mask)
+        assert used == (0, 2, 4, 5)
+
+    def test_too_few_shards(self):
+        with pytest.raises(ValueError):
+            rs_matrix.decode_matrix(4, 2, 0b000111)
+
+
+class TestReferenceCodec:
+    @pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (12, 4), (16, 4), (5, 3)])
+    def test_roundtrip_no_loss(self, k, m):
+        rng = np.random.default_rng(k * 100 + m)
+        data = rng.integers(0, 256, 1000).astype(np.uint8).tobytes()
+        shards = rs_ref.encode_block(data, k, m)
+        assert shards.shape[0] == k + m
+        assert rs_ref.verify(shards, k)
+        assert rs_ref.join(shards, k, len(data)) == data
+
+    @pytest.mark.parametrize("k,m", [(4, 2), (12, 4), (8, 8)])
+    def test_reconstruct_all_patterns(self, k, m):
+        import itertools
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, 4099).astype(np.uint8).tobytes()
+        full = rs_ref.encode_block(data, k, m)
+        L = full.shape[1]
+        n = k + m
+        # lose up to m shards in a few random patterns + all 1/2-loss patterns
+        patterns = [frozenset(c) for c in itertools.combinations(range(n), 1)]
+        patterns += [frozenset(c) for c in itertools.combinations(range(n), min(2, m))][:20]
+        rng2 = np.random.default_rng(8)
+        for _ in range(10):
+            patterns.append(frozenset(
+                int(i) for i in rng2.choice(n, size=m, replace=False)))
+        for missing in patterns:
+            avail = {i: full[i] for i in range(n) if i not in missing}
+            out = rs_ref.reconstruct(avail, k, m, L)
+            assert (out == full).all(), f"pattern {sorted(missing)}"
+
+    def test_split_pads(self):
+        out = rs_ref.split(b"abcdefg", 3)
+        assert out.shape == (3, 3)
+        assert bytes(out.reshape(-1)) == b"abcdefg\x00\x00"
+
+    def test_zero_data(self):
+        with pytest.raises(ValueError):
+            rs_ref.split(b"", 4)
