@@ -1,0 +1,133 @@
+"""Pure-Python HighwayHash (fallback when the native library is absent).
+
+Same algorithm as native/highwayhash.cpp; validated by the same
+known-answer tests (HH64 published vectors + the reference's magic bitrot
+key, which is HH256(zero_key, first 100 pi decimals) — reference constant
+at cmd/bitrot.go:31). Slow — correctness fallback only.
+"""
+
+from __future__ import annotations
+
+M64 = (1 << 64) - 1
+
+_MUL0 = (0xdbe6d5d5fe4cce2f, 0xa4093822299f31d0,
+         0x13198a2e03707344, 0x243f6a8885a308d3)
+_MUL1 = (0x3bd39e10cb0ef593, 0xc0acf169b5f18a8c,
+         0xbe5466cf34e90c6c, 0x452821e638d01377)
+
+
+def _rot32(x: int) -> int:
+    return ((x >> 32) | (x << 32)) & M64
+
+
+class HighwayHash:
+    def __init__(self, key: bytes):
+        assert len(key) == 32
+        k = [int.from_bytes(key[i * 8:(i + 1) * 8], "little") for i in range(4)]
+        self.mul0 = list(_MUL0)
+        self.mul1 = list(_MUL1)
+        self.v0 = [self.mul0[i] ^ k[i] for i in range(4)]
+        self.v1 = [self.mul1[i] ^ _rot32(k[i]) for i in range(4)]
+        self._buf = b""
+
+    # -- core permutation ---------------------------------------------------
+    @staticmethod
+    def _zipper_merge(v1: int, v0: int) -> tuple[int, int]:
+        add0 = ((((v0 & 0xff000000) | (v1 & 0xff00000000)) >> 24)
+                | (((v0 & 0xff0000000000) | (v1 & 0xff000000000000)) >> 16)
+                | (v0 & 0xff0000) | ((v0 & 0xff00) << 32)
+                | ((v1 & 0xff00000000000000) >> 8) | ((v0 << 56) & M64))
+        add1 = ((((v1 & 0xff000000) | (v0 & 0xff00000000)) >> 24)
+                | (v1 & 0xff0000) | ((v1 & 0xff0000000000) >> 16)
+                | ((v1 & 0xff00) << 24) | ((v0 & 0xff000000000000) >> 8)
+                | ((v1 & 0xff) << 48) | (v0 & 0xff00000000000000))
+        return add1, add0
+
+    def _update(self, lanes: list[int]) -> None:
+        v0, v1, mul0, mul1 = self.v0, self.v1, self.mul0, self.mul1
+        for i in range(4):
+            v1[i] = (v1[i] + mul0[i] + lanes[i]) & M64
+            mul0[i] ^= ((v1[i] & 0xffffffff) * (v0[i] >> 32)) & M64
+            v0[i] = (v0[i] + mul1[i]) & M64
+            mul1[i] ^= ((v0[i] & 0xffffffff) * (v1[i] >> 32)) & M64
+        for dst, src, (hi, lo) in ((v0, v1, (1, 0)), (v0, v1, (3, 2)),
+                                   (v1, v0, (1, 0)), (v1, v0, (3, 2))):
+            add1, add0 = self._zipper_merge(src[hi], src[lo])
+            dst[lo] = (dst[lo] + add0) & M64
+            dst[hi] = (dst[hi] + add1) & M64
+
+    def _update_packet(self, p: bytes) -> None:
+        self._update([int.from_bytes(p[i * 8:(i + 1) * 8], "little")
+                      for i in range(4)])
+
+    def _update_remainder(self, b: bytes) -> None:
+        n = len(b)
+        mod4 = n & 3
+        remainder = b[n & ~3:]
+        packet = bytearray(32)
+        for i in range(4):
+            self.v0[i] = (self.v0[i] + ((n << 32) + n)) & M64
+        # rotate v1 lanes' 32-bit halves left by n
+        if n:
+            for i in range(4):
+                h0 = self.v1[i] & 0xffffffff
+                h1 = self.v1[i] >> 32
+                h0 = ((h0 << n) | (h0 >> (32 - n))) & 0xffffffff
+                h1 = ((h1 << n) | (h1 >> (32 - n))) & 0xffffffff
+                self.v1[i] = (h1 << 32) | h0
+        packet[:n & ~3] = b[:n & ~3]
+        if n & 16:
+            base = n & ~3
+            for i in range(4):
+                # signed offset into the full buffer (reaches back into
+                # already-copied bytes when mod4 < 4)
+                packet[28 + i] = b[base + mod4 + i - 4]
+        elif mod4:
+            packet[16] = remainder[0]
+            packet[17] = remainder[mod4 >> 1]
+            packet[18] = remainder[mod4 - 1]
+        self._update_packet(bytes(packet))
+
+    def _permute_and_update(self) -> None:
+        v = self.v0
+        self._update([_rot32(v[2]), _rot32(v[3]), _rot32(v[0]), _rot32(v[1])])
+
+    # -- public streaming API ----------------------------------------------
+    def update(self, data: bytes) -> None:
+        buf = self._buf + data
+        full = len(buf) & ~31
+        for i in range(0, full, 32):
+            self._update_packet(buf[i:i + 32])
+        self._buf = buf[full:]
+
+    def _clone(self) -> "HighwayHash":
+        h = HighwayHash.__new__(HighwayHash)
+        h.v0, h.v1 = list(self.v0), list(self.v1)
+        h.mul0, h.mul1 = list(self.mul0), list(self.mul1)
+        h._buf = self._buf
+        return h
+
+    def digest64(self) -> int:
+        h = self._clone()
+        if h._buf:
+            h._update_remainder(h._buf)
+        for _ in range(4):
+            h._permute_and_update()
+        return (h.v0[0] + h.v1[0] + h.mul0[0] + h.mul1[0]) & M64
+
+    def digest256(self) -> bytes:
+        h = self._clone()
+        if h._buf:
+            h._update_remainder(h._buf)
+        for _ in range(10):
+            h._permute_and_update()
+        def modred(a3u, a2, a1, a0):
+            a3 = a3u & 0x3FFFFFFFFFFFFFFF
+            m1 = a1 ^ (((a3 << 1) | (a2 >> 63)) & M64) ^ (((a3 << 2) | (a2 >> 62)) & M64)
+            m0 = a0 ^ ((a2 << 1) & M64) ^ ((a2 << 2) & M64)
+            return m1 & M64, m0 & M64
+        h1, h0 = modred((h.v1[1] + h.mul1[1]) & M64, (h.v1[0] + h.mul1[0]) & M64,
+                        (h.v0[1] + h.mul0[1]) & M64, (h.v0[0] + h.mul0[0]) & M64)
+        h3, h2 = modred((h.v1[3] + h.mul1[3]) & M64, (h.v1[2] + h.mul1[2]) & M64,
+                        (h.v0[3] + h.mul0[3]) & M64, (h.v0[2] + h.mul0[2]) & M64)
+        return b"".join(x.to_bytes(8, "little") for x in (h0, h1, h2, h3))

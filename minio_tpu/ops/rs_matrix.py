@@ -25,6 +25,7 @@ def vandermonde(rows: int, cols: int) -> np.ndarray:
     for r in range(rows):
         for c in range(cols):
             m[r, c] = gf256.gf_exp(r, c)
+    m.setflags(write=False)
     return m
 
 

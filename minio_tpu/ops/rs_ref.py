@@ -48,7 +48,9 @@ def reconstruct(shards: dict[int, np.ndarray], data_shards: int,
     """Rebuild missing shards from the survivors.
 
     shards: {index: bytes-array} of the available shards (each (L,) uint8).
-    Returns the full (n, L) shard matrix.
+    Returns the full (n, L) shard matrix. With data_only=True, missing
+    *parity* rows are left zero-filled — callers must only consume the data
+    rows in that mode (GET path); heal paths must use data_only=False.
     """
     n = data_shards + parity_shards
     present = 0

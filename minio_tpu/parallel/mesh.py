@@ -1,0 +1,112 @@
+"""Multi-chip sharding of the erasure data path.
+
+Mapping of the reference's distribution axes onto a TPU mesh (reference
+parallelism inventory: SURVEY §2.5):
+
+  dp ("data")     — independent objects/blocks: batch dim of the shard
+                    tensors. The analog of the reference's per-request
+                    goroutine fan-out (its RAM-gated admission control).
+  sp ("sequence") — byte columns of a block. Blocks are GF-columnwise
+                    independent, so a huge object's bytes shard across
+                    chips with zero cross-talk in encode/decode — the
+                    storage analog of sequence/context parallelism (no
+                    ring needed; the "attention" here is column-local).
+  tp              — output-shard rows (the coding matrix's rows) can be
+                    row-sharded for very wide sets; with n <= 32 shards
+                    the matrix is tiny, so tp is folded into dp unless
+                    explicitly requested.
+  ep              — erasure-set routing (sipHashMod object->set) stays on
+                    the host control plane (object/sets.py), exactly like
+                    the reference's static "expert" routing.
+
+Collectives used (all ride ICI inside a pool): all_gather to reassemble
+per-shard integrity tags across sp; psum for global counters/consistency
+checks. Cross-host traffic (remote drives) stays on the gRPC/HTTP data
+plane (storage/), mirroring the reference's DCN split.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import rs_matrix, rs_tpu
+from ..models import pipeline
+
+
+def make_mesh(n_devices: int | None = None,
+              devices=None) -> Mesh:
+    """Factor n devices into a (dp, sp) mesh, favoring sp (byte-column
+    sharding scales with object size; batch with request rate)."""
+    if devices is None:
+        devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+    n = len(devices)
+    sp = 1
+    for cand in range(min(n, 8), 0, -1):
+        if n % cand == 0:
+            sp = cand
+            break
+    dp = n // sp
+    dev_array = np.asarray(devices).reshape(dp, sp)
+    return Mesh(dev_array, axis_names=("dp", "sp"))
+
+
+def sharded_put_step(mesh: Mesh, k: int, m: int):
+    """Build the jitted multi-chip PUT step over `mesh`.
+
+    In:  data (B, k, S) uint8, B % dp == 0, S % (sp*128) == 0.
+    Out: parity (B, m, S) sharded like the input; tags (B, n, 128)
+         replicated along sp (XOR-combined across byte columns).
+    """
+    pm = np.asarray(rs_matrix.parity_matrix(k, m))
+    m2 = rs_tpu._bit_expand_cached(pm.tobytes(), pm.shape)
+
+    def local_step(data):  # data: (B/dp, k, S/sp)
+        parity = rs_tpu.gf_matmul_xla(jnp.asarray(m2, jnp.bfloat16), data)
+        full = jnp.concatenate([data, parity], axis=-2)
+        # local partial integrity tags, XOR-combined across the sp axis:
+        # all_gather + fold (XOR has no direct psum; gather stays tiny)
+        part = pipeline.xor_fold_digest(full)          # (B/dp, n, 128)
+        gathered = jax.lax.all_gather(part, "sp")      # (sp, B/dp, n, 128)
+        tags = jax.lax.reduce(gathered, np.uint8(0),
+                              jax.lax.bitwise_xor, (0,))
+        # global consistency counter (exercises psum across both axes)
+        total = jax.lax.psum(
+            jax.lax.psum(jnp.sum(parity.astype(jnp.int32) & 1), "sp"), "dp")
+        return parity, tags, total
+
+    from jax.experimental.shard_map import shard_map
+    fn = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None, "sp"),),
+        out_specs=(P("dp", None, "sp"), P("dp", None, None), P()),
+        check_rep=False)
+    return jax.jit(fn)
+
+
+def sharded_heal_step(mesh: Mesh, k: int, m: int, present_mask: int):
+    """Multi-chip heal: survivors (B, k, S) -> missing shards, sp/dp
+    sharded. Byte-column independence means zero collectives in the hot
+    math — the win of sequence-parallel erasure coding."""
+    r, _used, _missing = rs_matrix.recover_matrix(k, m, present_mask)
+    r = np.asarray(r)
+    m2 = rs_tpu._bit_expand_cached(r.tobytes(), r.shape)
+
+    def local_step(survivors):
+        return rs_tpu.gf_matmul_xla(jnp.asarray(m2, jnp.bfloat16), survivors)
+
+    from jax.experimental.shard_map import shard_map
+    fn = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None, "sp"),),
+        out_specs=P("dp", None, "sp"),
+        check_rep=False)
+    return jax.jit(fn)
+
+
+def shard_array(mesh: Mesh, arr, spec: P):
+    return jax.device_put(arr, NamedSharding(mesh, spec))

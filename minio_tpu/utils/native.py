@@ -1,0 +1,137 @@
+"""ctypes bindings to the native C++ library (native/*.cpp).
+
+The library is built on demand (make -C native) and provides:
+  * HighwayHash-64/256 (single-shot, batched, streaming) — the CPU bitrot
+    engine (reference behavior: cmd/bitrot.go algorithms).
+  * gf_matmul — GFNI/AVX-512 (or portable) GF(2^8) coding matmul — the CPU
+    fallback codec and bench baseline.
+
+Everything degrades gracefully: if the shared library is missing and make
+fails, `available()` returns False and pure-Python/numpy fallbacks take
+over (slower, same bytes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libminio_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                       check=True, capture_output=True, timeout=300)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.hh64.restype = ctypes.c_uint64
+        lib.hh64.argtypes = [u8p, u8p, ctypes.c_size_t]
+        lib.hh256.restype = None
+        lib.hh256.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
+        lib.hh256_batch.restype = None
+        lib.hh256_batch.argtypes = [u8p, u8p, ctypes.c_size_t,
+                                    ctypes.c_size_t, ctypes.c_size_t, u8p]
+        lib.hh_init.restype = None
+        lib.hh_init.argtypes = [u8p, u8p]
+        lib.hh_update_packets.restype = None
+        lib.hh_update_packets.argtypes = [u8p, u8p, ctypes.c_size_t]
+        lib.hh_final256.restype = None
+        lib.hh_final256.argtypes = [u8p, u8p, ctypes.c_size_t, u8p]
+        lib.gf_matmul.restype = None
+        lib.gf_matmul.argtypes = [u8p, ctypes.c_size_t, ctypes.c_size_t,
+                                  u8p, ctypes.c_size_t,
+                                  u8p, ctypes.c_size_t, ctypes.c_size_t,
+                                  ctypes.c_int]
+        lib.gf_has_gfni.restype = ctypes.c_int
+        lib.gf_has_gfni.argtypes = []
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def hh64(key: bytes, data: bytes | np.ndarray) -> int:
+    lib = get_lib()
+    assert lib is not None
+    k = np.frombuffer(key, dtype=np.uint8)
+    d = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray)) else np.ascontiguousarray(data, np.uint8)
+    return int(lib.hh64(_u8p(k), _u8p(d), d.size))
+
+
+def hh256(key: bytes, data: bytes | np.ndarray) -> bytes:
+    lib = get_lib()
+    assert lib is not None
+    k = np.frombuffer(key, dtype=np.uint8)
+    d = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray)) else np.ascontiguousarray(data, np.uint8)
+    out = np.zeros(32, dtype=np.uint8)
+    lib.hh256(_u8p(k), _u8p(d), d.size, _u8p(out))
+    return out.tobytes()
+
+
+def hh256_batch(key: bytes, shards: np.ndarray) -> np.ndarray:
+    """Hash each row of a contiguous (n, L) uint8 array -> (n, 32)."""
+    lib = get_lib()
+    assert lib is not None
+    shards = np.ascontiguousarray(shards, np.uint8)
+    n, length = shards.shape
+    k = np.frombuffer(key, dtype=np.uint8)
+    out = np.zeros((n, 32), dtype=np.uint8)
+    lib.hh256_batch(_u8p(k), _u8p(shards), n, length, shards.strides[0],
+                    _u8p(out))
+    return out
+
+
+def gf_matmul(matrix: np.ndarray, data: np.ndarray,
+              force_path: int = 0) -> np.ndarray:
+    """out(r,L) = matrix(r,k) (x) data(k,L) over GF(2^8), native speed."""
+    lib = get_lib()
+    assert lib is not None
+    matrix = np.ascontiguousarray(matrix, np.uint8)
+    data = np.ascontiguousarray(data, np.uint8)
+    r, k = matrix.shape
+    k2, length = data.shape
+    assert k == k2
+    out = np.zeros((r, length), dtype=np.uint8)
+    lib.gf_matmul(_u8p(matrix), r, k, _u8p(data), data.strides[0],
+                  _u8p(out), out.strides[0], length, force_path)
+    return out
+
+
+def has_gfni() -> bool:
+    lib = get_lib()
+    return bool(lib and lib.gf_has_gfni())

@@ -1,0 +1,230 @@
+// HighwayHash — portable C++ implementation (algorithm is public domain).
+//
+// Role in this framework: HighwayHash-256 is the default per-shard bitrot
+// checksum (reference behavior: cmd/bitrot.go:30-58 — algorithm
+// "highwayhash256S" keyed with the magic pi-digest key). The hot GET/PUT
+// paths checksum every shard block; this library provides the CPU engine
+// (single-shot + batched) that the Python layer binds via ctypes. A
+// device-side batched implementation is the TPU counterpart.
+//
+// Layout notes: state is 4 u64 lanes per register (v0, v1, mul0, mul1).
+// The batched entry points hash many equal-length shards in one call to
+// amortize FFI overhead (one call per encode step, not per shard).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+struct HHState {
+  uint64_t v0[4];
+  uint64_t v1[4];
+  uint64_t mul0[4];
+  uint64_t mul1[4];
+};
+
+static const uint64_t kMul0[4] = {
+    0xdbe6d5d5fe4cce2full, 0xa4093822299f31d0ull,
+    0x13198a2e03707344ull, 0x243f6a8885a308d3ull};
+static const uint64_t kMul1[4] = {
+    0x3bd39e10cb0ef593ull, 0xc0acf169b5f18a8cull,
+    0xbe5466cf34e90c6cull, 0x452821e638d01377ull};
+
+inline uint64_t Rot32(uint64_t x) { return (x >> 32) | (x << 32); }
+
+inline void Reset(const uint64_t key[4], HHState* s) {
+  for (int i = 0; i < 4; ++i) {
+    s->mul0[i] = kMul0[i];
+    s->mul1[i] = kMul1[i];
+    s->v0[i] = kMul0[i] ^ key[i];
+    s->v1[i] = kMul1[i] ^ Rot32(key[i]);
+  }
+}
+
+inline void ZipperMergeAndAdd(const uint64_t v1, const uint64_t v0,
+                              uint64_t* add1, uint64_t* add0) {
+  *add0 += (((v0 & 0xff000000ull) | (v1 & 0xff00000000ull)) >> 24) |
+           (((v0 & 0xff0000000000ull) | (v1 & 0xff000000000000ull)) >> 16) |
+           (v0 & 0xff0000ull) | ((v0 & 0xff00ull) << 32) |
+           ((v1 & 0xff00000000000000ull) >> 8) | (v0 << 56);
+  *add1 += (((v1 & 0xff000000ull) | (v0 & 0xff00000000ull)) >> 24) |
+           (v1 & 0xff0000ull) | ((v1 & 0xff0000000000ull) >> 16) |
+           ((v1 & 0xff00ull) << 24) | ((v0 & 0xff000000000000ull) >> 8) |
+           ((v1 & 0xffull) << 48) | (v0 & 0xff00000000000000ull);
+}
+
+inline void Update(const uint64_t lanes[4], HHState* s) {
+  for (int i = 0; i < 4; ++i) {
+    s->v1[i] += s->mul0[i] + lanes[i];
+    s->mul0[i] ^= (s->v1[i] & 0xffffffff) * (s->v0[i] >> 32);
+    s->v0[i] += s->mul1[i];
+    s->mul1[i] ^= (s->v0[i] & 0xffffffff) * (s->v1[i] >> 32);
+  }
+  ZipperMergeAndAdd(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  ZipperMergeAndAdd(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  ZipperMergeAndAdd(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  ZipperMergeAndAdd(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+inline uint64_t Read64LE(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/ARM LE)
+}
+
+inline void UpdatePacket(const uint8_t* packet, HHState* s) {
+  uint64_t lanes[4] = {Read64LE(packet), Read64LE(packet + 8),
+                       Read64LE(packet + 16), Read64LE(packet + 24)};
+  Update(lanes, s);
+}
+
+inline void Rotate32By(uint64_t count, uint64_t lanes[4]) {
+  for (int i = 0; i < 4; ++i) {
+    uint32_t half0 = static_cast<uint32_t>(lanes[i] & 0xffffffff);
+    uint32_t half1 = static_cast<uint32_t>(lanes[i] >> 32);
+    lanes[i] = (count == 0)
+                   ? lanes[i]
+                   : ((static_cast<uint64_t>((half0 << count) |
+                                             (half0 >> (32 - count)))) |
+                      (static_cast<uint64_t>((half1 << count) |
+                                             (half1 >> (32 - count)))
+                       << 32));
+  }
+}
+
+inline void UpdateRemainder(const uint8_t* bytes, const size_t size_mod32,
+                            HHState* s) {
+  const size_t size_mod4 = size_mod32 & 3;
+  const uint8_t* remainder = bytes + (size_mod32 & ~3ull);
+  uint8_t packet[32] = {0};
+  for (int i = 0; i < 4; ++i) {
+    s->v0[i] += (static_cast<uint64_t>(size_mod32) << 32) + size_mod32;
+  }
+  Rotate32By(size_mod32, s->v1);
+  std::memcpy(packet, bytes, size_mod32 & ~3ull);
+  if (size_mod32 & 16) {
+    for (int i = 0; i < 4; ++i) {
+      // signed offset: reaches back into the already-copied bytes when
+      // size_mod4 < 4 (the upstream algorithm's unsigned wraparound,
+      // made explicit)
+      packet[28 + i] =
+          remainder[static_cast<ptrdiff_t>(size_mod4) + i - 4];
+    }
+  } else if (size_mod4) {
+    packet[16 + 0] = remainder[0];
+    packet[16 + 1] = remainder[size_mod4 >> 1];
+    packet[16 + 2] = remainder[size_mod4 - 1];
+  }
+  UpdatePacket(packet, s);
+}
+
+inline void Permute(const uint64_t v[4], uint64_t permuted[4]) {
+  permuted[0] = Rot32(v[2]);
+  permuted[1] = Rot32(v[3]);
+  permuted[2] = Rot32(v[0]);
+  permuted[3] = Rot32(v[1]);
+}
+
+inline void PermuteAndUpdate(HHState* s) {
+  uint64_t permuted[4];
+  Permute(s->v0, permuted);
+  Update(permuted, s);
+}
+
+inline void ModularReduction(uint64_t a3_unmasked, uint64_t a2, uint64_t a1,
+                             uint64_t a0, uint64_t* m1, uint64_t* m0) {
+  const uint64_t a3 = a3_unmasked & 0x3FFFFFFFFFFFFFFFull;
+  *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+  *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+inline void ProcessAll(const uint8_t* data, size_t size, HHState* s) {
+  size_t i;
+  for (i = 0; i + 32 <= size; i += 32) {
+    UpdatePacket(data + i, s);
+  }
+  if ((size & 31) != 0) UpdateRemainder(data + i, size & 31, s);
+}
+
+inline uint64_t Finalize64(HHState* s) {
+  for (int i = 0; i < 4; ++i) PermuteAndUpdate(s);
+  return s->v0[0] + s->v1[0] + s->mul0[0] + s->mul1[0];
+}
+
+inline void Finalize256(HHState* s, uint64_t hash[4]) {
+  for (int i = 0; i < 10; ++i) PermuteAndUpdate(s);
+  ModularReduction(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0],
+                   s->v0[1] + s->mul0[1], s->v0[0] + s->mul0[0],
+                   &hash[1], &hash[0]);
+  ModularReduction(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2],
+                   s->v0[3] + s->mul0[3], s->v0[2] + s->mul0[2],
+                   &hash[3], &hash[2]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// 64-bit variant, used for self-test against published vectors.
+uint64_t hh64(const uint8_t* key32, const uint8_t* data, size_t size) {
+  uint64_t key[4];
+  std::memcpy(key, key32, 32);
+  HHState s;
+  Reset(key, &s);
+  ProcessAll(data, size, &s);
+  return Finalize64(&s);
+}
+
+// 256-bit digest of one buffer (32-byte output, little-endian u64 x4).
+void hh256(const uint8_t* key32, const uint8_t* data, size_t size,
+           uint8_t* out32) {
+  uint64_t key[4];
+  std::memcpy(key, key32, 32);
+  HHState s;
+  Reset(key, &s);
+  ProcessAll(data, size, &s);
+  uint64_t hash[4];
+  Finalize256(&s, hash);
+  std::memcpy(out32, hash, 32);
+}
+
+// Batched 256-bit digests: n buffers of equal length `size`, laid out
+// contiguously with stride `stride` bytes; out = n x 32 bytes.
+// One FFI call per erasure-encode step (n = shards).
+void hh256_batch(const uint8_t* key32, const uint8_t* data, size_t n,
+                 size_t size, size_t stride, uint8_t* out) {
+  for (size_t j = 0; j < n; ++j) {
+    hh256(key32, data + j * stride, size, out + j * 32);
+  }
+}
+
+// Streaming interface: caller owns an opaque 128-byte state blob.
+void hh_init(const uint8_t* key32, uint8_t* state128) {
+  uint64_t key[4];
+  std::memcpy(key, key32, 32);
+  HHState s;
+  Reset(key, &s);
+  std::memcpy(state128, &s, sizeof(HHState));
+}
+
+// Append full 32-byte packets only (size % 32 == 0).
+void hh_update_packets(uint8_t* state128, const uint8_t* data, size_t size) {
+  HHState s;
+  std::memcpy(&s, state128, sizeof(HHState));
+  for (size_t i = 0; i + 32 <= size; i += 32) UpdatePacket(data + i, &s);
+  std::memcpy(state128, &s, sizeof(HHState));
+}
+
+// Final call: append remainder (< 32 bytes) and emit 256-bit digest.
+void hh_final256(uint8_t* state128, const uint8_t* remainder, size_t rem_size,
+                 uint8_t* out32) {
+  HHState s;
+  std::memcpy(&s, state128, sizeof(HHState));
+  if (rem_size) UpdateRemainder(remainder, rem_size & 31, &s);
+  uint64_t hash[4];
+  Finalize256(&s, hash);
+  std::memcpy(out32, hash, 32);
+}
+
+}  // extern "C"

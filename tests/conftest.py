@@ -1,16 +1,26 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Must run before jax is imported anywhere — pytest imports conftest first.
+The environment ships JAX_PLATFORMS=axon (remote TPU tunnel) and a
+sitecustomize that may import jax at interpreter startup. Tests must run
+on the local CPU backend (fast, 8 virtual devices for sharding tests), so
+we *override* the platform — backends initialize lazily, so doing this
+before any jax computation is sufficient even if jax is already imported.
+
 The driver's multichip dry-run uses the same mechanism
-(xla_force_host_platform_device_count), so tests exercise the identical
-virtual-mesh path.
+(xla_force_host_platform_device_count).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

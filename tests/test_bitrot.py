@@ -1,0 +1,140 @@
+"""Bitrot hashing tests: known-answer vectors, native<->Python identity,
+framing math."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu import bitrot
+from minio_tpu.ops.highwayhash_py import HighwayHash
+from minio_tpu.utils import native
+
+HH64_KEY = bytes(range(32))
+# Published HighwayHash-64 test vectors (key = 0x00..0x1f as 4 LE u64,
+# data = bytes 0..len-1). Lengths 0-7 exercise init/remainder/finalize.
+HH64_VECTORS = {
+    0: 0x907A56DE22C26E53,
+    1: 0x7EAB43AAC7CDDD78,
+    2: 0xB8D0569AB0B53D62,
+    3: 0x5C6BEFAB8A463D80,
+    4: 0xF205A46893007EDA,
+    5: 0x2B8A1668E4A94541,
+    6: 0xBD4CCC325BEFCA6F,
+    7: 0x4D02AE1738F59482,
+}
+
+PI_100_DECIMALS = (
+    "1415926535897932384626433832795028841971693993751058209749445923078164"
+    "062862089986280348253421170679")
+
+
+class TestHighwayHashPy:
+    @pytest.mark.parametrize("n,want", sorted(HH64_VECTORS.items()))
+    def test_hh64_vectors(self, n, want):
+        h = HighwayHash(HH64_KEY)
+        h.update(bytes(range(n)))
+        assert h.digest64() == want
+
+    def test_magic_key_derivation(self):
+        # The reference's magic bitrot key is HH256(zero_key, pi decimals)
+        # (reference constant: cmd/bitrot.go:31). Reproducing it proves
+        # byte-identity with the reference's hash library.
+        h = HighwayHash(bytes(32))
+        h.update(PI_100_DECIMALS.encode())
+        assert h.digest256() == bitrot.MAGIC_HIGHWAYHASH_KEY
+
+    def test_streaming_split_invariance(self):
+        data = bytes(range(256)) * 5
+        h1 = HighwayHash(HH64_KEY)
+        h1.update(data)
+        h2 = HighwayHash(HH64_KEY)
+        for i in range(0, len(data), 37):
+            h2.update(data[i:i + 37])
+        assert h1.digest256() == h2.digest256()
+        assert h1.digest64() == h2.digest64()
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+class TestNativeHH:
+    @pytest.mark.parametrize("n,want", sorted(HH64_VECTORS.items()))
+    def test_hh64_vectors(self, n, want):
+        assert native.hh64(HH64_KEY, bytes(range(n))) == want
+
+    def test_magic_key_derivation(self):
+        got = native.hh256(bytes(32), PI_100_DECIMALS.encode())
+        assert got == bitrot.MAGIC_HIGHWAYHASH_KEY
+
+    @pytest.mark.parametrize("n", [0, 1, 31, 32, 33, 63, 64, 100, 1000, 4097])
+    def test_native_matches_python(self, n):
+        data = bytes((i * 7 + 3) % 256 for i in range(n))
+        h = HighwayHash(HH64_KEY)
+        h.update(data)
+        assert native.hh64(HH64_KEY, data) == h.digest64()
+        hp = HighwayHash(bitrot.MAGIC_HIGHWAYHASH_KEY)
+        hp.update(data)
+        assert native.hh256(bitrot.MAGIC_HIGHWAYHASH_KEY, data) == hp.digest256()
+
+    def test_batch_matches_single(self):
+        rng = np.random.default_rng(0)
+        shards = rng.integers(0, 256, (16, 1000)).astype(np.uint8)
+        got = native.hh256_batch(bitrot.MAGIC_HIGHWAYHASH_KEY, shards)
+        for i in range(16):
+            want = native.hh256(bitrot.MAGIC_HIGHWAYHASH_KEY,
+                                shards[i].tobytes())
+            assert got[i].tobytes() == want
+
+    def test_streaming_interface(self):
+        data = bytes(range(200))
+        h = bitrot._NativeHH256()
+        for i in range(0, len(data), 13):
+            h.update(data[i:i + 13])
+        hp = HighwayHash(bitrot.MAGIC_HIGHWAYHASH_KEY)
+        hp.update(data)
+        assert h.digest() == hp.digest256()
+        # digest() must not consume state: calling twice is stable
+        assert h.digest() == hp.digest256()
+
+
+class TestBitrotLayer:
+    def test_algorithm_names_match_reference(self):
+        # exact names the reference serializes into xl.meta
+        assert {a.value for a in bitrot.BitrotAlgorithm} == {
+            "sha256", "blake2b", "highwayhash256", "highwayhash256S"}
+        assert bitrot.DEFAULT_BITROT_ALGORITHM.value == "highwayhash256S"
+        assert bitrot.BitrotAlgorithm.from_string("sha256") is \
+            bitrot.BitrotAlgorithm.SHA256
+        with pytest.raises(ValueError):
+            bitrot.BitrotAlgorithm.from_string("md5")
+
+    def test_hashers(self):
+        data = b"hello bitrot"
+        assert bitrot.hash_shard(data, bitrot.BitrotAlgorithm.SHA256) == \
+            hashlib.sha256(data).digest()
+        assert bitrot.hash_shard(data, bitrot.BitrotAlgorithm.BLAKE2B512) == \
+            hashlib.blake2b(data, digest_size=64).digest()
+        hh = bitrot.hash_shard(data, bitrot.BitrotAlgorithm.HIGHWAYHASH256S)
+        h = HighwayHash(bitrot.MAGIC_HIGHWAYHASH_KEY)
+        h.update(data)
+        assert hh == h.digest256()
+
+    def test_shard_file_size_math(self):
+        a = bitrot.BitrotAlgorithm.HIGHWAYHASH256S
+        # one block exactly
+        assert bitrot.bitrot_shard_file_size(100, 100, a) == 100 + 32
+        # two blocks (one partial)
+        assert bitrot.bitrot_shard_file_size(101, 100, a) == 101 + 64
+        # whole-file algo: no framing overhead
+        assert bitrot.bitrot_shard_file_size(
+            101, 100, bitrot.BitrotAlgorithm.SHA256) == 101
+        assert bitrot.bitrot_shard_file_size(0, 100, a) == 0
+
+    def test_batch_hash_all_algos(self):
+        rng = np.random.default_rng(1)
+        shards = rng.integers(0, 256, (4, 257)).astype(np.uint8)
+        for algo in bitrot.BitrotAlgorithm:
+            got = bitrot.hash_shards_batch(shards, algo)
+            assert got.shape == (4, algo.digest_size)
+            for i in range(4):
+                assert got[i].tobytes() == bitrot.hash_shard(
+                    shards[i].tobytes(), algo)
