@@ -33,7 +33,9 @@ from ..ops import rs_matrix, rs_tpu
 class ECConfig:
     """Erasure-set geometry: k data + m parity shards over blockSize-byte
     blocks (reference defaults: block 4 MiB; this framework benches 1 MiB
-    per BASELINE config)."""
+    per BASELINE config). Placement math delegates to
+    storage.datatypes.ErasureInfo so there is exactly one copy of the
+    cmd/erasure-coding.go:120-143 formulas."""
     data_shards: int
     parity_shards: int
     block_size: int = 1 << 20
@@ -42,29 +44,23 @@ class ECConfig:
     def total_shards(self) -> int:
         return self.data_shards + self.parity_shards
 
+    def _erasure_info(self):
+        from ..storage.datatypes import ErasureInfo
+        return ErasureInfo(data_blocks=self.data_shards,
+                           parity_blocks=self.parity_shards,
+                           block_size=self.block_size)
+
     @property
     def shard_size(self) -> int:
         """Per-shard bytes of one full block (ceil division, zero-padded:
         same split semantics as the reference codec)."""
-        return -(-self.block_size // self.data_shards)
+        return self._erasure_info().shard_size()
 
     def shard_file_size(self, total_length: int) -> int:
-        """Size of one shard's payload for an object of total_length bytes
-        (reference math: cmd/erasure-coding.go:120-131)."""
-        if total_length <= 0:
-            return max(total_length, -1)
-        full = total_length // self.block_size
-        last = total_length % self.block_size
-        last_shard = -(-last // self.data_shards)
-        return full * self.shard_size + last_shard
+        return self._erasure_info().shard_file_size(total_length)
 
     def shard_file_offset(self, start: int, length: int, total: int) -> int:
-        """Read-until offset in a shard file for a ranged read
-        (cmd/erasure-coding.go:134-143 semantics)."""
-        shard_size = self.shard_size
-        sfs = self.shard_file_size(total)
-        till = ((start + length) // self.block_size) * shard_size + shard_size
-        return min(till, sfs)
+        return self._erasure_info().shard_file_offset(start, length, total)
 
 
 # ---------------------------------------------------------------------------

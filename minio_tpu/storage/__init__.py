@@ -1,0 +1,14 @@
+"""Per-drive storage layer: StorageAPI verbs, xl.meta v2, format.json v3,
+and the local POSIX drive (reference layer L6, SURVEY §2.3)."""
+
+from . import errors  # noqa: F401
+from .api import BitrotVerifier, StorageAPI  # noqa: F401
+from .datatypes import (BLOCK_SIZE_V1, ChecksumInfo, DiskInfo,  # noqa: F401
+                        ErasureInfo, FileInfo, ObjectInfo, ObjectPartInfo,
+                        VolInfo, hash_order, new_file_info)
+from .format import (FormatErasureV3, get_format_in_quorum,  # noqa: F401
+                     new_format_erasure_v3)
+from .xl_meta import XLMetaV2  # noqa: F401
+from .xl_storage import (MINIO_META_BUCKET, MINIO_META_MULTIPART_BUCKET,  # noqa: F401
+                         MINIO_META_TMP_BUCKET, XL_STORAGE_FORMAT_FILE,
+                         XLStorage)

@@ -44,7 +44,10 @@ def get_lib() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        # Always invoke make: it is a timestamp-based no-op when current,
+        # and rebuilds the .so after source edits (a pre-existing stale
+        # binary would otherwise be loaded silently forever).
+        if not _build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)

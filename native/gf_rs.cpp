@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <vector>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -100,8 +101,9 @@ __attribute__((target("avx512f,avx512bw,gfni")))
 void MatmulGfni(const uint8_t* matrix, size_t r, size_t k,
                 const uint8_t* data, size_t stride_in,
                 uint8_t* out, size_t stride_out, size_t len) {
-  // Precompute affine qwords for the whole matrix (r*k tiny).
-  uint64_t aff[64 * 64];  // supports up to 64x64 matrices; callers are <=32x32
+  // Precompute affine qwords for the whole matrix (r*k tiny; heap so an
+  // arbitrarily large recovery matrix can never overrun the stack).
+  std::vector<uint64_t> aff(r * k);
   for (size_t j = 0; j < r; ++j)
     for (size_t i = 0; i < k; ++i)
       aff[j * k + i] = AffineQword(matrix[j * k + i]);

@@ -1,0 +1,340 @@
+"""Storage layer tests: xl.meta journal, format.json quorum, POSIX drive
+verbs, bitrot verify (mirrors the reference's xl-storage/xl-meta tests)."""
+
+import io
+import os
+import uuid
+
+import pytest
+
+from minio_tpu import bitrot
+from minio_tpu.storage import (BLOCK_SIZE_V1, FileInfo, FormatErasureV3,
+                               XLMetaV2, XLStorage, errors,
+                               get_format_in_quorum, hash_order,
+                               new_file_info, new_format_erasure_v3)
+from minio_tpu.storage.xl_meta import is_xl2_v1_format
+
+
+# ---------------------------------------------------------------------------
+# hash_order (placement-compatibility critical)
+# ---------------------------------------------------------------------------
+
+def test_hash_order_reference_vectors():
+    # crc32("object")%4 == computed here once; property-level checks:
+    order = hash_order("object", 4)
+    assert sorted(order) == [1, 2, 3, 4]
+    # deterministic
+    assert order == hash_order("object", 4)
+    # rotation structure: consecutive mod cardinality
+    zero = [x - 1 for x in order]
+    for i in range(3):
+        assert zero[(i + 1)] == (zero[i] + 1) % 4
+    assert hash_order("x", 0) == []
+    # known value: crc32 of "mybucket/myobject"
+    import zlib
+    key = "mybucket/myobject"
+    start = zlib.crc32(key.encode()) % 16
+    got = hash_order(key, 16)
+    assert got[0] == 1 + ((start + 1) % 16)
+
+
+# ---------------------------------------------------------------------------
+# xl.meta
+# ---------------------------------------------------------------------------
+
+def _sample_fi(version_id="", n_parts=1, deleted=False, mod_time=1000.0):
+    fi = new_file_info("bucket/obj", 4, 2)
+    fi.volume, fi.name = "bucket", "obj"
+    fi.version_id = version_id
+    fi.deleted = deleted
+    fi.data_dir = str(uuid.uuid4())
+    fi.mod_time = mod_time
+    fi.size = 1234
+    fi.metadata = {"etag": "abc", "content-type": "text/plain",
+                   "x-minio-internal-compressed": "s2"}
+    for i in range(1, n_parts + 1):
+        fi.add_object_part(i, f"etag{i}", 1234, 1234)
+    return fi
+
+
+def test_xlmeta_roundtrip():
+    fi = _sample_fi()
+    z = XLMetaV2()
+    z.add_version(fi)
+    buf = z.dumps()
+    assert is_xl2_v1_format(buf)
+    assert buf[:8] == b"XL2 1   "
+
+    z2 = XLMetaV2.loads(buf)
+    got = z2.to_file_info("bucket", "obj")
+    assert got.size == 1234
+    assert got.data_dir == fi.data_dir
+    assert abs(got.mod_time - 1000.0) < 1e-6
+    assert got.metadata["etag"] == "abc"
+    assert got.metadata["x-minio-internal-compressed"] == "s2"
+    assert got.erasure.data_blocks == 4
+    assert got.erasure.parity_blocks == 2
+    assert got.erasure.distribution == fi.erasure.distribution
+    assert got.parts[0].etag == "etag1"
+    assert got.is_latest
+
+
+def test_xlmeta_versions_latest_and_delete_marker():
+    z = XLMetaV2()
+    v1, v2 = str(uuid.uuid4()), str(uuid.uuid4())
+    z.add_version(_sample_fi(v1, mod_time=1000.0))
+    z.add_version(_sample_fi(v2, mod_time=2000.0))
+    latest = z.to_file_info("bucket", "obj")
+    assert latest.version_id == v2 and latest.is_latest
+    old = z.to_file_info("bucket", "obj", v1)
+    assert old.version_id == v1 and not old.is_latest
+
+    # delete marker becomes latest
+    dm = FileInfo(name="obj", version_id=str(uuid.uuid4()),
+                  deleted=True, mod_time=3000.0)
+    z.add_version(dm)
+    latest = z.to_file_info("bucket", "obj")
+    assert latest.deleted and latest.is_latest
+
+    # delete a version -> returns its data dir
+    dd, last = z.delete_version(FileInfo(name="obj", version_id=v1))
+    assert dd and not last
+    with pytest.raises(errors.FileVersionNotFound):
+        z.to_file_info("bucket", "obj", v1)
+
+
+def test_xlmeta_null_version():
+    z = XLMetaV2()
+    z.add_version(_sample_fi(""))  # null version
+    fi = z.to_file_info("bucket", "obj", "null")
+    assert fi.version_id == ""
+    # replacing the null version keeps one entry
+    z.add_version(_sample_fi("", mod_time=5000.0))
+    assert len(z.versions) == 1
+
+
+def test_xlmeta_corrupt():
+    with pytest.raises(errors.FileCorrupt):
+        XLMetaV2.loads(b"garbage-not-xl2-format!")
+
+
+# ---------------------------------------------------------------------------
+# format.json
+# ---------------------------------------------------------------------------
+
+def test_format_roundtrip_and_quorum():
+    fmts = new_format_erasure_v3(2, 4)
+    flat = [f for row in fmts for f in row]
+    assert len({f.id for f in flat}) == 1
+    assert len({f.this for f in flat}) == 8
+
+    # json round trip
+    f0 = FormatErasureV3.from_json(flat[0].to_json())
+    assert f0.this == flat[0].this
+    assert f0.sets == flat[0].sets
+    assert f0.distribution_algo == "SIPMOD"
+
+    # quorum with 3 missing
+    ref = get_format_in_quorum(flat[:5] + [None] * 3)
+    assert ref.sets == flat[0].sets
+
+    # no quorum
+    with pytest.raises(errors.StorageError):
+        get_format_in_quorum([flat[0]] + [None] * 7)
+
+    si, di = flat[0].find_disk_index(flat[0].this)
+    assert (si, di) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# XLStorage drive verbs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def drive(tmp_path):
+    d = XLStorage(str(tmp_path / "drive0"))
+    fmts = new_format_erasure_v3(1, 4)
+    d.write_format(fmts[0][0])
+    return d
+
+
+def test_drive_format_identity(drive):
+    assert drive.get_disk_id() == drive.read_format().this
+    info = drive.disk_info()
+    assert info.total > 0 and info.disk_id == drive.get_disk_id()
+
+
+def test_drive_volumes(drive):
+    drive.make_vol("bucket1")
+    with pytest.raises(errors.VolumeExists):
+        drive.make_vol("bucket1")
+    assert "bucket1" in [v.name for v in drive.list_vols()]
+    assert drive.stat_vol("bucket1").name == "bucket1"
+    with pytest.raises(errors.VolumeNotFound):
+        drive.stat_vol("nope")
+    drive.write_all("bucket1", "x/y", b"abc")
+    with pytest.raises(errors.VolumeNotEmpty):
+        drive.delete_vol("bucket1")
+    drive.delete_vol("bucket1", force=True)
+    with pytest.raises(errors.VolumeNotFound):
+        drive.stat_vol("bucket1")
+
+
+def test_drive_files(drive):
+    drive.make_vol("b")
+    drive.write_all("b", "dir/file", b"hello world")
+    assert drive.read_all("b", "dir/file") == b"hello world"
+    with pytest.raises(errors.FileNotFound):
+        drive.read_all("b", "missing")
+    with pytest.raises(errors.VolumeNotFound):
+        drive.read_all("novol", "x")
+
+    # create_file exact-size contract
+    drive.create_file("b", "cf", 5, io.BytesIO(b"12345"))
+    assert drive.read_all("b", "cf") == b"12345"
+    with pytest.raises(errors.LessData):
+        drive.create_file("b", "cf2", 10, io.BytesIO(b"123"))
+    with pytest.raises(errors.MoreData):
+        drive.create_file("b", "cf3", 2, io.BytesIO(b"12345"))
+
+    # append + ranged read
+    drive.append_file("b", "ap", b"aaa")
+    drive.append_file("b", "ap", b"bbb")
+    assert drive.read_file("b", "ap", 2, 3) == b"abb"
+
+    # stream
+    r = drive.read_file_stream("b", "ap", 1, 4)
+    assert r.read() == b"aabb"
+    r.close()
+
+    # rename cleans empty parents
+    drive.rename_file("b", "dir/file", "b", "dir2/file2")
+    assert not os.path.isdir(os.path.join(drive.root, "b", "dir"))
+    assert drive.read_all("b", "dir2/file2") == b"hello world"
+
+    # delete cleans empty parents
+    drive.delete_file("b", "dir2/file2")
+    assert not os.path.isdir(os.path.join(drive.root, "b", "dir2"))
+
+
+def test_drive_metadata_roundtrip(drive):
+    drive.make_vol("b")
+    fi = _sample_fi()
+    drive.write_metadata("b", "obj", fi)
+    got = drive.read_version("b", "obj")
+    assert got.size == fi.size and got.data_dir == fi.data_dir
+    versions = drive.read_versions("b", "obj")
+    assert len(versions) == 1
+
+    drive.delete_version("b", "obj", got)
+    with pytest.raises(errors.FileNotFound):
+        drive.read_version("b", "obj")
+
+
+def test_drive_rename_data_two_phase_commit(drive):
+    """Staged tmp write -> RenameData == atomic publish."""
+    drive.make_vol("b")
+    tmp_vol = ".minio.sys/tmp"
+    tmp_id = str(uuid.uuid4())
+    fi = _sample_fi()
+    # stage: shard + xl.meta under tmp
+    drive.write_all(tmp_vol, f"{tmp_id}/{fi.data_dir}/part.1", b"shard-bytes")
+    drive.write_metadata(tmp_vol, tmp_id, fi)
+
+    drive.rename_data(tmp_vol, tmp_id, fi.data_dir, "b", "obj")
+    got = drive.read_version("b", "obj")
+    assert got.data_dir == fi.data_dir
+    assert drive.read_all("b", f"obj/{fi.data_dir}/part.1") == b"shard-bytes"
+    # tmp is gone
+    with pytest.raises(errors.FileNotFound):
+        drive.read_all(tmp_vol, f"{tmp_id}/{fi.data_dir}/part.1")
+
+    # overwrite via second rename_data replaces the null version
+    fi2 = _sample_fi(mod_time=2000.0)
+    tmp_id2 = str(uuid.uuid4())
+    drive.write_all(tmp_vol, f"{tmp_id2}/{fi2.data_dir}/part.1", b"v2")
+    drive.write_metadata(tmp_vol, tmp_id2, fi2)
+    drive.rename_data(tmp_vol, tmp_id2, fi2.data_dir, "b", "obj")
+    got2 = drive.read_version("b", "obj")
+    assert got2.data_dir == fi2.data_dir
+    assert len(drive.read_versions("b", "obj")) == 1  # null replaced
+
+
+def test_drive_walk(drive):
+    drive.make_vol("b")
+    for name in ["a/1", "a/2", "z"]:
+        fi = _sample_fi()
+        tmp_id = str(uuid.uuid4())
+        drive.write_all(".minio.sys/tmp",
+                        f"{tmp_id}/{fi.data_dir}/part.1", b"x")
+        drive.write_metadata(".minio.sys/tmp", tmp_id, fi)
+        drive.rename_data(".minio.sys/tmp", tmp_id, fi.data_dir, "b", name)
+    names = [fi.name for fi in drive.walk("b")]
+    assert names == ["a/1", "a/2", "z"]
+    names = [fi.name for fi in drive.walk("b", dir_path="a")]
+    assert names == ["a/1", "a/2"]
+
+
+def test_drive_verify_file_streaming_bitrot(drive, tmp_path):
+    """Streaming framing [digest||block]* round-trips through verify and a
+    flipped byte is caught (reference bitrotVerify)."""
+    drive.make_vol("b")
+    algo = bitrot.DEFAULT_BITROT_ALGORITHM
+    fi = new_file_info("b/o", 4, 2)
+    fi.volume, fi.name = "b", "o"
+    fi.data_dir = str(uuid.uuid4())
+    fi.erasure.block_size = 1024  # small blocks for the test
+    part_size = fi.erasure.shard_file_size(4096)
+    shard_size = fi.erasure.shard_size()
+    fi.size = 4096
+    fi.add_object_part(1, "", 4096, 4096)
+    fi.erasure.checksums = []
+    from minio_tpu.storage.datatypes import ChecksumInfo
+    fi.erasure.checksums.append(ChecksumInfo(1, algo.value, b""))
+
+    # build a framed shard file: per block digest||block
+    payload = os.urandom(part_size)
+    framed = b""
+    off = 0
+    while off < part_size:
+        blk = payload[off:off + shard_size]
+        framed += bitrot.hash_shard(blk, algo) + blk
+        off += shard_size
+    drive.write_all("b", f"o/{fi.data_dir}/part.1", framed)
+
+    drive.verify_file("b", "o", fi)   # passes
+    drive.check_parts("b", "o", fi)   # sizes ok
+
+    # flip one payload byte -> mismatch
+    bad = bytearray(framed)
+    bad[algo.digest_size + 3] ^= 0xFF
+    drive.write_all("b", f"o/{fi.data_dir}/part.1", bytes(bad))
+    with pytest.raises(errors.BitrotHashMismatch):
+        drive.verify_file("b", "o", fi)
+
+
+def test_drive_path_traversal_rejected(drive):
+    drive.make_vol("b")
+    for bad in ["../x", "a/../../x", "/etc/passwd", "..\\x"]:
+        with pytest.raises(errors.FileAccessDenied):
+            drive.read_all("b", bad)
+    with pytest.raises(errors.FileAccessDenied):
+        drive.delete_file("b", "../../outside", recursive=True)
+    with pytest.raises((errors.FileAccessDenied, errors.VolumeNotFound)):
+        drive.stat_vol("../escape")
+
+
+def test_shard_file_math():
+    fi = new_file_info("x", 12, 4)
+    ei = fi.erasure
+    assert ei.block_size == BLOCK_SIZE_V1
+    ss = ei.shard_size()
+    assert ss == -(-BLOCK_SIZE_V1 // 12)
+    # one full block
+    assert ei.shard_file_size(BLOCK_SIZE_V1) == ss
+    # block + 1 byte
+    assert ei.shard_file_size(BLOCK_SIZE_V1 + 1) == ss + 1
+    assert ei.shard_file_size(0) == 0
+    # offset never exceeds file size
+    total = 3 * BLOCK_SIZE_V1 + 17
+    assert ei.shard_file_offset(0, total, total) == ei.shard_file_size(total)
