@@ -1,0 +1,14 @@
+"""Object engine: erasure-coded object CRUD + multipart + healing on one
+erasure set (reference layers L4a/L5, SURVEY §2.1-2.2)."""
+
+from . import api_errors  # noqa: F401
+from .codec import Codec  # noqa: F401
+from .engine import ErasureObjects, GetOptions, PutOptions  # noqa: F401
+from .hash_reader import HashReader  # noqa: F401
+from .healing import HealMixin, HealResultItem  # noqa: F401
+from .multipart import CompletePart, MultipartMixin, PartInfo  # noqa: F401
+from .nslock import NSLock, NSLockMap  # noqa: F401
+
+
+class ErasureSetObjects(MultipartMixin, HealMixin):
+    """The full per-set object engine: CRUD + multipart + heal."""

@@ -1,0 +1,149 @@
+"""Host-side erasure codec: split/join + encode/reconstruct routing.
+
+The engine-facing seam shaped like the reference's codec wrapper
+(cmd/erasure-coding.go:28-112: EncodeData / DecodeDataBlocks /
+DecodeDataAndParityBlocks / split semantics). Two backends, picked per
+call by batch size — the generalized accelerator-offload pattern of the
+fork's QAT engine gate (pkg/hash/reader.go:189-206):
+
+  * native C++ GFNI/AVX-512 (utils/native.py) — low latency, small
+    batches / single blocks;
+  * TPU kernels (ops/rs_tpu.py) — batched blocks, amortizing dispatch.
+
+Both produce byte-identical shards (tests/test_rs_tpu.py oracle checks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..ops import rs_matrix, rs_ref, rs_tpu
+from ..utils import native
+
+# Batches at least this large go to the device (dispatch+transfer amortized).
+DEVICE_MIN_BYTES = int(os.environ.get("MINIO_TPU_DEVICE_MIN_BYTES",
+                                      str(8 << 20)))
+
+
+def _device_is_tpu() -> bool:
+    try:
+        import jax
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+class Codec:
+    """RS(k, m) over GF(2^8), klauspost-compatible matrices."""
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 block_size: int):
+        if not (1 <= data_shards <= 256 and 0 <= parity_shards
+                and data_shards + parity_shards <= 256):
+            raise ValueError("unsupported erasure geometry")
+        self.k = data_shards
+        self.m = parity_shards
+        self.block_size = block_size
+        self.shard_size = -(-block_size // data_shards)
+        self._parity_matrix = np.asarray(
+            rs_matrix.parity_matrix(self.k, self.m), dtype=np.uint8)
+
+    # -- split / join ------------------------------------------------------
+
+    def split(self, block: bytes | memoryview) -> np.ndarray:
+        """block -> (k, S) zero-padded shards, S = ceil(len/k)
+        (klauspost Split semantics via reference EncodeData,
+        cmd/erasure-coding.go:70-84)."""
+        n = len(block)
+        if n == 0:
+            return np.zeros((self.k, 0), dtype=np.uint8)
+        shard = -(-n // self.k)
+        buf = np.zeros(self.k * shard, dtype=np.uint8)
+        buf[:n] = np.frombuffer(block, dtype=np.uint8)
+        return buf.reshape(self.k, shard)
+
+    @staticmethod
+    def join(data_shards: np.ndarray, size: int) -> bytes:
+        """Concatenate data shards and trim padding."""
+        return data_shards.reshape(-1).tobytes()[:size]
+
+    # -- encode ------------------------------------------------------------
+
+    def encode_batch(self, data: np.ndarray, *, force: str = ""
+                     ) -> np.ndarray:
+        """(B, k, S) or (k, S) data shards -> parity appended (…, k+m, S).
+
+        force: "" auto-route, "native", "device", "numpy" (tests)."""
+        if self.m == 0:
+            return data
+        single = data.ndim == 2
+        batch = data[None] if single else data
+        path = force or self._route(batch.nbytes)
+        if path == "device":
+            out = np.asarray(rs_tpu.encode(batch, self.k, self.m))
+        elif path == "native" and native.available():
+            b, k, s = batch.shape
+            parity = np.empty((b, self.m, s), dtype=np.uint8)
+            for i in range(b):
+                parity[i] = native.gf_matmul(self._parity_matrix, batch[i])
+            out = np.concatenate([batch, parity], axis=1)
+        else:
+            out = np.stack([rs_ref.encode(batch[i], self.m)
+                            for i in range(batch.shape[0])])
+        return out[0] if single else out
+
+    def _route(self, nbytes: int) -> str:
+        if _device_is_tpu() and nbytes >= DEVICE_MIN_BYTES:
+            return "device"
+        if native.available():
+            return "native"
+        return "numpy"
+
+    # -- reconstruct -------------------------------------------------------
+
+    def reconstruct(self, shards: list[np.ndarray | None],
+                    data_only: bool = False, *, force: str = "",
+                    rows: Optional[set[int]] = None) -> list[np.ndarray]:
+        """Fill in missing (None) shards from >= k survivors.
+
+        shards: length k+m list in shard-index order; returns the full
+        list (or just data shards) — reference DecodeDataAndParityBlocks /
+        DecodeDataBlocks (cmd/erasure-coding.go:89-112). With `rows`, only
+        those shard indices are rebuilt (the heal path's exact-rows form;
+        others stay None).
+        """
+        n = self.k + self.m
+        if len(shards) != n:
+            raise ValueError("bad shard count")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.k:
+            from . import api_errors
+            raise api_errors.InsufficientReadQuorum(
+                f"{len(present)} shards < k={self.k}")
+        wanted = [i for i in range(n) if shards[i] is None
+                  and (not data_only or i < self.k)
+                  and (rows is None or i in rows)]
+        if not wanted:
+            return list(shards)  # type: ignore[arg-type]
+
+        mask = sum(1 << i for i in present)
+        rec, used, rec_missing = rs_matrix.recover_matrix(self.k, self.m,
+                                                          mask)
+        keep = [r for r, idx in enumerate(rec_missing) if idx in wanted]
+        rec = rec[keep]
+        rec_missing = tuple(idx for idx in rec_missing if idx in wanted)
+        stacked = np.stack([shards[i] for i in used])
+        path = force or self._route(stacked.nbytes)
+        if path == "device":
+            out = np.asarray(rs_tpu.apply_matrix(np.asarray(rec), stacked))
+        elif path == "native" and native.available():
+            out = native.gf_matmul(np.asarray(rec, dtype=np.uint8), stacked)
+        else:
+            out = rs_ref.apply_matrix(np.asarray(rec), stacked)
+        result = list(shards)
+        for row, idx in enumerate(rec_missing):
+            result[idx] = out[row]
+        return result  # type: ignore[return-value]

@@ -1,0 +1,689 @@
+"""ErasureObjects — object CRUD on one erasure set.
+
+The per-set object engine (reference erasureObjects, cmd/erasure-object.go
++ cmd/erasure.go): N = k+m drives, every object's shards distributed by
+hashOrder, xl.meta written to all drives, quorum-checked reads/writes,
+2-phase commit through .minio.sys/tmp.
+
+TPU-first deltas vs the reference's per-block loop:
+  * The PUT hot loop aggregates up to ENCODE_BATCH_BLOCKS full blocks and
+    encodes them as one (B, k, S) device batch (cmd/erasure-encode.go's
+    block loop, batched for the MXU), then hashes all shard rows in one
+    batched bitrot call.
+  * GET reconstruct stacks all blocks of a part that share an erasure
+    pattern into one batched matmul (cmd/erasure-decode.go:211 semantics).
+  * MD5/ETag runs on a background thread overlapped with encode — the
+    generalized QAT async-MD5 pattern (cmd/erasure-encode.go:113-124).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid as _uuid
+from typing import BinaryIO, Iterator, Optional
+
+import numpy as np
+
+from .. import bitrot as bitrot_mod
+from ..storage import errors as serr
+from ..storage.api import StorageAPI
+from ..storage.datatypes import (BLOCK_SIZE_V1, ChecksumInfo, FileInfo,
+                                 ObjectInfo, new_file_info, now)
+from ..storage.xl_storage import (MINIO_META_BUCKET,
+                                  MINIO_META_MULTIPART_BUCKET,
+                                  MINIO_META_TMP_BUCKET)
+from . import api_errors, bitrot_io, metadata as meta
+from .codec import Codec
+from .hash_reader import HashReader
+from .nslock import NSLockMap
+
+ENCODE_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_ENCODE_BATCH", "8"))
+
+# Reserved bucket names an S3 client can't touch.
+RESERVED_BUCKETS = (MINIO_META_BUCKET,)
+
+
+class PutOptions:
+    def __init__(self, metadata: Optional[dict] = None,
+                 version_id: str = "", versioned: bool = False,
+                 parity: Optional[int] = None):
+        self.metadata = dict(metadata or {})
+        self.version_id = version_id
+        self.versioned = versioned
+        self.parity = parity
+
+
+class GetOptions:
+    def __init__(self, version_id: str = ""):
+        self.version_id = version_id
+
+
+class ErasureObjects:
+    """One erasure set over `disks` (k data + m parity)."""
+
+    def __init__(self, disks: list[Optional[StorageAPI]],
+                 data_shards: int, parity_shards: int,
+                 block_size: int = BLOCK_SIZE_V1,
+                 ns_lock: Optional[NSLockMap] = None,
+                 bitrot_algo: bitrot_mod.BitrotAlgorithm =
+                 bitrot_mod.DEFAULT_BITROT_ALGORITHM,
+                 set_index: int = 0):
+        assert len(disks) == data_shards + parity_shards
+        self.disks = disks
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.block_size = block_size
+        self.bitrot_algo = bitrot_algo
+        self.ns = ns_lock or NSLockMap()
+        self.set_index = set_index
+        self._codec_cache: dict[tuple[int, int], Codec] = {}
+        # MRF hook: called (bucket, object) when a GET had to reconstruct
+        # or hit bitrot — the sets layer queues a heal (reference
+        # deepHealObject trigger, cmd/erasure-object.go:298-303)
+        self.on_degraded_read = None
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def codec(self, k: int, m: int) -> Codec:
+        key = (k, m)
+        if key not in self._codec_cache:
+            self._codec_cache[key] = Codec(k, m, self.block_size)
+        return self._codec_cache[key]
+
+    def get_disks(self) -> list[Optional[StorageAPI]]:
+        return list(self.disks)
+
+    def _default_quorums(self, parity: Optional[int] = None
+                         ) -> tuple[int, int, int, int]:
+        """(data, parity, readQuorum, writeQuorum) for a fresh object
+        (cmd/erasure-object.go:536-547)."""
+        m = self.parity_shards if parity is None else parity
+        k = len(self.disks) - m
+        return k, m, k, meta.write_quorum_for(k, m)
+
+    # ------------------------------------------------------------------
+    # bucket ops (cmd/erasure-bucket.go)
+    # ------------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        if bucket in RESERVED_BUCKETS or not bucket:
+            raise api_errors.BucketNameInvalid(bucket)
+        _, errs = meta.for_each_disk(
+            self.disks, lambda i, d: d.make_vol(bucket))
+        write_quorum = len(self.disks) // 2 + 1
+        exists = sum(1 for e in errs if isinstance(e, serr.VolumeExists))
+        if exists >= write_quorum:
+            raise api_errors.BucketExists(bucket)
+        ok = sum(1 for e in errs
+                 if e is None or isinstance(e, serr.VolumeExists))
+        if ok < write_quorum:
+            err = meta.reduce_write_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS + (serr.VolumeExists,),
+                write_quorum)
+            raise api_errors.to_object_err(
+                err or api_errors.InsufficientWriteQuorum(), bucket)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            self.get_bucket_info(bucket)
+            return True
+        except api_errors.BucketNotFound:
+            return False
+
+    def get_bucket_info(self, bucket: str):
+        results, errs = meta.for_each_disk(
+            self.disks, lambda i, d: d.stat_vol(bucket))
+        read_quorum = len(self.disks) // 2
+        err = meta.reduce_read_quorum_errs(
+            errs, meta.OBJECT_OP_IGNORED_ERRS, read_quorum)
+        if err is not None:
+            raise api_errors.to_object_err(err, bucket)
+        for r in results:
+            if r is not None:
+                return r
+        raise api_errors.BucketNotFound(bucket)
+
+    def list_buckets(self):
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                return [v for v in d.list_vols()
+                        if not v.name.startswith(".")]
+            except serr.StorageError:
+                continue
+        return []
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        def rm(i, d):
+            try:
+                d.delete_vol(bucket, force)
+            except serr.VolumeNotFound:
+                pass
+
+        _, errs = meta.for_each_disk(self.disks, rm)
+        write_quorum = len(self.disks) // 2 + 1
+        err = meta.reduce_write_quorum_errs(
+            errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise api_errors.to_object_err(err, bucket)
+
+    # ------------------------------------------------------------------
+    # PUT (cmd/erasure-object.go:521-703 + cmd/erasure-encode.go)
+    # ------------------------------------------------------------------
+
+    def put_object(self, bucket: str, object_name: str, reader,
+                   size: int = -1, opts: Optional[PutOptions] = None
+                   ) -> ObjectInfo:
+        opts = opts or PutOptions()
+        if isinstance(reader, (bytes, bytearray)):
+            import io as _io
+            size = len(reader)
+            reader = HashReader(_io.BytesIO(reader), size)
+        elif not isinstance(reader, HashReader):
+            reader = HashReader(reader, size)
+
+        k, m, _, write_quorum = self._default_quorums(opts.parity)
+        fi = new_file_info(f"{bucket}/{object_name}", k, m)
+        fi.erasure.block_size = self.block_size
+        fi.volume, fi.name = bucket, object_name
+        fi.data_dir = str(_uuid.uuid4())
+        if opts.versioned:
+            fi.version_id = opts.version_id or str(_uuid.uuid4())
+
+        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+        tmp_id = str(_uuid.uuid4())
+        part_path = f"{tmp_id}/{fi.data_dir}/part.1"
+        codec = self.codec(k, m)
+        shard_size = codec.shard_size
+
+        writers: list[Optional[object]] = []
+        for d in shuffled:
+            if d is None:
+                writers.append(None)
+                continue
+            writers.append(bitrot_io.new_bitrot_writer(
+                d, MINIO_META_TMP_BUCKET, part_path, -1,
+                self.bitrot_algo, shard_size))
+
+        try:
+            try:
+                total = self._encode_stream(reader, codec, writers,
+                                            write_quorum, bucket,
+                                            object_name)
+                reader.verify()
+            finally:
+                reader.close()  # stop the async hasher even on failure
+            etag = opts.metadata.pop("etag", "") or reader.md5_current_hex()
+
+            fi.size = total
+            fi.mod_time = now()
+            fi.metadata = dict(opts.metadata)
+            fi.metadata["etag"] = etag
+            fi.add_object_part(1, etag, total,
+                               reader.actual_size
+                               if reader.actual_size >= 0 else total)
+            fi.erasure.checksums = [
+                ChecksumInfo(1, self.bitrot_algo.value, b"")]
+
+            # per-drive metadata then commit (2-phase: tmp -> final)
+            with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+                self._commit(shuffled, writers, tmp_id, fi, bucket,
+                             object_name, write_quorum)
+        except Exception:
+            self._cleanup_tmp(shuffled, tmp_id)
+            raise
+        return fi.to_object_info(bucket, object_name)
+
+    def _encode_stream(self, reader, codec: Codec, writers,
+                       write_quorum: int, bucket: str,
+                       object_name: str) -> int:
+        """The PUT hot loop: read blocks, batch-encode, batch-hash,
+        fan-out framed writes. Returns total bytes."""
+        total = 0
+        pending: list[bytes] = []
+
+        def flush(blocks: list[bytes]) -> None:
+            if not blocks:
+                return
+            if len(blocks) > 1:
+                # full blocks share a shard length: one device batch
+                data = np.stack([codec.split(b) for b in blocks])
+                full = codec.encode_batch(data)
+            else:
+                full = codec.encode_batch(codec.split(blocks[0]))[None, ...]
+            b_, n_, s_ = full.shape
+            digests = bitrot_mod.hash_shards_batch(
+                full.reshape(b_ * n_, s_), self.bitrot_algo
+            ).reshape(b_, n_, -1)
+            for bi in range(b_):
+                self._write_shards(full[bi], digests[bi], writers,
+                                   write_quorum, bucket, object_name)
+
+        while True:
+            block = _read_full(reader, self.block_size)
+            if not block:
+                break
+            total += len(block)
+            if len(block) == self.block_size:
+                pending.append(block)
+                if len(pending) >= ENCODE_BATCH_BLOCKS:
+                    flush(pending)
+                    pending = []
+            else:
+                flush(pending)
+                pending = []
+                flush([block])
+                break
+        flush(pending)
+        return total
+
+    def _write_shards(self, shards: np.ndarray, digests: np.ndarray,
+                      writers, write_quorum: int, bucket: str,
+                      object_name: str) -> None:
+        """parallelWriter.Write: write shard i to writer i, tolerate
+        failures down to write quorum (cmd/erasure-encode.go:38-72)."""
+        def write(i: int, w) -> None:
+            w.write_with_digest(shards[i].tobytes(), digests[i].tobytes())
+
+        idx = list(range(len(writers)))
+        _, errs = meta.for_each_disk(
+            [writers[i] for i in idx],  # type: ignore[misc]
+            lambda i, w: write(i, w))
+        for i, e in enumerate(errs):
+            if e is not None:
+                writers[i] = None
+        live = sum(1 for w in writers if w is not None)
+        if live < write_quorum:
+            raise api_errors.InsufficientWriteQuorum(
+                f"{live} live writers < quorum {write_quorum}")
+
+    def _commit(self, shuffled, writers, tmp_id: str, fi: FileInfo,
+                bucket: str, object_name: str, write_quorum: int) -> None:
+        def close_writer(i, d):
+            w = writers[i]
+            if w is None:
+                raise serr.DiskNotFound(f"writer {i}")
+            w.close()  # flushes remaining frames (empty file for 0-byte)
+
+        _, errs = meta.for_each_disk(shuffled, close_writer)
+        for i, e in enumerate(errs):
+            if e is not None:
+                writers[i] = None
+
+        import copy
+        metas = [copy.deepcopy(fi) for _ in range(len(shuffled))]
+        if not self.bitrot_algo.streaming:
+            # whole-file digests are per-drive (each shard differs)
+            for i, w in enumerate(writers):
+                if w is not None:
+                    for c in metas[i].erasure.checksums:
+                        c.hash = w.digest()
+        disks_for_meta = [d if writers[i] is not None else None
+                          for i, d in enumerate(shuffled)]
+        meta.write_unique_file_info(disks_for_meta, MINIO_META_TMP_BUCKET,
+                                    tmp_id, metas, write_quorum)
+
+        def rename(i, d):
+            d.rename_data(MINIO_META_TMP_BUCKET, tmp_id, fi.data_dir,
+                          bucket, object_name)
+
+        _, errs = meta.for_each_disk(disks_for_meta, rename)
+        err = meta.reduce_write_quorum_errs(
+            errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise api_errors.to_object_err(err, bucket, object_name)
+
+    def _cleanup_tmp(self, disks, tmp_id: str) -> None:
+        def rm(i, d):
+            try:
+                d.delete_file(MINIO_META_TMP_BUCKET, tmp_id, recursive=True)
+            except serr.StorageError:
+                pass
+        meta.for_each_disk(disks, rm)
+
+    # ------------------------------------------------------------------
+    # GET (cmd/erasure-object.go:124-323 + cmd/erasure-decode.go)
+    # ------------------------------------------------------------------
+
+    def _object_file_info(self, bucket: str, object_name: str,
+                          version_id: str = ""
+                          ) -> tuple[FileInfo, list[Optional[FileInfo]],
+                                     list[Optional[StorageAPI]]]:
+        metas, errs = meta.read_all_file_info(self.disks, bucket,
+                                              object_name, version_id)
+        try:
+            read_quorum, _ = meta.object_quorum_from_meta(
+                metas, errs, self.parity_shards)
+        except (api_errors.InsufficientReadQuorum, serr.StorageError):
+            err = meta.reduce_read_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS,
+                len(self.disks) - self.parity_shards)
+            raise api_errors.to_object_err(
+                err or api_errors.InsufficientReadQuorum(),
+                bucket, object_name) from None
+        err = meta.reduce_read_quorum_errs(errs, meta.OBJECT_OP_IGNORED_ERRS,
+                                           read_quorum)
+        if err is not None:
+            raise api_errors.to_object_err(err, bucket, object_name)
+        fi = meta.pick_valid_file_info(metas, read_quorum)
+        online, _ = meta.list_online_disks(self.disks, metas, errs)
+        return fi, metas, online
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: Optional[GetOptions] = None) -> ObjectInfo:
+        opts = opts or GetOptions()
+        with self.ns.new_lock(f"{bucket}/{object_name}").read_locked():
+            fi, _, _ = self._object_file_info(bucket, object_name,
+                                              opts.version_id)
+        if fi.deleted:
+            if opts.version_id:
+                return fi.to_object_info(bucket, object_name)
+            raise api_errors.ObjectNotFound(bucket, object_name)
+        return fi.to_object_info(bucket, object_name)
+
+    def get_object(self, bucket: str, object_name: str,
+                   offset: int = 0, length: int = -1,
+                   opts: Optional[GetOptions] = None
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        """Returns (info, chunk iterator). Reads are verified (streaming
+        bitrot) and reconstructed on the fly when shards are missing."""
+        opts = opts or GetOptions()
+        lock = self.ns.new_lock(f"{bucket}/{object_name}")
+        if not lock.get_rlock(30.0):
+            raise api_errors.ObjectApiError("read lock timeout")
+        try:
+            fi, metas, online = self._object_file_info(
+                bucket, object_name, opts.version_id)
+            if fi.deleted:
+                raise api_errors.MethodNotAllowed(
+                    f"{bucket}/{object_name} is a delete marker")
+            oi = fi.to_object_info(bucket, object_name)
+            if length < 0:
+                length = fi.size - offset
+            if offset < 0 or length < 0 or offset + length > fi.size:
+                if not (fi.size == 0 and offset == 0 and length <= 0):
+                    raise api_errors.InvalidRange(offset, length, fi.size)
+        except Exception:
+            lock.unlock()
+            raise
+
+        def gen() -> Iterator[bytes]:
+            try:
+                if fi.size == 0 or length == 0:
+                    return
+                yield from self._read_object_stream(
+                    bucket, object_name, fi, metas, online, offset, length)
+            finally:
+                lock.unlock()
+
+        return oi, gen()
+
+    def _read_object_stream(self, bucket, object_name, fi: FileInfo,
+                            metas, online, offset: int, length: int
+                            ) -> Iterator[bytes]:
+        """Per-part block loop (getObjectWithFileInfo,
+        cmd/erasure-object.go:217-323)."""
+        shuffled_disks = meta.shuffle_disks(online, fi.erasure.distribution)
+        shuffled_meta = meta.shuffle_parts_metadata(metas,
+                                                    fi.erasure.distribution)
+        k = fi.erasure.data_blocks
+        codec = self.codec(k, fi.erasure.parity_blocks)
+
+        part_idx, part_off = fi.object_to_part_offset(offset)
+        remaining = length
+        for pi in range(part_idx, len(fi.parts)):
+            if remaining <= 0:
+                break
+            part = fi.parts[pi]
+            part_read_off = part_off if pi == part_idx else 0
+            part_read_len = min(remaining, part.size - part_read_off)
+            yield from self._read_part(
+                bucket, object_name, fi, shuffled_disks, shuffled_meta,
+                codec, part, part_read_off, part_read_len)
+            remaining -= part_read_len
+
+    def _read_part(self, bucket, object_name, fi: FileInfo, disks, smeta,
+                   codec: Codec, part, offset: int, length: int
+                   ) -> Iterator[bytes]:
+        n = len(disks)
+        k = fi.erasure.data_blocks
+        shard_size = fi.erasure.shard_size()
+        till = fi.erasure.shard_file_offset(offset, length, part.size)
+        path = f"{object_name}/{fi.data_dir}/part.{part.number}"
+
+        readers: list[Optional[object]] = [None] * n
+        for i, d in enumerate(disks):
+            if d is None or smeta[i] is None:
+                continue
+            csum = smeta[i].erasure.get_checksum_info(part.number)
+            algo = (bitrot_mod.BitrotAlgorithm.from_string(csum.algorithm)
+                    if csum else self.bitrot_algo)
+            readers[i] = bitrot_io.new_bitrot_reader(
+                d, bucket, path, till, algo,
+                csum.hash if csum else b"", shard_size)
+
+        start_block = offset // fi.erasure.block_size
+        end_block = (offset + length - 1) // fi.erasure.block_size
+        heal_required = False
+
+        for block_num in range(start_block, end_block + 1):
+            block_off = block_num * fi.erasure.block_size
+            block_len = min(fi.erasure.block_size, part.size - block_off)
+            shard_len = -(-block_len // k)
+            shards, had_errors = self._read_block_shards(
+                readers, codec, block_num, shard_size, shard_len, k, n)
+            heal_required = heal_required or had_errors
+            data = np.concatenate([s[:shard_len] for s in shards[:k]])
+            begin = max(offset - block_off, 0)
+            end = min(offset + length - block_off, block_len)
+            yield data.tobytes()[begin:end]
+
+        for r in readers:
+            if r is not None:
+                r.close()
+        if heal_required and self.on_degraded_read is not None:
+            try:
+                self.on_degraded_read(bucket, object_name)
+            except Exception:  # noqa: BLE001 — heal queueing is best-effort
+                pass
+
+    def _read_block_shards(self, readers, codec: Codec, block_num: int,
+                           shard_size: int, shard_len: int, k: int, n: int
+                           ) -> tuple[list, bool]:
+        """k-of-n shard reads with hedged extras on failure
+        (parallelReader, cmd/erasure-decode.go:102-184)."""
+        offset = block_num * shard_size
+        shards: list[Optional[np.ndarray]] = [None] * n
+        tried = [False] * n
+        had_errors = False
+
+        def try_read(indices: list[int]) -> None:
+            def read_one(j, r):
+                if r is None or tried[indices[j]]:
+                    raise serr.DiskNotFound(f"reader {indices[j]}")
+                data = r.read_at(offset, shard_len)
+                return indices[j], data
+
+            results, errs = meta.for_each_disk(
+                [readers[i] for i in indices],
+                read_one)
+            for j, (res, e) in enumerate(zip(results, errs)):
+                i = indices[j]
+                tried[i] = True
+                if e is None and res is not None:
+                    shards[i] = np.frombuffer(res[1], dtype=np.uint8)
+                elif e is not None:
+                    readers[i] = None
+
+        # preference: data shards first (avoids reconstruct entirely)
+        try_read([i for i in range(k) if readers[i] is not None])
+        got = sum(1 for s in shards if s is not None)
+        while got < k:
+            extras = [i for i in range(n)
+                      if readers[i] is not None and not tried[i]]
+            if not extras:
+                break
+            had_errors = True
+            try_read(extras[:k - got])
+            got = sum(1 for s in shards if s is not None)
+        if got < k:
+            raise api_errors.InsufficientReadQuorum(
+                f"{got} readable shards < k={k}")
+        if any(shards[i] is None for i in range(k)):
+            had_errors = True
+            shards = codec.reconstruct(shards, data_only=True)
+        return shards, had_errors
+
+    # ------------------------------------------------------------------
+    # DELETE (cmd/erasure-object.go:727-820)
+    # ------------------------------------------------------------------
+
+    def delete_object(self, bucket: str, object_name: str,
+                      version_id: str = "", versioned: bool = False
+                      ) -> ObjectInfo:
+        k, m, _, write_quorum = self._default_quorums()
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            if versioned and not version_id:
+                # versioned delete without a version: write a delete marker
+                fi = FileInfo(volume=bucket, name=object_name,
+                              version_id=str(_uuid.uuid4()), deleted=True,
+                              mod_time=now())
+                _, errs = meta.for_each_disk(
+                    self.disks,
+                    lambda i, d: d.write_metadata(bucket, object_name, fi))
+                err = meta.reduce_write_quorum_errs(
+                    errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+                if err is not None:
+                    raise api_errors.to_object_err(err, bucket, object_name)
+                oi = fi.to_object_info(bucket, object_name)
+                return oi
+
+            fi = FileInfo(volume=bucket, name=object_name,
+                          version_id=version_id)
+
+            def rm(i, d):
+                d.delete_version(bucket, object_name, fi)
+
+            _, errs = meta.for_each_disk(self.disks, rm)
+            # not-found is counted (not ignored) so a missing object maps
+            # to ObjectNotFound rather than a quorum failure
+            err = meta.reduce_write_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+            if err is not None:
+                raise api_errors.to_object_err(err, bucket, object_name)
+        return ObjectInfo(bucket=bucket, name=object_name,
+                          version_id=version_id)
+
+    def delete_objects(self, bucket: str, objects: list[str]
+                       ) -> list[Optional[Exception]]:
+        out: list[Optional[Exception]] = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 — per-key result list
+                out.append(e)
+        return out
+
+    # ------------------------------------------------------------------
+    # LIST (merge-walk across drives; cmd/erasure-sets.go:888-1081)
+    # ------------------------------------------------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> tuple[list[ObjectInfo], list[str], bool]:
+        """Returns (objects, common_prefixes, is_truncated)."""
+        self.get_bucket_info(bucket)  # existence + quorum check
+        names = self._merged_names(bucket, prefix)
+        objects: list[ObjectInfo] = []
+        prefixes: list[str] = []
+        seen_prefix: set[str] = set()
+        truncated = False
+        for name in names:
+            if marker and name <= marker:
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    p = prefix + rest[:di + len(delimiter)]
+                    if marker and p <= marker:
+                        continue  # prefix page already returned
+                    if p not in seen_prefix:
+                        seen_prefix.add(p)
+                        prefixes.append(p)
+                        if len(objects) + len(prefixes) >= max_keys + 1:
+                            truncated = True
+                            prefixes = prefixes[:max_keys - len(objects)]
+                            break
+                    continue
+            try:
+                fi = self._read_one(bucket, name)
+            except api_errors.ObjectApiError:
+                continue
+            if fi.deleted:
+                continue
+            objects.append(fi.to_object_info(bucket, name))
+            if len(objects) + len(prefixes) >= max_keys + 1:
+                truncated = True
+                objects = objects[:max_keys - len(prefixes)]
+                break
+        return objects, prefixes, truncated
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", max_keys: int = 1000
+                             ) -> list[ObjectInfo]:
+        self.get_bucket_info(bucket)
+        out: list[ObjectInfo] = []
+        for name in self._merged_names(bucket, prefix):
+            if marker and name <= marker:
+                continue
+            for d in self.disks:
+                if d is None:
+                    continue
+                try:
+                    for fi in d.read_versions(bucket, name):
+                        out.append(fi.to_object_info(bucket, name))
+                    break
+                except serr.StorageError:
+                    continue
+            if len(out) >= max_keys:
+                break
+        return out
+
+    def _merged_names(self, bucket: str, prefix: str) -> list[str]:
+        """Union of object names across drives, lexically sorted (the
+        merge-walk's effect; every drive carries every object's xl.meta)."""
+        names: set[str] = set()
+        live = 0
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                for fi in d.walk(bucket):
+                    if fi.name.startswith(prefix):
+                        names.add(fi.name)
+                live += 1
+            except serr.StorageError:
+                continue
+            if live >= 3:  # reference asks 3 random disks per set
+                break
+        return sorted(names)
+
+    def _read_one(self, bucket: str, object_name: str) -> FileInfo:
+        fi, _, _ = self._object_file_info(bucket, object_name)
+        return fi
+
+
+def _read_full(reader, n: int) -> bytes:
+    """io.ReadFull semantics: exactly n bytes unless EOF."""
+    buf = b""
+    while len(buf) < n:
+        chunk = reader.read(n - len(buf))
+        if not chunk:
+            break
+        buf += chunk
+    return buf

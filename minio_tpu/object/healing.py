@@ -1,0 +1,254 @@
+"""Object healing (reference cmd/erasure-healing.go).
+
+heal_object: find drives whose copy of an object is missing, stale, or
+bitrot-corrupt; rebuild exactly the missing shards from the healthy ones
+and commit them to the outdated drives via the same tmp→rename 2-phase
+commit as PUT (healObject, cmd/erasure-healing.go:220-489).
+
+TPU-first: reconstruction uses the *recover matrix* — decode and
+re-encode collapsed into one GF(2⁸) matmul producing only the lost shard
+rows (the device form of erasure-lowlevel-heal.go's decode→pipe→encode),
+batched over all blocks of a part.
+"""
+
+from __future__ import annotations
+
+import copy
+import uuid as _uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import bitrot as bitrot_mod
+from ..storage import errors as serr
+from ..storage.api import StorageAPI
+from ..storage.datatypes import FileInfo
+from ..storage.xl_storage import MINIO_META_TMP_BUCKET
+from . import api_errors, bitrot_io, metadata as meta
+from .engine import ErasureObjects
+
+
+@dataclass
+class HealResultItem:
+    """Summary of one heal operation (madmin HealResultItem shape)."""
+    bucket: str = ""
+    object: str = ""
+    version_id: str = ""
+    disks_total: int = 0
+    disks_healed: int = 0
+    missing_before: int = 0
+    missing_after: int = 0
+    healed_drives: list[str] = field(default_factory=list)
+    dangling_removed: bool = False
+
+
+class HealMixin(ErasureObjects):
+    def heal_bucket(self, bucket: str) -> None:
+        """Create the bucket volume on drives that miss it
+        (healBucket, cmd/erasure-healing.go)."""
+        def mk(i, d):
+            try:
+                d.stat_vol(bucket)
+            except serr.VolumeNotFound:
+                d.make_vol(bucket)
+
+        _, errs = meta.for_each_disk(self.disks, mk)
+        err = meta.reduce_write_quorum_errs(
+            errs, meta.OBJECT_OP_IGNORED_ERRS, len(self.disks) // 2 + 1)
+        if err is not None:
+            raise api_errors.to_object_err(err, bucket)
+
+    def heal_object(self, bucket: str, object_name: str,
+                    version_id: str = "", deep_scan: bool = False,
+                    dry_run: bool = False) -> HealResultItem:
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            return self._heal_object(bucket, object_name, version_id,
+                                     deep_scan, dry_run)
+
+    def _heal_object(self, bucket, object_name, version_id, deep_scan,
+                     dry_run) -> HealResultItem:
+        res = HealResultItem(bucket=bucket, object=object_name,
+                             version_id=version_id,
+                             disks_total=len(self.disks))
+        metas, errs = meta.read_all_file_info(self.disks, bucket,
+                                              object_name, version_id)
+        # quorum geometry of the latest copy
+        try:
+            read_quorum, write_quorum = meta.object_quorum_from_meta(
+                metas, errs, self.parity_shards)
+        except (api_errors.InsufficientReadQuorum, serr.StorageError):
+            # maybe dangling (too few copies to ever reconstruct):
+            n_meta = sum(1 for fi in metas if fi is not None)
+            if 0 < n_meta < len(self.disks) - self.parity_shards:
+                self._remove_dangling(bucket, object_name, version_id)
+                res.dangling_removed = True
+                return res
+            raise api_errors.to_object_err(
+                api_errors.InsufficientReadQuorum(), bucket,
+                object_name) from None
+
+        fi = meta.pick_valid_file_info(metas, read_quorum)
+        if fi.deleted:
+            # delete markers need only metadata replication
+            missing = [i for i, m in enumerate(metas)
+                       if m is None or m.mod_time != fi.mod_time]
+            res.missing_before = len(missing)
+            if not dry_run and missing:
+                for i in missing:
+                    d = self.disks[i]
+                    if d is None:
+                        continue
+                    try:
+                        d.write_metadata(bucket, object_name,
+                                         copy.deepcopy(fi))
+                        res.disks_healed += 1
+                    except serr.StorageError:
+                        pass
+            res.missing_after = sum(
+                1 for i in missing
+                if self.disks[i] is None)
+            return res
+
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+        smeta = meta.shuffle_parts_metadata(metas, fi.erasure.distribution)
+
+        # classify each shard-slot: healthy (latest meta + parts verify) or
+        # outdated (reference disksWithAllParts,
+        # cmd/erasure-healing-common.go:158)
+        healthy: list[Optional[StorageAPI]] = [None] * len(shuffled)
+        outdated: list[Optional[StorageAPI]] = [None] * len(shuffled)
+        for i, d in enumerate(shuffled):
+            if d is None:
+                continue
+            fi_i = smeta[i]
+            if fi_i is None or fi_i.mod_time != fi.mod_time or \
+                    fi_i.data_dir != fi.data_dir:
+                outdated[i] = d
+                continue
+            try:
+                d.check_parts(bucket, object_name, fi_i)
+                if deep_scan:
+                    d.verify_file(bucket, object_name, fi_i)
+                healthy[i] = d
+            except serr.StorageError:
+                outdated[i] = d
+
+        n_healthy = sum(1 for d in healthy if d is not None)
+        res.missing_before = len(shuffled) - n_healthy
+        if n_healthy < k:
+            raise api_errors.InsufficientReadQuorum(
+                f"heal: only {n_healthy} healthy shards < k={k}")
+        to_heal = [i for i in range(len(shuffled))
+                   if outdated[i] is not None]
+        if not to_heal or dry_run:
+            res.missing_after = res.missing_before
+            return res
+
+        tmp_id = str(_uuid.uuid4())
+        codec = self.codec(k, m)
+        try:
+            self._reconstruct_shards(bucket, object_name, fi, healthy,
+                                     smeta, to_heal, shuffled, tmp_id,
+                                     codec)
+            # write healed xl.meta + rename into place
+            heal_fi = copy.deepcopy(fi)
+            for i in to_heal:
+                d = shuffled[i]
+                if d is None:
+                    continue
+                f = copy.deepcopy(heal_fi)
+                f.erasure.index = i + 1
+                try:
+                    d.write_metadata(MINIO_META_TMP_BUCKET, tmp_id, f)
+                    d.rename_data(MINIO_META_TMP_BUCKET, tmp_id,
+                                  fi.data_dir, bucket, object_name)
+                    res.disks_healed += 1
+                    res.healed_drives.append(str(d))
+                except serr.StorageError:
+                    pass
+        finally:
+            self._cleanup_tmp(shuffled, tmp_id)
+
+        res.missing_after = res.missing_before - res.disks_healed
+        return res
+
+    def _reconstruct_shards(self, bucket, object_name, fi: FileInfo,
+                            healthy, smeta, to_heal, shuffled, tmp_id,
+                            codec) -> None:
+        """Per part: batched recover-matrix matmul over all blocks,
+        streaming results into bitrot writers for the outdated drives."""
+        n = len(shuffled)
+        k = fi.erasure.data_blocks
+        shard_size = fi.erasure.shard_size()
+
+        for part in fi.parts:
+            if part.size == 0:
+                # empty part: just create the empty framed file
+                for i in to_heal:
+                    d = shuffled[i]
+                    if d is not None:
+                        w = bitrot_io.new_bitrot_writer(
+                            d, MINIO_META_TMP_BUCKET,
+                            f"{tmp_id}/{fi.data_dir}/part.{part.number}",
+                            -1, self.bitrot_algo, shard_size)
+                        w.close()
+                continue
+            path = f"{object_name}/{fi.data_dir}/part.{part.number}"
+            till = fi.erasure.shard_file_offset(0, part.size, part.size)
+            readers: list[Optional[object]] = [None] * n
+            for i, d in enumerate(healthy):
+                if d is None:
+                    continue
+                csum = smeta[i].erasure.get_checksum_info(part.number)
+                algo = (bitrot_mod.BitrotAlgorithm.from_string(
+                    csum.algorithm) if csum else self.bitrot_algo)
+                readers[i] = bitrot_io.new_bitrot_reader(
+                    d, bucket, path, till, algo,
+                    csum.hash if csum else b"", shard_size)
+            writers: dict[int, object] = {}
+            for i in to_heal:
+                d = shuffled[i]
+                if d is not None:
+                    writers[i] = bitrot_io.new_bitrot_writer(
+                        d, MINIO_META_TMP_BUCKET,
+                        f"{tmp_id}/{fi.data_dir}/part.{part.number}",
+                        -1, self.bitrot_algo, shard_size)
+
+            n_blocks = -(-part.size // fi.erasure.block_size)
+            for b in range(n_blocks):
+                block_len = min(fi.erasure.block_size,
+                                part.size - b * fi.erasure.block_size)
+                shard_len = -(-block_len // k)
+                shards, _ = self._read_block_shards(
+                    readers, codec, b, shard_size, shard_len, k, n)
+                # rebuild exactly the rows being healed (recover-matrix
+                # rows for to_heal only; healthy-but-unread parity is NOT
+                # recomputed)
+                full = codec.reconstruct(
+                    [shards[i] if i < len(shards) and shards[i] is not None
+                     else None for i in range(n)],
+                    rows=set(writers.keys()))
+                for i, w in writers.items():
+                    w.write(np.ascontiguousarray(
+                        full[i][:shard_len]).tobytes())
+            for r in readers:
+                if r is not None:
+                    r.close()
+            for w in writers.values():
+                w.close()
+
+    def _remove_dangling(self, bucket, object_name, version_id) -> None:
+        """Too few copies survive to ever reconstruct: purge the remnants
+        (reference dangling-object GC, cmd/erasure-healing.go:311-325)."""
+        fi = FileInfo(volume=bucket, name=object_name,
+                      version_id=version_id)
+
+        def rm(i, d):
+            try:
+                d.delete_version(bucket, object_name, fi)
+            except serr.StorageError:
+                pass
+
+        meta.for_each_disk(self.disks, rm)

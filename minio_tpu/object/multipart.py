@@ -1,0 +1,311 @@
+"""Multipart upload sessions (reference cmd/erasure-multipart.go).
+
+Sessions live under ``.minio.sys/multipart/<sha256(bucket/object)>/
+<uploadID>/`` — a flat v3-format hierarchy: the session's xl.meta holds
+the user metadata + the parts recorded so far; each part is separately
+erasure-coded into ``<dataDir>/part.N`` with bitrot framing
+(PutObjectPart encodes exactly like PutObject, cmd/erasure-multipart.go:430).
+CompleteMultipartUpload validates the client's part list, freezes the
+final FileInfo, and commits the whole session dir with the same
+rename_data 2-phase commit PUT uses.
+
+Crash safety: a session is resumable by uploadID at any point (the
+reference's checkpoint/resume analog, SURVEY §5) — parts already
+uploaded survive process restarts because they live on the drives.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import uuid as _uuid
+from typing import Optional
+
+import numpy as np
+
+from .. import bitrot as bitrot_mod
+from ..storage import errors as serr
+from ..storage.datatypes import ChecksumInfo, FileInfo, ObjectInfo, now
+from ..storage.xl_storage import (MINIO_META_MULTIPART_BUCKET,
+                                  MINIO_META_TMP_BUCKET)
+from . import api_errors, bitrot_io, metadata as meta
+from .engine import ErasureObjects, PutOptions, _read_full
+from .hash_reader import HashReader
+
+MIN_PART_SIZE = 5 << 20  # S3: every part but the last >= 5 MiB
+
+
+class CompletePart:
+    def __init__(self, part_number: int, etag: str):
+        self.part_number = part_number
+        self.etag = etag
+
+
+class PartInfo:
+    def __init__(self, part_number: int, etag: str, size: int,
+                 actual_size: int, last_modified: float):
+        self.part_number = part_number
+        self.etag = etag
+        self.size = size
+        self.actual_size = actual_size
+        self.last_modified = last_modified
+
+
+class MultipartMixin(ErasureObjects):
+    # -- paths -------------------------------------------------------------
+
+    @staticmethod
+    def _mp_sha_dir(bucket: str, object_name: str) -> str:
+        return hashlib.sha256(
+            f"{bucket}/{object_name}".encode()).hexdigest()
+
+    def _upload_dir(self, bucket: str, object_name: str,
+                    upload_id: str) -> str:
+        return f"{self._mp_sha_dir(bucket, object_name)}/{upload_id}"
+
+    def _check_upload_exists(self, bucket: str, object_name: str,
+                             upload_id: str) -> FileInfo:
+        path = self._upload_dir(bucket, object_name, upload_id)
+        metas, errs = meta.read_all_file_info(
+            self.disks, MINIO_META_MULTIPART_BUCKET, path)
+        live = [fi for fi in metas if fi is not None]
+        if not live:
+            raise api_errors.InvalidUploadID(upload_id)
+        k = live[0].erasure.data_blocks
+        try:
+            return meta.pick_valid_file_info(metas, max(1, k))
+        except api_errors.InsufficientReadQuorum:
+            raise api_errors.InvalidUploadID(upload_id) from None
+
+    # -- session lifecycle -------------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             opts: Optional[PutOptions] = None) -> str:
+        opts = opts or PutOptions()
+        self.get_bucket_info(bucket)
+        k, m, _, write_quorum = self._default_quorums(opts.parity)
+        upload_id = str(_uuid.uuid4())
+        path = self._upload_dir(bucket, object_name, upload_id)
+
+        from ..storage.datatypes import new_file_info
+        fi = new_file_info(f"{bucket}/{object_name}", k, m)
+        fi.erasure.block_size = self.block_size
+        fi.volume = MINIO_META_MULTIPART_BUCKET
+        fi.name = path
+        fi.data_dir = str(_uuid.uuid4())
+        fi.mod_time = now()
+        fi.metadata = dict(opts.metadata)
+        if opts.versioned:
+            fi.metadata["x-minio-internal-versioned"] = "true"
+
+        metas = [copy.deepcopy(fi) for _ in self.disks]
+        meta.write_unique_file_info(self.disks, MINIO_META_MULTIPART_BUCKET,
+                                    path, metas, write_quorum)
+        return upload_id
+
+    def put_object_part(self, bucket: str, object_name: str,
+                        upload_id: str, part_number: int, reader,
+                        size: int = -1) -> PartInfo:
+        if not (1 <= part_number <= 10000):
+            raise api_errors.InvalidPart(part_number)
+        if isinstance(reader, (bytes, bytearray)):
+            import io as _io
+            size = len(reader)
+            reader = HashReader(_io.BytesIO(reader), size)
+        elif not isinstance(reader, HashReader):
+            reader = HashReader(reader, size)
+
+        with self.ns.new_lock(
+                f"{bucket}/{object_name}/{upload_id}").write_locked():
+            session_fi = self._check_upload_exists(bucket, object_name,
+                                                   upload_id)
+            k = session_fi.erasure.data_blocks
+            m = session_fi.erasure.parity_blocks
+            write_quorum = meta.write_quorum_for(k, m)
+            codec = self.codec(k, m)
+            path = self._upload_dir(bucket, object_name, upload_id)
+            shuffled = meta.shuffle_disks(self.disks,
+                                          session_fi.erasure.distribution)
+
+            tmp_id = str(_uuid.uuid4())
+            tmp_part = f"{tmp_id}/part.{part_number}"
+            writers: list[Optional[object]] = []
+            for d in shuffled:
+                writers.append(None if d is None else
+                               bitrot_io.new_bitrot_writer(
+                                   d, MINIO_META_TMP_BUCKET, tmp_part, -1,
+                                   self.bitrot_algo, codec.shard_size))
+            try:
+                total = self._encode_stream(reader, codec, writers,
+                                            write_quorum, bucket,
+                                            object_name)
+                reader.verify()
+                etag = reader.md5_current_hex()
+
+                def close_writer(i, d):
+                    w = writers[i]
+                    if w is None:
+                        raise serr.DiskNotFound(f"writer {i}")
+                    w.close()
+
+                _, errs = meta.for_each_disk(shuffled, close_writer)
+                for i, e in enumerate(errs):
+                    if e is not None:
+                        writers[i] = None
+
+                # move the staged part into the session's data dir
+                dst = f"{path}/{session_fi.data_dir}/part.{part_number}"
+
+                def rename(i, d):
+                    if writers[i] is None:
+                        raise serr.DiskNotFound(f"writer {i}")
+                    d.rename_file(MINIO_META_TMP_BUCKET, tmp_part,
+                                  MINIO_META_MULTIPART_BUCKET, dst)
+
+                _, errs = meta.for_each_disk(shuffled, rename)
+                err = meta.reduce_write_quorum_errs(
+                    errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+                if err is not None:
+                    raise api_errors.to_object_err(err, bucket, object_name)
+            finally:
+                reader.close()  # stop the async hasher even on failure
+                self._cleanup_tmp(shuffled, tmp_id)
+
+            # record the part in the session journal
+            session_fi.add_object_part(part_number, etag, total,
+                                       reader.actual_size
+                                       if reader.actual_size >= 0 else total)
+            session_fi.erasure.checksums = [
+                c for c in session_fi.erasure.checksums
+                if c.part_number != part_number]
+            session_fi.erasure.checksums.append(
+                ChecksumInfo(part_number, self.bitrot_algo.value, b""))
+            session_fi.mod_time = now()
+            metas = [copy.deepcopy(session_fi) for _ in self.disks]
+            meta.write_unique_file_info(
+                self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
+                write_quorum)
+            return PartInfo(part_number, etag, total, total, now())
+
+    def list_object_parts(self, bucket: str, object_name: str,
+                          upload_id: str, part_marker: int = 0,
+                          max_parts: int = 1000) -> list[PartInfo]:
+        fi = self._check_upload_exists(bucket, object_name, upload_id)
+        out = [PartInfo(p.number, p.etag, p.size, p.actual_size, fi.mod_time)
+               for p in fi.parts if p.number > part_marker]
+        return out[:max_parts]
+
+    def list_multipart_uploads(self, bucket: str, object_name: str = ""
+                               ) -> list[str]:
+        """Upload IDs in progress (for `object_name` if given)."""
+        out: list[str] = []
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                if object_name:
+                    sha_dirs = [self._mp_sha_dir(bucket, object_name) + "/"]
+                else:
+                    sha_dirs = d.list_dir(MINIO_META_MULTIPART_BUCKET, "")
+                for sha in sha_dirs:
+                    try:
+                        ids = d.list_dir(MINIO_META_MULTIPART_BUCKET,
+                                         sha.rstrip("/"))
+                    except serr.StorageError:
+                        continue
+                    out.extend(i.rstrip("/") for i in ids)
+                break
+            except serr.StorageError:
+                continue
+        return sorted(set(out))
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        self._check_upload_exists(bucket, object_name, upload_id)
+        path = self._upload_dir(bucket, object_name, upload_id)
+
+        def rm(i, d):
+            try:
+                d.delete_file(MINIO_META_MULTIPART_BUCKET, path,
+                              recursive=True)
+            except serr.FileNotFound:
+                pass
+
+        meta.for_each_disk(self.disks, rm)
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[CompletePart]) -> ObjectInfo:
+        with self.ns.new_lock(
+                f"{bucket}/{object_name}/{upload_id}").write_locked():
+            session_fi = self._check_upload_exists(bucket, object_name,
+                                                   upload_id)
+            k = session_fi.erasure.data_blocks
+            m = session_fi.erasure.parity_blocks
+            write_quorum = meta.write_quorum_for(k, m)
+            path = self._upload_dir(bucket, object_name, upload_id)
+
+            by_number = {p.number: p for p in session_fi.parts}
+            total = 0
+            md5_concat = b""
+            final_parts = []
+            for idx, cp in enumerate(parts):
+                have = by_number.get(cp.part_number)
+                if have is None or have.etag != cp.etag.strip('"'):
+                    raise api_errors.InvalidPart(
+                        cp.part_number, cp.etag,
+                        have.etag if have else "missing")
+                if (idx != len(parts) - 1
+                        and have.size < MIN_PART_SIZE):
+                    raise api_errors.PartTooSmall(cp.part_number, have.size)
+                total += have.size
+                md5_concat += bytes.fromhex(have.etag)
+                final_parts.append(have)
+
+            etag = (hashlib.md5(md5_concat).hexdigest()
+                    + f"-{len(parts)}")
+
+            fi = copy.deepcopy(session_fi)
+            fi.volume, fi.name = bucket, object_name
+            fi.size = total
+            fi.mod_time = now()
+            fi.parts = final_parts
+            fi.metadata["etag"] = etag
+            if fi.metadata.pop("x-minio-internal-versioned", ""):
+                fi.version_id = str(_uuid.uuid4())
+            fi.erasure.checksums = [
+                ChecksumInfo(p.number, self.bitrot_algo.value, b"")
+                for p in final_parts]
+
+            # drop uncommitted parts' shard files
+            keep = {p.number for p in final_parts}
+            extra = [p for p in session_fi.parts if p.number not in keep]
+
+            def drop_extra(i, d):
+                for p in extra:
+                    try:
+                        d.delete_file(
+                            MINIO_META_MULTIPART_BUCKET,
+                            f"{path}/{fi.data_dir}/part.{p.number}")
+                    except serr.StorageError:
+                        pass
+
+            if extra:
+                meta.for_each_disk(self.disks, drop_extra)
+
+            metas = [copy.deepcopy(fi) for _ in self.disks]
+            with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+                meta.write_unique_file_info(
+                    self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
+                    write_quorum)
+
+                def rename(i, d):
+                    d.rename_data(MINIO_META_MULTIPART_BUCKET, path,
+                                  fi.data_dir, bucket, object_name)
+
+                _, errs = meta.for_each_disk(self.disks, rename)
+                err = meta.reduce_write_quorum_errs(
+                    errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+                if err is not None:
+                    raise api_errors.to_object_err(err, bucket, object_name)
+            return fi.to_object_info(bucket, object_name)

@@ -1,0 +1,156 @@
+"""Namespace locking — per-(bucket, object) RW locks.
+
+Local mode of the reference's nsLockMap (cmd/namespace-lock.go:57-66,
+localLockInstance): an in-process map of timed RW mutexes keyed by
+namespace path, with reference counting so idle entries are reclaimed
+(pkg/lsync LRWMutex semantics). The distributed mode (dsync quorum
+locks) plugs in behind the same RWLocker interface
+(minio_tpu/distributed/dsync.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class _TimedRWLock:
+    """Writer-preferring RW lock with acquisition timeout (pkg/lsync
+    LRWMutex behavior)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+        self.refs = 0
+
+    def acquire_read(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    if self._writer or self._writers_waiting:
+                        return False
+            self._readers += 1
+            return True
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        if self._writer or self._readers:
+                            return False
+                self._writer = True
+                return True
+            finally:
+                self._writers_waiting -= 1
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+class NSLockMap:
+    """Map of namespace path -> RW lock (reference nsLockMap)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._locks: dict[str, _TimedRWLock] = {}
+
+    def new_lock(self, *paths: str) -> "NSLock":
+        return NSLock(self, [p for p in paths if p])
+
+    def _get(self, path: str) -> _TimedRWLock:
+        with self._mu:
+            lk = self._locks.get(path)
+            if lk is None:
+                lk = _TimedRWLock()
+                self._locks[path] = lk
+            lk.refs += 1
+            return lk
+
+    def _put(self, path: str, lk: _TimedRWLock) -> None:
+        with self._mu:
+            lk.refs -= 1
+            if lk.refs == 0:
+                self._locks.pop(path, None)
+
+
+class NSLock:
+    """RWLocker over one or more namespace paths (cmd/namespace-lock.go:38:
+    GetLock/GetRLock/Unlock/RUnlock). Multi-path locks acquire in sorted
+    order to avoid deadlock (the reference sorts volume lists too)."""
+
+    def __init__(self, ns: NSLockMap, paths: list[str]):
+        self._ns = ns
+        self._paths = sorted(set(paths))
+        self._held: list[tuple[str, _TimedRWLock]] = []
+
+    def get_lock(self, timeout: float = 30.0) -> bool:
+        return self._acquire(write=True, timeout=timeout)
+
+    def get_rlock(self, timeout: float = 30.0) -> bool:
+        return self._acquire(write=False, timeout=timeout)
+
+    def _acquire(self, write: bool, timeout: float) -> bool:
+        acquired: list[tuple[str, _TimedRWLock]] = []
+        for p in self._paths:
+            lk = self._ns._get(p)
+            ok = (lk.acquire_write(timeout) if write
+                  else lk.acquire_read(timeout))
+            if not ok:
+                self._ns._put(p, lk)
+                for q, ql in reversed(acquired):
+                    (ql.release_write() if write else ql.release_read())
+                    self._ns._put(q, ql)
+                return False
+            acquired.append((p, lk))
+        self._held = acquired
+        self._write = write
+        return True
+
+    def unlock(self) -> None:
+        for p, lk in reversed(self._held):
+            (lk.release_write() if self._write else lk.release_read())
+            self._ns._put(p, lk)
+        self._held = []
+
+    runlock = unlock
+
+    # context-manager sugar for the engine
+    def write_locked(self, timeout: float = 30.0):
+        return _LockCtx(self, True, timeout)
+
+    def read_locked(self, timeout: float = 30.0):
+        return _LockCtx(self, False, timeout)
+
+
+class _LockCtx:
+    def __init__(self, lock: NSLock, write: bool, timeout: float):
+        self._lock, self._write, self._timeout = lock, write, timeout
+
+    def __enter__(self):
+        ok = (self._lock.get_lock(self._timeout) if self._write
+              else self._lock.get_rlock(self._timeout))
+        if not ok:
+            from . import api_errors
+            raise api_errors.ObjectApiError("lock acquisition timed out")
+        return self._lock
+
+    def __exit__(self, *exc):
+        self._lock.unlock()
+        return False

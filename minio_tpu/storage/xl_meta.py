@@ -35,6 +35,7 @@ EC_REED_SOLOMON = 1
 CSUM_HIGHWAYHASH = 1
 
 RESERVED_METADATA_PREFIX = "x-minio-internal-"
+BITROT_SIDECAR_KEY = "x-minio-internal-bitrot-checksums"
 
 _ZERO_UUID = b"\x00" * 16
 
@@ -100,6 +101,15 @@ class XLMetaV2:
                     meta_sys[k] = v.encode()
                 else:
                     meta_user[k] = v
+            # v2 natively encodes only HighwayHash256S (CSumAlgo); other
+            # bitrot algorithms + whole-file digests ride in MetaSys
+            # (reference v2 is streaming-HH-only; this is our extension)
+            if any(c.algorithm != "highwayhash256S" or c.hash
+                   for c in fi.erasure.checksums):
+                import json as _json
+                meta_sys[BITROT_SIDECAR_KEY] = _json.dumps({
+                    str(c.part_number): [c.algorithm, c.hash.hex()]
+                    for c in fi.erasure.checksums}).encode()
             obj = {
                 "ID": uv,
                 "DDir": _uuid_bytes(fi.data_dir),
@@ -237,14 +247,23 @@ class XLMetaV2:
             if k.lower().startswith(RESERVED_METADATA_PREFIX):
                 metadata[k] = (val.decode()
                                if isinstance(val, (bytes, bytearray)) else val)
+        sidecar = metadata.pop(BITROT_SIDECAR_KEY, "")
+        if sidecar:
+            import json as _json
+            side = _json.loads(sidecar)
+            checksums = [ChecksumInfo(part_number=int(n), algorithm=a,
+                                      hash=bytes.fromhex(h))
+                         for n, (a, h) in side.items()]
+        else:
+            checksums = [ChecksumInfo(part_number=p.number,
+                                      algorithm="highwayhash256S", hash=b"")
+                         for p in parts]
         ei = ErasureInfo(
             algorithm="rs-vandermonde",
             data_blocks=o["EcM"], parity_blocks=o["EcN"],
             block_size=o["EcBSize"], index=o["EcIndex"],
             distribution=list(bytes(o["EcDist"])),
-            checksums=[ChecksumInfo(part_number=p.number,
-                                    algorithm="highwayhash256S", hash=b"")
-                       for p in parts])
+            checksums=checksums)
         return FileInfo(
             volume=volume, name=path,
             version_id=_uuid_str(bytes(o["ID"])),

@@ -1,0 +1,497 @@
+"""Erasure object engine tests: PUT/GET/DELETE/LIST, quorum under drive
+faults (naughty-disk analog), bitrot reconstruct, multipart, heal —
+mirroring the reference's cmd/erasure-object_test.go /
+erasure-healing_test.go / erasure-multipart tests."""
+
+import hashlib
+import io
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.object import (CompletePart, ErasureSetObjects, GetOptions,
+                              PutOptions, api_errors)
+from minio_tpu.storage import XLStorage, errors as serr, new_format_erasure_v3
+from minio_tpu.storage.api import StorageAPI
+
+K, M = 4, 2  # small set: fast tests, same code paths as 12+4
+NDISKS = K + M
+BLOCK = 1 << 16  # 64 KiB blocks keep fixtures fast
+
+
+class NaughtyDisk(StorageAPI):
+    """Programmable fault-injection wrapper (reference naughtyDisk,
+    cmd/naughty-disk_test.go): fails specific verbs with a given error."""
+
+    def __init__(self, inner: StorageAPI):
+        self.inner = inner
+        self.fail_verbs: dict[str, Exception] = {}
+        self.offline = False
+
+    def __getattr__(self, name):
+        if name in ("inner", "fail_verbs", "offline"):
+            raise AttributeError(name)
+        attr = getattr(self.inner, name)
+        if not callable(attr):
+            return attr
+
+        def wrapper(*a, **kw):
+            if self.offline:
+                raise serr.DiskNotFound("naughty: offline")
+            if name in self.fail_verbs:
+                raise self.fail_verbs[name]
+            return attr(*a, **kw)
+
+        return wrapper
+
+    def __str__(self):
+        return f"naughty({self.inner})"
+
+    # abstract-method passthroughs the metaclass requires
+    def is_online(self): return not self.offline
+    def is_local(self): return True
+    def endpoint(self): return self.inner.endpoint()
+    def close(self): return None
+    def get_disk_id(self): return self.inner.get_disk_id()
+    def set_disk_id(self, i): return None
+    def disk_info(self): return self.inner.disk_info()
+    def make_vol(self, v): return self.__getattr__("make_vol")(v)
+    def list_vols(self): return self.__getattr__("list_vols")()
+    def stat_vol(self, v): return self.__getattr__("stat_vol")(v)
+    def delete_vol(self, v, force=False):
+        return self.__getattr__("delete_vol")(v, force)
+    def write_metadata(self, v, p, fi):
+        return self.__getattr__("write_metadata")(v, p, fi)
+    def read_version(self, v, p, vid=""):
+        return self.__getattr__("read_version")(v, p, vid)
+    def read_versions(self, v, p):
+        return self.__getattr__("read_versions")(v, p)
+    def delete_version(self, v, p, fi):
+        return self.__getattr__("delete_version")(v, p, fi)
+    def rename_data(self, sv, sp, dd, dv, dp):
+        return self.__getattr__("rename_data")(sv, sp, dd, dv, dp)
+    def list_dir(self, v, p, count=-1):
+        return self.__getattr__("list_dir")(v, p, count)
+    def read_file(self, v, p, o, l, verifier=None):
+        return self.__getattr__("read_file")(v, p, o, l, verifier)
+    def append_file(self, v, p, b):
+        return self.__getattr__("append_file")(v, p, b)
+    def create_file(self, v, p, s, r):
+        return self.__getattr__("create_file")(v, p, s, r)
+    def read_file_stream(self, v, p, o, l):
+        return self.__getattr__("read_file_stream")(v, p, o, l)
+    def rename_file(self, sv, sp, dv, dp):
+        return self.__getattr__("rename_file")(sv, sp, dv, dp)
+    def check_parts(self, v, p, fi):
+        return self.__getattr__("check_parts")(v, p, fi)
+    def check_file(self, v, p):
+        return self.__getattr__("check_file")(v, p)
+    def delete_file(self, v, p, recursive=False):
+        return self.__getattr__("delete_file")(v, p, recursive)
+    def verify_file(self, v, p, fi):
+        return self.__getattr__("verify_file")(v, p, fi)
+    def write_all(self, v, p, d):
+        return self.__getattr__("write_all")(v, p, d)
+    def read_all(self, v, p):
+        return self.__getattr__("read_all")(v, p)
+    def walk(self, v, d="", m="", recursive=True):
+        if self.offline:
+            raise serr.DiskNotFound("naughty: offline")
+        return self.inner.walk(v, d, m, recursive)
+
+
+def make_engine(tmp_path, n=NDISKS, k=K, m=M, naughty=False):
+    fmts = new_format_erasure_v3(1, n)
+    disks = []
+    for j in range(n):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        d.write_format(fmts[0][j])
+        disks.append(NaughtyDisk(d) if naughty else d)
+    return ErasureSetObjects(disks, k, m, block_size=BLOCK)
+
+
+@pytest.fixture()
+def eng(tmp_path):
+    e = make_engine(tmp_path)
+    e.make_bucket("bucket")
+    return e
+
+
+@pytest.fixture()
+def neng(tmp_path):
+    e = make_engine(tmp_path, naughty=True)
+    e.make_bucket("bucket")
+    return e
+
+
+def payload(size, seed=7) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# basic CRUD
+# ---------------------------------------------------------------------------
+
+def test_put_get_roundtrip_sizes(eng):
+    for size in [0, 1, 100, BLOCK - 1, BLOCK, BLOCK + 1,
+                 3 * BLOCK + 12345]:
+        data = payload(size, seed=size)
+        oi = eng.put_object("bucket", f"o{size}", data)
+        assert oi.size == size
+        assert oi.etag == hashlib.md5(data).hexdigest()
+        oi2, it = eng.get_object("bucket", f"o{size}")
+        assert b"".join(it) == data
+        assert oi2.etag == oi.etag
+
+
+def test_ranged_get(eng):
+    data = payload(4 * BLOCK + 999)
+    eng.put_object("bucket", "r", data)
+    for off, ln in [(0, 10), (BLOCK - 1, 2), (BLOCK, BLOCK),
+                    (2 * BLOCK + 7, 3 * BLOCK // 2),
+                    (4 * BLOCK + 990, 9), (0, len(data))]:
+        _, it = eng.get_object("bucket", "r", offset=off, length=ln)
+        assert b"".join(it) == data[off:off + ln], (off, ln)
+    with pytest.raises(api_errors.InvalidRange):
+        eng.get_object("bucket", "r", offset=len(data) + 1, length=2)
+
+
+def test_get_missing_object(eng):
+    with pytest.raises(api_errors.ObjectNotFound):
+        eng.get_object_info("bucket", "nope")
+    with pytest.raises(api_errors.BucketNotFound):
+        eng.get_object_info("nobucket", "nope")
+
+
+def test_bucket_lifecycle(eng):
+    eng.make_bucket("b2")
+    assert eng.bucket_exists("b2")
+    with pytest.raises(api_errors.BucketExists):
+        eng.make_bucket("b2")
+    names = [v.name for v in eng.list_buckets()]
+    assert "b2" in names and "bucket" in names
+    eng.delete_bucket("b2")
+    assert not eng.bucket_exists("b2")
+    with pytest.raises(api_errors.BucketNameInvalid):
+        eng.make_bucket(".minio.sys")
+
+
+def test_list_objects_delimiter_and_truncation(eng):
+    for name in ["a/1", "a/2", "b/1", "c", "d"]:
+        eng.put_object("bucket", name, b"x")
+    objs, prefixes, trunc = eng.list_objects("bucket", delimiter="/")
+    assert [o.name for o in objs] == ["c", "d"]
+    assert prefixes == ["a/", "b/"]
+    assert not trunc
+    objs, _, _ = eng.list_objects("bucket", prefix="a/")
+    assert [o.name for o in objs] == ["a/1", "a/2"]
+    objs, prefixes, trunc = eng.list_objects("bucket", max_keys=2)
+    assert trunc and len(objs) + len(prefixes) == 2
+    # marker resumes
+    objs, _, _ = eng.list_objects("bucket", marker="b/1")
+    assert [o.name for o in objs] == ["c", "d"]
+
+
+def test_overwrite_replaces(eng):
+    eng.put_object("bucket", "o", b"one")
+    eng.put_object("bucket", "o", b"twotwo")
+    oi, it = eng.get_object("bucket", "o")
+    assert b"".join(it) == b"twotwo"
+
+
+# ---------------------------------------------------------------------------
+# quorum / fault injection
+# ---------------------------------------------------------------------------
+
+def test_put_succeeds_with_m_disks_down(neng):
+    for d in neng.disks[:M]:
+        d.offline = True
+    data = payload(2 * BLOCK + 5)
+    oi = neng.put_object("bucket", "deg", data)
+    for d in neng.disks[:M]:
+        d.offline = False
+    _, it = neng.get_object("bucket", "deg")
+    assert b"".join(it) == data
+
+
+def test_put_fails_below_write_quorum(neng):
+    for d in neng.disks[:M + 1]:
+        d.offline = True
+    with pytest.raises((api_errors.InsufficientWriteQuorum,
+                        api_errors.ObjectApiError)):
+        neng.put_object("bucket", "x", payload(BLOCK))
+
+
+def test_get_with_m_disks_down(neng):
+    data = payload(3 * BLOCK + 17)
+    neng.put_object("bucket", "o", data)
+    for d in neng.disks[K:]:
+        d.offline = True  # all parity drives down
+    _, it = neng.get_object("bucket", "o")
+    assert b"".join(it) == data
+
+
+def test_get_reconstructs_with_data_disks_down(neng):
+    data = payload(3 * BLOCK + 17)
+    neng.put_object("bucket", "o", data)
+    # distribution maps shard index -> disk; kill two arbitrary drives
+    neng.disks[0].offline = True
+    neng.disks[3].offline = True
+    _, it = neng.get_object("bucket", "o")
+    assert b"".join(it) == data
+
+
+def test_get_fails_below_read_quorum(neng):
+    data = payload(BLOCK)
+    neng.put_object("bucket", "o", data)
+    for d in neng.disks[: M + 1]:
+        d.offline = True
+    with pytest.raises((api_errors.InsufficientReadQuorum,
+                        api_errors.ObjectNotFound)):
+        oi, it = neng.get_object("bucket", "o")
+        b"".join(it)
+
+
+def test_read_file_faults_hedge_to_parity(neng):
+    data = payload(2 * BLOCK)
+    neng.put_object("bucket", "o", data)
+    # two drives serve metadata but fail shard reads mid-GET
+    neng.disks[1].fail_verbs["read_file_stream"] = serr.FaultyDisk("boom")
+    neng.disks[2].fail_verbs["read_file_stream"] = serr.FaultyDisk("boom")
+    _, it = neng.get_object("bucket", "o")
+    assert b"".join(it) == data
+
+
+def test_bitrot_corruption_detected_and_recovered(eng, tmp_path):
+    data = payload(2 * BLOCK + 3)
+    eng.put_object("bucket", "o", data)
+    # flip payload bytes in two shard files
+    import glob
+    parts = sorted(glob.glob(str(tmp_path / "d*" / "bucket" / "o" / "*" /
+                                 "part.1")))
+    for f in parts[:2]:
+        with open(f, "r+b") as fh:
+            fh.seek(40)
+            fh.write(b"\xff\xff\xff\xff")
+    _, it = eng.get_object("bucket", "o")
+    assert b"".join(it) == data
+
+
+def test_delete_missing_object_maps_to_not_found(eng):
+    with pytest.raises(api_errors.ObjectNotFound):
+        eng.delete_object("bucket", "never-existed")
+
+
+def test_list_pagination_with_prefix_markers(eng):
+    for name in ["a/1", "a/2", "b/1", "c"]:
+        eng.put_object("bucket", name, b"x")
+    # page 1: one entry
+    objs, prefixes, trunc = eng.list_objects("bucket", delimiter="/",
+                                             max_keys=1)
+    assert trunc and prefixes == ["a/"] and not objs
+    # page 2 resumes AFTER prefix 'a/' — must not re-emit it
+    objs, prefixes, trunc = eng.list_objects("bucket", delimiter="/",
+                                             marker="a/", max_keys=1)
+    assert prefixes == ["b/"] and not objs
+    objs, prefixes, trunc = eng.list_objects("bucket", delimiter="/",
+                                             marker="b/")
+    assert [o.name for o in objs] == ["c"] and not prefixes and not trunc
+
+
+def test_whole_file_bitrot_algo(tmp_path):
+    """Engine configured with SHA256 (whole-file) bitrot: digests persist
+    per drive in xl.meta, corruption detected and reconstructed."""
+    from minio_tpu import bitrot as bm
+    fmts = new_format_erasure_v3(1, NDISKS)
+    disks = []
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"w{j}"))
+        d.write_format(fmts[0][j])
+        disks.append(d)
+    e = ErasureSetObjects(disks, K, M, block_size=BLOCK,
+                          bitrot_algo=bm.BitrotAlgorithm.SHA256)
+    e.make_bucket("b")
+    data = payload(2 * BLOCK + 7)
+    e.put_object("b", "o", data)
+    fi = disks[0].read_version("b", "o")
+    assert fi.erasure.checksums[0].algorithm == "sha256"
+    assert len(fi.erasure.checksums[0].hash) == 32
+    disks[0].verify_file("b", "o", fi)
+    import glob
+    f = glob.glob(str(tmp_path / "w0" / "b" / "o" / "*" / "part.1"))[0]
+    with open(f, "r+b") as fh:
+        fh.seek(10)
+        fh.write(b"Z" * 4)
+    _, it = e.get_object("b", "o")
+    assert b"".join(it) == data
+    res = e.heal_object("b", "o", deep_scan=True)
+    assert res.disks_healed == 1
+
+
+def test_degraded_read_triggers_heal_hook(eng, tmp_path):
+    data = payload(BLOCK)
+    eng.put_object("bucket", "o", data)
+    calls = []
+    eng.on_degraded_read = lambda b, o: calls.append((b, o))
+    _wipe_drive_object(tmp_path, 0, "bucket", "o")
+    _, it = eng.get_object("bucket", "o")
+    assert b"".join(it) == data
+    assert calls == [("bucket", "o")]
+
+
+# ---------------------------------------------------------------------------
+# multipart
+# ---------------------------------------------------------------------------
+
+def test_multipart_roundtrip(eng):
+    part_size = 5 << 20
+    p1, p2, p3 = payload(part_size, 1), payload(part_size, 2), \
+        payload(123456, 3)
+    uid = eng.new_multipart_upload("bucket", "mp",
+                                   PutOptions(metadata={"content-type":
+                                                        "app/x"}))
+    assert uid in eng.list_multipart_uploads("bucket", "mp")
+    etags = []
+    for n, p in [(1, p1), (2, p2), (3, p3)]:
+        pi = eng.put_object_part("bucket", "mp", uid, n, p)
+        assert pi.etag == hashlib.md5(p).hexdigest()
+        etags.append(CompletePart(n, pi.etag))
+    parts = eng.list_object_parts("bucket", "mp", uid)
+    assert [p.part_number for p in parts] == [1, 2, 3]
+    oi = eng.complete_multipart_upload("bucket", "mp", uid, etags)
+    assert oi.size == 2 * part_size + 123456
+    assert oi.etag.endswith("-3")
+    want = p1 + p2 + p3
+    _, it = eng.get_object("bucket", "mp")
+    assert b"".join(it) == want
+    # ranged read across part boundary
+    off = part_size - 100
+    _, it = eng.get_object("bucket", "mp", offset=off, length=200)
+    assert b"".join(it) == want[off:off + 200]
+    # session is gone
+    with pytest.raises(api_errors.InvalidUploadID):
+        eng.list_object_parts("bucket", "mp", uid)
+
+
+def test_multipart_part_reupload_and_abort(eng):
+    uid = eng.new_multipart_upload("bucket", "mp2")
+    eng.put_object_part("bucket", "mp2", uid, 1, b"aaa")
+    pi = eng.put_object_part("bucket", "mp2", uid, 1, b"bbbb")
+    parts = eng.list_object_parts("bucket", "mp2", uid)
+    assert len(parts) == 1 and parts[0].size == 4
+    eng.abort_multipart_upload("bucket", "mp2", uid)
+    with pytest.raises(api_errors.InvalidUploadID):
+        eng.put_object_part("bucket", "mp2", uid, 2, b"x")
+
+
+def test_multipart_complete_validation(eng):
+    uid = eng.new_multipart_upload("bucket", "mp3")
+    pi = eng.put_object_part("bucket", "mp3", uid, 1, b"small")
+    with pytest.raises(api_errors.InvalidPart):
+        eng.complete_multipart_upload(
+            "bucket", "mp3", uid, [CompletePart(1, "wrong-etag")])
+    with pytest.raises(api_errors.InvalidPart):
+        eng.complete_multipart_upload(
+            "bucket", "mp3", uid, [CompletePart(9, pi.etag)])
+    # single small part is fine (last part exempt from min size)
+    oi = eng.complete_multipart_upload("bucket", "mp3", uid,
+                                       [CompletePart(1, pi.etag)])
+    assert oi.size == 5
+
+
+def test_multipart_part_too_small(eng):
+    uid = eng.new_multipart_upload("bucket", "mp4")
+    p1 = eng.put_object_part("bucket", "mp4", uid, 1, b"tiny")
+    p2 = eng.put_object_part("bucket", "mp4", uid, 2, b"tiny2")
+    with pytest.raises(api_errors.PartTooSmall):
+        eng.complete_multipart_upload(
+            "bucket", "mp4", uid,
+            [CompletePart(1, p1.etag), CompletePart(2, p2.etag)])
+
+
+# ---------------------------------------------------------------------------
+# healing
+# ---------------------------------------------------------------------------
+
+def _wipe_drive_object(tmp_path, di, bucket, obj):
+    import shutil
+    p = tmp_path / f"d{di}" / bucket / obj
+    if p.exists():
+        shutil.rmtree(p)
+
+
+def test_heal_missing_shards(eng, tmp_path):
+    data = payload(3 * BLOCK + 99)
+    eng.put_object("bucket", "h", data)
+    _wipe_drive_object(tmp_path, 0, "bucket", "h")
+    _wipe_drive_object(tmp_path, 4, "bucket", "h")
+
+    res = eng.heal_object("bucket", "h")
+    assert res.disks_healed == 2
+    assert res.missing_after == 0
+
+    # all drives carry verifiable shards again
+    for j in range(NDISKS):
+        d = eng.disks[j]
+        fi = d.read_version("bucket", "h")
+        d.check_parts("bucket", "h", fi)
+        d.verify_file("bucket", "h", fi)
+
+    # degraded read relying on the healed drives (positions preserved)
+    sub = [eng.disks[0], None, None, eng.disks[3], eng.disks[4],
+           eng.disks[5]]
+    e2 = ErasureSetObjects(sub, K, M, block_size=BLOCK)
+    _, it = e2.get_object("bucket", "h")
+    assert b"".join(it) == data
+
+
+def test_heal_corrupt_shard_deep_scan(eng, tmp_path):
+    data = payload(2 * BLOCK)
+    eng.put_object("bucket", "hc", data)
+    import glob
+    f = sorted(glob.glob(str(tmp_path / "d2" / "bucket" / "hc" / "*" /
+                             "part.1")))[0]
+    with open(f, "r+b") as fh:
+        fh.seek(50)
+        fh.write(b"\x00\x00\x00\x00\x00")
+
+    res = eng.heal_object("bucket", "hc", deep_scan=True)
+    assert res.disks_healed == 1
+    d = eng.disks[2]
+    d.verify_file("bucket", "hc", d.read_version("bucket", "hc"))
+
+
+def test_heal_dry_run_reports_without_fixing(eng, tmp_path):
+    eng.put_object("bucket", "hd", payload(BLOCK))
+    _wipe_drive_object(tmp_path, 1, "bucket", "hd")
+    res = eng.heal_object("bucket", "hd", dry_run=True)
+    assert res.missing_before == 1 and res.disks_healed == 0
+    with pytest.raises(serr.StorageError):
+        eng.disks[1].read_version("bucket", "hd")
+
+
+def test_heal_bucket(eng, tmp_path):
+    import shutil
+    shutil.rmtree(tmp_path / "d3" / "bucket")
+    eng.heal_bucket("bucket")
+    assert eng.disks[3].stat_vol("bucket").name == "bucket"
+
+
+def test_heal_delete_marker(eng):
+    eng.put_object("bucket", "dm", b"x", opts=PutOptions(versioned=True))
+    eng.delete_object("bucket", "dm", versioned=True)
+    res = eng.heal_object("bucket", "dm")
+    assert res.missing_after == 0
+
+
+def test_versioned_suspend_and_restore(eng):
+    v1 = eng.put_object("bucket", "v", b"v1", opts=PutOptions(versioned=True))
+    eng.delete_object("bucket", "v", versioned=True)
+    # deleting the delete marker itself restores the object
+    versions = eng.list_object_versions("bucket", "v")
+    marker = next(v for v in versions if v.delete_marker)
+    eng.delete_object("bucket", "v", version_id=marker.version_id)
+    oi = eng.get_object_info("bucket", "v")
+    assert oi.version_id == v1.version_id
