@@ -372,6 +372,16 @@ class ErasureObjects:
         online, _ = meta.list_online_disks(self.disks, metas, errs)
         return fi, metas, online
 
+    def has_object_versions(self, bucket: str, object_name: str) -> bool:
+        """True when ANY version (including a delete marker) exists —
+        the zone-affinity probe (reference getZoneIdx's delete-marker
+        cases, cmd/erasure-server-sets.go:195-220)."""
+        try:
+            self._object_file_info(bucket, object_name)
+            return True
+        except api_errors.ObjectApiError:
+            return False
+
     def get_object_info(self, bucket: str, object_name: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
         opts = opts or GetOptions()
@@ -398,8 +408,13 @@ class ErasureObjects:
             fi, metas, online = self._object_file_info(
                 bucket, object_name, opts.version_id)
             if fi.deleted:
-                raise api_errors.MethodNotAllowed(
-                    f"{bucket}/{object_name} is a delete marker")
+                # latest is a delete marker: plain GET -> NotFound;
+                # explicit version GET -> MethodNotAllowed (S3 semantics,
+                # matching get_object_info)
+                if opts.version_id:
+                    raise api_errors.MethodNotAllowed(
+                        f"{bucket}/{object_name} is a delete marker")
+                raise api_errors.ObjectNotFound(bucket, object_name)
             oi = fi.to_object_info(bucket, object_name)
             if length < 0:
                 length = fi.size - offset
@@ -410,19 +425,33 @@ class ErasureObjects:
             lock.unlock()
             raise
 
+        # a drive that is present but lacks the latest copy needs heal
+        # even when no shard read will fail (its shard may be parity)
+        flagged = False
+        if self.on_degraded_read is not None and any(
+                online[i] is None and self.disks[i] is not None
+                for i in range(len(online))):
+            flagged = True
+            try:
+                self.on_degraded_read(bucket, object_name)
+            except Exception:  # noqa: BLE001 — heal queueing is best-effort
+                pass
+
         def gen() -> Iterator[bytes]:
             try:
                 if fi.size == 0 or length == 0:
                     return
                 yield from self._read_object_stream(
-                    bucket, object_name, fi, metas, online, offset, length)
+                    bucket, object_name, fi, metas, online, offset, length,
+                    suppress_heal_flag=flagged)
             finally:
                 lock.unlock()
 
         return oi, gen()
 
     def _read_object_stream(self, bucket, object_name, fi: FileInfo,
-                            metas, online, offset: int, length: int
+                            metas, online, offset: int, length: int,
+                            suppress_heal_flag: bool = False
                             ) -> Iterator[bytes]:
         """Per-part block loop (getObjectWithFileInfo,
         cmd/erasure-object.go:217-323)."""
@@ -442,12 +471,13 @@ class ErasureObjects:
             part_read_len = min(remaining, part.size - part_read_off)
             yield from self._read_part(
                 bucket, object_name, fi, shuffled_disks, shuffled_meta,
-                codec, part, part_read_off, part_read_len)
+                codec, part, part_read_off, part_read_len,
+                suppress_heal_flag)
             remaining -= part_read_len
 
     def _read_part(self, bucket, object_name, fi: FileInfo, disks, smeta,
-                   codec: Codec, part, offset: int, length: int
-                   ) -> Iterator[bytes]:
+                   codec: Codec, part, offset: int, length: int,
+                   suppress_heal_flag: bool = False) -> Iterator[bytes]:
         n = len(disks)
         k = fi.erasure.data_blocks
         shard_size = fi.erasure.shard_size()
@@ -484,7 +514,8 @@ class ErasureObjects:
         for r in readers:
             if r is not None:
                 r.close()
-        if heal_required and self.on_degraded_read is not None:
+        if heal_required and not suppress_heal_flag \
+                and self.on_degraded_read is not None:
             try:
                 self.on_degraded_read(bucket, object_name)
             except Exception:  # noqa: BLE001 — heal queueing is best-effort

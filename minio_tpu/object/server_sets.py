@@ -1,0 +1,243 @@
+"""ErasureServerSets — zones ("server sets") for cluster expansion.
+
+The reference's top ObjectLayer (cmd/erasure-server-sets.go): multiple
+independent ErasureSets groups. PUT goes to the zone already holding the
+object, else the zone with the most free space weighted by capacity
+(getZoneIdx:195, getAvailableZoneIdx:122); GET/HEAD/DELETE scan zones in
+order; listings merge across zones.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..storage.datatypes import ObjectInfo
+from . import api_errors
+from .sets import ErasureSets
+
+DISK_FILL_FRACTION = 0.95  # reference diskFillFraction
+
+
+class ErasureServerSets:
+    def __init__(self, server_sets: list[ErasureSets]):
+        assert server_sets
+        self.server_sets = server_sets
+
+    def single_zone(self) -> bool:
+        return len(self.server_sets) == 1
+
+    # ------------------------------------------------------------------
+    # zone choice
+    # ------------------------------------------------------------------
+
+    def _available_space(self, size: int) -> list[int]:
+        """Per-zone available bytes after the write, 0 when it would cross
+        the fill watermark (getServerSetsAvailableSpace,
+        cmd/erasure-server-sets.go:143-190)."""
+        out = []
+        for z in self.server_sets:
+            info = z.storage_info()
+            total, available = info["total"], info["free"]
+            if available < size:
+                available = 0
+            if available > 0:
+                available -= size
+                want_left = int(total * (1.0 - DISK_FILL_FRACTION))
+                if available <= want_left:
+                    available = 0
+            out.append(available)
+        return out
+
+    def get_available_zone_idx(self, size: int) -> int:
+        spaces = self._available_space(max(size, 0))
+        total = sum(spaces)
+        if total == 0:
+            return -1
+        choose = random.randrange(total)
+        at = 0
+        for i, a in enumerate(spaces):
+            at += a
+            if at > choose and a > 0:
+                return i
+        return -1
+
+    def get_zone_idx(self, bucket: str, object_name: str, size: int) -> int:
+        """Zone for a PUT: the zone holding ANY version of the object
+        (including a delete marker — version history must stay together)
+        wins; else weighted free space (getZoneIdx,
+        cmd/erasure-server-sets.go:195)."""
+        if self.single_zone():
+            return 0
+        for i, z in enumerate(self.server_sets):
+            if z.has_object_versions(bucket, object_name):
+                return i
+        idx = self.get_available_zone_idx(size * 2)  # ×2 for parity
+        if idx < 0:
+            raise api_errors.to_object_err(
+                api_errors.InsufficientWriteQuorum(), bucket, object_name)
+        return idx
+
+    # ------------------------------------------------------------------
+    # bucket ops
+    # ------------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        for z in self.server_sets:
+            z.make_bucket(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        if not force:
+            objs, pfx, _ = self.list_objects(bucket, max_keys=1)
+            if objs or pfx:
+                raise api_errors.BucketNotEmpty(bucket)
+        for z in self.server_sets:
+            z.delete_bucket(bucket, force=True)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return self.server_sets[0].bucket_exists(bucket)
+
+    def get_bucket_info(self, bucket: str):
+        return self.server_sets[0].get_bucket_info(bucket)
+
+    def list_buckets(self):
+        return self.server_sets[0].list_buckets()
+
+    def heal_bucket(self, bucket: str) -> None:
+        for z in self.server_sets:
+            z.heal_bucket(bucket)
+
+    # ------------------------------------------------------------------
+    # object ops
+    # ------------------------------------------------------------------
+
+    def put_object(self, bucket, object_name, reader, size=-1, opts=None):
+        idx = self.get_zone_idx(bucket, object_name,
+                                max(size, 0) if size else 0)
+        return self.server_sets[idx].put_object(bucket, object_name,
+                                                reader, size, opts)
+
+    def _first_zone_with(self, fn, bucket, object_name):
+        last: Optional[Exception] = None
+        for z in self.server_sets:
+            try:
+                return fn(z)
+            except api_errors.ObjectNotFound as e:
+                last = e
+        raise last or api_errors.ObjectNotFound(bucket, object_name)
+
+    def get_object(self, bucket, object_name, offset=0, length=-1,
+                   opts=None):
+        return self._first_zone_with(
+            lambda z: z.get_object(bucket, object_name, offset, length,
+                                   opts), bucket, object_name)
+
+    def get_object_info(self, bucket, object_name, opts=None):
+        return self._first_zone_with(
+            lambda z: z.get_object_info(bucket, object_name, opts),
+            bucket, object_name)
+
+    def delete_object(self, bucket, object_name, version_id="",
+                      versioned=False):
+        return self._first_zone_with(
+            lambda z: z.delete_object(bucket, object_name, version_id,
+                                      versioned), bucket, object_name)
+
+    def delete_objects(self, bucket, objects):
+        out = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 — per-key result list
+                out.append(e)
+        return out
+
+    def heal_object(self, bucket, object_name, version_id="",
+                    deep_scan=False, dry_run=False):
+        return self._first_zone_with(
+            lambda z: z.heal_object(bucket, object_name, version_id,
+                                    deep_scan, dry_run),
+            bucket, object_name)
+
+    # ------------------------------------------------------------------
+    # multipart: session created in the chosen PUT zone; subsequent calls
+    # find the zone owning the uploadID
+    # ------------------------------------------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts=None):
+        idx = self.get_zone_idx(bucket, object_name, 1 << 30)
+        return self.server_sets[idx].new_multipart_upload(
+            bucket, object_name, opts)
+
+    def _zone_of_upload(self, bucket, object_name, upload_id):
+        for z in self.server_sets:
+            try:
+                z.list_object_parts(bucket, object_name, upload_id,
+                                    max_parts=1)
+                return z
+            except api_errors.InvalidUploadID:
+                continue
+        raise api_errors.InvalidUploadID(upload_id)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        reader, size=-1):
+        z = self._zone_of_upload(bucket, object_name, upload_id)
+        return z.put_object_part(bucket, object_name, upload_id,
+                                 part_number, reader, size)
+
+    def list_object_parts(self, bucket, object_name, upload_id,
+                          part_marker=0, max_parts=1000):
+        z = self._zone_of_upload(bucket, object_name, upload_id)
+        return z.list_object_parts(bucket, object_name, upload_id,
+                                   part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket, object_name=""):
+        out = []
+        for z in self.server_sets:
+            out.extend(z.list_multipart_uploads(bucket, object_name))
+        return sorted(set(out))
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        z = self._zone_of_upload(bucket, object_name, upload_id)
+        return z.abort_multipart_upload(bucket, object_name, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        z = self._zone_of_upload(bucket, object_name, upload_id)
+        return z.complete_multipart_upload(bucket, object_name, upload_id,
+                                           parts)
+
+    # ------------------------------------------------------------------
+    # listing
+    # ------------------------------------------------------------------
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000):
+        from .sets import merge_listings
+        per_zone = [z.list_objects(bucket, prefix, marker, delimiter,
+                                   max_keys)
+                    for z in self.server_sets]
+        return merge_listings(per_zone, max_keys)
+
+    def list_object_versions(self, bucket, prefix="", marker="",
+                             max_keys=1000):
+        out = []
+        for z in self.server_sets:
+            out.extend(z.list_object_versions(bucket, prefix, marker,
+                                              max_keys))
+        out.sort(key=lambda o: (o.name, -o.mod_time))
+        return out[:max_keys]
+
+    def storage_info(self) -> dict:
+        zones = [z.storage_info() for z in self.server_sets]
+        return {"total": sum(z["total"] for z in zones),
+                "free": sum(z["free"] for z in zones),
+                "used": sum(z["used"] for z in zones),
+                "online_disks": sum(z["online_disks"] for z in zones),
+                "offline_disks": sum(z["offline_disks"] for z in zones),
+                "zones": zones}
+
+    def close(self) -> None:
+        for z in self.server_sets:
+            z.close()

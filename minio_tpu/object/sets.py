@@ -1,0 +1,378 @@
+"""ErasureSets — S = setCount × setDriveCount drives, object→set routing.
+
+The reference's erasureSets layer (cmd/erasure-sets.go): each object maps
+to exactly one erasure set by SipHash-2-4 of its name keyed by the
+deployment ID (sipHashMod:590); bucket operations fan out to every set;
+listings merge across sets. Includes the MRF ("most recently failed")
+heal queue fed by degraded reads (maintainMRFList:1641, healMRFRoutine)
+and the format bootstrap (waitForFormatErasure semantics,
+cmd/prepare-storage.go).
+
+The EP analog of SURVEY §2.5: set routing is static "expert" routing on
+the host control plane; each set's device batches stay independent.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid as _uuid
+from typing import Optional
+
+from ..storage import errors as serr
+from ..storage.datatypes import ObjectInfo
+from ..storage.format import (DISTRIBUTION_ALGO_V2, DISTRIBUTION_ALGO_V3,
+                              FormatErasureV3, get_format_in_quorum,
+                              new_format_erasure_v3)
+from ..storage.xl_storage import XLStorage
+from ..utils.siphash import crc_hash_mod, sip_hash_mod
+from . import ErasureSetObjects, api_errors
+from .engine import GetOptions, PutOptions
+from .nslock import NSLockMap
+
+
+class ErasureSets:
+    """Routes the ObjectLayer surface over `set_count` erasure sets."""
+
+    def __init__(self, sets: list[ErasureSetObjects], deployment_id: str,
+                 distribution_algo: str = DISTRIBUTION_ALGO_V3,
+                 enable_mrf: bool = True):
+        self.sets = sets
+        self.deployment_id = deployment_id
+        self.distribution_algo = distribution_algo
+        self._id16 = _uuid.UUID(deployment_id).bytes
+        self._mrf_queue: "queue.Queue[tuple[str, str]]" = queue.Queue(
+            maxsize=10000)
+        self._mrf_thread: Optional[threading.Thread] = None
+        self._closed = False
+        if enable_mrf:
+            for s in self.sets:
+                s.on_degraded_read = self._queue_mrf_heal
+            self._mrf_thread = threading.Thread(
+                target=self._heal_mrf_routine, daemon=True)
+            self._mrf_thread.start()
+
+    # ------------------------------------------------------------------
+    # construction from drives (format bootstrap)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_drives(cls, drive_roots: list[str], set_count: int,
+                    set_drive_count: int, parity: int,
+                    block_size: int = 1 << 22,
+                    ns_lock: Optional[NSLockMap] = None,
+                    **engine_kw) -> "ErasureSets":
+        """Open (formatting if fresh) setCount×setDriveCount local drives
+        (reference waitForFormatErasure + newErasureSets,
+        cmd/prepare-storage.go / cmd/erasure-sets.go:337)."""
+        assert len(drive_roots) == set_count * set_drive_count
+        enable_mrf = engine_kw.pop("enable_mrf", True)
+        # a faulty drive becomes a None slot, never a bootstrap abort
+        # (reference: sets open with offline slots, reconnect monitor
+        # picks them up later)
+        drives: list[Optional[XLStorage]] = []
+        for r in drive_roots:
+            try:
+                drives.append(XLStorage(r))
+            except serr.StorageError:
+                drives.append(None)
+        formats: list[Optional[FormatErasureV3]] = []
+        for d in drives:
+            if d is None:
+                formats.append(None)
+                continue
+            try:
+                formats.append(d.read_format())
+            except serr.StorageError:
+                formats.append(None)
+
+        if all(f is None for f in formats):
+            if all(d is None for d in drives):
+                raise serr.DiskNotFound("no usable drives")
+            fresh = new_format_erasure_v3(set_count, set_drive_count)
+            for i in range(set_count):
+                for j in range(set_drive_count):
+                    d = drives[i * set_drive_count + j]
+                    if d is None:
+                        continue
+                    try:
+                        d.write_format(fresh[i][j])
+                        formats[i * set_drive_count + j] = d.read_format()
+                    except serr.StorageError:
+                        pass
+        else:
+            ref = get_format_in_quorum(formats)
+            # heal drives with missing format (fresh replacements)
+            for idx, f in enumerate(formats):
+                if f is None and drives[idx] is not None:
+                    # the slot's expected UUID is position-derived
+                    si, di = idx // set_drive_count, idx % set_drive_count
+                    import dataclasses
+                    nf = dataclasses.replace(
+                        ref, this=ref.sets[si][di])
+                    try:
+                        drives[idx].write_format(nf)
+                        formats[idx] = drives[idx].read_format()
+                    except serr.StorageError:
+                        pass
+
+        deployment_id = next(f.id for f in formats if f is not None)
+        ref_sets = next(f.sets for f in formats if f is not None)
+
+        # order drives by their position in the format's sets matrix
+        by_uuid = {}
+        for d, f in zip(drives, formats):
+            if d is not None and f is not None:
+                by_uuid[f.this] = d
+        ns = ns_lock or NSLockMap()
+        sets = []
+        for i in range(set_count):
+            set_drives = [by_uuid.get(ref_sets[i][j])
+                          for j in range(set_drive_count)]
+            sets.append(ErasureSetObjects(
+                set_drives, set_drive_count - parity, parity,
+                block_size=block_size, ns_lock=ns, set_index=i,
+                **engine_kw))
+        return cls(sets, deployment_id, enable_mrf=enable_mrf)
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def get_hashed_set_index(self, object_name: str) -> int:
+        if self.distribution_algo == DISTRIBUTION_ALGO_V2:
+            return crc_hash_mod(object_name, len(self.sets))
+        return sip_hash_mod(object_name, len(self.sets), self._id16)
+
+    def get_hashed_set(self, object_name: str) -> ErasureSetObjects:
+        return self.sets[self.get_hashed_set_index(object_name)]
+
+    # ------------------------------------------------------------------
+    # MRF heal queue (cmd/erasure-sets.go:1641-1711)
+    # ------------------------------------------------------------------
+
+    def _queue_mrf_heal(self, bucket: str, object_name: str) -> None:
+        try:
+            self._mrf_queue.put_nowait((bucket, object_name))
+        except queue.Full:
+            pass
+
+    def _heal_mrf_routine(self) -> None:
+        while not self._closed:
+            try:
+                bucket, obj = self._mrf_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self.get_hashed_set(obj).heal_object(bucket, obj)
+            except Exception:  # noqa: BLE001 — background heal best-effort
+                pass
+            finally:
+                self._mrf_queue.task_done()
+
+    def drain_mrf(self, timeout: float = 10.0) -> None:
+        """Wait for queued MRF heals to COMPLETE (not just dequeue)."""
+        import threading as _t
+        done = _t.Event()
+
+        def waiter():
+            self._mrf_queue.join()
+            done.set()
+
+        _t.Thread(target=waiter, daemon=True).start()
+        done.wait(timeout)
+
+    def close(self) -> None:
+        self._closed = True
+
+    # ------------------------------------------------------------------
+    # bucket ops (fan out to every set)
+    # ------------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        done = []
+        try:
+            for s in self.sets:
+                s.make_bucket(bucket)
+                done.append(s)
+        except api_errors.BucketExists:
+            raise
+        except Exception:
+            for s in done:  # undo partial create (reference undoMakeBucket)
+                try:
+                    s.delete_bucket(bucket, force=True)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self.get_bucket_info(bucket)
+        if not force:
+            objs, _, _ = self.list_objects(bucket, max_keys=1)
+            if objs:
+                raise api_errors.BucketNotEmpty(bucket)
+        for s in self.sets:
+            s.delete_bucket(bucket, force=True)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return self.sets[0].bucket_exists(bucket)
+
+    def get_bucket_info(self, bucket: str):
+        return self.sets[0].get_bucket_info(bucket)
+
+    def list_buckets(self):
+        return self.sets[0].list_buckets()
+
+    def heal_bucket(self, bucket: str) -> None:
+        for s in self.sets:
+            s.heal_bucket(bucket)
+
+    # ------------------------------------------------------------------
+    # object ops (route by hash)
+    # ------------------------------------------------------------------
+
+    def put_object(self, bucket, object_name, reader, size=-1, opts=None):
+        return self.get_hashed_set(object_name).put_object(
+            bucket, object_name, reader, size, opts)
+
+    def get_object(self, bucket, object_name, offset=0, length=-1,
+                   opts=None):
+        return self.get_hashed_set(object_name).get_object(
+            bucket, object_name, offset, length, opts)
+
+    def get_object_info(self, bucket, object_name, opts=None):
+        return self.get_hashed_set(object_name).get_object_info(
+            bucket, object_name, opts)
+
+    def delete_object(self, bucket, object_name, version_id="",
+                      versioned=False):
+        return self.get_hashed_set(object_name).delete_object(
+            bucket, object_name, version_id, versioned)
+
+    def delete_objects(self, bucket, objects):
+        return [self._try_delete(bucket, o) for o in objects]
+
+    def _try_delete(self, bucket, object_name):
+        try:
+            self.delete_object(bucket, object_name)
+            return None
+        except Exception as e:  # noqa: BLE001 — per-key result list
+            return e
+
+    def heal_object(self, bucket, object_name, version_id="",
+                    deep_scan=False, dry_run=False):
+        return self.get_hashed_set(object_name).heal_object(
+            bucket, object_name, version_id, deep_scan, dry_run)
+
+    def has_object_versions(self, bucket, object_name) -> bool:
+        return self.get_hashed_set(object_name).has_object_versions(
+            bucket, object_name)
+
+    # ------------------------------------------------------------------
+    # multipart (route by object name)
+    # ------------------------------------------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts=None):
+        return self.get_hashed_set(object_name).new_multipart_upload(
+            bucket, object_name, opts)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        reader, size=-1):
+        return self.get_hashed_set(object_name).put_object_part(
+            bucket, object_name, upload_id, part_number, reader, size)
+
+    def list_object_parts(self, bucket, object_name, upload_id,
+                          part_marker=0, max_parts=1000):
+        return self.get_hashed_set(object_name).list_object_parts(
+            bucket, object_name, upload_id, part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket, object_name=""):
+        if object_name:
+            return self.get_hashed_set(object_name).list_multipart_uploads(
+                bucket, object_name)
+        out = []
+        for s in self.sets:
+            out.extend(s.list_multipart_uploads(bucket))
+        return sorted(set(out))
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).abort_multipart_upload(
+            bucket, object_name, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        return self.get_hashed_set(object_name).complete_multipart_upload(
+            bucket, object_name, upload_id, parts)
+
+    # ------------------------------------------------------------------
+    # listing (merge across sets; cmd/erasure-sets.go merge walks)
+    # ------------------------------------------------------------------
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> tuple[list[ObjectInfo], list[str], bool]:
+        per_set = [s.list_objects(bucket, prefix, marker, delimiter,
+                                  max_keys)
+                   for s in self.sets]
+        return merge_listings(per_set, max_keys)
+
+    def list_object_versions(self, bucket, prefix="", marker="",
+                             max_keys=1000):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_object_versions(bucket, prefix, marker,
+                                              max_keys))
+        out.sort(key=lambda o: (o.name, -o.mod_time))
+        return out[:max_keys]
+
+    # ------------------------------------------------------------------
+    # info / usage
+    # ------------------------------------------------------------------
+
+    def storage_info(self) -> dict:
+        """Aggregate drive capacity (reference StorageInfo)."""
+        total = free = online = offline = 0
+        for s in self.sets:
+            for d in s.disks:
+                if d is None or not d.is_online():
+                    offline += 1
+                    continue
+                try:
+                    di = d.disk_info()
+                    total += di.total
+                    free += di.free
+                    online += 1
+                except serr.StorageError:
+                    offline += 1
+        return {"total": total, "free": free, "used": total - free,
+                "online_disks": online, "offline_disks": offline,
+                "sets": len(self.sets),
+                "drives_per_set": len(self.sets[0].disks)}
+
+def merge_listings(per_layer: list[tuple[list[ObjectInfo], list[str], bool]],
+                   max_keys: int
+                   ) -> tuple[list[ObjectInfo], list[str], bool]:
+    """Merge per-set/per-zone listing pages into one lexically sorted page
+    (the single home of the merge-walk truncation rules)."""
+    objects: dict[str, ObjectInfo] = {}
+    prefixes: set[str] = set()
+    any_truncated = False
+    for objs, pfx, trunc in per_layer:
+        for o in objs:
+            objects.setdefault(o.name, o)
+        prefixes.update(pfx)
+        any_truncated = any_truncated or trunc
+    merged = sorted([(n, False) for n in objects]
+                    + [(p, True) for p in prefixes])
+    out_objs: list[ObjectInfo] = []
+    out_pfx: list[str] = []
+    truncated = any_truncated
+    for name, is_pfx in merged:
+        if len(out_objs) + len(out_pfx) >= max_keys:
+            truncated = True
+            break
+        if is_pfx:
+            out_pfx.append(name)
+        else:
+            out_objs.append(objects[name])
+    return out_objs, out_pfx, truncated
