@@ -139,9 +139,18 @@ class ErasureServerSets:
 
     def delete_object(self, bucket, object_name, version_id="",
                       versioned=False):
-        return self._first_zone_with(
-            lambda z: z.delete_object(bucket, object_name, version_id,
-                                      versioned), bucket, object_name)
+        # a versioned delete WRITES a marker — it must land in the zone
+        # holding the object's history, never blindly in zone 0
+        for z in self.server_sets:
+            if z.has_object_versions(bucket, object_name):
+                return z.delete_object(bucket, object_name, version_id,
+                                       versioned)
+        if versioned and not version_id:
+            # S3: versioned DELETE of a missing key still writes a marker
+            idx = self.get_available_zone_idx(1 << 20)
+            return self.server_sets[max(idx, 0)].delete_object(
+                bucket, object_name, version_id, versioned)
+        raise api_errors.ObjectNotFound(bucket, object_name)
 
     def delete_objects(self, bucket, objects):
         out = []
