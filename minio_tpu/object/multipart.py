@@ -95,6 +95,9 @@ class MultipartMixin(ErasureObjects):
         fi.data_dir = str(_uuid.uuid4())
         fi.mod_time = now()
         fi.metadata = dict(opts.metadata)
+        # the sha-dir layout loses the object name; keep it in the session
+        # metadata so bucket-wide upload listings can report real keys
+        fi.metadata["x-minio-internal-object-name"] = object_name
         if opts.versioned:
             fi.metadata["x-minio-internal-versioned"] = "true"
 
@@ -196,9 +199,11 @@ class MultipartMixin(ErasureObjects):
         return out[:max_parts]
 
     def list_multipart_uploads(self, bucket: str, object_name: str = ""
-                               ) -> list[str]:
-        """Upload IDs in progress (for `object_name` if given)."""
-        out: list[str] = []
+                               ) -> list[dict]:
+        """Uploads in progress (for `object_name` if given): each entry is
+        {"object", "upload_id", "initiated"} read from the session
+        xl.meta (cmd/erasure-multipart.go ListMultipartUploads)."""
+        out: list[dict] = []
         for d in self.disks:
             if d is None:
                 continue
@@ -213,11 +218,26 @@ class MultipartMixin(ErasureObjects):
                                          sha.rstrip("/"))
                     except serr.StorageError:
                         continue
-                    out.extend(i.rstrip("/") for i in ids)
+                    for uid in ids:
+                        uid = uid.rstrip("/")
+                        path = f"{sha.rstrip('/')}/{uid}"
+                        try:
+                            fi = d.read_version(
+                                MINIO_META_MULTIPART_BUCKET, path)
+                        except serr.StorageError:
+                            continue
+                        out.append({
+                            "object": fi.metadata.get(
+                                "x-minio-internal-object-name",
+                                object_name),
+                            "upload_id": uid,
+                            "initiated": fi.mod_time,
+                        })
                 break
             except serr.StorageError:
                 continue
-        return sorted(set(out))
+        out.sort(key=lambda u: (u["object"], u["upload_id"]))
+        return out
 
     def abort_multipart_upload(self, bucket: str, object_name: str,
                                upload_id: str) -> None:

@@ -139,6 +139,7 @@ class ErasureServerSets:
 
     def delete_object(self, bucket, object_name, version_id="",
                       versioned=False):
+        self.get_bucket_info(bucket)  # missing bucket must not 204
         # a versioned delete WRITES a marker — it must land in the zone
         # holding the object's history, never blindly in zone 0
         for z in self.server_sets:
@@ -148,7 +149,9 @@ class ErasureServerSets:
         if versioned and not version_id:
             # S3: versioned DELETE of a missing key still writes a marker
             idx = self.get_available_zone_idx(1 << 20)
-            return self.server_sets[max(idx, 0)].delete_object(
+            if idx < 0:
+                raise api_errors.InsufficientWriteQuorum()
+            return self.server_sets[idx].delete_object(
                 bucket, object_name, version_id, versioned)
         raise api_errors.ObjectNotFound(bucket, object_name)
 
@@ -205,7 +208,8 @@ class ErasureServerSets:
         out = []
         for z in self.server_sets:
             out.extend(z.list_multipart_uploads(bucket, object_name))
-        return sorted(set(out))
+        out.sort(key=lambda u: (u["object"], u["upload_id"]))
+        return out
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
         z = self._zone_of_upload(bucket, object_name, upload_id)

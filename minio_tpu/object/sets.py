@@ -293,7 +293,8 @@ class ErasureSets:
         out = []
         for s in self.sets:
             out.extend(s.list_multipart_uploads(bucket))
-        return sorted(set(out))
+        out.sort(key=lambda u: (u["object"], u["upload_id"]))
+        return out
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
         return self.get_hashed_set(object_name).abort_multipart_upload(

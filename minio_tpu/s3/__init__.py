@@ -1,0 +1,11 @@
+"""S3 HTTP frontend: signatures, handlers, XML dialect, server.
+
+The rebuild of the reference's L1-L3 (cmd/http, cmd/routers.go,
+cmd/auth-handler.go, cmd/signature-v*.go, cmd/object-handlers.go,
+cmd/bucket-handlers.go) as a request-snapshot handler layer over the
+object engine.
+"""
+
+from .credentials import Credentials, generate_credentials  # noqa: F401
+from .handlers import S3ApiHandlers  # noqa: F401
+from .server import S3Server  # noqa: F401

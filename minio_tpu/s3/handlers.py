@@ -1,0 +1,1169 @@
+"""S3 API handlers — the request→ObjectLayer glue.
+
+The rebuild of the reference's handler layer (cmd/object-handlers.go,
+cmd/bucket-handlers.go, cmd/bucket-listobjects-handlers.go) on top of a
+request snapshot + the object layer: auth classification and signature
+verification, conditional headers, ranged reads, streaming-signed
+payload decoding, multipart, copy, delete-multiple, tagging, versioning.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import dataclasses
+import hashlib
+import io
+import re
+import threading
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from email.utils import formatdate, parsedate_to_datetime
+from typing import Callable, Iterator, Optional
+
+from ..object import api_errors as oerr
+from ..object.bucket_metadata import BucketMetadataSys
+from ..object.engine import GetOptions, PutOptions
+from ..object.hash_reader import HashReader
+from ..object.multipart import CompletePart
+from ..storage.datatypes import ObjectInfo
+from . import signature as sig
+from . import xmlgen
+from .credentials import Credentials, global_credentials
+from .s3errors import S3Error, api_error_from
+
+MAX_OBJECT_SIZE = 5 * (1 << 40)          # 5 TiB
+MAX_PART_SIZE = 5 * (1 << 30)            # 5 GiB
+MIN_PART_SIZE = 5 * (1 << 20)            # 5 MiB
+MAX_PARTS = 10000
+_BUCKET_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
+
+
+@dataclasses.dataclass
+class HTTPResponse:
+    status: int = 200
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    body: bytes = b""
+    stream: Optional[Iterator[bytes]] = None   # used instead of body if set
+
+    def with_xml(self, payload: bytes) -> "HTTPResponse":
+        self.headers["Content-Type"] = "application/xml"
+        self.body = payload
+        return self
+
+
+class RequestContext:
+    """Everything a handler needs about one request."""
+
+    def __init__(self, req: sig.Request, body_stream, content_length: int):
+        self.req = req
+        self.body_stream = body_stream
+        self.content_length = content_length
+        self.cred: Optional[Credentials] = None
+        self.auth_type = sig.get_request_auth_type(req)
+
+    def query1(self, name: str, default: str = "") -> str:
+        v = self.req.query.get(name)
+        return v[0] if v else default
+
+    def has_query(self, name: str) -> bool:
+        return name in self.req.query
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.req.header(name, default)
+
+    def read_body(self) -> bytes:
+        if self.content_length <= 0:
+            return b""
+        return self.body_stream.read(self.content_length)
+
+
+def _http_date(t: float) -> str:
+    return formatdate(t, usegmt=True)
+
+
+def _extract_metadata(ctx: RequestContext) -> dict[str, str]:
+    """User + standard metadata from headers
+    (cmd/utils.go extractMetadata)."""
+    md: dict[str, str] = {}
+    for k, v in ctx.req.headers.items():
+        if k.startswith("x-amz-meta-"):
+            md["X-Amz-Meta-" + k[len("x-amz-meta-"):].title()] = v
+        elif k in ("content-type", "content-encoding", "cache-control",
+                   "content-disposition", "content-language", "expires"):
+            md[k] = v
+    if "content-type" not in md:
+        md["content-type"] = "application/octet-stream"
+    if ctx.header("x-amz-storage-class"):
+        md["x-amz-storage-class"] = ctx.header("x-amz-storage-class")
+    if ctx.header("x-amz-website-redirect-location"):
+        md["x-amz-website-redirect-location"] = ctx.header(
+            "x-amz-website-redirect-location")
+    return md
+
+
+def _parse_range(header: str, size: int) -> Optional[tuple[int, int]]:
+    """`bytes=a-b` → (offset, length); None = whole object. Raises
+    InvalidRange when unsatisfiable (cmd/httprange.go)."""
+    if not header:
+        return None
+    if not header.startswith("bytes="):
+        return None  # ignored per S3 semantics
+    spec = header[len("bytes="):]
+    if "," in spec:
+        raise S3Error("NotImplemented", "multiple ranges not supported")
+    try:
+        first, last = spec.split("-", 1)
+        if first == "":
+            n = int(last)
+            if n == 0:
+                raise S3Error("InvalidRange")
+            offset = max(size - n, 0)
+            return offset, size - offset
+        start = int(first)
+        if last == "":
+            if start >= size:
+                raise S3Error("InvalidRange")
+            return start, size - start
+        end = int(last)
+        if start > end:
+            raise S3Error("InvalidRange")
+        if start >= size:
+            raise S3Error("InvalidRange")
+        return start, min(end, size - 1) - start + 1
+    except ValueError:
+        return None
+
+
+class S3ApiHandlers:
+    def __init__(self, object_layer, region: str = "us-east-1",
+                 creds: Optional[Credentials] = None,
+                 iam=None, max_clients: int = 256):
+        self.obj = object_layer
+        self.region = region
+        self.root_cred = creds or global_credentials()
+        self.iam = iam            # optional IAMSys (policy checks + users)
+        self.bucket_meta = BucketMetadataSys(object_layer)
+        # RAM-budgeted admission gate (cmd/handler-api.go:100 analog)
+        self._admission = threading.BoundedSemaphore(max_clients)
+        self.events = None        # optional event notifier hook
+
+    # ------------------------------------------------------------------
+    # auth
+    # ------------------------------------------------------------------
+
+    def _cred_lookup(self, access_key: str) -> Credentials:
+        if access_key == self.root_cred.access_key:
+            return self.root_cred
+        if self.iam is not None:
+            cred = self.iam.get_credentials(access_key)
+            if cred is not None and cred.is_valid():
+                return cred
+        raise sig.SigError("InvalidAccessKeyId")
+
+    def authenticate(self, ctx: RequestContext,
+                     action: str = "", bucket: str = "",
+                     object_name: str = "") -> None:
+        """Verify the request signature and (if IAM is wired) that the
+        caller may perform `action` (cmd/auth-handler.go checkRequestAuthType)."""
+        at = ctx.auth_type
+        if at == sig.AUTH_SIGNED:
+            body_sha = ctx.header("x-amz-content-sha256",
+                                  sig.UNSIGNED_PAYLOAD)
+            ctx.cred = sig.verify_v4(ctx.req, self._cred_lookup,
+                                     self.region, body_sha)
+        elif at == sig.AUTH_STREAMING_SIGNED:
+            ctx.cred = sig.verify_v4(ctx.req, self._cred_lookup,
+                                     self.region,
+                                     sig.STREAMING_CONTENT_SHA256)
+        elif at == sig.AUTH_PRESIGNED:
+            ctx.cred = sig.verify_v4_presigned(ctx.req, self._cred_lookup,
+                                               self.region)
+        elif at == sig.AUTH_SIGNED_V2:
+            ctx.cred = sig.verify_v2(ctx.req, self._cred_lookup)
+        elif at == sig.AUTH_ANONYMOUS:
+            if not self._anonymous_allowed(action, bucket, object_name):
+                raise S3Error("AccessDenied")
+            ctx.cred = Credentials()
+            return
+        else:
+            raise S3Error("SignatureVersionNotSupported")
+        if self.iam is not None and ctx.cred.access_key and \
+                ctx.cred.access_key != self.root_cred.access_key:
+            if not self.iam.is_allowed(ctx.cred, action, bucket,
+                                       object_name):
+                raise S3Error("AccessDenied")
+
+    def _anonymous_allowed(self, action: str, bucket: str,
+                           object_name: str) -> bool:
+        if not bucket or self.iam is None:
+            return False
+        return self.iam.is_anonymous_allowed(
+            self.bucket_meta.get(bucket).policy_json, action, bucket,
+            object_name)
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def handle(self, ctx: RequestContext) -> HTTPResponse:
+        with self._admission:
+            try:
+                return self._route(ctx)
+            except Exception as e:  # noqa: BLE001 — map to S3 error XML
+                return self._error_response(ctx, api_error_from(e))
+
+    def _error_response(self, ctx: RequestContext,
+                        err: S3Error) -> HTTPResponse:
+        body = xmlgen.error_response(err.code, err.message, ctx.req.path,
+                                     str(uuid.uuid4()))
+        r = HTTPResponse(status=err.status)
+        return r.with_xml(body)
+
+    def _route(self, ctx: RequestContext) -> HTTPResponse:
+        path = urllib.parse.unquote(ctx.req.path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        m = ctx.req.method
+
+        if not bucket:
+            if m == "GET":
+                return self.list_buckets(ctx)
+            raise S3Error("MethodNotAllowed")
+
+        if key:
+            return self._route_object(ctx, m, bucket, key)
+        return self._route_bucket(ctx, m, bucket)
+
+    def _route_bucket(self, ctx, m, bucket) -> HTTPResponse:
+        if m == "GET":
+            if ctx.has_query("location"):
+                return self.get_bucket_location(ctx, bucket)
+            if ctx.has_query("versioning"):
+                return self.get_bucket_versioning(ctx, bucket)
+            if ctx.has_query("versions"):
+                return self.list_object_versions(ctx, bucket)
+            if ctx.has_query("uploads"):
+                return self.list_multipart_uploads(ctx, bucket)
+            if ctx.has_query("policy"):
+                return self.get_bucket_policy(ctx, bucket)
+            if ctx.has_query("tagging"):
+                return self.get_bucket_tagging(ctx, bucket)
+            if ctx.has_query("lifecycle"):
+                return self.get_bucket_lifecycle(ctx, bucket)
+            if ctx.has_query("encryption"):
+                return self.get_bucket_encryption(ctx, bucket)
+            if ctx.has_query("object-lock"):
+                return self.get_object_lock_config(ctx, bucket)
+            if ctx.has_query("replication"):
+                return self.get_bucket_replication(ctx, bucket)
+            if ctx.has_query("notification"):
+                return self.get_bucket_notification(ctx, bucket)
+            if ctx.query1("list-type") == "2":
+                return self.list_objects_v2(ctx, bucket)
+            return self.list_objects_v1(ctx, bucket)
+        if m == "PUT":
+            if ctx.has_query("versioning"):
+                return self.put_bucket_versioning(ctx, bucket)
+            if ctx.has_query("policy"):
+                return self.put_bucket_policy(ctx, bucket)
+            if ctx.has_query("tagging"):
+                return self.put_bucket_tagging(ctx, bucket)
+            if ctx.has_query("lifecycle"):
+                return self.put_bucket_lifecycle(ctx, bucket)
+            if ctx.has_query("encryption"):
+                return self.put_bucket_encryption(ctx, bucket)
+            if ctx.has_query("object-lock"):
+                return self.put_object_lock_config(ctx, bucket)
+            if ctx.has_query("replication"):
+                return self.put_bucket_replication(ctx, bucket)
+            if ctx.has_query("notification"):
+                return self.put_bucket_notification(ctx, bucket)
+            return self.make_bucket(ctx, bucket)
+        if m == "HEAD":
+            return self.head_bucket(ctx, bucket)
+        if m == "DELETE":
+            if ctx.has_query("policy"):
+                return self.delete_bucket_policy(ctx, bucket)
+            if ctx.has_query("tagging"):
+                return self.delete_bucket_tagging(ctx, bucket)
+            if ctx.has_query("lifecycle"):
+                return self.delete_bucket_lifecycle(ctx, bucket)
+            if ctx.has_query("encryption"):
+                return self.delete_bucket_encryption(ctx, bucket)
+            if ctx.has_query("replication"):
+                return self.delete_bucket_replication(ctx, bucket)
+            return self.delete_bucket(ctx, bucket)
+        if m == "POST":
+            if ctx.has_query("delete"):
+                return self.delete_multiple_objects(ctx, bucket)
+        raise S3Error("MethodNotAllowed")
+
+    def _route_object(self, ctx, m, bucket, key) -> HTTPResponse:
+        if m == "GET":
+            if ctx.has_query("uploadId"):
+                return self.list_object_parts(ctx, bucket, key)
+            if ctx.has_query("tagging"):
+                return self.get_object_tagging(ctx, bucket, key)
+            return self.get_object(ctx, bucket, key)
+        if m == "HEAD":
+            return self.head_object(ctx, bucket, key)
+        if m == "PUT":
+            if ctx.has_query("uploadId") and ctx.has_query("partNumber"):
+                if ctx.header("x-amz-copy-source"):
+                    return self.copy_object_part(ctx, bucket, key)
+                return self.put_object_part(ctx, bucket, key)
+            if ctx.has_query("tagging"):
+                return self.put_object_tagging(ctx, bucket, key)
+            if ctx.header("x-amz-copy-source"):
+                return self.copy_object(ctx, bucket, key)
+            return self.put_object(ctx, bucket, key)
+        if m == "POST":
+            if ctx.has_query("uploads"):
+                return self.new_multipart_upload(ctx, bucket, key)
+            if ctx.has_query("uploadId"):
+                return self.complete_multipart_upload(ctx, bucket, key)
+        if m == "DELETE":
+            if ctx.has_query("uploadId"):
+                return self.abort_multipart_upload(ctx, bucket, key)
+            if ctx.has_query("tagging"):
+                return self.delete_object_tagging(ctx, bucket, key)
+            return self.delete_object(ctx, bucket, key)
+        raise S3Error("MethodNotAllowed")
+
+    # ------------------------------------------------------------------
+    # service + bucket handlers
+    # ------------------------------------------------------------------
+
+    def list_buckets(self, ctx) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListAllMyBuckets")
+        buckets = self.obj.list_buckets()
+        return HTTPResponse().with_xml(xmlgen.list_buckets_response(
+            "minio", buckets))
+
+    def make_bucket(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:CreateBucket", bucket)
+        if not _BUCKET_RE.match(bucket) or ".." in bucket:
+            raise S3Error("InvalidBucketName")
+        body = ctx.read_body()
+        if body:
+            # LocationConstraint must match our region if present
+            try:
+                root = ET.fromstring(body)
+                loc = root.find(f"{{{xmlgen.S3_XMLNS}}}LocationConstraint")
+                loc_txt = (loc.text or "") if loc is not None else ""
+                if loc_txt and loc_txt != self.region:
+                    raise S3Error("InvalidRegion",
+                                  f"region must be {self.region}")
+            except ET.ParseError:
+                raise S3Error("MalformedXML")
+        if ctx.header("x-amz-bucket-object-lock-enabled") == "true":
+            self.obj.make_bucket(bucket)
+            self.bucket_meta.update(
+                bucket, versioning="Enabled",
+                object_lock_xml="<ObjectLockConfiguration>"
+                "<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+                "</ObjectLockConfiguration>")
+        else:
+            self.obj.make_bucket(bucket)
+        self._notify("s3:BucketCreated:*", bucket, "")
+        return HTTPResponse(headers={"Location": f"/{bucket}"})
+
+    def head_bucket(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListBucket", bucket)
+        self.obj.get_bucket_info(bucket)
+        return HTTPResponse()
+
+    def delete_bucket(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:DeleteBucket", bucket)
+        force = ctx.header("x-minio-force-delete") == "true"
+        self.obj.delete_bucket(bucket, force=force)
+        self.bucket_meta.delete(bucket)
+        self._notify("s3:BucketRemoved:*", bucket, "")
+        return HTTPResponse(status=204)
+
+    def get_bucket_location(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetBucketLocation", bucket)
+        self.obj.get_bucket_info(bucket)
+        region = "" if self.region == "us-east-1" else self.region
+        return HTTPResponse().with_xml(xmlgen.location_response(region))
+
+    def get_bucket_versioning(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetBucketVersioning", bucket)
+        self.obj.get_bucket_info(bucket)
+        return HTTPResponse().with_xml(xmlgen.versioning_response(
+            self.bucket_meta.get(bucket).versioning))
+
+    def put_bucket_versioning(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutBucketVersioning", bucket)
+        self.obj.get_bucket_info(bucket)
+        body = ctx.read_body()
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        status_el = root.find(f"{{{xmlgen.S3_XMLNS}}}Status")
+        if status_el is None:
+            status_el = root.find("Status")
+        status = (status_el.text or "") if status_el is not None else ""
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error("MalformedXML", "bad versioning status")
+        self.bucket_meta.update(bucket, versioning=status)
+        return HTTPResponse()
+
+    # --- policy / tagging / configs ------------------------------------
+
+    def get_bucket_policy(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetBucketPolicy", bucket)
+        self.obj.get_bucket_info(bucket)
+        pj = self.bucket_meta.get(bucket).policy_json
+        if not pj:
+            raise S3Error("NoSuchBucketPolicy")
+        return HTTPResponse(headers={"Content-Type": "application/json"},
+                            body=pj.encode())
+
+    def put_bucket_policy(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutBucketPolicy", bucket)
+        self.obj.get_bucket_info(bucket)
+        body = ctx.read_body()
+        import json
+        try:
+            json.loads(body)
+        except ValueError:
+            raise S3Error("MalformedPolicy", "policy is not JSON")
+        self.bucket_meta.update(bucket, policy_json=body.decode())
+        return HTTPResponse(status=204)
+
+    def delete_bucket_policy(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:DeleteBucketPolicy", bucket)
+        self.obj.get_bucket_info(bucket)
+        self.bucket_meta.update(bucket, policy_json="")
+        return HTTPResponse(status=204)
+
+    def get_bucket_tagging(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetBucketTagging", bucket)
+        self.obj.get_bucket_info(bucket)
+        tags = self.bucket_meta.get(bucket).tagging
+        if not tags:
+            raise S3Error("NoSuchTagSet")
+        return HTTPResponse().with_xml(xmlgen.tagging_response(tags))
+
+    def put_bucket_tagging(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutBucketTagging", bucket)
+        self.obj.get_bucket_info(bucket)
+        tags = _parse_tagging_xml(ctx.read_body())
+        self.bucket_meta.update(bucket, tagging=tags)
+        return HTTPResponse()
+
+    def delete_bucket_tagging(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutBucketTagging", bucket)
+        self.obj.get_bucket_info(bucket)
+        self.bucket_meta.update(bucket, tagging={})
+        return HTTPResponse(status=204)
+
+    def _xml_config(self, ctx, bucket, field: str, action: str,
+                    missing_code: str) -> HTTPResponse:
+        self.authenticate(ctx, action, bucket)
+        self.obj.get_bucket_info(bucket)
+        xml_doc = getattr(self.bucket_meta.get(bucket), field)
+        if not xml_doc:
+            raise S3Error(missing_code)
+        return HTTPResponse(headers={"Content-Type": "application/xml"},
+                            body=xml_doc.encode())
+
+    def _put_xml_config(self, ctx, bucket, field: str,
+                        action: str) -> HTTPResponse:
+        self.authenticate(ctx, action, bucket)
+        self.obj.get_bucket_info(bucket)
+        body = ctx.read_body()
+        try:
+            ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        self.bucket_meta.update(bucket, **{field: body.decode()})
+        return HTTPResponse()
+
+    def _del_xml_config(self, ctx, bucket, field: str,
+                        action: str) -> HTTPResponse:
+        self.authenticate(ctx, action, bucket)
+        self.obj.get_bucket_info(bucket)
+        self.bucket_meta.update(bucket, **{field: ""})
+        return HTTPResponse(status=204)
+
+    def get_bucket_lifecycle(self, ctx, bucket):
+        return self._xml_config(ctx, bucket, "lifecycle_xml",
+                                "s3:GetLifecycleConfiguration",
+                                "NoSuchLifecycleConfiguration")
+
+    def put_bucket_lifecycle(self, ctx, bucket):
+        return self._put_xml_config(ctx, bucket, "lifecycle_xml",
+                                    "s3:PutLifecycleConfiguration")
+
+    def delete_bucket_lifecycle(self, ctx, bucket):
+        return self._del_xml_config(ctx, bucket, "lifecycle_xml",
+                                    "s3:PutLifecycleConfiguration")
+
+    def get_bucket_encryption(self, ctx, bucket):
+        return self._xml_config(
+            ctx, bucket, "sse_config_xml", "s3:GetEncryptionConfiguration",
+            "ServerSideEncryptionConfigurationNotFoundError")
+
+    def put_bucket_encryption(self, ctx, bucket):
+        return self._put_xml_config(ctx, bucket, "sse_config_xml",
+                                    "s3:PutEncryptionConfiguration")
+
+    def delete_bucket_encryption(self, ctx, bucket):
+        return self._del_xml_config(ctx, bucket, "sse_config_xml",
+                                    "s3:PutEncryptionConfiguration")
+
+    def get_object_lock_config(self, ctx, bucket):
+        return self._xml_config(ctx, bucket, "object_lock_xml",
+                                "s3:GetBucketObjectLockConfiguration",
+                                "NoSuchObjectLockConfiguration")
+
+    def put_object_lock_config(self, ctx, bucket):
+        return self._put_xml_config(ctx, bucket, "object_lock_xml",
+                                    "s3:PutBucketObjectLockConfiguration")
+
+    def get_bucket_replication(self, ctx, bucket):
+        return self._xml_config(ctx, bucket, "replication_xml",
+                                "s3:GetReplicationConfiguration",
+                                "ReplicationConfigurationNotFoundError")
+
+    def put_bucket_replication(self, ctx, bucket):
+        return self._put_xml_config(ctx, bucket, "replication_xml",
+                                    "s3:PutReplicationConfiguration")
+
+    def delete_bucket_replication(self, ctx, bucket):
+        return self._del_xml_config(ctx, bucket, "replication_xml",
+                                    "s3:PutReplicationConfiguration")
+
+    def get_bucket_notification(self, ctx, bucket):
+        self.authenticate(ctx, "s3:GetBucketNotification", bucket)
+        self.obj.get_bucket_info(bucket)
+        doc = self.bucket_meta.get(bucket).notification_xml
+        if not doc:
+            doc = ('<?xml version="1.0" encoding="UTF-8"?>'
+                   f'<NotificationConfiguration xmlns="{xmlgen.S3_XMLNS}"/>')
+        return HTTPResponse(headers={"Content-Type": "application/xml"},
+                            body=doc.encode())
+
+    def put_bucket_notification(self, ctx, bucket):
+        return self._put_xml_config(ctx, bucket, "notification_xml",
+                                    "s3:PutBucketNotification")
+
+    # --- listings -------------------------------------------------------
+
+    def list_objects_v1(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListBucket", bucket)
+        prefix = ctx.query1("prefix")
+        marker = ctx.query1("marker")
+        delimiter = ctx.query1("delimiter")
+        enc = ctx.query1("encoding-type")
+        max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
+        if max_keys == 0:
+            self.obj.get_bucket_info(bucket)
+            objs, prefixes, trunc = [], [], False
+        else:
+            objs, prefixes, trunc = self.obj.list_objects(
+                bucket, prefix, marker, delimiter, max_keys)
+        next_marker = ""
+        if trunc:
+            if objs and (not prefixes or objs[-1].name > prefixes[-1]):
+                next_marker = objs[-1].name
+            elif prefixes:
+                next_marker = prefixes[-1]
+        return HTTPResponse().with_xml(xmlgen.list_objects_v1_response(
+            bucket, prefix, marker, delimiter, max_keys, enc, objs,
+            prefixes, trunc, next_marker))
+
+    def list_objects_v2(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListBucket", bucket)
+        prefix = ctx.query1("prefix")
+        delimiter = ctx.query1("delimiter")
+        enc = ctx.query1("encoding-type")
+        start_after = ctx.query1("start-after")
+        token = ctx.query1("continuation-token")
+        fetch_owner = ctx.query1("fetch-owner") == "true"
+        max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
+        marker = _decode_token(token) if token else start_after
+        if max_keys == 0:
+            self.obj.get_bucket_info(bucket)
+            objs, prefixes, trunc = [], [], False
+        else:
+            objs, prefixes, trunc = self.obj.list_objects(
+                bucket, prefix, marker, delimiter, max_keys)
+        next_token = ""
+        if trunc:
+            last = objs[-1].name if objs else (prefixes[-1] if prefixes
+                                               else "")
+            next_token = _encode_token(last)
+        return HTTPResponse().with_xml(xmlgen.list_objects_v2_response(
+            bucket, prefix, delimiter, max_keys, enc, start_after, token,
+            next_token, objs, prefixes, trunc, fetch_owner))
+
+    def list_object_versions(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListBucketVersions", bucket)
+        prefix = ctx.query1("prefix")
+        key_marker = ctx.query1("key-marker")
+        vid_marker = ctx.query1("version-id-marker")
+        delimiter = ctx.query1("delimiter")
+        enc = ctx.query1("encoding-type")
+        max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
+        versions = self.obj.list_object_versions(bucket, prefix,
+                                                 key_marker, max_keys)
+        return HTTPResponse().with_xml(xmlgen.list_versions_response(
+            bucket, prefix, key_marker, vid_marker, delimiter, max_keys,
+            enc, versions, [], False))
+
+    def delete_multiple_objects(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:DeleteObject", bucket)
+        self.obj.get_bucket_info(bucket)  # missing bucket -> 404, not 200
+        body = ctx.read_body()
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        quiet = False
+        keys: list[tuple[str, str]] = []
+        for child in root:
+            tag = child.tag.split("}")[-1]
+            if tag == "Quiet":
+                quiet = (child.text or "").strip() == "true"
+            elif tag == "Object":
+                key_el = vid = None
+                for sub in child:
+                    st = sub.tag.split("}")[-1]
+                    if st == "Key":
+                        key_el = sub.text or ""
+                    elif st == "VersionId":
+                        vid = sub.text or ""
+                if key_el:
+                    keys.append((key_el, vid or ""))
+        if len(keys) > 1000:
+            raise S3Error("MalformedXML", "too many objects (max 1000)")
+        versioned = self.bucket_meta.versioning_enabled(bucket)
+        deleted, errors = [], []
+        for key, vid in keys:
+            try:
+                res = self.obj.delete_object(bucket, key, version_id=vid,
+                                             versioned=versioned)
+                entry = {"key": key, "version_id": vid}
+                if isinstance(res, ObjectInfo) and res.delete_marker:
+                    entry["delete_marker"] = True
+                    entry["delete_marker_version"] = res.version_id
+                deleted.append(entry)
+                self._notify("s3:ObjectRemoved:Delete", bucket, key)
+            except oerr.ObjectNotFound:
+                deleted.append({"key": key, "version_id": vid})
+            except Exception as e:  # noqa: BLE001 — per-key error entry
+                ae = api_error_from(e)
+                errors.append({"key": key, "code": ae.code,
+                               "message": ae.message})
+        if quiet:
+            deleted = []
+        return HTTPResponse().with_xml(
+            xmlgen.delete_objects_response(deleted, errors))
+
+    def list_multipart_uploads(self, ctx, bucket) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListBucketMultipartUploads", bucket)
+        self.obj.get_bucket_info(bucket)
+        prefix = ctx.query1("prefix")
+        max_uploads = _parse_max_keys(ctx.query1("max-uploads", "1000"))
+        uploads = self.obj.list_multipart_uploads(bucket)
+        if prefix:
+            uploads = [u for u in uploads
+                       if u["object"].startswith(prefix)]
+        return HTTPResponse().with_xml(
+            xmlgen.list_multipart_uploads_response(
+                bucket, "", "", prefix, "", max_uploads, False,
+                uploads[:max_uploads]))
+
+    # ------------------------------------------------------------------
+    # object handlers
+    # ------------------------------------------------------------------
+
+    def _put_reader(self, ctx) -> tuple[HashReader, int]:
+        """Build the verified PUT stream: content-md5 / x-amz-content-
+        sha256 expectations + streaming-signature decoding
+        (cmd/object-handlers.go:1343-1435)."""
+        size = ctx.content_length
+        md5_hex = ""
+        cm = ctx.header("content-md5")
+        if cm:
+            try:
+                md5_hex = binascii.hexlify(
+                    base64.b64decode(cm, validate=True)).decode()
+            except (binascii.Error, ValueError):
+                raise S3Error("InvalidDigest")
+        sha_hex = ""
+        body_sha = ctx.header("x-amz-content-sha256")
+        stream = ctx.body_stream
+        if ctx.auth_type == sig.AUTH_STREAMING_SIGNED:
+            decoded = ctx.header("x-amz-decoded-content-length")
+            if not decoded:
+                raise S3Error("MissingContentLength")
+            try:
+                size = int(decoded)
+            except ValueError:
+                raise S3Error("InvalidArgument",
+                              "bad x-amz-decoded-content-length")
+            stream = sig.new_chunked_reader(ctx.req, ctx.body_stream,
+                                            ctx.cred)
+        elif body_sha and body_sha not in (sig.UNSIGNED_PAYLOAD, ""):
+            sha_hex = body_sha
+        if size < 0:
+            raise S3Error("MissingContentLength")
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        return HashReader(stream, size, md5_hex=md5_hex,
+                          sha256_hex=sha_hex), size
+
+    def put_object(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        self.obj.get_bucket_info(bucket)
+        self._enforce_quota(bucket, max(ctx.content_length, 0))
+        reader, size = self._put_reader(ctx)
+        metadata = _extract_metadata(ctx)
+        if ctx.header("x-amz-tagging"):
+            metadata["X-Amz-Tagging"] = ctx.header("x-amz-tagging")
+        versioned = self.bucket_meta.versioning_enabled(bucket)
+        info = self.obj.put_object(
+            bucket, key, reader, size,
+            PutOptions(metadata=metadata, versioned=versioned))
+        headers = {"ETag": f'"{info.etag}"'}
+        if info.version_id and info.version_id != "null":
+            headers["x-amz-version-id"] = info.version_id
+        self._notify("s3:ObjectCreated:Put", bucket, key)
+        return HTTPResponse(headers=headers)
+
+    def _obj_response_headers(self, info: ObjectInfo) -> dict[str, str]:
+        h = {
+            "ETag": f'"{info.etag}"',
+            "Last-Modified": _http_date(info.mod_time),
+            "Content-Type": info.content_type or
+            "application/octet-stream",
+            "Accept-Ranges": "bytes",
+        }
+        if info.content_encoding:
+            h["Content-Encoding"] = info.content_encoding
+        if info.version_id and info.version_id != "null":
+            h["x-amz-version-id"] = info.version_id
+        for k, v in info.user_defined.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-meta-"):
+                h[k] = v
+            elif lk in ("cache-control", "content-disposition",
+                        "content-language", "expires"):
+                h[k] = v
+        if info.delete_marker:
+            h["x-amz-delete-marker"] = "true"
+        return h
+
+    def _check_preconditions(self, ctx, info: ObjectInfo) -> Optional[int]:
+        """Conditional header evaluation; returns an HTTP status to
+        short-circuit with, or None (cmd/object-handlers-common.go)."""
+        inm = ctx.header("if-none-match")
+        im = ctx.header("if-match")
+        etag = info.etag
+        if im and im.strip('"') != etag:
+            return 412
+        if inm and inm.strip('"') == etag:
+            return 304
+        ims = ctx.header("if-modified-since")
+        if ims and not inm:
+            try:
+                t = parsedate_to_datetime(ims).timestamp()
+                if info.mod_time <= t:
+                    return 304
+            except (TypeError, ValueError):
+                pass
+        ius = ctx.header("if-unmodified-since")
+        if ius and not im:
+            try:
+                t = parsedate_to_datetime(ius).timestamp()
+                if info.mod_time > t:
+                    return 412
+            except (TypeError, ValueError):
+                pass
+        return None
+
+    def get_object(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetObject", bucket, key)
+        vid = ctx.query1("versionId")
+        opts = GetOptions(version_id="" if vid == "null" else vid)
+        info = self.obj.get_object_info(bucket, key, opts)
+        short = self._check_preconditions(ctx, info)
+        if short is not None:
+            return HTTPResponse(status=short,
+                                headers=self._obj_response_headers(info))
+        rng = _parse_range(ctx.header("range"), info.size)
+        offset, length = (0, info.size) if rng is None else rng
+        info, stream = self.obj.get_object(bucket, key, offset, length,
+                                           opts)
+        headers = self._obj_response_headers(info)
+        headers["Content-Length"] = str(length)
+        status = 200
+        if rng is not None:
+            status = 206
+            headers["Content-Range"] = (
+                f"bytes {offset}-{offset + length - 1}/{info.size}")
+        # response header overrides (presigned GET)
+        for qk, hk in (("response-content-type", "Content-Type"),
+                       ("response-content-disposition",
+                        "Content-Disposition"),
+                       ("response-cache-control", "Cache-Control"),
+                       ("response-content-encoding", "Content-Encoding"),
+                       ("response-content-language", "Content-Language")):
+            if ctx.query1(qk):
+                headers[hk] = ctx.query1(qk)
+        self._notify("s3:ObjectAccessed:Get", bucket, key)
+        return HTTPResponse(status=status, headers=headers, stream=stream)
+
+    def head_object(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetObject", bucket, key)
+        vid = ctx.query1("versionId")
+        opts = GetOptions(version_id="" if vid == "null" else vid)
+        info = self.obj.get_object_info(bucket, key, opts)
+        short = self._check_preconditions(ctx, info)
+        headers = self._obj_response_headers(info)
+        headers["Content-Length"] = str(info.size)
+        if short is not None:
+            return HTTPResponse(status=short, headers=headers)
+        self._notify("s3:ObjectAccessed:Head", bucket, key)
+        return HTTPResponse(headers=headers)
+
+    def delete_object(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:DeleteObject", bucket, key)
+        self.obj.get_bucket_info(bucket)
+        vid = ctx.query1("versionId")
+        versioned = self.bucket_meta.versioning_enabled(bucket)
+        headers = {}
+        try:
+            res = self.obj.delete_object(
+                bucket, key, version_id="" if vid == "null" else vid,
+                versioned=versioned)
+            if isinstance(res, ObjectInfo):
+                if res.delete_marker:
+                    headers["x-amz-delete-marker"] = "true"
+                if res.version_id and res.version_id != "null":
+                    headers["x-amz-version-id"] = res.version_id
+        except oerr.ObjectNotFound:
+            pass  # S3 DELETE of a missing key is 204
+        self._notify("s3:ObjectRemoved:Delete", bucket, key)
+        return HTTPResponse(status=204, headers=headers)
+
+    def copy_object(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        src_bucket, src_key, src_vid = _parse_copy_source(
+            ctx.header("x-amz-copy-source"))
+        if self.iam is not None and ctx.cred and \
+                ctx.cred.access_key != self.root_cred.access_key:
+            if not self.iam.is_allowed(ctx.cred, "s3:GetObject",
+                                       src_bucket, src_key):
+                raise S3Error("AccessDenied")
+        opts = GetOptions(version_id=src_vid)
+        src_info = self.obj.get_object_info(src_bucket, src_key, opts)
+        # copy preconditions
+        csm = ctx.header("x-amz-copy-source-if-match")
+        if csm and csm.strip('"') != src_info.etag:
+            raise S3Error("PreconditionFailed")
+        csnm = ctx.header("x-amz-copy-source-if-none-match")
+        if csnm and csnm.strip('"') == src_info.etag:
+            raise S3Error("PreconditionFailed")
+        directive = ctx.header("x-amz-metadata-directive", "COPY")
+        if directive == "REPLACE":
+            metadata = _extract_metadata(ctx)
+        else:
+            if src_bucket == bucket and src_key == key:
+                raise S3Error("InvalidRequest",
+                              "self-copy requires metadata directive "
+                              "REPLACE")
+            metadata = dict(src_info.user_defined)
+            metadata["content-type"] = src_info.content_type
+        _, stream = self.obj.get_object(src_bucket, src_key, 0,
+                                        src_info.size, opts)
+        if src_bucket == bucket and src_key == key:
+            # self-copy: drain before writing — the GET stream holds the
+            # read lock the PUT's write lock would wait on
+            stream = iter([b"".join(stream)])
+        reader = HashReader(_IterStream(stream), src_info.size)
+        versioned = self.bucket_meta.versioning_enabled(bucket)
+        info = self.obj.put_object(
+            bucket, key, reader, src_info.size,
+            PutOptions(metadata=metadata, versioned=versioned))
+        headers = {}
+        if info.version_id and info.version_id != "null":
+            headers["x-amz-version-id"] = info.version_id
+        self._notify("s3:ObjectCreated:Copy", bucket, key)
+        return HTTPResponse(headers=headers).with_xml(
+            xmlgen.copy_object_response(info.etag, info.mod_time))
+
+    # --- multipart ------------------------------------------------------
+
+    def new_multipart_upload(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        self.obj.get_bucket_info(bucket)
+        metadata = _extract_metadata(ctx)
+        upload_id = self.obj.new_multipart_upload(
+            bucket, key, PutOptions(metadata=metadata))
+        return HTTPResponse().with_xml(
+            xmlgen.initiate_multipart_response(bucket, key, upload_id))
+
+    def put_object_part(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        upload_id = ctx.query1("uploadId")
+        try:
+            part_number = int(ctx.query1("partNumber"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "partNumber must be an int")
+        if not 1 <= part_number <= MAX_PARTS:
+            raise S3Error("InvalidArgument",
+                          f"partNumber must be 1..{MAX_PARTS}")
+        reader, size = self._put_reader(ctx)
+        if size > MAX_PART_SIZE:
+            raise S3Error("EntityTooLarge")
+        part = self.obj.put_object_part(bucket, key, upload_id,
+                                        part_number, reader, size)
+        return HTTPResponse(headers={"ETag": f'"{part.etag}"'})
+
+    def copy_object_part(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        upload_id = ctx.query1("uploadId")
+        try:
+            part_number = int(ctx.query1("partNumber"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "partNumber must be an int")
+        src_bucket, src_key, src_vid = _parse_copy_source(
+            ctx.header("x-amz-copy-source"))
+        opts = GetOptions(version_id=src_vid)
+        src_info = self.obj.get_object_info(src_bucket, src_key, opts)
+        rng = _parse_range(ctx.header("x-amz-copy-source-range"),
+                           src_info.size)
+        offset, length = (0, src_info.size) if rng is None else rng
+        _, stream = self.obj.get_object(src_bucket, src_key, offset,
+                                        length, opts)
+        reader = HashReader(_IterStream(stream), length)
+        part = self.obj.put_object_part(bucket, key, upload_id,
+                                        part_number, reader, length)
+        x = xmlgen.X()
+        x.open("CopyPartResult", xmlns=xmlgen.S3_XMLNS)
+        x.elem("LastModified", xmlgen._ts(part.mod_time
+                                          if hasattr(part, "mod_time")
+                                          else 0.0))
+        x.elem("ETag", f'"{part.etag}"')
+        x.close("CopyPartResult")
+        return HTTPResponse().with_xml(x.bytes())
+
+    def complete_multipart_upload(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObject", bucket, key)
+        upload_id = ctx.query1("uploadId")
+        body = ctx.read_body()
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        parts: list[CompletePart] = []
+        for child in root:
+            if not child.tag.endswith("Part"):
+                continue
+            num = etag = None
+            for sub in child:
+                st = sub.tag.split("}")[-1]
+                if st == "PartNumber":
+                    num = int(sub.text or "0")
+                elif st == "ETag":
+                    etag = (sub.text or "").strip('"')
+            if num is None or etag is None:
+                raise S3Error("MalformedXML")
+            parts.append(CompletePart(num, etag))
+        if not parts:
+            raise S3Error("MalformedXML", "no parts")
+        if parts != sorted(parts, key=lambda p: p.part_number):
+            raise S3Error("InvalidPartOrder")
+        info = self.obj.complete_multipart_upload(bucket, key, upload_id,
+                                                  parts)
+        self._notify("s3:ObjectCreated:CompleteMultipartUpload", bucket,
+                     key)
+        host = ctx.header("host", "")
+        return HTTPResponse().with_xml(xmlgen.complete_multipart_response(
+            f"http://{host}/{bucket}/{key}", bucket, key, info.etag))
+
+    def abort_multipart_upload(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:AbortMultipartUpload", bucket, key)
+        self.obj.abort_multipart_upload(bucket, key,
+                                        ctx.query1("uploadId"))
+        return HTTPResponse(status=204)
+
+    def list_object_parts(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:ListMultipartUploadParts", bucket, key)
+        upload_id = ctx.query1("uploadId")
+        try:
+            marker = int(ctx.query1("part-number-marker", "0"))
+        except ValueError:
+            raise S3Error("InvalidArgument",
+                          "part-number-marker must be an int")
+        max_parts = _parse_max_keys(ctx.query1("max-parts", "1000"))
+        parts = self.obj.list_object_parts(bucket, key, upload_id, marker,
+                                           max_parts + 1)
+        trunc = len(parts) > max_parts
+        parts = parts[:max_parts]
+        next_marker = parts[-1].part_number if parts and trunc else 0
+        return HTTPResponse().with_xml(xmlgen.list_parts_response(
+            bucket, key, upload_id, marker, next_marker, max_parts, trunc,
+            parts))
+
+    # --- object tagging -------------------------------------------------
+
+    def get_object_tagging(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetObjectTagging", bucket, key)
+        info = self.obj.get_object_info(bucket, key)
+        raw = info.user_defined.get("X-Amz-Tagging", "")
+        tags = dict(urllib.parse.parse_qsl(raw))
+        return HTTPResponse().with_xml(xmlgen.tagging_response(tags))
+
+    def put_object_tagging(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObjectTagging", bucket, key)
+        tags = _parse_tagging_xml(ctx.read_body())
+        self._rewrite_metadata(
+            bucket, key,
+            {"X-Amz-Tagging": urllib.parse.urlencode(tags)})
+        return HTTPResponse()
+
+    def delete_object_tagging(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:DeleteObjectTagging", bucket, key)
+        self._rewrite_metadata(bucket, key, {"X-Amz-Tagging": None})
+        return HTTPResponse(status=204)
+
+    def _rewrite_metadata(self, bucket, key, updates: dict) -> None:
+        """Metadata-only rewrite via self-copy (no dedicated metadata-op
+        verb on the layer yet)."""
+        info = self.obj.get_object_info(bucket, key)
+        md = dict(info.user_defined)
+        md["content-type"] = info.content_type
+        for k, v in updates.items():
+            if v is None:
+                md.pop(k, None)
+            else:
+                md[k] = v
+        md["etag"] = info.etag
+        # drain first: the GET stream holds the object's read lock until
+        # exhausted, and the PUT below needs the write lock
+        _, stream = self.obj.get_object(bucket, key, 0, info.size)
+        data = b"".join(stream)
+        self.obj.put_object(bucket, key,
+                            HashReader(io.BytesIO(data), len(data)),
+                            len(data), PutOptions(metadata=md))
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    def _enforce_quota(self, bucket: str, incoming: int) -> None:
+        q = self.bucket_meta.get_quota(bucket)
+        if not q or not q.get("quota"):
+            return
+        limit = int(q["quota"])
+        if self._bucket_usage(bucket) + incoming > limit:
+            raise S3Error("QuotaExceeded")
+
+    def _bucket_usage(self, bucket: str) -> int:
+        """Bytes used by one bucket. Walks the listing (the data-usage
+        crawler cache replaces this scan once wired, cmd/bucket-quota.go
+        reads dataUsageCache)."""
+        used = 0
+        marker = ""
+        while True:
+            objs, _, trunc = self.obj.list_objects(bucket, "", marker,
+                                                   "", 1000)
+            used += sum(o.size for o in objs)
+            if not trunc or not objs:
+                return used
+            marker = objs[-1].name
+
+    def _notify(self, event_name: str, bucket: str, key: str) -> None:
+        if self.events is not None:
+            try:
+                self.events.send(event_name, bucket, key)
+            except Exception:  # noqa: BLE001 — events are best-effort
+                pass
+
+
+class _IterStream:
+    """File-like over an iterator of byte chunks."""
+
+    def __init__(self, it: Iterator[bytes]):
+        self.it = it
+        self.buf = b""
+        self.eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        while not self.eof and (n < 0 or len(self.buf) < n):
+            try:
+                self.buf += next(self.it)
+            except StopIteration:
+                self.eof = True
+        if n < 0:
+            out, self.buf = self.buf, b""
+        else:
+            out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
+def _parse_max_keys(v: str) -> int:
+    try:
+        n = int(v)
+    except ValueError:
+        raise S3Error("InvalidArgument", "max-keys must be an int")
+    if n < 0:
+        raise S3Error("InvalidArgument", "max-keys must be >= 0")
+    return min(n, 1000)  # 0 is a legal request for an empty listing
+
+
+def _encode_token(marker: str) -> str:
+    return base64.urlsafe_b64encode(marker.encode()).decode()
+
+
+def _decode_token(token: str) -> str:
+    try:
+        return base64.urlsafe_b64decode(token.encode()).decode()
+    except (binascii.Error, ValueError):
+        raise S3Error("InvalidArgument", "bad continuation token")
+
+
+def _parse_copy_source(src: str) -> tuple[str, str, str]:
+    src = urllib.parse.unquote(src)
+    vid = ""
+    if "?versionId=" in src:
+        src, vid = src.split("?versionId=", 1)
+    src = src.lstrip("/")
+    if "/" not in src:
+        raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+    bucket, key = src.split("/", 1)
+    return bucket, key, "" if vid == "null" else vid
+
+
+def _parse_tagging_xml(body: bytes) -> dict[str, str]:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise S3Error("MalformedXML")
+    tags: dict[str, str] = {}
+    for ts in root.iter():
+        if ts.tag.split("}")[-1] == "Tag":
+            k = v = None
+            for sub in ts:
+                st = sub.tag.split("}")[-1]
+                if st == "Key":
+                    k = sub.text or ""
+                elif st == "Value":
+                    v = sub.text or ""
+            if not k or len(k) > 128 or (v and len(v) > 256):
+                raise S3Error("InvalidTagKey" if not k or len(k) > 128
+                              else "InvalidTagValue")
+            tags[k] = v or ""
+    if len(tags) > 50:
+        raise S3Error("InvalidArgument", "too many tags")
+    return tags

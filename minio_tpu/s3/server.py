@@ -1,0 +1,160 @@
+"""S3 HTTP server — wire transport for the handler layer.
+
+The reference's L1 frontend (cmd/http/, cmd/routers.go) is an epoll Go
+server with a middleware chain; here a threaded stdlib HTTP server feeds
+the same request snapshot into S3ApiHandlers. Streaming: response bodies
+may be chunk iterators (GET path never buffers the whole object).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import signature as sig
+from .credentials import Credentials
+from .handlers import HTTPResponse, RequestContext, S3ApiHandlers
+
+SERVER_NAME = "MinIO-TPU"
+
+
+class _BodyReader:
+    """Content-Length-bounded request-body reader that can drain what the
+    handler left unread (keep-alive connection hygiene)."""
+
+    def __init__(self, raw, length: int):
+        self.raw = raw
+        self.remaining = max(length, 0)
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        if n is None or n < 0 or n > self.remaining:
+            n = self.remaining
+        chunk = self.raw.read(n)
+        self.remaining -= len(chunk)
+        return chunk
+
+    def drain(self) -> None:
+        while self.remaining > 0:
+            if not self.read(min(self.remaining, 1 << 16)):
+                break
+
+
+def _make_handler_class(api: S3ApiHandlers, extra_routers):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = SERVER_NAME
+
+        def log_message(self, fmt, *args):  # silence default stderr log
+            pass
+
+        def _snapshot(self) -> RequestContext:
+            parsed = urllib.parse.urlsplit(self.path)
+            query = urllib.parse.parse_qs(parsed.query,
+                                          keep_blank_values=True)
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            req = sig.Request(method=self.command, path=parsed.path,
+                              query=query, headers=headers,
+                              raw_query=parsed.query)
+            length = int(headers.get("content-length", 0) or 0)
+            return RequestContext(req, _BodyReader(self.rfile, length),
+                                  length)
+
+        def _respond(self, resp: HTTPResponse) -> None:
+            body = resp.body
+            chunked = resp.stream is not None and \
+                "Content-Length" not in resp.headers
+            self.send_response(resp.status)
+            for k, v in resp.headers.items():
+                self.send_header(k, v)
+            if resp.stream is None and "Content-Length" not in resp.headers:
+                self.send_header("Content-Length", str(len(body)))
+            if chunked:
+                self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            if self.command == "HEAD":
+                if resp.stream is not None:
+                    resp.stream.close()
+                return
+            try:
+                if resp.stream is not None:
+                    if chunked:
+                        for chunk in resp.stream:
+                            if chunk:
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        for chunk in resp.stream:
+                            self.wfile.write(chunk)
+                elif body:
+                    self.wfile.write(body)
+            except BrokenPipeError:
+                pass
+
+        def _dispatch(self) -> None:
+            # admin/health/metrics routers get first crack at the path
+            ctx = self._snapshot()
+            try:
+                for prefix, router in extra_routers:
+                    if self.path.startswith(prefix):
+                        self._respond(router(ctx))
+                        return
+                self._respond(api.handle(ctx))
+            finally:
+                # keep-alive hygiene: any request-body bytes the handler
+                # didn't consume (auth failure, early error, streaming
+                # trailer) would otherwise be parsed as the next request
+                ctx.body_stream.drain()
+
+        do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
+
+    return Handler
+
+
+class S3Server:
+    """Threaded S3 endpoint over an object layer.
+
+    extra_routers: list of (path_prefix, fn(ctx) -> HTTPResponse) checked
+    before S3 routing — used for /minio/admin, /minio/health, metrics.
+    """
+
+    def __init__(self, object_layer, address: str = "127.0.0.1",
+                 port: int = 0, region: str = "us-east-1",
+                 creds: Optional[Credentials] = None, iam=None):
+        self.api = S3ApiHandlers(object_layer, region=region, creds=creds,
+                                 iam=iam)
+        self.extra_routers: list = []
+        self._httpd = ThreadingHTTPServer(
+            (address, port),
+            _make_handler_class(self.api, self.extra_routers))
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def register_router(self, prefix: str, fn) -> None:
+        self.extra_routers.append((prefix, fn))
+
+    def start(self) -> "S3Server":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -352,7 +352,8 @@ def test_multipart_roundtrip(eng):
     uid = eng.new_multipart_upload("bucket", "mp",
                                    PutOptions(metadata={"content-type":
                                                         "app/x"}))
-    assert uid in eng.list_multipart_uploads("bucket", "mp")
+    uploads = eng.list_multipart_uploads("bucket", "mp")
+    assert ("mp", uid) in [(u["object"], u["upload_id"]) for u in uploads]
     etags = []
     for n, p in [(1, p1), (2, p2), (3, p3)]:
         pi = eng.put_object_part("bucket", "mp", uid, n, p)
