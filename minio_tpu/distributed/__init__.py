@@ -1,0 +1,7 @@
+"""Distributed runtime: internode RPC transport, quorum locks (dsync),
+remote storage, peer control plane.
+
+The rebuild of the reference's L7 (cmd/rest/, pkg/dsync/,
+cmd/lock-rest-*.go, cmd/storage-rest-*.go, cmd/peer-rest-*.go): nodes
+speak a thin authenticated HTTP-POST RPC over DCN; shard-batch math
+stays on-device over ICI (minio_tpu/parallel)."""

@@ -1,0 +1,201 @@
+"""dsync — distributed quorum RW lock (pkg/dsync/drwmutex.go).
+
+A lock over N lockers (one per node) is held when a quorum grants it:
+    tolerance = N // 2
+    quorum    = N - tolerance   (+1 when N is even and it's a write lock)
+Acquisition broadcasts Lock/RLock to all lockers concurrently, waits for
+responses, and on sub-quorum releases every partial grant
+(drwmutex.go:213-380). Callers retry with jitter until their timeout
+(lockBlocking, drwmutex.go:143).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from typing import Optional, Protocol
+
+ACQUIRE_TIMEOUT = 1.0          # per-broadcast collect window
+RETRY_INTERVAL_MAX = 0.25      # jittered sleep between attempts
+
+
+class NetLocker(Protocol):
+    """One node's lock endpoint (pkg/dsync/rpc-client-interface.go:39).
+    LocalLocker satisfies this in-process; LockRPCClient over the wire."""
+
+    def lock(self, uid: str, resources: list[str], owner: str,
+             source: str) -> bool: ...
+    def rlock(self, uid: str, resources: list[str], owner: str,
+              source: str) -> bool: ...
+    def unlock(self, uid: str, resources: list[str]) -> bool: ...
+    def runlock(self, uid: str, resources: list[str]) -> bool: ...
+
+
+def quorum_for(n: int, write: bool) -> int:
+    tolerance = n // 2
+    q = n - tolerance
+    if write and q == tolerance:
+        q += 1   # even N: write quorum must exceed half
+    return q
+
+
+class DRWMutex:
+    """Distributed RW mutex over a fixed locker list (one resource)."""
+
+    def __init__(self, lockers: list[Optional[NetLocker]],
+                 resources: list[str], owner: str = "dsync"):
+        self.lockers = lockers
+        self.resources = sorted(resources)
+        self.owner = owner
+        self._uid = ""
+        self._write = False
+
+    # -- public API (DRWMutex.GetLock / GetRLock / Unlock / RUnlock) -------
+
+    def get_lock(self, timeout: float = 30.0, source: str = "") -> bool:
+        return self._lock_blocking(True, timeout, source)
+
+    def get_rlock(self, timeout: float = 30.0, source: str = "") -> bool:
+        return self._lock_blocking(False, timeout, source)
+
+    def unlock(self) -> None:
+        self._release_all(self._uid, self._write)
+        self._uid = ""
+
+    runlock = unlock
+
+    # -- internals ---------------------------------------------------------
+
+    def _lock_blocking(self, write: bool, timeout: float,
+                       source: str) -> bool:
+        deadline = time.monotonic() + timeout
+        uid = str(uuid.uuid4())
+        while True:
+            if self._try_once(uid, write, source):
+                self._uid, self._write = uid, write
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(random.random() * RETRY_INTERVAL_MAX)
+
+    def _try_once(self, uid: str, write: bool, source: str) -> bool:
+        n = len(self.lockers)
+        need = quorum_for(n, write)
+        granted: list[Optional[bool]] = [None] * n
+        done = threading.Event()
+        pending = threading.Semaphore(0)
+
+        def ask(i: int, lk: NetLocker) -> None:
+            try:
+                if write:
+                    ok = lk.lock(uid, self.resources, self.owner, source)
+                else:
+                    ok = lk.rlock(uid, self.resources, self.owner, source)
+            except Exception:  # noqa: BLE001 — a dead locker is a no-vote
+                ok = False
+            granted[i] = ok
+            pending.release()
+
+        live = 0
+        for i, lk in enumerate(self.lockers):
+            if lk is None:
+                granted[i] = False
+                pending.release()
+                continue
+            live += 1
+            threading.Thread(target=ask, args=(i, lk), daemon=True).start()
+
+        # collect answers up to the acquire window; stop early once the
+        # outcome is decided either way
+        deadline = time.monotonic() + ACQUIRE_TIMEOUT
+        answers = 0
+        while answers < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if not pending.acquire(timeout=remaining):
+                break
+            answers += 1
+            yes = sum(1 for g in granted if g)
+            no = sum(1 for g in granted if g is False)
+            if yes >= need or no > n - need:
+                break
+
+        if sum(1 for g in granted if g) >= need:
+            done.set()
+            return True
+        # sub-quorum: roll back whatever was granted (and whatever may
+        # still be granted after the window — unlock is idempotent)
+        self._release_all(uid, write)
+        return False
+
+    def _release_all(self, uid: str, write: bool) -> None:
+        if not uid:
+            return
+        for lk in self.lockers:
+            if lk is None:
+                continue
+            try:
+                if write:
+                    lk.unlock(uid, self.resources)
+                else:
+                    lk.runlock(uid, self.resources)
+            except Exception:  # noqa: BLE001 — expiry sweep will reap it
+                pass
+
+
+class DistNSLockMap:
+    """Distributed drop-in for object.nslock.NSLockMap: new_lock returns
+    an RWLocker backed by DRWMutex over the cluster's lockers
+    (cmd/namespace-lock.go distLockInstance)."""
+
+    def __init__(self, lockers: list[Optional[NetLocker]],
+                 owner: str = ""):
+        self.lockers = lockers
+        self.owner = owner or str(uuid.uuid4())
+
+    def new_lock(self, *paths: str) -> "DistNSLock":
+        return DistNSLock(DRWMutex(self.lockers,
+                                   [p for p in paths if p], self.owner))
+
+
+class DistNSLock:
+    def __init__(self, dm: DRWMutex):
+        self._dm = dm
+
+    def get_lock(self, timeout: float = 30.0) -> bool:
+        return self._dm.get_lock(timeout)
+
+    def get_rlock(self, timeout: float = 30.0) -> bool:
+        return self._dm.get_rlock(timeout)
+
+    def unlock(self) -> None:
+        self._dm.unlock()
+
+    runlock = unlock
+
+    def write_locked(self, timeout: float = 30.0):
+        return _DistLockCtx(self, True, timeout)
+
+    def read_locked(self, timeout: float = 30.0):
+        return _DistLockCtx(self, False, timeout)
+
+
+class _DistLockCtx:
+    def __init__(self, lock: DistNSLock, write: bool, timeout: float):
+        self._lock, self._write, self._timeout = lock, write, timeout
+
+    def __enter__(self):
+        ok = (self._lock.get_lock(self._timeout) if self._write
+              else self._lock.get_rlock(self._timeout))
+        if not ok:
+            from ..object import api_errors
+            raise api_errors.ObjectApiError(
+                "distributed lock acquisition timed out")
+        return self._lock
+
+    def __exit__(self, *exc):
+        self._lock.unlock()
+        return False

@@ -1,0 +1,222 @@
+"""Peer control plane + bootstrap verify.
+
+The reference fans control operations out to every node over peer REST
+(cmd/peer-rest-client.go / cmd/peer-rest-server.go, aggregated by
+NotificationSys, cmd/notification.go) and verifies cluster config
+consistency at startup against the first node
+(cmd/bootstrap-peer-server.go verifyServerSystemConfig).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from .transport import NetworkError, RestClient, RPCError, RPCHandler
+
+PEER_RPC_PREFIX = "/minio/peer/v1"
+BOOTSTRAP_RPC_PREFIX = "/minio/bootstrap/v1"
+
+
+class PeerRPCServer:
+    """This node's control-plane verbs. Hooks are injected so the server
+    stays decoupled from the subsystems it pokes."""
+
+    def __init__(self, access_key: str, secret_key: str,
+                 node_id: str = ""):
+        self.handler = RPCHandler(PEER_RPC_PREFIX, access_key, secret_key)
+        self.node_id = node_id
+        self.started = time.time()
+        # injectable hooks
+        self.get_server_info: Callable[[], dict] = lambda: {}
+        self.get_locks: Callable[[], dict] = lambda: {}
+        self.reload_bucket_metadata: Callable[[str], None] = lambda b: None
+        self.reload_iam: Callable[[], None] = lambda: None
+        self.signal_service: Callable[[str], None] = lambda sig: None
+        self.get_metrics: Callable[[], dict] = lambda: {}
+
+        h = self.handler
+        h.register("server-info", lambda a, b: {
+            "node": self.node_id, "uptime": time.time() - self.started,
+            **self.get_server_info()})
+        h.register("locks", lambda a, b: self.get_locks())
+        h.register("reload-bucket-metadata", self._reload_bm)
+        h.register("reload-iam", lambda a, b: self.reload_iam())
+        h.register("signal", self._signal)
+        h.register("metrics", lambda a, b: self.get_metrics())
+
+    def _reload_bm(self, args, body):
+        self.reload_bucket_metadata(args.get("bucket", ""))
+
+    def _signal(self, args, body):
+        self.signal_service(args.get("sig", ""))
+
+    def route(self, ctx):
+        return self.handler.route(ctx)
+
+
+class PeerRPCClient:
+    def __init__(self, host: str, port: int, access_key: str,
+                 secret_key: str, timeout: float = 5.0):
+        self.rc = RestClient(host, port, PEER_RPC_PREFIX, access_key,
+                             secret_key, timeout=timeout)
+
+    def server_info(self) -> Optional[dict]:
+        try:
+            return self.rc.call_json("server-info")
+        except (NetworkError, RPCError):
+            return None
+
+    def locks(self) -> dict:
+        try:
+            return self.rc.call_json("locks") or {}
+        except (NetworkError, RPCError):
+            return {}
+
+    def reload_bucket_metadata(self, bucket: str) -> bool:
+        try:
+            self.rc.call("reload-bucket-metadata", {"bucket": bucket})
+            return True
+        except (NetworkError, RPCError):
+            return False
+
+    def reload_iam(self) -> bool:
+        try:
+            self.rc.call("reload-iam")
+            return True
+        except (NetworkError, RPCError):
+            return False
+
+    def signal_service(self, sig: str) -> bool:
+        try:
+            self.rc.call("signal", {"sig": sig})
+            return True
+        except (NetworkError, RPCError):
+            return False
+
+    def metrics(self) -> dict:
+        try:
+            return self.rc.call_json("metrics") or {}
+        except (NetworkError, RPCError):
+            return {}
+
+    @property
+    def online(self) -> bool:
+        return self.rc.online
+
+    def close(self) -> None:
+        self.rc.close()
+
+
+class NotificationSys:
+    """Fan-out aggregator over all peer clients (cmd/notification.go):
+    each call broadcasts concurrently and returns per-peer results."""
+
+    def __init__(self, peers: list[PeerRPCClient]):
+        self.peers = peers
+
+    def _broadcast(self, fn: Callable[[PeerRPCClient], object]) -> list:
+        out: list = [None] * len(self.peers)
+        threads = []
+        for i, p in enumerate(self.peers):
+            def run(i=i, p=p):
+                try:
+                    out[i] = fn(p)
+                except Exception as e:  # noqa: BLE001 — per-peer result
+                    out[i] = e
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=10)
+        return out
+
+    def server_info_all(self) -> list:
+        return self._broadcast(lambda p: p.server_info())
+
+    def reload_bucket_metadata(self, bucket: str) -> list:
+        return self._broadcast(
+            lambda p: p.reload_bucket_metadata(bucket))
+
+    def reload_iam(self) -> list:
+        return self._broadcast(lambda p: p.reload_iam())
+
+    def top_locks(self) -> dict:
+        merged: dict = {}
+        for locks in self._broadcast(lambda p: p.locks()):
+            if isinstance(locks, dict):
+                for res, holders in locks.items():
+                    merged.setdefault(res, []).extend(holders)
+        return merged
+
+    def signal_all(self, sig: str) -> list:
+        return self._broadcast(lambda p: p.signal_service(sig))
+
+
+# ---------------------------------------------------------------------------
+# bootstrap verify
+# ---------------------------------------------------------------------------
+
+def system_config_hash(endpoints: list[str], access_key: str,
+                       secret_key: str) -> str:
+    """Digest of the node's view of cluster topology + credentials
+    (the reference compares ServerSystemConfig field-by-field; a digest
+    keeps secrets off the wire)."""
+    blob = json.dumps({
+        "endpoints": sorted(endpoints),
+        "cred": hashlib.sha256(
+            f"{access_key}:{secret_key}".encode()).hexdigest(),
+    }, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class BootstrapRPCServer:
+    def __init__(self, access_key: str, secret_key: str,
+                 endpoints: list[str]):
+        self.handler = RPCHandler(BOOTSTRAP_RPC_PREFIX, access_key,
+                                  secret_key)
+        self.config_hash = system_config_hash(endpoints, access_key,
+                                              secret_key)
+        self.handler.register(
+            "verify", lambda a, b: {"hash": self.config_hash})
+
+    def route(self, ctx):
+        return self.handler.route(ctx)
+
+
+def verify_server_system_config(peers: list[tuple[str, int]],
+                                endpoints: list[str], access_key: str,
+                                secret_key: str, retries: int = 30,
+                                interval: float = 1.0) -> None:
+    """Block until every peer reports the same config digest
+    (cmd/server-main.go:464-478 retry loop). Raises RuntimeError on a
+    real mismatch; keeps retrying while peers are unreachable."""
+    want = system_config_hash(endpoints, access_key, secret_key)
+    remaining = {f"{h}:{p}" for h, p in peers}
+    for _ in range(retries):
+        for h, p in list(peers):
+            key = f"{h}:{p}"
+            if key not in remaining:
+                continue
+            rc = RestClient(h, p, BOOTSTRAP_RPC_PREFIX, access_key,
+                            secret_key, timeout=2.0)
+            try:
+                got = rc.call_json("verify")
+            except (NetworkError, RPCError):
+                continue
+            finally:
+                rc.close()
+            if got and got.get("hash") == want:
+                remaining.discard(key)
+            elif got:
+                raise RuntimeError(
+                    f"peer {key} has a different cluster config "
+                    "(endpoints or credentials mismatch)")
+        if not remaining:
+            return
+        time.sleep(interval)
+    raise RuntimeError(f"peers unreachable during bootstrap: "
+                       f"{sorted(remaining)}")

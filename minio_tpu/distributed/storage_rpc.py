@@ -1,0 +1,387 @@
+"""Storage RPC — every StorageAPI verb over the internode transport.
+
+The reference's cmd/storage-rest-server.go / cmd/storage-rest-client.go:
+a remote drive is just a StorageAPI whose verbs travel as
+`POST /minio/storage/v1/<verb>` with JSON args and raw byte bodies.
+The client maps transport failures to DiskNotFound so quorum logic
+treats a dead peer exactly like a dead local drive, and the underlying
+RestClient probes the host back online (cmd/storage-rest-client.go
+toStorageErr + reconnect semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+from typing import BinaryIO, Iterator, Optional
+
+from ..storage import errors as serr
+from ..storage.api import BitrotVerifier, StorageAPI
+from ..storage.datatypes import (ChecksumInfo, DiskInfo, ErasureInfo,
+                                 FileInfo, ObjectPartInfo, VolInfo)
+from .transport import NetworkError, RestClient, RPCError, RPCHandler
+
+STORAGE_RPC_PREFIX = "/minio/storage/v1"
+
+
+# ---------------------------------------------------------------------------
+# FileInfo wire codec (the reference uses msgp codegen on the same structs,
+# cmd/storage-datatypes_gen.go)
+# ---------------------------------------------------------------------------
+
+def fi_to_dict(fi: FileInfo) -> dict:
+    d = dataclasses.asdict(fi)
+    for c in d["erasure"]["checksums"]:
+        c["hash"] = c["hash"].hex()
+    return d
+
+
+def fi_from_dict(d: dict) -> FileInfo:
+    e = d.get("erasure", {})
+    checksums = [ChecksumInfo(part_number=c["part_number"],
+                              algorithm=c["algorithm"],
+                              hash=bytes.fromhex(c["hash"]))
+                 for c in e.get("checksums", [])]
+    erasure = ErasureInfo(
+        algorithm=e.get("algorithm", ""),
+        data_blocks=e.get("data_blocks", 0),
+        parity_blocks=e.get("parity_blocks", 0),
+        block_size=e.get("block_size", 0),
+        index=e.get("index", 0),
+        distribution=list(e.get("distribution", [])),
+        checksums=checksums)
+    parts = [ObjectPartInfo(**p) for p in d.get("parts", [])]
+    return FileInfo(
+        volume=d.get("volume", ""), name=d.get("name", ""),
+        version_id=d.get("version_id", ""),
+        is_latest=d.get("is_latest", True),
+        deleted=d.get("deleted", False),
+        data_dir=d.get("data_dir", ""),
+        mod_time=d.get("mod_time", 0.0), size=d.get("size", 0),
+        metadata=dict(d.get("metadata", {})), parts=parts,
+        erasure=erasure)
+
+
+# error name <-> class registry: RPC carries the class name as `kind`
+_ERR_CLASSES = {name: cls for name, cls in vars(serr).items()
+                if isinstance(cls, type) and issubclass(cls, Exception)}
+
+
+def _to_storage_err(e: Exception) -> Exception:
+    if isinstance(e, RPCError):
+        cls = _ERR_CLASSES.get(e.kind)
+        if cls is not None:
+            return cls(e.message)
+        return serr.UnexpectedError(f"{e.kind}: {e.message}")
+    if isinstance(e, NetworkError):
+        return serr.DiskNotFound(str(e))
+    return e
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class StorageRPCServer:
+    """Exposes one node's local drives. Each drive is addressed by its
+    endpoint path (the `disk` arg), mirroring the reference's
+    per-endpoint route mounting."""
+
+    def __init__(self, drives: dict[str, StorageAPI], access_key: str,
+                 secret_key: str):
+        self.drives = drives
+        self.handler = RPCHandler(STORAGE_RPC_PREFIX, access_key,
+                                  secret_key)
+        for verb in ("diskinfo", "getdiskid", "setdiskid", "makevol",
+                     "listvols", "statvol", "deletevol", "writemetadata",
+                     "readversion", "readversions", "deleteversion",
+                     "renamedata", "listdir", "readfile", "appendfile",
+                     "createfile", "renamefile", "checkparts",
+                     "checkfile", "deletefile", "verifyfile", "writeall",
+                     "readall", "walk"):
+            self.handler.register(verb, getattr(self, "_" + verb))
+
+    def route(self, ctx):
+        return self.handler.route(ctx)
+
+    def _disk(self, args: dict) -> StorageAPI:
+        d = self.drives.get(args.get("disk", ""))
+        if d is None:
+            raise serr.DiskNotFound(args.get("disk", ""))
+        return d
+
+    # each verb: (args, body) -> dict | bytes | None ------------------------
+
+    def _diskinfo(self, a, b):
+        info = self._disk(a).disk_info()
+        return dataclasses.asdict(info)
+
+    def _getdiskid(self, a, b):
+        return {"id": self._disk(a).get_disk_id()}
+
+    def _setdiskid(self, a, b):
+        self._disk(a).set_disk_id(a.get("id", ""))
+
+    def _makevol(self, a, b):
+        self._disk(a).make_vol(a["volume"])
+
+    def _listvols(self, a, b):
+        return [{"name": v.name, "created": v.created}
+                for v in self._disk(a).list_vols()]
+
+    def _statvol(self, a, b):
+        v = self._disk(a).stat_vol(a["volume"])
+        return {"name": v.name, "created": v.created}
+
+    def _deletevol(self, a, b):
+        self._disk(a).delete_vol(a["volume"],
+                                 force=a.get("force") == "true")
+
+    def _writemetadata(self, a, b):
+        self._disk(a).write_metadata(a["volume"], a["path"],
+                                     fi_from_dict(json.loads(b.decode())))
+
+    def _readversion(self, a, b):
+        fi = self._disk(a).read_version(a["volume"], a["path"],
+                                        a.get("version-id", ""))
+        return fi_to_dict(fi)
+
+    def _readversions(self, a, b):
+        return [fi_to_dict(fi) for fi in
+                self._disk(a).read_versions(a["volume"], a["path"])]
+
+    def _deleteversion(self, a, b):
+        self._disk(a).delete_version(a["volume"], a["path"],
+                                     fi_from_dict(json.loads(b.decode())))
+
+    def _renamedata(self, a, b):
+        self._disk(a).rename_data(a["src-volume"], a["src-path"],
+                                  a["data-dir"], a["dst-volume"],
+                                  a["dst-path"])
+
+    def _listdir(self, a, b):
+        return self._disk(a).list_dir(a["volume"], a.get("dir-path", ""),
+                                      int(a.get("count", "-1")))
+
+    def _readfile(self, a, b):
+        verifier = None
+        if a.get("verifier-algo"):
+            verifier = BitrotVerifier(a["verifier-algo"],
+                                      bytes.fromhex(a["verifier-hash"]))
+        return self._disk(a).read_file(a["volume"], a["path"],
+                                       int(a["offset"]), int(a["length"]),
+                                       verifier)
+
+    def _appendfile(self, a, b):
+        self._disk(a).append_file(a["volume"], a["path"], b)
+
+    def _createfile(self, a, b):
+        self._disk(a).create_file(a["volume"], a["path"],
+                                  int(a.get("size", "-1")),
+                                  io.BytesIO(b))
+
+    def _renamefile(self, a, b):
+        self._disk(a).rename_file(a["src-volume"], a["src-path"],
+                                  a["dst-volume"], a["dst-path"])
+
+    def _checkparts(self, a, b):
+        self._disk(a).check_parts(a["volume"], a["path"],
+                                  fi_from_dict(json.loads(b.decode())))
+
+    def _checkfile(self, a, b):
+        self._disk(a).check_file(a["volume"], a["path"])
+
+    def _deletefile(self, a, b):
+        self._disk(a).delete_file(a["volume"], a["path"],
+                                  recursive=a.get("recursive") == "true")
+
+    def _verifyfile(self, a, b):
+        self._disk(a).verify_file(a["volume"], a["path"],
+                                  fi_from_dict(json.loads(b.decode())))
+
+    def _writeall(self, a, b):
+        self._disk(a).write_all(a["volume"], a["path"], b)
+
+    def _readall(self, a, b):
+        return self._disk(a).read_all(a["volume"], a["path"])
+
+    def _walk(self, a, b):
+        return [fi_to_dict(fi) for fi in
+                self._disk(a).walk(a["volume"], a.get("dir-path", ""),
+                                   a.get("marker", ""),
+                                   a.get("recursive", "true") == "true")]
+
+
+# ---------------------------------------------------------------------------
+# client — a remote drive as a StorageAPI
+# ---------------------------------------------------------------------------
+
+class RemoteStorage(StorageAPI):
+    """StorageAPI over the wire. `disk` names the remote drive (its
+    endpoint path on the serving node)."""
+
+    def __init__(self, host: str, port: int, disk: str, access_key: str,
+                 secret_key: str, timeout: float = 30.0):
+        self.rc = RestClient(host, port, STORAGE_RPC_PREFIX, access_key,
+                             secret_key, timeout=timeout)
+        self.disk = disk
+        self._disk_id = ""
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, verb: str, args: Optional[dict] = None,
+              body: bytes = b"") -> bytes:
+        a = {"disk": self.disk}
+        a.update(args or {})
+        try:
+            return self.rc.call(verb, a, body)
+        except (RPCError, NetworkError) as e:
+            raise _to_storage_err(e) from None
+
+    def _call_json(self, verb: str, args: Optional[dict] = None,
+                   body: bytes = b""):
+        out = self._call(verb, args, body)
+        return json.loads(out.decode()) if out else None
+
+    # -- identity / health -------------------------------------------------
+
+    def __str__(self) -> str:
+        return f"{self.rc.host}:{self.rc.port}{self.disk}"
+
+    def is_online(self) -> bool:
+        return self.rc.online
+
+    def is_local(self) -> bool:
+        return False
+
+    def hostname(self) -> str:
+        return self.rc.host
+
+    def endpoint(self) -> str:
+        return str(self)
+
+    def close(self) -> None:
+        self.rc.close()
+
+    def get_disk_id(self) -> str:
+        out = self._call_json("getdiskid")
+        return out["id"] if out else ""
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+        self._call("setdiskid", {"id": disk_id})
+
+    def disk_info(self) -> DiskInfo:
+        out = self._call_json("diskinfo") or {}
+        return DiskInfo(**out)
+
+    # -- volumes -----------------------------------------------------------
+
+    def make_vol(self, volume: str) -> None:
+        self._call("makevol", {"volume": volume})
+
+    def list_vols(self) -> list[VolInfo]:
+        return [VolInfo(v["name"], v["created"])
+                for v in self._call_json("listvols") or []]
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        v = self._call_json("statvol", {"volume": volume})
+        return VolInfo(v["name"], v["created"])
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        self._call("deletevol", {"volume": volume,
+                                 "force": "true" if force else "false"})
+
+    # -- metadata ----------------------------------------------------------
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("writemetadata", {"volume": volume, "path": path},
+                   json.dumps(fi_to_dict(fi)).encode())
+
+    def read_version(self, volume: str, path: str,
+                     version_id: str = "") -> FileInfo:
+        return fi_from_dict(self._call_json(
+            "readversion", {"volume": volume, "path": path,
+                            "version-id": version_id}))
+
+    def read_versions(self, volume: str, path: str) -> list[FileInfo]:
+        return [fi_from_dict(d) for d in self._call_json(
+            "readversions", {"volume": volume, "path": path}) or []]
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("deleteversion", {"volume": volume, "path": path},
+                   json.dumps(fi_to_dict(fi)).encode())
+
+    def rename_data(self, src_volume: str, src_path: str, data_dir: str,
+                    dst_volume: str, dst_path: str) -> None:
+        self._call("renamedata", {
+            "src-volume": src_volume, "src-path": src_path,
+            "data-dir": data_dir, "dst-volume": dst_volume,
+            "dst-path": dst_path})
+
+    # -- files -------------------------------------------------------------
+
+    def list_dir(self, volume: str, dir_path: str,
+                 count: int = -1) -> list[str]:
+        return self._call_json("listdir", {
+            "volume": volume, "dir-path": dir_path,
+            "count": str(count)}) or []
+
+    def read_file(self, volume: str, path: str, offset: int, length: int,
+                  verifier: Optional[BitrotVerifier] = None) -> bytes:
+        args = {"volume": volume, "path": path, "offset": str(offset),
+                "length": str(length)}
+        if verifier is not None:
+            args["verifier-algo"] = verifier.algorithm
+            args["verifier-hash"] = verifier.digest.hex()
+        return self._call("readfile", args)
+
+    def append_file(self, volume: str, path: str, buf: bytes) -> None:
+        self._call("appendfile", {"volume": volume, "path": path}, buf)
+
+    def create_file(self, volume: str, path: str, size: int,
+                    reader: BinaryIO) -> None:
+        data = reader.read() if size < 0 else reader.read(size)
+        self._call("createfile", {"volume": volume, "path": path,
+                                  "size": str(size)}, data or b"")
+
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> BinaryIO:
+        return io.BytesIO(self.read_file(volume, path, offset, length))
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        self._call("renamefile", {
+            "src-volume": src_volume, "src-path": src_path,
+            "dst-volume": dst_volume, "dst-path": dst_path})
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("checkparts", {"volume": volume, "path": path},
+                   json.dumps(fi_to_dict(fi)).encode())
+
+    def check_file(self, volume: str, path: str) -> None:
+        self._call("checkfile", {"volume": volume, "path": path})
+
+    def delete_file(self, volume: str, path: str,
+                    recursive: bool = False) -> None:
+        self._call("deletefile", {
+            "volume": volume, "path": path,
+            "recursive": "true" if recursive else "false"})
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("verifyfile", {"volume": volume, "path": path},
+                   json.dumps(fi_to_dict(fi)).encode())
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._call("writeall", {"volume": volume, "path": path}, data)
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        return self._call("readall", {"volume": volume, "path": path})
+
+    def walk(self, volume: str, dir_path: str = "", marker: str = "",
+             recursive: bool = True) -> Iterator[FileInfo]:
+        for d in self._call_json("walk", {
+                "volume": volume, "dir-path": dir_path, "marker": marker,
+                "recursive": "true" if recursive else "false"}) or []:
+            yield fi_from_dict(d)

@@ -1,0 +1,306 @@
+"""Internode RPC transport — thin authenticated HTTP-POST verbs.
+
+The reference's cmd/rest/client.go: each RPC is
+`POST /<service>/v1/<verb>?arg=...` with an opaque body stream and a
+JWT bearer derived from the cluster credentials. The client keeps a
+persistent connection pool, marks the host offline on network error and
+probes it back online in the background (cmd/rest/client.go:179-).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from typing import Callable, Optional
+
+DEFAULT_TIMEOUT = 30.0
+HEALTH_PROBE_INTERVAL = 1.0
+
+
+class RPCError(Exception):
+    """Error returned by the remote handler (payload survived)."""
+
+    def __init__(self, kind: str, message: str = ""):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.message = message
+
+
+class NetworkError(Exception):
+    """Transport-level failure — the peer may be down."""
+
+
+# ---------------------------------------------------------------------------
+# internode auth: HMAC token over (access_key, expiry) with the secret key
+# (the reference uses JWT with the same claims, cmd/jwt.go)
+# ---------------------------------------------------------------------------
+
+def make_token(access_key: str, secret_key: str,
+               ttl: float = 15 * 60) -> str:
+    expiry = int(time.time() + ttl)
+    msg = f"{access_key}:{expiry}"
+    mac = hmac.new(secret_key.encode(), msg.encode(),
+                   hashlib.sha256).hexdigest()
+    return base64.urlsafe_b64encode(
+        f"{msg}:{mac}".encode()).decode()
+
+
+def verify_token(token: str, access_key: str, secret_key: str) -> bool:
+    try:
+        decoded = base64.urlsafe_b64decode(token.encode()).decode()
+        ak, expiry, mac = decoded.rsplit(":", 2)
+    except (ValueError, UnicodeDecodeError):
+        return False
+    if ak != access_key:
+        return False
+    if int(expiry) < time.time():
+        return False
+    want = hmac.new(secret_key.encode(), f"{ak}:{expiry}".encode(),
+                    hashlib.sha256).hexdigest()
+    return hmac.compare_digest(want, mac)
+
+
+class RestClient:
+    """One peer endpoint. call() POSTs a verb; on connection failure the
+    host is marked offline and a background probe re-enables it."""
+
+    def __init__(self, host: str, port: int, service_path: str,
+                 access_key: str, secret_key: str,
+                 timeout: float = DEFAULT_TIMEOUT):
+        self.host, self.port = host, port
+        self.service_path = service_path.rstrip("/")
+        self.access_key, self.secret_key = access_key, secret_key
+        self.timeout = timeout
+        self._online = True
+        self._mu = threading.Lock()
+        self._prober: Optional[threading.Thread] = None
+
+    @property
+    def online(self) -> bool:
+        return self._online
+
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.service_path}"
+
+    def call(self, verb: str, args: Optional[dict] = None,
+             body: bytes = b"", stream_response: bool = False):
+        """POST the verb. Returns response bytes (or an HTTPResponse when
+        stream_response for large reads)."""
+        if not self._online:
+            raise NetworkError(f"{self.host}:{self.port} is offline")
+        qs = urllib.parse.urlencode(args or {})
+        path = f"{self.service_path}/{verb}" + (f"?{qs}" if qs else "")
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Authorization":
+                    "Bearer " + make_token(self.access_key,
+                                           self.secret_key),
+                "Content-Length": str(len(body)),
+            })
+            resp = conn.getresponse()
+            if resp.status != 200:
+                payload = resp.read()
+                conn.close()
+                try:
+                    err = json.loads(payload.decode())
+                    raise RPCError(err.get("kind", "error"),
+                                   err.get("message", ""))
+                except (ValueError, KeyError):
+                    raise RPCError("http", f"status {resp.status}")
+            if stream_response:
+                return _StreamedResponse(conn, resp)
+            data = resp.read()
+            conn.close()
+            return data
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            self.mark_offline()
+            raise NetworkError(str(e)) from e
+
+    def call_json(self, verb: str, args: Optional[dict] = None,
+                  payload=None):
+        body = json.dumps(payload).encode() if payload is not None else b""
+        out = self.call(verb, args, body)
+        return json.loads(out.decode()) if out else None
+
+    def mark_offline(self) -> None:
+        """Start the background health probe (reference MarkOffline,
+        cmd/rest/client.go:179)."""
+        with self._mu:
+            if not self._online:
+                return
+            self._online = False
+            self._prober = threading.Thread(target=self._probe_loop,
+                                            daemon=True)
+            self._prober.start()
+
+    def _probe_loop(self) -> None:
+        while not self._online:
+            time.sleep(HEALTH_PROBE_INTERVAL)
+            try:
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=2.0)
+                conn.request("GET", self.service_path + "/health")
+                resp = conn.getresponse()
+                resp.read()
+                conn.close()
+                if resp.status in (200, 404):
+                    self._online = True
+                    return
+            except (OSError, http.client.HTTPException):
+                continue
+
+    def close(self) -> None:
+        self._online = True  # stop any probe loop
+
+
+class _StreamedResponse:
+    def __init__(self, conn, resp):
+        self._conn = conn
+        self.resp = resp
+
+    def read(self, n: int = -1) -> bytes:
+        return self.resp.read(n)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+# ---------------------------------------------------------------------------
+# server side: verb table mounted under a path prefix on any HTTP server
+# ---------------------------------------------------------------------------
+
+class RPCHandler:
+    """Routes `POST <prefix>/<verb>` to registered python callables.
+
+    handler(args: dict[str, str], body: bytes) -> bytes | dict | None.
+    Raised exceptions are serialized as {"kind", "message"} with a 500.
+    Mount into the S3Server via register_router(prefix, self.route) or
+    serve standalone via serve().
+    """
+
+    def __init__(self, prefix: str, access_key: str, secret_key: str):
+        self.prefix = prefix.rstrip("/")
+        self.access_key, self.secret_key = access_key, secret_key
+        self._verbs: dict[str, Callable] = {}
+
+    def register(self, verb: str, fn: Callable) -> None:
+        self._verbs[verb] = fn
+
+    def route(self, ctx) -> "HTTPResponse":
+        from ..s3.handlers import HTTPResponse
+        path = ctx.req.path
+        verb = path[len(self.prefix):].lstrip("/")
+        if verb == "health":
+            return HTTPResponse(body=b"OK")
+        auth = ctx.header("authorization")
+        if not (auth.startswith("Bearer ") and verify_token(
+                auth[7:], self.access_key, self.secret_key)):
+            return HTTPResponse(status=403, body=json.dumps(
+                {"kind": "auth", "message": "invalid token"}).encode())
+        fn = self._verbs.get(verb)
+        if fn is None:
+            return HTTPResponse(status=404, body=json.dumps(
+                {"kind": "unknown-verb", "message": verb}).encode())
+        args = {k: v[0] for k, v in ctx.req.query.items()}
+        body = ctx.read_body()
+        try:
+            out = fn(args, body)
+        except Exception as e:  # noqa: BLE001 — serialize to the caller
+            return HTTPResponse(status=500, body=json.dumps(
+                {"kind": type(e).__name__, "message": str(e)}).encode())
+        if out is None:
+            return HTTPResponse(body=b"")
+        if isinstance(out, (bytes, bytearray)):
+            return HTTPResponse(body=bytes(out))
+        return HTTPResponse(body=json.dumps(out).encode(),
+                            headers={"Content-Type": "application/json"})
+
+
+class RPCServer:
+    """Standalone HTTP host for one or more RPCHandlers (a node's
+    internode port when no S3 frontend is wanted, e.g. tests or
+    storage-only processes)."""
+
+    def __init__(self, address: str = "127.0.0.1", port: int = 0):
+        import urllib.parse as _up
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        from ..s3 import signature as sig
+        from ..s3.handlers import RequestContext
+
+        handlers: list[tuple[str, RPCHandler]] = []
+        self._handlers = handlers
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _go(self):
+                import io as _io
+                parsed = _up.urlsplit(self.path)
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                req = sig.Request(
+                    method=self.command, path=parsed.path,
+                    query=_up.parse_qs(parsed.query,
+                                       keep_blank_values=True),
+                    headers=headers, raw_query=parsed.query)
+                length = int(headers.get("content-length", 0) or 0)
+                # read the body eagerly: keeps the keep-alive socket clean
+                # no matter what the handler does with it
+                raw = self.rfile.read(length) if length else b""
+                ctx = RequestContext(req, _io.BytesIO(raw), length)
+                resp = None
+                for prefix, h in handlers:
+                    if parsed.path.startswith(prefix):
+                        resp = h.route(ctx)
+                        break
+                if resp is None:
+                    from ..s3.handlers import HTTPResponse
+                    resp = HTTPResponse(status=404, body=b"not found")
+                body = resp.body
+                self.send_response(resp.status)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            do_GET = do_POST = _go
+
+        self._httpd = ThreadingHTTPServer((address, port), _H)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def mount(self, handler: RPCHandler) -> None:
+        self._handlers.append((handler.prefix, handler))
+
+    def mount_route(self, prefix: str, handler: RPCHandler) -> None:
+        self._handlers.append((prefix, handler))
+
+    def start(self) -> "RPCServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

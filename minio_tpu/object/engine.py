@@ -382,6 +382,38 @@ class ErasureObjects:
         except api_errors.ObjectApiError:
             return False
 
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               metadata: dict, version_id: str = ""
+                               ) -> ObjectInfo:
+        """Metadata-only update of an existing version in place (tags,
+        user metadata) — no data rewrite, no new version (reference
+        updates xl.meta via WriteMetadata on the same version id)."""
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            fi, metas, online = self._object_file_info(
+                bucket, object_name, version_id)
+            if fi.deleted:
+                raise api_errors.MethodNotAllowed(
+                    f"{bucket}/{object_name} is a delete marker")
+            new_meta = dict(metadata)
+            new_meta["etag"] = fi.metadata.get("etag", "")
+
+            def upd(i, d):
+                m = metas[i]
+                if m is None:
+                    raise serr.FileNotFound(object_name)
+                m.metadata = dict(new_meta)
+                d.write_metadata(bucket, object_name, m)
+
+            _, errs = meta.for_each_disk(online, upd)
+            _, write_quorum = meta.object_quorum_from_meta(
+                metas, [None] * len(metas), self.parity_shards)
+            err = meta.reduce_write_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+            if err is not None:
+                raise api_errors.to_object_err(err, bucket, object_name)
+            fi.metadata = new_meta
+        return fi.to_object_info(bucket, object_name)
+
     def get_object_info(self, bucket: str, object_name: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
         opts = opts or GetOptions()

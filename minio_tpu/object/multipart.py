@@ -95,8 +95,11 @@ class MultipartMixin(ErasureObjects):
         fi.data_dir = str(_uuid.uuid4())
         fi.mod_time = now()
         fi.metadata = dict(opts.metadata)
-        # the sha-dir layout loses the object name; keep it in the session
-        # metadata so bucket-wide upload listings can report real keys
+        # the sha-dir layout loses bucket + object name; keep them in the
+        # session metadata so bucket-wide upload listings can report real
+        # keys and never leak another bucket's uploads (the multipart
+        # meta volume is shared by ALL buckets)
+        fi.metadata["x-minio-internal-bucket"] = bucket
         fi.metadata["x-minio-internal-object-name"] = object_name
         if opts.versioned:
             fi.metadata["x-minio-internal-versioned"] = "true"
@@ -226,6 +229,9 @@ class MultipartMixin(ErasureObjects):
                                 MINIO_META_MULTIPART_BUCKET, path)
                         except serr.StorageError:
                             continue
+                        if fi.metadata.get("x-minio-internal-bucket",
+                                           bucket) != bucket:
+                            continue  # shared volume holds ALL buckets
                         out.append({
                             "object": fi.metadata.get(
                                 "x-minio-internal-object-name",

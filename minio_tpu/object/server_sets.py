@@ -172,6 +172,13 @@ class ErasureServerSets:
                                     deep_scan, dry_run),
             bucket, object_name)
 
+    def update_object_metadata(self, bucket, object_name, metadata,
+                               version_id=""):
+        return self._first_zone_with(
+            lambda z: z.update_object_metadata(bucket, object_name,
+                                               metadata, version_id),
+            bucket, object_name)
+
     # ------------------------------------------------------------------
     # multipart: session created in the chosen PUT zone; subsequent calls
     # find the zone owning the uploadID

@@ -264,6 +264,11 @@ class ErasureSets:
         return self.get_hashed_set(object_name).heal_object(
             bucket, object_name, version_id, deep_scan, dry_run)
 
+    def update_object_metadata(self, bucket, object_name, metadata,
+                               version_id=""):
+        return self.get_hashed_set(object_name).update_object_metadata(
+            bucket, object_name, metadata, version_id)
+
     def has_object_versions(self, bucket, object_name) -> bool:
         return self.get_hashed_set(object_name).has_object_versions(
             bucket, object_name)

@@ -613,10 +613,14 @@ class S3ApiHandlers:
         enc = ctx.query1("encoding-type")
         max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
         versions = self.obj.list_object_versions(bucket, prefix,
-                                                 key_marker, max_keys)
+                                                 key_marker, max_keys + 1)
+        trunc = len(versions) > max_keys
+        versions = versions[:max_keys]
+        nkm = versions[-1].name if trunc and versions else ""
+        nvm = versions[-1].version_id if trunc and versions else ""
         return HTTPResponse().with_xml(xmlgen.list_versions_response(
             bucket, prefix, key_marker, vid_marker, delimiter, max_keys,
-            enc, versions, [], False))
+            enc, versions, [], trunc, nkm, nvm))
 
     def delete_multiple_objects(self, ctx, bucket) -> HTTPResponse:
         self.authenticate(ctx, "s3:DeleteObject", bucket)
@@ -647,6 +651,8 @@ class S3ApiHandlers:
         versioned = self.bucket_meta.versioning_enabled(bucket)
         deleted, errors = [], []
         for key, vid in keys:
+            if vid == "null":
+                vid = ""  # same normalization as single DELETE
             try:
                 res = self.obj.delete_object(bucket, key, version_id=vid,
                                              versioned=versioned)
@@ -676,10 +682,14 @@ class S3ApiHandlers:
         if prefix:
             uploads = [u for u in uploads
                        if u["object"].startswith(prefix)]
+        trunc = len(uploads) > max_uploads
+        uploads = uploads[:max_uploads]
+        nkm = uploads[-1]["object"] if trunc and uploads else ""
+        num = uploads[-1]["upload_id"] if trunc and uploads else ""
         return HTTPResponse().with_xml(
             xmlgen.list_multipart_uploads_response(
-                bucket, "", "", prefix, "", max_uploads, False,
-                uploads[:max_uploads]))
+                bucket, "", "", prefix, "", max_uploads, trunc, uploads,
+                nkm, num))
 
     # ------------------------------------------------------------------
     # object handlers
@@ -973,7 +983,11 @@ class S3ApiHandlers:
             for sub in child:
                 st = sub.tag.split("}")[-1]
                 if st == "PartNumber":
-                    num = int(sub.text or "0")
+                    try:
+                        num = int(sub.text or "0")
+                    except ValueError:
+                        raise S3Error("MalformedXML",
+                                      "PartNumber must be an int")
                 elif st == "ETag":
                     etag = (sub.text or "").strip('"')
             if num is None or etag is None:
@@ -1037,25 +1051,23 @@ class S3ApiHandlers:
         self._rewrite_metadata(bucket, key, {"X-Amz-Tagging": None})
         return HTTPResponse(status=204)
 
-    def _rewrite_metadata(self, bucket, key, updates: dict) -> None:
-        """Metadata-only rewrite via self-copy (no dedicated metadata-op
-        verb on the layer yet)."""
-        info = self.obj.get_object_info(bucket, key)
+    def _rewrite_metadata(self, bucket, key, updates: dict,
+                          version_id: str = "") -> None:
+        """Metadata-only update in place — no data rewrite, no new
+        version (tags on a versioned bucket must not grow the stack)."""
+        info = self.obj.get_object_info(bucket, key,
+                                        GetOptions(version_id=version_id))
         md = dict(info.user_defined)
         md["content-type"] = info.content_type
+        if info.content_encoding:
+            md["content-encoding"] = info.content_encoding
         for k, v in updates.items():
             if v is None:
                 md.pop(k, None)
             else:
                 md[k] = v
-        md["etag"] = info.etag
-        # drain first: the GET stream holds the object's read lock until
-        # exhausted, and the PUT below needs the write lock
-        _, stream = self.obj.get_object(bucket, key, 0, info.size)
-        data = b"".join(stream)
-        self.obj.put_object(bucket, key,
-                            HashReader(io.BytesIO(data), len(data)),
-                            len(data), PutOptions(metadata=md))
+        self.obj.update_object_metadata(bucket, key, md,
+                                        version_id or info.version_id)
 
     # ------------------------------------------------------------------
     # misc

@@ -97,6 +97,32 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                 pass
 
         def _dispatch(self) -> None:
+            # chunked request bodies have no Content-Length: without
+            # decoding them we can't find the next request's boundary,
+            # so reject and close (prevents request smuggling)
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                self.close_connection = True
+                body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                        b"<Error><Code>NotImplemented</Code><Message>"
+                        b"Transfer-Encoding: chunked is not supported"
+                        b"</Message></Error>")
+                self.send_response(501)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            try:
+                int(self.headers.get("Content-Length", 0) or 0)
+            except ValueError:
+                self.close_connection = True
+                self.send_response(400)
+                self.send_header("Content-Length", "0")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                return
             # admin/health/metrics routers get first crack at the path
             ctx = self._snapshot()
             try:

@@ -164,7 +164,9 @@ def list_versions_response(bucket: str, prefix: str, key_marker: str,
                            max_keys: int, encoding_type: str,
                            versions: list[ObjectInfo],
                            prefixes: list[str],
-                           is_truncated: bool) -> bytes:
+                           is_truncated: bool,
+                           next_key_marker: str = "",
+                           next_version_marker: str = "") -> bytes:
     x = X()
     x.open("ListVersionsResult", xmlns=S3_XMLNS)
     x.elem("Name", bucket)
@@ -175,6 +177,10 @@ def list_versions_response(bucket: str, prefix: str, key_marker: str,
     if delimiter:
         x.elem("Delimiter", _maybe_encode(delimiter, encoding_type))
     x.elem("IsTruncated", "true" if is_truncated else "false")
+    if is_truncated and next_key_marker:
+        x.elem("NextKeyMarker",
+               _maybe_encode(next_key_marker, encoding_type))
+        x.elem("NextVersionIdMarker", next_version_marker or "null")
     for o in versions:
         tag = "DeleteMarker" if o.delete_marker else "Version"
         x.open(tag)
@@ -253,12 +259,18 @@ def list_parts_response(bucket: str, key: str, upload_id: str,
 def list_multipart_uploads_response(bucket: str, key_marker: str,
                                     upload_id_marker: str, prefix: str,
                                     delimiter: str, max_uploads: int,
-                                    is_truncated: bool, uploads) -> bytes:
+                                    is_truncated: bool, uploads,
+                                    next_key_marker: str = "",
+                                    next_upload_id_marker: str = ""
+                                    ) -> bytes:
     x = X()
     x.open("ListMultipartUploadsResult", xmlns=S3_XMLNS)
     x.elem("Bucket", bucket)
     x.elem("KeyMarker", key_marker)
     x.elem("UploadIdMarker", upload_id_marker)
+    if is_truncated and next_key_marker:
+        x.elem("NextKeyMarker", next_key_marker)
+        x.elem("NextUploadIdMarker", next_upload_id_marker)
     x.elem("Prefix", prefix)
     if delimiter:
         x.elem("Delimiter", delimiter)

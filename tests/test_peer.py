@@ -1,0 +1,101 @@
+"""Peer control plane + bootstrap verify tests."""
+
+from __future__ import annotations
+
+import pytest
+
+from minio_tpu.distributed.local_locker import LocalLocker
+from minio_tpu.distributed.peer_rpc import (BootstrapRPCServer,
+                                            NotificationSys,
+                                            PeerRPCClient, PeerRPCServer,
+                                            system_config_hash,
+                                            verify_server_system_config)
+from minio_tpu.distributed.transport import RPCServer
+
+AK, SK = "peerak", "peersecret12345"
+
+
+@pytest.fixture()
+def mesh():
+    """3 peer nodes with injected hooks."""
+    hosts, servers, clients = [], [], []
+    reloaded = []
+    for i in range(3):
+        srv = PeerRPCServer(AK, SK, node_id=f"node{i}")
+        srv.get_server_info = lambda i=i: {"drives": 4, "idx": i}
+        lk = LocalLocker()
+        lk.lock(f"uid{i}", [f"res{i}"], "o")
+        srv.get_locks = lk.dump
+        srv.reload_bucket_metadata = \
+            lambda b, i=i: reloaded.append((i, b))
+        host = RPCServer().start()
+        host.mount(srv.handler)
+        hosts.append(host)
+        servers.append(srv)
+        clients.append(PeerRPCClient("127.0.0.1", host.port, AK, SK))
+    yield servers, clients, reloaded
+    for c in clients:
+        c.close()
+    for h in hosts:
+        h.stop()
+
+
+def test_server_info_broadcast(mesh):
+    _, clients, _ = mesh
+    ns = NotificationSys(clients)
+    infos = ns.server_info_all()
+    assert len(infos) == 3
+    assert {i["node"] for i in infos} == {"node0", "node1", "node2"}
+    assert all(i["drives"] == 4 for i in infos)
+
+
+def test_top_locks_merges_all_nodes(mesh):
+    _, clients, _ = mesh
+    ns = NotificationSys(clients)
+    locks = ns.top_locks()
+    assert set(locks) == {"res0", "res1", "res2"}
+
+
+def test_reload_bucket_metadata_fanout(mesh):
+    _, clients, reloaded = mesh
+    ns = NotificationSys(clients)
+    oks = ns.reload_bucket_metadata("mybucket")
+    assert all(oks)
+    assert sorted(reloaded) == [(0, "mybucket"), (1, "mybucket"),
+                                (2, "mybucket")]
+
+
+def test_dead_peer_tolerated(mesh):
+    _, clients, _ = mesh
+    dead = PeerRPCClient("127.0.0.1", 1, AK, SK, timeout=0.5)
+    ns = NotificationSys(clients + [dead])
+    infos = ns.server_info_all()
+    assert infos[-1] is None
+    assert sum(1 for i in infos if i) == 3
+    dead.close()
+
+
+def test_bootstrap_verify_matches():
+    eps = ["node0:9000/d1", "node1:9000/d1"]
+    host = RPCServer().start()
+    host.mount(BootstrapRPCServer(AK, SK, eps).handler)
+    verify_server_system_config([("127.0.0.1", host.port)], eps, AK, SK,
+                                retries=3, interval=0.1)
+    host.stop()
+
+
+def test_bootstrap_verify_mismatch_raises():
+    host = RPCServer().start()
+    host.mount(BootstrapRPCServer(AK, SK, ["node0:9000/other"]).handler)
+    with pytest.raises(RuntimeError, match="different cluster config"):
+        verify_server_system_config(
+            [("127.0.0.1", host.port)], ["node0:9000/d1"], AK, SK,
+            retries=3, interval=0.1)
+    host.stop()
+
+
+def test_config_hash_stability():
+    a = system_config_hash(["b", "a"], "k", "s")
+    b = system_config_hash(["a", "b"], "k", "s")
+    assert a == b
+    assert a != system_config_hash(["a", "b"], "k", "other")
