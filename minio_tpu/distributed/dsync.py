@@ -19,6 +19,7 @@ from typing import Optional, Protocol
 
 ACQUIRE_TIMEOUT = 1.0          # per-broadcast collect window
 RETRY_INTERVAL_MAX = 0.25      # jittered sleep between attempts
+REFRESH_INTERVAL = 30.0        # LOCK_VALIDITY / 4: keep long holds alive
 
 
 class NetLocker(Protocol):
@@ -31,6 +32,7 @@ class NetLocker(Protocol):
               source: str) -> bool: ...
     def unlock(self, uid: str, resources: list[str]) -> bool: ...
     def runlock(self, uid: str, resources: list[str]) -> bool: ...
+    def refresh(self, uid: str, resources: list[str]) -> bool: ...
 
 
 def quorum_for(n: int, write: bool) -> int:
@@ -51,6 +53,8 @@ class DRWMutex:
         self.owner = owner
         self._uid = ""
         self._write = False
+        self._refresh_stop: Optional[threading.Event] = None
+        self.lock_lost = False   # set by the refresh loop on quorum loss
 
     # -- public API (DRWMutex.GetLock / GetRLock / Unlock / RUnlock) -------
 
@@ -61,6 +65,9 @@ class DRWMutex:
         return self._lock_blocking(False, timeout, source)
 
     def unlock(self) -> None:
+        if self._refresh_stop is not None:
+            self._refresh_stop.set()
+            self._refresh_stop = None
         self._release_all(self._uid, self._write)
         self._uid = ""
 
@@ -71,20 +78,54 @@ class DRWMutex:
     def _lock_blocking(self, write: bool, timeout: float,
                        source: str) -> bool:
         deadline = time.monotonic() + timeout
-        uid = str(uuid.uuid4())
         while True:
+            # fresh uid per attempt: a straggler grant from a failed
+            # attempt must never alias a later attempt's grant on the
+            # same locker (its rollback would release both)
+            uid = str(uuid.uuid4())
             if self._try_once(uid, write, source):
                 self._uid, self._write = uid, write
+                self._start_refresh(uid)
                 return True
             if time.monotonic() >= deadline:
                 return False
             time.sleep(random.random() * RETRY_INTERVAL_MAX)
 
+    def _start_refresh(self, uid: str) -> None:
+        """Keep the held lock alive on every locker: a grant not refreshed
+        within LOCK_VALIDITY is swept by the lockers' maintenance loop.
+        When a quorum of lockers no longer knows the grant (force-unlock,
+        partition-long sweep), stop refreshing and flag the lock as lost
+        so the holder can abort its critical section (the reference's
+        startContinousLockRefresh cancels the op context on quorum
+        loss)."""
+        stop = threading.Event()
+        self._refresh_stop = stop
+        self.lock_lost = False
+
+        def run() -> None:
+            n = len(self.lockers)
+            while not stop.wait(REFRESH_INTERVAL):
+                alive = 0
+                for lk in self.lockers:
+                    if lk is None:
+                        continue
+                    try:
+                        if lk.refresh(uid, self.resources):
+                            alive += 1
+                    except Exception:  # noqa: BLE001 — dead locker: no vote
+                        pass
+                if alive < quorum_for(n, self._write):
+                    self.lock_lost = True
+                    return
+
+        threading.Thread(target=run, daemon=True).start()
+
     def _try_once(self, uid: str, write: bool, source: str) -> bool:
         n = len(self.lockers)
         need = quorum_for(n, write)
         granted: list[Optional[bool]] = [None] * n
-        done = threading.Event()
+        aborted = threading.Event()
         pending = threading.Semaphore(0)
 
         def ask(i: int, lk: NetLocker) -> None:
@@ -97,6 +138,18 @@ class DRWMutex:
                 ok = False
             granted[i] = ok
             pending.release()
+            # Straggler grant after the attempt already failed: the main
+            # thread's rollback may have run before this grant landed, so
+            # undo it here — otherwise it orphans the resource for up to
+            # LOCK_VALIDITY.
+            if ok and aborted.is_set():
+                try:
+                    if write:
+                        lk.unlock(uid, self.resources)
+                    else:
+                        lk.runlock(uid, self.resources)
+                except Exception:  # noqa: BLE001 — expiry sweep will reap it
+                    pass
 
         live = 0
         for i, lk in enumerate(self.lockers):
@@ -124,10 +177,10 @@ class DRWMutex:
                 break
 
         if sum(1 for g in granted if g) >= need:
-            done.set()
             return True
-        # sub-quorum: roll back whatever was granted (and whatever may
-        # still be granted after the window — unlock is idempotent)
+        # sub-quorum: roll back whatever was granted; in-flight grant
+        # threads see `aborted` and undo their own late grants
+        aborted.set()
         self._release_all(uid, write)
         return False
 

@@ -55,11 +55,10 @@ def verify_token(token: str, access_key: str, secret_key: str) -> bool:
     try:
         decoded = base64.urlsafe_b64decode(token.encode()).decode()
         ak, expiry, mac = decoded.rsplit(":", 2)
+        expired = int(expiry) < time.time()
     except (ValueError, UnicodeDecodeError):
         return False
-    if ak != access_key:
-        return False
-    if int(expiry) < time.time():
+    if ak != access_key or expired:
         return False
     want = hmac.new(secret_key.encode(), f"{ak}:{expiry}".encode(),
                     hashlib.sha256).hexdigest()
@@ -111,10 +110,12 @@ class RestClient:
                 conn.close()
                 try:
                     err = json.loads(payload.decode())
+                except ValueError:
+                    err = None
+                if isinstance(err, dict):
                     raise RPCError(err.get("kind", "error"),
                                    err.get("message", ""))
-                except (ValueError, KeyError):
-                    raise RPCError("http", f"status {resp.status}")
+                raise RPCError("http", f"status {resp.status}")
             if stream_response:
                 return _StreamedResponse(conn, resp)
             data = resp.read()

@@ -75,6 +75,10 @@ class BucketMetadataSys:
         self.obj = object_layer
         self._cache: dict[str, BucketMetadata] = {}
         self._mu = threading.Lock()
+        # Cluster hook: called with the bucket name after every persisted
+        # change so peers drop their caches (the reference broadcasts
+        # LoadBucketMetadata via NotificationSys after each update).
+        self.on_change = None
 
     def _meta_path(self, bucket: str) -> str:
         return f"buckets/{bucket}/{BUCKET_METADATA_FILE}"
@@ -103,6 +107,14 @@ class BucketMetadataSys:
                             bm.to_bytes())
         with self._mu:
             self._cache[bucket] = bm
+        self._notify(bucket)
+
+    def _notify(self, bucket: str) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change(bucket)
+            except Exception:  # noqa: BLE001 — peers reload lazily anyway
+                pass
 
     def update(self, bucket: str, **fields) -> BucketMetadata:
         bm = self.get(bucket)
@@ -121,6 +133,7 @@ class BucketMetadataSys:
             pass
         with self._mu:
             self._cache.pop(bucket, None)
+        self._notify(bucket)
 
     def reload(self, bucket: str) -> None:
         """Drop the cache entry (peer-notified metadata change)."""

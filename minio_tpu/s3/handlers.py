@@ -62,6 +62,9 @@ class RequestContext:
         self.content_length = content_length
         self.cred: Optional[Credentials] = None
         self.auth_type = sig.get_request_auth_type(req)
+        # hex digest the client signed over (x-amz-content-sha256);
+        # enforced when the body is consumed (isReqAuthenticated analog)
+        self.expect_body_sha = ""
 
     def query1(self, name: str, default: str = "") -> str:
         v = self.req.query.get(name)
@@ -75,8 +78,14 @@ class RequestContext:
 
     def read_body(self) -> bytes:
         if self.content_length <= 0:
-            return b""
-        return self.body_stream.read(self.content_length)
+            data = b""
+        else:
+            data = self.body_stream.read(self.content_length)
+        if self.expect_body_sha:
+            if hashlib.sha256(data).hexdigest() != self.expect_body_sha:
+                raise S3Error("XAmzContentSHA256Mismatch")
+            self.expect_body_sha = ""
+        return data
 
 
 def _http_date(t: float) -> str:
@@ -173,6 +182,11 @@ class S3ApiHandlers:
                                   sig.UNSIGNED_PAYLOAD)
             ctx.cred = sig.verify_v4(ctx.req, self._cred_lookup,
                                      self.region, body_sha)
+            # a signed hex digest must match the actual body; object PUT
+            # verifies via HashReader, every other consumer via read_body
+            if len(body_sha) == 64 and all(
+                    c in "0123456789abcdef" for c in body_sha):
+                ctx.expect_body_sha = body_sha
         elif at == sig.AUTH_STREAMING_SIGNED:
             ctx.cred = sig.verify_v4(ctx.req, self._cred_lookup,
                                      self.region,

@@ -58,6 +58,9 @@ ERROR_TABLE: dict[str, tuple[int, str]] = {
                             "that is not implemented"),
     "PreconditionFailed": (412, "At least one of the pre-conditions you "
                                 "specified did not hold"),
+    "XAmzContentSHA256Mismatch": (400, "The provided 'x-amz-content-sha256' "
+                                       "header does not match what was "
+                                       "computed."),
     "RequestTimeTooSkewed": (403, "The difference between the request time "
                                   "and the server's time is too large."),
     "SignatureDoesNotMatch": (403, "The request signature we calculated "

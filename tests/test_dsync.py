@@ -183,3 +183,22 @@ def test_dist_nslock_engine_interface(lock_cluster):
     lk = ns.new_lock("bucket/key")
     assert lk.get_lock(timeout=3.0)
     lk.unlock()
+
+
+def test_refresh_keeps_long_hold_alive(monkeypatch):
+    """A lock held past the validity window survives the lockers' expiry
+    sweep because the holder refreshes it (ADVICE r1: without refresh,
+    any write lock held >LOCK_VALIDITY silently expired)."""
+    from minio_tpu.distributed import dsync as dsync_mod
+    monkeypatch.setattr(dsync_mod, "REFRESH_INTERVAL", 0.05)
+    lockers = [LocalLocker() for _ in range(3)]
+    dm = DRWMutex(lockers, ["bucket/long-op"])
+    assert dm.get_lock(timeout=2.0)
+    time.sleep(0.3)
+    for lk in lockers:
+        lk.expire_old_locks(validity=0.15)  # reaps only un-refreshed grants
+    dm2 = DRWMutex(lockers, ["bucket/long-op"])
+    assert not dm2.get_lock(timeout=0.3), "lock was lost while held"
+    dm.unlock()
+    assert dm2.get_lock(timeout=2.0)
+    dm2.unlock()

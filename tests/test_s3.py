@@ -565,3 +565,23 @@ def test_delete_multiple_on_missing_bucket(client):
                                      query={"delete": ""}, body=doc)
     assert status == 404
     assert b"NoSuchBucket" in body
+
+
+def test_signed_body_sha_mismatch_rejected(client, bucket):
+    """A signed request whose body doesn't match the signed
+    x-amz-content-sha256 must be rejected (isReqAuthenticated analog)."""
+    policy = (b'{"Version":"2012-10-17","Statement":[{"Effect":"Allow",'
+              b'"Principal":{"AWS":["*"]},"Action":["s3:GetObject"],'
+              b'"Resource":["arn:aws:s3:::testbucket/*"]}]}')
+    # sign over DIFFERENT bytes than we send
+    wrong_hash = hashlib.sha256(b"something else entirely").hexdigest()
+    hdrs = {"host": f"{client.host}:{client.port}"}
+    hdrs = sig.sign_v4("PUT", f"/{bucket}", {"policy": [""]}, hdrs,
+                       wrong_hash, client.creds, REGION)
+    conn = http.client.HTTPConnection(client.host, client.port, timeout=60)
+    conn.request("PUT", f"/{bucket}?policy=", body=policy, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    assert resp.status == 400
+    assert b"XAmzContentSHA256Mismatch" in data
