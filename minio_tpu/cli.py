@@ -1,0 +1,105 @@
+"""CLI — `python -m minio_tpu server ...` process bootstrap.
+
+The reference's L0 (main.go + cmd/server-main.go): parse args, boot the
+node, print the startup banner, block on signals.
+
+Single node:
+    python -m minio_tpu server /data/d{1...16} --address :9000
+
+Distributed (run once per node, same node list everywhere):
+    python -m minio_tpu server \
+        --node 10.0.0.1:9000=/data/d{1...8} \
+        --node 10.0.0.2:9000=/data/d{1...8} \
+        --this 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+from .cluster import NodeSpec, parse_node_arg, start_node, start_single
+from .s3.credentials import Credentials, global_credentials
+
+
+def _parse(argv: list[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="minio_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("server", help="start an object-store node")
+    s.add_argument("drives", nargs="*",
+                   help="local drive paths (ellipses {1...N} supported)")
+    s.add_argument("--address", default=":9000",
+                   help="listen address host:port (default :9000)")
+    s.add_argument("--node", action="append", default=[],
+                   help="host:port=/drive{1...N} — one per cluster node")
+    s.add_argument("--this", type=int, default=-1,
+                   help="index of this node in the --node list")
+    s.add_argument("--parity", type=int, default=None,
+                   help="parity shards per set (default N/2)")
+    s.add_argument("--set-drive-count", type=int, default=0,
+                   help="drives per erasure set (default: auto 4..16)")
+    s.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
+    return p.parse_args(argv)
+
+
+def _creds() -> Credentials:
+    ak = os.environ.get("MINIO_ACCESS_KEY") or \
+        os.environ.get("MINIO_ROOT_USER")
+    sk = os.environ.get("MINIO_SECRET_KEY") or \
+        os.environ.get("MINIO_ROOT_PASSWORD")
+    if ak and sk:
+        return Credentials(access_key=ak, secret_key=sk)
+    return global_credentials()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    creds = _creds()
+    kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
+              region=args.region)
+
+    if args.node:
+        if args.this < 0 or args.this >= len(args.node):
+            print("--this must index the --node list", file=sys.stderr)
+            return 2
+        nodes = [parse_node_arg(n) for n in args.node]
+        node = start_node(nodes, args.this, creds, **kw)
+    else:
+        if not args.drives:
+            print("no drives given", file=sys.stderr)
+            return 2
+        host, sep, port = args.address.rpartition(":")
+        if not sep:
+            host, port = args.address, ""
+        try:
+            port_n = int(port) if port else 9000
+        except ValueError:
+            print(f"bad --address {args.address!r}: port must be a "
+                  "number (host:port)", file=sys.stderr)
+            return 2
+        node = start_single(args.drives, host or "0.0.0.0", port_n,
+                            creds, **kw)
+
+    info = node.object_layer.storage_info()
+    print(f"MinIO-TPU node {node.spec.addr} up: "
+          f"{node.set_count} set(s) x {node.set_drive_count} drives, "
+          f"EC:{node.parity}; {info['online_disks']} online / "
+          f"{info['offline_disks']} offline drives")
+    print(f"S3 endpoint: {node.url}  (access key {creds.access_key})")
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

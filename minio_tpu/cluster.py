@@ -1,0 +1,247 @@
+"""Cluster assembly — boot a node into a runnable (multi-)node system.
+
+The reference's serverMain (cmd/server-main.go:371-533): parse endpoints,
+mount the internode RPC routers (storage/lock/peer/bootstrap) on the same
+HTTP server that serves S3, verify cluster config against peers, assemble
+the ObjectLayer from local + remote drives (waitForFormatErasure), swap
+the namespace lock for dsync when distributed, and start the S3 API.
+
+A node's own drives are local XLStorage objects (also exported over
+storage RPC for peers); every other node's drives are RemoteStorage
+clients. The drive order is the endpoint order, identical on every node,
+so each drive occupies the same erasure-set slot cluster-wide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from .distributed.local_locker import LocalLocker
+from .distributed.lock_rpc import LockRPCClient, LockRPCServer
+from .distributed.peer_rpc import (BootstrapRPCServer, NotificationSys,
+                                   PeerRPCClient, PeerRPCServer,
+                                   verify_server_system_config)
+from .distributed.storage_rpc import RemoteStorage, StorageRPCServer
+from .distributed.dsync import DistNSLockMap
+from .object.nslock import NSLockMap
+from .object.sets import ErasureSets
+from .object.server_sets import ErasureServerSets
+from .s3.credentials import Credentials
+from .s3.server import S3Server
+from .storage import errors as serr
+from .storage.xl_storage import XLStorage
+from .utils import ellipses
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    """One node: where it listens and which drive paths it owns."""
+    host: str
+    port: int
+    drives: list[str]
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+def parse_node_arg(arg: str) -> NodeSpec:
+    """"host:port=/d{1...4}" or "host:port=/a,/b" -> NodeSpec."""
+    addr, _, paths = arg.partition("=")
+    if not paths:
+        raise ValueError(f"node arg needs host:port=drives, got {arg!r}")
+    host, _, port = addr.rpartition(":")
+    drives = []
+    for p in paths.split(","):
+        drives.extend(ellipses.expand_arg(p))
+    return NodeSpec(host or "127.0.0.1", int(port), drives)
+
+
+class ClusterNode:
+    """One running node: S3 endpoint + internode RPC + object layer."""
+
+    def __init__(self, nodes: list[NodeSpec], this: int,
+                 creds: Credentials, parity: Optional[int] = None,
+                 set_drive_count: int = 0, block_size: int = 1 << 22,
+                 region: str = "us-east-1", iam=None,
+                 bootstrap_timeout: float = 30.0,
+                 format_timeout: float = 30.0):
+        self.nodes = nodes
+        self.this = this
+        self.creds = creds
+        self.spec = nodes[this]
+        self.distributed = len(nodes) > 1
+
+        all_drives = [(ni, path) for ni, n in enumerate(nodes)
+                      for path in n.drives]
+        total = len(all_drives)
+        node_counts = [len(n.drives) for n in nodes]
+        if set_drive_count:
+            if total % set_drive_count:
+                raise ValueError("drives not divisible into sets")
+            set_count = total // set_drive_count
+        else:
+            set_count, set_drive_count = ellipses.divide_into_sets(
+                total, node_counts)
+        if parity is None:
+            parity = set_drive_count // 2   # reference default EC:N/2
+        self.set_count, self.set_drive_count = set_count, set_drive_count
+        self.parity = parity
+
+        # -- local drives + RPC servers on this node's listener ------------
+        self.local_drives: dict[str, XLStorage] = {}
+        for path in self.spec.drives:
+            try:
+                self.local_drives[path] = XLStorage(path)
+            except serr.StorageError:
+                pass
+        self.locker = LocalLocker()
+        ak, sk = creds.access_key, creds.secret_key
+        self._storage_rpc = StorageRPCServer(self.local_drives, ak, sk)
+        self._lock_rpc = LockRPCServer(self.locker, ak, sk)
+        self._peer_rpc = PeerRPCServer(ak, sk, node_id=self.spec.addr)
+        endpoints = [f"{n.addr}{p}" for n in nodes for p in n.drives]
+        self._bootstrap_rpc = BootstrapRPCServer(ak, sk, endpoints)
+
+        # the S3 server carries every router (reference configureServerHandler)
+        self.s3: Optional[S3Server] = None
+        self.sets = None
+        self._remote_clients: list[RemoteStorage] = []
+        self._lock_clients: list[LockRPCClient] = []
+        self._start_server(region, iam)
+        try:
+            self._finish_boot(nodes, this, all_drives, endpoints, ak, sk,
+                              set_count, set_drive_count, parity,
+                              block_size, bootstrap_timeout,
+                              format_timeout)
+        except BaseException:
+            # a failed boot must not leak the already-listening server /
+            # RPC clients into the process (shutdown is idempotent and
+            # tolerant of the partially-built state)
+            self.shutdown()
+            raise
+
+    def _finish_boot(self, nodes, this, all_drives, endpoints, ak, sk,
+                     set_count, set_drive_count, parity, block_size,
+                     bootstrap_timeout, format_timeout) -> None:
+        # -- bootstrap verify against peers --------------------------------
+        peers = [(n.host, n.port) for i, n in enumerate(nodes)
+                 if i != this]
+        if peers:
+            verify_server_system_config(
+                peers, endpoints, ak, sk,
+                retries=max(int(bootstrap_timeout), 1))
+
+        # -- assemble the drive list in global endpoint order --------------
+        drives: list = []
+        for ni, path in all_drives:
+            if ni == this:
+                drives.append(self.local_drives.get(path))
+            else:
+                rc = RemoteStorage(nodes[ni].host, nodes[ni].port, path,
+                                   ak, sk)
+                self._remote_clients.append(rc)
+                drives.append(rc)
+
+        # -- namespace lock: dsync across every node when distributed ------
+        if self.distributed:
+            lockers: list = []
+            for i, n in enumerate(nodes):
+                if i == this:
+                    lockers.append(self.locker)
+                else:
+                    lc = LockRPCClient(n.host, n.port, ak, sk)
+                    self._lock_clients.append(lc)
+                    lockers.append(lc)
+            ns_lock = DistNSLockMap(lockers, owner=self.spec.addr)
+        else:
+            ns_lock = NSLockMap()
+
+        # -- format bootstrap (waitForFormatErasure) -----------------------
+        deadline = time.monotonic() + format_timeout
+        while True:
+            try:
+                sets = ErasureSets.from_storage(
+                    drives, set_count, set_drive_count, parity,
+                    block_size=block_size, ns_lock=ns_lock,
+                    create_format=(this == 0))
+                break
+            except serr.StorageError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        self.sets = sets
+        self.object_layer = ErasureServerSets([sets])
+        self.s3.api.set_object_layer(self.object_layer)
+
+        # -- peer control plane hooks --------------------------------------
+        peer_clients = [PeerRPCClient(n.host, n.port, ak, sk)
+                        for i, n in enumerate(nodes) if i != this]
+        self.notification = NotificationSys(peer_clients)
+        self._peer_rpc.get_locks = self.locker.dump
+        self._peer_rpc.get_server_info = lambda: {
+            "addr": self.spec.addr,
+            "sets": self.set_count,
+            "drives_per_set": self.set_drive_count,
+        }
+        self._peer_rpc.reload_bucket_metadata = \
+            lambda b: self.s3.api.bucket_meta.reload(b)
+        self.s3.api.bucket_meta.on_change = \
+            lambda b: self.notification.reload_bucket_metadata(b)
+
+    # ------------------------------------------------------------------
+
+    def _start_server(self, region: str, iam) -> None:
+        self.s3 = S3Server(None, address=self.spec.host,
+                           port=self.spec.port, region=region,
+                           creds=self.creds, iam=iam)
+        self.s3.register_router("/minio/storage/",
+                                self._storage_rpc.route)
+        self.s3.register_router("/minio/lock/", self._lock_rpc.route)
+        self.s3.register_router("/minio/peer/", self._peer_rpc.route)
+        self.s3.register_router("/minio/bootstrap/",
+                                self._bootstrap_rpc.route)
+        self.s3.start()
+
+    @property
+    def url(self) -> str:
+        return self.s3.url
+
+    def shutdown(self) -> None:
+        """Idempotent; safe on a partially-booted node."""
+        if self.s3 is not None:
+            try:
+                self.s3.stop()
+            except Exception:  # noqa: BLE001 — already stopped
+                pass
+            self.s3 = None
+        if self.sets is not None:
+            self.sets.close()
+            self.sets = None
+        self._lock_rpc.close()
+        for c in self._remote_clients:
+            c.close()
+        self._remote_clients = []
+        for c in self._lock_clients:
+            c.close()
+        self._lock_clients = []
+
+
+def start_node(nodes: list[NodeSpec], this: int, creds: Credentials,
+               **kw) -> ClusterNode:
+    """Boot node `this` of a cluster described by `nodes`."""
+    return ClusterNode(nodes, this, creds, **kw)
+
+
+def start_single(drives: list[str], address: str = "127.0.0.1",
+                 port: int = 0, creds: Optional[Credentials] = None,
+                 **kw) -> ClusterNode:
+    """Single-node server over local drives (reference `minio server
+    /data/d{1...16}`)."""
+    from .s3.credentials import global_credentials
+    creds = creds or global_credentials()
+    paths = ellipses.expand_args(drives)
+    spec = NodeSpec(address, port, paths)
+    return ClusterNode([spec], 0, creds, **kw)

@@ -108,39 +108,36 @@ def heal_blocks(survivors, present_mask: int, cfg: ECConfig,
 
 
 # ---------------------------------------------------------------------------
-# Device bitrot checksum (GF(2)-linear surrogate usable inside jit; the
-# cryptographic digests (HighwayHash/SHA256) run in the host engine or the
-# dedicated device hash kernels — see minio_tpu/bitrot.py)
-# ---------------------------------------------------------------------------
-
-def xor_fold_digest(shards: jax.Array, fold: int = 128) -> jax.Array:
-    """Cheap on-device integrity tag: XOR-fold each shard row to `fold`
-    bytes. Used by the multichip dry-run and as a fast in-pipeline
-    consistency probe (NOT a bitrot-grade digest)."""
-    *lead, n, s = shards.shape
-    pad = (-s) % fold
-    if pad:
-        shards = jnp.pad(shards, [(0, 0)] * (len(lead) + 1) + [(0, pad)])
-    chunks = shards.reshape(*lead, n, -1, fold)
-    return jax.lax.reduce(chunks, np.uint8(0), jax.lax.bitwise_xor,
-                          (len(lead) + 1,))
-
-
-# ---------------------------------------------------------------------------
 # The flagship jittable step (what __graft_entry__.entry() exposes)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def put_step(data: jax.Array, k: int, m: int) -> tuple[jax.Array, jax.Array]:
-    """One PUT device step: encode parity for a batch of blocks and emit
-    per-shard integrity tags.
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def put_step(data: jax.Array, k: int, m: int, shard_len: int = 0,
+             key: bytes = b"") -> tuple[jax.Array, jax.Array]:
+    """One PUT device step: RS-encode a batch of blocks AND compute each
+    shard's streaming-bitrot digest — the full reference per-block PUT
+    work (cmd/erasure-encode.go:75-146 + cmd/bitrot-streaming.go:46-58)
+    as one device program.
 
-    data: (B, k, S) uint8.
-    Returns (parity (B, m, S) uint8, tags (B, k+m, 128) uint8).
+    data: (B, k, S) uint8 data shards. S may include right zero-padding
+    (GF coding is column-independent, so padded columns encode to zeros);
+    shard_len (< = S, default S) is the true shard byte-length the bitrot
+    digests must cover.
+    Returns (shards (B, k+m, S) uint8, digests (B, k+m, 32) uint8), where
+    digests are HighwayHash256 of each shard's first shard_len bytes —
+    byte-identical to the CPU bitrot path (minio_tpu/bitrot.py).
     """
+    from ..ops import highwayhash_jax
+    from ..bitrot import MAGIC_HIGHWAYHASH_KEY
+    b, k_, s = data.shape
+    assert k_ == k
+    shard_len = shard_len or s
+    key = key or MAGIC_HIGHWAYHASH_KEY
     pm = np.asarray(rs_matrix.parity_matrix(k, m))
     m2 = rs_tpu._bit_expand_cached(pm.tobytes(), pm.shape)
     parity = rs_tpu._apply_matrix_impl(
         jnp.asarray(m2), data, m, k, rs_tpu.default_use_pallas())
     full = jnp.concatenate([data, parity], axis=-2)
-    return parity, xor_fold_digest(full)
+    digests = highwayhash_jax._hh256_impl(
+        full.reshape(b * (k + m), s), shard_len, bytes(key))
+    return full, digests.reshape(b, k + m, 32)

@@ -102,6 +102,37 @@ class Codec:
             return "native"
         return "numpy"
 
+    # -- fused encode + bitrot (device) ------------------------------------
+
+    def encode_and_hash_batch(self, data: np.ndarray, algo,
+                              *, force: str = ""):
+        """Fused device path for the PUT hot loop: one program computes
+        parity AND every shard's HighwayHash256 digest (the reference's
+        Erasure.Encode + streaming-bitrot work, cmd/erasure-encode.go:75 +
+        cmd/bitrot-streaming.go:46, as a single device step).
+
+        data: (B, k, S). Returns (full (B, k+m, S), digests (B, k+m, 32))
+        as numpy arrays, or None when the batch doesn't route to the
+        device or the bitrot algorithm has no device kernel.
+        """
+        from .. import bitrot as bitrot_mod
+        if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
+                        bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
+            return None
+        if self.m == 0:
+            return None
+        path = force or self._route(data.nbytes)
+        if path != "device":
+            return None
+        from ..models.pipeline import put_step
+        full, digests = put_step(data, self.k, self.m)
+        # fetch only what the host doesn't have: the m parity rows + the
+        # digests (the k data rows are the caller's own bytes; reading
+        # them back would 4x the device->host traffic at EC 12+4)
+        parity = np.asarray(full[:, self.k:, :])
+        return (np.concatenate([np.asarray(data, np.uint8), parity],
+                               axis=1), np.asarray(digests))
+
     # -- reconstruct -------------------------------------------------------
 
     def reconstruct(self, shards: list[np.ndarray | None],

@@ -251,14 +251,21 @@ class ErasureObjects:
             if len(blocks) > 1:
                 # full blocks share a shard length: one device batch
                 data = np.stack([codec.split(b) for b in blocks])
-                full = codec.encode_batch(data)
             else:
-                full = codec.encode_batch(codec.split(blocks[0]))[None, ...]
-            b_, n_, s_ = full.shape
-            digests = bitrot_mod.hash_shards_batch(
-                full.reshape(b_ * n_, s_), self.bitrot_algo
-            ).reshape(b_, n_, -1)
-            for bi in range(b_):
+                data = codec.split(blocks[0])[None, ...]
+            # fused device encode+digest when routed there (one program,
+            # one round-trip); split CPU/device path otherwise
+            fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
+            if fused is not None:
+                full, digests = fused
+            else:
+                full = codec.encode_batch(data) if len(blocks) > 1 else \
+                    codec.encode_batch(data[0])[None, ...]
+                b_, n_, s_ = full.shape
+                digests = bitrot_mod.hash_shards_batch(
+                    full.reshape(b_ * n_, s_), self.bitrot_algo
+                ).reshape(b_, n_, -1)
+            for bi in range(full.shape[0]):
                 self._write_shards(full[bi], digests[bi], writers,
                                    write_quorum, bucket, object_name)
 

@@ -149,18 +149,28 @@ class HealMixin(ErasureObjects):
         tmp_id = str(_uuid.uuid4())
         codec = self.codec(k, m)
         try:
-            self._reconstruct_shards(bucket, object_name, fi, healthy,
-                                     smeta, to_heal, shuffled, tmp_id,
-                                     codec)
-            # write healed xl.meta + rename into place
+            written = self._reconstruct_shards(
+                bucket, object_name, fi, healthy, smeta, to_heal,
+                shuffled, tmp_id, codec)
+            # write healed xl.meta + rename into place — only on drives
+            # whose shard files were fully written (a writer that failed
+            # mid-stream must not get committing metadata)
             heal_fi = copy.deepcopy(fi)
             for i in to_heal:
                 d = shuffled[i]
-                if d is None:
+                if d is None or i not in written:
                     continue
                 f = copy.deepcopy(heal_fi)
                 f.erasure.index = i + 1
                 try:
+                    # a wiped drive may have lost the bucket dir itself —
+                    # recreate it before renaming in (reference heals the
+                    # bucket before the object, cmd/erasure-healing.go
+                    # healBucket)
+                    try:
+                        d.make_vol(bucket)
+                    except serr.VolumeExists:
+                        pass
                     d.write_metadata(MINIO_META_TMP_BUCKET, tmp_id, f)
                     d.rename_data(MINIO_META_TMP_BUCKET, tmp_id,
                                   fi.data_dir, bucket, object_name)
@@ -171,29 +181,54 @@ class HealMixin(ErasureObjects):
         finally:
             self._cleanup_tmp(shuffled, tmp_id)
 
+        if res.disks_healed == 0:
+            # nothing was actually repaired: surface it so callers (MRF
+            # queue, admin heal) retry instead of counting it healed —
+            # the reference heals with write quorum 1, so zero successes
+            # is a failure (cmd/erasure-lowlevel-heal.go:28)
+            raise api_errors.to_object_err(
+                serr.DiskNotFound("heal wrote no shards"),
+                bucket, object_name)
         res.missing_after = res.missing_before - res.disks_healed
         return res
 
     def _reconstruct_shards(self, bucket, object_name, fi: FileInfo,
                             healthy, smeta, to_heal, shuffled, tmp_id,
-                            codec) -> None:
+                            codec) -> set[int]:
         """Per part: batched recover-matrix matmul over all blocks,
-        streaming results into bitrot writers for the outdated drives."""
+        streaming results into bitrot writers for the outdated drives.
+        Returns the indices whose shard files were fully written — a
+        writer that errors (drive died again mid-heal) is dropped, not
+        fatal (the reference heals with write quorum 1,
+        cmd/erasure-lowlevel-heal.go:28)."""
         n = len(shuffled)
         k = fi.erasure.data_blocks
         shard_size = fi.erasure.shard_size()
+        written = set(to_heal)
+
+        def drop(i: int, writers: dict) -> None:
+            written.discard(i)
+            w = writers.pop(i, None)
+            if w is not None:
+                try:
+                    w.close()
+                except serr.StorageError:
+                    pass
 
         for part in fi.parts:
             if part.size == 0:
                 # empty part: just create the empty framed file
                 for i in to_heal:
                     d = shuffled[i]
-                    if d is not None:
-                        w = bitrot_io.new_bitrot_writer(
-                            d, MINIO_META_TMP_BUCKET,
-                            f"{tmp_id}/{fi.data_dir}/part.{part.number}",
-                            -1, self.bitrot_algo, shard_size)
-                        w.close()
+                    if d is not None and i in written:
+                        try:
+                            w = bitrot_io.new_bitrot_writer(
+                                d, MINIO_META_TMP_BUCKET,
+                                f"{tmp_id}/{fi.data_dir}/part.{part.number}",
+                                -1, self.bitrot_algo, shard_size)
+                            w.close()
+                        except serr.StorageError:
+                            written.discard(i)
                 continue
             path = f"{object_name}/{fi.data_dir}/part.{part.number}"
             till = fi.erasure.shard_file_offset(0, part.size, part.size)
@@ -210,11 +245,15 @@ class HealMixin(ErasureObjects):
             writers: dict[int, object] = {}
             for i in to_heal:
                 d = shuffled[i]
-                if d is not None:
+                if d is None or i not in written:
+                    continue
+                try:
                     writers[i] = bitrot_io.new_bitrot_writer(
                         d, MINIO_META_TMP_BUCKET,
                         f"{tmp_id}/{fi.data_dir}/part.{part.number}",
                         -1, self.bitrot_algo, shard_size)
+                except serr.StorageError:
+                    written.discard(i)
 
             n_blocks = -(-part.size // fi.erasure.block_size)
             for b in range(n_blocks):
@@ -230,14 +269,21 @@ class HealMixin(ErasureObjects):
                     [shards[i] if i < len(shards) and shards[i] is not None
                      else None for i in range(n)],
                     rows=set(writers.keys()))
-                for i, w in writers.items():
-                    w.write(np.ascontiguousarray(
-                        full[i][:shard_len]).tobytes())
+                for i, w in list(writers.items()):
+                    try:
+                        w.write(np.ascontiguousarray(
+                            full[i][:shard_len]).tobytes())
+                    except serr.StorageError:
+                        drop(i, writers)
             for r in readers:
                 if r is not None:
                     r.close()
-            for w in writers.values():
-                w.close()
+            for i, w in list(writers.items()):
+                try:
+                    w.close()
+                except serr.StorageError:
+                    drop(i, writers)
+        return written
 
     def _remove_dangling(self, bucket, object_name, version_id) -> None:
         """Too few copies survive to ever reconstruct: purge the remnants

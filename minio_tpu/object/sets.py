@@ -65,30 +65,54 @@ class ErasureSets:
         """Open (formatting if fresh) setCount×setDriveCount local drives
         (reference waitForFormatErasure + newErasureSets,
         cmd/prepare-storage.go / cmd/erasure-sets.go:337)."""
-        assert len(drive_roots) == set_count * set_drive_count
-        enable_mrf = engine_kw.pop("enable_mrf", True)
         # a faulty drive becomes a None slot, never a bootstrap abort
         # (reference: sets open with offline slots, reconnect monitor
         # picks them up later)
-        drives: list[Optional[XLStorage]] = []
+        drives: list = []
         for r in drive_roots:
             try:
                 drives.append(XLStorage(r))
             except serr.StorageError:
                 drives.append(None)
+        return cls.from_storage(drives, set_count, set_drive_count, parity,
+                                block_size=block_size, ns_lock=ns_lock,
+                                **engine_kw)
+
+    @classmethod
+    def from_storage(cls, drives: list, set_count: int,
+                     set_drive_count: int, parity: int,
+                     block_size: int = 1 << 22,
+                     ns_lock: Optional[NSLockMap] = None,
+                     create_format: bool = True,
+                     **engine_kw) -> "ErasureSets":
+        """Assemble sets over arbitrary StorageAPI drives — local
+        XLStorage and/or RemoteStorage (the distributed boot path,
+        reference newErasureSets over storage REST clients,
+        cmd/erasure-sets.go:337-430).
+
+        create_format=False makes an unformatted cluster an error instead
+        of a fresh format write (non-first nodes wait for the first node
+        to format, cmd/prepare-storage.go waitForFormatErasure).
+        """
+        from ..storage.format import read_format_from, write_format_to
+        assert len(drives) == set_count * set_drive_count
+        enable_mrf = engine_kw.pop("enable_mrf", True)
         formats: list[Optional[FormatErasureV3]] = []
         for d in drives:
             if d is None:
                 formats.append(None)
                 continue
             try:
-                formats.append(d.read_format())
+                formats.append(read_format_from(d))
             except serr.StorageError:
                 formats.append(None)
 
         if all(f is None for f in formats):
             if all(d is None for d in drives):
                 raise serr.DiskNotFound("no usable drives")
+            if not create_format:
+                raise serr.UnformattedDisk(
+                    "cluster not formatted yet (waiting for first node)")
             fresh = new_format_erasure_v3(set_count, set_drive_count)
             for i in range(set_count):
                 for j in range(set_drive_count):
@@ -96,8 +120,9 @@ class ErasureSets:
                     if d is None:
                         continue
                     try:
-                        d.write_format(fresh[i][j])
-                        formats[i * set_drive_count + j] = d.read_format()
+                        write_format_to(d, fresh[i][j])
+                        formats[i * set_drive_count + j] = \
+                            read_format_from(d)
                     except serr.StorageError:
                         pass
         else:
@@ -111,8 +136,8 @@ class ErasureSets:
                     nf = dataclasses.replace(
                         ref, this=ref.sets[si][di])
                     try:
-                        drives[idx].write_format(nf)
-                        formats[idx] = drives[idx].read_format()
+                        write_format_to(drives[idx], nf)
+                        formats[idx] = read_format_from(drives[idx])
                     except serr.StorageError:
                         pass
 

@@ -56,34 +56,50 @@ def make_mesh(n_devices: int | None = None,
 
 
 def sharded_put_step(mesh: Mesh, k: int, m: int):
-    """Build the jitted multi-chip PUT step over `mesh`.
+    """Build the jitted multi-chip PUT step over `mesh`: the full
+    encode+bitrot pipeline with real collectives.
 
-    In:  data (B, k, S) uint8, B % dp == 0, S % (sp*128) == 0.
-    Out: parity (B, m, S) sharded like the input; tags (B, n, 128)
-         replicated along sp (XOR-combined across byte columns).
+    In:  data (B, k, S) uint8, B % dp == 0, S % (sp*128) == 0, and
+         (k+m) % sp == 0.
+    Out: parity (B, m, S) column-sharded like the input; digests
+         (B, k+m, 32) HighwayHash256 per shard, row-sharded along sp;
+         a psum'd consistency counter.
+
+    Encode runs column-sharded (sp = byte columns, GF-columnwise
+    independent — zero collectives). Bitrot digests are sequential over a
+    shard's *full* byte stream, so the pipeline re-shards (B, n, S) from
+    column-sharded to shard-row-sharded with an all_to_all over sp (the
+    storage analog of a sequence-parallel attention's SP->TP switch), then
+    each device HighwayHashes its rows whole.
     """
     pm = np.asarray(rs_matrix.parity_matrix(k, m))
     m2 = rs_tpu._bit_expand_cached(pm.tobytes(), pm.shape)
+    from ..bitrot import MAGIC_HIGHWAYHASH_KEY
+    from ..ops import highwayhash_jax
+    n = k + m
+    sp_size = mesh.devices.shape[1]
+    assert n % sp_size == 0, "total shards must divide the sp axis"
 
     def local_step(data):  # data: (B/dp, k, S/sp)
         parity = rs_tpu.gf_matmul_xla(jnp.asarray(m2, jnp.bfloat16), data)
-        full = jnp.concatenate([data, parity], axis=-2)
-        # local partial integrity tags, XOR-combined across the sp axis:
-        # all_gather + fold (XOR has no direct psum; gather stays tiny)
-        part = pipeline.xor_fold_digest(full)          # (B/dp, n, 128)
-        gathered = jax.lax.all_gather(part, "sp")      # (sp, B/dp, n, 128)
-        tags = jax.lax.reduce(gathered, np.uint8(0),
-                              jax.lax.bitwise_xor, (0,))
+        full = jnp.concatenate([data, parity], axis=-2)  # (B/dp, n, S/sp)
+        # SP->TP reshard: split shard rows across sp, gather byte columns
+        rows = jax.lax.all_to_all(full, "sp", split_axis=1, concat_axis=2,
+                                  tiled=True)            # (B/dp, n/sp, S)
+        b_loc, r_loc, s_full = rows.shape
+        digests = highwayhash_jax._hh256_impl(
+            rows.reshape(b_loc * r_loc, s_full), s_full,
+            bytes(MAGIC_HIGHWAYHASH_KEY)).reshape(b_loc, r_loc, 32)
         # global consistency counter (exercises psum across both axes)
         total = jax.lax.psum(
             jax.lax.psum(jnp.sum(parity.astype(jnp.int32) & 1), "sp"), "dp")
-        return parity, tags, total
+        return parity, digests, total
 
     from jax.experimental.shard_map import shard_map
     fn = shard_map(
         local_step, mesh=mesh,
         in_specs=(P("dp", None, "sp"),),
-        out_specs=(P("dp", None, "sp"), P("dp", None, None), P()),
+        out_specs=(P("dp", None, "sp"), P("dp", "sp", None), P()),
         check_rep=False)
     return jax.jit(fn)
 

@@ -158,6 +158,13 @@ class S3ApiHandlers:
         self._admission = threading.BoundedSemaphore(max_clients)
         self.events = None        # optional event notifier hook
 
+    def set_object_layer(self, object_layer) -> None:
+        """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
+        before the drive/format bootstrap finishes — the reference's
+        server also serves peers before newObjectLayer returns)."""
+        self.obj = object_layer
+        self.bucket_meta.obj = object_layer
+
     # ------------------------------------------------------------------
     # auth
     # ------------------------------------------------------------------

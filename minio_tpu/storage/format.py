@@ -123,6 +123,30 @@ def get_format_in_quorum(formats: list[FormatErasureV3 | None]
     raise errors.CorruptedFormat("unreachable")
 
 
+def read_format_from(disk) -> FormatErasureV3:
+    """Read format.json through the StorageAPI surface (works for local
+    AND remote drives — the remote bootstrap path)."""
+    data = disk.read_all(MINIO_META_BUCKET, FORMAT_CONFIG_FILE)
+    return FormatErasureV3.from_json(data)
+
+
+def write_format_to(disk, fmt: FormatErasureV3) -> None:
+    """Write format.json through the StorageAPI surface, creating the
+    meta volumes (reference initFormatErasure per-disk work)."""
+    for vol in (MINIO_META_BUCKET, MINIO_META_BUCKET + "/buckets",
+                MINIO_META_BUCKET + "/tmp", MINIO_META_BUCKET + "/multipart"):
+        try:
+            disk.make_vol(vol)
+        except errors.VolumeExists:
+            pass
+    disk.write_all(MINIO_META_BUCKET, FORMAT_CONFIG_FILE,
+                   fmt.to_json().encode())
+    try:
+        disk.set_disk_id(fmt.this)
+    except errors.StorageError:
+        pass
+
+
 def check_format_consistency(ref: FormatErasureV3,
                              f: FormatErasureV3) -> None:
     """A drive's format must agree with the quorum topology

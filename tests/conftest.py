@@ -22,5 +22,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the HH256 device kernel costs ~10 s of
+    # XLA compile per distinct shape — cache across test runs
+    _cache = os.path.join(os.path.dirname(__file__), os.pardir,
+                          ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:
     pass

@@ -1,0 +1,200 @@
+"""Multi-node cluster: remote drives inside ErasureSets, dsync NSLock,
+bootstrap verify, node-loss reads, heal through remote shards.
+
+The reference proves this with verify-build.sh's distributed matrix and
+buildscripts/verify-healing.sh (3-node cluster, drive wipe + heal); here
+four nodes run in one process on loopback ports — same RPC planes, no
+containers.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from minio_tpu.cluster import ClusterNode, NodeSpec
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.utils import ellipses
+
+CREDS = Credentials(access_key="clusterkey", secret_key="clustersecret")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _boot_cluster(tmp_path, n_nodes=4, drives_per_node=4, parity=4,
+                  set_drive_count=16):
+    ports = _free_ports(n_nodes)
+    nodes = []
+    for i in range(n_nodes):
+        drives = [str(tmp_path / f"n{i}d{j}")
+                  for j in range(drives_per_node)]
+        nodes.append(NodeSpec("127.0.0.1", ports[i], drives))
+
+    out: list = [None] * n_nodes
+    errs: list = [None] * n_nodes
+
+    def boot(i):
+        try:
+            out[i] = ClusterNode(nodes, i, CREDS, parity=parity,
+                                 set_drive_count=set_drive_count,
+                                 block_size=1 << 16,
+                                 format_timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — surfaced by the test
+            errs[i] = e
+
+    threads = [threading.Thread(target=boot, args=(i,))
+               for i in range(n_nodes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for e in errs:
+        if e is not None:
+            raise e
+    assert all(o is not None for o in out)
+    return out
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cluster")
+    nodes = _boot_cluster(tmp)
+    yield nodes
+    for n in nodes:
+        try:
+            n.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_cluster_boot_topology(cluster):
+    for n in cluster:
+        assert n.set_count == 1
+        assert n.set_drive_count == 16
+        # same deployment id everywhere
+        assert n.sets.deployment_id == cluster[0].sets.deployment_id
+    info = cluster[0].object_layer.storage_info()
+    assert info["online_disks"] == 16
+
+
+def test_put_on_one_node_get_on_another(cluster):
+    a, b = cluster[0], cluster[3]
+    a.object_layer.make_bucket("shared")
+    payload = b"\xab" * 200_000  # multiple blocks at 64 KiB block size
+    a.object_layer.put_object("shared", "obj1", payload)
+    oi = b.object_layer.get_object_info("shared", "obj1")
+    assert oi.size == len(payload)
+    _, stream = b.object_layer.get_object("shared", "obj1")
+    assert b"".join(stream) == payload
+
+
+def test_get_survives_node_loss_and_heals(cluster):
+    a, c = cluster[0], cluster[2]
+    a.object_layer.make_bucket("lossy")
+    payload = bytes(range(256)) * 1000
+    a.object_layer.put_object("lossy", "obj", payload)
+
+    # kill node 1's HTTP server: its 4 drives go dark (12 of 16 left,
+    # exactly k for EC 12+4)
+    victim = cluster[1]
+    victim.s3.stop()
+    try:
+        _, stream = c.object_layer.get_object("lossy", "obj")
+        assert b"".join(stream) == payload
+    finally:
+        victim._start_server("us-east-1", None)
+
+    # drives are back; heal rewrites anything the dead node missed
+    time.sleep(1.5)  # reconnect probe interval is 1 s
+    res = c.object_layer.heal_object("lossy", "obj")
+    _, stream = c.object_layer.get_object("lossy", "obj")
+    assert b"".join(stream) == payload
+
+
+def test_put_during_node_loss_then_heal(cluster):
+    """PUT with a node down writes exactly write-quorum (12 of 16)
+    shards; after the node returns, heal rebuilds its 4 shards, proven by
+    reading with a DIFFERENT node down afterwards."""
+    a, d = cluster[0], cluster[3]
+    a.object_layer.make_bucket("wounded")
+    victim = cluster[2]
+    victim.s3.stop()
+    payload = b"x" * 150_000
+    try:
+        # EC 12+4 write quorum is 12: succeeds on the 12 online drives
+        a.object_layer.put_object("wounded", "obj", payload)
+    finally:
+        victim._start_server("us-east-1", None)
+    time.sleep(1.5)  # reconnect probe interval is 1 s
+    d.object_layer.heal_object("wounded", "obj")
+
+    # node 2's shards must now be real: lose node 1 instead and read
+    other = cluster[1]
+    other.s3.stop()
+    try:
+        _, stream = d.object_layer.get_object("wounded", "obj")
+        assert b"".join(stream) == payload
+    finally:
+        other._start_server("us-east-1", None)
+
+
+def test_dsync_exclusive_across_nodes(cluster):
+    a, b = cluster[0], cluster[1]
+    la = a.sets.sets[0].ns.new_lock("zz/obj")
+    lb = b.sets.sets[0].ns.new_lock("zz/obj")
+    assert la.get_lock(timeout=5.0)
+    try:
+        assert not lb.get_lock(timeout=0.8)
+    finally:
+        la.unlock()
+    assert lb.get_lock(timeout=5.0)
+    lb.unlock()
+
+
+def test_bootstrap_verify_rejects_mismatched_creds(tmp_path):
+    ports = _free_ports(2)
+    nodes = [NodeSpec("127.0.0.1", ports[0],
+                      [str(tmp_path / f"ad{j}") for j in range(4)]),
+             NodeSpec("127.0.0.1", ports[1],
+                      [str(tmp_path / f"bd{j}") for j in range(4)])]
+    good = threading.Thread(
+        target=lambda: _try_boot(nodes, 0, CREDS), daemon=True)
+    good.start()
+    bad_creds = Credentials(access_key="clusterkey", secret_key="WRONG")
+    with pytest.raises(RuntimeError):
+        ClusterNode(nodes, 1, bad_creds, parity=2, set_drive_count=8,
+                    block_size=1 << 16, bootstrap_timeout=6.0,
+                    format_timeout=10.0)
+
+
+def _try_boot(nodes, i, creds):
+    try:
+        n = ClusterNode(nodes, i, creds, parity=2, set_drive_count=8,
+                        block_size=1 << 16, bootstrap_timeout=20.0,
+                        format_timeout=20.0)
+        n.shutdown()
+    except Exception:  # noqa: BLE001 — partner may never come up
+        pass
+
+
+def test_ellipses_expansion():
+    assert ellipses.expand_arg("/d{1...4}") == ["/d1", "/d2", "/d3", "/d4"]
+    assert ellipses.expand_arg("/d{01...03}") == ["/d01", "/d02", "/d03"]
+    assert ellipses.expand_arg("h{1...2}/d{1...2}") == [
+        "h1/d1", "h1/d2", "h2/d1", "h2/d2"]
+    assert ellipses.divide_into_sets(16) == (1, 16)
+    assert ellipses.divide_into_sets(32) == (2, 16)
+    assert ellipses.divide_into_sets(4) == (1, 4)
+    with pytest.raises(ValueError):
+        ellipses.divide_into_sets(17)
