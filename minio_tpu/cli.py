@@ -90,12 +90,12 @@ def main(argv: list[str] | None = None) -> int:
           f"{info['offline_disks']} offline drives")
     print(f"S3 endpoint: {node.url}  (access key {creds.access_key})")
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
     try:
-        while not stop:
-            signal.pause()
+        stop.wait()   # Event.wait is signal-safe: no lost-wakeup window
     finally:
         node.shutdown()
     return 0

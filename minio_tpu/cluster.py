@@ -110,6 +110,7 @@ class ClusterNode:
         self.sets = None
         self._remote_clients: list[RemoteStorage] = []
         self._lock_clients: list[LockRPCClient] = []
+        self._peer_clients: list[PeerRPCClient] = []
         self._start_server(region, iam)
         try:
             self._finish_boot(nodes, this, all_drives, endpoints, ak, sk,
@@ -176,10 +177,19 @@ class ClusterNode:
         self.object_layer = ErasureServerSets([sets])
         self.s3.api.set_object_layer(self.object_layer)
 
+        # -- IAM over the object layer (erasure-coded identity store) ------
+        if self.s3.api.iam is None:
+            from .iam import IAMSys
+            self.s3.api.iam = IAMSys(self.object_layer,
+                                     root_cred=self.creds)
+        self.iam = self.s3.api.iam
+        self.iam.bucket_policy_lookup = \
+            lambda b: self.s3.api.bucket_meta.get(b).policy_json
+
         # -- peer control plane hooks --------------------------------------
-        peer_clients = [PeerRPCClient(n.host, n.port, ak, sk)
-                        for i, n in enumerate(nodes) if i != this]
-        self.notification = NotificationSys(peer_clients)
+        self._peer_clients = [PeerRPCClient(n.host, n.port, ak, sk)
+                              for i, n in enumerate(nodes) if i != this]
+        self.notification = NotificationSys(self._peer_clients)
         self._peer_rpc.get_locks = self.locker.dump
         self._peer_rpc.get_server_info = lambda: {
             "addr": self.spec.addr,
@@ -190,6 +200,8 @@ class ClusterNode:
             lambda b: self.s3.api.bucket_meta.reload(b)
         self.s3.api.bucket_meta.on_change = \
             lambda b: self.notification.reload_bucket_metadata(b)
+        self._peer_rpc.reload_iam = self.iam.load
+        self.iam.on_change = self.notification.reload_iam
 
     # ------------------------------------------------------------------
 
@@ -227,6 +239,9 @@ class ClusterNode:
         for c in self._lock_clients:
             c.close()
         self._lock_clients = []
+        for c in self._peer_clients:
+            c.close()
+        self._peer_clients = []
 
 
 def start_node(nodes: list[NodeSpec], this: int, creds: Credentials,

@@ -1,0 +1,370 @@
+"""IAMSys — users, groups, policies, service accounts, STS credentials.
+
+The reference's cmd/iam.go + cmd/iam-object-store.go: all IAM state
+persists as JSON objects under `.minio.sys/config/iam/` through the
+ObjectLayer itself (so it is erasure-coded and survives drive loss), with
+an in-memory cache and peer-reload broadcast on change.
+
+Layout (mirrors iam-object-store keys):
+    config/iam/users/<ak>.json          identity (secret, status)
+    config/iam/groups/<name>.json       {members, status}
+    config/iam/policies/<name>.json     policy document
+    config/iam/policydb/users/<ak>.json      {"policy": [names]}
+    config/iam/policydb/groups/<name>.json   {"policy": [names]}
+    config/iam/svcaccts/<ak>.json       service account (parent, secret)
+    config/iam/sts/<ak>.json            temp credentials
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import secrets
+import threading
+import time
+from typing import Callable, Optional
+
+from ..s3.credentials import Credentials, generate_credentials
+from .policy import CANNED_POLICIES, Policy, PolicyArgs
+
+IAM_PREFIX = "config/iam"
+MINIO_META_BUCKET = ".minio.sys"
+
+
+class IAMError(Exception):
+    pass
+
+
+class IAMSys:
+    """In-memory IAM state over persisted JSON blobs.
+
+    `object_layer=None` gives a purely in-memory IAM (tests, single-shot
+    tools); with a layer every mutation persists before the cache updates.
+    """
+
+    def __init__(self, object_layer=None, root_cred: Optional[Credentials]
+                 = None):
+        self.obj = object_layer
+        self.root = root_cred
+        self._mu = threading.RLock()
+        self.users: dict[str, Credentials] = {}
+        self.groups: dict[str, dict] = {}           # name -> {members,status}
+        self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
+        self.user_policy: dict[str, list[str]] = {}
+        self.group_policy: dict[str, list[str]] = {}
+        self.sts_creds: dict[str, Credentials] = {}
+        self.svc_accounts: dict[str, Credentials] = {}
+        # cluster hook: called with no args after every mutation so peers
+        # reload (reference NotificationSys.LoadUser/LoadPolicy etc.)
+        self.on_change: Optional[Callable[[], None]] = None
+        # bucket policy lookup seam (bucket -> policy JSON or "")
+        self.bucket_policy_lookup: Optional[Callable[[str], str]] = None
+        if self.obj is not None:
+            self.load()
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def _path(self, *parts: str) -> str:
+        return "/".join((IAM_PREFIX,) + parts) + ".json"
+
+    def _save(self, path: str, payload: dict) -> None:
+        if self.obj is None:
+            return
+        self.obj.put_object(MINIO_META_BUCKET, path,
+                            json.dumps(payload).encode())
+
+    def _delete(self, path: str) -> None:
+        if self.obj is None:
+            return
+        from ..object import api_errors
+        try:
+            self.obj.delete_object(MINIO_META_BUCKET, path)
+        except api_errors.ObjectApiError:
+            pass
+
+    def _read_all(self, prefix: str) -> dict[str, dict]:
+        """name (sans .json) -> parsed payload for every object under
+        config/iam/<prefix>/."""
+        if self.obj is None:
+            return {}
+        from ..object import api_errors
+        out = {}
+        try:
+            objs, _, _ = self.obj.list_objects(
+                MINIO_META_BUCKET, prefix=f"{IAM_PREFIX}/{prefix}/",
+                max_keys=10000)
+        except api_errors.ObjectApiError:
+            return {}
+        for oi in objs:
+            if not oi.name.endswith(".json"):
+                continue
+            name = oi.name[len(f"{IAM_PREFIX}/{prefix}/"):-len(".json")]
+            try:
+                _, stream = self.obj.get_object(MINIO_META_BUCKET, oi.name)
+                out[name] = json.loads(b"".join(stream).decode())
+            except (api_errors.ObjectApiError, ValueError):
+                continue
+        return out
+
+    def load(self) -> None:
+        """(Re)build the cache from the meta bucket (reference
+        IAMSys.Load)."""
+        with self._mu:
+            self.users = {
+                ak: Credentials(access_key=ak,
+                                secret_key=d.get("secret_key", ""),
+                                status=d.get("status", "on"))
+                for ak, d in self._read_all("users").items()}
+            self.groups = self._read_all("groups")
+            self.policies = dict(CANNED_POLICIES)
+            for name, d in self._read_all("policies").items():
+                try:
+                    self.policies[name] = Policy.from_json(json.dumps(d))
+                except (ValueError, KeyError):
+                    continue
+            self.user_policy = {
+                ak: list(d.get("policy", []))
+                for ak, d in self._read_all("policydb/users").items()}
+            self.group_policy = {
+                g: list(d.get("policy", []))
+                for g, d in self._read_all("policydb/groups").items()}
+            self.svc_accounts = {
+                ak: Credentials(access_key=ak,
+                                secret_key=d.get("secret_key", ""),
+                                parent_user=d.get("parent", ""),
+                                status=d.get("status", "on"))
+                for ak, d in self._read_all("svcaccts").items()}
+            now = time.time()
+            self.sts_creds = {}
+            for ak, d in self._read_all("sts").items():
+                c = Credentials(access_key=ak,
+                                secret_key=d.get("secret_key", ""),
+                                session_token=d.get("session_token", ""),
+                                expiration=d.get("expiration", 0.0),
+                                parent_user=d.get("parent", ""))
+                if not c.is_expired() or c.expiration > now:
+                    self.sts_creds[ak] = c
+
+    def _notify(self) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change()
+            except Exception:  # noqa: BLE001 — peers reload lazily anyway
+                pass
+
+    # ------------------------------------------------------------------
+    # users / groups / policies CRUD (cmd/admin-handlers-users.go surface)
+    # ------------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str,
+                 status: str = "on") -> None:
+        if self.root is not None and access_key == self.root.access_key:
+            raise IAMError("cannot override root account")
+        with self._mu:
+            self._save(self._path("users", access_key),
+                       {"secret_key": secret_key, "status": status})
+            self.users[access_key] = Credentials(
+                access_key=access_key, secret_key=secret_key, status=status)
+        self._notify()
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        with self._mu:
+            u = self.users.get(access_key)
+            if u is None:
+                raise IAMError(f"no such user {access_key}")
+            u.status = status
+            self._save(self._path("users", access_key),
+                       {"secret_key": u.secret_key, "status": status})
+        self._notify()
+
+    def remove_user(self, access_key: str) -> None:
+        with self._mu:
+            self.users.pop(access_key, None)
+            self.user_policy.pop(access_key, None)
+            self._delete(self._path("users", access_key))
+            self._delete(self._path("policydb/users", access_key))
+            # drop the user's service accounts + STS creds
+            for ak, c in list(self.svc_accounts.items()):
+                if c.parent_user == access_key:
+                    self.svc_accounts.pop(ak, None)
+                    self._delete(self._path("svcaccts", ak))
+            for ak, c in list(self.sts_creds.items()):
+                if c.parent_user == access_key:
+                    self.sts_creds.pop(ak, None)
+                    self._delete(self._path("sts", ak))
+        self._notify()
+
+    def list_users(self) -> list[str]:
+        with self._mu:
+            return sorted(self.users)
+
+    def add_members_to_group(self, group: str, members: list[str]) -> None:
+        with self._mu:
+            g = self.groups.setdefault(group,
+                                       {"members": [], "status": "on"})
+            for m in members:
+                if m not in self.users:
+                    raise IAMError(f"no such user {m}")
+                if m not in g["members"]:
+                    g["members"].append(m)
+            self._save(self._path("groups", group), g)
+        self._notify()
+
+    def remove_members_from_group(self, group: str,
+                                  members: list[str]) -> None:
+        with self._mu:
+            g = self.groups.get(group)
+            if g is None:
+                raise IAMError(f"no such group {group}")
+            g["members"] = [m for m in g["members"] if m not in members]
+            if g["members"]:
+                self._save(self._path("groups", group), g)
+            else:
+                self.groups.pop(group, None)
+                self.group_policy.pop(group, None)
+                self._delete(self._path("groups", group))
+                self._delete(self._path("policydb/groups", group))
+        self._notify()
+
+    def set_policy(self, name: str, policy: Policy) -> None:
+        """Create/replace a named policy document."""
+        with self._mu:
+            self.policies[name] = policy
+            self._save(self._path("policies", name),
+                       json.loads(policy.to_json()))
+        self._notify()
+
+    def delete_policy(self, name: str) -> None:
+        with self._mu:
+            if name in CANNED_POLICIES:
+                raise IAMError(f"cannot delete canned policy {name}")
+            self.policies.pop(name, None)
+            self._delete(self._path("policies", name))
+        self._notify()
+
+    def attach_policy(self, names: str | list[str], user: str = "",
+                      group: str = "") -> None:
+        """Map policy name(s) to a user or group (reference
+        IAMSys.PolicyDBSet)."""
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        with self._mu:
+            for n in names:
+                if n not in self.policies:
+                    raise IAMError(f"no such policy {n}")
+            if user:
+                self.user_policy[user] = names
+                self._save(self._path("policydb/users", user),
+                           {"policy": names})
+            elif group:
+                self.group_policy[group] = names
+                self._save(self._path("policydb/groups", group),
+                           {"policy": names})
+            else:
+                raise IAMError("user or group required")
+        self._notify()
+
+    # ------------------------------------------------------------------
+    # service accounts + STS
+    # ------------------------------------------------------------------
+
+    def new_service_account(self, parent_user: str,
+                            access_key: str = "",
+                            secret_key: str = "") -> Credentials:
+        with self._mu:
+            if not access_key:
+                fresh = generate_credentials()
+                access_key = fresh.access_key
+                secret_key = fresh.secret_key
+            cred = Credentials(access_key=access_key,
+                               secret_key=secret_key,
+                               parent_user=parent_user)
+            self.svc_accounts[access_key] = cred
+            self._save(self._path("svcaccts", access_key),
+                       {"secret_key": secret_key, "parent": parent_user,
+                        "status": "on"})
+        self._notify()
+        return cred
+
+    def assume_role(self, parent_cred: Credentials,
+                    duration_seconds: int = 3600) -> Credentials:
+        """Mint temp credentials for an authenticated user (reference
+        AssumeRole, cmd/sts-handlers.go:43-86)."""
+        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+        fresh = generate_credentials()
+        token = base64.urlsafe_b64encode(secrets.token_bytes(24)).decode()
+        cred = Credentials(
+            access_key=fresh.access_key, secret_key=fresh.secret_key,
+            session_token=token,
+            expiration=time.time() + duration_seconds,
+            parent_user=parent_cred.parent_user or parent_cred.access_key)
+        with self._mu:
+            self.sts_creds[cred.access_key] = cred
+            self._save(self._path("sts", cred.access_key),
+                       {"secret_key": cred.secret_key,
+                        "session_token": cred.session_token,
+                        "expiration": cred.expiration,
+                        "parent": cred.parent_user})
+        self._notify()
+        return cred
+
+    # ------------------------------------------------------------------
+    # the authorization surface the S3 handlers consume
+    # ------------------------------------------------------------------
+
+    def get_credentials(self, access_key: str) -> Optional[Credentials]:
+        with self._mu:
+            for table in (self.users, self.svc_accounts, self.sts_creds):
+                c = table.get(access_key)
+                if c is not None:
+                    return c
+        return None
+
+    def _effective_policy_names(self, access_key: str) -> list[str]:
+        names = list(self.user_policy.get(access_key, []))
+        for g, info in self.groups.items():
+            if info.get("status", "on") == "on" and \
+                    access_key in info.get("members", []):
+                names.extend(self.group_policy.get(g, []))
+        return names
+
+    def is_allowed(self, cred: Credentials, action: str, bucket: str,
+                   object_name: str = "") -> bool:
+        """Identity-policy + bucket-policy union (reference
+        IAMSys.IsAllowed + PolicyDBGet; temp/service creds evaluate their
+        parent's policies)."""
+        account = cred.parent_user or cred.access_key
+        if cred.is_expired():
+            return False
+        args = PolicyArgs(account=account, action=action, bucket=bucket,
+                          object=object_name)
+        with self._mu:
+            names = self._effective_policy_names(account)
+            docs = [self.policies[n] for n in names if n in self.policies]
+        # bucket policy participates in the same deny/allow algebra
+        if bucket and self.bucket_policy_lookup is not None:
+            raw = self.bucket_policy_lookup(bucket)
+            if raw:
+                try:
+                    docs.append(Policy.from_json(raw))
+                except (ValueError, KeyError):
+                    pass
+        for doc in docs:
+            # explicit deny in ANY applicable policy wins
+            for st in doc.statements:
+                if st.effect == "Deny" and st.applies(args):
+                    return False
+        return any(doc.is_allowed(args) for doc in docs)
+
+    def is_anonymous_allowed(self, policy_json: str, action: str,
+                             bucket: str, object_name: str = "") -> bool:
+        if not policy_json:
+            return False
+        try:
+            doc = Policy.from_json(policy_json)
+        except (ValueError, KeyError):
+            return False
+        return doc.is_allowed(PolicyArgs(
+            account="*", action=action, bucket=bucket, object=object_name))

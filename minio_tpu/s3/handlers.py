@@ -92,6 +92,10 @@ def _http_date(t: float) -> str:
     return formatdate(t, usegmt=True)
 
 
+def _is_hex_sha(s: str) -> bool:
+    return len(s) == 64 and all(c in "0123456789abcdef" for c in s)
+
+
 def _extract_metadata(ctx: RequestContext) -> dict[str, str]:
     """User + standard metadata from headers
     (cmd/utils.go extractMetadata)."""
@@ -191,8 +195,7 @@ class S3ApiHandlers:
                                      self.region, body_sha)
             # a signed hex digest must match the actual body; object PUT
             # verifies via HashReader, every other consumer via read_body
-            if len(body_sha) == 64 and all(
-                    c in "0123456789abcdef" for c in body_sha):
+            if _is_hex_sha(body_sha):
                 ctx.expect_body_sha = body_sha
         elif at == sig.AUTH_STREAMING_SIGNED:
             ctx.cred = sig.verify_v4(ctx.req, self._cred_lookup,
@@ -210,6 +213,14 @@ class S3ApiHandlers:
             return
         else:
             raise S3Error("SignatureVersionNotSupported")
+        # temp (STS) credentials must present their session token —
+        # header for signed requests, X-Amz-Security-Token query param
+        # for presigned URLs (signature.py:291)
+        if ctx.cred.is_temp():
+            token = ctx.header("x-amz-security-token") or \
+                ctx.query1("X-Amz-Security-Token")
+            if token != ctx.cred.session_token:
+                raise S3Error("InvalidTokenId")
         if self.iam is not None and ctx.cred.access_key and \
                 ctx.cred.access_key != self.root_cred.access_key:
             if not self.iam.is_allowed(ctx.cred, action, bucket,
@@ -223,6 +234,58 @@ class S3ApiHandlers:
         return self.iam.is_anonymous_allowed(
             self.bucket_meta.get(bucket).policy_json, action, bucket,
             object_name)
+
+    # ------------------------------------------------------------------
+    # STS (POST / with Action=AssumeRole; cmd/sts-handlers.go:43-86)
+    # ------------------------------------------------------------------
+
+    def handle_sts(self, ctx: RequestContext) -> HTTPResponse:
+        if self.iam is None:
+            raise S3Error("NotImplemented", "STS requires IAM")
+        # SigV4 over the form body (service "sts" or "s3" both accepted);
+        # any valid non-temporary user may assume a role — the minted
+        # credential inherits the PARENT's policies, so no policy check
+        # gates the call itself (reference AssumeRole semantics)
+        body_sha = ctx.header("x-amz-content-sha256",
+                              sig.UNSIGNED_PAYLOAD)
+        cred = sig.verify_v4(ctx.req, self._cred_lookup, self.region,
+                             body_sha)
+        if _is_hex_sha(body_sha):
+            ctx.expect_body_sha = body_sha     # enforced by read_body
+        body = ctx.read_body()
+        form = {k: v[0] for k, v in
+                urllib.parse.parse_qs(body.decode(errors="replace")).items()}
+        action = form.get("Action", "")
+        if action != "AssumeRole":
+            raise S3Error("InvalidArgument",
+                          f"unsupported STS action {action!r}")
+        if cred.is_temp():
+            raise S3Error("AccessDenied",
+                          "temporary credentials cannot assume roles")
+        try:
+            duration = int(form.get("DurationSeconds", "3600"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "bad DurationSeconds") from None
+        minted = self.iam.assume_role(cred, duration)
+        import datetime as _dt
+        exp = _dt.datetime.fromtimestamp(
+            minted.expiration, _dt.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ")
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<AssumeRoleResponse xmlns='
+            '"https://sts.amazonaws.com/doc/2011-06-15/">'
+            "<AssumeRoleResult><Credentials>"
+            f"<AccessKeyId>{minted.access_key}</AccessKeyId>"
+            f"<SecretAccessKey>{minted.secret_key}</SecretAccessKey>"
+            f"<SessionToken>{minted.session_token}</SessionToken>"
+            f"<Expiration>{exp}</Expiration>"
+            "</Credentials></AssumeRoleResult>"
+            "<ResponseMetadata><RequestId>"
+            f"{uuid.uuid4()}</RequestId></ResponseMetadata>"
+            "</AssumeRoleResponse>")
+        return HTTPResponse(body=xml.encode(),
+                            headers={"Content-Type": "application/xml"})
 
     # ------------------------------------------------------------------
     # dispatch
@@ -252,6 +315,8 @@ class S3ApiHandlers:
         if not bucket:
             if m == "GET":
                 return self.list_buckets(ctx)
+            if m == "POST":
+                return self.handle_sts(ctx)
             raise S3Error("MethodNotAllowed")
 
         if key:
