@@ -203,6 +203,16 @@ class ClusterNode:
         self._peer_rpc.reload_iam = self.iam.load
         self.iam.on_change = self.notification.reload_iam
 
+        # -- background plane (initAutoHeal + initDataCrawler) -------------
+        from .object.background import DataUsageCrawler, DiskMonitor
+        self.disk_monitor = DiskMonitor(sets).start()
+        self.crawler = None
+        if this == 0:
+            # one crawler per cluster (first node), like the reference's
+            # leader-ish crawler cadence; usage cache feeds quota
+            self.crawler = DataUsageCrawler(self.object_layer).start()
+            self.s3.api.usage = self.crawler
+
     # ------------------------------------------------------------------
 
     def _start_server(self, region: str, iam) -> None:
@@ -223,6 +233,12 @@ class ClusterNode:
 
     def shutdown(self) -> None:
         """Idempotent; safe on a partially-booted node."""
+        if getattr(self, "disk_monitor", None) is not None:
+            self.disk_monitor.close()
+            self.disk_monitor = None
+        if getattr(self, "crawler", None) is not None:
+            self.crawler.close()
+            self.crawler = None
         if self.s3 is not None:
             try:
                 self.s3.stop()

@@ -1,0 +1,238 @@
+"""Background plane: disk reconnect/new-disk heal + data-usage crawler.
+
+The reference runs these from serverMain (cmd/server-main.go:487-493):
+  * monitorLocalDisksAndHeal (cmd/background-newdisks-heal-ops.go) +
+    connectDisks/monitorAndConnectEndpoints (cmd/erasure-sets.go:200-281):
+    dead drive slots are re-probed, returning drives re-admitted after a
+    format check, fresh (wiped/replaced) drives formatted for their slot
+    and then swept — every object they should hold is healed onto them
+    (healErasureSet, cmd/global-heal.go).
+  * the data crawler (cmd/data-crawler.go:61-157): walks every bucket,
+    accumulates per-bucket object counts/bytes (feeding quota + admin
+    DataUsageInfo), and applies per-object actions (lifecycle expiry
+    rides these hooks, cmd/data-crawler.go:629-713).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from ..storage import errors as serr
+from ..storage.format import read_format_from, write_format_to
+from ..storage.xl_storage import MINIO_META_BUCKET, XLStorage
+from . import api_errors
+from .sets import ErasureSets
+
+DATA_USAGE_OBJECT = "datausage/usage.json"
+
+
+class DiskMonitor:
+    """Re-admit returning drives; format + sweep-heal fresh ones."""
+
+    def __init__(self, sets: ErasureSets, interval: float = 10.0):
+        self.sets = sets
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.healed_slots: list[tuple[int, int]] = []   # for tests/admin
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DiskMonitor":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — keep monitoring
+                pass
+
+    # -- one scan ----------------------------------------------------------
+
+    def scan_once(self) -> int:
+        """Probe every slot; returns how many drives were (re)admitted."""
+        if self.sets.format_ref is None or self.sets.slot_sources is None:
+            return 0
+        admitted = 0
+        for i, eng in enumerate(self.sets.sets):
+            for j in range(len(eng.disks)):
+                if self._probe_slot(i, j):
+                    admitted += 1
+        return admitted
+
+    def _probe_slot(self, i: int, j: int) -> bool:
+        eng = self.sets.sets[i]
+        cur = eng.disks[j]
+        want_uuid = self.sets.format_ref.sets[i][j]
+
+        def fmt_of(d):
+            """format, or None (fresh), or 'err' (unreachable)."""
+            try:
+                return read_format_from(d)
+            except (serr.UnformattedDisk, serr.FileNotFound,
+                    serr.VolumeNotFound, serr.CorruptedFormat):
+                return None
+            except serr.StorageError:
+                return "err"
+
+        if cur is not None:
+            fmt = fmt_of(cur)
+            if fmt not in (None, "err") and fmt.this == want_uuid \
+                    and fmt.id == self.sets.deployment_id:
+                return False         # healthy and in place
+            if fmt == "err" and not isinstance(cur, XLStorage):
+                return False         # remote hiccup: transport re-probes
+
+        # slot is dead, wiped, or replaced: (re)open from its source
+        src = self.sets.slot_sources[i][j]
+        if isinstance(src, str):
+            try:
+                drive = XLStorage(src)
+            except serr.StorageError:
+                return False
+        else:
+            drive = src if src is not None else cur
+        if drive is None:
+            return False
+
+        fmt = fmt_of(drive)
+        if fmt == "err":
+            return False             # unreachable/IO error: try later
+
+        if fmt is not None:
+            if fmt.this != want_uuid or fmt.id != self.sets.deployment_id:
+                return False         # foreign drive: never adopt
+            if cur is drive:
+                return False
+            eng.disks[j] = drive
+            return True
+
+        # fresh/wiped drive: format it for this slot, admit, sweep-heal
+        # (reference HealFormat + healErasureSet)
+        nf = dataclasses.replace(self.sets.format_ref, this=want_uuid)
+        try:
+            write_format_to(drive, nf)
+        except serr.StorageError:
+            return False
+        eng.disks[j] = drive
+        self.healed_slots.append((i, j))
+        try:
+            self.heal_set_sweep(i)
+        except Exception:  # noqa: BLE001 — MRF/next sweep will retry
+            pass
+        return True
+
+    def heal_set_sweep(self, set_index: int) -> int:
+        """Heal every bucket + object of one set (healErasureSet,
+        cmd/global-heal.go). Returns objects healed."""
+        eng = self.sets.sets[set_index]
+        healed = 0
+        for vol in eng.list_buckets():
+            try:
+                eng.heal_bucket(vol.name)
+            except api_errors.ObjectApiError:
+                continue
+            for name in eng._merged_names(vol.name, ""):
+                try:
+                    eng.heal_object(vol.name, name)
+                    healed += 1
+                except api_errors.ObjectApiError:
+                    continue
+        return healed
+
+
+class DataUsageCrawler:
+    """Periodic bucket/object scan feeding usage accounting and
+    per-object actions (lifecycle enforcement plugs in via `actions`)."""
+
+    def __init__(self, object_layer, interval: float = 60.0,
+                 actions: Optional[list[Callable]] = None,
+                 persist: bool = True):
+        self.obj = object_layer
+        self.interval = interval
+        # each action: fn(bucket: str, info: ObjectInfo) -> None
+        self.actions = list(actions or [])
+        self.persist = persist
+        self.usage: dict = {"buckets": {}, "objects_total": 0,
+                            "size_total": 0, "last_update": 0.0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DataUsageCrawler":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — keep crawling
+                pass
+
+    def scan_once(self) -> dict:
+        buckets: dict[str, dict] = {}
+        for vol in self.obj.list_buckets():
+            b = vol.name
+            count = size = 0
+            marker = ""
+            while True:
+                try:
+                    objs, _, trunc = self.obj.list_objects(
+                        b, "", marker, "", 1000)
+                except api_errors.ObjectApiError:
+                    break
+                for oi in objs:
+                    count += 1
+                    size += oi.size
+                    for action in self.actions:
+                        try:
+                            action(b, oi)
+                        except Exception:  # noqa: BLE001 — per-object
+                            pass
+                if not trunc or not objs:
+                    break
+                marker = objs[-1].name
+            buckets[b] = {"objects": count, "size": size}
+        self.usage = {
+            "buckets": buckets,
+            "objects_total": sum(v["objects"] for v in buckets.values()),
+            "size_total": sum(v["size"] for v in buckets.values()),
+            "last_update": time.time(),
+        }
+        if self.persist:
+            try:
+                self.obj.put_object(MINIO_META_BUCKET, DATA_USAGE_OBJECT,
+                                    json.dumps(self.usage).encode())
+            except api_errors.ObjectApiError:
+                pass
+        return self.usage
+
+    def bucket_usage(self, bucket: str) -> Optional[int]:
+        """Cached bytes for a bucket; None before the first scan."""
+        if not self.usage["last_update"]:
+            return None
+        info = self.usage["buckets"].get(bucket)
+        return int(info["size"]) if info else 0
+
+    @classmethod
+    def load_snapshot(cls, object_layer) -> Optional[dict]:
+        try:
+            _, stream = object_layer.get_object(MINIO_META_BUCKET,
+                                                DATA_USAGE_OBJECT)
+            return json.loads(b"".join(stream).decode())
+        except (api_errors.ObjectApiError, ValueError):
+            return None

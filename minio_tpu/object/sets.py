@@ -36,10 +36,17 @@ class ErasureSets:
 
     def __init__(self, sets: list[ErasureSetObjects], deployment_id: str,
                  distribution_algo: str = DISTRIBUTION_ALGO_V3,
-                 enable_mrf: bool = True):
+                 enable_mrf: bool = True,
+                 format_ref: Optional[FormatErasureV3] = None,
+                 slot_sources: Optional[list] = None):
         self.sets = sets
         self.deployment_id = deployment_id
         self.distribution_algo = distribution_algo
+        # topology reference + per-slot drive sources (root path or live
+        # StorageAPI), set-major order — the reconnect/new-disk monitor's
+        # map of what belongs where (reference erasure-sets endpoints)
+        self.format_ref = format_ref
+        self.slot_sources = slot_sources
         self._id16 = _uuid.UUID(deployment_id).bytes
         self._mrf_queue: "queue.Queue[tuple[str, str]]" = queue.Queue(
             maxsize=10000)
@@ -76,7 +83,7 @@ class ErasureSets:
                 drives.append(None)
         return cls.from_storage(drives, set_count, set_drive_count, parity,
                                 block_size=block_size, ns_lock=ns_lock,
-                                **engine_kw)
+                                sources=list(drive_roots), **engine_kw)
 
     @classmethod
     def from_storage(cls, drives: list, set_count: int,
@@ -84,6 +91,7 @@ class ErasureSets:
                      block_size: int = 1 << 22,
                      ns_lock: Optional[NSLockMap] = None,
                      create_format: bool = True,
+                     sources: Optional[list] = None,
                      **engine_kw) -> "ErasureSets":
         """Assemble sets over arbitrary StorageAPI drives — local
         XLStorage and/or RemoteStorage (the distributed boot path,
@@ -146,19 +154,34 @@ class ErasureSets:
 
         # order drives by their position in the format's sets matrix
         by_uuid = {}
-        for d, f in zip(drives, formats):
+        src_by_uuid = {}
+        if sources is None:
+            sources = list(drives)
+        for idx, (d, f) in enumerate(zip(drives, formats)):
             if d is not None and f is not None:
                 by_uuid[f.this] = d
+                src_by_uuid[f.this] = sources[idx]
         ns = ns_lock or NSLockMap()
         sets = []
+        slot_sources = []
         for i in range(set_count):
             set_drives = [by_uuid.get(ref_sets[i][j])
                           for j in range(set_drive_count)]
+            # per-slot source: the drive that attested the slot's UUID,
+            # else the position-derived input (same heuristic the
+            # format-heal above uses for fresh replacements)
+            slot_sources.append([
+                src_by_uuid.get(ref_sets[i][j],
+                                sources[i * set_drive_count + j])
+                for j in range(set_drive_count)])
             sets.append(ErasureSetObjects(
                 set_drives, set_drive_count - parity, parity,
                 block_size=block_size, ns_lock=ns, set_index=i,
                 **engine_kw))
-        return cls(sets, deployment_id, enable_mrf=enable_mrf)
+        fmt_ref = FormatErasureV3(id=deployment_id,
+                                  sets=[list(s) for s in ref_sets])
+        return cls(sets, deployment_id, enable_mrf=enable_mrf,
+                   format_ref=fmt_ref, slot_sources=slot_sources)
 
     # ------------------------------------------------------------------
     # routing

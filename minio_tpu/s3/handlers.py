@@ -161,6 +161,7 @@ class S3ApiHandlers:
         # RAM-budgeted admission gate (cmd/handler-api.go:100 analog)
         self._admission = threading.BoundedSemaphore(max_clients)
         self.events = None        # optional event notifier hook
+        self.usage = None         # optional DataUsageCrawler (quota cache)
 
     def set_object_layer(self, object_layer) -> None:
         """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
@@ -1168,9 +1169,13 @@ class S3ApiHandlers:
             raise S3Error("QuotaExceeded")
 
     def _bucket_usage(self, bucket: str) -> int:
-        """Bytes used by one bucket. Walks the listing (the data-usage
-        crawler cache replaces this scan once wired, cmd/bucket-quota.go
-        reads dataUsageCache)."""
+        """Bytes used by one bucket: the data-usage crawler's cache when
+        one is attached (cmd/bucket-quota.go reads dataUsageCache), else
+        a listing walk."""
+        if self.usage is not None:
+            cached = self.usage.bucket_usage(bucket)
+            if cached is not None:
+                return cached
         used = 0
         marker = ""
         while True:
