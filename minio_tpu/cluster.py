@@ -203,14 +203,28 @@ class ClusterNode:
         self._peer_rpc.reload_iam = self.iam.load
         self.iam.on_change = self.notification.reload_iam
 
+        # -- live bucket features (events, replication, lifecycle) ---------
+        from .features import EventNotifier, ReplicationPool
+        from .features.lifecycle import crawler_action
+        self.events = EventNotifier(self.s3.api.bucket_meta)
+        self.s3.api.events = self.events
+        self.replication = ReplicationPool(self.object_layer,
+                                           self.s3.api.bucket_meta)
+        self.s3.api.replication = self.replication
+
         # -- background plane (initAutoHeal + initDataCrawler) -------------
         from .object.background import DataUsageCrawler, DiskMonitor
         self.disk_monitor = DiskMonitor(sets).start()
         self.crawler = None
         if this == 0:
             # one crawler per cluster (first node), like the reference's
-            # leader-ish crawler cadence; usage cache feeds quota
-            self.crawler = DataUsageCrawler(self.object_layer).start()
+            # leader-ish crawler cadence; usage cache feeds quota and the
+            # crawler enforces lifecycle expiry
+            self.crawler = DataUsageCrawler(
+                self.object_layer,
+                actions=[crawler_action(self.s3.api.bucket_meta,
+                                        self.object_layer,
+                                        self.events)]).start()
             self.s3.api.usage = self.crawler
 
     # ------------------------------------------------------------------
@@ -239,6 +253,12 @@ class ClusterNode:
         if getattr(self, "crawler", None) is not None:
             self.crawler.close()
             self.crawler = None
+        if getattr(self, "events", None) is not None:
+            self.events.close()
+            self.events = None
+        if getattr(self, "replication", None) is not None:
+            self.replication.close()
+            self.replication = None
         if self.s3 is not None:
             try:
                 self.s3.stop()

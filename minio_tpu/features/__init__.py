@@ -1,0 +1,7 @@
+"""Live bucket features: lifecycle enforcement, event notification,
+replication (reference pkg/bucket/lifecycle, pkg/event,
+cmd/bucket-replication.go)."""
+
+from .events import EventNotifier, NotificationConfig  # noqa: F401
+from .lifecycle import Lifecycle  # noqa: F401
+from .replication import ReplicationConfig, ReplicationPool  # noqa: F401

@@ -1,0 +1,216 @@
+"""Bucket event notification: config rules + target dispatch.
+
+The reference's pkg/event: per-bucket NotificationConfiguration XML maps
+event-name patterns + prefix/suffix filters to targets (ARNs); every
+object operation publishes an S3-format event record to the matching
+targets, asynchronously with retry (queue store). Here: a webhook target
+(HTTP POST of the JSON record) and an in-memory target for tests, with a
+bounded async queue + retries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import queue
+import threading
+import time
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _findall(el, tag):
+    return list(el.findall(tag)) + list(el.findall(_NS + tag))
+
+
+def _text(el, tag, default=""):
+    r = el.find(tag)
+    if r is None:
+        r = el.find(_NS + tag)
+    return (r.text or "").strip() if r is not None else default
+
+
+@dataclasses.dataclass
+class QueueRule:
+    arn: str
+    events: list[str]                  # e.g. ["s3:ObjectCreated:*"]
+    prefix: str = ""
+    suffix: str = ""
+
+    def matches(self, event_name: str, key: str) -> bool:
+        if not any(fnmatch.fnmatchcase(event_name, pat)
+                   for pat in self.events):
+            return False
+        if self.prefix and not key.startswith(self.prefix):
+            return False
+        if self.suffix and not key.endswith(self.suffix):
+            return False
+        return True
+
+
+class NotificationConfig:
+    def __init__(self, rules: list[QueueRule]):
+        self.rules = rules
+
+    @classmethod
+    def from_xml(cls, raw: str | bytes) -> "NotificationConfig":
+        root = ET.fromstring(raw)
+        rules = []
+        for qel in (_findall(root, "QueueConfiguration")
+                    + _findall(root, "TopicConfiguration")
+                    + _findall(root, "CloudFunctionConfiguration")):
+            arn = (_text(qel, "Queue") or _text(qel, "Topic")
+                   or _text(qel, "CloudFunction"))
+            events = [(e.text or "").strip()
+                      for e in _findall(qel, "Event")]
+            prefix = suffix = ""
+            for fel in _findall(qel, "Filter"):
+                for kel in _findall(fel, "S3Key"):
+                    for frel in _findall(kel, "FilterRule"):
+                        name = _text(frel, "Name").lower()
+                        value = _text(frel, "Value")
+                        if name == "prefix":
+                            prefix = value
+                        elif name == "suffix":
+                            suffix = value
+            rules.append(QueueRule(arn=arn, events=events, prefix=prefix,
+                                   suffix=suffix))
+        return cls(rules)
+
+
+# ---------------------------------------------------------------------------
+# targets
+# ---------------------------------------------------------------------------
+
+class WebhookTarget:
+    """POST the event JSON to an endpoint (pkg/event/target/webhook)."""
+
+    def __init__(self, arn: str, endpoint: str, timeout: float = 5.0):
+        self.arn = arn
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    def send(self, record: dict) -> None:
+        body = json.dumps(record).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+
+class MemoryTarget:
+    """Captures records in-process (tests / ListenNotification feed)."""
+
+    def __init__(self, arn: str):
+        self.arn = arn
+        self.records: list[dict] = []
+        self._cond = threading.Condition()
+
+    def send(self, record: dict) -> None:
+        with self._cond:
+            self.records.append(record)
+            self._cond.notify_all()
+
+    def wait_for(self, n: int, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self.records) < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    return False
+            return True
+
+
+# ---------------------------------------------------------------------------
+# notifier
+# ---------------------------------------------------------------------------
+
+def event_record(event_name: str, bucket: str, key: str, size: int = 0,
+                 etag: str = "", region: str = "us-east-1") -> dict:
+    """S3 event message structure (pkg/event/event.go)."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
+    return {"Records": [{
+        "eventVersion": "2.0", "eventSource": "minio:s3",
+        "awsRegion": region, "eventTime": now, "eventName": event_name,
+        "userIdentity": {"principalId": "minio"},
+        "s3": {"s3SchemaVersion": "1.0",
+               "bucket": {"name": bucket,
+                          "arn": f"arn:aws:s3:::{bucket}"},
+               "object": {"key": key, "size": size, "eTag": etag}},
+    }]}
+
+
+class EventNotifier:
+    """Per-bucket rule matching + async fan-out with retries."""
+
+    def __init__(self, bucket_meta_sys, region: str = "us-east-1",
+                 retries: int = 3, queue_size: int = 10000):
+        self.bucket_meta = bucket_meta_sys
+        self.region = region
+        self.retries = retries
+        self.targets: dict[str, object] = {}     # arn -> target
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def register_target(self, target) -> None:
+        self.targets[target.arn] = target
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def send(self, event_name: str, bucket: str, key: str,
+             size: int = 0, etag: str = "") -> None:
+        bm = self.bucket_meta.get(bucket)
+        if not bm.notification_xml:
+            return
+        try:
+            cfg = NotificationConfig.from_xml(bm.notification_xml)
+        except ET.ParseError:
+            return
+        for rule in cfg.rules:
+            if not rule.matches(event_name, key):
+                continue
+            target = self.targets.get(rule.arn)
+            if target is None:
+                continue
+            record = event_record(event_name, bucket, key, size, etag,
+                                  self.region)
+            try:
+                self._q.put_nowait((target, record, 0))
+            except queue.Full:
+                pass                        # at-most-once under overload
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                target, record, attempt = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                target.send(record)
+            except Exception:  # noqa: BLE001 — retry with backoff
+                if attempt + 1 < self.retries:
+                    time.sleep(0.2 * (attempt + 1))
+                    try:
+                        self._q.put_nowait((target, record, attempt + 1))
+                    except queue.Full:
+                        pass
+            finally:
+                self._q.task_done()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        done = threading.Event()
+
+        def waiter():
+            self._q.join()
+            done.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        done.wait(timeout)

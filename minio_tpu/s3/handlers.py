@@ -162,6 +162,7 @@ class S3ApiHandlers:
         self._admission = threading.BoundedSemaphore(max_clients)
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
+        self.replication = None   # optional ReplicationPool
 
     def set_object_layer(self, object_layer) -> None:
         """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
@@ -1191,6 +1192,16 @@ class S3ApiHandlers:
             try:
                 self.events.send(event_name, bucket, key)
             except Exception:  # noqa: BLE001 — events are best-effort
+                pass
+        # async replication rides the same mutation signals
+        # (mustReplicate check happens inside the pool)
+        if self.replication is not None and key:
+            try:
+                if event_name.startswith("s3:ObjectCreated:"):
+                    self.replication.on_put(bucket, key)
+                elif event_name.startswith("s3:ObjectRemoved:"):
+                    self.replication.on_delete(bucket, key)
+            except Exception:  # noqa: BLE001 — replication is async
                 pass
 
 
