@@ -14,6 +14,7 @@ import binascii
 import dataclasses
 import hashlib
 import io
+import os
 import re
 import threading
 import urllib.parse
@@ -96,6 +97,25 @@ def _is_hex_sha(s: str) -> bool:
     return len(s) == 64 and all(c in "0123456789abcdef" for c in s)
 
 
+def _skip_take(chunks: Iterator[bytes], skip: int, take: int
+               ) -> Iterator[bytes]:
+    """Trim a chunk stream to [skip, skip+take)."""
+    for chunk in chunks:
+        if skip:
+            if len(chunk) <= skip:
+                skip -= len(chunk)
+                continue
+            chunk = chunk[skip:]
+            skip = 0
+        if take <= 0:
+            return
+        if len(chunk) > take:
+            yield chunk[:take]
+            return
+        take -= len(chunk)
+        yield chunk
+
+
 def _extract_metadata(ctx: RequestContext) -> dict[str, str]:
     """User + standard metadata from headers
     (cmd/utils.go extractMetadata)."""
@@ -163,6 +183,10 @@ class S3ApiHandlers:
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPool
+        from ..features import crypto as sse
+        self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
+        self.compression_enabled = os.environ.get(
+            "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
 
     def set_object_layer(self, object_layer) -> None:
         """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
@@ -827,15 +851,45 @@ class S3ApiHandlers:
         metadata = _extract_metadata(ctx)
         if ctx.header("x-amz-tagging"):
             metadata["X-Amz-Tagging"] = ctx.header("x-amz-tagging")
+        reader, size, sse_headers = self._apply_put_transforms(
+            ctx, key, reader, size, metadata)
         versioned = self.bucket_meta.versioning_enabled(bucket)
         info = self.obj.put_object(
             bucket, key, reader, size,
             PutOptions(metadata=metadata, versioned=versioned))
-        headers = {"ETag": f'"{info.etag}"'}
+        headers = {"ETag": f'"{info.etag}"', **sse_headers}
         if info.version_id and info.version_id != "null":
             headers["x-amz-version-id"] = info.version_id
         self._notify("s3:ObjectCreated:Put", bucket, key)
         return HTTPResponse(headers=headers)
+
+    def _apply_put_transforms(self, ctx, key, reader, size, metadata
+                              ) -> tuple:
+        """Compression + SSE wrapping of the PUT stream (reference
+        newS2CompressReader + EncryptRequest wiring,
+        cmd/object-handlers.go:1452-1470)."""
+        from ..features import crypto as sse
+        ssec_key = sse.parse_ssec_headers(ctx.header)
+        sse_s3 = ctx.header("x-amz-server-side-encryption") == "AES256" \
+            and ssec_key is None
+        compress = (self.compression_enabled
+                    and sse.is_compressible(
+                        key, metadata.get("content-type", "")))
+        if ssec_key is None and not sse_s3 and not compress:
+            return reader, size, {}
+        reader2, size2 = sse.setup_put_transforms(
+            key_name=key, raw_reader=reader, raw_size=size,
+            metadata=metadata, ssec_key=ssec_key, sse_s3=sse_s3,
+            master_key=self.sse_master_key, compress=compress)
+        headers = {}
+        if sse_s3:
+            headers["x-amz-server-side-encryption"] = "AES256"
+        elif ssec_key is not None:
+            headers["x-amz-server-side-encryption-customer-algorithm"] = \
+                "AES256"
+            headers["x-amz-server-side-encryption-customer-key-md5"] = \
+                metadata.get(sse.MK_KEYMD5, "")
+        return reader2, size2, headers
 
     def _obj_response_headers(self, info: ObjectInfo) -> dict[str, str]:
         h = {
@@ -897,6 +951,10 @@ class S3ApiHandlers:
         if short is not None:
             return HTTPResponse(status=short,
                                 headers=self._obj_response_headers(info))
+        from ..features import crypto as sse
+        md = info.user_defined or {}
+        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+            return self._get_transformed(ctx, bucket, key, info, opts, md)
         rng = _parse_range(ctx.header("range"), info.size)
         offset, length = (0, info.size) if rng is None else rng
         info, stream = self.obj.get_object(bucket, key, offset, length,
@@ -920,6 +978,69 @@ class S3ApiHandlers:
         self._notify("s3:ObjectAccessed:Get", bucket, key)
         return HTTPResponse(status=status, headers=headers, stream=stream)
 
+    def _get_transformed(self, ctx, bucket, key, info, opts, md
+                         ) -> HTTPResponse:
+        """GET of an encrypted and/or compressed object: decrypt the
+        covering package range / decompress, then trim to the requested
+        plaintext range (reference DecryptBlocksRequestR + s2 reader
+        stack, cmd/object-api-utils.go:626-697)."""
+        from ..features import crypto as sse
+        enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+        compressed = bool(md.get(sse.MK_COMPRESS))
+        actual = int(md.get(sse.MK_ACTUAL, info.size))
+        rng = _parse_range(ctx.header("range"), actual)
+        offset, length = (0, actual) if rng is None else rng
+
+        if actual <= 0 or length <= 0:
+            stream = iter(())
+        elif compressed:
+            # compressed payloads have no random access: decode from the
+            # start and skip (the reference's s2 path does the same)
+            _, stream = self.obj.get_object(bucket, key, 0, info.size,
+                                            opts)
+            if enc is not None:
+                stream = sse.decrypt_stream(stream, enc[0], enc[1])
+            stream = sse.decompress_stream(stream)
+            stream = _skip_take(stream, offset, length)
+        else:
+            # package-aligned ciphertext range
+            pkg_full = sse.PKG_SIZE + sse.TAG_SIZE
+            start_pkg = offset // sse.PKG_SIZE
+            end_pkg = (offset + length - 1) // sse.PKG_SIZE
+            coff = start_pkg * pkg_full
+            clen = min(info.size - coff,
+                       (end_pkg - start_pkg + 1) * pkg_full)
+            _, stream = self.obj.get_object(bucket, key, coff, clen, opts)
+            stream = sse.decrypt_stream(stream, enc[0], enc[1],
+                                        start_seq=start_pkg)
+            stream = _skip_take(stream, offset - start_pkg * sse.PKG_SIZE,
+                                length)
+
+        headers = self._obj_response_headers(info)
+        headers.update(self._sse_response_headers(md))
+        headers["Content-Length"] = str(length)
+        status = 200
+        if rng is not None:
+            status = 206
+            headers["Content-Range"] = (
+                f"bytes {offset}-{offset + length - 1}/{actual}")
+        self._notify("s3:ObjectAccessed:Get", bucket, key)
+        return HTTPResponse(status=status, headers=headers, stream=stream)
+
+    def _sse_response_headers(self, md: dict) -> dict:
+        from ..features import crypto as sse
+        mode = md.get(sse.MK_SSE, "")
+        if mode == "S3":
+            return {"x-amz-server-side-encryption": "AES256"}
+        if mode == "C":
+            return {
+                "x-amz-server-side-encryption-customer-algorithm":
+                    "AES256",
+                "x-amz-server-side-encryption-customer-key-md5":
+                    md.get(sse.MK_KEYMD5, ""),
+            }
+        return {}
+
     def head_object(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:GetObject", bucket, key)
         vid = ctx.query1("versionId")
@@ -927,7 +1048,16 @@ class S3ApiHandlers:
         info = self.obj.get_object_info(bucket, key, opts)
         short = self._check_preconditions(ctx, info)
         headers = self._obj_response_headers(info)
-        headers["Content-Length"] = str(info.size)
+        from ..features import crypto as sse
+        md = info.user_defined or {}
+        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+            if md.get(sse.MK_SSE) == "C":
+                sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+            headers.update(self._sse_response_headers(md))
+            headers["Content-Length"] = md.get(sse.MK_ACTUAL,
+                                               str(info.size))
+        else:
+            headers["Content-Length"] = str(info.size)
         if short is not None:
             return HTTPResponse(status=short, headers=headers)
         self._notify("s3:ObjectAccessed:Head", bucket, key)
@@ -974,6 +1104,14 @@ class S3ApiHandlers:
         directive = ctx.header("x-amz-metadata-directive", "COPY")
         if directive == "REPLACE":
             metadata = _extract_metadata(ctx)
+            # the stored bytes are copied verbatim: the transform state
+            # (seals, compression flag, actual size) must survive a
+            # metadata REPLACE or the copy is unreadable
+            from ..features import crypto as sse
+            for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
+                       sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL):
+                if ik in src_info.user_defined:
+                    metadata[ik] = src_info.user_defined[ik]
         else:
             if src_bucket == bucket and src_key == key:
                 raise S3Error("InvalidRequest",
@@ -988,6 +1126,9 @@ class S3ApiHandlers:
             # read lock the PUT's write lock would wait on
             stream = iter([b"".join(stream)])
         reader = HashReader(_IterStream(stream), src_info.size)
+        # the bytes are identical, so the ETag is too — and for
+        # transformed objects the stored-byte MD5 is NOT the ETag
+        metadata["etag"] = src_info.etag
         versioned = self.bucket_meta.versioning_enabled(bucket)
         info = self.obj.put_object(
             bucket, key, reader, src_info.size,
@@ -1004,6 +1145,10 @@ class S3ApiHandlers:
     def new_multipart_upload(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:PutObject", bucket, key)
         self.obj.get_bucket_info(bucket)
+        if ctx.header("x-amz-server-side-encryption") or ctx.header(
+                "x-amz-server-side-encryption-customer-algorithm"):
+            raise S3Error("NotImplemented",
+                          "SSE multipart uploads are not supported yet")
         metadata = _extract_metadata(ctx)
         upload_id = self.obj.new_multipart_upload(
             bucket, key, PutOptions(metadata=metadata))

@@ -111,6 +111,9 @@ ERROR_TABLE: dict[str, tuple[int, str]] = {
         404, "The replication configuration was not found"),
     "ServerSideEncryptionConfigurationNotFoundError": (
         404, "The server side encryption configuration was not found"),
+    "InvalidEncryptionAlgorithmError": (
+        400, "The Encryption request you specified is not valid. "
+             "Supported value: AES256."),
     "NoSuchCORSConfiguration": (404, "The CORS configuration does not "
                                      "exist"),
     "NotificationNotFound": (404, "The notification configuration does "
