@@ -203,6 +203,10 @@ class ClusterNode:
         self._peer_rpc.reload_iam = self.iam.load
         self.iam.on_change = self.notification.reload_iam
 
+        # -- admin / health / metrics routers ------------------------------
+        from .s3.admin import mount_admin
+        self.admin = mount_admin(self.s3, self)
+
         # -- live bucket features (events, replication, lifecycle) ---------
         from .features import EventNotifier, ReplicationPool
         from .features.lifecycle import crawler_action

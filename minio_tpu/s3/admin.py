@@ -1,0 +1,340 @@
+"""Admin API + healthcheck + Prometheus metrics routers.
+
+The reference's /minio/admin/v3 surface (cmd/admin-handlers*.go,
+cmd/admin-router.go), /minio/health/{live,ready,cluster}
+(cmd/healthcheck-*.go) and /minio/prometheus/metrics (cmd/metrics.go),
+mounted as extra routers on the S3 server. Admin calls are SigV4-
+authenticated: the root credential, or an IAM identity whose policies
+allow the admin:* action.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+from typing import Optional
+
+from . import signature as sig
+from .handlers import HTTPResponse, RequestContext
+from .s3errors import S3Error
+
+ADMIN_PREFIX = "/minio/admin/v3"
+HEALTH_PREFIX = "/minio/health"
+METRICS_PREFIX = "/minio/prometheus/metrics"
+
+
+class HealSequence:
+    """One background heal run, queryable by token
+    (cmd/admin-heal-ops.go healSequence)."""
+
+    def __init__(self, object_layer, bucket: str, prefix: str):
+        self.token = str(uuid.uuid4())
+        self.bucket = bucket
+        self.prefix = prefix
+        self.status = "running"
+        self.items_scanned = 0
+        self.items_healed = 0
+        self.failures = 0
+        self.started = time.time()
+        self._obj = object_layer
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self) -> None:
+        from ..object import api_errors
+        try:
+            buckets = ([self.bucket] if self.bucket else
+                       [v.name for v in self._obj.list_buckets()])
+            for b in buckets:
+                try:
+                    self._obj.heal_bucket(b)
+                except api_errors.ObjectApiError:
+                    pass
+                marker = ""
+                while True:
+                    objs, _, trunc = self._obj.list_objects(
+                        b, self.prefix, marker, "", 1000)
+                    for oi in objs:
+                        self.items_scanned += 1
+                        try:
+                            self._obj.heal_object(b, oi.name)
+                            self.items_healed += 1
+                        except api_errors.ObjectApiError:
+                            self.failures += 1
+                    if not trunc or not objs:
+                        break
+                    marker = objs[-1].name
+            self.status = "done"
+        except Exception:  # noqa: BLE001 — surfaced via status
+            self.status = "failed"
+
+    def to_dict(self) -> dict:
+        return {"token": self.token, "status": self.status,
+                "bucket": self.bucket, "prefix": self.prefix,
+                "items_scanned": self.items_scanned,
+                "items_healed": self.items_healed,
+                "failures": self.failures,
+                "elapsed": round(time.time() - self.started, 3)}
+
+
+class AdminHandlers:
+    """Router for /minio/admin/v3/* (mount via S3Server extra routers)."""
+
+    def __init__(self, api, node=None):
+        """api: S3ApiHandlers; node: optional ClusterNode (peer plane)."""
+        self.api = api
+        self.node = node
+        self.started = time.time()
+        self._heals: dict[str, HealSequence] = {}
+
+    # -- auth --------------------------------------------------------------
+
+    def _auth(self, ctx: RequestContext, action: str) -> None:
+        at = ctx.auth_type
+        if at not in (sig.AUTH_SIGNED, sig.AUTH_PRESIGNED):
+            raise S3Error("AccessDenied")
+        if at == sig.AUTH_SIGNED:
+            body_sha = ctx.header("x-amz-content-sha256",
+                                  sig.UNSIGNED_PAYLOAD)
+            cred = sig.verify_v4(ctx.req, self.api._cred_lookup,
+                                 self.api.region, body_sha)
+        else:
+            cred = sig.verify_v4_presigned(ctx.req, self.api._cred_lookup,
+                                           self.api.region)
+        if cred.access_key == self.api.root_cred.access_key:
+            return
+        if self.api.iam is not None and self.api.iam.is_allowed(
+                cred, action, "", ""):
+            return
+        raise S3Error("AccessDenied")
+
+    # -- dispatch ----------------------------------------------------------
+
+    def route(self, ctx: RequestContext) -> HTTPResponse:
+        try:
+            return self._route(ctx)
+        except S3Error as e:
+            return HTTPResponse(
+                status=e.status,
+                body=json.dumps({"Code": e.code,
+                                 "Message": e.message}).encode(),
+                headers={"Content-Type": "application/json"})
+        except sig.SigError as e:
+            return HTTPResponse(
+                status=403,
+                body=json.dumps({"Code": e.code}).encode(),
+                headers={"Content-Type": "application/json"})
+
+    def _route(self, ctx: RequestContext) -> HTTPResponse:
+        path = urllib.parse.unquote(ctx.req.path)
+        sub = path[len(ADMIN_PREFIX):].strip("/")
+        m = ctx.req.method
+
+        if sub == "info" and m == "GET":
+            self._auth(ctx, "admin:ServerInfo")
+            return self._json(self.server_info())
+        if sub == "storageinfo" and m == "GET":
+            self._auth(ctx, "admin:StorageInfo")
+            return self._json(self.api.obj.storage_info())
+        if sub == "datausageinfo" and m == "GET":
+            self._auth(ctx, "admin:DataUsageInfo")
+            usage = self.api.usage.usage if self.api.usage is not None \
+                else {}
+            return self._json(usage)
+        if sub == "top/locks" and m == "GET":
+            self._auth(ctx, "admin:TopLocksInfo")
+            return self._json(self.top_locks())
+
+        if sub == "heal" and m == "POST":
+            self._auth(ctx, "admin:Heal")
+            bucket = ctx.query1("bucket")
+            prefix = ctx.query1("prefix")
+            seq = HealSequence(self.api.obj, bucket, prefix)
+            self._heals[seq.token] = seq
+            return self._json({"token": seq.token})
+        if sub == "heal/status" and m == "GET":
+            self._auth(ctx, "admin:Heal")
+            seq = self._heals.get(ctx.query1("token"))
+            if seq is None:
+                raise S3Error("AdminInvalidArgument", "unknown heal token")
+            return self._json(seq.to_dict())
+
+        # -- IAM management (cmd/admin-handlers-users.go) ------------------
+        if sub == "add-user" and m == "PUT":
+            self._auth(ctx, "admin:CreateUser")
+            body = json.loads(ctx.read_body().decode() or "{}")
+            self._iam().add_user(ctx.query1("accessKey"),
+                                 body.get("secretKey", ""),
+                                 body.get("status", "on"))
+            return self._json({})
+        if sub == "remove-user" and m == "DELETE":
+            self._auth(ctx, "admin:DeleteUser")
+            self._iam().remove_user(ctx.query1("accessKey"))
+            return self._json({})
+        if sub == "list-users" and m == "GET":
+            self._auth(ctx, "admin:ListUsers")
+            return self._json({"users": self._iam().list_users()})
+        if sub == "set-user-status" and m == "PUT":
+            self._auth(ctx, "admin:EnableUser")
+            self._iam().set_user_status(ctx.query1("accessKey"),
+                                        ctx.query1("status"))
+            return self._json({})
+        if sub == "add-canned-policy" and m == "PUT":
+            self._auth(ctx, "admin:CreatePolicy")
+            from ..iam.policy import Policy
+            self._iam().set_policy(
+                ctx.query1("name"),
+                Policy.from_json(ctx.read_body().decode()))
+            return self._json({})
+        if sub == "remove-canned-policy" and m == "DELETE":
+            self._auth(ctx, "admin:DeletePolicy")
+            self._iam().delete_policy(ctx.query1("name"))
+            return self._json({})
+        if sub == "list-canned-policies" and m == "GET":
+            self._auth(ctx, "admin:ListUserPolicies")
+            return self._json({
+                "policies": sorted(self._iam().policies)})
+        if sub == "set-user-or-group-policy" and m == "PUT":
+            self._auth(ctx, "admin:AttachUserOrGroupPolicy")
+            self._iam().attach_policy(
+                ctx.query1("policyName"),
+                user=ctx.query1("userOrGroup")
+                if ctx.query1("isGroup") != "true" else "",
+                group=ctx.query1("userOrGroup")
+                if ctx.query1("isGroup") == "true" else "")
+            return self._json({})
+        if sub == "add-service-account" and m == "PUT":
+            self._auth(ctx, "admin:CreateServiceAccount")
+            body = json.loads(ctx.read_body().decode() or "{}")
+            cred = self._iam().new_service_account(
+                body.get("parent", ""), body.get("accessKey", ""),
+                body.get("secretKey", ""))
+            return self._json({"accessKey": cred.access_key,
+                               "secretKey": cred.secret_key})
+
+        raise S3Error("AdminInvalidArgument",
+                      f"unknown admin call {m} {sub!r}")
+
+    def _iam(self):
+        if self.api.iam is None:
+            raise S3Error("NotImplemented", "IAM is not configured")
+        return self.api.iam
+
+    @staticmethod
+    def _json(payload: dict) -> HTTPResponse:
+        return HTTPResponse(body=json.dumps(payload).encode(),
+                            headers={"Content-Type": "application/json"})
+
+    # -- info --------------------------------------------------------------
+
+    def server_info(self) -> dict:
+        info = {
+            "version": "minio-tpu-dev",
+            "uptime": round(time.time() - self.started, 3),
+            "region": self.api.region,
+            "storage": self.api.obj.storage_info()
+            if self.api.obj is not None else {},
+        }
+        if self.node is not None:
+            info["node"] = self.node.spec.addr
+            info["sets"] = self.node.set_count
+            info["drives_per_set"] = self.node.set_drive_count
+            peers = self.node.notification.server_info_all()
+            info["peers"] = [p for p in peers if isinstance(p, dict)]
+        return info
+
+    def top_locks(self) -> dict:
+        merged: dict = {}
+        if self.node is not None:
+            merged.update(self.node.notification.top_locks())
+            local = self.node.locker.dump()
+        else:
+            local = {}
+        for res, holders in local.items():
+            merged.setdefault(res, []).extend(holders)
+        return merged
+
+
+class HealthHandlers:
+    """/minio/health/{live,ready,cluster} (cmd/healthcheck-handler.go)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def route(self, ctx: RequestContext) -> HTTPResponse:
+        sub = ctx.req.path[len(HEALTH_PREFIX):].strip("/")
+        if sub == "live":
+            return HTTPResponse(status=200)
+        if sub in ("ready", "cluster"):
+            obj = self.api.obj
+            if obj is None:
+                return HTTPResponse(status=503)
+            try:
+                info = obj.storage_info()
+            except Exception:  # noqa: BLE001 — failure = not ready
+                return HTTPResponse(status=503)
+            total = info["online_disks"] + info["offline_disks"]
+            # ready when a write quorum of drives is online
+            if total and info["online_disks"] > total // 2:
+                return HTTPResponse(status=200)
+            return HTTPResponse(status=503)
+        return HTTPResponse(status=404)
+
+
+class MetricsHandler:
+    """Prometheus text exposition (cmd/metrics.go subset)."""
+
+    def __init__(self, api, node=None):
+        self.api = api
+        self.node = node
+
+    def route(self, ctx: RequestContext) -> HTTPResponse:
+        lines = []
+
+        def gauge(name, value, help_=""):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+
+        try:
+            info = self.api.obj.storage_info() if self.api.obj else {}
+        except Exception:  # noqa: BLE001
+            info = {}
+        gauge("minio_disks_online", info.get("online_disks", 0),
+              "Online drives")
+        gauge("minio_disks_offline", info.get("offline_disks", 0),
+              "Offline drives")
+        gauge("minio_capacity_raw_total_bytes", info.get("total", 0),
+              "Raw capacity")
+        gauge("minio_capacity_raw_free_bytes", info.get("free", 0),
+              "Raw free")
+        if self.api.usage is not None:
+            u = self.api.usage.usage
+            gauge("minio_usage_object_total", u.get("objects_total", 0),
+                  "Objects")
+            gauge("minio_usage_size_total_bytes", u.get("size_total", 0),
+                  "Logical bytes")
+            for b, v in u.get("buckets", {}).items():
+                lines.append(
+                    f'minio_bucket_usage_size_bytes{{bucket="{b}"}} '
+                    f'{v["size"]}')
+        if self.api.replication is not None:
+            gauge("minio_replication_completed_total",
+                  self.api.replication.replicated, "Replicated ops")
+            gauge("minio_replication_failed_total",
+                  self.api.replication.failed, "Failed replication ops")
+        return HTTPResponse(body=("\n".join(lines) + "\n").encode(),
+                            headers={"Content-Type": "text/plain"})
+
+
+def mount_admin(server, node=None) -> AdminHandlers:
+    """Attach admin/health/metrics routers to an S3Server."""
+    admin = AdminHandlers(server.api, node)
+    server.register_router(ADMIN_PREFIX, admin.route)
+    server.register_router(HEALTH_PREFIX, HealthHandlers(server.api).route)
+    server.register_router(METRICS_PREFIX,
+                           MetricsHandler(server.api, node).route)
+    return admin

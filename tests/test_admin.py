@@ -1,0 +1,169 @@
+"""Admin API, healthcheck, metrics over the live server (reference
+cmd/admin-handlers_test.go / healthcheck intents)."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.iam import IAMSys
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.admin import mount_admin
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+
+CREDS = Credentials("admintestkey", "admintestsecret1")
+REGION = "us-east-1"
+
+
+class Client:
+    def __init__(self, port, creds=CREDS):
+        self.port, self.creds = port, creds
+
+    def request(self, method, path, query=None, body=b"", sign=True):
+        query = {k: [v] for k, v in (query or {}).items()}
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        hdrs = {"host": f"127.0.0.1:{self.port}"}
+        if sign:
+            payload_hash = hashlib.sha256(body).hexdigest()
+            hdrs = sig.sign_v4(method, path, query, hdrs, payload_hash,
+                               self.creds, REGION)
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=30)
+        conn.request(method, path + (f"?{qs}" if qs else ""), body=body,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("admindrives")
+    drives = [str(root / f"d{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
+                                   parity=2, block_size=1 << 16)
+    iam = IAMSys(sets, root_cred=CREDS)
+    srv = S3Server(sets, creds=CREDS, region=REGION, iam=iam).start()
+    mount_admin(srv)
+    yield srv
+    srv.stop()
+    sets.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return Client(server.port)
+
+
+def test_health_endpoints(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    for sub, want in (("live", 200), ("ready", 200), ("cluster", 200),
+                      ("nope", 404)):
+        conn.request("GET", f"/minio/health/{sub}")
+        r = conn.getresponse()
+        r.read()
+        assert r.status == want, sub
+    conn.close()
+
+
+def test_admin_requires_auth(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    conn.request("GET", "/minio/admin/v3/info")
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 403
+    conn.close()
+
+
+def test_admin_info_and_storage(client):
+    st, body = client.request("GET", "/minio/admin/v3/info")
+    assert st == 200
+    info = json.loads(body)
+    assert info["storage"]["online_disks"] == 4
+
+    st, body = client.request("GET", "/minio/admin/v3/storageinfo")
+    assert st == 200 and json.loads(body)["online_disks"] == 4
+
+
+def test_admin_iam_flow(client, server):
+    st, _ = client.request("PUT", "/minio/admin/v3/add-user",
+                           query={"accessKey": "adminmadeuser"},
+                           body=json.dumps(
+                               {"secretKey": "secretsecret1"}).encode())
+    assert st == 200
+    st, body = client.request("GET", "/minio/admin/v3/list-users")
+    assert st == 200 and "adminmadeuser" in json.loads(body)["users"]
+
+    st, _ = client.request(
+        "PUT", "/minio/admin/v3/set-user-or-group-policy",
+        query={"policyName": "readonly", "userOrGroup": "adminmadeuser"})
+    assert st == 200
+    cred = server.api.iam.get_credentials("adminmadeuser")
+    assert server.api.iam.is_allowed(cred, "s3:GetObject", "b", "o")
+
+    # a plain user may NOT call admin APIs
+    user_client = Client(client.port,
+                         Credentials("adminmadeuser", "secretsecret1"))
+    st, _ = user_client.request("GET", "/minio/admin/v3/list-users")
+    assert st == 403
+
+    st, _ = client.request("DELETE", "/minio/admin/v3/remove-user",
+                           query={"accessKey": "adminmadeuser"})
+    assert st == 200
+    assert server.api.iam.get_credentials("adminmadeuser") is None
+
+
+def test_admin_policy_crud(client):
+    pol = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::x/*"]}]}).encode()
+    st, _ = client.request("PUT", "/minio/admin/v3/add-canned-policy",
+                           query={"name": "adminpol"}, body=pol)
+    assert st == 200
+    st, body = client.request("GET",
+                              "/minio/admin/v3/list-canned-policies")
+    assert st == 200 and "adminpol" in json.loads(body)["policies"]
+    st, _ = client.request("DELETE",
+                           "/minio/admin/v3/remove-canned-policy",
+                           query={"name": "adminpol"})
+    assert st == 200
+
+
+def test_admin_heal_sequence(client, server):
+    server.api.obj.make_bucket("healb")
+    server.api.obj.put_object("healb", "o1", b"data1" * 100)
+    server.api.obj.put_object("healb", "o2", b"data2" * 100)
+    st, body = client.request("POST", "/minio/admin/v3/heal",
+                              query={"bucket": "healb"})
+    assert st == 200
+    token = json.loads(body)["token"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st, body = client.request("GET", "/minio/admin/v3/heal/status",
+                                  query={"token": token})
+        assert st == 200
+        d = json.loads(body)
+        if d["status"] != "running":
+            break
+        time.sleep(0.1)
+    assert d["status"] == "done"
+    assert d["items_scanned"] == 2
+
+
+def test_metrics_endpoint(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    conn.request("GET", "/minio/prometheus/metrics")
+    r = conn.getresponse()
+    text = r.read().decode()
+    conn.close()
+    assert r.status == 200
+    assert "minio_disks_online 4" in text
+    assert "minio_capacity_raw_total_bytes" in text
