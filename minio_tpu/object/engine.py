@@ -667,7 +667,7 @@ class ErasureObjects:
                      ) -> tuple[list[ObjectInfo], list[str], bool]:
         """Returns (objects, common_prefixes, is_truncated)."""
         self.get_bucket_info(bucket)  # existence + quorum check
-        names = self._merged_names(bucket, prefix)
+        names = self._merged_names(bucket, prefix, marker)
         objects: list[ObjectInfo] = []
         prefixes: list[str] = []
         seen_prefix: set[str] = set()
@@ -708,7 +708,7 @@ class ErasureObjects:
                              ) -> list[ObjectInfo]:
         self.get_bucket_info(bucket)
         out: list[ObjectInfo] = []
-        for name in self._merged_names(bucket, prefix):
+        for name in self._merged_names(bucket, prefix, marker):
             if marker and name <= marker:
                 continue
             for d in self.disks:
@@ -724,24 +724,46 @@ class ErasureObjects:
                 break
         return out
 
-    def _merged_names(self, bucket: str, prefix: str) -> list[str]:
-        """Union of object names across drives, lexically sorted (the
-        merge-walk's effect; every drive carries every object's xl.meta)."""
-        names: set[str] = set()
+    def _merged_names(self, bucket: str, prefix: str,
+                      marker: str = "") -> Iterator[str]:
+        """Lazy lexical merge-walk of object names across drives (the
+        reference's startMergeWalks/lexicallySortedEntry,
+        cmd/erasure-sets.go:888-1081): each drive streams its own sorted
+        walk, a heap merge dedupes, and nothing is materialized — a
+        100k-key bucket costs one page, not one set.
+
+        Yields names > marker matching prefix, in order, until the
+        caller stops."""
+        import heapq
+
+        # narrow the walk to the deepest directory of the prefix
+        dir_part = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+
+        def drive_names(d) -> Iterator[str]:
+            try:
+                for fi in d.walk(bucket, dir_part, marker):
+                    yield fi.name
+            except serr.StorageError:
+                return              # drive died mid-walk: its names drop
+
+        iters = []
         live = 0
         for d in self.disks:
             if d is None:
                 continue
-            try:
-                for fi in d.walk(bucket):
-                    if fi.name.startswith(prefix):
-                        names.add(fi.name)
-                live += 1
-            except serr.StorageError:
-                continue
-            if live >= 3:  # reference asks 3 random disks per set
+            iters.append(drive_names(d))
+            live += 1
+            if live >= 3:  # reference asks 3 disks per set
                 break
-        return sorted(names)
+        last = None
+        for name in heapq.merge(*iters):
+            if name == last:
+                continue
+            last = name
+            if name.startswith(prefix):
+                yield name
+            elif name > prefix:
+                return              # sorted: nothing later can match
 
     def _read_one(self, bucket: str, object_name: str) -> FileInfo:
         fi, _, _ = self._object_file_info(bucket, object_name)
