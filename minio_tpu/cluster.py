@@ -160,6 +160,12 @@ class ClusterNode:
         else:
             ns_lock = NSLockMap()
 
+        # -- cross-request device batch former + RAM-budgeted admission ----
+        from .parallel.scheduler import BatchScheduler, requests_budget
+        self.scheduler = BatchScheduler()
+        self.s3.api.set_max_clients(
+            requests_budget(block_size, set_drive_count))
+
         # -- format bootstrap (waitForFormatErasure) -----------------------
         deadline = time.monotonic() + format_timeout
         while True:
@@ -167,7 +173,8 @@ class ClusterNode:
                 sets = ErasureSets.from_storage(
                     drives, set_count, set_drive_count, parity,
                     block_size=block_size, ns_lock=ns_lock,
-                    create_format=(this == 0))
+                    create_format=(this == 0),
+                    scheduler=self.scheduler)
                 break
             except serr.StorageError:
                 if time.monotonic() >= deadline:
@@ -263,6 +270,9 @@ class ClusterNode:
         if getattr(self, "replication", None) is not None:
             self.replication.close()
             self.replication = None
+        if getattr(self, "scheduler", None) is not None:
+            self.scheduler.close()
+            self.scheduler = None
         if self.s3 is not None:
             try:
                 self.s3.stop()

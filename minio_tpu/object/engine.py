@@ -67,7 +67,8 @@ class ErasureObjects:
                  ns_lock: Optional[NSLockMap] = None,
                  bitrot_algo: bitrot_mod.BitrotAlgorithm =
                  bitrot_mod.DEFAULT_BITROT_ALGORITHM,
-                 set_index: int = 0):
+                 set_index: int = 0,
+                 scheduler=None):
         assert len(disks) == data_shards + parity_shards
         self.disks = disks
         self.data_shards = data_shards
@@ -76,6 +77,8 @@ class ErasureObjects:
         self.bitrot_algo = bitrot_algo
         self.ns = ns_lock or NSLockMap()
         self.set_index = set_index
+        # optional cross-request batch former (parallel/scheduler.py)
+        self.scheduler = scheduler
         self._codec_cache: dict[tuple[int, int], Codec] = {}
         # MRF hook: called (bucket, object) when a GET had to reconstruct
         # or hit bitrot — the sets layer queues a heal (reference
@@ -254,8 +257,13 @@ class ErasureObjects:
             else:
                 data = codec.split(blocks[0])[None, ...]
             # fused device encode+digest when routed there (one program,
-            # one round-trip); split CPU/device path otherwise
-            fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
+            # one round-trip); the cross-request scheduler coalesces
+            # concurrent PUT streams into shared dispatches
+            if self.scheduler is not None:
+                fused = self.scheduler.encode_and_hash(
+                    codec, data, self.bitrot_algo)
+            else:
+                fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
             if fused is not None:
                 full, digests = fused
             else:

@@ -1,0 +1,33 @@
+"""Bounded byte-buffer pool (reference pkg/bpool.BytePoolCap, fed to the
+erasure encoder at cmd/erasure-sets.go:374).
+
+PUT streams stage each block in a same-width buffer; pooling them caps
+allocation churn and puts a hard bound on staging memory. get() blocks
+when the pool is exhausted — that back-pressure IS the admission
+control for raw block memory.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+
+class BytePool:
+    def __init__(self, width: int, capacity: int):
+        self.width = width
+        self.capacity = capacity
+        self._q: "queue.Queue[bytearray]" = queue.Queue(maxsize=capacity)
+        for _ in range(capacity):
+            self._q.put(bytearray(width))
+
+    def get(self, timeout: Optional[float] = None) -> bytearray:
+        return self._q.get(timeout=timeout)
+
+    def put(self, buf: bytearray) -> None:
+        if len(buf) != self.width:
+            return                       # foreign buffer: drop it
+        try:
+            self._q.put_nowait(buf)
+        except queue.Full:
+            pass

@@ -188,6 +188,12 @@ class S3ApiHandlers:
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
 
+    def set_max_clients(self, n: int) -> None:
+        """Re-size the admission gate once topology is known (the
+        reference computes maxClients from RAM + drive count,
+        cmd/handler-api.go:46-57)."""
+        self._admission = threading.BoundedSemaphore(max(n, 1))
+
     def set_object_layer(self, object_layer) -> None:
         """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
         before the drive/format bootstrap finishes — the reference's

@@ -1,0 +1,123 @@
+"""Cross-request batch scheduler: coalescing, correctness of scatter,
+failure propagation, buffer pool back-pressure, admission budget."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from minio_tpu import bitrot as bitrot_mod
+from minio_tpu.object.codec import Codec
+from minio_tpu.parallel.bpool import BytePool
+from minio_tpu.parallel.scheduler import BatchScheduler, requests_budget
+
+HH = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S
+
+
+@pytest.fixture()
+def device_codec(monkeypatch):
+    """Force the codec's device route (runs on the CPU jax backend)."""
+    from minio_tpu.object import codec as codec_mod
+    monkeypatch.setattr(codec_mod, "_device_is_tpu", lambda: True)
+    monkeypatch.setattr(codec_mod, "DEVICE_MIN_BYTES", 0)
+    return codec_mod
+
+
+def test_scheduler_coalesces_concurrent_streams(device_codec):
+    sched = BatchScheduler(max_batch=64, max_wait=0.05)
+    codec = Codec(4, 2, 4 * 512)
+    rng = np.random.default_rng(0)
+    inputs = [rng.integers(0, 256, (2, 4, 512), dtype=np.uint8)
+              for _ in range(6)]
+    outs: list = [None] * len(inputs)
+
+    def run(i):
+        outs[i] = sched.encode_and_hash(codec, inputs[i], HH)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(inputs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+    for i, out in enumerate(outs):
+        assert out is not None
+        full, digests = out
+        want = codec.encode_batch(inputs[i], force="numpy")
+        assert (full == want).all()
+        want_dg = bitrot_mod.hash_shards_batch(
+            want.reshape(-1, 512), HH).reshape(2, 6, 32)
+        assert (digests == want_dg).all()
+    # at least some requests shared a dispatch
+    assert sched.batches < len(inputs)
+    assert sched.coalesced > 0
+    sched.close()
+
+
+def test_scheduler_respects_max_batch(device_codec):
+    sched = BatchScheduler(max_batch=3, max_wait=0.05)
+    codec = Codec(4, 2, 4 * 256)
+    rng = np.random.default_rng(1)
+    inputs = [rng.integers(0, 256, (2, 4, 256), dtype=np.uint8)
+              for _ in range(4)]            # 8 blocks > max_batch 3
+    outs: list = [None] * 4
+    threads = [threading.Thread(
+        target=lambda i=i: outs.__setitem__(
+            i, sched.encode_and_hash(codec, inputs[i], HH)))
+        for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(4):
+        full, _ = outs[i]
+        assert (full == codec.encode_batch(inputs[i],
+                                           force="numpy")).all()
+    sched.close()
+
+
+def test_scheduler_declines_non_hh():
+    sched = BatchScheduler()
+    codec = Codec(4, 2, 4 * 128)
+    data = np.zeros((1, 4, 128), np.uint8)
+    assert sched.encode_and_hash(
+        codec, data, bitrot_mod.BitrotAlgorithm.SHA256) is None
+    sched.close()
+
+
+def test_scheduler_propagates_errors(device_codec, monkeypatch):
+    sched = BatchScheduler(max_wait=0.01)
+    codec = Codec(4, 2, 4 * 128)
+
+    def boom(*a, **k):
+        raise RuntimeError("device on fire")
+
+    from minio_tpu.object import codec as codec_mod
+    monkeypatch.setattr(codec_mod.Codec, "encode_and_hash_batch", boom)
+    data = np.zeros((1, 4, 128), np.uint8)
+    with pytest.raises(RuntimeError):
+        sched.encode_and_hash(codec, data, HH)
+    sched.close()
+
+
+def test_bytepool_backpressure():
+    pool = BytePool(1024, 2)
+    a, b = pool.get(), pool.get()
+    with pytest.raises(Exception):
+        pool.get(timeout=0.05)
+    pool.put(a)
+    c = pool.get(timeout=1.0)
+    assert len(c) == 1024
+    pool.put(bytearray(5))     # wrong width: silently dropped
+    pool.put(b)
+    pool.put(c)
+
+
+def test_requests_budget_formula():
+    n = requests_budget(1 << 22, 16)
+    assert n >= 8
+    # bigger blocks -> fewer admitted requests
+    assert requests_budget(1 << 26, 16) <= n
