@@ -40,7 +40,9 @@ MK_SEALED = "X-Minio-Internal-Sse-Sealed-Key"
 MK_IV = "X-Minio-Internal-Sse-Iv"
 MK_KEYMD5 = "X-Minio-Internal-Sse-Key-Md5"
 MK_COMPRESS = "X-Minio-Internal-Compression"
-MK_ACTUAL = "X-Minio-Internal-Actual-Size"
+# matches storage.datatypes.to_object_info's actual-size key, so
+# ObjectInfo.actual_size is correct for transformed objects too
+MK_ACTUAL = "X-Minio-Internal-actual-size"
 
 COMPRESSIBLE_EXT = (".txt", ".log", ".csv", ".json", ".tar", ".xml",
                     ".bin")

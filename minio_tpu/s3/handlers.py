@@ -417,7 +417,56 @@ class S3ApiHandlers:
         if m == "POST":
             if ctx.has_query("delete"):
                 return self.delete_multiple_objects(ctx, bucket)
+            if "multipart/form-data" in ctx.header("content-type"):
+                return self.post_policy_upload(ctx, bucket)
         raise S3Error("MethodNotAllowed")
+
+    def post_policy_upload(self, ctx, bucket) -> HTTPResponse:
+        """Browser form upload (PostPolicyBucketHandler,
+        cmd/bucket-handlers.go)."""
+        from . import postpolicy as pp
+        body = ctx.read_body()
+        fields, file_bytes, file_name = pp.parse_multipart_form(
+            body, ctx.header("content-type"))
+        cred = pp.verify_post_signature(fields, self._cred_lookup,
+                                        self.region)
+        lower = {k.lower(): v for k, v in fields.items()}
+        key = lower.get("key", "")
+        if not key:
+            raise S3Error("MalformedPOSTRequest", "missing key field")
+        key = key.replace("${filename}", file_name)
+        pp.check_post_policy(lower.get("policy", ""), fields,
+                             len(file_bytes))
+        if self.iam is not None and \
+                cred.access_key != self.root_cred.access_key:
+            if not self.iam.is_allowed(cred, "s3:PutObject", bucket, key):
+                raise S3Error("AccessDenied")
+        self.obj.get_bucket_info(bucket)
+        self._enforce_quota(bucket, len(file_bytes))
+        metadata = {"content-type": lower.get(
+            "content-type", "application/octet-stream")}
+        for k, v in fields.items():
+            if k.lower().startswith("x-amz-meta-"):
+                metadata["X-Amz-Meta-" +
+                         k[len("x-amz-meta-"):].title()] = v
+        versioned = self.bucket_meta.versioning_enabled(bucket)
+        info = self.obj.put_object(
+            bucket, key, file_bytes,
+            opts=PutOptions(metadata=metadata, versioned=versioned))
+        self._notify("s3:ObjectCreated:Post", bucket, key)
+        status = int(lower.get("success_action_status", "204"))
+        if status not in (200, 201, 204):
+            status = 204
+        headers = {"ETag": f'"{info.etag}"',
+                   "Location": f"/{bucket}/{key}"}
+        if status == 201:
+            xml = (f'<?xml version="1.0" encoding="UTF-8"?>'
+                   f"<PostResponse><Location>/{bucket}/{key}</Location>"
+                   f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                   f'<ETag>"{info.etag}"</ETag></PostResponse>')
+            return HTTPResponse(status=201, headers=headers,
+                                body=xml.encode())
+        return HTTPResponse(status=status, headers=headers)
 
     def _route_object(self, ctx, m, bucket, key) -> HTTPResponse:
         if m == "GET":
@@ -425,6 +474,10 @@ class S3ApiHandlers:
                 return self.list_object_parts(ctx, bucket, key)
             if ctx.has_query("tagging"):
                 return self.get_object_tagging(ctx, bucket, key)
+            if ctx.has_query("retention"):
+                return self.get_object_retention(ctx, bucket, key)
+            if ctx.has_query("legal-hold"):
+                return self.get_object_legal_hold(ctx, bucket, key)
             return self.get_object(ctx, bucket, key)
         if m == "HEAD":
             return self.head_object(ctx, bucket, key)
@@ -435,6 +488,10 @@ class S3ApiHandlers:
                 return self.put_object_part(ctx, bucket, key)
             if ctx.has_query("tagging"):
                 return self.put_object_tagging(ctx, bucket, key)
+            if ctx.has_query("retention"):
+                return self.put_object_retention(ctx, bucket, key)
+            if ctx.has_query("legal-hold"):
+                return self.put_object_legal_hold(ctx, bucket, key)
             if ctx.header("x-amz-copy-source"):
                 return self.copy_object(ctx, bucket, key)
             return self.put_object(ctx, bucket, key)
@@ -859,6 +916,13 @@ class S3ApiHandlers:
             metadata["X-Amz-Tagging"] = ctx.header("x-amz-tagging")
         reader, size, sse_headers = self._apply_put_transforms(
             ctx, key, reader, size, metadata)
+        # object lock: explicit headers win; else the bucket default
+        from ..features import objectlock as olock
+        olock.retention_headers_from_request(ctx.header, metadata)
+        lock_cfg = self.bucket_meta.get(bucket).object_lock_xml
+        if lock_cfg and olock.MD_MODE not in metadata:
+            olock.DefaultRetention.from_config_xml(lock_cfg).apply_to(
+                metadata)
         versioned = self.bucket_meta.versioning_enabled(bucket)
         info = self.obj.put_object(
             bucket, key, reader, size,
@@ -1074,6 +1138,7 @@ class S3ApiHandlers:
         self.obj.get_bucket_info(bucket)
         vid = ctx.query1("versionId")
         versioned = self.bucket_meta.versioning_enabled(bucket)
+        self._enforce_object_lock(ctx, bucket, key, vid, versioned)
         headers = {}
         try:
             res = self.obj.delete_object(
@@ -1311,6 +1376,103 @@ class S3ApiHandlers:
     # ------------------------------------------------------------------
     # misc
     # ------------------------------------------------------------------
+
+    def _enforce_object_lock(self, ctx, bucket: str, key: str,
+                             version_id: str, versioned: bool) -> None:
+        """WORM enforcement on deletion (enforceRetentionForDeletion,
+        cmd/bucket-object-lock.go): only the removal of an actual
+        VERSION is gated — a versioned delete without versionId just
+        writes a marker."""
+        from ..features import objectlock as olock
+        if not self.bucket_meta.get(bucket).object_lock_xml:
+            return
+        if versioned and not version_id:
+            return                        # delete marker: always allowed
+        try:
+            info = self.obj.get_object_info(
+                bucket, key, GetOptions(version_id=version_id))
+        except oerr.ObjectApiError:
+            return
+        bypass = ctx.header("x-amz-bypass-governance-retention") == "true"
+        if bypass and self.iam is not None and ctx.cred and \
+                ctx.cred.access_key != self.root_cred.access_key:
+            if not self.iam.is_allowed(
+                    ctx.cred, "s3:BypassGovernanceRetention", bucket, key):
+                bypass = False
+        reason = olock.check_deletable(info.user_defined or {}, bypass)
+        if reason is not None:
+            raise S3Error("ObjectLocked", reason)
+
+    # --- ?retention / ?legal-hold subresources --------------------------
+
+    def get_object_retention(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetObjectRetention", bucket, key)
+        from ..features import objectlock as olock
+        info = self.obj.get_object_info(
+            bucket, key, GetOptions(version_id=ctx.query1("versionId")))
+        xml = olock.retention_xml(info.user_defined or {})
+        if not xml:
+            raise S3Error("NoSuchObjectLockConfiguration")
+        return HTTPResponse().with_xml(
+            b'<?xml version="1.0" encoding="UTF-8"?>' + xml.encode())
+
+    def put_object_retention(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObjectRetention", bucket, key)
+        from ..features import objectlock as olock
+        if not self.bucket_meta.get(bucket).object_lock_xml:
+            raise S3Error("InvalidRequest",
+                          "bucket is missing ObjectLockConfiguration")
+        mode, until = olock.parse_retention_xml(ctx.read_body())
+        if mode not in ("GOVERNANCE", "COMPLIANCE") or not until:
+            raise S3Error("InvalidArgument", "bad retention document")
+        vid = ctx.query1("versionId")
+        info = self.obj.get_object_info(bucket, key,
+                                        GetOptions(version_id=vid))
+        md = dict(info.user_defined or {})
+        # tightening is always allowed; loosening COMPLIANCE never is
+        cur_mode = md.get(olock.MD_MODE, "")
+        if cur_mode == "COMPLIANCE":
+            try:
+                if olock.parse_iso(until) < olock.parse_iso(
+                        md.get(olock.MD_RETAIN, until)):
+                    raise S3Error("ObjectLocked",
+                                  "cannot shorten COMPLIANCE retention")
+            except ValueError:
+                raise S3Error("InvalidArgument", "bad date") from None
+        md[olock.MD_MODE] = mode
+        md[olock.MD_RETAIN] = until
+        md["content-type"] = info.content_type
+        self.obj.update_object_metadata(bucket, key, md,
+                                        vid or info.version_id)
+        return HTTPResponse()
+
+    def get_object_legal_hold(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:GetObjectLegalHold", bucket, key)
+        from ..features import objectlock as olock
+        info = self.obj.get_object_info(
+            bucket, key, GetOptions(version_id=ctx.query1("versionId")))
+        return HTTPResponse().with_xml(
+            b'<?xml version="1.0" encoding="UTF-8"?>' +
+            olock.legal_hold_xml(info.user_defined or {}).encode())
+
+    def put_object_legal_hold(self, ctx, bucket, key) -> HTTPResponse:
+        self.authenticate(ctx, "s3:PutObjectLegalHold", bucket, key)
+        from ..features import objectlock as olock
+        if not self.bucket_meta.get(bucket).object_lock_xml:
+            raise S3Error("InvalidRequest",
+                          "bucket is missing ObjectLockConfiguration")
+        status = olock.parse_legal_hold_xml(ctx.read_body())
+        if status not in ("ON", "OFF"):
+            raise S3Error("InvalidArgument", "bad legal hold document")
+        vid = ctx.query1("versionId")
+        info = self.obj.get_object_info(bucket, key,
+                                        GetOptions(version_id=vid))
+        md = dict(info.user_defined or {})
+        md[olock.MD_HOLD] = status
+        md["content-type"] = info.content_type
+        self.obj.update_object_metadata(bucket, key, md,
+                                        vid or info.version_id)
+        return HTTPResponse()
 
     def _enforce_quota(self, bucket: str, incoming: int) -> None:
         q = self.bucket_meta.get_quota(bucket)

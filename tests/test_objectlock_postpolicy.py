@@ -1,0 +1,253 @@
+"""Object-lock (WORM) enforcement + POST-policy browser uploads over the
+live server (reference cmd/bucket-object-lock.go, cmd/postpolicyform.go
+test intents)."""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import hashlib
+import hmac
+import http.client
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.features import objectlock as olock
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+
+CREDS = Credentials("locktestkey1", "locktestsecret1")
+REGION = "us-east-1"
+
+
+class Client:
+    def __init__(self, port, creds=CREDS):
+        self.port, self.creds = port, creds
+
+    def request(self, method, path, query=None, body=b"", headers=None,
+                sign=True):
+        query = {k: [v] for k, v in (query or {}).items()}
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+        hdrs["host"] = f"127.0.0.1:{self.port}"
+        if sign:
+            payload_hash = hashlib.sha256(body).hexdigest()
+            hdrs = sig.sign_v4(method, urllib.parse.quote(path), query,
+                               hdrs, payload_hash, self.creds, REGION)
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=30)
+        conn.request(method, urllib.parse.quote(path) +
+                     (f"?{qs}" if qs else ""), body=body, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        out = {k.lower(): v for k, v in resp.getheaders()}
+        conn.close()
+        return resp.status, out, data
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lockdrives")
+    drives = [str(root / f"d{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
+                                   parity=2, block_size=1 << 16)
+    srv = S3Server(sets, creds=CREDS, region=REGION).start()
+    yield srv
+    srv.stop()
+    sets.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = Client(server.port)
+    st, _, _ = c.request(
+        "PUT", "/lockb",
+        headers={"x-amz-bucket-object-lock-enabled": "true"})
+    assert st == 200
+    # lock requires versioning
+    c.request("PUT", "/lockb", query={"versioning": ""},
+              body=b"<VersioningConfiguration><Status>Enabled"
+                   b"</Status></VersioningConfiguration>")
+    return c
+
+
+def _iso(dt_s):
+    return olock.iso(time.time() + dt_s)
+
+
+def test_compliance_retention_blocks_version_delete(client):
+    st, h, _ = client.request(
+        "PUT", "/lockb/worm1", body=b"keep me",
+        headers={olock.MD_MODE: "COMPLIANCE",
+                 olock.MD_RETAIN: _iso(3600)})
+    assert st == 200
+    vid = h.get("x-amz-version-id", "")
+    assert vid
+
+    # versioned delete (marker) is fine
+    st, _, _ = client.request("DELETE", "/lockb/worm1")
+    assert st == 204
+    # deleting the LOCKED VERSION is not
+    st, _, body = client.request("DELETE", "/lockb/worm1",
+                                 query={"versionId": vid})
+    assert st == 400 and b"ObjectLocked" in body
+    # bypass header cannot unlock COMPLIANCE
+    st, _, _ = client.request(
+        "DELETE", "/lockb/worm1", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert st == 400
+
+
+def test_governance_retention_bypass(client):
+    st, h, _ = client.request(
+        "PUT", "/lockb/gov1", body=b"gov",
+        headers={olock.MD_MODE: "GOVERNANCE",
+                 olock.MD_RETAIN: _iso(3600)})
+    assert st == 200
+    vid = h["x-amz-version-id"]
+    st, _, _ = client.request("DELETE", "/lockb/gov1",
+                              query={"versionId": vid})
+    assert st == 400
+    # root with the bypass header may delete
+    st, _, _ = client.request(
+        "DELETE", "/lockb/gov1", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert st == 204
+
+
+def test_legal_hold_subresource(client):
+    st, h, _ = client.request("PUT", "/lockb/held1", body=b"held")
+    vid = h["x-amz-version-id"]
+    st, _, _ = client.request(
+        "PUT", "/lockb/held1", query={"legal-hold": ""},
+        body=b"<LegalHold><Status>ON</Status></LegalHold>")
+    assert st == 200
+    st, _, body = client.request("GET", "/lockb/held1",
+                                 query={"legal-hold": ""})
+    assert st == 200 and b"<Status>ON</Status>" in body
+    st, _, _ = client.request("DELETE", "/lockb/held1",
+                              query={"versionId": vid})
+    assert st == 400
+    # release the hold, then delete succeeds
+    client.request("PUT", "/lockb/held1", query={"legal-hold": ""},
+                   body=b"<LegalHold><Status>OFF</Status></LegalHold>")
+    st, _, _ = client.request("DELETE", "/lockb/held1",
+                              query={"versionId": vid})
+    assert st == 204
+
+
+def test_retention_subresource_and_default(client):
+    # bucket default retention applies to new objects
+    cfg = (b"<ObjectLockConfiguration><ObjectLockEnabled>Enabled"
+           b"</ObjectLockEnabled><Rule><DefaultRetention>"
+           b"<Mode>GOVERNANCE</Mode><Days>1</Days>"
+           b"</DefaultRetention></Rule></ObjectLockConfiguration>")
+    st, _, _ = client.request("PUT", "/lockb", query={"object-lock": ""},
+                              body=cfg)
+    assert st == 200
+    st, h, _ = client.request("PUT", "/lockb/defret", body=b"d")
+    vid = h["x-amz-version-id"]
+    st, _, body = client.request("GET", "/lockb/defret",
+                                 query={"retention": ""})
+    assert st == 200 and b"GOVERNANCE" in body
+    # COMPLIANCE retention cannot be shortened
+    st, _, _ = client.request(
+        "PUT", "/lockb/defret", query={"retention": ""},
+        body=(f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>"
+              f"{_iso(7200)}</RetainUntilDate></Retention>").encode())
+    assert st == 200
+    st, _, _ = client.request(
+        "PUT", "/lockb/defret", query={"retention": ""},
+        body=(f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>"
+              f"{_iso(60)}</RetainUntilDate></Retention>").encode())
+    assert st == 400
+
+
+# ---------------------------------------------------------------------------
+# POST policy upload
+# ---------------------------------------------------------------------------
+
+def _post_form(client, bucket, fields, file_bytes,
+               filename="upload.bin"):
+    boundary = "testboundary12345"
+    parts = []
+    for k, v in fields.items():
+        parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                     f'name="{k}"\r\n\r\n{v}\r\n'.encode())
+    parts.append(
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"file\"; "
+        f'filename="{filename}"\r\n'
+        f"Content-Type: application/octet-stream\r\n\r\n".encode()
+        + file_bytes + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    conn = http.client.HTTPConnection("127.0.0.1", client.port,
+                                      timeout=30)
+    conn.request("POST", f"/{bucket}", body=body, headers={
+        "Host": f"127.0.0.1:{client.port}",
+        "Content-Type": f"multipart/form-data; boundary={boundary}",
+        "Content-Length": str(len(body))})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _signed_policy_fields(key_prefix, max_size=1 << 20):
+    t = _dt.datetime.now(_dt.timezone.utc)
+    datestamp = t.strftime("%Y%m%d")
+    amz_date = t.strftime("%Y%m%dT%H%M%SZ")
+    credential = f"{CREDS.access_key}/{datestamp}/{REGION}/s3/aws4_request"
+    policy = {
+        "expiration": (t + _dt.timedelta(hours=1)).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"),
+        "conditions": [
+            {"bucket": "postb"},
+            ["starts-with", "$key", key_prefix],
+            ["content-length-range", 1, max_size],
+            {"x-amz-credential": credential},
+            {"x-amz-date": amz_date},
+        ],
+    }
+    policy_b64 = base64.b64encode(
+        json.dumps(policy).encode()).decode()
+    skey = sig.signing_key(CREDS.secret_key, datestamp, REGION, "s3")
+    signature = hmac.new(skey, policy_b64.encode(),
+                         hashlib.sha256).hexdigest()
+    return {"key": key_prefix + "${filename}", "policy": policy_b64,
+            "x-amz-credential": credential, "x-amz-date": amz_date,
+            "x-amz-signature": signature, "bucket": "postb"}
+
+
+def test_post_policy_upload(client, server):
+    assert client.request("PUT", "/postb")[0] == 200
+    fields = _signed_policy_fields("uploads/")
+    st, _ = _post_form(client, "postb", fields, b"posted bytes",
+                       filename="hello.txt")
+    assert st == 204
+    st, _, got = client.request("GET", "/postb/uploads/hello.txt")
+    assert st == 200 and got == b"posted bytes"
+
+
+def test_post_policy_rejects_bad_signature(client):
+    fields = _signed_policy_fields("uploads/")
+    fields["x-amz-signature"] = "0" * 64
+    st, _ = _post_form(client, "postb", fields, b"nope")
+    assert st == 403
+
+
+def test_post_policy_enforces_conditions(client):
+    # key outside the allowed prefix
+    fields = _signed_policy_fields("uploads/")
+    fields["key"] = "outside/file.txt"
+    st, _ = _post_form(client, "postb", fields, b"x")
+    assert st == 403
+    # file too large
+    fields = _signed_policy_fields("uploads/", max_size=4)
+    st, _ = _post_form(client, "postb", fields, b"toolarge")
+    assert st == 400
