@@ -80,6 +80,24 @@ def main(argv: list[str] | None = None) -> int:
             print(f"bad --address {args.address!r}: port must be a "
                   "number (host:port)", file=sys.stderr)
             return 2
+        from .utils import ellipses as _ell
+        expanded = _ell.expand_args(args.drives)
+        if len(expanded) == 1:
+            # one path: FS backend, no erasure (reference newObjectLayer)
+            from .cluster import start_fs
+            node = start_fs(expanded[0], host or "0.0.0.0", port_n,
+                            creds, region=args.region)
+            print(f"MinIO-TPU FS node up at {node.url} "
+                  f"(access key {creds.access_key})")
+            import threading
+            stop = threading.Event()
+            signal.signal(signal.SIGTERM, lambda *a: stop.set())
+            signal.signal(signal.SIGINT, lambda *a: stop.set())
+            try:
+                stop.wait()
+            finally:
+                node.shutdown()
+            return 0
         node = start_single(args.drives, host or "0.0.0.0", port_n,
                             creds, **kw)
 

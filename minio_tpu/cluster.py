@@ -310,3 +310,39 @@ def start_single(drives: list[str], address: str = "127.0.0.1",
     paths = ellipses.expand_args(drives)
     spec = NodeSpec(address, port, paths)
     return ClusterNode([spec], 0, creds, **kw)
+
+
+class FSNode:
+    """Single-directory FS-backend server (reference newObjectLayer's
+    one-endpoint branch, cmd/server-main.go:524-532): no erasure, plain
+    file tree, full S3 surface."""
+
+    def __init__(self, root: str, address: str = "127.0.0.1",
+                 port: int = 0, creds: Optional[Credentials] = None,
+                 region: str = "us-east-1"):
+        from .object.fs import FSObjects
+        from .s3.credentials import global_credentials
+        from .s3.admin import mount_admin
+        from .iam import IAMSys
+        self.creds = creds or global_credentials()
+        self.object_layer = FSObjects(root)
+        iam = IAMSys(self.object_layer, root_cred=self.creds)
+        self.s3 = S3Server(self.object_layer, address=address, port=port,
+                           region=region, creds=self.creds, iam=iam)
+        self.iam = iam
+        iam.bucket_policy_lookup = \
+            lambda b: self.s3.api.bucket_meta.get(b).policy_json
+        mount_admin(self.s3)
+        self.s3.start()
+
+    @property
+    def url(self) -> str:
+        return self.s3.url
+
+    def shutdown(self) -> None:
+        self.s3.stop()
+
+
+def start_fs(root: str, address: str = "127.0.0.1", port: int = 0,
+             creds: Optional[Credentials] = None, **kw) -> FSNode:
+    return FSNode(root, address, port, creds, **kw)

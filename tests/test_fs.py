@@ -1,0 +1,142 @@
+"""FS backend: the full handler surface over a plain directory tree
+(reference fs-v1 + ExecObjectLayerTest's FS leg)."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import os
+import urllib.parse
+
+import pytest
+
+from minio_tpu.object import api_errors
+from minio_tpu.object.fs import FSObjects
+from minio_tpu.object.multipart import CompletePart
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+
+CREDS = Credentials("fstestkey123", "fstestsecret123")
+REGION = "us-east-1"
+
+
+@pytest.fixture()
+def fs(tmp_path):
+    return FSObjects(str(tmp_path / "fsroot"))
+
+
+def test_fs_object_lifecycle(fs):
+    fs.make_bucket("b")
+    assert fs.bucket_exists("b")
+    payload = os.urandom(3 << 20)
+    info = fs.put_object("b", "dir/obj.bin", payload)
+    assert info.etag == hashlib.md5(payload).hexdigest()
+    assert info.size == len(payload)
+
+    # the object is a PLAIN FILE at the expected path
+    assert open(os.path.join(fs.root, "b", "dir", "obj.bin"),
+                "rb").read() == payload
+
+    got_info, stream = fs.get_object("b", "dir/obj.bin")
+    assert b"".join(stream) == payload
+    _, stream = fs.get_object("b", "dir/obj.bin", offset=100, length=50)
+    assert b"".join(stream) == payload[100:150]
+
+    objs, prefixes, _ = fs.list_objects("b", delimiter="/")
+    assert prefixes == ["dir/"] and not objs
+    objs, _, _ = fs.list_objects("b", prefix="dir/")
+    assert [o.name for o in objs] == ["dir/obj.bin"]
+
+    fs.delete_object("b", "dir/obj.bin")
+    with pytest.raises(api_errors.ObjectNotFound):
+        fs.get_object_info("b", "dir/obj.bin")
+    # empty dirs pruned
+    assert not os.path.exists(os.path.join(fs.root, "b", "dir"))
+    fs.delete_bucket("b")
+    assert not fs.bucket_exists("b")
+
+
+def test_fs_metadata_and_update(fs):
+    fs.make_bucket("m")
+    fs.put_object("m", "o", b"x", opts=__import__(
+        "minio_tpu.object.engine", fromlist=["PutOptions"]).PutOptions(
+        metadata={"content-type": "text/css",
+                  "X-Amz-Meta-Color": "blue"}))
+    info = fs.get_object_info("m", "o")
+    assert info.content_type == "text/css"
+    assert info.user_defined["X-Amz-Meta-Color"] == "blue"
+    fs.update_object_metadata("m", "o", {"content-type": "text/css",
+                                         "X-Amz-Meta-Color": "red"})
+    assert fs.get_object_info("m", "o").user_defined[
+        "X-Amz-Meta-Color"] == "red"
+
+
+def test_fs_multipart(fs):
+    fs.make_bucket("mp")
+    uid = fs.new_multipart_upload("mp", "big")
+    p1 = os.urandom(5 << 20)
+    p2 = os.urandom(1 << 20)
+    i1 = fs.put_object_part("mp", "big", uid, 1, p1)
+    i2 = fs.put_object_part("mp", "big", uid, 2, p2)
+    parts = fs.list_object_parts("mp", "big", uid)
+    assert [p.number for p in parts] == [1, 2]
+    ups = fs.list_multipart_uploads("mp")
+    assert ups and ups[0]["upload_id"] == uid
+    info = fs.complete_multipart_upload(
+        "mp", "big", uid,
+        [CompletePart(1, i1.etag), CompletePart(2, i2.etag)])
+    assert info.etag.endswith("-2")
+    _, stream = fs.get_object("mp", "big")
+    assert b"".join(stream) == p1 + p2
+    assert fs.list_multipart_uploads("mp") == []
+
+
+def test_fs_over_http(tmp_path):
+    fs = FSObjects(str(tmp_path / "httproot"))
+    srv = S3Server(fs, creds=CREDS, region=REGION).start()
+    try:
+        def req(method, path, body=b"", query=None, headers=None):
+            query = {k: [v] for k, v in (query or {}).items()}
+            qs = urllib.parse.urlencode(
+                {k: v[0] for k, v in query.items()})
+            hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+            hdrs["host"] = f"127.0.0.1:{srv.port}"
+            hdrs = sig.sign_v4(method, urllib.parse.quote(path), query,
+                               hdrs, hashlib.sha256(body).hexdigest(),
+                               CREDS, REGION)
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request(method, urllib.parse.quote(path) +
+                         (f"?{qs}" if qs else ""), body=body,
+                         headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+
+        assert req("PUT", "/web")[0] == 200
+        payload = b"fs over http" * 1000
+        assert req("PUT", "/web/a/b.txt", body=payload)[0] == 200
+        st, got = req("GET", "/web/a/b.txt")
+        assert st == 200 and got == payload
+        st, body = req("GET", "/web", query={"list-type": "2"})
+        assert st == 200 and b"a/b.txt" in body
+        assert req("DELETE", "/web/a/b.txt")[0] == 204
+        assert req("GET", "/web/a/b.txt")[0] == 404
+    finally:
+        srv.stop()
+
+
+def test_fs_node_boot(tmp_path):
+    from minio_tpu.cluster import start_fs
+    node = start_fs(str(tmp_path / "fsnode"), port=0, creds=CREDS)
+    try:
+        node.object_layer.make_bucket("boot")
+        node.object_layer.put_object("boot", "k", b"v")
+        # IAM persists through the FS layer too
+        node.iam.add_user("fsuser", "fsusersecret1")
+        node.iam.attach_policy("readonly", user="fsuser")
+        assert node.iam.get_credentials("fsuser") is not None
+    finally:
+        node.shutdown()
