@@ -500,6 +500,9 @@ class S3ApiHandlers:
                 return self.new_multipart_upload(ctx, bucket, key)
             if ctx.has_query("uploadId"):
                 return self.complete_multipart_upload(ctx, bucket, key)
+            if ctx.has_query("select") or \
+                    ctx.query1("select-type") == "2":
+                return self.select_object_content(ctx, bucket, key)
         if m == "DELETE":
             if ctx.has_query("uploadId"):
                 return self.abort_multipart_upload(ctx, bucket, key)
@@ -1376,6 +1379,33 @@ class S3ApiHandlers:
     # ------------------------------------------------------------------
     # misc
     # ------------------------------------------------------------------
+
+    def select_object_content(self, ctx, bucket, key) -> HTTPResponse:
+        """SelectObjectContent: SQL over a CSV/JSON object streamed back
+        as AWS event-stream messages (reference pkg/s3select +
+        cmd/object-handlers.go SelectObjectContentHandler)."""
+        self.authenticate(ctx, "s3:GetObject", bucket, key)
+        from ..s3select import SelectRequest
+        from ..s3select.select import event_stream
+        req = SelectRequest.from_xml(ctx.read_body())
+        info = self.obj.get_object_info(bucket, key)
+        # decrypt/decompress transparently via the transformed GET path
+        from ..features import crypto as sse
+        md = info.user_defined or {}
+        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+            enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+            _, stream = self.obj.get_object(bucket, key, 0, info.size)
+            if enc is not None:
+                stream = sse.decrypt_stream(stream, enc[0], enc[1])
+            if md.get(sse.MK_COMPRESS):
+                stream = sse.decompress_stream(stream)
+            data = b"".join(stream)
+        else:
+            _, stream = self.obj.get_object(bucket, key, 0, info.size)
+            data = b"".join(stream)
+        return HTTPResponse(
+            headers={"Content-Type": "application/octet-stream"},
+            stream=event_stream(req, data))
 
     def _enforce_object_lock(self, ctx, bucket: str, key: str,
                              version_id: str, versioned: bool) -> None:

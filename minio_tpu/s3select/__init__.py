@@ -1,0 +1,5 @@
+"""S3 Select: SQL over CSV/JSON objects with event-stream responses
+(reference pkg/s3select — SQL parser/evaluator, format readers, message
+framing)."""
+
+from .select import SelectRequest, run_select  # noqa: F401

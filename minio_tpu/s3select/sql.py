@@ -1,0 +1,608 @@
+"""SQL subset for S3 Select (reference pkg/s3select/sql — hand-written
+parser + evaluator).
+
+Grammar:
+    SELECT projection FROM table [WHERE expr] [LIMIT n]
+    projection := * | expr [AS name] ("," expr [AS name])*
+    table      := S3Object[.path] [[AS] alias]
+    expr       := OR-chains of AND-chains of comparisons over terms
+    comparison := term (=|!=|<>|<|<=|>|>=) term | term [NOT] LIKE str
+                  | term [NOT] IN (lit, ...) | term [NOT] BETWEEN a AND b
+                  | term IS [NOT] NULL
+    term       := literal | column | alias.column | _N | -term
+                  | term (+|-|*|/|%) term | (expr)
+                  | COUNT(*) | SUM/AVG/MIN/MAX/COUNT(expr)
+                  | LOWER/UPPER/LENGTH/TRIM(expr) | CAST(expr AS type)
+
+Values are Python str/float/int/bool/None; comparisons coerce numerics
+like the reference's typed values.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+
+class SQLError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><>|<=|>=|!=|=|<|>|\(|\)|,|\*|/|\+|-|%|\.)
+""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "from", "where", "limit", "as", "and", "or", "not", "like",
+    "in", "between", "is", "null", "true", "false", "escape", "cast",
+}
+
+
+def tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    i = 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if not m:
+            raise SQLError(f"bad character {src[i]!r} at {i}")
+        i = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind == "ws":
+            continue
+        if kind == "ident" and text.lower() in _KEYWORDS:
+            out.append(("kw", text.lower()))
+        elif kind == "string":
+            out.append(("str", text[1:-1].replace("''", "'")))
+        elif kind == "qident":
+            out.append(("ident", text[1:-1].replace('""', '"')))
+        else:
+            out.append((kind, text))
+    out.append(("eof", ""))
+    return out
+
+
+# -- AST --------------------------------------------------------------------
+
+class Node:
+    pass
+
+
+class Lit(Node):
+    def __init__(self, v):
+        self.v = v
+
+
+class Col(Node):
+    def __init__(self, name: str):
+        self.name = name
+
+
+class Unary(Node):
+    def __init__(self, op, x):
+        self.op, self.x = op, x
+
+
+class Bin(Node):
+    def __init__(self, op, a, b):
+        self.op, self.a, self.b = op, a, b
+
+
+class Like(Node):
+    def __init__(self, x, pat, negate):
+        self.x, self.pat, self.negate = x, pat, negate
+
+
+class In(Node):
+    def __init__(self, x, items, negate):
+        self.x, self.items, self.negate = x, items, negate
+
+
+class Between(Node):
+    def __init__(self, x, lo, hi, negate):
+        self.x, self.lo, self.hi, self.negate = x, lo, hi, negate
+
+
+class IsNull(Node):
+    def __init__(self, x, negate):
+        self.x, self.negate = x, negate
+
+
+class Func(Node):
+    def __init__(self, name, args):
+        self.name, self.args = name, args
+
+
+class Agg(Node):
+    def __init__(self, name, arg):
+        self.name, self.arg = name, arg   # arg None = COUNT(*)
+
+
+class Query:
+    def __init__(self):
+        self.projections: list[tuple[Node, Optional[str]]] = []
+        self.star = False
+        self.alias = "s3object"
+        self.where: Optional[Node] = None
+        self.limit: Optional[int] = None
+
+    @property
+    def is_aggregate(self) -> bool:
+        return any(isinstance(e, Agg) for e, _ in self.projections)
+
+
+_AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+_SCALAR_FUNCS = {"lower", "upper", "length", "char_length",
+                 "character_length", "trim", "abs"}
+
+
+class Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect_kw(self, kw):
+        k, v = self.next()
+        if k != "kw" or v != kw:
+            raise SQLError(f"expected {kw.upper()}, got {v!r}")
+
+    def accept_kw(self, kw) -> bool:
+        k, v = self.peek()
+        if k == "kw" and v == kw:
+            self.i += 1
+            return True
+        return False
+
+    def accept_op(self, op) -> bool:
+        k, v = self.peek()
+        if k == "op" and v == op:
+            self.i += 1
+            return True
+        return False
+
+    # -- grammar ----------------------------------------------------------
+
+    def parse(self) -> Query:
+        q = Query()
+        self.expect_kw("select")
+        if self.accept_op("*"):
+            q.star = True
+        else:
+            while True:
+                e = self.expr()
+                alias = None
+                if self.accept_kw("as"):
+                    k, v = self.next()
+                    if k not in ("ident", "str"):
+                        raise SQLError("bad alias")
+                    alias = v
+                q.projections.append((e, alias))
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("from")
+        k, v = self.next()
+        if k != "ident" or v.lower() not in ("s3object", "s3objects"):
+            raise SQLError(f"FROM must be S3Object, got {v!r}")
+        while self.accept_op("."):
+            self.next()                      # S3Object.path: ignored
+        k, v = self.peek()
+        if k == "ident":
+            q.alias = v.lower()
+            self.next()
+        elif self.accept_kw("as"):
+            k, v = self.next()
+            q.alias = v.lower()
+        if self.accept_kw("where"):
+            q.where = self.expr()
+        if self.accept_kw("limit"):
+            k, v = self.next()
+            if k != "number":
+                raise SQLError("LIMIT needs a number")
+            q.limit = int(float(v))
+        k, v = self.peek()
+        if k != "eof":
+            raise SQLError(f"unexpected trailing {v!r}")
+        return q
+
+    def expr(self) -> Node:
+        return self.or_expr()
+
+    def or_expr(self) -> Node:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = Bin("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> Node:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = Bin("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> Node:
+        if self.accept_kw("not"):
+            return Unary("not", self.not_expr())
+        return self.comparison()
+
+    def comparison(self) -> Node:
+        left = self.additive()
+        negate = self.accept_kw("not")
+        k, v = self.peek()
+        if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            if negate:
+                raise SQLError("NOT before comparison operator")
+            self.next()
+            return Bin(v, left, self.additive())
+        if self.accept_kw("like"):
+            k, pat = self.next()
+            if k != "str":
+                raise SQLError("LIKE needs a string pattern")
+            esc = ""
+            if self.accept_kw("escape"):
+                k2, esc = self.next()
+                if k2 != "str" or len(esc) != 1:
+                    raise SQLError("ESCAPE needs a 1-char string")
+            return Like(left, _like_regex(pat, esc), negate)
+        if self.accept_kw("in"):
+            if not self.accept_op("("):
+                raise SQLError("IN needs a list")
+            items = []
+            while True:
+                items.append(self.additive())
+                if not self.accept_op(","):
+                    break
+            if not self.accept_op(")"):
+                raise SQLError("unclosed IN list")
+            return In(left, items, negate)
+        if self.accept_kw("between"):
+            lo = self.additive()
+            self.expect_kw("and")
+            hi = self.additive()
+            return Between(left, lo, hi, negate)
+        if self.accept_kw("is"):
+            neg = self.accept_kw("not")
+            self.expect_kw("null")
+            return IsNull(left, neg)
+        if negate:
+            raise SQLError("dangling NOT")
+        return left
+
+    def additive(self) -> Node:
+        left = self.multiplicative()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("+", "-"):
+                self.next()
+                left = Bin(v, left, self.multiplicative())
+            else:
+                return left
+
+    def multiplicative(self) -> Node:
+        left = self.unary()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("*", "/", "%"):
+                self.next()
+                left = Bin(v, left, self.unary())
+            else:
+                return left
+
+    def unary(self) -> Node:
+        if self.accept_op("-"):
+            return Unary("neg", self.unary())
+        if self.accept_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> Node:
+        k, v = self.next()
+        if k == "number":
+            f = float(v)
+            return Lit(int(f) if f.is_integer() and "." not in v
+                       and "e" not in v.lower() else f)
+        if k == "str":
+            return Lit(v)
+        if k == "kw" and v in ("true", "false"):
+            return Lit(v == "true")
+        if k == "kw" and v == "null":
+            return Lit(None)
+        if k == "kw" and v == "cast":
+            if not self.accept_op("("):
+                raise SQLError("CAST needs (")
+            e = self.expr()
+            self.expect_kw("as")
+            k2, typ = self.next()
+            if not self.accept_op(")"):
+                raise SQLError("unclosed CAST")
+            return Func("cast_" + typ.lower(), [e])
+        if k == "op" and v == "(":
+            e = self.expr()
+            if not self.accept_op(")"):
+                raise SQLError("unclosed (")
+            return e
+        if k == "ident":
+            name = v
+            if self.accept_op("("):
+                fname = name.lower()
+                if fname == "count" and self.accept_op("*"):
+                    if not self.accept_op(")"):
+                        raise SQLError("unclosed COUNT(*)")
+                    return Agg("count", None)
+                args = []
+                if not self.accept_op(")"):
+                    while True:
+                        args.append(self.expr())
+                        if not self.accept_op(","):
+                            break
+                    if not self.accept_op(")"):
+                        raise SQLError("unclosed function call")
+                if fname in _AGG_FUNCS:
+                    if len(args) != 1:
+                        raise SQLError(f"{fname} takes one argument")
+                    return Agg(fname, args[0])
+                if fname in _SCALAR_FUNCS:
+                    return Func(fname, args)
+                raise SQLError(f"unknown function {name}")
+            # alias.column / column / _N
+            if self.accept_op("."):
+                k2, v2 = self.next()
+                if k2 not in ("ident", "number"):
+                    raise SQLError("bad column reference")
+                return Col(str(v2))
+            return Col(name)
+        raise SQLError(f"unexpected token {v!r}")
+
+
+def _like_regex(pat: str, esc: str) -> "re.Pattern":
+    out = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if esc and c == esc and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def parse(sql: str) -> Query:
+    return Parser(tokenize(sql)).parse()
+
+
+# -- evaluation -------------------------------------------------------------
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _coerce_pair(a, b):
+    """Numeric comparison when both sides look numeric, else string."""
+    na, nb = _num(a), _num(b)
+    if na is not None and nb is not None:
+        return na, nb
+    if a is None or b is None:
+        return a, b
+    return str(a), str(b)
+
+
+def evaluate(node: Node, row: dict, alias: str) -> Any:
+    if isinstance(node, Lit):
+        return node.v
+    if isinstance(node, Col):
+        name = node.name
+        if name.lower() == alias:
+            return row
+        if name in row:
+            return row[name]
+        # case-insensitive fallback + positional _N
+        low = name.lower()
+        for k, v in row.items():
+            if k.lower() == low:
+                return v
+        if low.startswith("_") and low[1:].isdigit():
+            idx = int(low[1:]) - 1
+            vals = list(row.values())
+            return vals[idx] if 0 <= idx < len(vals) else None
+        return None
+    if isinstance(node, Unary):
+        v = evaluate(node.x, row, alias)
+        if node.op == "not":
+            return not _truthy(v)
+        n = _num(v)
+        return -n if n is not None else None
+    if isinstance(node, Bin):
+        if node.op == "and":
+            return _truthy(evaluate(node.a, row, alias)) and \
+                _truthy(evaluate(node.b, row, alias))
+        if node.op == "or":
+            return _truthy(evaluate(node.a, row, alias)) or \
+                _truthy(evaluate(node.b, row, alias))
+        a = evaluate(node.a, row, alias)
+        b = evaluate(node.b, row, alias)
+        if node.op in ("+", "-", "*", "/", "%"):
+            na, nb = _num(a), _num(b)
+            if na is None or nb is None:
+                return None
+            try:
+                if node.op == "+":
+                    r = na + nb
+                elif node.op == "-":
+                    r = na - nb
+                elif node.op == "*":
+                    r = na * nb
+                elif node.op == "/":
+                    r = na / nb
+                else:
+                    r = na % nb
+            except ZeroDivisionError:
+                return None
+            return int(r) if float(r).is_integer() else r
+        a, b = _coerce_pair(a, b)
+        if a is None or b is None:
+            return False
+        if node.op == "=":
+            return a == b
+        if node.op in ("!=", "<>"):
+            return a != b
+        if node.op == "<":
+            return a < b
+        if node.op == "<=":
+            return a <= b
+        if node.op == ">":
+            return a > b
+        if node.op == ">=":
+            return a >= b
+    if isinstance(node, Like):
+        v = evaluate(node.x, row, alias)
+        ok = v is not None and bool(node.pat.match(str(v)))
+        return ok != node.negate
+    if isinstance(node, In):
+        v = evaluate(node.x, row, alias)
+        hit = False
+        for item in node.items:
+            a, b = _coerce_pair(v, evaluate(item, row, alias))
+            if a is not None and a == b:
+                hit = True
+                break
+        return hit != node.negate
+    if isinstance(node, Between):
+        v = evaluate(node.x, row, alias)
+        lo = evaluate(node.lo, row, alias)
+        hi = evaluate(node.hi, row, alias)
+        a, l2 = _coerce_pair(v, lo)
+        a2, h2 = _coerce_pair(v, hi)
+        ok = (a is not None and l2 is not None and h2 is not None
+              and l2 <= a and a2 <= h2)
+        return ok != node.negate
+    if isinstance(node, IsNull):
+        v = evaluate(node.x, row, alias)
+        return (v is None) != node.negate
+    if isinstance(node, Func):
+        args = [evaluate(a, row, alias) for a in node.args]
+        return _scalar_fn(node.name, args)
+    if isinstance(node, Agg):
+        raise SQLError("aggregate in row context")
+    raise SQLError(f"cannot evaluate {node!r}")
+
+
+def _truthy(v) -> bool:
+    return bool(v) and v is not None
+
+
+def _scalar_fn(name: str, args: list):
+    a = args[0] if args else None
+    if name == "lower":
+        return str(a).lower() if a is not None else None
+    if name == "upper":
+        return str(a).upper() if a is not None else None
+    if name in ("length", "char_length", "character_length"):
+        return len(str(a)) if a is not None else None
+    if name == "trim":
+        return str(a).strip() if a is not None else None
+    if name == "abs":
+        n = _num(a)
+        return abs(n) if n is not None else None
+    if name.startswith("cast_"):
+        typ = name[5:]
+        if a is None:
+            return None
+        if typ in ("int", "integer"):
+            try:
+                return int(float(a))
+            except (TypeError, ValueError):
+                raise SQLError(f"cannot cast {a!r} to int") from None
+        if typ in ("float", "double", "decimal", "numeric"):
+            n = _num(a)
+            if n is None:
+                raise SQLError(f"cannot cast {a!r} to float")
+            return n
+        if typ in ("string", "varchar", "char", "text"):
+            return str(a)
+        if typ in ("bool", "boolean"):
+            return str(a).lower() in ("true", "1")
+        raise SQLError(f"unknown cast type {typ}")
+    raise SQLError(f"unknown function {name}")
+
+
+class Aggregator:
+    """Accumulates aggregate projections over the row stream."""
+
+    def __init__(self, query: Query):
+        self.q = query
+        self.state = []
+        for e, _ in query.projections:
+            if isinstance(e, Agg):
+                self.state.append({"n": 0, "sum": 0.0, "min": None,
+                                   "max": None})
+            else:
+                self.state.append(None)
+
+    def feed(self, row: dict) -> None:
+        for (e, _), st in zip(self.q.projections, self.state):
+            if not isinstance(e, Agg):
+                continue
+            if e.arg is None:                  # COUNT(*)
+                st["n"] += 1
+                continue
+            v = evaluate(e.arg, row, self.q.alias)
+            if v is None:
+                continue
+            st["n"] += 1
+            n = _num(v)
+            if n is not None:
+                st["sum"] += n
+            cur = v if n is None else n
+            if st["min"] is None or cur < st["min"]:
+                st["min"] = cur
+            if st["max"] is None or cur > st["max"]:
+                st["max"] = cur
+
+    def result(self) -> dict:
+        out = {}
+        for i, ((e, alias), st) in enumerate(
+                zip(self.q.projections, self.state)):
+            name = alias or f"_{i + 1}"
+            if not isinstance(e, Agg):
+                out[name] = None
+                continue
+            if e.name == "count":
+                v = st["n"]
+            elif e.name == "sum":
+                v = st["sum"] if st["n"] else None
+            elif e.name == "avg":
+                v = st["sum"] / st["n"] if st["n"] else None
+            elif e.name == "min":
+                v = st["min"]
+            else:
+                v = st["max"]
+            if isinstance(v, float) and v.is_integer():
+                v = int(v)
+            out[name] = v
+        return out
