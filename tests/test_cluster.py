@@ -20,6 +20,19 @@ from minio_tpu.utils import ellipses
 CREDS = Credentials(access_key="clusterkey", secret_key="clustersecret")
 
 
+def _wait_remotes_online(nodes, timeout=30.0):
+    """After a node restart, wait for every peer's transport probe to
+    re-admit it (1 s probe interval; generous timeout for the 1-core CI
+    host where the whole suite competes for the clock)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(rc.is_online()
+               for n in nodes for rc in n._remote_clients):
+            return
+        time.sleep(0.2)
+    raise AssertionError("remote drives did not come back online")
+
+
 def _free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -116,7 +129,7 @@ def test_get_survives_node_loss_and_heals(cluster):
         victim._start_server("us-east-1", None)
 
     # drives are back; heal rewrites anything the dead node missed
-    time.sleep(1.5)  # reconnect probe interval is 1 s
+    _wait_remotes_online(cluster)
     res = c.object_layer.heal_object("lossy", "obj")
     _, stream = c.object_layer.get_object("lossy", "obj")
     assert b"".join(stream) == payload
@@ -136,7 +149,7 @@ def test_put_during_node_loss_then_heal(cluster):
         a.object_layer.put_object("wounded", "obj", payload)
     finally:
         victim._start_server("us-east-1", None)
-    time.sleep(1.5)  # reconnect probe interval is 1 s
+    _wait_remotes_online(cluster)
     d.object_layer.heal_object("wounded", "obj")
 
     # node 2's shards must now be real: lose node 1 instead and read
