@@ -17,9 +17,16 @@ import time
 import uuid
 from typing import Optional, Protocol
 
-ACQUIRE_TIMEOUT = 1.0          # per-broadcast collect window
 RETRY_INTERVAL_MAX = 0.25      # jittered sleep between attempts
 REFRESH_INTERVAL = 30.0        # LOCK_VALIDITY / 4: keep long holds alive
+
+# Per-broadcast collect window, self-tuning: when lockers answer slowly
+# the window grows instead of thrashing retries; when they answer fast
+# it shrinks back (the reference runs dsync under a dynamicTimeout,
+# cmd/dynamic-timeouts.go + cmd/namespace-lock.go).
+from ..utils.dyntimeout import DynamicTimeout  # noqa: E402
+
+ACQUIRE_TIMEOUT_DYN = DynamicTimeout(1.0, 0.25, 15.0)
 
 
 class NetLocker(Protocol):
@@ -162,19 +169,28 @@ class DRWMutex:
 
         # collect answers up to the acquire window; stop early once the
         # outcome is decided either way
-        deadline = time.monotonic() + ACQUIRE_TIMEOUT
+        window = ACQUIRE_TIMEOUT_DYN.timeout()
+        t0 = time.monotonic()
+        deadline = t0 + window
         answers = 0
+        timed_out = False
         while answers < n:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                timed_out = True
                 break
             if not pending.acquire(timeout=remaining):
+                timed_out = True
                 break
             answers += 1
             yes = sum(1 for g in granted if g)
             no = sum(1 for g in granted if g is False)
             if yes >= need or no > n - need:
                 break
+        if timed_out and answers < n:
+            ACQUIRE_TIMEOUT_DYN.log_failure()
+        else:
+            ACQUIRE_TIMEOUT_DYN.log_success(time.monotonic() - t0)
 
         if sum(1 for g in granted if g) >= need:
             return True

@@ -71,9 +71,13 @@ class DiskMonitor:
         return admitted
 
     def _probe_slot(self, i: int, j: int) -> bool:
+        from ..storage.diskid_check import DiskIDCheck
         eng = self.sets.sets[i]
         cur = eng.disks[j]
         want_uuid = self.sets.format_ref.sets[i][j]
+
+        def unwrap(d):
+            return getattr(d, "inner", d)
 
         def fmt_of(d):
             """format, or None (fresh), or 'err' (unreachable)."""
@@ -86,11 +90,11 @@ class DiskMonitor:
                 return "err"
 
         if cur is not None:
-            fmt = fmt_of(cur)
+            fmt = fmt_of(unwrap(cur))
             if fmt not in (None, "err") and fmt.this == want_uuid \
                     and fmt.id == self.sets.deployment_id:
                 return False         # healthy and in place
-            if fmt == "err" and not isinstance(cur, XLStorage):
+            if fmt == "err" and not isinstance(unwrap(cur), XLStorage):
                 return False         # remote hiccup: transport re-probes
 
         # slot is dead, wiped, or replaced: (re)open from its source
@@ -101,7 +105,7 @@ class DiskMonitor:
             except serr.StorageError:
                 return False
         else:
-            drive = src if src is not None else cur
+            drive = unwrap(src) if src is not None else unwrap(cur)
         if drive is None:
             return False
 
@@ -112,9 +116,9 @@ class DiskMonitor:
         if fmt is not None:
             if fmt.this != want_uuid or fmt.id != self.sets.deployment_id:
                 return False         # foreign drive: never adopt
-            if cur is drive:
+            if cur is not None and unwrap(cur) is drive:
                 return False
-            eng.disks[j] = drive
+            eng.disks[j] = DiskIDCheck(drive, want_uuid)
             return True
 
         # fresh/wiped drive: format it for this slot, admit, sweep-heal
@@ -124,7 +128,7 @@ class DiskMonitor:
             write_format_to(drive, nf)
         except serr.StorageError:
             return False
-        eng.disks[j] = drive
+        eng.disks[j] = DiskIDCheck(drive, want_uuid)
         self.healed_slots.append((i, j))
         try:
             self.heal_set_sweep(i)

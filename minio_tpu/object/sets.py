@@ -161,12 +161,18 @@ class ErasureSets:
             if d is not None and f is not None:
                 by_uuid[f.this] = d
                 src_by_uuid[f.this] = sources[idx]
+        from ..storage.diskid_check import DiskIDCheck
         ns = ns_lock or NSLockMap()
         sets = []
         slot_sources = []
         for i in range(set_count):
-            set_drives = [by_uuid.get(ref_sets[i][j])
-                          for j in range(set_drive_count)]
+            # every drive is identity-guarded: a swap/reformat behind a
+            # running set reads as DiskStale, never as wrong shards
+            # (cmd/xl-storage-disk-id-check.go)
+            set_drives = [
+                DiskIDCheck(by_uuid[ref_sets[i][j]], ref_sets[i][j])
+                if ref_sets[i][j] in by_uuid else None
+                for j in range(set_drive_count)]
             # per-slot source: the drive that attested the slot's UUID,
             # else the position-derived input (same heuristic the
             # format-heal above uses for fresh replacements)

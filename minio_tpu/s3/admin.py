@@ -146,6 +146,14 @@ class AdminHandlers:
         if sub == "top/locks" and m == "GET":
             self._auth(ctx, "admin:TopLocksInfo")
             return self._json(self.top_locks())
+        if sub == "trace" and m == "GET":
+            self._auth(ctx, "admin:ServerTrace")
+            n = int(ctx.query1("count", "0") or 0)
+            idle = float(ctx.query1("idle", "10") or 10)
+            return HTTPResponse(
+                headers={"Content-Type": "application/x-ndjson"},
+                stream=self.api.trace.stream(max_entries=n,
+                                             idle_timeout=idle))
 
         if sub == "heal" and m == "POST":
             self._auth(ctx, "admin:Heal")

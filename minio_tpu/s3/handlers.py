@@ -183,6 +183,8 @@ class S3ApiHandlers:
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPool
+        from .trace import TraceSys
+        self.trace = TraceSys()   # request tracing + audit hub
         from ..features import crypto as sse
         self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(

@@ -125,17 +125,33 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                 return
             # admin/health/metrics routers get first crack at the path
             ctx = self._snapshot()
+            import time as _time
+            t0 = _time.perf_counter()
+            status = [500]
+
+            def respond(resp):
+                status[0] = resp.status
+                self._respond(resp)
+
             try:
                 for prefix, router in extra_routers:
                     if self.path.startswith(prefix):
-                        self._respond(router(ctx))
+                        respond(router(ctx))
                         return
-                self._respond(api.handle(ctx))
+                respond(api.handle(ctx))
             finally:
                 # keep-alive hygiene: any request-body bytes the handler
                 # didn't consume (auth failure, early error, streaming
                 # trailer) would otherwise be parsed as the next request
                 ctx.body_stream.drain()
+                if api.trace is not None:
+                    try:
+                        api.trace.record(
+                            self.command, ctx.req.path, ctx.req.raw_query,
+                            status[0], _time.perf_counter() - t0,
+                            caller=self.client_address[0])
+                    except Exception:  # noqa: BLE001 — tracing is passive
+                        pass
 
         do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
 

@@ -1,0 +1,82 @@
+"""HTTP request tracing + audit logging.
+
+The reference wraps every route in httpTraceAll (cmd/http-tracer.go),
+publishes trace entries to pkg/pubsub for `mc admin trace` (admin /trace
+endpoint + peer fan-out), and ships structured audit entries to webhook
+targets (cmd/logger/audit.go). Here: a middleware recording method/path/
+status/duration/caller, an in-process hub, an admin streaming endpoint,
+and an optional audit webhook.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from ..utils.pubsub import PubSub
+
+
+class TraceSys:
+    def __init__(self, node_name: str = ""):
+        self.hub = PubSub()
+        self.node = node_name
+        self.audit_webhook: str = ""           # POST target for audit
+        self.requests_total = 0
+        self.errors_total = 0
+        self._mu = threading.Lock()
+
+    # -- middleware --------------------------------------------------------
+
+    def record(self, method: str, path: str, query: str, status: int,
+               duration_s: float, caller: str = "",
+               api: str = "") -> None:
+        with self._mu:
+            self.requests_total += 1
+            if status >= 500:
+                self.errors_total += 1
+        entry = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "node": self.node,
+            "api": api,
+            "method": method,
+            "path": path,
+            "query": query,
+            "status": status,
+            "duration_ms": round(duration_s * 1e3, 3),
+            "caller": caller,
+        }
+        if self.hub.subscriber_count:
+            self.hub.publish(entry)
+        if self.audit_webhook:
+            threading.Thread(target=self._ship_audit, args=(entry,),
+                             daemon=True).start()
+
+    def _ship_audit(self, entry: dict) -> None:
+        try:
+            req = urllib.request.Request(
+                self.audit_webhook, data=json.dumps(entry).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=3.0) as r:
+                r.read()
+        except Exception:  # noqa: BLE001 — audit is best-effort
+            pass
+
+    # -- admin streaming endpoint -----------------------------------------
+
+    def stream(self, max_entries: int = 0, idle_timeout: float = 10.0):
+        """Yields JSON-line trace entries as they happen (admin /trace);
+        ends after idle_timeout with no traffic or max_entries sent."""
+        sent = 0
+        with self.hub.subscribe() as sub:
+            while True:
+                entry = sub.get(timeout=idle_timeout)
+                if entry is None:
+                    return
+                yield (json.dumps(entry) + "\n").encode()
+                sent += 1
+                if max_entries and sent >= max_entries:
+                    return

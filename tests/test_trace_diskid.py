@@ -1,0 +1,134 @@
+"""Tracing/audit, pub/sub, dynamic timeouts, disk-ID guard."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.storage import errors as serr
+from minio_tpu.storage.diskid_check import DiskIDCheck
+from minio_tpu.storage.format import (FormatErasureV3, read_format_from,
+                                      write_format_to)
+from minio_tpu.storage.xl_storage import XLStorage
+from minio_tpu.utils.dyntimeout import DynamicTimeout
+from minio_tpu.utils.pubsub import PubSub
+
+
+def test_pubsub_fanout_and_drop():
+    hub = PubSub(buffer=2)
+    s1 = hub.subscribe()
+    s2 = hub.subscribe()
+    hub.publish("a")
+    assert s1.get(0.1) == "a" and s2.get(0.1) == "a"
+    s2.close()
+    assert hub.subscriber_count == 1
+    # overflow drops, publisher never blocks
+    for i in range(5):
+        hub.publish(i)
+    assert s1.get(0.1) == 0 and s1.get(0.1) == 1
+    s1.close()
+
+
+def test_dynamic_timeout_adjusts():
+    dt = DynamicTimeout(1.0, 0.1, 8.0)
+    for _ in range(16):
+        dt.log_failure()
+    assert dt.timeout() == pytest.approx(1.25)
+    for _ in range(64):
+        dt.log_success(0.01)
+    assert dt.timeout() < 1.25
+    assert dt.timeout() >= 0.1
+
+
+def test_diskid_check_guards_swapped_drive(tmp_path):
+    d = XLStorage(str(tmp_path / "drv"))
+    fmt = FormatErasureV3(id="0b671633-6e34-4f31-8ad0-1f8f43d29b88",
+                          this="11111111-2222-3333-4444-555555555555",
+                          sets=[["11111111-2222-3333-4444-555555555555"]])
+    write_format_to(d, fmt)
+    guard = DiskIDCheck(d, fmt.this, interval=0.0)  # recheck every call
+    guard.make_vol("bkt")
+    guard.write_all("bkt", "x", b"1")
+    assert guard.read_all("bkt", "x") == b"1"
+
+    # reformat the drive behind the wrapper: calls must fail DiskStale
+    import dataclasses
+    foreign = dataclasses.replace(
+        fmt, this="99999999-2222-3333-4444-555555555555",
+        sets=[["99999999-2222-3333-4444-555555555555"]])
+    write_format_to(d, foreign)
+    with pytest.raises(serr.DiskStale):
+        guard.read_all("bkt", "x")
+
+
+def test_trace_records_requests_and_streams(tmp_path):
+    from minio_tpu.object.fs import FSObjects
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+
+    creds = Credentials("tracetest123", "tracesecret123")
+    fs = FSObjects(str(tmp_path / "tr"))
+    srv = S3Server(fs, creds=creds).start()
+    try:
+        entries = []
+        done = threading.Event()
+
+        def consume():
+            for line in srv.api.trace.stream(max_entries=2,
+                                             idle_timeout=5.0):
+                entries.append(json.loads(line))
+            done.set()
+
+        threading.Thread(target=consume, daemon=True).start()
+        time.sleep(0.1)
+
+        def req(method, path, body=b""):
+            hdrs = {"host": f"127.0.0.1:{srv.port}"}
+            hdrs = sig.sign_v4(method, path, {}, hdrs,
+                               hashlib.sha256(body).hexdigest(), creds,
+                               "us-east-1")
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request(method, path, body=body, headers=hdrs)
+            r = conn.getresponse()
+            r.read()
+            conn.close()
+            return r.status
+
+        assert req("PUT", "/trb") == 200
+        assert req("PUT", "/trb/o", b"x") == 200
+        assert done.wait(10)
+        assert len(entries) == 2
+        assert entries[0]["method"] == "PUT"
+        assert entries[0]["path"] == "/trb"
+        assert entries[0]["status"] == 200
+        assert entries[0]["duration_ms"] > 0
+        assert srv.api.trace.requests_total >= 2
+    finally:
+        srv.stop()
+
+
+def test_wiped_drive_still_heals_through_guard(tmp_path):
+    """DiskIDCheck must not break the new-disk heal flow."""
+    import shutil
+    from minio_tpu.object.background import DiskMonitor
+    from minio_tpu.object.sets import ErasureSets
+    drives = [str(tmp_path / f"d{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
+                                   parity=2, block_size=1 << 16)
+    sets.make_bucket("b")
+    sets.put_object("b", "o", b"guarded" * 1000)
+    shutil.rmtree(drives[1])
+    mon = DiskMonitor(sets)
+    assert mon.scan_once() == 1
+    _, stream = sets.get_object("b", "o")
+    assert b"".join(stream) == b"guarded" * 1000
+    assert mon.scan_once() == 0
+    sets.close()
