@@ -1,0 +1,19 @@
+"""Gateway backends: alternate ObjectLayers over foreign storage
+(reference cmd/gateway-interface.go + cmd/gateway/{nas,s3,...}).
+
+A gateway returns an ObjectLayer; the whole S3/IAM/admin stack mounts on
+top unchanged. `new_gateway(kind, ...)` is the registry
+(cmd/gateway-main.go)."""
+
+from __future__ import annotations
+
+
+def new_gateway(kind: str, **kw):
+    if kind == "nas":
+        from .nas import NASGateway
+        return NASGateway(**kw).object_layer()
+    if kind == "s3":
+        from .s3 import S3Gateway
+        return S3Gateway(**kw).object_layer()
+    raise ValueError(f"unknown gateway kind {kind!r} "
+                     "(supported: nas, s3)")

@@ -1,0 +1,18 @@
+"""NAS gateway: S3 API over a shared filesystem mount.
+
+The reference's cmd/gateway/nas (121 LoC) is the FS backend pointed at a
+network mount — same here: the gateway IS FSObjects over the given path,
+multi-instance-safe to the degree the underlying mount's rename/fsync
+semantics allow (identical caveat to the reference)."""
+
+from __future__ import annotations
+
+from ..object.fs import FSObjects
+
+
+class NASGateway:
+    def __init__(self, path: str):
+        self.path = path
+
+    def object_layer(self) -> FSObjects:
+        return FSObjects(self.path)

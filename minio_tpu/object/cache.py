@@ -1,0 +1,180 @@
+"""Disk cache ObjectLayer wrapper (cmd/disk-cache.go cacheObjects).
+
+GETs are served from a local cache directory when the cached copy's ETag
+still matches the backend; misses read through and populate. Mutations
+invalidate. An LRU purge keeps the cache under a high-watermark fraction
+of its budget (cmd/disk-cache-backend.go purge semantics). Entry
+integrity is pinned with a SHA-256 over the cached bytes, verified on
+every cache hit (the cache-backend bitrot analog).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Iterator, Optional
+
+from . import api_errors
+from .engine import GetOptions, PutOptions
+
+DEFAULT_BUDGET = 1 << 30
+HIGH_WATERMARK = 0.9
+LOW_WATERMARK = 0.7
+MAX_ENTRY_FRACTION = 0.1
+
+
+class CacheObjects:
+    """ObjectLayer wrapper with a read cache on a local path."""
+
+    def __init__(self, inner, cache_dir: str,
+                 budget_bytes: int = DEFAULT_BUDGET):
+        self.inner = inner
+        self.dir = os.path.abspath(cache_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.budget = budget_bytes
+        self.hits = 0
+        self.misses = 0
+        self._mu = threading.Lock()
+
+    # everything not overridden passes straight through
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # -- entry layout ------------------------------------------------------
+
+    def _entry_dir(self, bucket: str, key: str) -> str:
+        h = hashlib.sha256(f"{bucket}/{key}".encode()).hexdigest()
+        return os.path.join(self.dir, h[:2], h)
+
+    def _load_entry(self, bucket: str, key: str) -> Optional[dict]:
+        d = self._entry_dir(bucket, key)
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save(self, bucket: str, key: str, info, data: bytes) -> None:
+        if len(data) > self.budget * MAX_ENTRY_FRACTION:
+            return                     # too big to cache
+        d = self._entry_dir(bucket, key)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "data"), "wb") as f:
+            f.write(data)
+        meta = {"etag": info.etag, "size": len(data),
+                "content_type": info.content_type,
+                "user_defined": dict(info.user_defined or {}),
+                "mod_time": info.mod_time,
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "cached_at": time.time()}
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        self._purge_if_needed()
+
+    def _drop(self, bucket: str, key: str) -> None:
+        shutil.rmtree(self._entry_dir(bucket, key), ignore_errors=True)
+
+    # -- LRU purge ---------------------------------------------------------
+
+    def _usage(self) -> int:
+        total = 0
+        for root, _, files in os.walk(self.dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+        return total
+
+    def _purge_if_needed(self) -> None:
+        with self._mu:
+            if self._usage() < self.budget * HIGH_WATERMARK:
+                return
+            entries = []
+            for sub in os.listdir(self.dir):
+                subdir = os.path.join(self.dir, sub)
+                if not os.path.isdir(subdir):
+                    continue
+                for h in os.listdir(subdir):
+                    d = os.path.join(subdir, h)
+                    try:
+                        with open(os.path.join(d, "meta.json")) as f:
+                            meta = json.load(f)
+                        entries.append((meta.get("cached_at", 0), d,
+                                        meta.get("size", 0)))
+                    except (OSError, ValueError):
+                        shutil.rmtree(d, ignore_errors=True)
+            entries.sort()                    # oldest first
+            usage = self._usage()
+            target = self.budget * LOW_WATERMARK
+            for _, d, size in entries:
+                if usage <= target:
+                    break
+                shutil.rmtree(d, ignore_errors=True)
+                usage -= size
+
+    # -- ObjectLayer overrides ---------------------------------------------
+
+    def get_object(self, bucket: str, key: str, offset: int = 0,
+                   length: int = -1,
+                   opts: Optional[GetOptions] = None):
+        if opts is not None and getattr(opts, "version_id", ""):
+            return self.inner.get_object(bucket, key, offset, length,
+                                         opts)
+        info = self.inner.get_object_info(bucket, key, opts)
+        entry = self._load_entry(bucket, key)
+        d = self._entry_dir(bucket, key)
+        if entry is not None and entry.get("etag") == info.etag:
+            try:
+                with open(os.path.join(d, "data"), "rb") as f:
+                    data = f.read()
+            except OSError:
+                data = None
+            if data is not None and hashlib.sha256(
+                    data).hexdigest() == entry.get("sha256"):
+                self.hits += 1
+                end = len(data) if length < 0 else offset + length
+                chunk = data[offset:end]
+                return info, iter([chunk])
+            self._drop(bucket, key)           # bitrot in the cache
+        self.misses += 1
+        if offset == 0 and length < 0 or (offset == 0
+                                          and length == info.size):
+            info2, stream = self.inner.get_object(bucket, key, 0, -1,
+                                                  opts)
+            data = b"".join(stream)
+            self._save(bucket, key, info2, data)
+            return info2, iter([data])
+        # ranged miss: read through without populating (the reference
+        # caches ranges separately; we keep whole-object entries only)
+        return self.inner.get_object(bucket, key, offset, length, opts)
+
+    def put_object(self, bucket: str, key: str, reader, size: int = -1,
+                   opts: Optional[PutOptions] = None):
+        self._drop(bucket, key)
+        return self.inner.put_object(bucket, key, reader, size, opts)
+
+    def delete_object(self, bucket: str, key: str, version_id: str = "",
+                      versioned: bool = False):
+        self._drop(bucket, key)
+        return self.inner.delete_object(bucket, key, version_id,
+                                        versioned)
+
+    def delete_objects(self, bucket: str, objects: list[str]):
+        for o in objects:
+            self._drop(bucket, o)
+        return self.inner.delete_objects(bucket, objects)
+
+    def update_object_metadata(self, bucket: str, key: str,
+                               metadata: dict, version_id: str = ""):
+        self._drop(bucket, key)
+        return self.inner.update_object_metadata(bucket, key, metadata,
+                                                 version_id)
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "usage": self._usage(), "budget": self.budget}

@@ -1,0 +1,179 @@
+"""Minimal SigV4 S3 client (used by the S3 gateway and replication).
+
+Covers the verbs the gateway's ObjectLayer surface needs: bucket CRUD +
+list, object put/get/stat/delete, ListObjectsV2. Streaming GET bodies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+from email.utils import parsedate_to_datetime
+from typing import Iterator, Optional
+
+from ..s3 import signature as sig
+from ..s3.credentials import Credentials
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _findall(el, tag):
+    return list(el.findall(tag)) + list(el.findall(_NS + tag))
+
+
+def _text(el, tag, default=""):
+    r = el.find(tag)
+    if r is None:
+        r = el.find(_NS + tag)
+    return (r.text or "") if r is not None and r.text is not None \
+        else default
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, code: str, body: bytes = b""):
+        super().__init__(f"{status} {code}")
+        self.status = status
+        self.code = code
+        self.body = body
+
+
+class S3Client:
+    def __init__(self, host: str, port: int, creds: Credentials,
+                 region: str = "us-east-1", timeout: float = 60.0):
+        self.host, self.port = host, port
+        self.creds = creds
+        self.region = region
+        self.timeout = timeout
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 query: Optional[dict] = None, body: bytes = b"",
+                 headers: Optional[dict] = None, stream: bool = False):
+        query = {k: [v] for k, v in (query or {}).items()}
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+        hdrs["host"] = f"{self.host}:{self.port}"
+        hdrs = sig.sign_v4(method, urllib.parse.quote(path), query, hdrs,
+                           hashlib.sha256(body).hexdigest(), self.creds,
+                           self.region)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        conn.request(method, urllib.parse.quote(path) +
+                     (f"?{qs}" if qs else ""), body=body, headers=hdrs)
+        resp = conn.getresponse()
+        if resp.status >= 300:
+            data = resp.read()
+            conn.close()
+            code = ""
+            try:
+                code = _text(ET.fromstring(data), "Code")
+            except ET.ParseError:
+                pass
+            raise S3ClientError(resp.status, code, data)
+        if stream:
+            return conn, resp
+        data = resp.read()
+        out_headers = {k.lower(): v for k, v in resp.getheaders()}
+        conn.close()
+        return out_headers, data
+
+    # -- buckets -----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        self._request("PUT", f"/{bucket}")
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._request("DELETE", f"/{bucket}")
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            self._request("HEAD", f"/{bucket}")
+            return True
+        except S3ClientError:
+            return False
+
+    def list_buckets(self) -> list[tuple[str, float]]:
+        _, data = self._request("GET", "/")
+        out = []
+        root = ET.fromstring(data)
+        for b in root.iter():
+            if b.tag.endswith("Bucket"):
+                name = _text(b, "Name")
+                if name:
+                    out.append((name, 0.0))
+        return out
+
+    # -- objects -----------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   metadata: Optional[dict] = None) -> str:
+        hdrs = dict(metadata or {})
+        h, _ = self._request("PUT", f"/{bucket}/{key}", body=body,
+                             headers=hdrs)
+        return h.get("etag", "").strip('"')
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        h, _ = self._request("HEAD", f"/{bucket}/{key}")
+        return h
+
+    def get_object(self, bucket: str, key: str, offset: int = 0,
+                   length: int = -1) -> tuple[dict, Iterator[bytes]]:
+        hdrs = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            hdrs["range"] = f"bytes={offset}-{end}"
+        conn, resp = self._request("GET", f"/{bucket}/{key}",
+                                   headers=hdrs, stream=True)
+        out_headers = {k.lower(): v for k, v in resp.getheaders()}
+
+        def gen():
+            try:
+                while True:
+                    chunk = resp.read(1 << 16)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                conn.close()
+
+        return out_headers, gen()
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._request("DELETE", f"/{bucket}/{key}")
+
+    def list_objects_v2(self, bucket: str, prefix: str = "",
+                        delimiter: str = "",
+                        continuation: str = "", max_keys: int = 1000
+                        ) -> tuple[list[dict], list[str], str]:
+        q = {"list-type": "2", "max-keys": str(max_keys)}
+        if prefix:
+            q["prefix"] = prefix
+        if delimiter:
+            q["delimiter"] = delimiter
+        if continuation:
+            q["continuation-token"] = continuation
+        _, data = self._request("GET", f"/{bucket}", query=q)
+        root = ET.fromstring(data)
+        objs = []
+        for c in _findall(root, "Contents"):
+            lm = _text(c, "LastModified")
+            try:
+                mt = parsedate_to_datetime(lm).timestamp()
+            except (TypeError, ValueError):
+                try:
+                    import datetime as _dt
+                    mt = _dt.datetime.fromisoformat(
+                        lm.replace("Z", "+00:00")).timestamp()
+                except ValueError:
+                    mt = 0.0
+            objs.append({"key": _text(c, "Key"),
+                         "size": int(_text(c, "Size", "0") or 0),
+                         "etag": _text(c, "ETag").strip('"'),
+                         "mod_time": mt})
+        prefixes = [_text(p, "Prefix")
+                    for p in _findall(root, "CommonPrefixes")]
+        token = _text(root, "NextContinuationToken")
+        return objs, prefixes, token

@@ -1,0 +1,155 @@
+"""Gateways (NAS, S3-proxy) + disk cache wrapper (reference
+cmd/gateway/{nas,s3} and cmd/disk-cache test intents)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from minio_tpu.gateway import new_gateway
+from minio_tpu.object import api_errors
+from minio_tpu.object.cache import CacheObjects
+from minio_tpu.object.fs import FSObjects
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+
+CREDS = Credentials("gwtestkey123", "gwtestsecret123")
+
+
+def test_nas_gateway_is_fs(tmp_path):
+    gw = new_gateway("nas", path=str(tmp_path / "mount"))
+    gw.make_bucket("share")
+    gw.put_object("share", "doc.txt", b"on the nas")
+    # the file is on the "mount" as a plain file
+    assert open(tmp_path / "mount" / "share" / "doc.txt",
+                "rb").read() == b"on the nas"
+    _, stream = gw.get_object("share", "doc.txt")
+    assert b"".join(stream) == b"on the nas"
+
+
+def test_unknown_gateway():
+    with pytest.raises(ValueError):
+        new_gateway("azure")
+
+
+@pytest.fixture()
+def upstream(tmp_path):
+    """A live 'remote cloud' S3 endpoint backed by an erasure set."""
+    drives = [str(tmp_path / f"up{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
+                                   parity=2, block_size=1 << 16)
+    srv = S3Server(sets, creds=CREDS).start()
+    yield srv
+    srv.stop()
+    sets.close()
+
+
+def test_s3_gateway_proxies_objects(upstream, tmp_path):
+    gw = new_gateway("s3", host="127.0.0.1", port=upstream.port,
+                     access_key=CREDS.access_key,
+                     secret_key=CREDS.secret_key)
+    gw.make_bucket("remote")
+    assert gw.bucket_exists("remote")
+    assert "remote" in [v.name for v in gw.list_buckets()]
+
+    payload = os.urandom(100_000)
+    info = gw.put_object("remote", "obj", payload,
+                         opts=__import__(
+                             "minio_tpu.object.engine",
+                             fromlist=["PutOptions"]).PutOptions(
+                             metadata={"content-type": "application/x-t",
+                                       "X-Amz-Meta-K": "v"}))
+    assert info.etag
+
+    got = gw.get_object_info("remote", "obj")
+    assert got.size == len(payload)
+    assert got.content_type == "application/x-t"
+    assert got.user_defined.get("x-amz-meta-k") == "v"
+
+    _, stream = gw.get_object("remote", "obj")
+    assert b"".join(stream) == payload
+    _, stream = gw.get_object("remote", "obj", offset=10, length=100)
+    assert b"".join(stream) == payload[10:110]
+
+    objs, _, _ = gw.list_objects("remote", prefix="ob")
+    assert [o.name for o in objs] == ["obj"]
+
+    gw.delete_object("remote", "obj")
+    with pytest.raises(api_errors.ObjectApiError):
+        gw.get_object_info("remote", "obj")
+
+
+def test_s3_gateway_multipart(upstream):
+    gw = new_gateway("s3", host="127.0.0.1", port=upstream.port,
+                     access_key=CREDS.access_key,
+                     secret_key=CREDS.secret_key)
+    gw.make_bucket("mpb")
+    uid = gw.new_multipart_upload("mpb", "big")
+    from minio_tpu.object.multipart import CompletePart
+    p1 = gw.put_object_part("mpb", "big", uid, 1, b"a" * 1000)
+    p2 = gw.put_object_part("mpb", "big", uid, 2, b"b" * 1000)
+    gw.complete_multipart_upload(
+        "mpb", "big", uid,
+        [CompletePart(1, p1.etag), CompletePart(2, p2.etag)])
+    _, stream = gw.get_object("mpb", "big")
+    assert b"".join(stream) == b"a" * 1000 + b"b" * 1000
+
+
+def test_disk_cache_hits_and_invalidation(tmp_path):
+    fs = FSObjects(str(tmp_path / "origin"))
+    cache = CacheObjects(fs, str(tmp_path / "cache"),
+                         budget_bytes=1 << 20)
+    fs.make_bucket("cb")
+    cache.put_object("cb", "o", b"version one")
+
+    _, s = cache.get_object("cb", "o")
+    assert b"".join(s) == b"version one"
+    assert cache.misses == 1 and cache.hits == 0
+    _, s = cache.get_object("cb", "o")
+    assert b"".join(s) == b"version one"
+    assert cache.hits == 1
+
+    # overwrite via the CACHE wrapper invalidates
+    cache.put_object("cb", "o", b"version two!")
+    _, s = cache.get_object("cb", "o")
+    assert b"".join(s) == b"version two!"
+
+    # write BEHIND the cache (etag changes): stale entry is bypassed
+    fs.put_object("cb", "o", b"behind the back")
+    _, s = cache.get_object("cb", "o")
+    assert b"".join(s) == b"behind the back"
+
+    # ranged reads work from the cached entry
+    _, s = cache.get_object("cb", "o", offset=7, length=3)
+    assert b"".join(s) == b"the"
+
+
+def test_disk_cache_detects_corruption(tmp_path):
+    fs = FSObjects(str(tmp_path / "o2"))
+    cache = CacheObjects(fs, str(tmp_path / "c2"))
+    fs.make_bucket("b")
+    cache.put_object("b", "k", b"pristine data")
+    b"".join(cache.get_object("b", "k")[1])        # populate
+
+    # flip a byte in the cached copy
+    d = cache._entry_dir("b", "k")
+    with open(os.path.join(d, "data"), "r+b") as f:
+        f.seek(0)
+        f.write(b"X")
+    _, s = cache.get_object("b", "k")
+    assert b"".join(s) == b"pristine data"          # served from origin
+
+
+def test_disk_cache_purges_lru(tmp_path):
+    fs = FSObjects(str(tmp_path / "o3"))
+    cache = CacheObjects(fs, str(tmp_path / "c3"),
+                         budget_bytes=100_000)
+    fs.make_bucket("b")
+    import time as _t
+    for i in range(20):
+        cache.put_object("b", f"k{i}", bytes(8000))
+        b"".join(cache.get_object("b", f"k{i}")[1])
+        _t.sleep(0.01)
+    assert cache._usage() <= 100_000 * 0.95
