@@ -111,9 +111,10 @@ def heal_blocks(survivors, present_mask: int, cfg: ECConfig,
 # The flagship jittable step (what __graft_entry__.entry() exposes)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def put_step(data: jax.Array, k: int, m: int, shard_len: int = 0,
-             key: bytes = b"") -> tuple[jax.Array, jax.Array]:
+             key: bytes = b"", algo: str = "highwayhash"
+             ) -> tuple[jax.Array, jax.Array]:
     """One PUT device step: RS-encode a batch of blocks AND compute each
     shard's streaming-bitrot digest — the full reference per-block PUT
     work (cmd/erasure-encode.go:75-146 + cmd/bitrot-streaming.go:46-58)
@@ -122,22 +123,27 @@ def put_step(data: jax.Array, k: int, m: int, shard_len: int = 0,
     data: (B, k, S) uint8 data shards. S may include right zero-padding
     (GF coding is column-independent, so padded columns encode to zeros);
     shard_len (< = S, default S) is the true shard byte-length the bitrot
-    digests must cover.
-    Returns (shards (B, k+m, S) uint8, digests (B, k+m, 32) uint8), where
-    digests are HighwayHash256 of each shard's first shard_len bytes —
+    digests must cover. algo: "highwayhash" (keyed HH256, the default
+    bitrot) or "sha256".
+    Returns (shards (B, k+m, S) uint8, digests (B, k+m, 32) uint8) —
     byte-identical to the CPU bitrot path (minio_tpu/bitrot.py).
     """
-    from ..ops import highwayhash_jax
     from ..bitrot import MAGIC_HIGHWAYHASH_KEY
     b, k_, s = data.shape
     assert k_ == k
     shard_len = shard_len or s
-    key = key or MAGIC_HIGHWAYHASH_KEY
     pm = np.asarray(rs_matrix.parity_matrix(k, m))
     m2 = rs_tpu._bit_expand_cached(pm.tobytes(), pm.shape)
     parity = rs_tpu._apply_matrix_impl(
         jnp.asarray(m2), data, m, k, rs_tpu.default_use_pallas())
     full = jnp.concatenate([data, parity], axis=-2)
-    digests = highwayhash_jax._hh256_impl(
-        full.reshape(b * (k + m), s), shard_len, bytes(key))
+    rows = full.reshape(b * (k + m), s)
+    if algo == "sha256":
+        from ..ops import sha256_jax
+        digests = sha256_jax._sha256_impl(rows, shard_len)
+    else:
+        from ..ops import highwayhash_jax
+        key = key or MAGIC_HIGHWAYHASH_KEY
+        digests = highwayhash_jax._hh256_impl(rows, shard_len,
+                                              bytes(key))
     return full, digests.reshape(b, k + m, 32)

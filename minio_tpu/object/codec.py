@@ -116,8 +116,12 @@ class Codec:
         device or the bitrot algorithm has no device kernel.
         """
         from .. import bitrot as bitrot_mod
-        if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
-                        bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
+        if algo in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
+                    bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
+            kernel = "highwayhash"
+        elif algo is bitrot_mod.BitrotAlgorithm.SHA256:
+            kernel = "sha256"
+        else:
             return None
         if self.m == 0:
             return None
@@ -125,7 +129,7 @@ class Codec:
         if path != "device":
             return None
         from ..models.pipeline import put_step
-        full, digests = put_step(data, self.k, self.m)
+        full, digests = put_step(data, self.k, self.m, algo=kernel)
         # fetch only what the host doesn't have: the m parity rows + the
         # digests (the k data rows are the caller's own bytes; reading
         # them back would 4x the device->host traffic at EC 12+4)

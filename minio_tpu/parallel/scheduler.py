@@ -69,7 +69,8 @@ class BatchScheduler:
         caller falls back to its local CPU path)."""
         from .. import bitrot as bitrot_mod
         if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
-                        bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
+                        bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S,
+                        bitrot_mod.BitrotAlgorithm.SHA256):
             return None
         if codec.m == 0:
             return None

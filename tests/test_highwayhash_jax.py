@@ -96,9 +96,28 @@ def test_codec_fused_matches_cpu_path():
     assert (digests == want_dg).all()
 
 
-def test_codec_fused_declines_non_hh():
+def test_codec_fused_declines_unsupported_algo():
     from minio_tpu.object.codec import Codec
     codec = Codec(4, 2, 8192)
     data = np.zeros((1, 4, 64), dtype=np.uint8)
     assert codec.encode_and_hash_batch(
-        data, bitrot_mod.BitrotAlgorithm.SHA256, force="device") is None
+        data, bitrot_mod.BitrotAlgorithm.BLAKE2B512,
+        force="device") is None
+
+
+def test_codec_fused_sha256():
+    import hashlib
+    from minio_tpu.object.codec import Codec
+    codec = Codec(4, 2, 8192)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (2, 4, 1024), dtype=np.uint8)
+    out = codec.encode_and_hash_batch(
+        data, bitrot_mod.BitrotAlgorithm.SHA256, force="device")
+    assert out is not None
+    full, digests = out
+    want_full = codec.encode_batch(data, force="numpy")
+    assert (full == want_full).all()
+    for b in range(2):
+        for r in range(6):
+            assert digests[b, r].tobytes() == hashlib.sha256(
+                want_full[b, r].tobytes()).digest()

@@ -79,12 +79,12 @@ def test_scheduler_respects_max_batch(device_codec):
     sched.close()
 
 
-def test_scheduler_declines_non_hh():
+def test_scheduler_declines_unsupported_algo():
     sched = BatchScheduler()
     codec = Codec(4, 2, 4 * 128)
     data = np.zeros((1, 4, 128), np.uint8)
     assert sched.encode_and_hash(
-        codec, data, bitrot_mod.BitrotAlgorithm.SHA256) is None
+        codec, data, bitrot_mod.BitrotAlgorithm.BLAKE2B512) is None
     sched.close()
 
 
