@@ -214,6 +214,11 @@ class ClusterNode:
         from .s3.admin import mount_admin
         self.admin = mount_admin(self.s3, self)
 
+        # -- config KV (newAllSubsystems ConfigSys + lookupConfigs) --------
+        from .config import ConfigSys
+        self.config = ConfigSys(self.object_layer, secret=sk)
+        self.s3.api.config = self.config
+
         # -- live bucket features (events, replication, lifecycle) ---------
         from .features import EventNotifier, ReplicationPool
         from .features.lifecycle import crawler_action
@@ -222,6 +227,9 @@ class ClusterNode:
         self.replication = ReplicationPool(self.object_layer,
                                            self.s3.api.bucket_meta)
         self.s3.api.replication = self.replication
+        # apply stored/env config to the live subsystems
+        self.config.apply(self.s3.api, events=self.events,
+                          trace=self.s3.api.trace)
 
         # -- background plane (initAutoHeal + initDataCrawler) -------------
         from .object.background import DataUsageCrawler, DiskMonitor

@@ -1,0 +1,3 @@
+"""Typed KV configuration system (reference cmd/config/ + config-*.go)."""
+
+from .kv import ConfigSys, SUBSYSTEMS  # noqa: F401

@@ -1,0 +1,227 @@
+"""Typed KV config: subsystems, env overrides, encrypted persistence,
+history + rollback.
+
+The reference's cmd/config system (cmd/config/config.go:101-127 subsystem
+enumeration; cmd/config-encrypted.go stores the blob encrypted with the
+root credentials; cmd/admin-handlers-config-kv.go history/rollback;
+lookupConfigs applies values at startup). Same architecture here:
+
+  * a registry of subsystems with typed default keys,
+  * `MINIO_<SUBSYS>_<KEY>` environment variables override stored values,
+  * the blob persists AES-GCM-encrypted under the root secret at
+    .minio.sys/config/config.json through the ObjectLayer,
+  * every set() snapshots the previous blob into config/history/,
+  * apply() pushes live values into the running server (compression,
+    region, audit webhook, event webhook targets, API limits).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Optional
+
+CONFIG_OBJECT = "config/config.json"
+HISTORY_PREFIX = "config/history"
+MINIO_META_BUCKET = ".minio.sys"
+
+# subsystem -> {key: default} (reference cmd/config/config.go:101-127)
+SUBSYSTEMS: dict[str, dict[str, str]] = {
+    "api": {"requests_max": "0", "cors_allow_origin": "*"},
+    "region": {"name": "us-east-1"},
+    "compression": {"enable": "off",
+                    "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
+                    "mime_types": "text/*,application/json"},
+    "storage_class": {"standard": "", "rrs": ""},
+    "heal": {"interval": "10s", "max_io": "4"},
+    "scanner": {"interval": "60s"},
+    "etcd": {"endpoints": ""},
+    "identity_openid": {"config_url": "", "client_id": ""},
+    "identity_ldap": {"server_addr": ""},
+    "kms_secret_key": {"key": ""},
+    "logger_webhook": {"enable": "off", "endpoint": ""},
+    "audit_webhook": {"enable": "off", "endpoint": ""},
+    "notify_webhook": {"enable": "off", "endpoint": "",
+                       "queue_limit": "10000"},
+}
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _derive_key(secret: str) -> bytes:
+    return hashlib.sha256(b"minio-tpu-config:" + secret.encode()).digest()
+
+
+def _encrypt(secret: str, plain: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    nonce = secrets.token_bytes(12)
+    return nonce + AESGCM(_derive_key(secret)).encrypt(nonce, plain, b"")
+
+
+def _decrypt(secret: str, blob: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(_derive_key(secret)).decrypt(blob[:12], blob[12:], b"")
+
+
+class ConfigSys:
+    def __init__(self, object_layer=None, secret: str = ""):
+        self.obj = object_layer
+        self.secret = secret
+        self._mu = threading.RLock()
+        self._kv: dict[str, dict[str, str]] = {
+            s: dict(defaults) for s, defaults in SUBSYSTEMS.items()}
+        if self.obj is not None:
+            self.load()
+        self._apply_env()
+
+    # -- persistence -------------------------------------------------------
+
+    def load(self) -> None:
+        from ..object import api_errors
+        try:
+            _, stream = self.obj.get_object(MINIO_META_BUCKET,
+                                            CONFIG_OBJECT)
+            blob = b"".join(stream)
+        except api_errors.ObjectApiError:
+            return
+        try:
+            plain = _decrypt(self.secret, blob) if self.secret else blob
+            stored = json.loads(plain.decode())
+        except Exception as e:  # noqa: BLE001 — bad blob = keep defaults
+            raise ConfigError(f"config undecryptable: {e}") from e
+        with self._mu:
+            for subsys, kv in stored.items():
+                if subsys in self._kv and isinstance(kv, dict):
+                    self._kv[subsys].update(
+                        {k: str(v) for k, v in kv.items()})
+        self._apply_env()
+
+    def _persist(self) -> None:
+        if self.obj is None:
+            return
+        with self._mu:
+            plain = json.dumps(self._kv, sort_keys=True).encode()
+        # history snapshot of the PREVIOUS blob (rollback source)
+        from ..object import api_errors
+        try:
+            _, stream = self.obj.get_object(MINIO_META_BUCKET,
+                                            CONFIG_OBJECT)
+            prev = b"".join(stream)
+            # microsecond-resolution name keeps history lexically ordered
+            # even for rapid successive writes
+            now = time.time()
+            ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
+            ts += f"{int(now * 1e6) % 1_000_000:06d}Z"
+            self.obj.put_object(
+                MINIO_META_BUCKET,
+                f"{HISTORY_PREFIX}/{ts}-{secrets.token_hex(4)}.json",
+                prev)
+        except api_errors.ObjectApiError:
+            pass
+        blob = _encrypt(self.secret, plain) if self.secret else plain
+        self.obj.put_object(MINIO_META_BUCKET, CONFIG_OBJECT, blob)
+
+    def _apply_env(self) -> None:
+        """MINIO_<SUBSYS>_<KEY> env overrides (highest precedence)."""
+        with self._mu:
+            for subsys, kv in self._kv.items():
+                for key in kv:
+                    env = f"MINIO_{subsys.upper()}_{key.upper()}"
+                    if env in os.environ:
+                        kv[key] = os.environ[env]
+
+    # -- KV surface --------------------------------------------------------
+
+    def get(self, subsys: str, key: str) -> str:
+        with self._mu:
+            try:
+                return self._kv[subsys][key]
+            except KeyError:
+                raise ConfigError(
+                    f"unknown config key {subsys}/{key}") from None
+
+    def get_subsys(self, subsys: str) -> dict[str, str]:
+        with self._mu:
+            if subsys not in self._kv:
+                raise ConfigError(f"unknown subsystem {subsys}")
+            return dict(self._kv[subsys])
+
+    def dump(self) -> dict:
+        with self._mu:
+            return {s: dict(kv) for s, kv in self._kv.items()}
+
+    def set_kv(self, subsys: str, **kv: str) -> None:
+        with self._mu:
+            if subsys not in self._kv:
+                raise ConfigError(f"unknown subsystem {subsys}")
+            for k in kv:
+                if k not in SUBSYSTEMS[subsys]:
+                    raise ConfigError(f"unknown key {subsys}/{k}")
+            self._kv[subsys].update({k: str(v) for k, v in kv.items()})
+        self._persist()
+
+    # -- history / rollback ------------------------------------------------
+
+    def history(self) -> list[str]:
+        from ..object import api_errors
+        if self.obj is None:
+            return []
+        try:
+            objs, _, _ = self.obj.list_objects(
+                MINIO_META_BUCKET, prefix=HISTORY_PREFIX + "/",
+                max_keys=1000)
+        except api_errors.ObjectApiError:
+            return []
+        return [o.name[len(HISTORY_PREFIX) + 1:] for o in objs]
+
+    def restore(self, entry: str) -> None:
+        from ..object import api_errors
+        try:
+            _, stream = self.obj.get_object(
+                MINIO_META_BUCKET, f"{HISTORY_PREFIX}/{entry}")
+            blob = b"".join(stream)
+        except api_errors.ObjectApiError:
+            raise ConfigError(f"no history entry {entry}") from None
+        plain = _decrypt(self.secret, blob) if self.secret else blob
+        stored = json.loads(plain.decode())
+        with self._mu:
+            for subsys, kv in stored.items():
+                if subsys in self._kv and isinstance(kv, dict):
+                    self._kv[subsys] = dict(SUBSYSTEMS[subsys])
+                    self._kv[subsys].update(
+                        {k: str(v) for k, v in kv.items()})
+        self._persist()
+
+    # -- live application (lookupConfigs, cmd/config-current.go:323) -------
+
+    def apply(self, api, events=None, trace=None) -> None:
+        """Push config into a running S3ApiHandlers + subsystems."""
+        api.region = self.get("region", "name")
+        api.compression_enabled = \
+            self.get("compression", "enable").lower() in ("on", "true")
+        reqs = int(self.get("api", "requests_max") or 0)
+        if reqs > 0:
+            api.set_max_clients(reqs)
+        kms = self.get("kms_secret_key", "key")
+        if kms:
+            try:
+                key = bytes.fromhex(kms)
+                if len(key) == 32:
+                    api.sse_master_key = key
+            except ValueError:
+                pass
+        if trace is not None and \
+                self.get("audit_webhook", "enable").lower() == "on":
+            trace.audit_webhook = self.get("audit_webhook", "endpoint")
+        if events is not None and \
+                self.get("notify_webhook", "enable").lower() == "on":
+            from ..features.events import WebhookTarget
+            events.register_target(WebhookTarget(
+                "arn:minio:sqs::_:webhook",
+                self.get("notify_webhook", "endpoint")))

@@ -154,6 +154,11 @@ class EventNotifier:
         self.region = region
         self.retries = retries
         self.targets: dict[str, object] = {}     # arn -> target
+        # live-listen hub: every event (rule-matched or not) publishes
+        # here for ListenBucketNotification subscribers (pkg/pubsub use
+        # in cmd/listen-notification-handlers.go)
+        from ..utils.pubsub import PubSub
+        self.hub = PubSub()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
@@ -167,6 +172,10 @@ class EventNotifier:
 
     def send(self, event_name: str, bucket: str, key: str,
              size: int = 0, etag: str = "") -> None:
+        if self.hub.subscriber_count:
+            self.hub.publish(
+                (bucket, event_record(event_name, bucket, key, size,
+                                      etag, self.region)))
         bm = self.bucket_meta.get(bucket)
         if not bm.notification_xml:
             return

@@ -169,6 +169,31 @@ class AdminHandlers:
                 raise S3Error("AdminInvalidArgument", "unknown heal token")
             return self._json(seq.to_dict())
 
+        # -- config KV (cmd/admin-handlers-config-kv.go) -------------------
+        if sub == "get-config" and m == "GET":
+            self._auth(ctx, "admin:ConfigUpdate")
+            return self._json(self._config().dump())
+        if sub == "set-config" and m == "PUT":
+            self._auth(ctx, "admin:ConfigUpdate")
+            subsys = ctx.query1("subsys")
+            kv = json.loads(ctx.read_body().decode() or "{}")
+            cfg = self._config()
+            cfg.set_kv(subsys, **{k: str(v) for k, v in kv.items()})
+            if self.node is not None:
+                cfg.apply(self.api, events=self.api.events,
+                          trace=self.api.trace)
+            return self._json({})
+        if sub == "config-history" and m == "GET":
+            self._auth(ctx, "admin:ConfigUpdate")
+            return self._json({"entries": self._config().history()})
+        if sub == "restore-config" and m == "PUT":
+            self._auth(ctx, "admin:ConfigUpdate")
+            cfg = self._config()
+            cfg.restore(ctx.query1("entry"))
+            cfg.apply(self.api, events=self.api.events,
+                      trace=self.api.trace)
+            return self._json({})
+
         # -- IAM management (cmd/admin-handlers-users.go) ------------------
         if sub == "add-user" and m == "PUT":
             self._auth(ctx, "admin:CreateUser")
@@ -229,6 +254,15 @@ class AdminHandlers:
         if self.api.iam is None:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
+
+    def _config(self):
+        cfg = getattr(self.api, "config", None)
+        if cfg is None:
+            from ..config import ConfigSys
+            cfg = ConfigSys(self.api.obj,
+                            secret=self.api.root_cred.secret_key)
+            self.api.config = cfg
+        return cfg
 
     @staticmethod
     def _json(payload: dict) -> HTTPResponse:

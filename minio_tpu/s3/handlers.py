@@ -185,6 +185,7 @@ class S3ApiHandlers:
         self.replication = None   # optional ReplicationPool
         from .trace import TraceSys
         self.trace = TraceSys()   # request tracing + audit hub
+        self.config = None        # optional ConfigSys (admin KV)
         from ..features import crypto as sse
         self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
@@ -381,6 +382,8 @@ class S3ApiHandlers:
                 return self.get_bucket_replication(ctx, bucket)
             if ctx.has_query("notification"):
                 return self.get_bucket_notification(ctx, bucket)
+            if ctx.has_query("events"):
+                return self.listen_bucket_notification(ctx, bucket)
             if ctx.query1("list-type") == "2":
                 return self.list_objects_v2(ctx, bucket)
             return self.list_objects_v1(ctx, bucket)
@@ -422,6 +425,48 @@ class S3ApiHandlers:
             if "multipart/form-data" in ctx.header("content-type"):
                 return self.post_policy_upload(ctx, bucket)
         raise S3Error("MethodNotAllowed")
+
+    def listen_bucket_notification(self, ctx, bucket) -> HTTPResponse:
+        """Live event stream for one bucket (ListenBucketNotification,
+        cmd/listen-notification-handlers.go): ND-JSON event records,
+        filtered by prefix/suffix/event-name query params, ends after an
+        idle window."""
+        import fnmatch as _fn
+        import json as _json
+        self.authenticate(ctx, "s3:ListenBucketNotification", bucket)
+        self.obj.get_bucket_info(bucket)
+        if self.events is None:
+            raise S3Error("NotImplemented", "event system not running")
+        prefix = ctx.query1("prefix")
+        suffix = ctx.query1("suffix")
+        patterns = ctx.req.query.get("events") or ["*"]
+        idle = float(ctx.query1("idle", "10") or 10)
+        hub = self.events.hub
+
+        def stream():
+            with hub.subscribe() as sub:
+                while True:
+                    item = sub.get(timeout=idle)
+                    if item is None:
+                        return
+                    b, record = item
+                    if b != bucket:
+                        continue
+                    rec = record["Records"][0]
+                    key = rec["s3"]["object"]["key"]
+                    if prefix and not key.startswith(prefix):
+                        continue
+                    if suffix and not key.endswith(suffix):
+                        continue
+                    if not any(_fn.fnmatchcase(rec["eventName"], p)
+                               or p == "*"
+                               for p in patterns):
+                        continue
+                    yield (_json.dumps(record) + "\n").encode()
+
+        return HTTPResponse(
+            headers={"Content-Type": "application/x-ndjson"},
+            stream=stream())
 
     def post_policy_upload(self, ctx, bucket) -> HTTPResponse:
         """Browser form upload (PostPolicyBucketHandler,
