@@ -69,6 +69,30 @@ def _decrypt(secret: str, blob: bytes) -> bytes:
     return AESGCM(_derive_key(secret)).decrypt(blob[:12], blob[12:], b"")
 
 
+# keys whose values must parse as non-negative integers
+_INT_KEYS = {("api", "requests_max"), ("heal", "max_io"),
+             ("notify_webhook", "queue_limit")}
+# keys restricted to on/off
+_BOOL_KEYS = {("compression", "enable"), ("logger_webhook", "enable"),
+              ("audit_webhook", "enable"), ("notify_webhook", "enable")}
+
+HISTORY_KEEP = 50
+
+
+def _validate(subsys: str, key: str, value: str) -> None:
+    if (subsys, key) in _INT_KEYS:
+        try:
+            if int(value) < 0:
+                raise ValueError
+        except ValueError:
+            raise ConfigError(
+                f"{subsys}/{key} must be a non-negative integer, "
+                f"got {value!r}") from None
+    if (subsys, key) in _BOOL_KEYS and value.lower() not in (
+            "on", "off", "true", "false", "1", "0", ""):
+        raise ConfigError(f"{subsys}/{key} must be on or off")
+
+
 class ConfigSys:
     def __init__(self, object_layer=None, secret: str = ""):
         self.obj = object_layer
@@ -76,9 +100,13 @@ class ConfigSys:
         self._mu = threading.RLock()
         self._kv: dict[str, dict[str, str]] = {
             s: dict(defaults) for s, defaults in SUBSYSTEMS.items()}
+        # env overlay, consulted by get() with highest precedence but
+        # NEVER persisted (set_kv writes only the stored layer)
+        self._env: dict[tuple[str, str], str] = {}
         if self.obj is not None:
             self.load()
-        self._apply_env()
+        else:
+            self._apply_env()
 
     # -- persistence -------------------------------------------------------
 
@@ -93,7 +121,10 @@ class ConfigSys:
         try:
             plain = _decrypt(self.secret, blob) if self.secret else blob
             stored = json.loads(plain.decode())
-        except Exception as e:  # noqa: BLE001 — bad blob = keep defaults
+        except Exception as e:  # noqa: BLE001
+            # an unreadable stored config is a hard error: silently
+            # falling back to defaults would drop security-relevant
+            # settings (the reference also refuses to start)
             raise ConfigError(f"config undecryptable: {e}") from e
         with self._mu:
             for subsys, kv in stored.items():
@@ -105,41 +136,61 @@ class ConfigSys:
     def _persist(self) -> None:
         if self.obj is None:
             return
+        from ..object import api_errors
+        # the whole read-snapshot-write cycle runs under the lock so two
+        # concurrent set_kv calls cannot store a stale blob
         with self._mu:
             plain = json.dumps(self._kv, sort_keys=True).encode()
-        # history snapshot of the PREVIOUS blob (rollback source)
+            try:
+                _, stream = self.obj.get_object(MINIO_META_BUCKET,
+                                                CONFIG_OBJECT)
+                prev = b"".join(stream)
+                # microsecond-resolution name keeps history lexically
+                # ordered even for rapid successive writes
+                now = time.time()
+                ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
+                ts += f"{int(now * 1e6) % 1_000_000:06d}Z"
+                self.obj.put_object(
+                    MINIO_META_BUCKET,
+                    f"{HISTORY_PREFIX}/{ts}-{secrets.token_hex(4)}.json",
+                    prev)
+            except api_errors.ObjectApiError:
+                pass
+            blob = _encrypt(self.secret, plain) if self.secret else plain
+            self.obj.put_object(MINIO_META_BUCKET, CONFIG_OBJECT, blob)
+            self._prune_history()
+
+    def _prune_history(self) -> None:
+        """Cap history at HISTORY_KEEP newest snapshots."""
         from ..object import api_errors
         try:
-            _, stream = self.obj.get_object(MINIO_META_BUCKET,
-                                            CONFIG_OBJECT)
-            prev = b"".join(stream)
-            # microsecond-resolution name keeps history lexically ordered
-            # even for rapid successive writes
-            now = time.time()
-            ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
-            ts += f"{int(now * 1e6) % 1_000_000:06d}Z"
-            self.obj.put_object(
-                MINIO_META_BUCKET,
-                f"{HISTORY_PREFIX}/{ts}-{secrets.token_hex(4)}.json",
-                prev)
+            entries = self.history()
         except api_errors.ObjectApiError:
-            pass
-        blob = _encrypt(self.secret, plain) if self.secret else plain
-        self.obj.put_object(MINIO_META_BUCKET, CONFIG_OBJECT, blob)
+            return
+        for entry in entries[:-HISTORY_KEEP]:
+            try:
+                self.obj.delete_object(MINIO_META_BUCKET,
+                                       f"{HISTORY_PREFIX}/{entry}")
+            except api_errors.ObjectApiError:
+                pass
 
     def _apply_env(self) -> None:
-        """MINIO_<SUBSYS>_<KEY> env overrides (highest precedence)."""
+        """MINIO_<SUBSYS>_<KEY> env overrides: an overlay with highest
+        read precedence, never merged into the persisted layer."""
         with self._mu:
+            self._env = {}
             for subsys, kv in self._kv.items():
                 for key in kv:
                     env = f"MINIO_{subsys.upper()}_{key.upper()}"
                     if env in os.environ:
-                        kv[key] = os.environ[env]
+                        self._env[(subsys, key)] = os.environ[env]
 
     # -- KV surface --------------------------------------------------------
 
     def get(self, subsys: str, key: str) -> str:
         with self._mu:
+            if (subsys, key) in self._env:
+                return self._env[(subsys, key)]
             try:
                 return self._kv[subsys][key]
             except KeyError:
@@ -150,19 +201,27 @@ class ConfigSys:
         with self._mu:
             if subsys not in self._kv:
                 raise ConfigError(f"unknown subsystem {subsys}")
-            return dict(self._kv[subsys])
+            out = dict(self._kv[subsys])
+            for (s2, k), v in self._env.items():
+                if s2 == subsys:
+                    out[k] = v
+            return out
 
     def dump(self) -> dict:
         with self._mu:
-            return {s: dict(kv) for s, kv in self._kv.items()}
+            out = {s: dict(kv) for s, kv in self._kv.items()}
+            for (s2, k), v in self._env.items():
+                out[s2][k] = v
+            return out
 
     def set_kv(self, subsys: str, **kv: str) -> None:
         with self._mu:
             if subsys not in self._kv:
                 raise ConfigError(f"unknown subsystem {subsys}")
-            for k in kv:
+            for k, v in kv.items():
                 if k not in SUBSYSTEMS[subsys]:
                     raise ConfigError(f"unknown key {subsys}/{k}")
+                _validate(subsys, k, str(v))
             self._kv[subsys].update({k: str(v) for k, v in kv.items()})
         self._persist()
 
@@ -200,14 +259,20 @@ class ConfigSys:
 
     # -- live application (lookupConfigs, cmd/config-current.go:323) -------
 
+    CONFIG_WEBHOOK_ARN = "arn:minio:sqs::_:webhook"
+
     def apply(self, api, events=None, trace=None) -> None:
-        """Push config into a running S3ApiHandlers + subsystems."""
+        """Push config into a running S3ApiHandlers + subsystems.
+        Off-transitions are applied too: disabling a webhook or resetting
+        requests_max actually stops the live behavior."""
         api.region = self.get("region", "name")
         api.compression_enabled = \
-            self.get("compression", "enable").lower() in ("on", "true")
-        reqs = int(self.get("api", "requests_max") or 0)
-        if reqs > 0:
-            api.set_max_clients(reqs)
+            self.get("compression", "enable").lower() in ("on", "true", "1")
+        try:
+            reqs = int(self.get("api", "requests_max") or 0)
+        except ValueError:
+            reqs = 0
+        api.set_max_clients(reqs if reqs > 0 else 256)
         kms = self.get("kms_secret_key", "key")
         if kms:
             try:
@@ -216,12 +281,18 @@ class ConfigSys:
                     api.sse_master_key = key
             except ValueError:
                 pass
-        if trace is not None and \
-                self.get("audit_webhook", "enable").lower() == "on":
-            trace.audit_webhook = self.get("audit_webhook", "endpoint")
-        if events is not None and \
-                self.get("notify_webhook", "enable").lower() == "on":
-            from ..features.events import WebhookTarget
-            events.register_target(WebhookTarget(
-                "arn:minio:sqs::_:webhook",
-                self.get("notify_webhook", "endpoint")))
+        if trace is not None:
+            if self.get("audit_webhook", "enable").lower() in ("on",
+                                                               "true", "1"):
+                trace.audit_webhook = self.get("audit_webhook", "endpoint")
+            else:
+                trace.audit_webhook = ""
+        if events is not None:
+            if self.get("notify_webhook", "enable").lower() in ("on",
+                                                                "true", "1"):
+                from ..features.events import WebhookTarget
+                events.register_target(WebhookTarget(
+                    self.CONFIG_WEBHOOK_ARN,
+                    self.get("notify_webhook", "endpoint")))
+            else:
+                events.targets.pop(self.CONFIG_WEBHOOK_ARN, None)

@@ -148,8 +148,13 @@ class AdminHandlers:
             return self._json(self.top_locks())
         if sub == "trace" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
-            n = int(ctx.query1("count", "0") or 0)
-            idle = float(ctx.query1("idle", "10") or 10)
+            try:
+                n = int(ctx.query1("count", "0") or 0)
+                idle = float(ctx.query1("idle", "10") or 10)
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad count/idle") from None
+            idle = min(max(idle, 1.0), 3600.0)
             return HTTPResponse(
                 headers={"Content-Type": "application/x-ndjson"},
                 stream=self.api.trace.stream(max_entries=n,
@@ -178,10 +183,13 @@ class AdminHandlers:
             subsys = ctx.query1("subsys")
             kv = json.loads(ctx.read_body().decode() or "{}")
             cfg = self._config()
-            cfg.set_kv(subsys, **{k: str(v) for k, v in kv.items()})
-            if self.node is not None:
-                cfg.apply(self.api, events=self.api.events,
-                          trace=self.api.trace)
+            from ..config import kv as _kvmod
+            try:
+                cfg.set_kv(subsys, **{k: str(v) for k, v in kv.items()})
+            except _kvmod.ConfigError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            cfg.apply(self.api, events=self.api.events,
+                      trace=self.api.trace)
             return self._json({})
         if sub == "config-history" and m == "GET":
             self._auth(ctx, "admin:ConfigUpdate")

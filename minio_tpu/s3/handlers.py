@@ -440,7 +440,11 @@ class S3ApiHandlers:
         prefix = ctx.query1("prefix")
         suffix = ctx.query1("suffix")
         patterns = ctx.req.query.get("events") or ["*"]
-        idle = float(ctx.query1("idle", "10") or 10)
+        try:
+            idle = float(ctx.query1("idle", "10") or 10)
+        except ValueError:
+            raise S3Error("InvalidArgument", "bad idle value") from None
+        idle = min(max(idle, 1.0), 3600.0)
         hub = self.events.hub
 
         def stream():

@@ -121,11 +121,18 @@ def test_admin_config_endpoints(tmp_path):
         assert st == 200
         st, body = req("GET", "/minio/admin/v3/get-config")
         assert json.loads(body)["compression"]["enable"] == "on"
-        # a second write snapshots the first blob into history
+        # a second write snapshots the first blob into history (NOT the
+        # region: set-config applies live, and changing the region would
+        # invalidate this client's SigV4 scope — which is correct)
         st, _ = req("PUT", "/minio/admin/v3/set-config",
-                    query={"subsys": "region"},
-                    body=json.dumps({"name": "us-west-9"}).encode())
+                    query={"subsys": "scanner"},
+                    body=json.dumps({"interval": "120s"}).encode())
         assert st == 200
+        # bad values are rejected before persisting
+        st, _ = req("PUT", "/minio/admin/v3/set-config",
+                    query={"subsys": "api"},
+                    body=json.dumps({"requests_max": "abc"}).encode())
+        assert st == 400
         st, body = req("GET", "/minio/admin/v3/config-history")
         assert st == 200 and json.loads(body)["entries"]
     finally:
