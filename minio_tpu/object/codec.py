@@ -20,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..ops import rs_matrix, rs_ref, rs_tpu
+from ..ops import gf256, rs_matrix, rs_ref, rs_tpu
 from ..utils import native
 
 # Batches at least this large go to the device (dispatch+transfer amortized).
@@ -137,6 +137,25 @@ class Codec:
         return (np.concatenate([np.asarray(data, np.uint8), parity],
                                axis=1), np.asarray(digests))
 
+    # -- batched decode (degraded GET) -------------------------------------
+
+    def decode_stacked(self, survivors: np.ndarray, present_mask: int,
+                       *, force: str = "") -> np.ndarray:
+        """(B, k, S) survivors — stacked in decode_matrix `used` order —
+        -> (B, k, S) data shards. The degraded-GET hot path: a batch of
+        blocks sharing one erasure pattern reconstructs in ONE device
+        matmul (cmd/erasure-decode.go's per-block ReconstructData,
+        batched for the MXU)."""
+        path = force or self._route(survivors.nbytes)
+        if path == "device":
+            return np.asarray(rs_tpu.reconstruct_data(
+                survivors, present_mask, self.k, self.m))
+        d, _used = rs_matrix.decode_matrix(self.k, self.m, present_mask)
+        d = np.asarray(d, dtype=np.uint8)
+        if path == "native" and native.available():
+            return np.stack([native.gf_matmul(d, s) for s in survivors])
+        return np.stack([gf256.gf_matmul(d, s) for s in survivors])
+
     # -- reconstruct -------------------------------------------------------
 
     def reconstruct(self, shards: list[np.ndarray | None],
@@ -177,7 +196,7 @@ class Codec:
         elif path == "native" and native.available():
             out = native.gf_matmul(np.asarray(rec, dtype=np.uint8), stacked)
         else:
-            out = rs_ref.apply_matrix(np.asarray(rec), stacked)
+            out = gf256.gf_matmul(np.asarray(rec, dtype=np.uint8), stacked)
         result = list(shards)
         for row, idx in enumerate(rec_missing):
             result[idx] = out[row]

@@ -7,11 +7,14 @@ hashOrder, xl.meta written to all drives, quorum-checked reads/writes,
 
 TPU-first deltas vs the reference's per-block loop:
   * The PUT hot loop aggregates up to ENCODE_BATCH_BLOCKS full blocks and
-    encodes them as one (B, k, S) device batch (cmd/erasure-encode.go's
-    block loop, batched for the MXU), then hashes all shard rows in one
-    batched bitrot call.
-  * GET reconstruct stacks all blocks of a part that share an erasure
-    pattern into one batched matmul (cmd/erasure-decode.go:211 semantics).
+    encodes + bitrot-digests them as one fused device program
+    (cmd/erasure-encode.go's block loop + cmd/bitrot-streaming.go,
+    batched for the MXU/VPU); the cross-request scheduler coalesces
+    concurrent streams into shared dispatches.
+  * Degraded GETs read GET_BATCH_BLOCKS blocks per group and
+    batch-reconstruct every block sharing an erasure pattern in one
+    stacked decode (cmd/erasure-decode.go:211 semantics, device-routed
+    for large groups — see _reconstruct_group).
   * MD5/ETag runs on a background thread overlapped with encode — the
     generalized QAT async-MD5 pattern (cmd/erasure-encode.go:113-124).
 """
@@ -38,6 +41,7 @@ from .hash_reader import HashReader
 from .nslock import NSLockMap
 
 ENCODE_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_ENCODE_BATCH", "8"))
+GET_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_GET_BATCH", "8"))
 
 # Reserved bucket names an S3 client can't touch.
 RESERVED_BUCKETS = (MINIO_META_BUCKET,)
@@ -546,17 +550,30 @@ class ErasureObjects:
         end_block = (offset + length - 1) // fi.erasure.block_size
         heal_required = False
 
-        for block_num in range(start_block, end_block + 1):
-            block_off = block_num * fi.erasure.block_size
-            block_len = min(fi.erasure.block_size, part.size - block_off)
-            shard_len = -(-block_len // k)
-            shards, had_errors = self._read_block_shards(
-                readers, codec, block_num, shard_size, shard_len, k, n)
-            heal_required = heal_required or had_errors
-            data = np.concatenate([s[:shard_len] for s in shards[:k]])
-            begin = max(offset - block_off, 0)
-            end = min(offset + length - block_off, block_len)
-            yield data.tobytes()[begin:end]
+        # blocks are read in groups so a degraded part reconstructs many
+        # blocks per device call instead of one matmul per block
+        bn = start_block
+        while bn <= end_block:
+            group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
+            group = []
+            for b in range(bn, group_end + 1):
+                block_off = b * fi.erasure.block_size
+                block_len = min(fi.erasure.block_size,
+                                part.size - block_off)
+                shard_len = -(-block_len // k)
+                shards, had_errors = self._read_block_shards_raw(
+                    readers, b, shard_size, shard_len, k, n)
+                heal_required = heal_required or had_errors
+                group.append((b, block_off, block_len, shard_len, shards))
+            if self._reconstruct_group(codec, group, k, n):
+                heal_required = True
+            for b, block_off, block_len, shard_len, shards in group:
+                data = np.concatenate([s[:shard_len]
+                                       for s in shards[:k]])
+                begin = max(offset - block_off, 0)
+                end = min(offset + length - block_off, block_len)
+                yield data.tobytes()[begin:end]
+            bn = group_end + 1
 
         for r in readers:
             if r is not None:
@@ -571,8 +588,48 @@ class ErasureObjects:
     def _read_block_shards(self, readers, codec: Codec, block_num: int,
                            shard_size: int, shard_len: int, k: int, n: int
                            ) -> tuple[list, bool]:
+        """Single-block convenience (healing path): raw read +
+        reconstruct-in-place."""
+        shards, had_errors = self._read_block_shards_raw(
+            readers, block_num, shard_size, shard_len, k, n)
+        if any(shards[i] is None for i in range(k)):
+            shards = codec.reconstruct(shards, data_only=True)
+        return shards, had_errors
+
+    def _reconstruct_group(self, codec: Codec, group, k: int,
+                           n: int) -> bool:
+        """Batch-reconstruct the degraded blocks of a read group: blocks
+        sharing one (present-mask, shard-length) pattern go through a
+        single stacked decode (device-routed for large groups). Returns
+        True when any block needed reconstruction."""
+        from ..ops import rs_matrix
+        buckets: dict[tuple[int, int], list[int]] = {}
+        for gi, (_b, _off, _bl, shard_len, shards) in enumerate(group):
+            if all(shards[i] is not None for i in range(k)):
+                continue
+            mask = sum(1 << i for i in range(n)
+                       if shards[i] is not None)
+            buckets.setdefault((mask, shard_len), []).append(gi)
+        for (mask, shard_len), idxs in buckets.items():
+            _, used = rs_matrix.decode_matrix(k, codec.m, mask)
+            stacked = np.stack([
+                np.stack([group[gi][4][u] for u in used])
+                for gi in idxs])                       # (G', k, S)
+            data = codec.decode_stacked(stacked, mask)
+            for row, gi in enumerate(idxs):
+                shards = group[gi][4]
+                for i in range(k):
+                    if shards[i] is None:
+                        shards[i] = data[row][i]
+        return bool(buckets)
+
+    def _read_block_shards_raw(self, readers, block_num: int,
+                               shard_size: int, shard_len: int, k: int,
+                               n: int) -> tuple[list, bool]:
         """k-of-n shard reads with hedged extras on failure
-        (parallelReader, cmd/erasure-decode.go:102-184)."""
+        (parallelReader, cmd/erasure-decode.go:102-184). Returns raw
+        shards (missing entries None — at least k present) without
+        reconstructing."""
         offset = block_num * shard_size
         shards: list[Optional[np.ndarray]] = [None] * n
         tried = [False] * n
@@ -612,7 +669,6 @@ class ErasureObjects:
                 f"{got} readable shards < k={k}")
         if any(shards[i] is None for i in range(k)):
             had_errors = True
-            shards = codec.reconstruct(shards, data_only=True)
         return shards, had_errors
 
     # ------------------------------------------------------------------

@@ -121,3 +121,19 @@ def test_codec_fused_sha256():
         for r in range(6):
             assert digests[b, r].tobytes() == hashlib.sha256(
                 want_full[b, r].tobytes()).digest()
+
+
+def test_codec_decode_stacked_matches_numpy():
+    from minio_tpu.object.codec import Codec
+    from minio_tpu.ops import rs_matrix, rs_ref
+    codec = Codec(4, 2, 4 * 512)
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, (3, 4, 512), dtype=np.uint8)
+    full = np.stack([rs_ref.encode(d, 2) for d in data])  # (3, 6, 512)
+    # lose shards 0 and 3: survivors 1,2,4,5
+    mask = sum(1 << i for i in (1, 2, 4, 5))
+    _, used = rs_matrix.decode_matrix(4, 2, mask)
+    stacked = np.stack([full[b][list(used)] for b in range(3)])
+    for force in ("numpy", "device"):
+        out = codec.decode_stacked(stacked, mask, force=force)
+        assert (out == data).all(), force
