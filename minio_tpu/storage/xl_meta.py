@@ -56,6 +56,62 @@ def is_xl2_v1_format(buf: bytes) -> bool:
     return (len(buf) > 8 and buf[:4] == XL_HEADER and buf[4:8] == XL_VERSION)
 
 
+def from_xl_v1_json(raw: bytes) -> "XLMetaV2":
+    """Parse a legacy xl.json (format v1, cmd/xl-storage-format-v1.go)
+    into a v2 journal — the read-side of the v1->v2 migration
+    (formatErasureMigrate semantics at the object level).
+
+    v1 stores ONE version per object: JSON with stat/erasure/meta/parts;
+    bitrot checksums are whole-file per-part entries under
+    erasure.checksum.
+    """
+    import json as _json
+    try:
+        d = _json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise errors.FileCorrupt(f"xl.json: {e}") from e
+    if d.get("format") != "xl":
+        raise errors.FileCorrupt("xl.json: not an xl format file")
+    er = d.get("erasure", {})
+    st = d.get("stat", {})
+    checksums = []
+    for c in er.get("checksum", []):
+        checksums.append(ChecksumInfo(
+            part_number=int(str(c.get("name", "part.1")
+                                ).split(".")[-1] or 1),
+            algorithm=c.get("algorithm", "highwayhash256S"),
+            hash=bytes.fromhex(c.get("hash", "") or "")))
+    parts = [ObjectPartInfo(
+        number=p.get("number", i + 1), etag=p.get("etag", ""),
+        size=p.get("size", 0),
+        actual_size=p.get("actualSize", p.get("size", 0)))
+        for i, p in enumerate(d.get("parts", []))]
+    mod_time = st.get("modTime", 0)
+    if isinstance(mod_time, str):
+        import datetime as _dt
+        try:
+            mod_time = _dt.datetime.fromisoformat(
+                mod_time.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            mod_time = 0.0
+    fi = FileInfo(
+        version_id="",                 # v1 is unversioned ("null")
+        data_dir="",                   # v1 keeps parts beside xl.json
+        size=st.get("size", 0), mod_time=float(mod_time),
+        metadata=dict(d.get("meta", {})), parts=parts,
+        erasure=ErasureInfo(
+            algorithm=er.get("algorithm", "rs-vandermonde"),
+            data_blocks=er.get("data", 0),
+            parity_blocks=er.get("parity", 0),
+            block_size=er.get("blockSize", 0),
+            index=er.get("index", 0),
+            distribution=list(er.get("distribution", [])),
+            checksums=checksums))
+    z = XLMetaV2()
+    z.add_version(fi)
+    return z
+
+
 class XLMetaV2:
     """In-memory journal; versions is a list of raw msgpack-shaped dicts."""
 

@@ -33,6 +33,7 @@ from .format import FORMAT_CONFIG_FILE, MINIO_META_BUCKET, FormatErasureV3
 from .xl_meta import XLMetaV2
 
 XL_STORAGE_FORMAT_FILE = "xl.meta"
+XL_LEGACY_FORMAT_FILE = "xl.json"   # format v1 (migrated on access)
 MINIO_META_TMP_BUCKET = MINIO_META_BUCKET + "/tmp"
 MINIO_META_MULTIPART_BUCKET = MINIO_META_BUCKET + "/multipart"
 MAX_PATH_LEN = 4096
@@ -381,7 +382,9 @@ class XLStorage(StorageAPI):
 
     def check_file(self, volume: str, path: str) -> None:
         fp = self._file_path(volume, path)
-        if not os.path.isfile(os.path.join(fp, XL_STORAGE_FORMAT_FILE)):
+        if not os.path.isfile(os.path.join(fp, XL_STORAGE_FORMAT_FILE)) \
+                and not os.path.isfile(
+                    os.path.join(fp, XL_LEGACY_FORMAT_FILE)):
             raise errors.FileNotFound(path)
 
     def list_dir(self, volume: str, dir_path: str,
@@ -413,7 +416,26 @@ class XLStorage(StorageAPI):
     # -- metadata ----------------------------------------------------------
 
     def _read_xl_meta(self, volume: str, path: str) -> XLMetaV2:
-        buf = self.read_all(volume, os.path.join(path, XL_STORAGE_FORMAT_FILE))
+        try:
+            buf = self.read_all(volume,
+                                os.path.join(path, XL_STORAGE_FORMAT_FILE))
+        except errors.FileNotFound:
+            # legacy v1 drive: migrate xl.json -> xl.meta on first touch
+            # (reference migrates at startup/access,
+            # cmd/xl-storage-format-v1.go + readVersion fallback)
+            from .xl_meta import from_xl_v1_json
+            legacy = self.read_all(
+                volume, os.path.join(path, XL_LEGACY_FORMAT_FILE))
+            meta = from_xl_v1_json(legacy)
+            self.write_all(volume,
+                           os.path.join(path, XL_STORAGE_FORMAT_FILE),
+                           meta.dumps())
+            try:
+                os.remove(self._file_path(
+                    volume, os.path.join(path, XL_LEGACY_FORMAT_FILE)))
+            except OSError:
+                pass
+            return meta
         return XLMetaV2.loads(buf)
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:

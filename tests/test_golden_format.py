@@ -157,3 +157,47 @@ def test_merged_names_is_lazy_and_paginates(tmp_path):
                                    max_keys=100)
     assert [o.name for o in objs3] == [f"other/{i:04d}" for i in range(10)]
     sets.close()
+
+def test_xl_v1_json_migration(tmp_path):
+    """A legacy xl.json drive entry is readable and migrates to xl.meta
+    on first access (reference xl-storage-format-v1 migration)."""
+    import json
+    import os
+    from minio_tpu.storage.xl_storage import XLStorage
+
+    d = XLStorage(str(tmp_path / "legacy"))
+    d.make_vol_bulk(".minio.sys", "b")
+    obj_dir = tmp_path / "legacy" / "b" / "old-obj"
+    os.makedirs(obj_dir)
+    v1 = {
+        "version": "1.0.1", "format": "xl",
+        "stat": {"size": 1234, "modTime": "2020-09-01T12:00:00Z"},
+        "erasure": {"algorithm": "klauspost/reedsolomon/vandermonde",
+                    "data": 4, "parity": 2, "blockSize": 1048576,
+                    "index": 3, "distribution": [3, 4, 5, 6, 1, 2],
+                    "checksum": [{"name": "part.1",
+                                  "algorithm": "highwayhash256S",
+                                  "hash": ""}]},
+        "minio": {"release": "RELEASE.2020"},
+        "meta": {"etag": "abcd", "content-type": "text/plain"},
+        "parts": [{"number": 1, "name": "part.1", "etag": "abcd",
+                   "size": 1234, "actualSize": 1234}],
+    }
+    (obj_dir / "xl.json").write_text(json.dumps(v1))
+
+    fi = d.read_version("b", "old-obj")
+    assert fi.size == 1234
+    assert fi.metadata["etag"] == "abcd"
+    assert fi.erasure.data_blocks == 4 and fi.erasure.parity_blocks == 2
+    assert fi.erasure.distribution == [3, 4, 5, 6, 1, 2]
+    assert fi.mod_time > 0
+
+    # migrated: xl.meta exists, xl.json is gone, re-read works
+    assert (obj_dir / "xl.meta").exists()
+    assert not (obj_dir / "xl.json").exists()
+    fi2 = d.read_version("b", "old-obj")
+    assert fi2.size == 1234
+
+    # legacy entries are visible to the walk (listing path)
+    names = [f.name for f in d.walk("b")]
+    assert "old-obj" in names
