@@ -156,6 +156,28 @@ class Codec:
             return np.stack([native.gf_matmul(d, s) for s in survivors])
         return np.stack([gf256.gf_matmul(d, s) for s in survivors])
 
+    def recover_stacked(self, survivors: np.ndarray, present_mask: int,
+                        rows: "set[int]", *, force: str = ""
+                        ) -> tuple[np.ndarray, list[int]]:
+        """(B, k, S) survivors (recover_matrix `used` order) -> exactly
+        the requested missing shard rows, one batched matmul — the heal
+        hot path over many blocks (cmd/erasure-lowlevel-heal.go's
+        decode→re-encode collapsed AND batched). Returns (out (B, R, S),
+        shard indices for each output row)."""
+        rec, _used, rec_missing = rs_matrix.recover_matrix(
+            self.k, self.m, present_mask)
+        keep = [r for r, idx in enumerate(rec_missing) if idx in rows]
+        idxs = [rec_missing[r] for r in keep]
+        rec = np.asarray(rec, dtype=np.uint8)[keep]
+        path = force or self._route(survivors.nbytes)
+        if path == "device":
+            out = np.asarray(rs_tpu.apply_matrix(rec, survivors))
+        elif path == "native" and native.available():
+            out = np.stack([native.gf_matmul(rec, s) for s in survivors])
+        else:
+            out = np.stack([gf256.gf_matmul(rec, s) for s in survivors])
+        return out, idxs
+
     # -- reconstruct -------------------------------------------------------
 
     def reconstruct(self, shards: list[np.ndarray | None],

@@ -7,8 +7,10 @@ commit as PUT (healObject, cmd/erasure-healing.go:220-489).
 
 TPU-first: reconstruction uses the *recover matrix* — decode and
 re-encode collapsed into one GF(2⁸) matmul producing only the lost shard
-rows (the device form of erasure-lowlevel-heal.go's decode→pipe→encode),
-batched over all blocks of a part.
+rows (the device form of erasure-lowlevel-heal.go's decode→pipe→encode).
+Blocks are read in groups of HEAL_BATCH_BLOCKS and every block sharing
+an erasure pattern rebuilds in one stacked, device-routed matmul
+(codec.recover_stacked).
 """
 
 from __future__ import annotations
@@ -27,6 +29,10 @@ from ..storage.datatypes import FileInfo
 from ..storage.xl_storage import MINIO_META_TMP_BUCKET
 from . import api_errors, bitrot_io, metadata as meta
 from .engine import ErasureObjects
+
+import os
+
+HEAL_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_HEAL_BATCH", "8"))
 
 
 @dataclass
@@ -255,26 +261,53 @@ class HealMixin(ErasureObjects):
                 except serr.StorageError:
                     written.discard(i)
 
+            from ..ops import rs_matrix
             n_blocks = -(-part.size // fi.erasure.block_size)
-            for b in range(n_blocks):
-                block_len = min(fi.erasure.block_size,
-                                part.size - b * fi.erasure.block_size)
-                shard_len = -(-block_len // k)
-                shards, _ = self._read_block_shards(
-                    readers, codec, b, shard_size, shard_len, k, n)
-                # rebuild exactly the rows being healed (recover-matrix
-                # rows for to_heal only; healthy-but-unread parity is NOT
-                # recomputed)
-                full = codec.reconstruct(
-                    [shards[i] if i < len(shards) and shards[i] is not None
-                     else None for i in range(n)],
-                    rows=set(writers.keys()))
-                for i, w in list(writers.items()):
-                    try:
-                        w.write(np.ascontiguousarray(
-                            full[i][:shard_len]).tobytes())
-                    except serr.StorageError:
-                        drop(i, writers)
+            bn = 0
+            while bn < n_blocks:
+                ge = min(bn + HEAL_BATCH_BLOCKS, n_blocks)
+                group = []
+                for b in range(bn, ge):
+                    block_len = min(fi.erasure.block_size,
+                                    part.size - b * fi.erasure.block_size)
+                    shard_len = -(-block_len // k)
+                    shards, _ = self._read_block_shards_raw(
+                        readers, b, shard_size, shard_len, k, n)
+                    group.append((b - bn, shard_len, shards))
+                # rebuild exactly the writer rows, batched per erasure
+                # pattern: many blocks -> ONE recover-matrix matmul
+                rebuilt: dict[int, dict[int, np.ndarray]] = {}
+                buckets: dict[tuple[int, int], list[int]] = {}
+                for gi, (_b, sl, shards) in enumerate(group):
+                    mask = sum(1 << i for i in range(n)
+                               if shards[i] is not None)
+                    buckets.setdefault((mask, sl), []).append(gi)
+                for (mask, sl), gis in buckets.items():
+                    _, used, _missing = rs_matrix.recover_matrix(
+                        k, self.parity_shards, mask)
+                    stacked = np.stack([
+                        np.stack([group[gi][2][u] for u in used])
+                        for gi in gis])
+                    out, idxs = codec.recover_stacked(
+                        stacked, mask, set(writers.keys()))
+                    for row_i, gi in enumerate(gis):
+                        rebuilt[gi] = {idx: out[row_i][r]
+                                       for r, idx in enumerate(idxs)}
+                for gi, (_b, shard_len, shards) in enumerate(group):
+                    rows = rebuilt.get(gi, {})
+                    for i, w in list(writers.items()):
+                        src = rows.get(i)
+                        if src is None and shards[i] is not None:
+                            src = shards[i]   # shard readable elsewhere
+                        if src is None:
+                            drop(i, writers)
+                            continue
+                        try:
+                            w.write(np.ascontiguousarray(
+                                src[:shard_len]).tobytes())
+                        except serr.StorageError:
+                            drop(i, writers)
+                bn = ge
             for r in readers:
                 if r is not None:
                     r.close()
