@@ -146,6 +146,12 @@ class AdminHandlers:
         if sub == "top/locks" and m == "GET":
             self._auth(ctx, "admin:TopLocksInfo")
             return self._json(self.top_locks())
+        if sub == "profiling/start" and m == "POST":
+            self._auth(ctx, "admin:Profiling")
+            return self._json(self._profiling_start())
+        if sub == "profiling/stop" and m == "POST":
+            self._auth(ctx, "admin:Profiling")
+            return self._profiling_stop()
         if sub == "trace" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
             try:
@@ -262,6 +268,31 @@ class AdminHandlers:
         if self.api.iam is None:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
+
+    def _profiling_start(self) -> dict:
+        """CPU profiling of this process (admin profiling/start,
+        cmd/admin-handlers.go:461; profiler kinds beyond cpu are Go
+        runtime specifics — cProfile is the Python-native equivalent)."""
+        import cProfile
+        if getattr(self, "_profiler", None) is not None:
+            return {"status": "already running"}
+        self._profiler = cProfile.Profile()
+        self._profiler.enable()
+        return {"status": "started", "kind": "cpu"}
+
+    def _profiling_stop(self) -> HTTPResponse:
+        import io
+        import pstats
+        prof = getattr(self, "_profiler", None)
+        if prof is None:
+            raise S3Error("AdminInvalidArgument", "profiling not running")
+        prof.disable()
+        self._profiler = None
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+            .print_stats(60)
+        return HTTPResponse(body=buf.getvalue().encode(),
+                            headers={"Content-Type": "text/plain"})
 
     def _config(self):
         cfg = getattr(self.api, "config", None)

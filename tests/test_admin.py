@@ -167,3 +167,15 @@ def test_metrics_endpoint(server):
     assert r.status == 200
     assert "minio_disks_online 4" in text
     assert "minio_capacity_raw_total_bytes" in text
+
+def test_admin_profiling(client, server):
+    st, body = client.request("POST", "/minio/admin/v3/profiling/start")
+    assert st == 200 and json.loads(body)["status"] == "started"
+    # generate a little work, then collect the report
+    client.request("GET", "/minio/admin/v3/info")
+    st, body = client.request("POST", "/minio/admin/v3/profiling/stop")
+    assert st == 200
+    assert b"cumulative" in body        # pstats header
+    # stop again: error
+    st, _ = client.request("POST", "/minio/admin/v3/profiling/stop")
+    assert st == 400
