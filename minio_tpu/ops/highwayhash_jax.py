@@ -9,20 +9,26 @@ B×n independent shard blocks, and the VPU runs all of them in lockstep.
 Layout choices that matter on the VPU:
   * no 64-bit integer lanes -> every u64 is a (lo, hi) pair of uint32
     arrays; adds carry via unsigned compare, 32x32->64 multiplies via
-    16-bit split.
+    16-bit split (with optimization barriers on the shifted operands —
+    XLA's algebraic simplifier cycles on mul(shr(x)) patterns).
   * the state's four u64 lanes are kept permanently split into even
     (0, 2) and odd (1, 3) lane pairs, because the zipper-merge step mixes
     lanes pairwise: with the split representation every packet round is
     purely elementwise (no stack/reshape relayouts inside the scan).
-  * packet words are pre-permuted once outside the scan into
-    [lo_e | hi_e | lo_o | hi_o] row order so each round takes contiguous
-    static slices.
+  * streams fold into sublane GROUPS: a (2, N) state uses 2 of 8 VPU
+    sublanes; reshaping to (2·G, N/G) with G stream groups stacked along
+    sublanes fills the register file (G=4 on TPU -> full 8-sublane
+    utilization). Packet words are pre-permuted once outside the scan so
+    every round takes contiguous static slices.
   * packet rounds are unrolled _UNROLL-fold per lax.scan step to amortize
-    loop overhead.
+    loop overhead; the CPU backend keeps G=1 and a small unroll (each op
+    is real single-core LLVM compile time there).
 
 Bit-identity with the scalar implementation (ops/highwayhash_py.py, itself
 pinned to the published HighwayHash vectors) is enforced by
-tests/test_highwayhash_jax.py over lengths covering every remainder path.
+tests/test_highwayhash_jax.py over lengths covering every remainder path,
+and the grouped TPU layout is algebraically the same elementwise program
+under a row relabeling.
 """
 
 from __future__ import annotations
@@ -39,28 +45,43 @@ _MUL0 = (0xdbe6d5d5fe4cce2f, 0xa4093822299f31d0,
 _MUL1 = (0x3bd39e10cb0ef593, 0xc0acf169b5f18a8c,
          0xbe5466cf34e90c6c, 0x452821e638d01377)
 
-# packets unrolled per scan step. On TPU big unrolls amortize loop
-# overhead and the (remote) compiler handles the op count; on the CPU
-# backend every op in the graph costs real LLVM compile time on this
-# single-core host, so keep the compiled-once scan body minimal.
+# packets unrolled per scan step / sublane stream-groups, per backend.
 _UNROLL_TPU = 16
 _UNROLL_CPU = 2
+_GROUPS_TPU = 4
+_GROUPS_CPU = 1
 
 
 def _unroll() -> int:
     try:
-        import jax as _jax
-        return _UNROLL_TPU if _jax.default_backend() == "tpu" \
+        return _UNROLL_TPU if jax.default_backend() == "tpu" \
             else _UNROLL_CPU
     except Exception:
         return _UNROLL_CPU
 
+
+def _groups() -> int:
+    try:
+        return _GROUPS_TPU if jax.default_backend() == "tpu" \
+            else _GROUPS_CPU
+    except Exception:
+        return _GROUPS_CPU
+
+
 U32 = jnp.uint32
 
-# row order applied to each packet's 8 little-endian u32 words so that the
-# scan body slices contiguously: [lo(l0), lo(l2), hi(l0), hi(l2),
-#                                 lo(l1), lo(l3), hi(l1), hi(l3)]
-_WORD_PERM = np.array([0, 4, 1, 5, 2, 6, 3, 7])
+
+def _word_perm(g: int) -> np.ndarray:
+    """Row permutation for (8·g, n/g) per-packet words laid out w-major
+    (row = word·g + group) -> [lo_e | hi_e | lo_o | hi_o] blocks of 2g
+    rows each, lane-major then group within a block.
+
+    Little-endian u64 lane j: lo = word 2j, hi = word 2j+1.
+    """
+    def block(words):
+        return [w * g + grp for w in words for grp in range(g)]
+    return np.array(block([0, 4]) + block([1, 5])
+                    + block([2, 6]) + block([3, 7]))
 
 
 # -- u64 emulation on (lo, hi) uint32 pairs ---------------------------------
@@ -156,32 +177,35 @@ def _zipper_merge(v1, v0):
 
 
 # -- state -------------------------------------------------------------------
-# State: 8 u64 pairs of (2, N) u32 arrays — {v0,v1,mul0,mul1} × {even
-# lanes (0,2), odd lanes (1,3)}.
+# State: 8 u64 pairs of (2·G, N/G) u32 arrays — {v0,v1,mul0,mul1} ×
+# {even lanes (0,2), odd lanes (1,3)}; within a pair, rows 0:G hold the
+# low lane's G stream groups, rows G:2G the high lane's.
 
-def _const_pair(vals2, n):
-    lo = np.array([v & 0xffffffff for v in vals2], np.uint32)
-    hi = np.array([v >> 32 for v in vals2], np.uint32)
-    return (jnp.broadcast_to(jnp.asarray(lo)[:, None], (2, n)),
-            jnp.broadcast_to(jnp.asarray(hi)[:, None], (2, n)))
+def _const_pair(vals2, g: int, cols: int):
+    lo = np.repeat(np.array([v & 0xffffffff for v in vals2], np.uint32), g)
+    hi = np.repeat(np.array([v >> 32 for v in vals2], np.uint32), g)
+    return (jnp.broadcast_to(jnp.asarray(lo)[:, None], (2 * g, cols)),
+            jnp.broadcast_to(jnp.asarray(hi)[:, None], (2 * g, cols)))
 
 
-def _init_state(key: bytes, n: int):
+def _init_state(key: bytes, g: int, cols: int):
     k = [int.from_bytes(key[i * 8:(i + 1) * 8], "little") for i in range(4)]
     rot = [((v >> 32) | (v << 32)) & ((1 << 64) - 1) for v in k]
     st = {}
     for tag, lanes in (("e", (0, 2)), ("o", (1, 3))):
-        mul0 = _const_pair([_MUL0[i] for i in lanes], n)
-        mul1 = _const_pair([_MUL1[i] for i in lanes], n)
+        mul0 = _const_pair([_MUL0[i] for i in lanes], g, cols)
+        mul1 = _const_pair([_MUL1[i] for i in lanes], g, cols)
         st["mul0" + tag] = mul0
         st["mul1" + tag] = mul1
-        st["v0" + tag] = _xor64(mul0, _const_pair([k[i] for i in lanes], n))
-        st["v1" + tag] = _xor64(mul1, _const_pair([rot[i] for i in lanes], n))
+        st["v0" + tag] = _xor64(
+            mul0, _const_pair([k[i] for i in lanes], g, cols))
+        st["v1" + tag] = _xor64(
+            mul1, _const_pair([rot[i] for i in lanes], g, cols))
     return st
 
 
 def _update(st, pe, po):
-    """One packet round. pe/po: u64 pairs of (2, N) — even/odd lanes."""
+    """One packet round. pe/po: u64 pairs of (2G, N/G) — even/odd lanes."""
     v0e, v0o = st["v0e"], st["v0o"]
     v1e, v1o = st["v1e"], st["v1o"]
     mul0e, mul0o = st["mul0e"], st["mul0o"]
@@ -205,9 +229,10 @@ def _update(st, pe, po):
             "mul0e": mul0e, "mul0o": mul0o, "mul1e": mul1e, "mul1o": mul1o}
 
 
-def _packet_from_rows(w):
-    """(8, N) u32 in _WORD_PERM row order -> (pe, po) u64 pairs."""
-    return (w[0:2], w[2:4]), (w[4:6], w[6:8])
+def _packet_from_rows(w, g: int):
+    """(8G, N/G) u32 in _word_perm order -> (pe, po) u64 pairs."""
+    return ((w[0:2 * g], w[2 * g:4 * g]),
+            (w[4 * g:6 * g], w[6 * g:8 * g]))
 
 
 def _rot32half(x, n: int):
@@ -218,7 +243,17 @@ def _rot32half(x, n: int):
             (x[1] << U32(n)) | (x[1] >> U32(32 - n)))
 
 
-def _update_remainder(st, tail_u8, n_bytes: int):
+def _words_grouped(packets_u8: jnp.ndarray, g: int) -> jnp.ndarray:
+    """(N, P, 32) uint8 packets -> (P, 8G, N/G) u32 in _word_perm order."""
+    n, p, _ = packets_u8.shape
+    words = lax.bitcast_convert_type(
+        packets_u8.reshape(n, p, 8, 4), U32)      # (N, P, 8) LE words
+    words = jnp.transpose(words, (1, 2, 0))       # (P, 8, N)
+    words = words.reshape(p, 8, g, n // g).reshape(p, 8 * g, n // g)
+    return words[:, _word_perm(g), :]
+
+
+def _update_remainder(st, tail_u8, n_bytes: int, g: int):
     """tail_u8: (N, R) uint8 with R = n_bytes = L mod 32 (may be 0)."""
     if n_bytes == 0:
         return st
@@ -226,7 +261,8 @@ def _update_remainder(st, tail_u8, n_bytes: int):
     st = dict(st)
     inc = ((n_bytes << 32) + n_bytes)
     for tag in ("e", "o"):
-        st["v0" + tag] = _add64(st["v0" + tag], _const_pair([inc, inc], N))
+        st["v0" + tag] = _add64(st["v0" + tag],
+                                _const_pair([inc, inc], g, N // g))
         st["v1" + tag] = _rot32half(st["v1" + tag], n_bytes)
 
     mod4 = n_bytes & 3
@@ -242,35 +278,36 @@ def _update_remainder(st, tail_u8, n_bytes: int):
         packet = packet.at[:, 16].set(rem[:, 0])
         packet = packet.at[:, 17].set(rem[:, mod4 >> 1])
         packet = packet.at[:, 18].set(rem[:, mod4 - 1])
-    words = lax.bitcast_convert_type(
-        packet.reshape(N, 8, 4), U32)          # (N, 8) little-endian
-    pe, po = _packet_from_rows(words.T[_WORD_PERM])
+    w = _words_grouped(packet[:, None, :], g)[0]
+    pe, po = _packet_from_rows(w, g)
     return _update(st, pe, po)
 
 
-def _permute_and_update(st):
+def _swap_blocks(x, g: int):
+    """Swap the two lane blocks (rows 0:G <-> G:2G) of one array."""
+    return jnp.concatenate([x[g:], x[:g]])
+
+
+def _permute_and_update(st, g: int):
     # packet lanes = v0 lanes [2,3,0,1] with 32-bit halves swapped:
-    # even packet lanes (0,2) <- v0 lanes (2,0) = v0e rows reversed;
-    # odd  packet lanes (1,3) <- v0 lanes (3,1) = v0o rows reversed.
+    # within each even/odd pair that is a lane-block swap + lo/hi swap.
     v0e, v0o = st["v0e"], st["v0o"]
-    # barrier: algsimp's reverse/slice rewrites interact with the update
-    # graph and grow it superlinearly per chained permute on CPU
-    pe = lax.optimization_barrier((v0e[1][::-1], v0e[0][::-1]))
-    po = lax.optimization_barrier((v0o[1][::-1], v0o[0][::-1]))
+    pe = (_swap_blocks(v0e[1], g), _swap_blocks(v0e[0], g))
+    po = (_swap_blocks(v0o[1], g), _swap_blocks(v0o[0], g))
     return _update(st, pe, po)
 
 
-def _finalize256(st):
-    """-> (8, N) u32: the 32-byte digest as 8 little-endian words."""
-    # fori_loop, not an unrolled chain: the round body compiles once
-    # (unrolling 10 rounds multiplies CPU-backend LLVM time 10x)
-    st = lax.fori_loop(0, 10, lambda i, s: _permute_and_update(s), st)
+def _finalize256(st, g: int):
+    """-> (8, N) u32: the 32-byte digest as 8 little-endian words, rows
+    in word order, columns in original stream order."""
+    st = lax.fori_loop(0, 10, lambda i, s: _permute_and_update(s, g), st)
 
     def lane(name, l):
-        # u64 lane l of state vector `name` as a pair of (N,) arrays
-        tag, row = ("e", l // 2) if l % 2 == 0 else ("o", l // 2)
+        # u64 lane l: (G, N/G) lo/hi slices of the e/o pair
+        tag = "e" if l % 2 == 0 else "o"
+        blk = l // 2
         x = st[name + tag]
-        return (x[0][row], x[1][row])
+        return (x[0][blk * g:(blk + 1) * g], x[1][blk * g:(blk + 1) * g])
 
     def modred(a3, a2, a1, a0):
         a3 = _and64c(a3, 0x3FFFFFFFFFFFFFFF)
@@ -287,43 +324,54 @@ def _finalize256(st):
                     sum64("v0", "mul0", 1), sum64("v0", "mul0", 0))
     h3, h2 = modred(sum64("v1", "mul1", 3), sum64("v1", "mul1", 2),
                     sum64("v0", "mul0", 3), sum64("v0", "mul0", 2))
-    return jnp.stack([h0[0], h0[1], h1[0], h1[1],
-                      h2[0], h2[1], h3[0], h3[1]])
+    # each h is a pair of (G, N/G); stack to (8, G, N/G) word-major,
+    # then flatten group rows back to N columns
+    out = jnp.stack([h0[0], h0[1], h1[0], h1[1],
+                     h2[0], h2[1], h3[0], h3[1]])      # (8, G, N/G)
+    return out.reshape(8, -1)                          # (8, N) group-major
 
 
 # -- public op ---------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _hh256_impl(data: jnp.ndarray, length: int, key: bytes) -> jnp.ndarray:
-    n = data.shape[0]
+    n_in = data.shape[0]
+    g = _groups()
+    pad_rows = (-n_in) % g
+    if pad_rows:
+        data = jnp.concatenate(
+            [data, jnp.zeros((pad_rows, data.shape[1]), jnp.uint8)])
+    n = n_in + pad_rows
     full = length // 32
     rem = length % 32
-    st = _init_state(key, n)
+    st = _init_state(key, g, n // g)
 
     if full:
-        words = lax.bitcast_convert_type(
-            data[:, :full * 32].reshape(n, full, 8, 4), U32)  # (N, F, 8)
-        words = jnp.transpose(words, (1, 2, 0))               # (F, 8, N)
-        words = words[:, _WORD_PERM, :]
-        g = min(_unroll(), full)
-        main = (full // g) * g
+        words = _words_grouped(
+            data[:, :full * 32].reshape(n, full, 32), g)  # (F, 8G, N/G)
+        u = min(_unroll(), full)
+        main = (full // u) * u
 
         def body(st, w):
-            for j in range(g):
-                pe, po = _packet_from_rows(w[j * 8:(j + 1) * 8])
+            for j in range(u):
+                pe, po = _packet_from_rows(w[j * 8 * g:(j + 1) * 8 * g], g)
                 st = _update(st, pe, po)
             return st, None
 
-        st, _ = lax.scan(body, st, words[:main].reshape(full // g,
-                                                        g * 8, n))
+        st, _ = lax.scan(body, st, words[:main].reshape(
+            full // u, u * 8 * g, n // g))
         for j in range(main, full):
-            pe, po = _packet_from_rows(words[j])
+            pe, po = _packet_from_rows(words[j], g)
             st = _update(st, pe, po)
     if rem:
-        st = _update_remainder(st, data[:, full * 32:length], rem)
-    out = _finalize256(st)                                    # (8, N) u32
-    return lax.bitcast_convert_type(
+        st = _update_remainder(st, data[:, full * 32:length], rem, g)
+    out = _finalize256(st, g)                          # (8, N) u32
+    # (8, N) -> (N, 8) -> little-endian bytes; the group fold in
+    # _finalize256 restored original stream order (groups were split
+    # contiguously: stream s lives in group s // (N/G))
+    digests = lax.bitcast_convert_type(
         jnp.transpose(out, (1, 0)), jnp.uint8).reshape(n, 32)
+    return digests[:n_in]
 
 
 def hh256_batch(key: bytes, data) -> jax.Array:
