@@ -209,6 +209,13 @@ class ClusterNode:
             lambda b: self.notification.reload_bucket_metadata(b)
         self._peer_rpc.reload_iam = self.iam.load
         self.iam.on_change = self.notification.reload_iam
+        self._peer_rpc.get_storage_info = self.object_layer.storage_info
+        self._peer_rpc.get_trace = \
+            lambda: list(self.s3.api.trace.recent)
+        self._peer_rpc.get_bucket_usage = \
+            lambda: (self.crawler.usage
+                     if getattr(self, "crawler", None) is not None
+                     else {})
 
         # -- admin / health / metrics routers ------------------------------
         from .s3.admin import mount_admin

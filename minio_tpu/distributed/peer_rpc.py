@@ -37,6 +37,9 @@ class PeerRPCServer:
         self.reload_iam: Callable[[], None] = lambda: None
         self.signal_service: Callable[[str], None] = lambda sig: None
         self.get_metrics: Callable[[], dict] = lambda: {}
+        self.get_storage_info: Callable[[], dict] = lambda: {}
+        self.get_trace: Callable[[], list] = lambda: []
+        self.get_bucket_usage: Callable[[], dict] = lambda: {}
 
         h = self.handler
         h.register("server-info", lambda a, b: {
@@ -47,6 +50,9 @@ class PeerRPCServer:
         h.register("reload-iam", lambda a, b: self.reload_iam())
         h.register("signal", self._signal)
         h.register("metrics", lambda a, b: self.get_metrics())
+        h.register("storage-info", lambda a, b: self.get_storage_info())
+        h.register("trace", lambda a, b: self.get_trace())
+        h.register("bucket-usage", lambda a, b: self.get_bucket_usage())
 
     def _reload_bm(self, args, body):
         self.reload_bucket_metadata(args.get("bucket", ""))
@@ -103,6 +109,24 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return {}
 
+    def storage_info(self) -> dict:
+        try:
+            return self.rc.call_json("storage-info") or {}
+        except (NetworkError, RPCError):
+            return {}
+
+    def trace(self) -> list:
+        try:
+            return self.rc.call_json("trace") or []
+        except (NetworkError, RPCError):
+            return []
+
+    def bucket_usage(self) -> dict:
+        try:
+            return self.rc.call_json("bucket-usage") or {}
+        except (NetworkError, RPCError):
+            return {}
+
     @property
     def online(self) -> bool:
         return self.rc.online
@@ -154,6 +178,18 @@ class NotificationSys:
 
     def signal_all(self, sig: str) -> list:
         return self._broadcast(lambda p: p.signal_service(sig))
+
+    def storage_info_all(self) -> list:
+        return self._broadcast(lambda p: p.storage_info())
+
+    def trace_all(self) -> list[dict]:
+        """Cluster-wide recent trace entries, time-ordered."""
+        merged: list[dict] = []
+        for entries in self._broadcast(lambda p: p.trace()):
+            if isinstance(entries, list):
+                merged.extend(e for e in entries if isinstance(e, dict))
+        merged.sort(key=lambda e: e.get("time", ""))
+        return merged
 
 
 # ---------------------------------------------------------------------------

@@ -152,6 +152,13 @@ class AdminHandlers:
         if sub == "profiling/stop" and m == "POST":
             self._auth(ctx, "admin:Profiling")
             return self._profiling_stop()
+        if sub == "trace/cluster" and m == "GET":
+            self._auth(ctx, "admin:ServerTrace")
+            entries = list(self.api.trace.recent)
+            if self.node is not None:
+                entries.extend(self.node.notification.trace_all())
+            entries.sort(key=lambda e: e.get("time", ""))
+            return self._json({"entries": entries[-500:]})
         if sub == "trace" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
             try:

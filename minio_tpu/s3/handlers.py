@@ -980,7 +980,9 @@ class S3ApiHandlers:
         versioned = self.bucket_meta.versioning_enabled(bucket)
         info = self.obj.put_object(
             bucket, key, reader, size,
-            PutOptions(metadata=metadata, versioned=versioned))
+            PutOptions(metadata=metadata, versioned=versioned,
+                       parity=self._parity_for(
+                           ctx.header("x-amz-storage-class"))))
         headers = {"ETag": f'"{info.etag}"', **sse_headers}
         if info.version_id and info.version_id != "null":
             headers["x-amz-version-id"] = info.version_id
@@ -1554,6 +1556,25 @@ class S3ApiHandlers:
         self.obj.update_object_metadata(bucket, key, md,
                                         vid or info.version_id)
         return HTTPResponse()
+
+    def _parity_for(self, storage_class: str):
+        """Per-request parity from the storage_class config subsystem
+        (cmd/config/storageclass: STANDARD / REDUCED_REDUNDANCY map to
+        EC:n strings). None = the set's default."""
+        if self.config is None or not storage_class:
+            return None
+        key = "rrs" if storage_class == "REDUCED_REDUNDANCY" \
+            else "standard"
+        try:
+            spec = self.config.get("storage_class", key)
+        except Exception:  # noqa: BLE001 — unknown subsystem/key
+            return None
+        if spec.upper().startswith("EC:"):
+            try:
+                return max(0, int(spec[3:]))
+            except ValueError:
+                return None
+        return None
 
     def _enforce_quota(self, bucket: str, incoming: int) -> None:
         q = self.bucket_meta.get_quota(bucket)

@@ -20,12 +20,17 @@ from ..utils.pubsub import PubSub
 
 
 class TraceSys:
-    def __init__(self, node_name: str = ""):
+    def __init__(self, node_name: str = "", ring_size: int = 200):
+        from collections import deque
         self.hub = PubSub()
         self.node = node_name
         self.audit_webhook: str = ""           # POST target for audit
         self.requests_total = 0
         self.errors_total = 0
+        # recent-entry ring: peers pull this for cluster-wide trace
+        # (the reference streams over peer REST; a pull ring is the
+        # polling equivalent)
+        self.recent: "deque[dict]" = deque(maxlen=ring_size)
         self._mu = threading.Lock()
 
     # -- middleware --------------------------------------------------------
@@ -48,6 +53,7 @@ class TraceSys:
             "duration_ms": round(duration_s * 1e3, 3),
             "caller": caller,
         }
+        self.recent.append(entry)
         if self.hub.subscriber_count:
             self.hub.publish(entry)
         if self.audit_webhook:

@@ -211,3 +211,37 @@ def test_ellipses_expansion():
     assert ellipses.divide_into_sets(4) == (1, 4)
     with pytest.raises(ValueError):
         ellipses.divide_into_sets(17)
+
+
+def test_peer_plane_verbs(cluster):
+    """storage-info / trace / bucket-usage travel the peer plane."""
+    a = cluster[0]
+    infos = a.notification.storage_info_all()
+    assert all(isinstance(i, dict) and i.get("online_disks") == 16
+               for i in infos)
+    # generate traffic on node 1's S3 listener, then pull its trace ring
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", cluster[1].spec.port,
+                                      timeout=10)
+    conn.request("GET", "/minio/health/live")
+    conn.getresponse().read()
+    conn.close()
+    merged = a.notification.trace_all()
+    assert any(e.get("path") == "/minio/health/live" for e in merged)
+
+
+def test_storage_class_parity(cluster):
+    """REDUCED_REDUNDANCY storage class lowers parity per object via the
+    config storage_class subsystem."""
+    a = cluster[0]
+    a.config.set_kv("storage_class", rrs="EC:2")
+    assert a.s3.api._parity_for("REDUCED_REDUNDANCY") == 2
+    assert a.s3.api._parity_for("STANDARD") is None   # no override set
+    a.object_layer.make_bucket("scb")
+    from minio_tpu.object.engine import PutOptions
+    a.object_layer.put_object("scb", "rr", b"q" * 50_000,
+                              opts=PutOptions(parity=2))
+    info = a.object_layer.get_object_info("scb", "rr")
+    assert info.parity_blocks == 2 and info.data_blocks == 14
+    _, stream = a.object_layer.get_object("scb", "rr")
+    assert b"".join(stream) == b"q" * 50_000
