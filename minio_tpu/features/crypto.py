@@ -36,6 +36,7 @@ TAG_SIZE = 16
 _AAD = b"minio-tpu-dare-v1"
 
 MK_SSE = "X-Minio-Internal-Sse"
+MK_SSE_MP = "X-Minio-Internal-Sse-Multipart"
 MK_SEALED = "X-Minio-Internal-Sse-Sealed-Key"
 MK_IV = "X-Minio-Internal-Sse-Iv"
 MK_KEYMD5 = "X-Minio-Internal-Sse-Key-Md5"
@@ -305,6 +306,42 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
         return raw_reader, raw_size
     metadata[MK_ACTUAL] = str(raw_size) if raw_size >= 0 else "-1"
     return PutObjReader(raw_reader, transforms), size
+
+
+def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
+                     sse_s3: bool, master_key: Optional[bytes],
+                     multipart: bool = False) -> None:
+    """Generate + seal a fresh object key into `metadata` without
+    wrapping any stream — the multipart-create path (each part encrypts
+    later with a per-part nonce; cmd/encryption-v1.go multipart
+    part-size math analog)."""
+    from ..s3.s3errors import S3Error
+    if ssec_key is not None:
+        sealing = ssec_key
+        metadata[MK_SSE] = "C"
+        metadata[MK_KEYMD5] = base64.b64encode(
+            hashlib.md5(ssec_key).digest()).decode()
+    elif sse_s3:
+        if master_key is None:
+            raise S3Error("ServerSideEncryptionConfigurationNotFoundError")
+        sealing = master_key
+        metadata[MK_SSE] = "S3"
+    else:
+        return
+    oek = secrets.token_bytes(32)
+    nonce_base = secrets.token_bytes(12)
+    metadata[MK_SEALED] = base64.b64encode(seal_key(sealing, oek)).decode()
+    metadata[MK_IV] = base64.b64encode(nonce_base).decode()
+    if multipart:
+        metadata[MK_SSE_MP] = "true"
+
+
+def part_nonce(nonce_base: bytes, part_number: int) -> bytes:
+    """Per-part package-nonce base: parts encrypt independently, so each
+    needs its own nonce space under the shared object key."""
+    import hmac as _hmac
+    return _hmac.new(nonce_base, b"part-%d" % part_number,
+                     hashlib.sha256).digest()[:12]
 
 
 def resolve_get_key(info_metadata: dict, header,

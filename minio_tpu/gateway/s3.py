@@ -188,6 +188,9 @@ class S3GatewayObjects:
                           "metadata": dict((opts or PutOptions()).metadata)}
         return uid
 
+    def get_multipart_info(self, bucket, key, uid) -> dict:
+        return dict(self._up(bucket, key, uid).get("metadata", {}))
+
     def _up(self, bucket, key, uid):
         mpu = getattr(self, "_mpu", {}).get(uid)
         if mpu is None or mpu["bucket"] != bucket or mpu["key"] != key:

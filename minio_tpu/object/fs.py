@@ -382,6 +382,11 @@ class FSObjects:
             raise api_errors.InvalidUploadID(upload_id)
         return info
 
+    def get_multipart_info(self, bucket: str, key: str,
+                           upload_id: str) -> dict:
+        return dict(self._upload_info(bucket, key, upload_id).get(
+            "metadata", {}))
+
     def put_object_part(self, bucket: str, key: str, upload_id: str,
                         part_number: int, reader, size: int = -1):
         self._upload_info(bucket, key, upload_id)

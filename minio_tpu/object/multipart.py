@@ -77,6 +77,12 @@ class MultipartMixin(ErasureObjects):
         except api_errors.InsufficientReadQuorum:
             raise api_errors.InvalidUploadID(upload_id) from None
 
+    def get_multipart_info(self, bucket: str, object_name: str,
+                           upload_id: str) -> dict:
+        """Session metadata of an in-progress upload (SSE seals etc.)."""
+        fi = self._check_upload_exists(bucket, object_name, upload_id)
+        return dict(fi.metadata)
+
     # -- session lifecycle -------------------------------------------------
 
     def new_multipart_upload(self, bucket: str, object_name: str,

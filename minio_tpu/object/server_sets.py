@@ -222,6 +222,10 @@ class ErasureServerSets:
         z = self._zone_of_upload(bucket, object_name, upload_id)
         return z.abort_multipart_upload(bucket, object_name, upload_id)
 
+    def get_multipart_info(self, bucket, object_name, upload_id):
+        z = self._zone_of_upload(bucket, object_name, upload_id)
+        return z.get_multipart_info(bucket, object_name, upload_id)
+
     def complete_multipart_upload(self, bucket, object_name, upload_id,
                                   parts):
         z = self._zone_of_upload(bucket, object_name, upload_id)

@@ -359,6 +359,10 @@ class ErasureSets:
         return self.get_hashed_set(object_name).abort_multipart_upload(
             bucket, object_name, upload_id)
 
+    def get_multipart_info(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).get_multipart_info(
+            bucket, object_name, upload_id)
+
     def complete_multipart_upload(self, bucket, object_name, upload_id,
                                   parts):
         return self.get_hashed_set(object_name).complete_multipart_upload(

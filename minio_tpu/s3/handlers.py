@@ -1113,12 +1113,17 @@ class S3ApiHandlers:
         from ..features import crypto as sse
         enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
         compressed = bool(md.get(sse.MK_COMPRESS))
-        actual = int(md.get(sse.MK_ACTUAL, info.size))
+        actual = self._plain_size(info, md)
         rng = _parse_range(ctx.header("range"), actual)
         offset, length = (0, actual) if rng is None else rng
 
         if actual <= 0 or length <= 0:
             stream = iter(())
+        elif enc is not None and md.get(sse.MK_SSE_MP) and info.parts:
+            # multipart SSE: parts are independent package streams under
+            # per-part nonces; walk the parts covering the range
+            stream = self._mp_decrypt_stream(ctx, bucket, key, info,
+                                             enc, offset, length)
         elif compressed:
             # compressed payloads have no random access: decode from the
             # start and skip (the reference's s2 path does the same)
@@ -1153,6 +1158,58 @@ class S3ApiHandlers:
         self._notify("s3:ObjectAccessed:Get", bucket, key)
         return HTTPResponse(status=status, headers=headers, stream=stream)
 
+    @staticmethod
+    def _plain_size(info, md: dict) -> int:
+        from ..features import crypto as sse
+        if md.get(sse.MK_SSE_MP) and info.parts:
+            return sum(p.actual_size for p in info.parts)
+        return int(md.get(sse.MK_ACTUAL, info.size))
+
+    def _mp_decrypt_stream(self, ctx, bucket, key, info, enc,
+                           offset: int, length: int) -> Iterator[bytes]:
+        """Decrypt a multipart-SSE object across part boundaries
+        (DecryptBlocksRequestR's part walk, cmd/encryption-v1.go:356)."""
+        from ..features import crypto as sse
+        vid = ctx.query1("versionId")
+        opts = GetOptions(version_id="" if vid == "null" else vid)
+        pkg_full = sse.PKG_SIZE + sse.TAG_SIZE
+
+        def gen():
+            remaining = length
+            want = offset
+            plain_start = 0
+            cipher_start = 0
+            for p in info.parts:
+                psize, csize = p.actual_size, p.size
+                plain_end = plain_start + psize
+                if remaining <= 0:
+                    return
+                if plain_end <= want:
+                    plain_start = plain_end
+                    cipher_start += csize
+                    continue
+                in_off = want - plain_start
+                in_len = min(remaining, psize - in_off)
+                start_pkg = in_off // sse.PKG_SIZE
+                end_pkg = (in_off + in_len - 1) // sse.PKG_SIZE
+                coff = cipher_start + start_pkg * pkg_full
+                clen = min(csize - start_pkg * pkg_full,
+                           (end_pkg - start_pkg + 1) * pkg_full)
+                _, stream = self.obj.get_object(bucket, key, coff, clen,
+                                                opts)
+                pt = sse.decrypt_stream(
+                    stream, enc[0], sse.part_nonce(enc[1], p.number),
+                    start_seq=start_pkg)
+                yield from _skip_take(pt,
+                                      in_off - start_pkg * sse.PKG_SIZE,
+                                      in_len)
+                remaining -= in_len
+                want += in_len
+                plain_start = plain_end
+                cipher_start += csize
+
+        return gen()
+
     def _sse_response_headers(self, md: dict) -> dict:
         from ..features import crypto as sse
         mode = md.get(sse.MK_SSE, "")
@@ -1180,8 +1237,7 @@ class S3ApiHandlers:
             if md.get(sse.MK_SSE) == "C":
                 sse.resolve_get_key(md, ctx.header, self.sse_master_key)
             headers.update(self._sse_response_headers(md))
-            headers["Content-Length"] = md.get(sse.MK_ACTUAL,
-                                               str(info.size))
+            headers["Content-Length"] = str(self._plain_size(info, md))
         else:
             headers["Content-Length"] = str(info.size)
         if short is not None:
@@ -1272,11 +1328,15 @@ class S3ApiHandlers:
     def new_multipart_upload(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:PutObject", bucket, key)
         self.obj.get_bucket_info(bucket)
-        if ctx.header("x-amz-server-side-encryption") or ctx.header(
-                "x-amz-server-side-encryption-customer-algorithm"):
-            raise S3Error("NotImplemented",
-                          "SSE multipart uploads are not supported yet")
         metadata = _extract_metadata(ctx)
+        # SSE multipart: seal one object key now; every part encrypts
+        # under it with a per-part nonce space
+        from ..features import crypto as sse
+        ssec_key = sse.parse_ssec_headers(ctx.header)
+        sse_s3 = ctx.header("x-amz-server-side-encryption") == "AES256" \
+            and ssec_key is None
+        sse.create_sse_seals(metadata, ssec_key, sse_s3,
+                             self.sse_master_key, multipart=True)
         upload_id = self.obj.new_multipart_upload(
             bucket, key, PutOptions(metadata=metadata))
         return HTTPResponse().with_xml(
@@ -1295,6 +1355,16 @@ class S3ApiHandlers:
         reader, size = self._put_reader(ctx)
         if size > MAX_PART_SIZE:
             raise S3Error("EntityTooLarge")
+        # SSE upload: encrypt the part under the session's object key
+        from ..features import crypto as sse
+        md = self.obj.get_multipart_info(bucket, key, upload_id)
+        if md.get(sse.MK_SSE):
+            enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+            reader = sse.PutObjReader(
+                reader, [sse.Encryptor(enc[0],
+                                       sse.part_nonce(enc[1],
+                                                      part_number))])
+            size = -1
         part = self.obj.put_object_part(bucket, key, upload_id,
                                         part_number, reader, size)
         return HTTPResponse(headers={"ETag": f'"{part.etag}"'})
@@ -1306,6 +1376,11 @@ class S3ApiHandlers:
             part_number = int(ctx.query1("partNumber"))
         except ValueError:
             raise S3Error("InvalidArgument", "partNumber must be an int")
+        from ..features import crypto as sse
+        if self.obj.get_multipart_info(bucket, key,
+                                       upload_id).get(sse.MK_SSE):
+            raise S3Error("NotImplemented",
+                          "copy-part into SSE uploads is not supported")
         src_bucket, src_key, src_vid = _parse_copy_source(
             ctx.header("x-amz-copy-source"))
         opts = GetOptions(version_id=src_vid)

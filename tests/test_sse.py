@@ -232,3 +232,79 @@ def test_copy_preserves_encryption(client):
     assert st == 200
     st, h, got = client.request("GET", "/sseb/dst.dat")
     assert st == 200 and got == payload
+
+def _multipart_sse(client, key_headers, bucket_key, parts_payloads):
+    st, _, body = client.request("POST", bucket_key, query={"uploads": ""},
+                                 headers=dict(key_headers))
+    assert st == 200, body
+    import xml.etree.ElementTree as ET
+    ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+    root = ET.fromstring(body)
+    uid = (root.find("s3:UploadId", ns) if root.find("s3:UploadId", ns)
+           is not None else root.find("UploadId")).text
+    etags = []
+    for i, payload in enumerate(parts_payloads, start=1):
+        st, h, body = client.request(
+            "PUT", bucket_key, query={"uploadId": uid,
+                                      "partNumber": str(i)},
+            body=payload, headers=dict(key_headers))
+        assert st == 200, body
+        etags.append(h["etag"].strip('"'))
+        # part ETag is the PLAINTEXT md5
+        assert etags[-1] == hashlib.md5(payload).hexdigest()
+    complete = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, start=1)) + \
+        "</CompleteMultipartUpload>"
+    st, _, body = client.request("POST", bucket_key,
+                                 query={"uploadId": uid},
+                                 body=complete.encode())
+    assert st == 200, body
+    return uid
+
+
+def test_multipart_sse_s3_roundtrip(client):
+    p1 = os.urandom(5 << 20)                       # 5 MiB min part
+    p2 = os.urandom(sse.PKG_SIZE + 12345)
+    _multipart_sse(client, {"x-amz-server-side-encryption": "AES256"},
+                   "/sseb/mp-s3.bin", [p1, p2])
+    want = p1 + p2
+    st, h, got = client.request("GET", "/sseb/mp-s3.bin")
+    assert st == 200 and got == want
+    assert int(h["content-length"]) == len(want)
+    assert h.get("x-amz-server-side-encryption") == "AES256"
+    # HEAD shows the plaintext size
+    st, h, _ = client.request("HEAD", "/sseb/mp-s3.bin")
+    assert int(h["content-length"]) == len(want)
+    # ranged reads across the part boundary
+    for start, end in ((0, 99), (len(p1) - 50, len(p1) + 50),
+                      (len(want) - 100, len(want) - 1)):
+        st, _, got = client.request(
+            "GET", "/sseb/mp-s3.bin",
+            headers={"range": f"bytes={start}-{end}"})
+        assert st == 206 and got == want[start:end + 1], (start, end)
+
+
+def test_multipart_sse_c_requires_key_per_part(client):
+    key = os.urandom(32)
+    p1 = os.urandom(5 << 20)
+    p2 = os.urandom(100_000)
+    _multipart_sse(client, ssec_headers(key), "/sseb/mp-c.bin", [p1, p2])
+    # GET without key denied; with key returns the plaintext
+    assert client.request("GET", "/sseb/mp-c.bin")[0] == 403
+    st, _, got = client.request("GET", "/sseb/mp-c.bin",
+                                headers=ssec_headers(key))
+    assert st == 200 and got == p1 + p2
+
+    # a part upload without the key is rejected
+    st, _, body = client.request("POST", "/sseb/mp-c2.bin",
+                                 query={"uploads": ""},
+                                 headers=ssec_headers(key))
+    assert st == 200
+    import xml.etree.ElementTree as ET
+    uid = [e.text for e in ET.fromstring(body).iter()
+           if e.tag.endswith("UploadId")][0]
+    st, _, _ = client.request("PUT", "/sseb/mp-c2.bin",
+                              query={"uploadId": uid, "partNumber": "1"},
+                              body=b"x" * 1000)
+    assert st == 403
