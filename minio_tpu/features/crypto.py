@@ -282,22 +282,8 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
         size = -1
 
     if ssec_key is not None or sse_s3:
-        if ssec_key is not None:
-            sealing = ssec_key
-            metadata[MK_SSE] = "C"
-            metadata[MK_KEYMD5] = base64.b64encode(
-                hashlib.md5(ssec_key).digest()).decode()
-        else:
-            if master_key is None:
-                raise S3Error(
-                    "ServerSideEncryptionConfigurationNotFoundError")
-            sealing = master_key
-            metadata[MK_SSE] = "S3"
-        oek = secrets.token_bytes(32)
-        nonce_base = secrets.token_bytes(12)
-        metadata[MK_SEALED] = base64.b64encode(
-            seal_key(sealing, oek)).decode()
-        metadata[MK_IV] = base64.b64encode(nonce_base).decode()
+        oek, nonce_base = create_sse_seals(metadata, ssec_key, sse_s3,
+                                           master_key)
         transforms.append(Encryptor(oek, nonce_base))
         if size >= 0:
             size = encrypted_size(size)
@@ -310,11 +296,13 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
 
 def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
                      sse_s3: bool, master_key: Optional[bytes],
-                     multipart: bool = False) -> None:
-    """Generate + seal a fresh object key into `metadata` without
-    wrapping any stream — the multipart-create path (each part encrypts
-    later with a per-part nonce; cmd/encryption-v1.go multipart
-    part-size math analog)."""
+                     multipart: bool = False
+                     ) -> Optional[tuple[bytes, bytes]]:
+    """Generate + seal a fresh object key into `metadata`; returns
+    (object key, nonce base) for callers that wrap a stream now (the
+    single-PUT path), or None when no SSE was requested. Multipart
+    uploads seal at create and encrypt each part later with a per-part
+    nonce (cmd/encryption-v1.go multipart part math analog)."""
     from ..s3.s3errors import S3Error
     if ssec_key is not None:
         sealing = ssec_key
@@ -327,13 +315,14 @@ def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
         sealing = master_key
         metadata[MK_SSE] = "S3"
     else:
-        return
+        return None
     oek = secrets.token_bytes(32)
     nonce_base = secrets.token_bytes(12)
     metadata[MK_SEALED] = base64.b64encode(seal_key(sealing, oek)).decode()
     metadata[MK_IV] = base64.b64encode(nonce_base).decode()
     if multipart:
         metadata[MK_SSE_MP] = "true"
+    return oek, nonce_base
 
 
 def part_nonce(nonce_base: bytes, part_number: int) -> bytes:

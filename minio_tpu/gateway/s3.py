@@ -31,6 +31,10 @@ def _map_err(e: S3ClientError, bucket: str, key: str = "") -> Exception:
 class S3GatewayObjects:
     """ObjectLayer over a remote S3 endpoint."""
 
+    # parts are buffered and re-uploaded whole; local SSE would break
+    # part-ETag semantics (the handler checks this capability)
+    supports_sse_multipart = False
+
     def __init__(self, client: S3Client):
         self.c = client
 

@@ -180,6 +180,11 @@ class FSObjects:
 
     def _info(self, bucket: str, key: str, meta: dict) -> ObjectInfo:
         md = dict(meta.get("metadata", {}))
+        parts = [ObjectPartInfo(number=p["number"], etag=p.get("etag", ""),
+                                size=p.get("size", 0),
+                                actual_size=p.get("actual_size",
+                                                  p.get("size", 0)))
+                 for p in meta.get("parts", [])]
         return ObjectInfo(
             bucket=bucket, name=key, mod_time=meta.get("mod_time", 0.0),
             size=meta.get("size", 0),
@@ -188,6 +193,7 @@ class FSObjects:
             etag=meta.get("etag", ""),
             content_type=md.get("content-type", ""),
             content_encoding=md.get("content-encoding", ""),
+            parts=parts,
             user_defined={k: v for k, v in md.items()
                           if k not in ("content-type",
                                        "content-encoding")})
@@ -486,7 +492,13 @@ class FSObjects:
         etag = (hashlib.md5(b"".join(md5s)).hexdigest()
                 + f"-{len(parts)}")
         meta = {"etag": etag, "metadata": info.get("metadata", {}),
-                "size": total, "mod_time": time.time()}
+                "size": total, "mod_time": time.time(),
+                "parts": [{"number": cp.part_number,
+                           "etag": stored[cp.part_number].etag,
+                           "size": stored[cp.part_number].size,
+                           "actual_size":
+                               stored[cp.part_number].actual_size}
+                          for cp in parts]}
         self._save_meta(bucket, key, meta)
         shutil.rmtree(d, ignore_errors=True)
         return self._info(bucket, key, meta)

@@ -186,6 +186,10 @@ class S3ApiHandlers:
         from .trace import TraceSys
         self.trace = TraceSys()   # request tracing + audit hub
         self.config = None        # optional ConfigSys (admin KV)
+        # upload-session metadata cache: immutable after create, so part
+        # uploads don't re-read the session journal per part
+        from collections import OrderedDict
+        self._mpu_meta: "OrderedDict[str, dict]" = OrderedDict()
         from ..features import crypto as sse
         self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
@@ -996,8 +1000,7 @@ class S3ApiHandlers:
         cmd/object-handlers.go:1452-1470)."""
         from ..features import crypto as sse
         ssec_key = sse.parse_ssec_headers(ctx.header)
-        sse_s3 = ctx.header("x-amz-server-side-encryption") == "AES256" \
-            and ssec_key is None
+        sse_s3 = self._sse_s3_requested(ctx, ssec_key)
         compress = (self.compression_enabled
                     and sse.is_compressible(
                         key, metadata.get("content-type", "")))
@@ -1122,7 +1125,7 @@ class S3ApiHandlers:
         elif enc is not None and md.get(sse.MK_SSE_MP) and info.parts:
             # multipart SSE: parts are independent package streams under
             # per-part nonces; walk the parts covering the range
-            stream = self._mp_decrypt_stream(ctx, bucket, key, info,
+            stream = self._mp_decrypt_stream(opts, bucket, key, info,
                                              enc, offset, length)
         elif compressed:
             # compressed payloads have no random access: decode from the
@@ -1158,6 +1161,32 @@ class S3ApiHandlers:
         self._notify("s3:ObjectAccessed:Get", bucket, key)
         return HTTPResponse(status=status, headers=headers, stream=stream)
 
+    def _multipart_meta(self, bucket: str, key: str,
+                        upload_id: str) -> dict:
+        """Session metadata with a bounded cache (immutable after
+        create; avoids one journal read per part upload)."""
+        cache_key = f"{bucket}/{key}/{upload_id}"
+        md = self._mpu_meta.get(cache_key)
+        if md is None:
+            md = self.obj.get_multipart_info(bucket, key, upload_id)
+            self._mpu_meta[cache_key] = md
+            while len(self._mpu_meta) > 1024:
+                self._mpu_meta.popitem(last=False)
+        return md
+
+    def _sse_s3_requested(self, ctx, ssec_key) -> bool:
+        """Validate x-amz-server-side-encryption: only AES256 (SSE-S3)
+        is supported — aws:kms etc. must error, never silently store
+        plaintext after an encryption request."""
+        algo = ctx.header("x-amz-server-side-encryption")
+        if not algo or ssec_key is not None:
+            return False
+        if algo != "AES256":
+            raise S3Error("NotImplemented",
+                          f"server-side encryption {algo!r} is not "
+                          "supported (use AES256)")
+        return True
+
     @staticmethod
     def _plain_size(info, md: dict) -> int:
         from ..features import crypto as sse
@@ -1165,13 +1194,11 @@ class S3ApiHandlers:
             return sum(p.actual_size for p in info.parts)
         return int(md.get(sse.MK_ACTUAL, info.size))
 
-    def _mp_decrypt_stream(self, ctx, bucket, key, info, enc,
+    def _mp_decrypt_stream(self, opts, bucket, key, info, enc,
                            offset: int, length: int) -> Iterator[bytes]:
         """Decrypt a multipart-SSE object across part boundaries
         (DecryptBlocksRequestR's part walk, cmd/encryption-v1.go:356)."""
         from ..features import crypto as sse
-        vid = ctx.query1("versionId")
-        opts = GetOptions(version_id="" if vid == "null" else vid)
         pkg_full = sse.PKG_SIZE + sse.TAG_SIZE
 
         def gen():
@@ -1333,8 +1360,12 @@ class S3ApiHandlers:
         # under it with a per-part nonce space
         from ..features import crypto as sse
         ssec_key = sse.parse_ssec_headers(ctx.header)
-        sse_s3 = ctx.header("x-amz-server-side-encryption") == "AES256" \
-            and ssec_key is None
+        sse_s3 = self._sse_s3_requested(ctx, ssec_key)
+        if (ssec_key is not None or sse_s3) and not getattr(
+                self.obj, "supports_sse_multipart", True):
+            raise S3Error("NotImplemented",
+                          "SSE multipart is not supported on this "
+                          "backend")
         sse.create_sse_seals(metadata, ssec_key, sse_s3,
                              self.sse_master_key, multipart=True)
         upload_id = self.obj.new_multipart_upload(
@@ -1357,7 +1388,7 @@ class S3ApiHandlers:
             raise S3Error("EntityTooLarge")
         # SSE upload: encrypt the part under the session's object key
         from ..features import crypto as sse
-        md = self.obj.get_multipart_info(bucket, key, upload_id)
+        md = self._multipart_meta(bucket, key, upload_id)
         if md.get(sse.MK_SSE):
             enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
             reader = sse.PutObjReader(
@@ -1377,8 +1408,8 @@ class S3ApiHandlers:
         except ValueError:
             raise S3Error("InvalidArgument", "partNumber must be an int")
         from ..features import crypto as sse
-        if self.obj.get_multipart_info(bucket, key,
-                                       upload_id).get(sse.MK_SSE):
+        if self._multipart_meta(bucket, key,
+                                upload_id).get(sse.MK_SSE):
             raise S3Error("NotImplemented",
                           "copy-part into SSE uploads is not supported")
         src_bucket, src_key, src_vid = _parse_copy_source(

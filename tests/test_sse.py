@@ -308,3 +308,39 @@ def test_multipart_sse_c_requires_key_per_part(client):
                               query={"uploadId": uid, "partNumber": "1"},
                               body=b"x" * 1000)
     assert st == 403
+
+
+def test_sse_kms_algo_rejected(client):
+    st, _, body = client.request(
+        "PUT", "/sseb/kms.bin", body=b"x",
+        headers={"x-amz-server-side-encryption": "aws:kms"})
+    assert st == 501
+    st, _, _ = client.request(
+        "POST", "/sseb/kmsmp.bin", query={"uploads": ""},
+        headers={"x-amz-server-side-encryption": "aws:kms"})
+    assert st == 501
+
+
+def test_multipart_sse_on_fs_backend(tmp_path):
+    """The FS backend records part boundaries, so multipart SSE decrypts
+    there too (single-drive deployments)."""
+    from minio_tpu.object.fs import FSObjects
+    fs = FSObjects(str(tmp_path / "fsmp"))
+    srv = S3Server(fs, creds=CREDS, region=REGION).start()
+    srv.api.sse_master_key = MASTER
+    try:
+        c = Client(srv.port)
+        assert c.request("PUT", "/fsb")[0] == 200
+        p1 = os.urandom(5 << 20)
+        p2 = os.urandom(70_000)
+        _multipart_sse(c, {"x-amz-server-side-encryption": "AES256"},
+                       "/fsb/mp.bin", [p1, p2])
+        st, h, got = c.request("GET", "/fsb/mp.bin")
+        assert st == 200 and got == p1 + p2
+        assert int(h["content-length"]) == len(p1) + len(p2)
+        st, _, got = c.request(
+            "GET", "/fsb/mp.bin",
+            headers={"range": f"bytes={len(p1) - 10}-{len(p1) + 9}"})
+        assert st == 206 and got == (p1 + p2)[len(p1) - 10:len(p1) + 10]
+    finally:
+        srv.stop()
