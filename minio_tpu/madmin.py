@@ -1,0 +1,176 @@
+"""Admin client SDK (reference pkg/madmin): a typed Python client for
+the /minio/admin/v3 surface, /minio/health, and the metrics endpoint —
+what `mc admin ...` scripts against."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import urllib.parse
+from typing import Iterator, Optional
+
+from .s3 import signature as sig
+from .s3.credentials import Credentials
+
+ADMIN_PREFIX = "/minio/admin/v3"
+
+
+class AdminClientError(Exception):
+    def __init__(self, status: int, payload: dict):
+        super().__init__(f"{status}: {payload}")
+        self.status = status
+        self.payload = payload
+
+
+class AdminClient:
+    def __init__(self, host: str, port: int, access_key: str,
+                 secret_key: str, region: str = "us-east-1",
+                 timeout: float = 30.0):
+        self.host, self.port = host, port
+        self.creds = Credentials(access_key, secret_key)
+        self.region = region
+        self.timeout = timeout
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, sub: str,
+                 query: Optional[dict] = None, body: bytes = b"",
+                 prefix: str = ADMIN_PREFIX, sign: bool = True):
+        path = f"{prefix}/{sub}" if sub else prefix
+        query = {k: [v] for k, v in (query or {}).items()}
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        hdrs = {"host": f"{self.host}:{self.port}"}
+        if sign:
+            hdrs = sig.sign_v4(method, path, query, hdrs,
+                               hashlib.sha256(body).hexdigest(),
+                               self.creds, self.region)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        conn.request(method, path + (f"?{qs}" if qs else ""), body=body,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if resp.status >= 300:
+            try:
+                payload = json.loads(data.decode())
+            except ValueError:
+                payload = {"raw": data.decode(errors="replace")}
+            raise AdminClientError(resp.status, payload)
+        return data
+
+    def _json(self, method, sub, query=None, body: bytes = b""):
+        out = self._request(method, sub, query, body)
+        return json.loads(out.decode()) if out else {}
+
+    # -- info / health -----------------------------------------------------
+
+    def server_info(self) -> dict:
+        return self._json("GET", "info")
+
+    def storage_info(self) -> dict:
+        return self._json("GET", "storageinfo")
+
+    def data_usage_info(self) -> dict:
+        return self._json("GET", "datausageinfo")
+
+    def top_locks(self) -> dict:
+        return self._json("GET", "top/locks")
+
+    def alive(self) -> bool:
+        try:
+            self._request("GET", "live", prefix="/minio/health",
+                          sign=False)
+            return True
+        except AdminClientError:
+            return False
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "", prefix="/minio/prometheus/metrics",
+                             sign=False).decode()
+
+    # -- heal --------------------------------------------------------------
+
+    def heal_start(self, bucket: str = "", prefix: str = "") -> str:
+        out = self._json("POST", "heal",
+                         {"bucket": bucket, "prefix": prefix})
+        return out["token"]
+
+    def heal_status(self, token: str) -> dict:
+        return self._json("GET", "heal/status", {"token": token})
+
+    # -- IAM ---------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str) -> None:
+        self._json("PUT", "add-user", {"accessKey": access_key},
+                   json.dumps({"secretKey": secret_key}).encode())
+
+    def remove_user(self, access_key: str) -> None:
+        self._json("DELETE", "remove-user", {"accessKey": access_key})
+
+    def list_users(self) -> list[str]:
+        return self._json("GET", "list-users")["users"]
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        self._json("PUT", "set-user-status",
+                   {"accessKey": access_key, "status": status})
+
+    def add_canned_policy(self, name: str, policy_json: str) -> None:
+        self._json("PUT", "add-canned-policy", {"name": name},
+                   policy_json.encode())
+
+    def remove_canned_policy(self, name: str) -> None:
+        self._json("DELETE", "remove-canned-policy", {"name": name})
+
+    def list_canned_policies(self) -> list[str]:
+        return self._json("GET", "list-canned-policies")["policies"]
+
+    def set_policy(self, policy_name: str, user_or_group: str,
+                   is_group: bool = False) -> None:
+        self._json("PUT", "set-user-or-group-policy",
+                   {"policyName": policy_name,
+                    "userOrGroup": user_or_group,
+                    "isGroup": "true" if is_group else "false"})
+
+    def add_service_account(self, parent: str, access_key: str = "",
+                            secret_key: str = "") -> dict:
+        return self._json("PUT", "add-service-account", None,
+                          json.dumps({"parent": parent,
+                                      "accessKey": access_key,
+                                      "secretKey": secret_key}).encode())
+
+    # -- config KV ---------------------------------------------------------
+
+    def get_config(self) -> dict:
+        return self._json("GET", "get-config")
+
+    def set_config(self, subsys: str, **kv) -> None:
+        self._json("PUT", "set-config", {"subsys": subsys},
+                   json.dumps(kv).encode())
+
+    def config_history(self) -> list[str]:
+        return self._json("GET", "config-history")["entries"]
+
+    def restore_config(self, entry: str) -> None:
+        self._json("PUT", "restore-config", {"entry": entry})
+
+    # -- trace / profiling -------------------------------------------------
+
+    def trace(self, count: int = 10, idle: float = 5.0
+              ) -> Iterator[dict]:
+        """Stream live trace entries (blocks until idle/count)."""
+        data = self._request("GET", "trace", {"count": str(count),
+                                              "idle": str(idle)})
+        for line in data.splitlines():
+            if line.strip():
+                yield json.loads(line)
+
+    def cluster_trace(self) -> list[dict]:
+        return self._json("GET", "trace/cluster")["entries"]
+
+    def profiling_start(self) -> dict:
+        return self._json("POST", "profiling/start")
+
+    def profiling_stop(self) -> str:
+        return self._request("POST", "profiling/stop").decode()

@@ -179,3 +179,46 @@ def test_admin_profiling(client, server):
     # stop again: error
     st, _ = client.request("POST", "/minio/admin/v3/profiling/stop")
     assert st == 400
+
+
+def test_madmin_client_sdk(server):
+    """The typed admin SDK against the live server (pkg/madmin analog)."""
+    from minio_tpu.madmin import AdminClient, AdminClientError
+    mc = AdminClient("127.0.0.1", server.port, CREDS.access_key,
+                     CREDS.secret_key)
+    assert mc.alive()
+    assert mc.server_info()["storage"]["online_disks"] == 4
+    assert mc.storage_info()["online_disks"] == 4
+
+    mc.add_user("sdkuser12345", "sdksecret12345")
+    assert "sdkuser12345" in mc.list_users()
+    mc.set_policy("readonly", "sdkuser12345")
+    svc = mc.add_service_account("sdkuser12345")
+    assert svc["accessKey"]
+    mc.remove_user("sdkuser12345")
+    assert "sdkuser12345" not in mc.list_users()
+
+    pol = json.dumps({"Statement": [{"Effect": "Allow",
+                                     "Action": ["s3:GetObject"],
+                                     "Resource": ["*"]}]})
+    mc.add_canned_policy("sdkpol", pol)
+    assert "sdkpol" in mc.list_canned_policies()
+    mc.remove_canned_policy("sdkpol")
+
+    mc.set_config("scanner", interval="90s")
+    assert mc.get_config()["scanner"]["interval"] == "90s"
+
+    token = mc.heal_start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = mc.heal_status(token)
+        if st["status"] != "running":
+            break
+        time.sleep(0.1)
+    assert st["status"] == "done"
+    assert "minio_disks_online" in mc.metrics_text()
+
+    # bad creds -> typed error
+    bad = AdminClient("127.0.0.1", server.port, "nope", "nopenopenope1")
+    with pytest.raises(AdminClientError):
+        bad.server_info()
