@@ -57,10 +57,10 @@ def bench_device() -> tuple[float, dict]:
     dd = jax.device_put(data)
 
     # correctness gate: shards AND digests byte-identical to the oracle
-    full, digests = put_step(dd[:1], K, M)
-    full, digests = np.asarray(full)[0], np.asarray(digests)[0]
+    parity, digests = put_step(dd[:1], K, M)
+    parity, digests = np.asarray(parity)[0], np.asarray(digests)[0]
     want = rs_ref.encode(data[0], M)
-    assert (full == want).all(), "device encode diverges from oracle"
+    assert (parity == want[K:]).all(), "device encode diverges from oracle"
     for row in (0, K, N_SHARDS - 1):
         want_dg = bitrot_mod.hash_shard(
             want[row], bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256)
@@ -72,8 +72,12 @@ def bench_device() -> tuple[float, dict]:
         def loop(d):
             def body(i, c):
                 d2 = d ^ c.astype(jnp.uint8)
-                shards, digs = put_step(d2, K, M)
-                return (c + digs.reshape(-1)[0].astype(jnp.int32)) & 127
+                parity, digs = put_step(d2, K, M)
+                # consume EVERY output element: a carry that reads one
+                # element lets XLA dead-code entire branches (digests of
+                # unread rows), understating the work
+                return (c + digs.astype(jnp.int32).sum()
+                        + parity.astype(jnp.int32).sum()) & 127
             return jax.lax.fori_loop(0, iters, body, jnp.int32(1))
         return loop
 

@@ -129,13 +129,12 @@ class Codec:
         if path != "device":
             return None
         from ..models.pipeline import put_step
-        full, digests = put_step(data, self.k, self.m, algo=kernel)
-        # fetch only what the host doesn't have: the m parity rows + the
-        # digests (the k data rows are the caller's own bytes; reading
-        # them back would 4x the device->host traffic at EC 12+4)
-        parity = np.asarray(full[:, self.k:, :])
-        return (np.concatenate([np.asarray(data, np.uint8), parity],
-                               axis=1), np.asarray(digests))
+        parity, digests = put_step(data, self.k, self.m, algo=kernel)
+        # only parity + digests cross back from the device; the k data
+        # rows are the caller's own bytes
+        return (np.concatenate([np.asarray(data, np.uint8),
+                                np.asarray(parity)], axis=1),
+                np.asarray(digests))
 
     # -- batched decode (degraded GET) -------------------------------------
 

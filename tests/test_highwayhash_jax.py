@@ -51,13 +51,13 @@ def test_put_step_fused_oracle():
     s = 1031  # odd length exercises the remainder path
     rng = np.random.default_rng(5)
     data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
-    full, digests = put_step(data, k, m)
-    full, digests = np.asarray(full), np.asarray(digests)
-    assert full.shape == (2, k + m, s)
+    parity, digests = put_step(data, k, m)
+    parity, digests = np.asarray(parity), np.asarray(digests)
+    assert parity.shape == (2, m, s)
     assert digests.shape == (2, k + m, 32)
     for b in range(2):
         want = rs_ref.encode(data[b], m)
-        assert (full[b] == want).all()
+        assert (parity[b] == want[k:]).all()
         for row in range(k + m):
             assert digests[b, row].tobytes() == _want(want[row].tobytes())
 
@@ -71,9 +71,9 @@ def test_put_step_padded_shard_len():
     rng = np.random.default_rng(6)
     data = rng.integers(0, 256, (1, k, s), dtype=np.uint8)
     padded = np.pad(data, ((0, 0), (0, 0), (0, pad)))
-    full_p, dg_p = put_step(padded, k, m, s)
-    full, dg = put_step(data, k, m)
-    assert (np.asarray(full_p)[..., :s] == np.asarray(full)).all()
+    par_p, dg_p = put_step(padded, k, m, s)
+    par, dg = put_step(data, k, m)
+    assert (np.asarray(par_p)[..., :s] == np.asarray(par)).all()
     assert (np.asarray(dg_p) == np.asarray(dg)).all()
 
 
