@@ -42,6 +42,8 @@ def _parse(argv: list[str]) -> argparse.Namespace:
                    help="drives per erasure set (default: auto 4..16)")
     s.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
+    s.add_argument("--cert", default="", help="TLS certificate file")
+    s.add_argument("--key", default="", help="TLS private key file")
     return p.parse_args(argv)
 
 
@@ -59,7 +61,8 @@ def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
     kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
-              region=args.region)
+              region=args.region,
+              certfile=args.cert or None, keyfile=args.key or None)
 
     if args.node:
         if args.this < 0 or args.this >= len(args.node):

@@ -67,7 +67,10 @@ class ClusterNode:
                  set_drive_count: int = 0, block_size: int = 1 << 22,
                  region: str = "us-east-1", iam=None,
                  bootstrap_timeout: float = 30.0,
-                 format_timeout: float = 30.0):
+                 format_timeout: float = 30.0,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
+        self._tls = (certfile, keyfile)
         self.nodes = nodes
         self.this = this
         self.creds = creds
@@ -256,9 +259,11 @@ class ClusterNode:
     # ------------------------------------------------------------------
 
     def _start_server(self, region: str, iam) -> None:
+        certfile, keyfile = getattr(self, "_tls", (None, None))
         self.s3 = S3Server(None, address=self.spec.host,
                            port=self.spec.port, region=region,
-                           creds=self.creds, iam=iam)
+                           creds=self.creds, iam=iam,
+                           certfile=certfile, keyfile=keyfile)
         self.s3.register_router("/minio/storage/",
                                 self._storage_rpc.route)
         self.s3.register_router("/minio/lock/", self._lock_rpc.route)

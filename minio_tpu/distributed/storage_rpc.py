@@ -96,6 +96,7 @@ class StorageRPCServer:
         for verb in ("diskinfo", "getdiskid", "setdiskid", "makevol",
                      "listvols", "statvol", "deletevol", "writemetadata",
                      "readversion", "readversions", "deleteversion",
+                     "deleteversions",
                      "renamedata", "listdir", "readfile", "appendfile",
                      "createfile", "renamefile", "checkparts",
                      "checkfile", "deletefile", "verifyfile", "writeall",
@@ -154,6 +155,16 @@ class StorageRPCServer:
     def _deleteversion(self, a, b):
         self._disk(a).delete_version(a["volume"], a["path"],
                                      fi_from_dict(json.loads(b.decode())))
+
+    def _deleteversions(self, a, b):
+        """Bulk delete: N versions in one round trip (reference
+        storageRESTMethodDeleteVersions). Per-item results travel as
+        [null | {kind, message}]."""
+        fis = [fi_from_dict(d) for d in json.loads(b.decode())]
+        errs = self._disk(a).delete_versions(a["volume"], fis)
+        return [None if e is None else
+                {"kind": type(e).__name__, "message": str(e)}
+                for e in errs]
 
     def _renamedata(self, a, b):
         self._disk(a).rename_data(a["src-volume"], a["src-path"],
@@ -312,6 +323,25 @@ class RemoteStorage(StorageAPI):
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
         self._call("deleteversion", {"volume": volume, "path": path},
                    json.dumps(fi_to_dict(fi)).encode())
+
+    def delete_versions(self, volume: str, versions: list[FileInfo]
+                        ) -> list[Optional[Exception]]:
+        """N deletes, ONE wire round trip (the r1 review's 'serial bulk
+        delete' fix; reference DeleteVersions RPC)."""
+        out = self._call_json(
+            "deleteversions", {"volume": volume},
+            json.dumps([fi_to_dict(fi) for fi in versions]).encode())
+        errs: list[Optional[Exception]] = []
+        for item in out or []:
+            if item is None:
+                errs.append(None)
+                continue
+            cls = _ERR_CLASSES.get(item.get("kind", ""),
+                                   serr.UnexpectedError)
+            errs.append(cls(item.get("message", "")))
+        while len(errs) < len(versions):
+            errs.append(serr.UnexpectedError("missing bulk result"))
+        return errs
 
     def rename_data(self, src_volume: str, src_path: str, data_dir: str,
                     dst_volume: str, dst_path: str) -> None:

@@ -713,13 +713,36 @@ class ErasureObjects:
 
     def delete_objects(self, bucket: str, objects: list[str]
                        ) -> list[Optional[Exception]]:
+        """Bulk delete: ONE storage call per drive for the whole batch
+        (reference DeleteObjects, cmd/erasure-object.go:772 — not a loop
+        of single deletes), with per-key quorum evaluation."""
+        if not objects:
+            return []
+        import copy
+        _k, _m, _, write_quorum = self._default_quorums()
+        fis = [FileInfo(volume=bucket, name=o) for o in objects]
+        with self.ns.new_lock(
+                *[f"{bucket}/{o}" for o in objects]).write_locked():
+            def bulk(i, d):
+                return d.delete_versions(bucket,
+                                         [copy.deepcopy(f) for f in fis])
+
+            results, disk_errs = meta.for_each_disk(self.disks, bulk)
+
         out: list[Optional[Exception]] = []
-        for o in objects:
-            try:
-                self.delete_object(bucket, o)
-                out.append(None)
-            except Exception as e:  # noqa: BLE001 — per-key result list
-                out.append(e)
+        for j, o in enumerate(objects):
+            per_disk: list[Optional[Exception]] = []
+            for res, derr in zip(results, disk_errs):
+                if derr is not None:
+                    per_disk.append(derr)      # whole drive failed
+                elif res is not None and j < len(res):
+                    per_disk.append(res[j])
+                else:
+                    per_disk.append(serr.DiskNotFound("no result"))
+            err = meta.reduce_write_quorum_errs(
+                per_disk, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+            out.append(None if err is None
+                       else api_errors.to_object_err(err, bucket, o))
         return out
 
     # ------------------------------------------------------------------

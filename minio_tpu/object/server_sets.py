@@ -156,6 +156,9 @@ class ErasureServerSets:
         raise api_errors.ObjectNotFound(bucket, object_name)
 
     def delete_objects(self, bucket, objects):
+        if self.single_zone():
+            self.get_bucket_info(bucket)
+            return self.server_sets[0].delete_objects(bucket, objects)
         out = []
         for o in objects:
             try:

@@ -304,14 +304,18 @@ class ErasureSets:
             bucket, object_name, version_id, versioned)
 
     def delete_objects(self, bucket, objects):
-        return [self._try_delete(bucket, o) for o in objects]
-
-    def _try_delete(self, bucket, object_name):
-        try:
-            self.delete_object(bucket, object_name)
-            return None
-        except Exception as e:  # noqa: BLE001 — per-key result list
-            return e
+        """Bulk delete grouped by erasure set: each set's batch goes to
+        its engine's one-call-per-drive path."""
+        by_set: dict[int, list[int]] = {}
+        for j, o in enumerate(objects):
+            by_set.setdefault(self.get_hashed_set_index(o), []).append(j)
+        out: list = [None] * len(objects)
+        for si, idxs in by_set.items():
+            errs = self.sets[si].delete_objects(
+                bucket, [objects[j] for j in idxs])
+            for j, e in zip(idxs, errs):
+                out[j] = e
+        return out
 
     def heal_object(self, bucket, object_name, version_id="",
                     deep_scan=False, dry_run=False):

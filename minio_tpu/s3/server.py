@@ -167,7 +167,9 @@ class S3Server:
 
     def __init__(self, object_layer, address: str = "127.0.0.1",
                  port: int = 0, region: str = "us-east-1",
-                 creds: Optional[Credentials] = None, iam=None):
+                 creds: Optional[Credentials] = None, iam=None,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
         self.api = S3ApiHandlers(object_layer, region=region, creds=creds,
                                  iam=iam)
         self.extra_routers: list = []
@@ -175,6 +177,13 @@ class S3Server:
             (address, port),
             _make_handler_class(self.api, self.extra_routers))
         self._httpd.daemon_threads = True
+        self.tls = bool(certfile)
+        if certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -184,7 +193,8 @@ class S3Server:
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def register_router(self, prefix: str, fn) -> None:
         self.extra_routers.append((prefix, fn))

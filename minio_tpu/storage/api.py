@@ -100,13 +100,18 @@ class StorageAPI(abc.ABC):
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None: ...
 
     def delete_versions(self, volume: str,
-                        versions: list[FileInfo]) -> list[Optional[Exception]]:
+                        versions: list[FileInfo]
+                        ) -> list[Optional[Exception]]:
+        """Bulk version delete: ONE call per drive for N objects
+        (reference DeleteVersions, cmd/storage-rest-common.go). The
+        default loops locally; the storage-RPC client overrides it with
+        a single wire round-trip."""
         out: list[Optional[Exception]] = []
         for fi in versions:
             try:
                 self.delete_version(volume, fi.name, fi)
                 out.append(None)
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — per-item result
                 out.append(e)
         return out
 

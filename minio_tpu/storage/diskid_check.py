@@ -133,6 +133,9 @@ class DiskIDCheck(StorageAPI):
     def delete_version(self, volume, path, fi):
         return self._call(self.inner.delete_version, volume, path, fi)
 
+    def delete_versions(self, volume, versions):
+        return self._call(self.inner.delete_versions, volume, versions)
+
     def rename_data(self, src_volume, src_path, data_dir, dst_volume,
                     dst_path):
         return self._call(self.inner.rename_data, src_volume, src_path,
