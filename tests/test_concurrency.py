@@ -1,0 +1,220 @@
+"""Concurrency races: parallel PUT/GET/DELETE/heal on one erasure set
+and through the live S3 server (the reference runs its whole suite under
+-race and drives mint concurrently; Python's analog is real thread
+interleaving over the same namespace + invariant checks)."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import os
+import random
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.object import api_errors
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+
+CREDS = Credentials("racetestkey1", "racetestsecret1")
+REGION = "us-east-1"
+
+
+@pytest.fixture()
+def sets(tmp_path):
+    s = ErasureSets.from_drives(
+        [str(tmp_path / f"d{i}") for i in range(6)], 1, 6, 2,
+        block_size=1 << 16)
+    yield s
+    s.close()
+
+
+def _run_threads(fns, timeout=120):
+    errs: list = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — surfaced by the test
+            errs.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), "deadlocked threads"
+    return errs
+
+
+def test_concurrent_puts_same_key_last_writer_wins(sets):
+    """N writers hammer ONE key; afterwards the object must be exactly
+    one writer's payload (never interleaved shards)."""
+    sets.make_bucket("race")
+    payloads = [bytes([i]) * 120_000 for i in range(8)]
+
+    def put(i):
+        def run():
+            sets.put_object("race", "contended", payloads[i])
+        return run
+
+    errs = _run_threads([put(i) for i in range(8)])
+    assert errs == []
+    _, stream = sets.get_object("race", "contended")
+    got = b"".join(stream)
+    assert got in payloads, "interleaved write detected"
+
+
+def test_concurrent_put_get_delete_mix(sets):
+    """Readers/writers/deleters over a shared keyspace: every GET must
+    return a complete consistent value or a clean ObjectNotFound."""
+    sets.make_bucket("mix")
+    keys = [f"k{i}" for i in range(6)]
+    for k in keys:
+        sets.put_object("mix", k, hashlib.sha256(k.encode()).digest()
+                        * 2000)
+    stop = threading.Event()
+    bad: list = []
+
+    def writer():
+        rng = random.Random(1)
+        while not stop.is_set():
+            k = rng.choice(keys)
+            sets.put_object("mix", k,
+                            hashlib.sha256(k.encode()).digest() * 2000)
+
+    def reader():
+        rng = random.Random(2)
+        while not stop.is_set():
+            k = rng.choice(keys)
+            try:
+                _, stream = sets.get_object("mix", k)
+                got = b"".join(stream)
+            except (api_errors.ObjectNotFound,
+                    api_errors.InsufficientReadQuorum):
+                continue
+            want = hashlib.sha256(k.encode()).digest() * 2000
+            if got != want:
+                bad.append((k, len(got)))
+
+    def deleter():
+        rng = random.Random(3)
+        while not stop.is_set():
+            k = rng.choice(keys)
+            try:
+                sets.delete_object("mix", k)
+            except api_errors.ObjectApiError:
+                pass
+            sets.put_object("mix", k,
+                            hashlib.sha256(k.encode()).digest() * 2000)
+
+    threads = [threading.Thread(target=f)
+               for f in (writer, writer, reader, reader, reader, deleter)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not bad, f"torn reads: {bad[:3]}"
+
+
+def test_concurrent_heal_and_reads(sets, tmp_path):
+    """Healing a degraded object while readers stream it."""
+    import shutil
+    sets.make_bucket("hb")
+    payload = os.urandom(300_000)
+    sets.put_object("hb", "obj", payload)
+    eng = sets.sets[0]
+    # wipe one drive's shard files for the object (leave format intact)
+    victim = eng.disks[2]
+    shutil.rmtree(os.path.join(victim.root, "hb"), ignore_errors=True)
+
+    def read():
+        for _ in range(5):
+            _, stream = sets.get_object("hb", "obj")
+            assert b"".join(stream) == payload
+
+    def heal():
+        try:
+            eng.heal_bucket("hb")
+            eng.heal_object("hb", "obj")
+        except api_errors.ObjectApiError:
+            pass
+
+    errs = _run_threads([read, read, heal, heal])
+    assert errs == []
+    _, stream = sets.get_object("hb", "obj")
+    assert b"".join(stream) == payload
+
+
+def test_concurrent_multipart_sessions(sets):
+    """Parallel multipart uploads to distinct keys + the same key."""
+    from minio_tpu.object.multipart import CompletePart
+    sets.make_bucket("mpb")
+
+    def upload(key, seed):
+        def run():
+            uid = sets.new_multipart_upload("mpb", key)
+            rng = random.Random(seed)
+            p = bytes([rng.randrange(256)]) * (5 << 20)
+            info = sets.put_object_part("mpb", key, uid, 1, p)
+            sets.complete_multipart_upload(
+                "mpb", key, uid, [CompletePart(1, info.etag)])
+        return run
+
+    errs = _run_threads([upload("a", 1), upload("b", 2), upload("c", 3),
+                         upload("same", 4), upload("same", 5)])
+    assert errs == []
+    for k in ("a", "b", "c", "same"):
+        info = sets.get_object_info("mpb", k)
+        assert info.size == 5 << 20
+
+
+def test_concurrent_s3_requests(tmp_path):
+    """Thread pool hammering the live server across the API surface."""
+    drives = [str(tmp_path / f"d{i}") for i in range(4)]
+    s = ErasureSets.from_drives(drives, 1, 4, 2, block_size=1 << 16)
+    srv = S3Server(s, creds=CREDS, region=REGION).start()
+    try:
+        def req(method, path, body=b"", query=None):
+            query = {k: [v] for k, v in (query or {}).items()}
+            qs = urllib.parse.urlencode(
+                {k: v[0] for k, v in query.items()})
+            hdrs = {"host": f"127.0.0.1:{srv.port}"}
+            hdrs = sig.sign_v4(method, path, query, hdrs,
+                               hashlib.sha256(body).hexdigest(), CREDS,
+                               REGION)
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=60)
+            conn.request(method, path + (f"?{qs}" if qs else ""),
+                         body=body, headers=hdrs)
+            r = conn.getresponse()
+            data = r.read()
+            conn.close()
+            return r.status, data
+
+        assert req("PUT", "/raceb")[0] == 200
+
+        def worker(i):
+            def run():
+                body = bytes([i]) * 50_000
+                assert req("PUT", f"/raceb/o{i}", body=body)[0] == 200
+                st, got = req("GET", f"/raceb/o{i}")
+                assert st == 200 and got == body
+                st, listing = req("GET", "/raceb",
+                                  query={"list-type": "2"})
+                assert st == 200
+                assert req("DELETE", f"/raceb/o{i}")[0] == 204
+            return run
+
+        errs = _run_threads([worker(i) for i in range(12)])
+        assert errs == []
+    finally:
+        srv.stop()
+        s.close()
