@@ -1187,6 +1187,40 @@ class S3ApiHandlers:
                           "supported (use AES256)")
         return True
 
+    def _copy_source_plaintext(self, ctx, src_bucket, src_key, src_info,
+                               opts) -> tuple[Iterator[bytes], int]:
+        """Plaintext stream + size of a copy source, decrypting with the
+        x-amz-copy-source-* SSE-C headers (or the master key) and
+        decompressing as needed."""
+        from ..features import crypto as sse
+        md = src_info.user_defined or {}
+        if not (md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS)):
+            _, stream = self.obj.get_object(src_bucket, src_key, 0,
+                                            src_info.size, opts)
+            return stream, src_info.size
+
+        def src_header(name, default=""):
+            prefix = "x-amz-server-side-encryption-customer"
+            if name.startswith(prefix):
+                return ctx.header(
+                    "x-amz-copy-source-server-side-encryption-customer"
+                    + name[len(prefix):], default)
+            return ctx.header(name, default)
+
+        enc = sse.resolve_get_key(md, src_header, self.sse_master_key)
+        plain_size = self._plain_size(src_info, md)
+        if enc is not None and md.get(sse.MK_SSE_MP) and src_info.parts:
+            return (self._mp_decrypt_stream(opts, src_bucket, src_key,
+                                            src_info, enc, 0, plain_size),
+                    plain_size)
+        _, stream = self.obj.get_object(src_bucket, src_key, 0,
+                                        src_info.size, opts)
+        if enc is not None:
+            stream = sse.decrypt_stream(stream, enc[0], enc[1])
+        if md.get(sse.MK_COMPRESS):
+            stream = sse.decompress_stream(stream)
+        return stream, plain_size
+
     @staticmethod
     def _plain_size(info, md: dict) -> int:
         from ..features import crypto as sse
@@ -1312,23 +1346,70 @@ class S3ApiHandlers:
         if csnm and csnm.strip('"') == src_info.etag:
             raise S3Error("PreconditionFailed")
         directive = ctx.header("x-amz-metadata-directive", "COPY")
+        from ..features import crypto as sse
+        src_md = src_info.user_defined or {}
+        src_transformed = bool(src_md.get(sse.MK_SSE)
+                               or src_md.get(sse.MK_COMPRESS))
+        # target transform request (re-encrypt / encrypt-on-copy), or an
+        # explicit source key (decrypt-on-copy)?
+        tgt_ssec = sse.parse_ssec_headers(ctx.header)
+        tgt_sse_s3 = self._sse_s3_requested(ctx, tgt_ssec)
+        re_transform = (tgt_ssec is not None or tgt_sse_s3
+                        or bool(ctx.header(
+                            "x-amz-copy-source-server-side-encryption-"
+                            "customer-algorithm")))
+
         if directive == "REPLACE":
             metadata = _extract_metadata(ctx)
-            # the stored bytes are copied verbatim: the transform state
-            # (seals, compression flag, actual size) must survive a
-            # metadata REPLACE or the copy is unreadable
-            from ..features import crypto as sse
-            for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
-                       sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL):
-                if ik in src_info.user_defined:
-                    metadata[ik] = src_info.user_defined[ik]
+            if src_transformed and not re_transform:
+                # stored bytes copied verbatim: the transform state
+                # (seals, compression flag, actual size) must survive a
+                # metadata REPLACE or the copy is unreadable
+                for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
+                           sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL,
+                           sse.MK_SSE_MP):
+                    if ik in src_md:
+                        metadata[ik] = src_md[ik]
         else:
-            if src_bucket == bucket and src_key == key:
+            if src_bucket == bucket and src_key == key \
+                    and not re_transform:
                 raise S3Error("InvalidRequest",
                               "self-copy requires metadata directive "
                               "REPLACE")
-            metadata = dict(src_info.user_defined)
+            metadata = dict(src_md)
             metadata["content-type"] = src_info.content_type
+            if re_transform:
+                for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
+                           sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL,
+                           sse.MK_SSE_MP):
+                    metadata.pop(ik, None)
+
+        if re_transform:
+            # re-encryption path (CopyObject with SSE change, reference
+            # re-encrypt wiring in cmd/object-handlers.go CopyObject):
+            # decrypt/decompress the source to plaintext, then apply the
+            # TARGET transforms like a fresh PUT
+            plain_stream, plain_size = self._copy_source_plaintext(
+                ctx, src_bucket, src_key, src_info, opts)
+            if src_bucket == bucket and src_key == key:
+                plain_stream = iter([b"".join(plain_stream)])
+            reader = HashReader(_IterStream(plain_stream), plain_size)
+            metadata["etag"] = src_info.etag
+            reader2, size2 = sse.setup_put_transforms(
+                key_name=key, raw_reader=reader, raw_size=plain_size,
+                metadata=metadata, ssec_key=tgt_ssec, sse_s3=tgt_sse_s3,
+                master_key=self.sse_master_key, compress=False)
+            versioned = self.bucket_meta.versioning_enabled(bucket)
+            info = self.obj.put_object(
+                bucket, key, reader2, size2,
+                PutOptions(metadata=metadata, versioned=versioned))
+            headers = {}
+            if info.version_id and info.version_id != "null":
+                headers["x-amz-version-id"] = info.version_id
+            self._notify("s3:ObjectCreated:Copy", bucket, key)
+            return HTTPResponse(headers=headers).with_xml(
+                xmlgen.copy_object_response(info.etag, info.mod_time))
+
         _, stream = self.obj.get_object(src_bucket, src_key, 0,
                                         src_info.size, opts)
         if src_bucket == bucket and src_key == key:

@@ -344,3 +344,57 @@ def test_multipart_sse_on_fs_backend(tmp_path):
         assert st == 206 and got == (p1 + p2)[len(p1) - 10:len(p1) + 10]
     finally:
         srv.stop()
+
+
+def ssec_copy_source_headers(key: bytes) -> dict:
+    return {("x-amz-copy-source-server-side-encryption-customer-"
+             + k.split("customer-")[1]): v
+            for k, v in ssec_headers(key).items()}
+
+
+def test_copy_rotates_ssec_key(client):
+    """SSE-C key rotation via CopyObject (copy-source key + new key)."""
+    old, new = os.urandom(32), os.urandom(32)
+    payload = os.urandom(120_000)
+    assert client.request("PUT", "/sseb/rot.bin", body=payload,
+                          headers=ssec_headers(old))[0] == 200
+    hdrs = {"x-amz-copy-source": "/sseb/rot.bin",
+            "x-amz-metadata-directive": "REPLACE"}
+    hdrs.update(ssec_copy_source_headers(old))
+    hdrs.update(ssec_headers(new))
+    st, h, body = client.request("PUT", "/sseb/rot.bin", headers=hdrs)
+    assert st == 200, body
+    # old key no longer opens it; new key does; bytes identical
+    assert client.request("GET", "/sseb/rot.bin",
+                          headers=ssec_headers(old))[0] == 403
+    st, _, got = client.request("GET", "/sseb/rot.bin",
+                                headers=ssec_headers(new))
+    assert st == 200 and got == payload
+
+
+def test_copy_encrypts_and_decrypts(client):
+    payload = os.urandom(90_000)
+    assert client.request("PUT", "/sseb/plainsrc.bin",
+                          body=payload)[0] == 200
+    # encrypt-on-copy (plain -> SSE-S3)
+    st, _, _ = client.request(
+        "PUT", "/sseb/enccopy.bin",
+        headers={"x-amz-copy-source": "/sseb/plainsrc.bin",
+                 "x-amz-server-side-encryption": "AES256"})
+    assert st == 200
+    st, h, got = client.request("GET", "/sseb/enccopy.bin")
+    assert st == 200 and got == payload
+    assert h.get("x-amz-server-side-encryption") == "AES256"
+
+    # decrypt-on-copy (SSE-C -> plaintext, via copy-source key only)
+    key = os.urandom(32)
+    assert client.request("PUT", "/sseb/csrc.bin", body=payload,
+                          headers=ssec_headers(key))[0] == 200
+    hdrs = {"x-amz-copy-source": "/sseb/csrc.bin",
+            "x-amz-metadata-directive": "REPLACE"}
+    hdrs.update(ssec_copy_source_headers(key))
+    st, _, _ = client.request("PUT", "/sseb/plain2.bin", headers=hdrs)
+    assert st == 200
+    st, h, got = client.request("GET", "/sseb/plain2.bin")
+    assert st == 200 and got == payload
+    assert "x-amz-server-side-encryption" not in h
