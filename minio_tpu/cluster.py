@@ -231,7 +231,7 @@ class ClusterNode:
 
         # -- live bucket features (events, replication, lifecycle) ---------
         from .features import EventNotifier, ReplicationPool
-        from .features.lifecycle import crawler_action
+        from .features.lifecycle import crawler_action, mpu_abort_action
         self.events = EventNotifier(self.s3.api.bucket_meta)
         self.s3.api.events = self.events
         self.replication = ReplicationPool(self.object_layer,
@@ -253,7 +253,10 @@ class ClusterNode:
                 self.object_layer,
                 actions=[crawler_action(self.s3.api.bucket_meta,
                                         self.object_layer,
-                                        self.events)]).start()
+                                        self.events)],
+                bucket_actions=[mpu_abort_action(
+                    self.s3.api.bucket_meta,
+                    self.object_layer)]).start()
             self.s3.api.usage = self.crawler
 
     # ------------------------------------------------------------------

@@ -118,14 +118,22 @@ class Lifecycle:
                    and object_name.startswith(r.prefix)]
         return max(cutoffs) if cutoffs else None
 
+    def noncurrent_expiry_days(self, object_name: str) -> int:
+        """Strictest NoncurrentDays applying to this key, or 0."""
+        days = [r.noncurrent_days for r in self.rules
+                if r.enabled and r.noncurrent_days
+                and object_name.startswith(r.prefix)]
+        return min(days) if days else 0
+
 
 def crawler_action(bucket_meta_sys, object_layer, notifier=None,
                    now_fn=time.time):
-    """DataUsageCrawler action enforcing lifecycle expiry
-    (cmd/data-crawler.go:629-713). Deletes (or delete-markers, when the
-    bucket is versioned) every eligible current version."""
+    """DataUsageCrawler per-object action enforcing lifecycle expiry
+    (cmd/data-crawler.go:629-713): current-version Expiration (delete or
+    delete-marker when versioned) and NoncurrentVersionExpiration."""
 
     def act(bucket: str, oi) -> None:
+        from ..object import api_errors
         bm = bucket_meta_sys.get(bucket)
         if not bm.lifecycle_xml:
             return
@@ -133,19 +141,70 @@ def crawler_action(bucket_meta_sys, object_layer, notifier=None,
             lc = Lifecycle.from_xml(bm.lifecycle_xml)
         except ET.ParseError:
             return
-        if not lc.is_expired(oi.name, oi.mod_time, now_fn()):
+        now = now_fn()
+        if lc.is_expired(oi.name, oi.mod_time, now):
+            try:
+                object_layer.delete_object(
+                    bucket, oi.name, versioned=bm.versioning_enabled())
+            except api_errors.ObjectApiError:
+                return
+            if notifier is not None:
+                try:
+                    notifier.send("s3:ObjectRemoved:Lifecycle", bucket,
+                                  oi.name)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
             return
+        nc_days = lc.noncurrent_expiry_days(oi.name)
+        if nc_days and bm.versioning_enabled():
+            cutoff = now - nc_days * 86400
+            try:
+                versions = object_layer.list_object_versions(
+                    bucket, prefix=oi.name)
+            except api_errors.ObjectApiError:
+                return
+            for v in versions:
+                if v.name != oi.name or v.is_latest:
+                    continue
+                if v.mod_time < cutoff and v.version_id:
+                    try:
+                        object_layer.delete_object(
+                            bucket, oi.name, version_id=v.version_id)
+                    except api_errors.ObjectApiError:
+                        pass
+
+    return act
+
+
+def mpu_abort_action(bucket_meta_sys, object_layer, now_fn=time.time):
+    """Per-bucket crawler action aborting incomplete multipart uploads
+    past their AbortIncompleteMultipartUpload cutoff
+    (cmd/data-crawler applyActions' multipart sweep)."""
+
+    def act(bucket: str) -> None:
         from ..object import api_errors
+        bm = bucket_meta_sys.get(bucket)
+        if not bm.lifecycle_xml:
+            return
         try:
-            object_layer.delete_object(
-                bucket, oi.name, versioned=bm.versioning_enabled())
+            lc = Lifecycle.from_xml(bm.lifecycle_xml)
+        except ET.ParseError:
+            return
+        if not any(r.enabled and r.abort_mpu_days for r in lc.rules):
+            return
+        try:
+            uploads = object_layer.list_multipart_uploads(bucket)
         except api_errors.ObjectApiError:
             return
-        if notifier is not None:
+        now = now_fn()
+        for up in uploads:
+            cutoff = lc.mpu_abort_before(up["object"], now)
+            if cutoff is None or up.get("initiated", 0.0) >= cutoff:
+                continue
             try:
-                notifier.send("s3:ObjectRemoved:Lifecycle", bucket,
-                              oi.name)
-            except Exception:  # noqa: BLE001 — events are best-effort
+                object_layer.abort_multipart_upload(
+                    bucket, up["object"], up["upload_id"])
+            except api_errors.ObjectApiError:
                 pass
 
     return act

@@ -161,11 +161,15 @@ class DataUsageCrawler:
 
     def __init__(self, object_layer, interval: float = 60.0,
                  actions: Optional[list[Callable]] = None,
+                 bucket_actions: Optional[list[Callable]] = None,
                  persist: bool = True):
         self.obj = object_layer
         self.interval = interval
         # each action: fn(bucket: str, info: ObjectInfo) -> None
         self.actions = list(actions or [])
+        # each bucket action: fn(bucket: str) -> None, once per scan
+        # (stale-multipart abort, bucket-level lifecycle work)
+        self.bucket_actions = list(bucket_actions or [])
         self.persist = persist
         self.usage: dict = {"buckets": {}, "objects_total": 0,
                             "size_total": 0, "last_update": 0.0}
@@ -191,6 +195,11 @@ class DataUsageCrawler:
         buckets: dict[str, dict] = {}
         for vol in self.obj.list_buckets():
             b = vol.name
+            for baction in self.bucket_actions:
+                try:
+                    baction(b)
+                except Exception:  # noqa: BLE001 — per-bucket
+                    pass
             count = size = 0
             marker = ""
             while True:

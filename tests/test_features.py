@@ -221,3 +221,68 @@ def test_replication_end_to_end(tmp_path):
         dst_srv.stop()
         src.close()
         dst.close()
+
+def test_noncurrent_version_expiry(tmp_path):
+    """NoncurrentVersionExpiration removes old versions, keeps the
+    latest."""
+    sets = _mk_sets(tmp_path)
+    api = S3ApiHandlers(sets)
+    sets.make_bucket("ncb")
+    api.bucket_meta.update("ncb", versioning="Enabled")
+    from minio_tpu.object.engine import PutOptions
+    for i in range(3):
+        sets.put_object("ncb", "doc", f"v{i}".encode(),
+                        opts=PutOptions(versioned=True))
+    assert len(sets.list_object_versions("ncb", prefix="doc")) == 3
+
+    lc = ("<LifecycleConfiguration><Rule><ID>nc</ID>"
+          "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+          "<NoncurrentVersionExpiration><NoncurrentDays>1"
+          "</NoncurrentDays></NoncurrentVersionExpiration>"
+          "</Rule></LifecycleConfiguration>")
+    api.bucket_meta.update("ncb", lifecycle_xml=lc)
+    future = time.time() + 2 * 86400
+    crawler = DataUsageCrawler(
+        sets, persist=False,
+        actions=[crawler_action(api.bucket_meta, sets,
+                                now_fn=lambda: future)])
+    crawler.scan_once()
+    versions = sets.list_object_versions("ncb", prefix="doc")
+    assert len(versions) == 1 and versions[0].is_latest
+    _, stream = sets.get_object("ncb", "doc")
+    assert b"".join(stream) == b"v2"
+    sets.close()
+
+
+def test_stale_multipart_abort(tmp_path):
+    from minio_tpu.features.lifecycle import mpu_abort_action
+    sets = _mk_sets(tmp_path)
+    api = S3ApiHandlers(sets)
+    sets.make_bucket("mab")
+    uid_old = sets.new_multipart_upload("mab", "stale")
+    uid_new = sets.new_multipart_upload("mab", "fresh")
+    lc = ("<LifecycleConfiguration><Rule><ID>abort</ID>"
+          "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+          "<AbortIncompleteMultipartUpload><DaysAfterInitiation>3"
+          "</DaysAfterInitiation></AbortIncompleteMultipartUpload>"
+          "</Rule></LifecycleConfiguration>")
+    api.bucket_meta.update("mab", lifecycle_xml=lc)
+
+    # "stale" was initiated 4 'days' before the injected clock; "fresh"
+    # 1 day (simulate by shifting the clock per upload age)
+    now = time.time()
+    act = mpu_abort_action(api.bucket_meta, sets,
+                           now_fn=lambda: now + 4 * 86400 - 3600)
+    act("mab")
+    uploads = {u["upload_id"] for u in sets.list_multipart_uploads("mab")}
+    # both are older than... actually both were initiated "now", so a
+    # +4d clock makes both stale; assert both aborted, then verify a
+    # fresh one (younger than cutoff) survives a +2d clock
+    assert uploads == set()
+    uid2 = sets.new_multipart_upload("mab", "young")
+    act2 = mpu_abort_action(api.bucket_meta, sets,
+                            now_fn=lambda: now + 2 * 86400)
+    act2("mab")
+    assert {u["upload_id"] for u in sets.list_multipart_uploads("mab")} \
+        == {uid2}
+    sets.close()
