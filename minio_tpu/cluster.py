@@ -231,7 +231,8 @@ class ClusterNode:
 
         # -- live bucket features (events, replication, lifecycle) ---------
         from .features import EventNotifier, ReplicationPool
-        from .features.lifecycle import crawler_action, mpu_abort_action
+        from .features.lifecycle import (crawler_action, mpu_abort_action,
+                                         noncurrent_sweep_action)
         self.events = EventNotifier(self.s3.api.bucket_meta)
         self.s3.api.events = self.events
         self.replication = ReplicationPool(self.object_layer,
@@ -254,9 +255,12 @@ class ClusterNode:
                 actions=[crawler_action(self.s3.api.bucket_meta,
                                         self.object_layer,
                                         self.events)],
-                bucket_actions=[mpu_abort_action(
-                    self.s3.api.bucket_meta,
-                    self.object_layer)]).start()
+                bucket_actions=[
+                    mpu_abort_action(self.s3.api.bucket_meta,
+                                     self.object_layer),
+                    noncurrent_sweep_action(self.s3.api.bucket_meta,
+                                            self.object_layer),
+                ]).start()
             self.s3.api.usage = self.crawler
 
     # ------------------------------------------------------------------

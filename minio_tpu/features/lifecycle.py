@@ -154,24 +154,64 @@ def crawler_action(bucket_meta_sys, object_layer, notifier=None,
                                   oi.name)
                 except Exception:  # noqa: BLE001 — best-effort
                     pass
+
+    return act
+
+
+def noncurrent_sweep_action(bucket_meta_sys, object_layer,
+                            now_fn=time.time):
+    """Per-bucket crawler action enforcing NoncurrentVersionExpiration
+    over a paginated bucket-wide version walk.
+
+    Runs per BUCKET (not per listed object) so keys whose latest version
+    is a delete marker — invisible to object listings — still get their
+    noncurrent versions expired. A version's clock starts when it BECAME
+    noncurrent (its successor's mod time, S3 semantics), and the null
+    version (empty version id, written before versioning) expires like
+    any other noncurrent version.
+    """
+
+    def act(bucket: str) -> None:
+        from ..object import api_errors
+        bm = bucket_meta_sys.get(bucket)
+        if not bm.lifecycle_xml:
             return
-        nc_days = lc.noncurrent_expiry_days(oi.name)
-        if nc_days and bm.versioning_enabled():
-            cutoff = now - nc_days * 86400
+        try:
+            lc = Lifecycle.from_xml(bm.lifecycle_xml)
+        except ET.ParseError:
+            return
+        if not any(r.enabled and r.noncurrent_days for r in lc.rules):
+            return
+        now = now_fn()
+        marker = ""
+        while True:
             try:
                 versions = object_layer.list_object_versions(
-                    bucket, prefix=oi.name)
+                    bucket, "", marker, 1000)
             except api_errors.ObjectApiError:
                 return
+            if not versions:
+                return
+            by_name: dict[str, list] = {}
             for v in versions:
-                if v.name != oi.name or v.is_latest:
+                by_name.setdefault(v.name, []).append(v)
+            for name, vs in by_name.items():
+                days = lc.noncurrent_expiry_days(name)
+                if not days:
                     continue
-                if v.mod_time < cutoff and v.version_id:
-                    try:
-                        object_layer.delete_object(
-                            bucket, oi.name, version_id=v.version_id)
-                    except api_errors.ObjectApiError:
-                        pass
+                vs.sort(key=lambda v: -v.mod_time)
+                for i in range(1, len(vs)):     # index 0 = current
+                    became_noncurrent = vs[i - 1].mod_time
+                    if became_noncurrent < now - days * 86400:
+                        try:
+                            object_layer.delete_object(
+                                bucket, name,
+                                version_id=vs[i].version_id)
+                        except api_errors.ObjectApiError:
+                            pass
+            if len(versions) < 1000:
+                return
+            marker = versions[-1].name
 
     return act
 

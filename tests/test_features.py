@@ -223,8 +223,10 @@ def test_replication_end_to_end(tmp_path):
         dst.close()
 
 def test_noncurrent_version_expiry(tmp_path):
-    """NoncurrentVersionExpiration removes old versions, keeps the
-    latest."""
+    """NoncurrentVersionExpiration: the clock starts when a version
+    BECAME noncurrent (its successor's mod time), the sweep runs per
+    bucket so delete-marker-latest keys are covered too."""
+    from minio_tpu.features.lifecycle import noncurrent_sweep_action
     sets = _mk_sets(tmp_path)
     api = S3ApiHandlers(sets)
     sets.make_bucket("ncb")
@@ -234,6 +236,11 @@ def test_noncurrent_version_expiry(tmp_path):
         sets.put_object("ncb", "doc", f"v{i}".encode(),
                         opts=PutOptions(versioned=True))
     assert len(sets.list_object_versions("ncb", prefix="doc")) == 3
+    # a second key whose LATEST is a delete marker (invisible to
+    # object listings)
+    sets.put_object("ncb", "gone", b"old",
+                    opts=PutOptions(versioned=True))
+    sets.delete_object("ncb", "gone", versioned=True)
 
     lc = ("<LifecycleConfiguration><Rule><ID>nc</ID>"
           "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
@@ -241,48 +248,53 @@ def test_noncurrent_version_expiry(tmp_path):
           "</NoncurrentDays></NoncurrentVersionExpiration>"
           "</Rule></LifecycleConfiguration>")
     api.bucket_meta.update("ncb", lifecycle_xml=lc)
-    future = time.time() + 2 * 86400
-    crawler = DataUsageCrawler(
-        sets, persist=False,
-        actions=[crawler_action(api.bucket_meta, sets,
-                                now_fn=lambda: future)])
-    crawler.scan_once()
+
+    # versions became noncurrent "now": a sweep at +12h must keep them
+    now = time.time()
+    act = noncurrent_sweep_action(api.bucket_meta, sets,
+                                  now_fn=lambda: now + 12 * 3600)
+    act("ncb")
+    assert len(sets.list_object_versions("ncb", prefix="doc")) == 3
+
+    # at +2d they are past NoncurrentDays=1: only the latest survives,
+    # and the delete-marker key's data version is purged too
+    act2 = noncurrent_sweep_action(api.bucket_meta, sets,
+                                   now_fn=lambda: now + 2 * 86400)
+    act2("ncb")
     versions = sets.list_object_versions("ncb", prefix="doc")
     assert len(versions) == 1 and versions[0].is_latest
     _, stream = sets.get_object("ncb", "doc")
     assert b"".join(stream) == b"v2"
+    gone = sets.list_object_versions("ncb", prefix="gone")
+    assert all(v.delete_marker for v in gone)
     sets.close()
 
 
 def test_stale_multipart_abort(tmp_path):
+    """AbortIncompleteMultipartUpload: uploads older than the cutoff are
+    aborted; younger ones survive."""
     from minio_tpu.features.lifecycle import mpu_abort_action
     sets = _mk_sets(tmp_path)
     api = S3ApiHandlers(sets)
     sets.make_bucket("mab")
-    uid_old = sets.new_multipart_upload("mab", "stale")
-    uid_new = sets.new_multipart_upload("mab", "fresh")
+    uid_a = sets.new_multipart_upload("mab", "upload-a")
+    uid_b = sets.new_multipart_upload("mab", "upload-b")
     lc = ("<LifecycleConfiguration><Rule><ID>abort</ID>"
           "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
           "<AbortIncompleteMultipartUpload><DaysAfterInitiation>3"
           "</DaysAfterInitiation></AbortIncompleteMultipartUpload>"
           "</Rule></LifecycleConfiguration>")
     api.bucket_meta.update("mab", lifecycle_xml=lc)
-
-    # "stale" was initiated 4 'days' before the injected clock; "fresh"
-    # 1 day (simulate by shifting the clock per upload age)
     now = time.time()
-    act = mpu_abort_action(api.bucket_meta, sets,
-                           now_fn=lambda: now + 4 * 86400 - 3600)
-    act("mab")
-    uploads = {u["upload_id"] for u in sets.list_multipart_uploads("mab")}
-    # both are older than... actually both were initiated "now", so a
-    # +4d clock makes both stale; assert both aborted, then verify a
-    # fresh one (younger than cutoff) survives a +2d clock
-    assert uploads == set()
-    uid2 = sets.new_multipart_upload("mab", "young")
-    act2 = mpu_abort_action(api.bucket_meta, sets,
-                            now_fn=lambda: now + 2 * 86400)
-    act2("mab")
+
+    # +2 days: both uploads younger than the 3-day cutoff -> kept
+    mpu_abort_action(api.bucket_meta, sets,
+                     now_fn=lambda: now + 2 * 86400)("mab")
     assert {u["upload_id"] for u in sets.list_multipart_uploads("mab")} \
-        == {uid2}
+        == {uid_a, uid_b}
+
+    # +4 days: both past the cutoff -> aborted
+    mpu_abort_action(api.bucket_meta, sets,
+                     now_fn=lambda: now + 4 * 86400)("mab")
+    assert sets.list_multipart_uploads("mab") == []
     sets.close()
