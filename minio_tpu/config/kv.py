@@ -266,6 +266,7 @@ class ConfigSys:
         Off-transitions are applied too: disabling a webhook or resetting
         requests_max actually stops the live behavior."""
         api.region = self.get("region", "name")
+        api.cors_allow_origin = self.get("api", "cors_allow_origin")
         api.compression_enabled = \
             self.get("compression", "enable").lower() in ("on", "true", "1")
         try:

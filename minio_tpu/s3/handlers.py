@@ -194,6 +194,7 @@ class S3ApiHandlers:
         self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
+        self.cors_allow_origin = "*"   # config api.cors_allow_origin
 
     def set_max_clients(self, n: int) -> None:
         """Re-size the admission gate once topology is known (the

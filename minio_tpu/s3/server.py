@@ -64,6 +64,16 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                                   length)
 
         def _respond(self, resp: HTTPResponse) -> None:
+            # CORS (cmd/generic-handlers.go corsHandler): reflect the
+            # allowed origin on every response when the client sent one
+            origin = self.headers.get("Origin")
+            allow = api.cors_allow_origin
+            if origin and allow and \
+                    "Access-Control-Allow-Origin" not in resp.headers:
+                resp.headers["Access-Control-Allow-Origin"] = (
+                    origin if allow == "*" else allow)
+                resp.headers["Access-Control-Expose-Headers"] = (
+                    "ETag, x-amz-version-id, x-amz-request-id")
             body = resp.body
             chunked = resp.stream is not None and \
                 "Content-Length" not in resp.headers
@@ -152,6 +162,24 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                             caller=self.client_address[0])
                     except Exception:  # noqa: BLE001 — tracing is passive
                         pass
+
+        def do_OPTIONS(self):
+            # CORS preflight
+            origin = self.headers.get("Origin", "")
+            allow = api.cors_allow_origin
+            resp = HTTPResponse(status=200 if (origin and allow) else 403)
+            if origin and allow:
+                resp.headers.update({
+                    "Access-Control-Allow-Origin":
+                        origin if allow == "*" else allow,
+                    "Access-Control-Allow-Methods":
+                        "GET, PUT, POST, DELETE, HEAD",
+                    "Access-Control-Allow-Headers":
+                        self.headers.get(
+                            "Access-Control-Request-Headers", "*"),
+                    "Access-Control-Max-Age": "3600",
+                })
+            self._respond(resp)
 
         do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _dispatch
 

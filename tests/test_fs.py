@@ -197,3 +197,39 @@ def test_tls_server(tmp_path):
         assert fs.bucket_exists("tlsb")
     finally:
         srv.stop()
+
+
+def test_cors_preflight_and_headers(tmp_path):
+    fs = FSObjects(str(tmp_path / "cors"))
+    srv = S3Server(fs, creds=CREDS).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.request("OPTIONS", "/anyb/anyk", headers={
+            "Origin": "https://app.example.com",
+            "Access-Control-Request-Method": "PUT"})
+        r = conn.getresponse()
+        r.read()
+        h = {k.lower(): v for k, v in r.getheaders()}
+        assert r.status == 200
+        assert h["access-control-allow-origin"] == \
+            "https://app.example.com"
+        assert "PUT" in h["access-control-allow-methods"]
+
+        # normal responses reflect the origin too
+        body = b""
+        hdrs = {"host": f"127.0.0.1:{srv.port}",
+                "origin": "https://app.example.com"}
+        hdrs = sig.sign_v4("PUT", "/corsb", {}, hdrs,
+                           hashlib.sha256(body).hexdigest(), CREDS,
+                           REGION)
+        conn.request("PUT", "/corsb", body=body, headers=hdrs)
+        r = conn.getresponse()
+        r.read()
+        h = {k.lower(): v for k, v in r.getheaders()}
+        assert r.status == 200
+        assert h.get("access-control-allow-origin") == \
+            "https://app.example.com"
+        conn.close()
+    finally:
+        srv.stop()
