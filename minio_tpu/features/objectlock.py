@@ -116,13 +116,58 @@ def check_deletable(user_defined: dict, bypass_governance: bool,
     try:
         until = parse_iso(until_raw)
     except ValueError:
-        return None
-    if now >= until:
+        # Corrupt/unparsable retain-until date on a locked object: fail
+        # closed — treat retention as still active rather than deletable.
+        until = None
+    if until is not None and now >= until:
         return None
     if mode == "COMPLIANCE":
         return "object is under COMPLIANCE retention"
     if mode == "GOVERNANCE" and not bypass_governance:
         return "object is under GOVERNANCE retention"
+    return None
+
+
+def check_retention_update(user_defined: dict, new_mode: str,
+                           new_until: str, bypass_governance: bool,
+                           now: Optional[float] = None) -> Optional[str]:
+    """None when the retention change is allowed; else the reason.
+
+    Mirrors PutObjectRetentionHandler (cmd/object-handlers.go):
+    - active COMPLIANCE retention can only be extended, never have its
+      mode changed or date reduced;
+    - weakening active GOVERNANCE retention (mode change away from a
+      stricter setting or date reduction) requires the governance-bypass
+      header plus s3:BypassGovernanceRetention (bypass_governance=True).
+    Tightening is always allowed.
+    """
+    now = now if now is not None else time.time()
+    cur_mode = user_defined.get(MD_MODE, "").upper()
+    cur_raw = user_defined.get(MD_RETAIN, "")
+    if not cur_mode or not cur_raw:
+        return None
+    try:
+        cur_until = parse_iso(cur_raw)
+    except ValueError:
+        cur_until = None                  # corrupt date: fail closed below
+    if cur_until is not None and now >= cur_until:
+        return None                       # retention expired: free change
+    try:
+        new_ts = parse_iso(new_until)
+    except ValueError:
+        return "bad retain-until date"
+    if cur_mode == "COMPLIANCE":
+        if new_mode != "COMPLIANCE":
+            return "cannot change mode while COMPLIANCE retention is active"
+        if cur_until is None or new_ts < cur_until:
+            return "cannot shorten COMPLIANCE retention"
+        return None
+    # active GOVERNANCE: shortening the date (or an unreadable stored
+    # date, where extension cannot be proven) needs the bypass grant
+    if cur_until is None or new_ts < cur_until:
+        if not bypass_governance:
+            return ("cannot weaken GOVERNANCE retention without "
+                    "x-amz-bypass-governance-retention")
     return None
 
 

@@ -8,15 +8,16 @@ Allow grants; default is deny.
 Wildcards: Action and Resource support '*' and '?' globs exactly like the
 reference's pkg/wildcard. Conditions implement the operators the S3
 dialect actually exercises (StringEquals / StringNotEquals / StringLike /
-StringNotLike / IpAddress prefix match); an unknown operator or key makes
-the condition false (deny-safe, matching AWS semantics for unresolvable
-conditions).
+StringNotLike / IpAddress / NotIpAddress with real CIDR containment); an
+unknown operator or key makes the condition false (deny-safe, matching
+AWS semantics for unresolvable conditions).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import ipaddress
 import json
 from typing import Optional
 
@@ -28,6 +29,16 @@ def _wild_match(pattern: str, s: str) -> bool:
     # fnmatch also honors [] classes; neutralize them to literal chars
     pattern = pattern.replace("[", "[[]")
     return fnmatch.fnmatchcase(s, pattern)
+
+
+def _ip_in_cidr(have: str, want: str) -> bool:
+    """CIDR containment (reference pkg/policy/condition ipaddress.go).
+    Malformed addresses or networks never match (deny-safe)."""
+    try:
+        return ipaddress.ip_address(have.strip()) in \
+            ipaddress.ip_network(want.strip(), strict=False)
+    except ValueError:
+        return False
 
 
 @dataclasses.dataclass
@@ -82,8 +93,13 @@ class Statement:
         return any(_wild_match(p, account) for p in self.principals)
 
     def _conditions_match(self, ctx: dict) -> bool:
+        # AWS/reference semantics: a NEGATED operator evaluates true when
+        # the condition key is absent from the request context; a positive
+        # operator evaluates false. Unknown operators are false (note this
+        # is only safe because the evaluator treats a non-applying Deny as
+        # "no opinion", same as the reference's unresolvable conditions).
         for op, kv in self.conditions.items():
-            neg = op.startswith("StringNot")
+            neg = op.startswith("StringNot") or op == "NotIpAddress"
             like = op.endswith("Like")
             if op in ("StringEquals", "StringNotEquals", "StringLike",
                       "StringNotLike"):
@@ -91,19 +107,32 @@ class Statement:
                     vals = want if isinstance(want, list) else [want]
                     have = ctx.get(key)
                     if have is None:
+                        if neg:
+                            continue
                         return False
                     hit = any(_wild_match(v, have) if like else v == have
                               for v in vals)
                     if hit == neg:
                         return False
-            elif op == "IpAddress":
+            elif op in ("IpAddress", "NotIpAddress"):
+                for key, want in kv.items():
+                    vals = want if isinstance(want, list) else [want]
+                    have = ctx.get(key)
+                    if have is None:
+                        if neg:
+                            continue
+                        return False
+                    hit = any(_ip_in_cidr(have, v) for v in vals)
+                    if hit == neg:
+                        return False
+            elif op == "Bool":
                 for key, want in kv.items():
                     vals = want if isinstance(want, list) else [want]
                     have = ctx.get(key)
                     if have is None:
                         return False
-                    if not any(have.startswith(v.split("/")[0].rsplit(
-                            ".", 1)[0]) for v in vals):
+                    if str(have).lower() not in \
+                            [str(v).lower() for v in vals]:
                         return False
             else:
                 return False                   # unknown operator: no match

@@ -331,7 +331,8 @@ class IAMSys:
         return names
 
     def is_allowed(self, cred: Credentials, action: str, bucket: str,
-                   object_name: str = "") -> bool:
+                   object_name: str = "",
+                   conditions: Optional[dict] = None) -> bool:
         """Identity-policy + bucket-policy union (reference
         IAMSys.IsAllowed + PolicyDBGet; temp/service creds evaluate their
         parent's policies)."""
@@ -339,7 +340,8 @@ class IAMSys:
         if cred.is_expired():
             return False
         args = PolicyArgs(account=account, action=action, bucket=bucket,
-                          object=object_name)
+                          object=object_name,
+                          conditions=dict(conditions or {}))
         with self._mu:
             names = self._effective_policy_names(account)
             docs = [self.policies[n] for n in names if n in self.policies]
@@ -359,7 +361,8 @@ class IAMSys:
         return any(doc.is_allowed(args) for doc in docs)
 
     def is_anonymous_allowed(self, policy_json: str, action: str,
-                             bucket: str, object_name: str = "") -> bool:
+                             bucket: str, object_name: str = "",
+                             conditions: Optional[dict] = None) -> bool:
         if not policy_json:
             return False
         try:
@@ -367,4 +370,5 @@ class IAMSys:
         except (ValueError, KeyError):
             return False
         return doc.is_allowed(PolicyArgs(
-            account="*", action=action, bucket=bucket, object=object_name))
+            account="*", action=action, bucket=bucket, object=object_name,
+            conditions=dict(conditions or {})))

@@ -103,10 +103,20 @@ class AdminHandlers:
         else:
             cred = sig.verify_v4_presigned(ctx.req, self.api._cred_lookup,
                                            self.api.region)
-        if cred.access_key == self.api.root_cred.access_key:
+        if cred.is_temp():
+            # STS credentials must present their session token, same as
+            # the S3 authenticate path — a leaked access/secret pair
+            # alone must not authorize admin calls.
+            token = ctx.header("x-amz-security-token") or \
+                ctx.query1("X-Amz-Security-Token")
+            if token != cred.session_token:
+                raise S3Error("AccessDenied", "invalid security token")
+        if cred.access_key == self.api.root_cred.access_key or \
+                cred.parent_user == self.api.root_cred.access_key:
             return
         if self.api.iam is not None and self.api.iam.is_allowed(
-                cred, action, "", ""):
+                cred, action, "", "",
+                self.api._policy_conditions(ctx)):
             return
         raise S3Error("AccessDenied")
 

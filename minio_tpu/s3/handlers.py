@@ -62,6 +62,8 @@ class RequestContext:
         self.body_stream = body_stream
         self.content_length = content_length
         self.cred: Optional[Credentials] = None
+        self.remote_addr = ""              # filled by the server loop
+        self.secure = False                # True on a TLS listener
         self.auth_type = sig.get_request_auth_type(req)
         # hex digest the client signed over (x-amz-content-sha256);
         # enforced when the body is consumed (isReqAuthenticated analog)
@@ -213,6 +215,12 @@ class S3ApiHandlers:
     # auth
     # ------------------------------------------------------------------
 
+    def _is_owner(self, cred: Credentials) -> bool:
+        """Root and its derived temp/service creds (reference
+        cred.ParentUser == globalActiveCred.AccessKey => IsOwner)."""
+        return cred.access_key == self.root_cred.access_key or \
+            cred.parent_user == self.root_cred.access_key
+
     def _cred_lookup(self, access_key: str) -> Credentials:
         if access_key == self.root_cred.access_key:
             return self.root_cred
@@ -247,7 +255,8 @@ class S3ApiHandlers:
         elif at == sig.AUTH_SIGNED_V2:
             ctx.cred = sig.verify_v2(ctx.req, self._cred_lookup)
         elif at == sig.AUTH_ANONYMOUS:
-            if not self._anonymous_allowed(action, bucket, object_name):
+            if not self._anonymous_allowed(ctx, action, bucket,
+                                           object_name):
                 raise S3Error("AccessDenied")
             ctx.cred = Credentials()
             return
@@ -262,18 +271,33 @@ class S3ApiHandlers:
             if token != ctx.cred.session_token:
                 raise S3Error("InvalidTokenId")
         if self.iam is not None and ctx.cred.access_key and \
-                ctx.cred.access_key != self.root_cred.access_key:
+                not self._is_owner(ctx.cred):
             if not self.iam.is_allowed(ctx.cred, action, bucket,
-                                       object_name):
+                                       object_name,
+                                       self._policy_conditions(ctx)):
                 raise S3Error("AccessDenied")
 
-    def _anonymous_allowed(self, action: str, bucket: str,
-                           object_name: str) -> bool:
+    @staticmethod
+    def _policy_conditions(ctx: "RequestContext") -> dict:
+        """Request facts for policy Condition evaluation (reference
+        getConditionValues, cmd/auth-handler.go)."""
+        cond = {}
+        if ctx.remote_addr:
+            cond["aws:SourceIp"] = ctx.remote_addr
+        referer = ctx.header("referer")
+        if referer:
+            cond["aws:Referer"] = referer
+        # real connection state, never a client-supplied header
+        cond["aws:SecureTransport"] = "true" if ctx.secure else "false"
+        return cond
+
+    def _anonymous_allowed(self, ctx: "RequestContext", action: str,
+                           bucket: str, object_name: str) -> bool:
         if not bucket or self.iam is None:
             return False
         return self.iam.is_anonymous_allowed(
             self.bucket_meta.get(bucket).policy_json, action, bucket,
-            object_name)
+            object_name, self._policy_conditions(ctx))
 
     # ------------------------------------------------------------------
     # STS (POST / with Action=AssumeRole; cmd/sts-handlers.go:43-86)
@@ -487,15 +511,24 @@ class S3ApiHandlers:
         cred = pp.verify_post_signature(fields, self._cred_lookup,
                                         self.region)
         lower = {k.lower(): v for k, v in fields.items()}
+        if cred.is_temp() and \
+                lower.get("x-amz-security-token") != cred.session_token:
+            raise S3Error("InvalidTokenId")
         key = lower.get("key", "")
         if not key:
             raise S3Error("MalformedPOSTRequest", "missing key field")
         key = key.replace("${filename}", file_name)
+        # Bind the policy check to the REQUEST's bucket, not a client-
+        # supplied form field (PostPolicyBucketHandler does the same) —
+        # otherwise a policy signed for bucket A replays against bucket B.
+        fields = {k: v for k, v in fields.items()
+                  if k.lower() != "bucket"}
+        fields["bucket"] = bucket
         pp.check_post_policy(lower.get("policy", ""), fields,
                              len(file_bytes))
-        if self.iam is not None and \
-                cred.access_key != self.root_cred.access_key:
-            if not self.iam.is_allowed(cred, "s3:PutObject", bucket, key):
+        if self.iam is not None and not self._is_owner(cred):
+            if not self.iam.is_allowed(cred, "s3:PutObject", bucket, key,
+                                       self._policy_conditions(ctx)):
                 raise S3Error("AccessDenied")
         self.obj.get_bucket_info(bucket)
         self._enforce_quota(bucket, len(file_bytes))
@@ -1333,9 +1366,10 @@ class S3ApiHandlers:
         src_bucket, src_key, src_vid = _parse_copy_source(
             ctx.header("x-amz-copy-source"))
         if self.iam is not None and ctx.cred and \
-                ctx.cred.access_key != self.root_cred.access_key:
+                not self._is_owner(ctx.cred):
             if not self.iam.is_allowed(ctx.cred, "s3:GetObject",
-                                       src_bucket, src_key):
+                                       src_bucket, src_key,
+                                       self._policy_conditions(ctx)):
                 raise S3Error("AccessDenied")
         opts = GetOptions(version_id=src_vid)
         src_info = self.obj.get_object_info(src_bucket, src_key, opts)
@@ -1664,15 +1698,22 @@ class S3ApiHandlers:
                 bucket, key, GetOptions(version_id=version_id))
         except oerr.ObjectApiError:
             return
-        bypass = ctx.header("x-amz-bypass-governance-retention") == "true"
-        if bypass and self.iam is not None and ctx.cred and \
-                ctx.cred.access_key != self.root_cred.access_key:
-            if not self.iam.is_allowed(
-                    ctx.cred, "s3:BypassGovernanceRetention", bucket, key):
-                bypass = False
+        bypass = self._governance_bypass(ctx, bucket, key)
         reason = olock.check_deletable(info.user_defined or {}, bypass)
         if reason is not None:
             raise S3Error("ObjectLocked", reason)
+
+    def _governance_bypass(self, ctx, bucket: str, key: str) -> bool:
+        """True when the request carries the governance-bypass header AND
+        the caller holds s3:BypassGovernanceRetention (root implicit)."""
+        if ctx.header("x-amz-bypass-governance-retention") != "true":
+            return False
+        if self.iam is not None and ctx.cred and \
+                not self._is_owner(ctx.cred):
+            return self.iam.is_allowed(
+                ctx.cred, "s3:BypassGovernanceRetention", bucket, key,
+                self._policy_conditions(ctx))
+        return True
 
     # --- ?retention / ?legal-hold subresources --------------------------
 
@@ -1700,16 +1741,14 @@ class S3ApiHandlers:
         info = self.obj.get_object_info(bucket, key,
                                         GetOptions(version_id=vid))
         md = dict(info.user_defined or {})
-        # tightening is always allowed; loosening COMPLIANCE never is
-        cur_mode = md.get(olock.MD_MODE, "")
-        if cur_mode == "COMPLIANCE":
-            try:
-                if olock.parse_iso(until) < olock.parse_iso(
-                        md.get(olock.MD_RETAIN, until)):
-                    raise S3Error("ObjectLocked",
-                                  "cannot shorten COMPLIANCE retention")
-            except ValueError:
-                raise S3Error("InvalidArgument", "bad date") from None
+        try:
+            olock.parse_iso(until)
+        except ValueError:
+            raise S3Error("InvalidArgument", "bad date") from None
+        reason = olock.check_retention_update(
+            md, mode, until, self._governance_bypass(ctx, bucket, key))
+        if reason is not None:          # date is pre-validated above, so
+            raise S3Error("ObjectLocked", reason)   # always a lock denial
         md[olock.MD_MODE] = mode
         md[olock.MD_RETAIN] = until
         md["content-type"] = info.content_type

@@ -62,14 +62,18 @@ def check_post_policy(policy_b64: str, fields: dict[str, str],
     except (ValueError, TypeError):
         raise S3Error("MalformedPOSTRequest", "bad policy") from None
     exp = doc.get("expiration")
-    if exp:
-        try:
-            when = _dt.datetime.fromisoformat(exp.replace("Z", "+00:00"))
-            if when < _dt.datetime.now(_dt.timezone.utc):
-                raise S3Error("AccessDenied", "policy expired")
-        except ValueError:
-            raise S3Error("MalformedPOSTRequest", "bad expiration") \
-                from None
+    if not exp:
+        # A policy without an expiration would be replayable forever.
+        raise S3Error("MalformedPOSTRequest", "missing expiration")
+    try:
+        when = _dt.datetime.fromisoformat(exp.replace("Z", "+00:00"))
+    except (ValueError, TypeError):
+        raise S3Error("MalformedPOSTRequest", "bad expiration") \
+            from None
+    if when.tzinfo is None:          # no offset given: treat as UTC
+        when = when.replace(tzinfo=_dt.timezone.utc)
+    if when < _dt.datetime.now(_dt.timezone.utc):
+        raise S3Error("AccessDenied", "policy expired")
     lower = {k.lower(): v for k, v in fields.items()}
     for cond in doc.get("conditions", []):
         if isinstance(cond, dict):

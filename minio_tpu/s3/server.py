@@ -8,6 +8,7 @@ may be chunk iterators (GET path never buffers the whole object).
 
 from __future__ import annotations
 
+import ssl
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -60,8 +61,11 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                               query=query, headers=headers,
                               raw_query=parsed.query)
             length = int(headers.get("content-length", 0) or 0)
-            return RequestContext(req, _BodyReader(self.rfile, length),
-                                  length)
+            ctx = RequestContext(req, _BodyReader(self.rfile, length),
+                                 length)
+            ctx.remote_addr = self.client_address[0]
+            ctx.secure = isinstance(self.connection, ssl.SSLSocket)
+            return ctx
 
         def _respond(self, resp: HTTPResponse) -> None:
             # CORS (cmd/generic-handlers.go corsHandler): reflect the

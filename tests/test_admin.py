@@ -26,10 +26,12 @@ class Client:
     def __init__(self, port, creds=CREDS):
         self.port, self.creds = port, creds
 
-    def request(self, method, path, query=None, body=b"", sign=True):
+    def request(self, method, path, query=None, body=b"", sign=True,
+                headers=None):
         query = {k: [v] for k, v in (query or {}).items()}
         qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
         hdrs = {"host": f"127.0.0.1:{self.port}"}
+        hdrs.update({k.lower(): v for k, v in (headers or {}).items()})
         if sign:
             payload_hash = hashlib.sha256(body).hexdigest()
             hdrs = sig.sign_v4(method, path, query, hdrs, payload_hash,
@@ -81,6 +83,28 @@ def test_admin_requires_auth(server):
     r.read()
     assert r.status == 403
     conn.close()
+
+
+def test_admin_sts_requires_session_token(server):
+    """Temp (STS) creds signing an admin call must present their session
+    token — a leaked access/secret pair alone is not enough
+    (ADVICE r2: admin _auth vs handlers.py authenticate parity)."""
+    iam = server.api.iam
+    temp = iam.assume_role(CREDS)
+    # no X-Amz-Security-Token header: rejected
+    naked = Client(server.port, creds=Credentials(
+        temp.access_key, temp.secret_key))
+    st, _ = naked.request("GET", "/minio/admin/v3/info")
+    assert st == 403
+    # wrong token: rejected
+    st, _ = naked.request("GET", "/minio/admin/v3/info",
+                          headers={"x-amz-security-token": "bogus"})
+    assert st == 403
+    # right token (root parent => implicit admin): accepted
+    st, _ = naked.request(
+        "GET", "/minio/admin/v3/info",
+        headers={"x-amz-security-token": temp.session_token})
+    assert st == 200
 
 
 def test_admin_info_and_storage(client):

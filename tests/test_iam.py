@@ -80,6 +80,70 @@ def test_policy_conditions():
     assert doc.is_allowed(a)
 
 
+def test_policy_bool_and_negated_absent_key():
+    """Bool operator (canonical enforce-TLS deny) + AWS absent-key
+    semantics: negated operators are TRUE when the key is missing."""
+    deny_http = Policy.from_json(json.dumps({
+        "Statement": [
+            {"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+            {"Effect": "Deny", "Action": "s3:*", "Resource": "*",
+             "Condition": {"Bool": {"aws:SecureTransport": "false"}}}]}))
+    a = args("s3:GetObject", obj="x")
+    a.conditions["aws:SecureTransport"] = "false"
+    assert not deny_http.is_allowed(a)        # plain HTTP: denied
+    a.conditions["aws:SecureTransport"] = "true"
+    assert deny_http.is_allowed(a)            # TLS: allowed
+
+    hotlink = Policy.from_json(json.dumps({
+        "Statement": [
+            {"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*"},
+            {"Effect": "Deny", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"StringNotLike":
+                           {"aws:Referer": "https://mysite.com/*"}}}]}))
+    b = args("s3:GetObject", obj="x")
+    # no Referer at all: the negated condition applies -> Deny wins
+    assert not hotlink.is_allowed(b)
+    b.conditions["aws:Referer"] = "https://evil.example/page"
+    assert not hotlink.is_allowed(b)
+    b.conditions["aws:Referer"] = "https://mysite.com/gallery"
+    assert hotlink.is_allowed(b)
+
+
+def test_policy_ip_condition_cidr():
+    """IpAddress honors the CIDR mask (ADVICE r2: '10.0.1.0/24' must not
+    match 10.0.11.x, and '10.0.0.0/8' must match 10.1.2.3)."""
+    doc = Policy.from_json(json.dumps({
+        "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                       "Resource": "*",
+                       "Condition": {"IpAddress":
+                                     {"aws:SourceIp": "10.0.1.0/24"}}}]}))
+    a = args("s3:GetObject", obj="x")
+    a.conditions["aws:SourceIp"] = "10.0.1.77"
+    assert doc.is_allowed(a)
+    a.conditions["aws:SourceIp"] = "10.0.11.77"   # prefix-string trap
+    assert not doc.is_allowed(a)
+    a.conditions["aws:SourceIp"] = "not-an-ip"
+    assert not doc.is_allowed(a)
+
+    wide = Policy.from_json(json.dumps({
+        "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                       "Resource": "*",
+                       "Condition": {"IpAddress":
+                                     {"aws:SourceIp": "10.0.0.0/8"}}}]}))
+    a.conditions["aws:SourceIp"] = "10.200.1.2"
+    assert wide.is_allowed(a)
+
+    neg = Policy.from_json(json.dumps({
+        "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                       "Resource": "*",
+                       "Condition": {"NotIpAddress":
+                                     {"aws:SourceIp": "192.168.0.0/16"}}}]}))
+    a.conditions["aws:SourceIp"] = "192.168.3.4"
+    assert not neg.is_allowed(a)
+    a.conditions["aws:SourceIp"] = "10.0.0.1"
+    assert neg.is_allowed(a)
+
+
 # ---------------------------------------------------------------------------
 # IAMSys (in-memory)
 # ---------------------------------------------------------------------------

@@ -155,17 +155,58 @@ def test_retention_subresource_and_default(client):
     st, _, body = client.request("GET", "/lockb/defret",
                                  query={"retention": ""})
     assert st == 200 and b"GOVERNANCE" in body
-    # COMPLIANCE retention cannot be shortened
+    # shortening active GOVERNANCE retention w/o the bypass header: denied
+    st, _, _ = client.request(
+        "PUT", "/lockb/defret", query={"retention": ""},
+        body=(f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
+              f"{_iso(60)}</RetainUntilDate></Retention>").encode())
+    assert st == 400
+    # tightening GOVERNANCE -> COMPLIANCE with a LONGER date: allowed
     st, _, _ = client.request(
         "PUT", "/lockb/defret", query={"retention": ""},
         body=(f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>"
-              f"{_iso(7200)}</RetainUntilDate></Retention>").encode())
+              f"{_iso(2 * 86400)}</RetainUntilDate></Retention>").encode())
     assert st == 200
+    # COMPLIANCE retention cannot be shortened...
     st, _, _ = client.request(
         "PUT", "/lockb/defret", query={"retention": ""},
         body=(f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>"
               f"{_iso(60)}</RetainUntilDate></Retention>").encode())
     assert st == 400
+    # ...nor its mode changed, even with the governance-bypass header
+    st, _, _ = client.request(
+        "PUT", "/lockb/defret", query={"retention": ""},
+        headers={"x-amz-bypass-governance-retention": "true"},
+        body=(f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
+              f"{_iso(3 * 86400)}</RetainUntilDate></Retention>").encode())
+    assert st == 400
+
+
+def test_check_deletable_fails_closed_on_corrupt_date():
+    """An unparsable retain-until date on a locked object must keep it
+    locked, not make it deletable (ADVICE r2)."""
+    from minio_tpu.features import objectlock as olock
+    md = {olock.MD_MODE: "COMPLIANCE", olock.MD_RETAIN: "garbage-date"}
+    assert olock.check_deletable(md, bypass_governance=False) is not None
+    md = {olock.MD_MODE: "GOVERNANCE", olock.MD_RETAIN: "also-bad"}
+    assert olock.check_deletable(md, bypass_governance=False) is not None
+    # governance bypass still applies
+    assert olock.check_deletable(md, bypass_governance=True) is None
+
+
+def test_governance_retention_bypass_header(client):
+    st, h, _ = client.request(
+        "PUT", "/lockb/govbp", body=b"g",
+        headers={"x-amz-object-lock-mode": "GOVERNANCE",
+                 "x-amz-object-lock-retain-until-date": _iso(86400)})
+    assert st == 200
+    # with bypass header (root holds BypassGovernanceRetention): shorten OK
+    st, _, _ = client.request(
+        "PUT", "/lockb/govbp", query={"retention": ""},
+        headers={"x-amz-bypass-governance-retention": "true"},
+        body=(f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
+              f"{_iso(60)}</RetainUntilDate></Retention>").encode())
+    assert st == 200
 
 
 # ---------------------------------------------------------------------------
@@ -250,4 +291,32 @@ def test_post_policy_enforces_conditions(client):
     # file too large
     fields = _signed_policy_fields("uploads/", max_size=4)
     st, _ = _post_form(client, "postb", fields, b"toolarge")
+    assert st == 400
+
+
+def test_post_policy_bound_to_request_bucket(client):
+    """A policy signed with {"bucket": "postb"} must not be replayable
+    against another bucket, even when the client supplies a matching
+    'bucket' form field (ADVICE r2: server injects the URL bucket)."""
+    assert client.request("PUT", "/otherb")[0] == 200
+    fields = _signed_policy_fields("uploads/")
+    # form field says postb (matches the policy) but the URL says otherb
+    st, _ = _post_form(client, "otherb", fields, b"replayed")
+    assert st == 403
+    st, _, _ = client.request("GET", "/otherb/uploads/upload.bin")
+    assert st == 404
+
+
+def test_post_policy_requires_expiration(client):
+    fields = _signed_policy_fields("uploads/")
+    doc = json.loads(base64.b64decode(fields["policy"]))
+    del doc["expiration"]
+    policy_b64 = base64.b64encode(json.dumps(doc).encode()).decode()
+    t = _dt.datetime.now(_dt.timezone.utc)
+    datestamp = t.strftime("%Y%m%d")
+    skey = sig.signing_key(CREDS.secret_key, datestamp, REGION, "s3")
+    fields["policy"] = policy_b64
+    fields["x-amz-signature"] = hmac.new(
+        skey, policy_b64.encode(), hashlib.sha256).hexdigest()
+    st, _ = _post_form(client, "postb", fields, b"forever")
     assert st == 400
