@@ -118,32 +118,57 @@ def bench_device() -> tuple[float, dict]:
                        ("heal_4miss_gibs", "heal")):
         info[name] = round(
             _bench_matrix_op(slope_time, dd, data, mode), 2)
+    info["secondary_note"] = (
+        "decode/heal rows are FUSED verify+reconstruct: each includes "
+        "HighwayHash256 bitrot verification of all 12 survivor shards "
+        "in the same device program (heal also digests the rebuilt "
+        "shards for their new frames); identity gated vs host oracle")
     return gib, info
 
 
 def _bench_matrix_op(slope_time, dd, data_host, mode: str) -> float:
-    """Secondary kernels for BASELINE configs #3/#4: batched reconstruct
-    (GetObject with 3 shards missing) and recover (full-drive heal,
-    here 4 lost shards = one dead 4-drive node), slope-timed on the
-    device-resident batch with a one-block identity gate vs the numpy
-    oracle."""
+    """Secondary kernels for BASELINE configs #3/#4, FUSED with bitrot
+    verification (r3): one device program per batch hashes every
+    survivor shard (HighwayHash256 streaming-bitrot verify — the
+    reference's inseparable verify-then-decode,
+    cmd/erasure-decode.go:111-150) AND
+
+      decode: reconstructs only the missing DATA rows (GetObject with 3
+              shards missing — a GET never rematerializes rows it read);
+      heal:   recovers all 4 lost rows (one dead 4-drive node) and also
+              digests the rebuilt shards for their new bitrot frames.
+
+    Slope-timed on the device-resident batch with a one-block identity
+    gate (rows AND digests) vs the host oracle."""
+    from minio_tpu import bitrot as bitrot_mod
+    from minio_tpu.models.pipeline import get_step, heal_step
     from minio_tpu.ops import gf256, rs_matrix, rs_tpu
 
     lost = (1, 5, 13) if mode == "decode" else (0, 4, 8, 12)
     mask = sum(1 << i for i in range(N_SHARDS) if i not in lost)
     if mode == "decode":
-        d, _used = rs_matrix.decode_matrix(K, M, mask)
-        mat = np.asarray(d)
+        mat, _used, missing = rs_matrix.missing_data_matrix(K, M, mask)
     else:
-        r, _used, _missing = rs_matrix.recover_matrix(K, M, mask)
-        mat = np.asarray(r)
+        mat, _used, missing = rs_matrix.recover_matrix(K, M, mask)
+    mat = np.ascontiguousarray(np.asarray(mat, np.uint8))
+    m2 = rs_tpu._bit_expand_cached(mat.tobytes(), mat.shape)
+    r = mat.shape[0]
+    step = get_step if mode == "decode" else heal_step
 
     def op(x):
-        return rs_tpu.apply_matrix(mat, x)
+        return step(x, m2, r, K, S)
 
-    got = np.asarray(op(dd[:1]))[0]
-    want = gf256.gf_matmul(mat.astype(np.uint8), data_host[0])
-    assert (got == want).all(), f"device {mode} diverges from oracle"
+    hh = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256
+    got = [np.asarray(o) for o in op(dd[:1])]
+    want_rows = gf256.gf_matmul(mat, data_host[0])
+    assert (got[0][0] == want_rows).all(), f"device {mode} rows diverge"
+    want_dg = bitrot_mod.hash_shard(data_host[0][0].tobytes(), hh)
+    assert got[1][0, 0].tobytes() == want_dg, \
+        f"device {mode} survivor digest diverges"
+    if mode == "heal":
+        want_odg = bitrot_mod.hash_shard(want_rows[0].tobytes(), hh)
+        assert got[2][0, 0].tobytes() == want_odg, \
+            "device heal output digest diverges"
 
     best = slope_time(op, dd)
     return BATCH * K * S / best / 2**30

@@ -151,3 +151,80 @@ def put_step(data: jax.Array, k: int, m: int, shard_len: int = 0,
         digests = highwayhash_jax._hh256_impl(
             rows, shard_len, bytes(key or MAGIC_HIGHWAYHASH_KEY))
     return parity, digests.reshape(b, k + m, 32)
+
+
+def _hash_rows(rows: jax.Array, shard_len: int, key: bytes,
+               algo: str) -> jax.Array:
+    """(N, S) rows -> (N, 32) bitrot digests over the first shard_len
+    bytes, on device (shared by put/get/heal steps)."""
+    from ..bitrot import MAGIC_HIGHWAYHASH_KEY
+    if algo == "sha256":
+        from ..ops import sha256_jax
+        return sha256_jax._sha256_impl(rows, shard_len)
+    from ..ops import highwayhash_jax
+    return highwayhash_jax._hh256_impl(
+        rows, shard_len, bytes(key or MAGIC_HIGHWAYHASH_KEY))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def get_step(survivors: jax.Array, matrix_bits: jax.Array, r: int,
+             k: int, shard_len: int = 0, key: bytes = b"",
+             algo: str = "highwayhash") -> tuple[jax.Array, jax.Array]:
+    """One degraded-GET device step: verify AND reconstruct in a single
+    dispatch — the reference treats bitrot verification as inseparable
+    from decode (streamingBitrotReader.ReadAt inside Erasure.Decode,
+    cmd/bitrot-streaming.go:111-150 + cmd/erasure-decode.go:211), so the
+    device program fuses them: one pass over the survivor rows feeds both
+    the bitrot hash scan and the missing-row GF matmul.
+
+    survivors:   (B, k, S) uint8 — the k surviving shards of each block,
+                 stacked in missing_data_matrix `used` order.
+    matrix_bits: (8r, 8k) 0/1 — bit-expanded missing-data matrix (only
+                 the rows a GET actually needs, not the full k x k).
+    shard_len:   true payload bytes per shard frame (digest coverage).
+    Returns (missing (B, r, S) uint8 — the reconstructed shards in
+    `missing` index order, digests (B, k, 32) uint8 — computed frame
+    digests of the survivors, for the host to compare against the frame
+    digests read from disk).
+    """
+    b, k_, s = survivors.shape
+    assert k_ == k
+    shard_len = shard_len or s
+    from ..ops import rs_tpu
+    missing = rs_tpu._apply_matrix_impl(
+        matrix_bits, survivors, r, k, rs_tpu.default_use_pallas())
+    digests = _hash_rows(survivors.reshape(b * k, s), shard_len, key, algo)
+    return missing, digests.reshape(b, k, 32)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def heal_step(survivors: jax.Array, matrix_bits: jax.Array, r: int,
+              k: int, shard_len: int = 0, key: bytes = b"",
+              algo: str = "highwayhash"
+              ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One heal device step: verify the survivors, recover the lost
+    shards, AND digest the recovered shards for their new bitrot frames —
+    the reference's decode→pipe→re-encode→rehash
+    (cmd/erasure-lowlevel-heal.go:28-48 + both bitrot sides) as one
+    program. The recovered rows never leave the device between the matmul
+    and their frame digests.
+
+    survivors:   (B, k, S) uint8 in recover_matrix `used` order.
+    matrix_bits: (8r, 8k) bit-expanded recover matrix (r = lost shards,
+                 data and parity rows both).
+    Returns (recovered (B, r, S), survivor_digests (B, k, 32),
+    recovered_digests (B, r, 32)) — the last are the digests the healer
+    writes into the rebuilt shards' streaming-bitrot frames.
+    """
+    b, k_, s = survivors.shape
+    assert k_ == k
+    shard_len = shard_len or s
+    from ..ops import rs_tpu
+    recovered = rs_tpu._apply_matrix_impl(
+        matrix_bits, survivors, r, k, rs_tpu.default_use_pallas())
+    # one hash scan over survivors+recovered rows (same reasoning as
+    # put_step: a separate small scan underfills the vector lanes)
+    rows = jnp.concatenate([survivors, recovered],
+                           axis=-2).reshape(b * (k + r), s)
+    digests = _hash_rows(rows, shard_len, key, algo).reshape(b, k + r, 32)
+    return recovered, digests[:, :k], digests[:, k:]

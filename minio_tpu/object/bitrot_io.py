@@ -131,9 +131,25 @@ class StreamingBitrotReader:
 
     def read_at(self, offset: int, length: int) -> bytes:
         """Read payload bytes [offset, offset+length) — must be
-        block-aligned (offset % shard_size == 0), like the reference."""
+        block-aligned (offset % shard_size == 0), like the reference.
+        Verifies every frame before returning."""
+        out = bytearray()
+        for digest, block in self.read_frames(offset, length):
+            got = bitrot_mod.hash_shard(block, self.algo)
+            if got != digest:
+                raise errors.BitrotHashMismatch(digest.hex(), got.hex())
+            out += block
+        return bytes(out)
+
+    def read_frames(self, offset: int, length: int
+                    ) -> list[tuple[bytes, bytes]]:
+        """Raw (expected_digest, payload) frames WITHOUT verifying — the
+        deferred-verify seam for the fused device path: the engine batches
+        many shards' frames into one device program that hashes and
+        reconstructs together (models/pipeline.get_step), then compares
+        digests host-side. Callers that don't batch must use read_at."""
         if length == 0:
-            return b""
+            return []
         if offset % self.shard_size:
             raise errors.UnexpectedError(
                 f"unaligned bitrot read at {offset}")
@@ -147,19 +163,16 @@ class StreamingBitrotReader:
                 self.till_offset - disk_off)
             self._pos = disk_off
 
-        out = bytearray()
+        frames: list[tuple[bytes, bytes]] = []
         remaining = length
         while remaining > 0:
             digest = self._read_exact(self.algo.digest_size)
             n = min(self.shard_size, remaining)
             block = self._read_exact(n)
             self._pos += self.algo.digest_size + n
-            got = bitrot_mod.hash_shard(block, self.algo)
-            if got != digest:
-                raise errors.BitrotHashMismatch(digest.hex(), got.hex())
-            out += block
+            frames.append((digest, block))
             remaining -= n
-        return bytes(out)
+        return frames
 
     def _read_exact(self, n: int) -> bytes:
         assert self._stream is not None

@@ -104,6 +104,18 @@ class Codec:
 
     # -- fused encode + bitrot (device) ------------------------------------
 
+    @staticmethod
+    def _device_hash_kernel(algo) -> Optional[str]:
+        """Device kernel name for a bitrot algorithm, or None when the
+        algorithm has no device implementation."""
+        from .. import bitrot as bitrot_mod
+        if algo in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
+                    bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
+            return "highwayhash"
+        if algo is bitrot_mod.BitrotAlgorithm.SHA256:
+            return "sha256"
+        return None
+
     def encode_and_hash_batch(self, data: np.ndarray, algo,
                               *, force: str = ""):
         """Fused device path for the PUT hot loop: one program computes
@@ -115,15 +127,8 @@ class Codec:
         as numpy arrays, or None when the batch doesn't route to the
         device or the bitrot algorithm has no device kernel.
         """
-        from .. import bitrot as bitrot_mod
-        if algo in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
-                    bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S):
-            kernel = "highwayhash"
-        elif algo is bitrot_mod.BitrotAlgorithm.SHA256:
-            kernel = "sha256"
-        else:
-            return None
-        if self.m == 0:
+        kernel = self._device_hash_kernel(algo)
+        if kernel is None or self.m == 0:
             return None
         path = force or self._route(data.nbytes)
         if path != "device":
@@ -135,6 +140,78 @@ class Codec:
         return (np.concatenate([np.asarray(data, np.uint8),
                                 np.asarray(parity)], axis=1),
                 np.asarray(digests))
+
+    # -- fused verify + decode / recover (device) --------------------------
+
+    def verify_and_decode_batch(self, survivors: np.ndarray,
+                                present_mask: int, shard_len: int, algo,
+                                *, force: str = ""):
+        """Fused device path for the degraded-GET hot loop: ONE program
+        bitrot-hashes every survivor shard AND reconstructs only the
+        missing data rows (models/pipeline.get_step — the device form of
+        cmd/erasure-decode.go:111-150's verify-then-decode).
+
+        survivors: (B, k, S) stacked in missing_data_matrix `used` order.
+        Returns (missing (B, r, S), missing_idx, survivor_digests
+        (B, k, 32)) as numpy arrays, or None when the batch doesn't route
+        to the device / the algorithm has no device kernel / nothing is
+        missing (plain verify has no matmul to fuse with).
+        """
+        kernel = self._device_hash_kernel(algo)
+        if kernel is None:
+            return None
+        path = force or self._route(survivors.nbytes)
+        if path != "device":
+            return None
+        dm, _used, missing = rs_matrix.missing_data_matrix(
+            self.k, self.m, present_mask)
+        if not missing:
+            return None
+        m2 = rs_tpu._bit_expand_cached(dm.tobytes(), dm.shape)
+        from ..models.pipeline import get_step
+        out, digests = get_step(survivors, m2, dm.shape[0], self.k,
+                                shard_len, algo=kernel)
+        return np.asarray(out), missing, np.asarray(digests)
+
+    def verify_and_recover_batch(self, survivors: np.ndarray,
+                                 present_mask: int, rows: "set[int]",
+                                 shard_len: int, algo, *,
+                                 force: str = ""):
+        """Fused device path for heal: verify survivors, rebuild exactly
+        the requested lost rows, and digest the rebuilt shards for their
+        new bitrot frames (models/pipeline.heal_step).
+
+        Returns (out (B, R, S), idxs, survivor_digests (B, k, 32),
+        out_digests (B, R, 32)) or None when not device-routed.
+        """
+        kernel = self._device_hash_kernel(algo)
+        if kernel is None:
+            return None
+        path = force or self._route(survivors.nbytes)
+        if path != "device":
+            return None
+        rec, idxs = self._recover_rows(present_mask, rows)
+        if not idxs:
+            return None
+        m2 = rs_tpu._bit_expand_cached(rec.tobytes(), rec.shape)
+        from ..models.pipeline import heal_step
+        out, sdig, odig = heal_step(survivors, m2, rec.shape[0], self.k,
+                                    shard_len, algo=kernel)
+        return (np.asarray(out), idxs, np.asarray(sdig),
+                np.asarray(odig))
+
+    def _recover_rows(self, present_mask: int, rows: "set[int]"
+                      ) -> tuple[np.ndarray, list[int]]:
+        """Recover matrix filtered to the requested shard rows: returns
+        (matrix (R x k) uint8, shard indices per output row) — the one
+        copy of the row-selection invariant shared by recover_stacked
+        and verify_and_recover_batch."""
+        rec, _used, rec_missing = rs_matrix.recover_matrix(
+            self.k, self.m, present_mask)
+        keep = [r for r, idx in enumerate(rec_missing) if idx in rows]
+        idxs = [rec_missing[r] for r in keep]
+        rec = np.ascontiguousarray(np.asarray(rec, dtype=np.uint8)[keep])
+        return rec, idxs
 
     # -- batched decode (degraded GET) -------------------------------------
 
@@ -163,11 +240,7 @@ class Codec:
         hot path over many blocks (cmd/erasure-lowlevel-heal.go's
         decode→re-encode collapsed AND batched). Returns (out (B, R, S),
         shard indices for each output row)."""
-        rec, _used, rec_missing = rs_matrix.recover_matrix(
-            self.k, self.m, present_mask)
-        keep = [r for r, idx in enumerate(rec_missing) if idx in rows]
-        idxs = [rec_missing[r] for r in keep]
-        rec = np.asarray(rec, dtype=np.uint8)[keep]
+        rec, idxs = self._recover_rows(present_mask, rows)
         path = force or self._route(survivors.nbytes)
         if path == "device":
             out = np.asarray(rs_tpu.apply_matrix(rec, survivors))

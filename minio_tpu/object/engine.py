@@ -12,9 +12,9 @@ TPU-first deltas vs the reference's per-block loop:
     batched for the MXU/VPU); the cross-request scheduler coalesces
     concurrent streams into shared dispatches.
   * Degraded GETs read GET_BATCH_BLOCKS blocks per group and
-    batch-reconstruct every block sharing an erasure pattern in one
-    stacked decode (cmd/erasure-decode.go:211 semantics, device-routed
-    for large groups — see _reconstruct_group).
+    batch verify+reconstruct every block sharing an erasure pattern in
+    one fused device dispatch (cmd/erasure-decode.go:111-211 semantics
+    — see _verify_and_reconstruct_group).
   * MD5/ETag runs on a background thread overlapped with encode — the
     generalized QAT async-MD5 pattern (cmd/erasure-encode.go:113-124).
 """
@@ -153,15 +153,34 @@ class ErasureObjects:
         raise api_errors.BucketNotFound(bucket)
 
     def list_buckets(self):
+        """Quorum-merged bucket listing: a bucket counts when a majority
+        of drives have its volume — a stale drive that missed a
+        make_bucket (or kept a deleted one) while offline can neither
+        hide nor resurrect a bucket (reference merges per-disk listings,
+        cmd/erasure-sets.go ListBuckets semantics)."""
+        counts: dict[str, int] = {}
+        infos: dict[str, object] = {}
+        answered = 0
         for d in self.disks:
             if d is None:
                 continue
             try:
-                return [v for v in d.list_vols()
-                        if not v.name.startswith(".")]
+                vols = d.list_vols()
             except serr.StorageError:
                 continue
-        return []
+            answered += 1
+            for v in vols:
+                if v.name.startswith("."):
+                    continue
+                counts[v.name] = counts.get(v.name, 0) + 1
+                prev = infos.get(v.name)
+                if prev is None or v.created < prev.created:
+                    infos[v.name] = v
+        if answered == 0:
+            return []
+        quorum = min(answered, len(self.disks) // 2 + 1)
+        return sorted((infos[n] for n, c in counts.items()
+                       if c >= quorum), key=lambda v: v.name)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         def rm(i, d):
@@ -550,6 +569,23 @@ class ErasureObjects:
         end_block = (offset + length - 1) // fi.erasure.block_size
         heal_required = False
 
+        # device-routed groups defer per-frame bitrot verification into
+        # the fused verify+decode program (one dispatch hashes AND
+        # reconstructs — cmd/erasure-decode.go:111-150's inseparable
+        # verify-then-decode, device form); small/CPU groups verify
+        # inline at read time as before. The digest comparison must use
+        # the algorithm the frames were WRITTEN with (per-shard
+        # csum.algorithm — it may differ from the server's current
+        # bitrot config), so deferral needs every reader on one
+        # streaming device-kernel algorithm.
+        algos = {r.algo for r in readers if r is not None}
+        part_algo = algos.pop() if len(algos) == 1 else None
+        defer_verify = (
+            part_algo is not None and part_algo.streaming
+            and codec._device_hash_kernel(part_algo) is not None
+            and codec._route(GET_BATCH_BLOCKS * k * shard_size)
+            == "device")
+
         # blocks are read in groups so a degraded part reconstructs many
         # blocks per device call instead of one matmul per block
         bn = start_block
@@ -561,13 +597,17 @@ class ErasureObjects:
                 block_len = min(fi.erasure.block_size,
                                 part.size - block_off)
                 shard_len = -(-block_len // k)
-                shards, had_errors = self._read_block_shards_raw(
-                    readers, b, shard_size, shard_len, k, n)
+                shards, digests, had_errors = self._read_block_shards_raw(
+                    readers, b, shard_size, shard_len, k, n,
+                    collect_digests=defer_verify)
                 heal_required = heal_required or had_errors
-                group.append((b, block_off, block_len, shard_len, shards))
-            if self._reconstruct_group(codec, group, k, n):
+                group.append([b, block_off, block_len, shard_len, shards,
+                              digests])
+            if self._verify_and_reconstruct_group(
+                    codec, group, k, n, readers, shard_size,
+                    part_algo or self.bitrot_algo):
                 heal_required = True
-            for b, block_off, block_len, shard_len, shards in group:
+            for b, block_off, block_len, shard_len, shards, _dg in group:
                 data = np.concatenate([s[:shard_len]
                                        for s in shards[:k]])
                 begin = max(offset - block_off, 0)
@@ -590,48 +630,132 @@ class ErasureObjects:
                            ) -> tuple[list, bool]:
         """Single-block convenience (healing path): raw read +
         reconstruct-in-place."""
-        shards, had_errors = self._read_block_shards_raw(
+        shards, _digests, had_errors = self._read_block_shards_raw(
             readers, block_num, shard_size, shard_len, k, n)
         if any(shards[i] is None for i in range(k)):
             shards = codec.reconstruct(shards, data_only=True)
         return shards, had_errors
 
-    def _reconstruct_group(self, codec: Codec, group, k: int,
-                           n: int) -> bool:
-        """Batch-reconstruct the degraded blocks of a read group: blocks
-        sharing one (present-mask, shard-length) pattern go through a
-        single stacked decode (device-routed for large groups). Returns
-        True when any block needed reconstruction."""
+    def _verify_and_reconstruct_group(self, codec: Codec, group, k: int,
+                                      n: int, readers, shard_size: int,
+                                      algo: bitrot_mod.BitrotAlgorithm
+                                      ) -> bool:
+        """Verify deferred frame digests AND reconstruct the degraded
+        blocks of a read group. Degraded blocks sharing one
+        (present-mask, shard-length) pattern go through a single fused
+        verify+decode device dispatch (models/pipeline.get_step); shards
+        the fused program didn't cover batch-verify in one host call. A
+        digest mismatch (rare) drops the corrupt shard's reader and
+        re-reads the affected block with inline verification. Group
+        entries are [b, off, blen, shard_len, shards, digests] lists,
+        mutated in place. Returns True when any block needed
+        reconstruction or had bitrot."""
         from ..ops import rs_matrix
+        heal = False
+        corrupt: set[int] = set()
+
+        # 1) degraded buckets: fused verify+decode on device, or
+        #    missing-rows-only matmul on host
         buckets: dict[tuple[int, int], list[int]] = {}
-        for gi, (_b, _off, _bl, shard_len, shards) in enumerate(group):
+        for gi, entry in enumerate(group):
+            shards = entry[4]
             if all(shards[i] is not None for i in range(k)):
                 continue
             mask = sum(1 << i for i in range(n)
                        if shards[i] is not None)
-            buckets.setdefault((mask, shard_len), []).append(gi)
+            buckets.setdefault((mask, entry[3]), []).append(gi)
         for (mask, shard_len), idxs in buckets.items():
-            _, used = rs_matrix.decode_matrix(k, codec.m, mask)
+            heal = True
+            _dm, used, _missing = rs_matrix.missing_data_matrix(
+                k, codec.m, mask)
             stacked = np.stack([
                 np.stack([group[gi][4][u] for u in used])
                 for gi in idxs])                       # (G', k, S)
-            data = codec.decode_stacked(stacked, mask)
-            for row, gi in enumerate(idxs):
-                shards = group[gi][4]
-                for i in range(k):
-                    if shards[i] is None:
-                        shards[i] = data[row][i]
-        return bool(buckets)
+            # fuse hashing only when digests were actually deferred;
+            # inline-verified shards need just the decode matmul
+            fused = codec.verify_and_decode_batch(
+                stacked, mask, shard_len, algo) if any(
+                group[gi][5][u] is not None
+                for gi in idxs for u in used) else None
+            if fused is not None:
+                out, missing_idx, sdig = fused
+                for row, gi in enumerate(idxs):
+                    shards, digests = group[gi][4], group[gi][5]
+                    bad = False
+                    for col, u in enumerate(used):
+                        exp = digests[u]
+                        if exp is None:
+                            continue
+                        if sdig[row, col].tobytes() != exp:
+                            shards[u] = None
+                            readers[u] = None
+                            bad = True
+                        else:
+                            digests[u] = None  # verified on device
+                    if bad:
+                        corrupt.add(gi)
+                    else:
+                        for r_i, mi in enumerate(missing_idx):
+                            shards[mi] = out[row][r_i]
+            else:
+                out, idxs_rows = codec.recover_stacked(
+                    stacked, mask, set(range(k)))
+                for row, gi in enumerate(idxs):
+                    shards = group[gi][4]
+                    for r_i, mi in enumerate(idxs_rows):
+                        shards[mi] = out[row][r_i]
+
+        # 2) batch-verify every shard the fused program didn't cover
+        #    (healthy blocks, hedged extras, CPU-routed buckets)
+        pend: dict[int, list[tuple[int, int]]] = {}
+        for gi, entry in enumerate(group):
+            if gi in corrupt:
+                continue
+            shards, digests = entry[4], entry[5]
+            for i in range(n):
+                if digests[i] is not None and shards[i] is not None:
+                    pend.setdefault(len(shards[i]), []).append((gi, i))
+        for _sl, items in pend.items():
+            stacked = np.stack([group[gi][4][i] for gi, i in items])
+            got = bitrot_mod.hash_shards_batch(stacked, algo)
+            for row, (gi, i) in enumerate(items):
+                if got[row].tobytes() != group[gi][5][i]:
+                    group[gi][4][i] = None
+                    readers[i] = None
+                    corrupt.add(gi)
+                else:
+                    group[gi][5][i] = None
+
+        # 3) corrupt blocks (bitrot found after deferral): re-read with
+        #    inline verification and host reconstruct — the corrupt
+        #    reader is dead, so hedged extras replace it
+        for gi in sorted(corrupt):
+            heal = True
+            b, _off, _blen, shard_len, _shards, _dg = group[gi]
+            new_shards, _digests, _he = self._read_block_shards_raw(
+                readers, b, shard_size, shard_len, k, n)
+            if any(new_shards[i] is None for i in range(k)):
+                new_shards = codec.reconstruct(new_shards, data_only=True)
+            group[gi][4] = new_shards
+            group[gi][5] = [None] * n
+        return heal
 
     def _read_block_shards_raw(self, readers, block_num: int,
                                shard_size: int, shard_len: int, k: int,
-                               n: int) -> tuple[list, bool]:
+                               n: int, collect_digests: bool = False
+                               ) -> tuple[list, list, bool]:
         """k-of-n shard reads with hedged extras on failure
-        (parallelReader, cmd/erasure-decode.go:102-184). Returns raw
-        shards (missing entries None — at least k present) without
-        reconstructing."""
+        (parallelReader, cmd/erasure-decode.go:102-184). Returns
+        (shards, expected_digests, had_errors): raw shards (missing
+        entries None — at least k present) without reconstructing.
+
+        With collect_digests, streaming readers skip per-frame host
+        verification and return each frame's stored digest instead
+        (digests[i] is None when the shard was verified at read time) —
+        the deferred-verify feed for the fused device program."""
         offset = block_num * shard_size
         shards: list[Optional[np.ndarray]] = [None] * n
+        digests: list[Optional[bytes]] = [None] * n
         tried = [False] * n
         had_errors = False
 
@@ -639,8 +763,13 @@ class ErasureObjects:
             def read_one(j, r):
                 if r is None or tried[indices[j]]:
                     raise serr.DiskNotFound(f"reader {indices[j]}")
-                data = r.read_at(offset, shard_len)
-                return indices[j], data
+                if collect_digests and isinstance(
+                        r, bitrot_io.StreamingBitrotReader):
+                    frames = r.read_frames(offset, shard_len)
+                    dg = frames[0][0] if frames else None
+                    data = frames[0][1] if frames else b""
+                    return indices[j], data, dg
+                return indices[j], r.read_at(offset, shard_len), None
 
             results, errs = meta.for_each_disk(
                 [readers[i] for i in indices],
@@ -650,6 +779,7 @@ class ErasureObjects:
                 tried[i] = True
                 if e is None and res is not None:
                     shards[i] = np.frombuffer(res[1], dtype=np.uint8)
+                    digests[i] = res[2]
                 elif e is not None:
                     readers[i] = None
 
@@ -669,7 +799,7 @@ class ErasureObjects:
                 f"{got} readable shards < k={k}")
         if any(shards[i] is None for i in range(k)):
             had_errors = True
-        return shards, had_errors
+        return shards, digests, had_errors
 
     # ------------------------------------------------------------------
     # DELETE (cmd/erasure-object.go:727-820)
@@ -798,18 +928,37 @@ class ErasureObjects:
         for name in self._merged_names(bucket, prefix, marker):
             if marker and name <= marker:
                 continue
-            for d in self.disks:
-                if d is None:
-                    continue
-                try:
-                    for fi in d.read_versions(bucket, name):
-                        out.append(fi.to_object_info(bucket, name))
-                    break
-                except serr.StorageError:
-                    continue
+            out.extend(fi.to_object_info(bucket, name)
+                       for fi in self._merged_versions(bucket, name))
             if len(out) >= max_keys:
                 break
         return out
+
+    def _merged_versions(self, bucket: str, name: str) -> list[FileInfo]:
+        """Quorum-merge the per-drive xl.meta version journals of one
+        object: a version counts only when >= read-quorum drives agree
+        on it (version id + mod time + kind) — a stale drive that missed
+        writes (or kept deleted versions) while offline cannot distort
+        the history. The reference merges per-drive FileInfo under
+        quorum the same way (readAllFileInfo + pickValidFileInfo,
+        cmd/erasure-metadata-utils.go:118). Versions sort newest-first
+        like the reference journal order."""
+        results, _errs = meta.for_each_disk(
+            self.disks, lambda i, d: d.read_versions(bucket, name))
+        counts: dict[tuple, int] = {}
+        picks: dict[tuple, FileInfo] = {}
+        for vers in results:
+            if vers is None:
+                continue
+            for fi in vers:
+                key = (fi.version_id, fi.mod_time, fi.deleted)
+                counts[key] = counts.get(key, 0) + 1
+                picks.setdefault(key, fi)
+        read_quorum = self.data_shards
+        merged = [picks[key] for key, c in counts.items()
+                  if c >= read_quorum]
+        merged.sort(key=lambda fi: (fi.mod_time or 0), reverse=True)
+        return merged
 
     def _merged_names(self, bucket: str, prefix: str,
                       marker: str = "") -> Iterator[str]:

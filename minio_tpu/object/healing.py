@@ -262,6 +262,20 @@ class HealMixin(ErasureObjects):
                     written.discard(i)
 
             from ..ops import rs_matrix
+            # device-routed heals defer survivor verification into the
+            # fused verify+recover+rehash program (pipeline.heal_step);
+            # CPU-routed heals verify inline at read time as before.
+            # Deferral needs every reader on ONE streaming device-kernel
+            # algorithm (the frames' own algorithm, which may differ
+            # from the server's current bitrot config).
+            algos = {r.algo for r in readers if r is not None}
+            part_algo = algos.pop() if len(algos) == 1 else None
+            defer_verify = (
+                part_algo is not None and part_algo.streaming
+                and codec._device_hash_kernel(part_algo) is not None
+                and codec._route(HEAL_BATCH_BLOCKS * k * shard_size)
+                == "device")
+            verify_algo = part_algo or self.bitrot_algo
             n_blocks = -(-part.size // fi.erasure.block_size)
             bn = 0
             while bn < n_blocks:
@@ -271,14 +285,18 @@ class HealMixin(ErasureObjects):
                     block_len = min(fi.erasure.block_size,
                                     part.size - b * fi.erasure.block_size)
                     shard_len = -(-block_len // k)
-                    shards, _ = self._read_block_shards_raw(
-                        readers, b, shard_size, shard_len, k, n)
-                    group.append((b - bn, shard_len, shards))
+                    shards, digests, _ = self._read_block_shards_raw(
+                        readers, b, shard_size, shard_len, k, n,
+                        collect_digests=defer_verify)
+                    group.append((b, shard_len, shards, digests))
                 # rebuild exactly the writer rows, batched per erasure
-                # pattern: many blocks -> ONE recover-matrix matmul
-                rebuilt: dict[int, dict[int, np.ndarray]] = {}
+                # pattern: many blocks -> ONE fused device program
+                # (verify survivors + recover rows + digest the rebuilt
+                # shards for their new bitrot frames), or one host
+                # recover matmul when CPU-routed
+                rebuilt: dict[int, dict[int, tuple]] = {}
                 buckets: dict[tuple[int, int], list[int]] = {}
-                for gi, (_b, sl, shards) in enumerate(group):
+                for gi, (_b, sl, shards, _dg) in enumerate(group):
                     mask = sum(1 << i for i in range(n)
                                if shards[i] is not None)
                     buckets.setdefault((mask, sl), []).append(gi)
@@ -288,23 +306,98 @@ class HealMixin(ErasureObjects):
                     stacked = np.stack([
                         np.stack([group[gi][2][u] for u in used])
                         for gi in gis])
-                    out, idxs = codec.recover_stacked(
-                        stacked, mask, set(writers.keys()))
-                    for row_i, gi in enumerate(gis):
-                        rebuilt[gi] = {idx: out[row_i][r]
-                                       for r, idx in enumerate(idxs)}
-                for gi, (_b, shard_len, shards) in enumerate(group):
+                    # fuse hashing only when digests were deferred;
+                    # inline-verified survivors need just the matmul
+                    fused = codec.verify_and_recover_batch(
+                        stacked, mask, set(writers.keys()), sl,
+                        verify_algo) if any(
+                        group[gi][3][u] is not None
+                        for gi in gis for u in used) else None
+                    if fused is not None:
+                        out, idxs, sdig, odig = fused
+                        for row_i, gi in enumerate(gis):
+                            digests = group[gi][3]
+                            bad = False
+                            for col, u in enumerate(used):
+                                exp = digests[u]
+                                if exp is None:
+                                    continue
+                                if sdig[row_i, col].tobytes() != exp:
+                                    readers[u] = None
+                                    group[gi][2][u] = None
+                                    bad = True
+                                else:
+                                    digests[u] = None  # verified
+                            if bad:
+                                rebuilt[gi] = None  # host rebuild below
+                            else:
+                                rebuilt[gi] = {
+                                    idx: (out[row_i][r],
+                                          odig[row_i][r].tobytes())
+                                    for r, idx in enumerate(idxs)}
+                    else:
+                        # deferred digests stay set: the host batch
+                        # verify below still covers these survivors —
+                        # a declined fused bucket must NOT skip
+                        # verification (else bitrot would be laundered
+                        # into freshly-digested healed shards)
+                        out, idxs = codec.recover_stacked(
+                            stacked, mask, set(writers.keys()))
+                        for row_i, gi in enumerate(gis):
+                            rebuilt[gi] = {idx: (out[row_i][r], None)
+                                           for r, idx in enumerate(idxs)}
+
+                # host batch verify of every survivor the fused program
+                # didn't cover (declined buckets, hedged extras)
+                pend: dict[int, list[tuple[int, int]]] = {}
+                for gi, (_b, _sl, shards, digests) in enumerate(group):
+                    for i in range(n):
+                        if digests[i] is not None and \
+                                shards[i] is not None:
+                            pend.setdefault(
+                                len(shards[i]), []).append((gi, i))
+                for _sl, items in pend.items():
+                    stacked = np.stack(
+                        [group[gi][2][i] for gi, i in items])
+                    got = bitrot_mod.hash_shards_batch(stacked,
+                                                       verify_algo)
+                    for row, (gi, i) in enumerate(items):
+                        if got[row].tobytes() != group[gi][3][i]:
+                            readers[i] = None
+                            group[gi][2][i] = None
+                            rebuilt[gi] = None  # host rebuild below
+                        else:
+                            group[gi][3][i] = None
+
+                # corrupt survivor found after deferral: re-read the
+                # block with inline verification and rebuild on host
+                for gi in range(len(group)):
+                    if rebuilt.get(gi, {}) is None:
+                        rebuilt[gi] = self._host_rebuild_block(
+                            readers, codec, group[gi][0], shard_size,
+                            group[gi][1], k, n, set(writers.keys()))
+
+                for gi, (_b, shard_len, shards, _dg) in enumerate(group):
                     rows = rebuilt.get(gi, {})
                     for i, w in list(writers.items()):
-                        src = rows.get(i)
+                        src, dg = rows.get(i, (None, None))
                         if src is None and shards[i] is not None:
                             src = shards[i]   # shard readable elsewhere
                         if src is None:
                             drop(i, writers)
                             continue
                         try:
-                            w.write(np.ascontiguousarray(
-                                src[:shard_len]).tobytes())
+                            block = np.ascontiguousarray(
+                                src[:shard_len]).tobytes()
+                            # a precomputed frame digest is only valid
+                            # when the writer frames use the same
+                            # algorithm it was computed with
+                            if dg is not None and \
+                                    self.bitrot_algo.streaming and \
+                                    verify_algo == self.bitrot_algo:
+                                w.write_with_digest(block, dg)
+                            else:
+                                w.write(block)
                         except serr.StorageError:
                             drop(i, writers)
                 bn = ge
@@ -317,6 +410,19 @@ class HealMixin(ErasureObjects):
                 except serr.StorageError:
                     drop(i, writers)
         return written
+
+    def _host_rebuild_block(self, readers, codec, block_num: int,
+                            shard_size: int, shard_len: int, k: int,
+                            n: int, rows: set[int]) -> dict:
+        """Rare path after a deferred-verify digest mismatch: the corrupt
+        reader is already dead, so re-read the block with inline
+        verification and rebuild the requested rows on host. Returns
+        {shard_idx: (array, None)} (no precomputed frame digest)."""
+        shards, _digests, _he = self._read_block_shards_raw(
+            readers, block_num, shard_size, shard_len, k, n)
+        full = codec.reconstruct(shards, rows=set(rows))
+        return {i: (full[i], None) for i in rows
+                if i < len(full) and full[i] is not None}
 
     def _remove_dangling(self, bucket, object_name, version_id) -> None:
         """Too few copies survive to ever reconstruct: purge the remnants
