@@ -123,7 +123,40 @@ def bench_device() -> tuple[float, dict]:
         "HighwayHash256 bitrot verification of all 12 survivor shards "
         "in the same device program (heal also digests the rebuilt "
         "shards for their new frames); identity gated vs host oracle")
+    info["config5_multipart_16p4_sha256_gibs"] = round(
+        _bench_config5(slope_time), 2)
     return gib, info
+
+
+def _bench_config5(slope_time) -> float:
+    """BASELINE config #5: multipart PUT device work — 16+4 geometry,
+    1 MiB blocks, SHA256 bitrot (fused encode+digest, one program).
+    The batch models 2 server sets' concurrent part streams coalesced by
+    the shared per-node BatchScheduler into one dispatch (cross-set
+    shard batching: cluster.py wires ONE scheduler into every set;
+    tests/test_scheduler.py proves the coalescing + no head-of-line).
+    Identity gated (parity + SHA256 digests) vs the host oracle."""
+    import jax
+    from minio_tpu.models.pipeline import put_step
+    from minio_tpu.ops import rs_ref
+
+    k5, m5 = 16, 4
+    s5 = -(-BLOCK // k5)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (BATCH, k5, s5)).astype(np.uint8)
+    dd = jax.device_put(data)
+
+    parity, digests = put_step(dd[:1], k5, m5, 0, b"", "sha256")
+    parity, digests = np.asarray(parity)[0], np.asarray(digests)[0]
+    want = rs_ref.encode(data[0], m5)
+    assert (parity == want[k5:]).all(), "config5 encode diverges"
+    import hashlib
+    for row in (0, k5, k5 + m5 - 1):
+        assert digests[row].tobytes() == hashlib.sha256(
+            want[row].tobytes()).digest(), "config5 digest diverges"
+
+    best = slope_time(lambda d: put_step(d, k5, m5, 0, b"", "sha256"), dd)
+    return BATCH * k5 * s5 / best / 2**30
 
 
 def _bench_matrix_op(slope_time, dd, data_host, mode: str) -> float:

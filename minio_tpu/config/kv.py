@@ -40,8 +40,10 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "heal": {"interval": "10s", "max_io": "4"},
     "scanner": {"interval": "60s"},
     "etcd": {"endpoints": ""},
-    "identity_openid": {"config_url": "", "client_id": ""},
-    "identity_ldap": {"server_addr": ""},
+    "identity_openid": {"config_url": "", "client_id": "",
+                        "jwks": "", "jwks_file": "",
+                        "claim_name": "policy", "claim_prefix": ""},
+    "identity_ldap": {"server_addr": "", "user_dn_format": ""},
     "kms_secret_key": {"key": ""},
     "logger_webhook": {"enable": "off", "endpoint": ""},
     "audit_webhook": {"enable": "off", "endpoint": ""},

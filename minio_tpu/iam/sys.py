@@ -310,6 +310,48 @@ class IAMSys:
         self._notify()
         return cred
 
+    def assume_role_with_claims(self, subject: str,
+                                policy_names: Optional[list[str]],
+                                duration_seconds: int = 3600,
+                                max_seconds: Optional[float] = None
+                                ) -> Credentials:
+        """Mint temp credentials for a FEDERATED identity (OIDC subject
+        or LDAP DN) — reference AssumeRoleWithWebIdentity/ClientGrants/
+        LDAPIdentity minting (cmd/sts-handlers.go:43-86). The cred's
+        parent is the federated subject; with policy_names given (OIDC
+        policy claim) the subject's policy mapping is set from the
+        token, with None (LDAP) the policy DB mapping for the DN — set
+        by the admin beforehand — stays authoritative. max_seconds (the
+        identity token's remaining lifetime) caps the minted cred AFTER
+        the floor — credentials must never outlive the token that
+        authenticated them (reference bounds STS expiry by JWT exp)."""
+        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+        if max_seconds is not None:
+            duration_seconds = min(duration_seconds, int(max_seconds))
+            if duration_seconds <= 0:
+                raise IAMError("identity token already expired")
+        fresh = generate_credentials()
+        token = base64.urlsafe_b64encode(secrets.token_bytes(24)).decode()
+        cred = Credentials(
+            access_key=fresh.access_key, secret_key=fresh.secret_key,
+            session_token=token,
+            expiration=time.time() + duration_seconds,
+            parent_user=subject)
+        with self._mu:
+            self.sts_creds[cred.access_key] = cred
+            self._save(self._path("sts", cred.access_key),
+                       {"secret_key": cred.secret_key,
+                        "session_token": cred.session_token,
+                        "expiration": cred.expiration,
+                        "parent": cred.parent_user})
+            if policy_names is not None:
+                self.user_policy[subject] = list(policy_names)
+                self._save(self._path("policydb/users",
+                                      subject.replace("/", "_")),
+                           {"policy": list(policy_names)})
+        self._notify()
+        return cred
+
     # ------------------------------------------------------------------
     # the authorization surface the S3 handlers consume
     # ------------------------------------------------------------------

@@ -178,7 +178,11 @@ class ErasureObjects:
                     infos[v.name] = v
         if answered == 0:
             return []
-        quorum = min(answered, len(self.disks) // 2 + 1)
+        # read quorum n//2 intersects the n//2+1 write quorum: a bucket
+        # created under write quorum stays listed with up to half the
+        # drives unreachable (review r3: n//2+1 here could hide a
+        # healthy bucket when one writer drive is down)
+        quorum = min(answered, max(1, len(self.disks) // 2))
         return sorted((infos[n] for n, c in counts.items()
                        if c >= quorum), key=lambda v: v.name)
 

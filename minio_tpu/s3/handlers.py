@@ -30,6 +30,8 @@ from ..object.hash_reader import HashReader
 from ..object.multipart import CompletePart
 from ..storage.datatypes import ObjectInfo
 from . import signature as sig
+from xml.sax.saxutils import escape as _sax_escape
+
 from . import xmlgen
 from .credentials import Credentials, global_credentials
 from .s3errors import S3Error, api_error_from
@@ -304,50 +306,133 @@ class S3ApiHandlers:
     # ------------------------------------------------------------------
 
     def handle_sts(self, ctx: RequestContext) -> HTTPResponse:
+        """STS action dispatch (reference cmd/sts-handlers.go:43-86):
+        AssumeRole is SigV4-authenticated; the federation actions
+        (WebIdentity/ClientGrants JWT, LDAP bind) are authenticated by
+        the presented token/credentials themselves."""
         if self.iam is None:
             raise S3Error("NotImplemented", "STS requires IAM")
-        # SigV4 over the form body (service "sts" or "s3" both accepted);
-        # any valid non-temporary user may assume a role — the minted
-        # credential inherits the PARENT's policies, so no policy check
-        # gates the call itself (reference AssumeRole semantics)
         body_sha = ctx.header("x-amz-content-sha256",
                               sig.UNSIGNED_PAYLOAD)
-        cred = sig.verify_v4(ctx.req, self._cred_lookup, self.region,
-                             body_sha)
         if _is_hex_sha(body_sha):
             ctx.expect_body_sha = body_sha     # enforced by read_body
         body = ctx.read_body()
         form = {k: v[0] for k, v in
                 urllib.parse.parse_qs(body.decode(errors="replace")).items()}
         action = form.get("Action", "")
-        if action != "AssumeRole":
-            raise S3Error("InvalidArgument",
-                          f"unsupported STS action {action!r}")
-        if cred.is_temp():
-            raise S3Error("AccessDenied",
-                          "temporary credentials cannot assume roles")
         try:
             duration = int(form.get("DurationSeconds", "3600"))
         except ValueError:
             raise S3Error("InvalidArgument", "bad DurationSeconds") from None
-        minted = self.iam.assume_role(cred, duration)
+
+        if action == "AssumeRole":
+            # SigV4 over the form body (service "sts" or "s3" both
+            # accepted); any valid non-temporary user may assume a role
+            # — the minted credential inherits the PARENT's policies,
+            # so no policy check gates the call itself
+            cred = sig.verify_v4(ctx.req, self._cred_lookup, self.region,
+                                 body_sha)
+            if cred.is_temp():
+                raise S3Error("AccessDenied",
+                              "temporary credentials cannot assume roles")
+            minted = self.iam.assume_role(cred, duration)
+            return self._sts_response(action, minted)
+
+        if action in ("AssumeRoleWithWebIdentity",
+                      "AssumeRoleWithClientGrants"):
+            from ..iam.providers import STSValidationError
+            token = form.get("WebIdentityToken") or form.get("Token", "")
+            if not token:
+                raise S3Error("InvalidArgument", "missing identity token")
+            provider = self._openid_provider()
+            if provider is None:
+                raise S3Error("NotImplemented",
+                              "OpenID is not configured")
+            try:
+                claims = provider.validate(token)
+            except STSValidationError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            policies = provider.policy_names(claims)
+            if not policies:
+                # no policy claim -> no permissions mapping; reject like
+                # the reference (policy claim is mandatory)
+                raise S3Error(
+                    "AccessDenied",
+                    f"token lacks a '{provider.claim_name}' claim")
+            subject = str(claims.get("sub") or claims.get("email") or "")
+            if not subject:
+                raise S3Error("AccessDenied", "token lacks sub claim")
+            # minted credentials never outlive the token that
+            # authenticated them
+            import time as _time
+            minted = self.iam.assume_role_with_claims(
+                f"oidc:{subject}", policies, duration,
+                max_seconds=float(claims["exp"]) - _time.time())
+            return self._sts_response(action, minted, subject=subject)
+
+        if action == "AssumeRoleWithLDAPIdentity":
+            from ..iam.providers import STSValidationError
+            provider = self._ldap_provider()
+            if provider is None:
+                raise S3Error("NotImplemented", "LDAP is not configured")
+            try:
+                dn = provider.bind(form.get("LDAPUsername", ""),
+                                   form.get("LDAPPassword", ""))
+            except STSValidationError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            # policies: the policy-DB mapping for the DN (set by the
+            # admin), never from the client
+            minted = self.iam.assume_role_with_claims(
+                f"ldap:{dn}", None, duration)
+            return self._sts_response(action, minted, subject=dn)
+
+        raise S3Error("InvalidArgument",
+                      f"unsupported STS action {action!r}")
+
+    def _openid_provider(self):
+        """identity_openid provider from config (rebuilt per call: the
+        config may be live-edited via admin set-config)."""
+        if getattr(self, "openid_provider", None) is not None:
+            return self.openid_provider
+        if self.config is None:
+            return None
+        from ..iam.providers import OpenIDProvider
+        p = OpenIDProvider(self.config.get_subsys("identity_openid"))
+        return p if p.enabled() else None
+
+    def _ldap_provider(self):
+        if getattr(self, "ldap_provider", None) is not None:
+            return self.ldap_provider
+        if self.config is None:
+            return None
+        from ..iam.providers import LDAPProvider
+        p = LDAPProvider(self.config.get_subsys("identity_ldap"))
+        return p if p.enabled() else None
+
+    def _sts_response(self, action: str, minted,
+                      subject: str = "") -> HTTPResponse:
         import datetime as _dt
         exp = _dt.datetime.fromtimestamp(
             minted.expiration, _dt.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ")
+        subject_xml = ""
+        if subject and action == "AssumeRoleWithWebIdentity":
+            subject_xml = ("<SubjectFromWebIdentityToken>"
+                           f"{_sax_escape(subject)}"
+                           "</SubjectFromWebIdentityToken>")
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
-            '<AssumeRoleResponse xmlns='
+            f'<{action}Response xmlns='
             '"https://sts.amazonaws.com/doc/2011-06-15/">'
-            "<AssumeRoleResult><Credentials>"
+            f"<{action}Result><Credentials>"
             f"<AccessKeyId>{minted.access_key}</AccessKeyId>"
             f"<SecretAccessKey>{minted.secret_key}</SecretAccessKey>"
             f"<SessionToken>{minted.session_token}</SessionToken>"
             f"<Expiration>{exp}</Expiration>"
-            "</Credentials></AssumeRoleResult>"
+            f"</Credentials>{subject_xml}</{action}Result>"
             "<ResponseMetadata><RequestId>"
             f"{uuid.uuid4()}</RequestId></ResponseMetadata>"
-            "</AssumeRoleResponse>")
+            f"</{action}Response>")
         return HTTPResponse(body=xml.encode(),
                             headers={"Content-Type": "application/xml"})
 
