@@ -15,6 +15,7 @@ so each drive occupies the same erasure-set slot cluster-wide.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional
 
@@ -233,10 +234,19 @@ class ClusterNode:
         from .features import EventNotifier, ReplicationPool
         from .features.lifecycle import (crawler_action, mpu_abort_action,
                                          noncurrent_sweep_action)
-        self.events = EventNotifier(self.s3.api.bucket_meta)
+        # durable event backlog lives under the node's first local
+        # drive (queuestore.go semantics: pending events survive a
+        # process restart)
+        _evq = os.path.join(self.spec.drives[0], ".minio.sys", "events") \
+            if self.spec.drives else None
+        self.events = EventNotifier(self.s3.api.bucket_meta,
+                                    queue_dir=_evq)
         self.s3.api.events = self.events
+        _rpq = os.path.join(self.spec.drives[0], ".minio.sys",
+                            "replication") if self.spec.drives else None
         self.replication = ReplicationPool(self.object_layer,
-                                           self.s3.api.bucket_meta)
+                                           self.s3.api.bucket_meta,
+                                           queue_dir=_rpq)
         self.s3.api.replication = self.replication
         # apply stored/env config to the live subsystems
         self.config.apply(self.s3.api, events=self.events,

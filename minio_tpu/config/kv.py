@@ -49,6 +49,10 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "audit_webhook": {"enable": "off", "endpoint": ""},
     "notify_webhook": {"enable": "off", "endpoint": "",
                        "queue_limit": "10000"},
+    "notify_redis": {"enable": "off", "address": "", "key": "minioevents",
+                     "format": "namespace", "password": ""},
+    "notify_kafka": {"enable": "off", "brokers": "", "topic": ""},
+    "notify_mqtt": {"enable": "off", "broker": "", "topic": ""},
 }
 
 
@@ -262,6 +266,9 @@ class ConfigSys:
     # -- live application (lookupConfigs, cmd/config-current.go:323) -------
 
     CONFIG_WEBHOOK_ARN = "arn:minio:sqs::_:webhook"
+    CONFIG_REDIS_ARN = "arn:minio:sqs::_:redis"
+    CONFIG_KAFKA_ARN = "arn:minio:sqs::_:kafka"
+    CONFIG_MQTT_ARN = "arn:minio:sqs::_:mqtt"
 
     def apply(self, api, events=None, trace=None) -> None:
         """Push config into a running S3ApiHandlers + subsystems.
@@ -291,11 +298,39 @@ class ConfigSys:
             else:
                 trace.audit_webhook = ""
         if events is not None:
-            if self.get("notify_webhook", "enable").lower() in ("on",
-                                                                "true", "1"):
-                from ..features.events import WebhookTarget
+            def _on(subsys: str) -> bool:
+                return self.get(subsys, "enable").lower() in ("on",
+                                                              "true", "1")
+            from ..features.events import (KafkaTarget, MQTTTarget,
+                                           RedisTarget, WebhookTarget)
+            if _on("notify_webhook"):
                 events.register_target(WebhookTarget(
                     self.CONFIG_WEBHOOK_ARN,
                     self.get("notify_webhook", "endpoint")))
             else:
-                events.targets.pop(self.CONFIG_WEBHOOK_ARN, None)
+                events.unregister_target(self.CONFIG_WEBHOOK_ARN)
+            if _on("notify_redis"):
+                events.register_target(RedisTarget(
+                    self.CONFIG_REDIS_ARN,
+                    self.get("notify_redis", "address"),
+                    self.get("notify_redis", "key"),
+                    format=self.get("notify_redis", "format"),
+                    password=self.get("notify_redis", "password")))
+            else:
+                events.unregister_target(self.CONFIG_REDIS_ARN)
+            if _on("notify_kafka"):
+                events.register_target(KafkaTarget(
+                    self.CONFIG_KAFKA_ARN,
+                    [b.strip() for b in
+                     self.get("notify_kafka", "brokers").split(",")
+                     if b.strip()],
+                    self.get("notify_kafka", "topic")))
+            else:
+                events.unregister_target(self.CONFIG_KAFKA_ARN)
+            if _on("notify_mqtt"):
+                events.register_target(MQTTTarget(
+                    self.CONFIG_MQTT_ARN,
+                    self.get("notify_mqtt", "broker"),
+                    self.get("notify_mqtt", "topic")))
+            else:
+                events.unregister_target(self.CONFIG_MQTT_ARN)

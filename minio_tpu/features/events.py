@@ -3,9 +3,17 @@
 The reference's pkg/event: per-bucket NotificationConfiguration XML maps
 event-name patterns + prefix/suffix filters to targets (ARNs); every
 object operation publishes an S3-format event record to the matching
-targets, asynchronously with retry (queue store). Here: a webhook target
-(HTTP POST of the JSON record) and an in-memory target for tests, with a
-bounded async queue + retries.
+targets, asynchronously with retry. Durability matches the reference's
+queuestore (pkg/event/target/queuestore.go): when the notifier has a
+queue directory, every matched event is persisted BEFORE dispatch and
+deleted only after the target accepts it — pending events survive a
+process restart (at-least-once).
+
+Targets: webhook (HTTP POST), redis (real RESP2 wire protocol —
+namespace HSET / access-log RPUSH like pkg/event/target/redis.go),
+mqtt (real MQTT 3.1.1 CONNECT/PUBLISH), kafka (produce logic behind a
+pluggable producer — the broker wire protocol needs a client lib this
+image doesn't ship), memory (tests / ListenNotification feed).
 """
 
 from __future__ import annotations
@@ -13,12 +21,15 @@ from __future__ import annotations
 import dataclasses
 import fnmatch
 import json
+import os
 import queue
+import socket
 import threading
 import time
 import urllib.request
+import uuid as _uuid
 import xml.etree.ElementTree as ET
-from typing import Optional
+from typing import Callable, Optional
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 
@@ -83,6 +94,64 @@ class NotificationConfig:
 
 
 # ---------------------------------------------------------------------------
+# durable queue store (pkg/event/target/queuestore.go semantics)
+# ---------------------------------------------------------------------------
+
+class QueueStore:
+    """One directory of JSON event files per target. put() is atomic
+    (tmp + rename); entries are deleted only after successful delivery,
+    so whatever is on disk at startup is exactly the undelivered
+    backlog."""
+
+    def __init__(self, directory: str, limit: int = 10000):
+        self.dir = directory
+        self.limit = limit
+        os.makedirs(directory, exist_ok=True)
+        self._mu = threading.Lock()
+        # O(1) limit enforcement: count once at startup, maintain on
+        # put/delete (a per-put listdir is O(n^2) as backlog grows)
+        self._count = len(self.keys())
+
+    def put(self, record: dict) -> Optional[str]:
+        """Persist; returns the entry key, or None when the store is at
+        its limit (caller falls back to at-most-once)."""
+        with self._mu:
+            if self._count >= self.limit:
+                return None
+            key = f"{time.time_ns():020d}-{_uuid.uuid4().hex[:8]}"
+            tmp = os.path.join(self.dir, f".tmp-{key}")
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, os.path.join(self.dir, key))
+            self._count += 1
+            return key
+
+    def get(self, key: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            try:
+                os.remove(os.path.join(self.dir, key))
+                self._count -= 1
+            except OSError:
+                pass
+
+    def keys(self) -> list[str]:
+        """Undelivered entry keys, oldest first (names sort by put
+        time)."""
+        try:
+            return sorted(k for k in os.listdir(self.dir)
+                          if not k.startswith("."))
+        except OSError:
+            return []
+
+
+# ---------------------------------------------------------------------------
 # targets
 # ---------------------------------------------------------------------------
 
@@ -101,6 +170,158 @@ class WebhookTarget:
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
+
+
+class RedisTarget:
+    """Event delivery over the actual Redis RESP2 wire protocol
+    (pkg/event/target/redis.go): format="namespace" keeps a hash of
+    object-key -> latest event (HSET / HDEL on delete events);
+    format="access" appends every event to a list (RPUSH)."""
+
+    def __init__(self, arn: str, addr: str, key: str,
+                 format: str = "namespace", password: str = "",
+                 timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        self.arn, self.addr, self.key = arn, addr, key
+        self.format = format
+        self.password = password
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 6379), timeout=self.timeout)
+
+    @staticmethod
+    def _resp(*args: bytes) -> bytes:
+        out = b"*%d\r\n" % len(args)
+        for a in args:
+            out += b"$%d\r\n%s\r\n" % (len(a), a)
+        return out
+
+    @staticmethod
+    def _read_reply(f) -> bytes:
+        line = f.readline()
+        if not line:
+            raise OSError("redis connection closed")
+        if line[:1] == b"-":
+            raise OSError(f"redis error: {line[1:].strip().decode()}")
+        if line[:1] == b"$":                    # bulk string
+            n = int(line[1:])
+            return f.read(n + 2)[:-2] if n >= 0 else b""
+        return line.strip()                     # +OK / :n
+
+    def send(self, record: dict) -> None:
+        rec = record["Records"][0]
+        obj_key = rec["s3"]["object"]["key"]
+        body = json.dumps(record).encode()
+        with self._connect() as s:
+            f = s.makefile("rb")
+            if self.password:
+                s.sendall(self._resp(b"AUTH", self.password.encode()))
+                self._read_reply(f)
+            if self.format == "access":
+                cmd = self._resp(b"RPUSH", self.key.encode(), body)
+            elif rec["eventName"].startswith("s3:ObjectRemoved"):
+                cmd = self._resp(b"HDEL", self.key.encode(),
+                                 obj_key.encode())
+            else:
+                cmd = self._resp(b"HSET", self.key.encode(),
+                                 obj_key.encode(), body)
+            s.sendall(cmd)
+            self._read_reply(f)
+
+
+class MQTTTarget:
+    """Event delivery over real MQTT 3.1.1 (pkg/event/target/mqtt.go):
+    CONNECT, await CONNACK, PUBLISH QoS 0, DISCONNECT."""
+
+    def __init__(self, arn: str, addr: str, topic: str,
+                 client_id: str = "", timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        self.arn, self.addr, self.topic = arn, addr, topic
+        self.client_id = client_id or f"minio-tpu-{_uuid.uuid4().hex[:8]}"
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 1883), timeout=self.timeout)
+
+    @staticmethod
+    def _varlen(n: int) -> bytes:
+        out = b""
+        while True:
+            b7, n = n & 0x7F, n >> 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    @staticmethod
+    def _mstr(s: bytes) -> bytes:
+        return len(s).to_bytes(2, "big") + s
+
+    def send(self, record: dict) -> None:
+        body = json.dumps(record).encode()
+        var = (self._mstr(b"MQTT") + b"\x04"   # protocol level 3.1.1
+               + b"\x02"                       # clean session
+               + (60).to_bytes(2, "big")       # keepalive
+               + self._mstr(self.client_id.encode()))
+        connect = b"\x10" + self._varlen(len(var)) + var
+        pub_var = self._mstr(self.topic.encode()) + body
+        publish = b"\x30" + self._varlen(len(pub_var)) + pub_var
+        with self._connect() as s:
+            s.sendall(connect)
+            ack = b""
+            while len(ack) < 4:                # CONNACK may fragment
+                chunk = s.recv(4 - len(ack))
+                if not chunk:
+                    raise OSError("MQTT connection closed before CONNACK")
+                ack += chunk
+            if ack[0] != 0x20 or ack[3] != 0:
+                raise OSError(f"MQTT CONNACK refused: {ack.hex()}")
+            s.sendall(publish)
+            s.sendall(b"\xe0\x00")             # DISCONNECT
+
+
+class KafkaTarget:
+    """Kafka-shaped target (pkg/event/target/kafka.go): key = object
+    key, value = event JSON, routed to `topic`. The broker wire
+    protocol requires a client library this image doesn't ship, so the
+    producer is pluggable: pass `producer(topic, key, value)` (tests
+    inject one; production wires kafka-python/confluent when present).
+    """
+
+    def __init__(self, arn: str, brokers: list[str], topic: str,
+                 producer: Optional[Callable] = None):
+        self.arn, self.brokers, self.topic = arn, brokers, topic
+        self._producer = producer    # resolved lazily on first send:
+        # building a broker connection in __init__ would run inside
+        # ConfigSys.apply() on node startup and crash the boot when the
+        # broker is temporarily down — deferring lets the queuestore
+        # retry machinery absorb the outage instead
+
+    def _default_producer(self) -> Callable:
+        try:
+            from kafka import KafkaProducer  # type: ignore
+        except ImportError:
+            raise OSError(
+                "no kafka client library available; inject a "
+                "producer or install kafka-python") from None
+        kp = KafkaProducer(bootstrap_servers=self.brokers)
+
+        def produce(topic, key, value):
+            kp.send(topic, key=key, value=value).get(timeout=10)
+        return produce
+
+    def send(self, record: dict) -> None:
+        if self._producer is None:
+            self._producer = self._default_producer()
+        rec = record["Records"][0]
+        key = rec["s3"]["object"]["key"].encode()
+        self._producer(self.topic, key, json.dumps(record).encode())
 
 
 class MemoryTarget:
@@ -149,23 +370,49 @@ class EventNotifier:
     """Per-bucket rule matching + async fan-out with retries."""
 
     def __init__(self, bucket_meta_sys, region: str = "us-east-1",
-                 retries: int = 3, queue_size: int = 10000):
+                 retries: int = 3, queue_size: int = 10000,
+                 queue_dir: Optional[str] = None,
+                 redrive_interval: float = 60.0):
         self.bucket_meta = bucket_meta_sys
         self.region = region
         self.retries = retries
         self.targets: dict[str, object] = {}     # arn -> target
+        # durable at-least-once backlog, one store per target (reference
+        # queuestore.go); None = legacy in-memory at-most-once
+        self.queue_dir = queue_dir
+        self.redrive_interval = redrive_interval
+        self._stores: dict[str, QueueStore] = {}
+        self._inflight: set[tuple[str, str]] = set()   # (arn, key)
         # live-listen hub: every event (rule-matched or not) publishes
         # here for ListenBucketNotification subscribers (pkg/pubsub use
         # in cmd/listen-notification-handlers.go)
         from ..utils.pubsub import PubSub
         self.hub = PubSub()
+        self._mu = threading.Lock()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+        if queue_dir is not None:
+            self._redrive_thread = threading.Thread(
+                target=self._redrive_loop, daemon=True)
+            self._redrive_thread.start()
 
     def register_target(self, target) -> None:
         self.targets[target.arn] = target
+        if self.queue_dir is not None:
+            safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                           for c in target.arn)
+            store = QueueStore(os.path.join(self.queue_dir, safe))
+            self._stores[target.arn] = store
+            self.redrive(target.arn)     # replay pre-restart backlog
+
+    def unregister_target(self, arn: str) -> None:
+        """Remove a target AND its queue store binding — a disabled
+        target must stop accumulating (and redriving) backlog. Entries
+        already on disk stay there until the target is re-enabled."""
+        self.targets.pop(arn, None)
+        self._stores.pop(arn, None)
 
     def close(self) -> None:
         self._stop.set()
@@ -191,26 +438,86 @@ class EventNotifier:
                 continue
             record = event_record(event_name, bucket, key, size, etag,
                                   self.region)
-            try:
-                self._q.put_nowait((target, record, 0))
-            except queue.Full:
-                pass                        # at-most-once under overload
+            store = self._stores.get(rule.arn)
+            store_key = store.put(record) if store is not None else None
+            # store full -> at-most-once fallback (store_key None)
+            self._enqueue(rule.arn, record, store_key, 0)
+
+    def _enqueue(self, arn: str, record: dict, store_key: Optional[str],
+                 attempt: int) -> bool:
+        if store_key is not None:
+            with self._mu:
+                if (arn, store_key) in self._inflight:
+                    return False
+                self._inflight.add((arn, store_key))
+        try:
+            self._q.put_nowait((arn, record, store_key, attempt))
+            return True
+        except queue.Full:
+            # durable entries stay in the store; the redrive loop
+            # re-queues them once there is room (at-least-once)
+            if store_key is not None:
+                with self._mu:
+                    self._inflight.discard((arn, store_key))
+            return False
+
+    def redrive(self, arn: Optional[str] = None) -> int:
+        """Queue every persisted-but-unqueued entry (startup replay and
+        the periodic loop). Returns how many were queued."""
+        n = 0
+        for a, store in list(self._stores.items()):
+            if arn is not None and a != arn:
+                continue
+            if a not in self.targets:
+                continue               # disabled target: backlog waits
+            for key in store.keys():
+                with self._mu:
+                    if (a, key) in self._inflight:
+                        continue
+                record = store.get(key)
+                if record is None:
+                    store.delete(key)       # corrupt entry
+                    continue
+                if self._enqueue(a, record, key, 0):
+                    n += 1
+        return n
+
+    def _redrive_loop(self) -> None:
+        while not self._stop.wait(self.redrive_interval):
+            self.redrive()
 
     def _worker(self) -> None:
         while not self._stop.is_set():
             try:
-                target, record, attempt = self._q.get(timeout=0.25)
+                arn, record, store_key, attempt = self._q.get(
+                    timeout=0.25)
             except queue.Empty:
                 continue
+            target = self.targets.get(arn)
             try:
+                if target is None:
+                    raise OSError(f"no target registered for {arn}")
                 target.send(record)
+                if store_key is not None:
+                    self._stores[arn].delete(store_key)
+                    with self._mu:
+                        self._inflight.discard((arn, store_key))
             except Exception:  # noqa: BLE001 — retry with backoff
                 if attempt + 1 < self.retries:
                     time.sleep(0.2 * (attempt + 1))
                     try:
-                        self._q.put_nowait((target, record, attempt + 1))
+                        self._q.put_nowait(
+                            (arn, record, store_key, attempt + 1))
                     except queue.Full:
-                        pass
+                        if store_key is not None:
+                            with self._mu:
+                                self._inflight.discard((arn, store_key))
+                elif store_key is not None:
+                    # retries exhausted: the durable entry REMAINS in
+                    # the store; the redrive loop (or next restart)
+                    # tries again — at-least-once, never silent drop
+                    with self._mu:
+                        self._inflight.discard((arn, store_key))
             finally:
                 self._q.task_done()
 

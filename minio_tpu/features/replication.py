@@ -144,10 +144,18 @@ class _S3MiniClient:
 
 
 class ReplicationPool:
-    """Async replication workers (cmd/bucket-replication.go pool)."""
+    """Async replication workers (cmd/bucket-replication.go pool).
+
+    With a queue_dir, every pending task is persisted BEFORE dispatch
+    and deleted only after the destination accepted it — pending
+    replication survives a process restart (the reference re-drives
+    lost work via MRF/status headers; here the queuestore pattern from
+    features/events.py serves both subsystems)."""
 
     def __init__(self, object_layer, bucket_meta_sys, workers: int = 2,
-                 queue_size: int = 10000):
+                 queue_size: int = 10000,
+                 queue_dir: Optional[str] = None,
+                 redrive_interval: float = 60.0):
         self.obj = object_layer
         self.bucket_meta = bucket_meta_sys
         self.targets: dict[str, ReplicationTarget] = {}
@@ -155,14 +163,66 @@ class ReplicationPool:
         self._stop = threading.Event()
         self.replicated = 0            # counters for admin/metrics
         self.failed = 0
+        self._mu = threading.Lock()
+        self._inflight: set[str] = set()
+        self.store = None
+        if queue_dir is not None:
+            from .events import QueueStore
+            self.store = QueueStore(queue_dir)
+            threading.Thread(target=self._redrive_loop, args=(
+                redrive_interval,), daemon=True).start()
         for _ in range(workers):
             threading.Thread(target=self._worker, daemon=True).start()
 
     def register_target(self, t: ReplicationTarget) -> None:
         self.targets[t.arn] = t
+        if self.store is not None:
+            self.redrive()             # replay pre-restart backlog
 
     def close(self) -> None:
         self._stop.set()
+
+    def redrive(self) -> int:
+        """Queue persisted-but-unqueued tasks (startup replay + the
+        periodic loop). Tasks whose target isn't registered yet stay
+        persisted."""
+        if self.store is None:
+            return 0
+        n = 0
+        for skey in self.store.keys():
+            with self._mu:
+                if skey in self._inflight:
+                    continue
+            task = self.store.get(skey)
+            if task is None:
+                self.store.delete(skey)
+                continue
+            if task.get("arn") not in self.targets:
+                continue
+            if self._queue_task(task, skey):
+                n += 1
+        return n
+
+    def _redrive_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.redrive()
+
+    def _queue_task(self, task: dict, skey: Optional[str]) -> bool:
+        if skey is not None:
+            with self._mu:
+                if skey in self._inflight:
+                    return False
+                self._inflight.add(skey)
+        try:
+            self._q.put_nowait((task, skey))
+            return True
+        except queue.Full:
+            if skey is not None:
+                with self._mu:
+                    self._inflight.discard(skey)
+            else:
+                self.failed += 1
+            return False
 
     # -- enqueue hooks (called from the S3 handlers) -----------------------
 
@@ -197,25 +257,35 @@ class ReplicationPool:
         target = self.targets.get(rule.target_arn)
         if target is None:
             return
-        try:
-            self._q.put_nowait((op, bucket, key, target))
-        except queue.Full:
-            self.failed += 1
+        task = {"op": op, "bucket": bucket, "key": key,
+                "arn": rule.target_arn}
+        skey = self.store.put(task) if self.store is not None else None
+        self._queue_task(task, skey)
 
     # -- workers -----------------------------------------------------------
 
     def _worker(self) -> None:
         while not self._stop.is_set():
             try:
-                op, bucket, key, target = self._q.get(timeout=0.25)
+                task, skey = self._q.get(timeout=0.25)
             except queue.Empty:
                 continue
             try:
-                self._replicate(op, bucket, key, target)
+                target = self.targets.get(task["arn"])
+                if target is None:
+                    raise OSError(f"no target {task['arn']}")
+                self._replicate(task["op"], task["bucket"], task["key"],
+                                target)
                 self.replicated += 1
-            except Exception:  # noqa: BLE001 — counted, next crawl retries
+                if skey is not None and self.store is not None:
+                    self.store.delete(skey)
+            except Exception:  # noqa: BLE001 — counted; durable entries
+                # stay persisted for the redrive loop / next restart
                 self.failed += 1
             finally:
+                if skey is not None:
+                    with self._mu:
+                        self._inflight.discard(skey)
                 self._q.task_done()
 
     def _replicate(self, op: str, bucket: str, key: str,

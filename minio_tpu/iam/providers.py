@@ -258,9 +258,9 @@ class LDAPProvider:
         return bool(self.server_addr)
 
     def _default_connect(self) -> socket.socket:
-        host, _, port = self.server_addr.rpartition(":")
-        return socket.create_connection((host or self.server_addr,
-                                         int(port or 389)), timeout=10)
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.server_addr, 389), timeout=10)
 
     def bind(self, username: str, password: str) -> str:
         """Simple bind; returns the bound DN or raises

@@ -288,18 +288,17 @@ class IAMSys:
         self._notify()
         return cred
 
-    def assume_role(self, parent_cred: Credentials,
-                    duration_seconds: int = 3600) -> Credentials:
-        """Mint temp credentials for an authenticated user (reference
-        AssumeRole, cmd/sts-handlers.go:43-86)."""
-        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+    def _mint_sts(self, parent: str, duration_seconds: int
+                  ) -> Credentials:
+        """Shared STS mint-and-persist (one copy of the sts/ record
+        format for assume_role and the federation paths)."""
         fresh = generate_credentials()
         token = base64.urlsafe_b64encode(secrets.token_bytes(24)).decode()
         cred = Credentials(
             access_key=fresh.access_key, secret_key=fresh.secret_key,
             session_token=token,
             expiration=time.time() + duration_seconds,
-            parent_user=parent_cred.parent_user or parent_cred.access_key)
+            parent_user=parent)
         with self._mu:
             self.sts_creds[cred.access_key] = cred
             self._save(self._path("sts", cred.access_key),
@@ -307,6 +306,16 @@ class IAMSys:
                         "session_token": cred.session_token,
                         "expiration": cred.expiration,
                         "parent": cred.parent_user})
+        return cred
+
+    def assume_role(self, parent_cred: Credentials,
+                    duration_seconds: int = 3600) -> Credentials:
+        """Mint temp credentials for an authenticated user (reference
+        AssumeRole, cmd/sts-handlers.go:43-86)."""
+        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+        cred = self._mint_sts(
+            parent_cred.parent_user or parent_cred.access_key,
+            duration_seconds)
         self._notify()
         return cred
 
@@ -330,21 +339,9 @@ class IAMSys:
             duration_seconds = min(duration_seconds, int(max_seconds))
             if duration_seconds <= 0:
                 raise IAMError("identity token already expired")
-        fresh = generate_credentials()
-        token = base64.urlsafe_b64encode(secrets.token_bytes(24)).decode()
-        cred = Credentials(
-            access_key=fresh.access_key, secret_key=fresh.secret_key,
-            session_token=token,
-            expiration=time.time() + duration_seconds,
-            parent_user=subject)
-        with self._mu:
-            self.sts_creds[cred.access_key] = cred
-            self._save(self._path("sts", cred.access_key),
-                       {"secret_key": cred.secret_key,
-                        "session_token": cred.session_token,
-                        "expiration": cred.expiration,
-                        "parent": cred.parent_user})
-            if policy_names is not None:
+        cred = self._mint_sts(subject, duration_seconds)
+        if policy_names is not None:
+            with self._mu:
                 self.user_policy[subject] = list(policy_names)
                 self._save(self._path("policydb/users",
                                       subject.replace("/", "_")),

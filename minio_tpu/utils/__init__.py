@@ -1,0 +1,10 @@
+def host_port(addr: str, default_port: int) -> tuple[str, int]:
+    """Split "host[:port]" robustly: a bare hostname gets the default
+    port (a naive rpartition(":") would misparse it as the port)."""
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        return addr, default_port
+    try:
+        return host, int(port)
+    except ValueError:
+        return addr, default_port

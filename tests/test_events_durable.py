@@ -1,0 +1,389 @@
+"""Durable event notification (VERDICT r2 item 3): at-least-once queue
+store surviving restart (pkg/event/target/queuestore.go semantics) +
+the new wire-protocol targets (Redis RESP2, MQTT 3.1.1, Kafka-shaped).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from minio_tpu.features.events import (EventNotifier, KafkaTarget,
+                                       MemoryTarget, MQTTTarget,
+                                       NotificationConfig, QueueStore,
+                                       RedisTarget, event_record)
+
+
+# ---------------------------------------------------------------------------
+# queue store
+# ---------------------------------------------------------------------------
+
+def test_queuestore_roundtrip_and_limit(tmp_path):
+    qs = QueueStore(str(tmp_path / "q"), limit=3)
+    keys = [qs.put(event_record("s3:ObjectCreated:Put", "b", f"k{i}"))
+            for i in range(3)]
+    assert all(keys)
+    assert qs.put(event_record("s3:ObjectCreated:Put", "b", "k3")) is None
+    assert qs.keys() == sorted(keys)          # oldest first
+    rec = qs.get(keys[0])
+    assert rec["Records"][0]["s3"]["object"]["key"] == "k0"
+    qs.delete(keys[0])
+    assert len(qs.keys()) == 2
+
+
+class _Meta:
+    """bucket_meta stub: one bucket wired to one ARN for all events."""
+
+    def __init__(self, arn):
+        self.xml = (
+            '<NotificationConfiguration>'
+            '<QueueConfiguration>'
+            f'<Queue>{arn}</Queue>'
+            '<Event>s3:ObjectCreated:*</Event>'
+            '</QueueConfiguration></NotificationConfiguration>')
+
+    def get(self, bucket):
+        class BM:
+            notification_xml = self.xml
+        return BM()
+
+
+class FlakyTarget:
+    """Fails until `ok` is set; then records deliveries."""
+
+    def __init__(self, arn):
+        self.arn = arn
+        self.ok = False
+        self.delivered: list[str] = []
+        self._cond = threading.Condition()
+
+    def send(self, record):
+        with self._cond:
+            if not self.ok:
+                raise OSError("target down")
+            self.delivered.append(
+                record["Records"][0]["s3"]["object"]["key"])
+            self._cond.notify_all()
+
+    def wait_for(self, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self.delivered) < n:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cond.wait(left):
+                    return False
+            return True
+
+
+def test_events_survive_restart(tmp_path):
+    """Events sent while the target is down must be delivered by a NEW
+    notifier over the same queue dir — no event loss across restart."""
+    arn = "arn:minio:sqs::1:webhook"
+    meta = _Meta(arn)
+    qdir = str(tmp_path / "events")
+
+    n1 = EventNotifier(meta, retries=2, queue_dir=qdir,
+                       redrive_interval=3600)
+    down = FlakyTarget(arn)
+    n1.register_target(down)
+    for i in range(5):
+        n1.send("s3:ObjectCreated:Put", "bkt", f"obj{i}")
+    n1.drain(5)
+    n1.close()                                 # "process dies"
+    assert not down.delivered
+
+    n2 = EventNotifier(meta, retries=2, queue_dir=qdir,
+                       redrive_interval=3600)
+    up = FlakyTarget(arn)
+    up.ok = True
+    n2.register_target(up)                     # startup replay
+    assert up.wait_for(5), f"only {up.delivered} delivered"
+    assert sorted(up.delivered) == [f"obj{i}" for i in range(5)]
+    # store is empty after delivery: a third notifier delivers nothing
+    n2.drain(5)
+    n2.close()
+    n3 = EventNotifier(meta, retries=2, queue_dir=qdir,
+                       redrive_interval=3600)
+    again = FlakyTarget(arn)
+    again.ok = True
+    n3.register_target(again)
+    n3.drain(2)
+    assert not again.delivered                 # no duplicates after ack
+    n3.close()
+
+
+def test_redrive_after_exhausted_retries(tmp_path):
+    """Retries exhausted -> entry stays persisted; an explicit redrive
+    (the periodic loop's body) delivers it once the target recovers."""
+    arn = "arn:minio:sqs::1:webhook"
+    meta = _Meta(arn)
+    n = EventNotifier(meta, retries=2, queue_dir=str(tmp_path / "q"),
+                      redrive_interval=3600)
+    t = FlakyTarget(arn)
+    n.register_target(t)
+    n.send("s3:ObjectCreated:Put", "bkt", "late")
+    n.drain(5)
+    assert not t.delivered
+    t.ok = True
+    assert n.redrive() == 1
+    assert t.wait_for(1)
+    n.close()
+
+
+# ---------------------------------------------------------------------------
+# Redis target: real RESP2 against an in-process server
+# ---------------------------------------------------------------------------
+
+class FakeRedis:
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.commands: list[list[bytes]] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    while True:
+                        line = f.readline()
+                        if not line or line[:1] != b"*":
+                            break
+                        n = int(line[1:])
+                        args = []
+                        for _ in range(n):
+                            ln = int(f.readline()[1:])
+                            args.append(f.read(ln + 2)[:-2])
+                        self.commands.append(args)
+                        conn.sendall(b"+OK\r\n" if args[0] != b"RPUSH"
+                                     else b":1\r\n")
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_redis_target_namespace_and_access():
+    srv = FakeRedis()
+    try:
+        t = RedisTarget("arn:minio:sqs::1:redis",
+                        f"127.0.0.1:{srv.port}", "bucketevents")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "x/y"))
+        t.send(event_record("s3:ObjectRemoved:Delete", "b", "x/y"))
+        acc = RedisTarget("arn:minio:sqs::2:redis",
+                          f"127.0.0.1:{srv.port}", "log",
+                          format="access")
+        acc.send(event_record("s3:ObjectCreated:Put", "b", "z"))
+        deadline = time.monotonic() + 5
+        while len(srv.commands) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        cmds = srv.commands
+        assert cmds[0][0] == b"HSET" and cmds[0][1] == b"bucketevents" \
+            and cmds[0][2] == b"x/y"
+        assert json.loads(cmds[0][3])["Records"][0]["eventName"] == \
+            "s3:ObjectCreated:Put"
+        assert cmds[1][:3] == [b"HDEL", b"bucketevents", b"x/y"]
+        assert cmds[2][0] == b"RPUSH" and cmds[2][1] == b"log"
+    finally:
+        srv.close()
+
+
+def test_redis_target_error_raises():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def answer():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(b"-NOAUTH Authentication required\r\n")
+        conn.close()
+
+    threading.Thread(target=answer, daemon=True).start()
+    t = RedisTarget("a", f"127.0.0.1:{port}", "k")
+    with pytest.raises(OSError, match="NOAUTH"):
+        t.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# MQTT target: real 3.1.1 against an in-process broker
+# ---------------------------------------------------------------------------
+
+class FakeMQTT:
+    def __init__(self, refuse=False):
+        self.refuse = refuse
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.published: list[tuple[str, bytes]] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @staticmethod
+    def _read_packet(f):
+        h = f.read(1)
+        if not h:
+            return None, b""
+        mult, ln = 1, 0
+        while True:
+            b = f.read(1)[0]
+            ln += (b & 0x7F) * mult
+            mult *= 128
+            if not b & 0x80:
+                break
+        return h[0], f.read(ln)
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    ptype, _body = self._read_packet(f)
+                    if ptype >> 4 != 1:         # expect CONNECT
+                        continue
+                    rc = 5 if self.refuse else 0
+                    conn.sendall(bytes([0x20, 2, 0, rc]))
+                    if self.refuse:
+                        continue
+                    while True:
+                        ptype, body = self._read_packet(f)
+                        if ptype is None or ptype >> 4 == 14:  # DISCONNECT
+                            break
+                        if ptype >> 4 == 3:     # PUBLISH QoS0
+                            tl = int.from_bytes(body[:2], "big")
+                            topic = body[2:2 + tl].decode()
+                            self.published.append((topic, body[2 + tl:]))
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_mqtt_target_publish_and_refusal():
+    broker = FakeMQTT()
+    try:
+        t = MQTTTarget("arn:minio:sqs::1:mqtt",
+                       f"127.0.0.1:{broker.port}", "minio/events")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "mq"))
+        deadline = time.monotonic() + 5
+        while not broker.published and time.monotonic() < deadline:
+            time.sleep(0.01)
+        topic, payload = broker.published[0]
+        assert topic == "minio/events"
+        assert json.loads(payload)["Records"][0]["s3"]["object"]["key"] \
+            == "mq"
+    finally:
+        broker.close()
+
+    refusing = FakeMQTT(refuse=True)
+    try:
+        t = MQTTTarget("a", f"127.0.0.1:{refusing.port}", "t")
+        with pytest.raises(OSError, match="CONNACK"):
+            t.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+    finally:
+        refusing.close()
+
+
+# ---------------------------------------------------------------------------
+# Kafka-shaped target: pluggable producer
+# ---------------------------------------------------------------------------
+
+def test_kafka_target_producer_injection():
+    sent = []
+    t = KafkaTarget("arn:minio:sqs::1:kafka", ["broker:9092"], "events",
+                    producer=lambda topic, key, value:
+                    sent.append((topic, key, value)))
+    t.send(event_record("s3:ObjectCreated:Put", "b", "kf"))
+    assert sent[0][0] == "events" and sent[0][1] == b"kf"
+    assert json.loads(sent[0][2])["Records"][0]["s3"]["object"]["key"] \
+        == "kf"
+
+
+def test_kafka_target_without_library_errors():
+    t = KafkaTarget("a", ["broker:9092"], "events")
+    with pytest.raises(OSError, match="kafka client library"):
+        t.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+
+
+# ---------------------------------------------------------------------------
+# replication durability across restart
+# ---------------------------------------------------------------------------
+
+def test_replication_survives_restart(tmp_path):
+    """Replication queued while the destination is down must be
+    re-driven by a NEW pool over the same queue dir after 'restart'
+    (VERDICT r2 weak #6)."""
+    from minio_tpu.features.replication import (ReplicationPool,
+                                                ReplicationTarget)
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+    from tests.test_features import REPL_XML, _mk_sets
+    from minio_tpu.s3.handlers import S3ApiHandlers
+
+    creds = Credentials("replsrckey1", "replsrcsecret1")
+    src = _mk_sets(tmp_path / "src")
+    dst = _mk_sets(tmp_path / "dst")
+    qdir = str(tmp_path / "replq")
+    try:
+        src.make_bucket("srcb")
+        dst.make_bucket("dstb")
+        api = S3ApiHandlers(src, creds=creds)
+        api.bucket_meta.update("srcb", replication_xml=REPL_XML)
+        src.put_object("srcb", "obj1", b"durable repl")
+
+        # pool 1: destination server NOT running -> replication fails,
+        # task stays persisted
+        pool1 = ReplicationPool(src, api.bucket_meta, queue_dir=qdir,
+                                redrive_interval=3600)
+        pool1.register_target(ReplicationTarget(
+            arn="arn:minio:replication::dst:target",
+            host="127.0.0.1", port=1, bucket="dstb",
+            access_key=creds.access_key, secret_key=creds.secret_key))
+        pool1.on_put("srcb", "obj1")
+        pool1.drain()
+        assert pool1.replicated == 0 and pool1.failed >= 1
+        assert len(pool1.store.keys()) == 1
+        pool1.close()                      # "process dies"
+
+        # pool 2 over the same dir, destination now up
+        dst_srv = S3Server(dst, creds=creds).start()
+        try:
+            pool2 = ReplicationPool(src, api.bucket_meta,
+                                    queue_dir=qdir,
+                                    redrive_interval=3600)
+            pool2.register_target(ReplicationTarget(
+                arn="arn:minio:replication::dst:target",
+                host="127.0.0.1", port=dst_srv.port, bucket="dstb",
+                access_key=creds.access_key,
+                secret_key=creds.secret_key))
+            deadline = time.monotonic() + 10
+            while pool2.replicated < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            _, stream = dst.get_object("dstb", "obj1")
+            assert b"".join(stream) == b"durable repl"
+            assert pool2.store.keys() == []      # acked -> store empty
+            pool2.close()
+        finally:
+            dst_srv.stop()
+    finally:
+        src.close()
+        dst.close()
