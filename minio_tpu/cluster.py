@@ -220,6 +220,13 @@ class ClusterNode:
             lambda: (self.crawler.usage
                      if getattr(self, "crawler", None) is not None
                      else {})
+        self._peer_rpc.obd_drive_paths = list(self.spec.drives)
+        # console-log ring: name this node's singleton so merged
+        # cluster logs attribute lines to their origin
+        from .utils.console import get_console
+        self.console = get_console()
+        self.console.node = self.spec.addr
+        self.console.log_line("INFO", f"node {self.spec.addr} online")
 
         # -- admin / health / metrics routers ------------------------------
         from .s3.admin import mount_admin
@@ -247,6 +254,21 @@ class ClusterNode:
         self.replication = ReplicationPool(self.object_layer,
                                            self.s3.api.bucket_meta,
                                            queue_dir=_rpq)
+        try:
+            buckets = [v.name for v in self.object_layer.list_buckets()]
+        except Exception as e:  # noqa: BLE001 — boot must proceed, but
+            # an unlistable namespace leaves targets unmounted: say so
+            self.console.log_line(
+                "ERROR", f"replication target mount skipped: {e}")
+            buckets = []
+        self.replication.mount_persisted_targets(buckets)
+        # service restart/stop: peers run the same local action the
+        # admin endpoint runs — DEFERRED so the RPC reply reaches the
+        # broadcaster before this process exec-restarts
+        import threading as _threading
+        self._peer_rpc.signal_service = \
+            lambda sig: _threading.Timer(
+                0.2, self.admin.service_action, (sig,)).start()
         self.s3.api.replication = self.replication
         # apply stored/env config to the live subsystems
         self.config.apply(self.s3.api, events=self.events,

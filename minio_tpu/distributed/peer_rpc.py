@@ -40,6 +40,7 @@ class PeerRPCServer:
         self.get_storage_info: Callable[[], dict] = lambda: {}
         self.get_trace: Callable[[], list] = lambda: []
         self.get_bucket_usage: Callable[[], dict] = lambda: {}
+        self.obd_drive_paths: list[str] = []
 
         h = self.handler
         h.register("server-info", lambda a, b: {
@@ -53,6 +54,36 @@ class PeerRPCServer:
         h.register("storage-info", lambda a, b: self.get_storage_info())
         h.register("trace", lambda a, b: self.get_trace())
         h.register("bucket-usage", lambda a, b: self.get_bucket_usage())
+        # profiling fan-out (cmd/admin-handlers.go:461-525 peer verbs),
+        # console-log ring, OBD bundle (peer-rest-common.go:29-56)
+        h.register("profiling-start", self._profiling_start)
+        h.register("profiling-stop", self._profiling_stop)
+        h.register("console-log", self._console_log)
+        h.register("obd", self._obd)
+
+    def _profiling_start(self, args, body):
+        from ..utils import profiling
+        return {"node": self.node_id, "started": profiling.start()}
+
+    def _profiling_stop(self, args, body):
+        from ..utils import profiling
+        return {"node": self.node_id,
+                "profile": profiling.stop_text() or ""}
+
+    def _console_log(self, args, body):
+        from ..utils.console import get_console
+        try:
+            n = int(args.get("count", "0") or 0)
+        except ValueError:
+            n = 0
+        return {"node": self.node_id,
+                "entries": get_console().recent(n)}
+
+    def _obd(self, args, body):
+        from ..utils.obd import local_obd
+        out = local_obd(self.obd_drive_paths)
+        out["node"] = self.node_id
+        return out
 
     def _reload_bm(self, args, body):
         self.reload_bucket_metadata(args.get("bucket", ""))
@@ -127,6 +158,31 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return {}
 
+    def profiling_start(self) -> Optional[dict]:
+        try:
+            return self.rc.call_json("profiling-start")
+        except (NetworkError, RPCError):
+            return None
+
+    def profiling_stop(self) -> Optional[dict]:
+        try:
+            return self.rc.call_json("profiling-stop")
+        except (NetworkError, RPCError):
+            return None
+
+    def console_log(self, count: int = 0) -> Optional[dict]:
+        try:
+            return self.rc.call_json("console-log",
+                                     {"count": str(count)})
+        except (NetworkError, RPCError):
+            return None
+
+    def obd(self) -> Optional[dict]:
+        try:
+            return self.rc.call_json("obd")
+        except (NetworkError, RPCError):
+            return None
+
     @property
     def online(self) -> bool:
         return self.rc.online
@@ -190,6 +246,26 @@ class NotificationSys:
                 merged.extend(e for e in entries if isinstance(e, dict))
         merged.sort(key=lambda e: e.get("time", ""))
         return merged
+
+    def profiling_start_all(self) -> list:
+        return self._broadcast(lambda p: p.profiling_start())
+
+    def profiling_stop_all(self) -> list:
+        return self._broadcast(lambda p: p.profiling_stop())
+
+    def console_log_all(self, count: int = 0) -> list[dict]:
+        """Cluster-wide console entries, time-ordered."""
+        merged: list[dict] = []
+        for res in self._broadcast(lambda p: p.console_log(count)):
+            if isinstance(res, dict):
+                merged.extend(e for e in res.get("entries", [])
+                              if isinstance(e, dict))
+        merged.sort(key=lambda e: e.get("ts", 0))
+        return merged
+
+    def obd_all(self) -> list[dict]:
+        return [r for r in self._broadcast(lambda p: p.obd())
+                if isinstance(r, dict)]
 
 
 # ---------------------------------------------------------------------------

@@ -179,6 +179,29 @@ class ReplicationPool:
         if self.store is not None:
             self.redrive()             # replay pre-restart backlog
 
+    def mount_target_entry(self, entry: dict) -> None:
+        """Register a persisted bucket-metadata target dict (the admin
+        remote-target registry's on-disk shape)."""
+        self.register_target(ReplicationTarget(
+            arn=entry["arn"], host=entry["host"],
+            port=int(entry.get("port", 9000)),
+            bucket=entry["bucket"],
+            access_key=entry.get("access_key", ""),
+            secret_key=entry.get("secret_key", ""),
+            region=entry.get("region", "us-east-1"),
+            secure=bool(entry.get("secure", False))))
+
+    def mount_persisted_targets(self, buckets: list[str]) -> None:
+        """Boot-time re-registration of every bucket's remote targets
+        from bucket metadata (reference loads the target registry at
+        startup, cmd/bucket-targets.go)."""
+        for b in buckets:
+            try:
+                for entry in self.bucket_meta.get(b).replication_targets:
+                    self.mount_target_entry(entry)
+            except Exception:  # noqa: BLE001 — per-bucket best effort
+                continue
+
     def close(self) -> None:
         self._stop.set()
 

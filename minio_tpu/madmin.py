@@ -172,5 +172,59 @@ class AdminClient:
     def profiling_start(self) -> dict:
         return self._json("POST", "profiling/start")
 
-    def profiling_stop(self) -> str:
-        return self._request("POST", "profiling/stop").decode()
+    def profiling_stop(self) -> dict[str, str]:
+        """Stop cluster-wide profiling; returns {node: profile_text}
+        extracted from the server's zip (one entry per node)."""
+        import io
+        import zipfile
+        blob = self._request("POST", "profiling/stop")
+        out: dict[str, str] = {}
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            for name in zf.namelist():
+                out[name] = zf.read(name).decode()
+        return out
+
+    def console_log(self, count: int = 0) -> list[dict]:
+        """Merged cluster console-log ring entries."""
+        return self._json("GET", "consolelog",
+                          {"count": str(count)})["entries"]
+
+    # -- service / quota / remote targets ----------------------------------
+
+    def service_action(self, action: str) -> dict:
+        """Cluster-wide service restart/stop (mc admin service)."""
+        return self._json("POST", "service", {"action": action})
+
+    def set_bucket_quota(self, bucket: str, quota: int,
+                         quota_type: str = "hard") -> None:
+        self._json("PUT", "set-bucket-quota", {"bucket": bucket},
+                   body=json.dumps({"quota": quota,
+                                    "quotatype": quota_type}).encode())
+
+    def get_bucket_quota(self, bucket: str) -> dict:
+        return self._json("GET", "get-bucket-quota", {"bucket": bucket})
+
+    def set_remote_target(self, bucket: str, host: str, port: int,
+                          target_bucket: str, access_key: str,
+                          secret_key: str, region: str = "us-east-1"
+                          ) -> str:
+        """Register a replication destination; returns its ARN."""
+        return self._json(
+            "PUT", "set-remote-target", {"bucket": bucket},
+            body=json.dumps({"host": host, "port": port,
+                             "targetbucket": target_bucket,
+                             "accesskey": access_key,
+                             "secretkey": secret_key,
+                             "region": region}).encode())["arn"]
+
+    def list_remote_targets(self, bucket: str) -> list[dict]:
+        return json.loads(self._request(
+            "GET", "list-remote-targets", {"bucket": bucket}))
+
+    def remove_remote_target(self, bucket: str, arn: str) -> None:
+        self._json("DELETE", "remove-remote-target",
+                   {"bucket": bucket, "arn": arn})
+
+    def obd_info(self) -> list[dict]:
+        """Per-node OBD bundles (drive latency probes, cpu/mem)."""
+        return self._json("GET", "obdinfo")["nodes"]

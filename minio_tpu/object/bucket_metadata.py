@@ -28,7 +28,8 @@ class BucketMetadata:
 
     FIELDS = ("policy_json", "versioning", "tagging", "quota",
               "lifecycle_xml", "sse_config_xml", "object_lock_xml",
-              "notification_xml", "replication_xml")
+              "notification_xml", "replication_xml",
+              "replication_targets")
 
     def __init__(self, name: str):
         self.name = name
@@ -42,6 +43,9 @@ class BucketMetadata:
         self.object_lock_xml: str = ""
         self.notification_xml: str = ""
         self.replication_xml: str = ""
+        # remote-target registry (cmd/bucket-targets.go): [{arn, host,
+        # port, bucket, access_key, secret_key, region, secure}]
+        self.replication_targets: list[dict] = []
 
     def versioning_enabled(self) -> bool:
         return self.versioning == "Enabled"

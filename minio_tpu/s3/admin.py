@@ -162,6 +162,28 @@ class AdminHandlers:
         if sub == "profiling/stop" and m == "POST":
             self._auth(ctx, "admin:Profiling")
             return self._profiling_stop()
+        if sub == "consolelog" and m == "GET":
+            self._auth(ctx, "admin:ConsoleLog")
+            try:
+                n = int(ctx.query1("count", "0") or 0)
+            except ValueError:
+                n = 0
+            from ..utils.console import get_console
+            entries = list(get_console().recent(n))
+            if self.node is not None:
+                entries.extend(self.node.notification.console_log_all(n))
+            entries.sort(key=lambda e: e.get("ts", 0))
+            return self._json({"entries": entries[-1000:]})
+        if sub == "obdinfo" and m == "GET":
+            self._auth(ctx, "admin:OBDInfo")
+            from ..utils.obd import local_obd
+            drives = list(self.node.spec.drives) \
+                if self.node is not None else []
+            nodes = [local_obd(drives)]
+            if self.node is not None:
+                nodes[0]["node"] = self.node.spec.addr
+                nodes.extend(self.node.notification.obd_all())
+            return self._json({"nodes": nodes})
         if sub == "trace/cluster" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
             entries = list(self.api.trace.recent)
@@ -269,6 +291,62 @@ class AdminHandlers:
                 group=ctx.query1("userOrGroup")
                 if ctx.query1("isGroup") == "true" else "")
             return self._json({})
+        if sub == "service" and m == "POST":
+            self._auth(ctx, "admin:ServiceRestart")
+            action = ctx.query1("action", "")
+            if action not in ("restart", "stop"):
+                raise S3Error("AdminInvalidArgument",
+                              f"unknown service action {action!r}")
+            if self.node is not None:
+                self.node.notification.signal_all(action)
+            # defer the local action so this response reaches the client
+            # (reference cmd/service.go restarts via exec after reply)
+            import threading as _threading
+            _threading.Timer(0.2, self.service_action, (action,)).start()
+            return self._json({"status": "success", "action": action})
+        if sub == "set-bucket-quota" and m == "PUT":
+            self._auth(ctx, "admin:SetBucketQuota")
+            bucket = ctx.query1("bucket", "")
+            self._require_bucket(bucket)
+            body = json.loads(ctx.read_body().decode() or "{}")
+            quota = int(body.get("quota", 0))
+            qtype = (body.get("quotatype") or body.get("type")
+                     or "hard").lower()
+            if quota < 0 or qtype not in ("hard", "fifo"):
+                raise S3Error("AdminInvalidArgument", "bad quota spec")
+            self.api.bucket_meta.update(
+                bucket, quota={"quota": quota, "type": qtype}
+                if quota else {})
+            return self._json({})
+        if sub == "get-bucket-quota" and m == "GET":
+            self._auth(ctx, "admin:GetBucketQuota")
+            bucket = ctx.query1("bucket", "")
+            return self._json(
+                self.api.bucket_meta.get(bucket).quota or {})
+        if sub == "set-remote-target" and m == "PUT":
+            self._auth(ctx, "admin:SetBucketTarget")
+            return self._set_remote_target(ctx)
+        if sub == "list-remote-targets" and m == "GET":
+            self._auth(ctx, "admin:GetBucketTarget")
+            bucket = ctx.query1("bucket", "")
+            targets = self.api.bucket_meta.get(
+                bucket).replication_targets
+            return HTTPResponse(
+                body=json.dumps([{k: v for k, v in t.items()
+                                  if k != "secret_key"}
+                                 for t in targets]).encode(),
+                headers={"Content-Type": "application/json"})
+        if sub == "remove-remote-target" and m == "DELETE":
+            self._auth(ctx, "admin:SetBucketTarget")
+            bucket = ctx.query1("bucket", "")
+            arn = ctx.query1("arn", "")
+            targets = [t for t in self.api.bucket_meta.get(
+                bucket).replication_targets if t.get("arn") != arn]
+            self.api.bucket_meta.update(bucket,
+                                        replication_targets=targets)
+            if self.api.replication is not None:
+                self.api.replication.targets.pop(arn, None)
+            return self._json({})
         if sub == "add-service-account" and m == "PUT":
             self._auth(ctx, "admin:CreateServiceAccount")
             body = json.loads(ctx.read_body().decode() or "{}")
@@ -286,30 +364,101 @@ class AdminHandlers:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
 
+    def _require_bucket(self, bucket: str) -> None:
+        """Quota/remote-target admin must target a REAL bucket —
+        bucket_meta.get() silently defaults for unknown names, so the
+        existence check has to hit the object layer (review r3)."""
+        from ..object import api_errors
+        try:
+            self.api.obj.get_bucket_info(bucket)
+        except api_errors.BucketNotFound:
+            raise S3Error("NoSuchBucket",
+                          f"bucket {bucket!r} does not exist") from None
+
+    def service_action(self, action: str) -> None:
+        """Local service restart/stop. Overridable hook; the default
+        re-execs the process for restart (reference cmd/service.go
+        restartProcess) and exits for stop."""
+        import os
+        import sys
+        if action == "restart":
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        elif action == "stop":
+            os._exit(0)
+
+    def _set_remote_target(self, ctx: RequestContext) -> HTTPResponse:
+        """Register a replication destination for a bucket
+        (cmd/admin-bucket-handlers.go SetRemoteTargetHandler +
+        cmd/bucket-targets.go): persisted in bucket metadata, mounted
+        into the live replication pool, ARN returned."""
+        import uuid as _uuid
+        bucket = ctx.query1("bucket", "")
+        body = json.loads(ctx.read_body().decode() or "{}")
+        host = body.get("host") or ""
+        tbucket = body.get("targetbucket") or body.get("bucket") or ""
+        if not bucket or not host or not tbucket:
+            raise S3Error("AdminInvalidArgument",
+                          "bucket, host and targetbucket are required")
+        self._require_bucket(bucket)
+        entry = {
+            "arn": f"arn:minio:replication::{_uuid.uuid4().hex[:12]}:"
+                   f"{tbucket}",
+            "host": host, "port": int(body.get("port", 9000)),
+            "bucket": tbucket,
+            "access_key": body.get("accesskey", ""),
+            "secret_key": body.get("secretkey", ""),
+            "region": body.get("region", "us-east-1"),
+            "secure": bool(body.get("secure", False)),
+        }
+        targets = list(self.api.bucket_meta.get(
+            bucket).replication_targets) + [entry]
+        self.api.bucket_meta.update(bucket, replication_targets=targets)
+        if self.api.replication is not None:
+            self.api.replication.mount_target_entry(entry)
+        return self._json({"arn": entry["arn"]})
+
     def _profiling_start(self) -> dict:
-        """CPU profiling of this process (admin profiling/start,
-        cmd/admin-handlers.go:461; profiler kinds beyond cpu are Go
-        runtime specifics — cProfile is the Python-native equivalent)."""
-        import cProfile
-        if getattr(self, "_profiler", None) is not None:
-            return {"status": "already running"}
-        self._profiler = cProfile.Profile()
-        self._profiler.enable()
-        return {"status": "started", "kind": "cpu"}
+        """Start CPU profiling on EVERY node: locally via the process
+        profiler, cluster-wide via the peer fan-out (reference admin
+        profiling/start, cmd/admin-handlers.go:461-525 + peer verb
+        peerRESTMethodStartProfiling; cProfile is the Python-native
+        equivalent of the pprof cpu kind)."""
+        from ..utils import profiling
+        out = {"status": "started" if profiling.start()
+               else "already running", "kind": "cpu"}
+        if self.node is not None:
+            peers = self.node.notification.profiling_start_all()
+            out["peers"] = [p for p in peers if isinstance(p, dict)]
+        return out
 
     def _profiling_stop(self) -> HTTPResponse:
+        """Stop everywhere and return one zip with a profile per node
+        (reference downloads a zip of all nodes' profiles)."""
         import io
-        import pstats
-        prof = getattr(self, "_profiler", None)
-        if prof is None:
+        import zipfile
+        from ..utils import profiling
+        local = profiling.stop_text()
+        if local is None and self.node is None:
             raise S3Error("AdminInvalidArgument", "profiling not running")
-        prof.disable()
-        self._profiler = None
-        buf = io.StringIO()
-        pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
-            .print_stats(60)
-        return HTTPResponse(body=buf.getvalue().encode(),
-                            headers={"Content-Type": "text/plain"})
+        profiles: list[tuple[str, str]] = []
+        local_name = self.node.spec.addr if self.node is not None \
+            else "local"
+        if local is not None:
+            profiles.append((local_name, local))
+        if self.node is not None:
+            for res in self.node.notification.profiling_stop_all():
+                if isinstance(res, dict) and res.get("profile"):
+                    profiles.append((res.get("node", "peer"),
+                                     res["profile"]))
+        if not profiles:
+            raise S3Error("AdminInvalidArgument", "profiling not running")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for node, text in profiles:
+                safe = node.replace(":", "_").replace("/", "_")
+                zf.writestr(f"profile-cpu-{safe}.txt", text)
+        return HTTPResponse(body=buf.getvalue(),
+                            headers={"Content-Type": "application/zip"})
 
     def _config(self):
         cfg = getattr(self.api, "config", None)
@@ -431,6 +580,7 @@ class MetricsHandler:
 def mount_admin(server, node=None) -> AdminHandlers:
     """Attach admin/health/metrics routers to an S3Server."""
     admin = AdminHandlers(server.api, node)
+    server.admin = admin       # reachable from the server handle
     server.register_router(ADMIN_PREFIX, admin.route)
     server.register_router(HEALTH_PREFIX, HealthHandlers(server.api).route)
     server.register_router(METRICS_PREFIX,

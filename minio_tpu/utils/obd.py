@@ -1,0 +1,76 @@
+"""OBD ("on-board diagnostics") bundle: per-node hardware/health facts
+(reference cmd/obdinfo.go getLocalDrivesOBD + peer OBD verbs,
+cmd/peer-rest-common.go:29-37): CPU and memory facts from /proc, plus a
+real latency probe per local drive (timed write+fsync+read of a small
+file) — the numbers an operator reads first when a cluster feels slow.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import time
+
+
+def _meminfo() -> dict:
+    out: dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if parts[0] in ("MemTotal:", "MemAvailable:"):
+                    out[parts[0][:-1]] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return {"total": out.get("MemTotal", 0),
+            "available": out.get("MemAvailable", 0)}
+
+
+def probe_drive(path: str, size: int = 64 << 10) -> dict:
+    """Timed write+fsync then read of `size` bytes under `path`
+    (reference getLocalDrivesOBD performance probe)."""
+    info: dict = {"path": path}
+    try:
+        usage = shutil.disk_usage(path)
+        info["total_bytes"] = usage.total
+        info["free_bytes"] = usage.free
+        probe = os.path.join(path, ".minio.sys", "tmp",
+                             f".obd-probe-{os.getpid()}")
+        os.makedirs(os.path.dirname(probe), exist_ok=True)
+        payload = os.urandom(size)
+        t0 = time.perf_counter()
+        with open(probe, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        info["write_latency_us"] = round(
+            (time.perf_counter() - t0) * 1e6)
+        t0 = time.perf_counter()
+        with open(probe, "rb") as f:
+            got = f.read()
+        info["read_latency_us"] = round(
+            (time.perf_counter() - t0) * 1e6)
+        info["ok"] = got == payload
+        os.remove(probe)
+    except OSError as e:
+        info["error"] = str(e)
+        info["ok"] = False
+    return info
+
+
+def local_obd(drive_paths: list[str] | None = None) -> dict:
+    """This node's OBD facts; the peer plane fans this out cluster-wide."""
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    return {
+        "hostname": socket.gethostname(),
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "cpu": {"count": os.cpu_count() or 0,
+                "load1": round(load1, 3), "load5": round(load5, 3),
+                "load15": round(load15, 3)},
+        "mem": _meminfo(),
+        "drives": [probe_drive(p) for p in (drive_paths or [])],
+    }

@@ -1,0 +1,45 @@
+"""Process-global CPU profiler (admin profiling + peer fan-out share
+one profiler per process — reference cmd/admin-handlers.go:461-525
+globalProfiler; cProfile is the Python-native equivalent of the Go
+pprof cpu kind)."""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import threading
+from typing import Optional
+
+_profiler: Optional[cProfile.Profile] = None
+_mu = threading.Lock()
+
+
+def start() -> bool:
+    """Begin profiling; False when already running."""
+    global _profiler
+    with _mu:
+        if _profiler is not None:
+            return False
+        _profiler = cProfile.Profile()
+        _profiler.enable()
+        return True
+
+
+def running() -> bool:
+    with _mu:
+        return _profiler is not None
+
+
+def stop_text(top: int = 60) -> Optional[str]:
+    """Stop and render the profile (None when not running)."""
+    global _profiler
+    with _mu:
+        prof, _profiler = _profiler, None
+    if prof is None:
+        return None
+    prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+        .print_stats(top)
+    return buf.getvalue()

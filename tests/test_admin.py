@@ -195,11 +195,16 @@ def test_metrics_endpoint(server):
 def test_admin_profiling(client, server):
     st, body = client.request("POST", "/minio/admin/v3/profiling/start")
     assert st == 200 and json.loads(body)["status"] == "started"
-    # generate a little work, then collect the report
+    # generate a little work, then collect the per-node zip
     client.request("GET", "/minio/admin/v3/info")
     st, body = client.request("POST", "/minio/admin/v3/profiling/stop")
     assert st == 200
-    assert b"cumulative" in body        # pstats header
+    import io
+    import zipfile
+    with zipfile.ZipFile(io.BytesIO(body)) as zf:
+        names = zf.namelist()
+        assert names and names[0].startswith("profile-cpu-")
+        assert "cumulative" in zf.read(names[0]).decode()  # pstats hdr
     # stop again: error
     st, _ = client.request("POST", "/minio/admin/v3/profiling/stop")
     assert st == 400
@@ -246,3 +251,55 @@ def test_madmin_client_sdk(server):
     bad = AdminClient("127.0.0.1", server.port, "nope", "nopenopenope1")
     with pytest.raises(AdminClientError):
         bad.server_info()
+
+
+def test_admin_service_action(client, server):
+    """Service restart/stop routes validate the action and run the
+    (injected) local hook after replying (VERDICT r2 item 10)."""
+    import time as _time
+    actions = []
+    server.admin.service_action = lambda a: actions.append(a)
+    st, body = client.request("POST", "/minio/admin/v3/service",
+                              query={"action": "restart"})
+    assert st == 200 and json.loads(body)["status"] == "success"
+    deadline = _time.time() + 3
+    while not actions and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert actions == ["restart"]
+    st, _ = client.request("POST", "/minio/admin/v3/service",
+                           query={"action": "reboot"})
+    assert st == 400
+
+
+def test_admin_bucket_quota_and_remote_targets(server):
+    """Quota admin CRUD + remote-target registry round-trip through the
+    madmin SDK; the registered target lands in the live replication
+    pool and persists in bucket metadata."""
+    from minio_tpu.features.replication import ReplicationPool
+    from minio_tpu.madmin import AdminClient
+    mc = AdminClient("127.0.0.1", server.port, CREDS.access_key,
+                     CREDS.secret_key)
+    server.api.obj.make_bucket("qb")
+
+    assert mc.get_bucket_quota("qb") == {}
+    mc.set_bucket_quota("qb", 1 << 20, "hard")
+    assert mc.get_bucket_quota("qb") == {"quota": 1 << 20,
+                                         "type": "hard"}
+    mc.set_bucket_quota("qb", 0)            # clear
+    assert mc.get_bucket_quota("qb") == {}
+
+    server.api.replication = ReplicationPool(server.api.obj,
+                                             server.api.bucket_meta)
+    arn = mc.set_remote_target("qb", "127.0.0.1", 9999, "destb",
+                               "dak12345678", "dsk1234567890")
+    assert arn.startswith("arn:minio:replication::")
+    assert arn in server.api.replication.targets
+    listed = mc.list_remote_targets("qb")
+    assert listed[0]["arn"] == arn and listed[0]["bucket"] == "destb"
+    assert "secret_key" not in listed[0]    # never leaked in listings
+    # persisted in bucket metadata (visible to a fresh metadata sys)
+    assert server.api.bucket_meta.get("qb").replication_targets
+
+    mc.remove_remote_target("qb", arn)
+    assert mc.list_remote_targets("qb") == []
+    assert arn not in server.api.replication.targets

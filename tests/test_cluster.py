@@ -245,3 +245,60 @@ def test_storage_class_parity(cluster):
     assert info.parity_blocks == 2 and info.data_blocks == 14
     _, stream = a.object_layer.get_object("scb", "rr")
     assert b"".join(stream) == b"q" * 50_000
+
+
+def test_cluster_profiling_console_obd(cluster):
+    """Profiling fan-out, console-log merge, and OBD travel the peer
+    plane (VERDICT r2 item 8). In-process nodes share one process-
+    global profiler/console singleton, so counts are not per-node here
+    — the assertions pin verb plumbing + payload shapes."""
+    a = cluster[0]
+    # profiling: start broadcasts; stop gathers at least one profile
+    from minio_tpu.utils import profiling as prof_mod
+    res = a.notification.profiling_start_all()
+    assert all(isinstance(r, dict) for r in res)
+    assert prof_mod.running()
+    stops = a.notification.profiling_stop_all()
+    assert any(isinstance(r, dict) and r.get("profile") for r in stops)
+    assert not prof_mod.running()
+
+    # console log: a line logged on this process is visible via the
+    # peer plane, with node attribution and time ordering
+    from minio_tpu.utils.console import get_console
+    get_console().log_line("INFO", "hello-from-test")
+    merged = a.notification.console_log_all()
+    assert any(e.get("message") == "hello-from-test" for e in merged)
+    assert all("ts" in e and "node" in e for e in merged)
+
+    # OBD: every PEER answers with cpu/mem facts and per-drive probes
+    # (the notification list excludes the calling node itself)
+    bundles = a.notification.obd_all()
+    assert len(bundles) == len(cluster) - 1
+    for b in bundles:
+        assert b["cpu"]["count"] >= 1 and b["mem"]["total"] > 0
+        assert len(b["drives"]) == 4        # drives_per_node
+        assert all(d.get("ok") for d in b["drives"])
+        assert all(d.get("write_latency_us", 0) > 0
+                   for d in b["drives"])
+
+
+def test_cluster_admin_profiling_zip_and_obd_endpoint(cluster):
+    """The admin endpoints aggregate the peer plane: profiling/stop
+    returns a zip, obdinfo and consolelog return per-node payloads —
+    exercised through the madmin SDK."""
+    from minio_tpu.madmin import AdminClient
+    a = cluster[0]
+    mc = AdminClient("127.0.0.1", a.spec.port, CREDS.access_key,
+                     CREDS.secret_key)
+    assert mc.profiling_start()["status"] in ("started",
+                                              "already running")
+    mc.server_info()                      # some work to profile
+    profiles = mc.profiling_stop()
+    assert profiles and all(n.startswith("profile-cpu-")
+                            for n in profiles)
+    assert any("cumulative" in t for t in profiles.values())
+
+    nodes = mc.obd_info()
+    assert len(nodes) == len(cluster)
+    logs = mc.console_log()
+    assert any("online" in e.get("message", "") for e in logs)

@@ -213,3 +213,85 @@ def test_select_over_http(tmp_path):
         assert events[0][1] == b"alice\ncarol\n"
     finally:
         srv.stop()
+
+# ---------------------------------------------------------------------------
+# Parquet input (VERDICT r2 item 7; reference pkg/s3select/parquet)
+# ---------------------------------------------------------------------------
+
+def _parquet_bytes() -> bytes:
+    import io
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    table = pa.table({
+        "name": ["alice", "bob", "carol", "dave"],
+        "age": [30, 25, 35, 28],
+        "city": ["paris", "london", "paris", "berlin"]})
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    return buf.getvalue()
+
+
+def test_select_parquet_matches_csv():
+    """The same queries over Parquet and CSV data must agree (CSV
+    values are strings, so numeric comparisons go through CAST on the
+    CSV side and arrive native from Parquet)."""
+    data = _parquet_bytes()
+    got = rows("SELECT name FROM S3Object WHERE city = 'paris'",
+               data=data, fmt="PARQUET")
+    want = rows("SELECT name FROM S3Object WHERE city = 'paris'")
+    assert got == want
+    got = rows("SELECT name, age FROM S3Object WHERE age > 26",
+               data=data, fmt="PARQUET")
+    assert got.splitlines() == ["alice,30", "carol,35", "dave,28"]
+    got = rows("SELECT COUNT(*), SUM(age) FROM S3Object",
+               data=data, fmt="PARQUET")
+    assert got.strip() == "4,118"
+
+
+def test_select_parquet_xml_and_bad_input():
+    req = SelectRequest.from_xml(
+        b"<SelectObjectContentRequest>"
+        b"<Expression>SELECT * FROM S3Object</Expression>"
+        b"<ExpressionType>SQL</ExpressionType>"
+        b"<InputSerialization><Parquet/></InputSerialization>"
+        b"<OutputSerialization><CSV/></OutputSerialization>"
+        b"</SelectObjectContentRequest>")
+    assert req.input_format == "PARQUET"
+    out = b"".join(run_select(req, _parquet_bytes())).decode()
+    assert len(out.splitlines()) == 4
+
+    from minio_tpu.s3.s3errors import S3Error
+    with pytest.raises(S3Error):
+        b"".join(run_select(req, b"this is not parquet"))
+
+
+def test_select_parquet_event_stream():
+    req = SelectRequest.from_xml(
+        b"<SelectObjectContentRequest>"
+        b"<Expression>SELECT name FROM S3Object WHERE age >= 30"
+        b"</Expression><ExpressionType>SQL</ExpressionType>"
+        b"<InputSerialization><Parquet/></InputSerialization>"
+        b"<OutputSerialization><JSON/></OutputSerialization>"
+        b"</SelectObjectContentRequest>")
+    frames = b"".join(event_stream(req, _parquet_bytes()))
+    assert b'"name": "alice"' in frames or b'"name":"alice"' in frames
+    assert b"End" in frames
+
+
+def test_select_parquet_corrupt_pages_maps_to_s3error():
+    """A valid footer with corrupt data pages must raise S3Error from
+    the row iterator, not a raw Arrow exception (review r3)."""
+    from minio_tpu.s3.s3errors import S3Error
+    blob = bytearray(_parquet_bytes())
+    # footer (tail) stays intact; clobber the data pages at the front
+    for i in range(4, min(60, len(blob) - 100)):
+        blob[i] ^= 0xFF
+    req = SelectRequest.from_xml(
+        b"<SelectObjectContentRequest>"
+        b"<Expression>SELECT * FROM S3Object</Expression>"
+        b"<ExpressionType>SQL</ExpressionType>"
+        b"<InputSerialization><Parquet/></InputSerialization>"
+        b"<OutputSerialization><CSV/></OutputSerialization>"
+        b"</SelectObjectContentRequest>")
+    with pytest.raises(S3Error):
+        b"".join(run_select(req, bytes(blob)))
