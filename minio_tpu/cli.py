@@ -44,6 +44,16 @@ def _parse(argv: list[str]) -> argparse.Namespace:
         "MINIO_REGION", "us-east-1"))
     s.add_argument("--cert", default="", help="TLS certificate file")
     s.add_argument("--key", default="", help="TLS private key file")
+
+    g = sub.add_parser("gateway", help="serve the S3 API over a "
+                       "foreign backend (cmd/gateway-main.go)")
+    g.add_argument("kind", choices=("nas", "s3", "azure"))
+    g.add_argument("target", nargs="?", default="",
+                   help="nas: /mount/path; s3: host:port; "
+                   "azure: blob endpoint host:port")
+    g.add_argument("--address", default=":9000")
+    g.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
     return p.parse_args(argv)
 
 
@@ -57,9 +67,80 @@ def _creds() -> Credentials:
     return global_credentials()
 
 
+def _run_gateway(args, creds: Credentials) -> int:
+    """`minio_tpu gateway <kind> <target>` — serve the full S3 surface
+    over a foreign backend (reference cmd/gateway-main.go). Backend
+    credentials come from MINIO_GATEWAY_{ACCESS,SECRET}_KEY (s3) or
+    MINIO_AZURE_{ACCOUNT,KEY} (azure)."""
+    from .gateway import new_gateway
+    from .s3.server import S3Server
+    from .utils import host_port
+
+    if args.kind == "nas":
+        if not args.target:
+            print("gateway nas needs a mount path", file=sys.stderr)
+            return 2
+        layer = new_gateway("nas", path=args.target)
+    elif args.kind == "s3":
+        if not args.target:
+            # no silent default: 127.0.0.1:9000 would be the gateway's
+            # own listen address — a self-proxying loop
+            print("gateway s3 needs an upstream host:port",
+                  file=sys.stderr)
+            return 2
+        h, p = host_port(args.target, 9000)
+        layer = new_gateway(
+            "s3", host=h, port=p,
+            access_key=os.environ.get("MINIO_GATEWAY_ACCESS_KEY",
+                                      creds.access_key),
+            secret_key=os.environ.get("MINIO_GATEWAY_SECRET_KEY",
+                                      creds.secret_key),
+            region=args.region)
+    else:
+        account = os.environ.get("MINIO_AZURE_ACCOUNT", "")
+        key = os.environ.get("MINIO_AZURE_KEY", "")
+        if not account or not key:
+            print("gateway azure needs MINIO_AZURE_ACCOUNT and "
+                  "MINIO_AZURE_KEY", file=sys.stderr)
+            return 2
+        h, p = host_port(args.target or f"{account}.blob.core."
+                         "windows.net:443", 443)
+        layer = new_gateway("azure", account=account, key_b64=key,
+                            host=h, port=p, secure=(p == 443))
+
+    lh, lp = host_port(args.address, 9000)
+    srv = S3Server(layer, creds=creds, region=args.region,
+                   address=lh or "0.0.0.0", port=lp).start()
+    print(f"MinIO-TPU {args.kind} gateway up at "
+          f"http://{lh or '127.0.0.1'}:{srv.port} "
+          f"(access key {creds.access_key})")
+
+    def cleanup():
+        srv.stop()
+        layer.close()
+
+    return _serve_until_signal(cleanup)
+
+
+def _serve_until_signal(cleanup) -> int:
+    """Block until SIGTERM/SIGINT, then run cleanup (Event.wait is
+    signal-safe: no lost-wakeup window)."""
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        cleanup()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
+    if args.cmd == "gateway":
+        return _run_gateway(args, creds)
     kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
               region=args.region,
               certfile=args.cert or None, keyfile=args.key or None)
@@ -92,15 +173,7 @@ def main(argv: list[str] | None = None) -> int:
                             creds, region=args.region)
             print(f"MinIO-TPU FS node up at {node.url} "
                   f"(access key {creds.access_key})")
-            import threading
-            stop = threading.Event()
-            signal.signal(signal.SIGTERM, lambda *a: stop.set())
-            signal.signal(signal.SIGINT, lambda *a: stop.set())
-            try:
-                stop.wait()
-            finally:
-                node.shutdown()
-            return 0
+            return _serve_until_signal(node.shutdown)
         node = start_single(args.drives, host or "0.0.0.0", port_n,
                             creds, **kw)
 
@@ -110,16 +183,7 @@ def main(argv: list[str] | None = None) -> int:
           f"EC:{node.parity}; {info['online_disks']} online / "
           f"{info['offline_disks']} offline drives")
     print(f"S3 endpoint: {node.url}  (access key {creds.access_key})")
-
-    import threading
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    try:
-        stop.wait()   # Event.wait is signal-safe: no lost-wakeup window
-    finally:
-        node.shutdown()
-    return 0
+    return _serve_until_signal(node.shutdown)
 
 
 if __name__ == "__main__":

@@ -15,5 +15,8 @@ def new_gateway(kind: str, **kw):
     if kind == "s3":
         from .s3 import S3Gateway
         return S3Gateway(**kw).object_layer()
+    if kind == "azure":
+        from .azure import AzureGateway
+        return AzureGateway(**kw).object_layer()
     raise ValueError(f"unknown gateway kind {kind!r} "
-                     "(supported: nas, s3)")
+                     "(supported: nas, s3, azure)")

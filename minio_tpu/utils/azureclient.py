@@ -1,0 +1,240 @@
+"""Azure Blob Storage REST client: SharedKey auth + the blob-service
+subset the gateway needs (reference cmd/gateway/azure/gateway-azure.go
+drives the Azure Go SDK; this speaks the documented REST surface
+directly so the gateway is dependency-free and offline-testable).
+
+Auth follows the published SharedKey scheme (2019-12-12 service
+version): HMAC-SHA256 over VERB + canonicalized standard headers +
+canonicalized x-ms-* headers + canonicalized resource, keyed by the
+base64-decoded account key. The HTTP connection factory is injectable,
+so tests run against an in-process server (Azurite-style).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Callable, Iterator, Optional
+
+API_VERSION = "2019-12-12"
+
+
+class AzureClientError(Exception):
+    def __init__(self, status: int, code: str, body: bytes = b""):
+        super().__init__(f"{status} {code}")
+        self.status = status
+        self.code = code
+        self.body = body
+
+
+def _rfc1123_now() -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+
+
+def shared_key_signature(account: str, key_b64: str, method: str,
+                         path: str, query: dict[str, str],
+                         headers: dict[str, str]) -> str:
+    """StringToSign per the SharedKey spec; returns the base64 HMAC."""
+    h = {k.lower(): v for k, v in headers.items()}
+    std = [h.get("content-encoding", ""), h.get("content-language", ""),
+           # Content-Length: empty string when 0 (2015-02-21+ behavior)
+           h.get("content-length", "") if h.get("content-length", "")
+           not in ("0",) else "",
+           h.get("content-md5", ""), h.get("content-type", ""),
+           # Date is carried in x-ms-date, so the Date line is empty
+           "",
+           h.get("if-modified-since", ""), h.get("if-match", ""),
+           h.get("if-none-match", ""), h.get("if-unmodified-since", ""),
+           h.get("range", "")]
+    ms = "".join(f"{k}:{h[k]}\n" for k in sorted(h) if
+                 k.startswith("x-ms-"))
+    res = f"/{account}{path}"
+    res += "".join(f"\n{k}:{query[k]}" for k in sorted(query))
+    sts = method + "\n" + "\n".join(std) + "\n" + ms + res
+    mac = hmac.new(base64.b64decode(key_b64), sts.encode("utf-8"),
+                   hashlib.sha256).digest()
+    return base64.b64encode(mac).decode()
+
+
+class AzureBlobClient:
+    def __init__(self, account: str, key_b64: str, host: str,
+                 port: int = 10000, secure: bool = False,
+                 timeout: float = 30.0,
+                 connect: Optional[Callable[[], object]] = None):
+        self.account = account
+        self.key_b64 = key_b64
+        self.host, self.port, self.secure = host, port, secure
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self):
+        cls = http.client.HTTPSConnection if self.secure \
+            else http.client.HTTPConnection
+        return cls(self.host, self.port, timeout=self.timeout)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 query: Optional[dict[str, str]] = None,
+                 headers: Optional[dict[str, str]] = None,
+                 body: bytes = b"", want_stream: bool = False):
+        query = dict(query or {})
+        hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+        hdrs.setdefault("x-ms-date", _rfc1123_now())
+        hdrs.setdefault("x-ms-version", API_VERSION)
+        hdrs["content-length"] = str(len(body))
+        hdrs["host"] = f"{self.host}:{self.port}"
+        sig = shared_key_signature(self.account, self.key_b64, method,
+                                   path, query, hdrs)
+        hdrs["authorization"] = f"SharedKey {self.account}:{sig}"
+        qs = urllib.parse.urlencode(query)
+        conn = self._connect()
+        conn.request(method, urllib.parse.quote(path)
+                     + (f"?{qs}" if qs else ""), body=body,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        if resp.status >= 300:
+            data = resp.read()
+            conn.close()
+            code = ""
+            try:
+                code = ET.fromstring(data).findtext("Code") or ""
+            except ET.ParseError:
+                pass
+            raise AzureClientError(resp.status, code, data)
+        if want_stream:
+            return resp, conn
+        data = resp.read()
+        out = {k.lower(): v for k, v in resp.getheaders()}
+        conn.close()
+        return out, data
+
+    # -- containers --------------------------------------------------------
+
+    def create_container(self, name: str) -> None:
+        self._request("PUT", f"/{name}", {"restype": "container"})
+
+    def delete_container(self, name: str) -> None:
+        self._request("DELETE", f"/{name}", {"restype": "container"})
+
+    def container_exists(self, name: str) -> bool:
+        try:
+            self._request("HEAD", f"/{name}", {"restype": "container"})
+            return True
+        except AzureClientError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def list_containers(self) -> list[str]:
+        _h, data = self._request("GET", "/", {"comp": "list"})
+        root = ET.fromstring(data)
+        return [el.findtext("Name") or ""
+                for el in root.iter("Container")]
+
+    # -- blobs -------------------------------------------------------------
+
+    def put_blob(self, container: str, blob: str, body: bytes,
+                 metadata: Optional[dict[str, str]] = None,
+                 content_type: str = "") -> str:
+        hdrs = {"x-ms-blob-type": "BlockBlob"}
+        if content_type:
+            hdrs["content-type"] = content_type
+        for k, v in (metadata or {}).items():
+            hdrs[f"x-ms-meta-{k}"] = v
+        h, _ = self._request("PUT", f"/{container}/{blob}",
+                             headers=hdrs, body=body)
+        return h.get("etag", "").strip('"')
+
+    def get_blob_props(self, container: str, blob: str) -> dict:
+        h, _ = self._request("HEAD", f"/{container}/{blob}")
+        return h
+
+    def get_blob(self, container: str, blob: str, offset: int = 0,
+                 length: int = -1) -> tuple[dict, Iterator[bytes]]:
+        hdrs = {}
+        if offset or length >= 0:
+            end = f"{offset + length - 1}" if length >= 0 else ""
+            hdrs["x-ms-range"] = f"bytes={offset}-{end}"
+        resp, conn = self._request("GET", f"/{container}/{blob}",
+                                   headers=hdrs, want_stream=True)
+        out = {k.lower(): v for k, v in resp.getheaders()}
+
+        def gen():
+            try:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                conn.close()
+
+        return out, gen()
+
+    def delete_blob(self, container: str, blob: str) -> None:
+        self._request("DELETE", f"/{container}/{blob}")
+
+    def list_blobs(self, container: str, prefix: str = "",
+                   delimiter: str = "", marker: str = "",
+                   max_results: int = 1000
+                   ) -> tuple[list[dict], list[str], str]:
+        """Returns (blobs, common_prefixes, next_marker)."""
+        q = {"restype": "container", "comp": "list",
+             "maxresults": str(max_results)}
+        if prefix:
+            q["prefix"] = prefix
+        if delimiter:
+            q["delimiter"] = delimiter
+        if marker:
+            q["marker"] = marker
+        _h, data = self._request("GET", f"/{container}", q)
+        root = ET.fromstring(data)
+        blobs = []
+        for el in root.iter("Blob"):
+            props = el.find("Properties")
+            blobs.append({
+                "name": el.findtext("Name") or "",
+                "size": int(props.findtext("Content-Length") or 0)
+                if props is not None else 0,
+                "etag": (props.findtext("Etag") or "").strip('"')
+                if props is not None else "",
+                "last_modified": props.findtext("Last-Modified") or ""
+                if props is not None else "",
+            })
+        prefixes = [el.findtext("Name") or ""
+                    for el in root.iter("BlobPrefix")]
+        next_marker = root.findtext("NextMarker") or ""
+        return blobs, prefixes, next_marker
+
+    # -- block (multipart) API --------------------------------------------
+
+    def put_block(self, container: str, blob: str, block_id: str,
+                  body: bytes) -> None:
+        """Stage one uncommitted block (the azure-native multipart
+        part: cmd/gateway/azure PutObjectPart maps here)."""
+        self._request("PUT", f"/{container}/{blob}",
+                      {"comp": "block", "blockid": block_id},
+                      body=body)
+
+    def put_block_list(self, container: str, blob: str,
+                       block_ids: list[str],
+                       metadata: Optional[dict[str, str]] = None,
+                       content_type: str = "") -> str:
+        xml = "<?xml version=\"1.0\" encoding=\"utf-8\"?><BlockList>" \
+            + "".join(f"<Uncommitted>{bid}</Uncommitted>"
+                      for bid in block_ids) + "</BlockList>"
+        hdrs: dict[str, str] = {}
+        if content_type:
+            hdrs["x-ms-blob-content-type"] = content_type
+        for k, v in (metadata or {}).items():
+            hdrs[f"x-ms-meta-{k}"] = v
+        h, _ = self._request("PUT", f"/{container}/{blob}",
+                             {"comp": "blocklist"}, headers=hdrs,
+                             body=xml.encode())
+        return h.get("etag", "").strip('"')

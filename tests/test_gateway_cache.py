@@ -31,7 +31,7 @@ def test_nas_gateway_is_fs(tmp_path):
 
 def test_unknown_gateway():
     with pytest.raises(ValueError):
-        new_gateway("azure")
+        new_gateway("gcsish")
 
 
 @pytest.fixture()
