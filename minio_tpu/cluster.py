@@ -275,10 +275,26 @@ class ClusterNode:
                           trace=self.s3.api.trace)
 
         # -- background plane (initAutoHeal + initDataCrawler) -------------
-        from .object.background import DataUsageCrawler, DiskMonitor
+        from .object.background import (DataUsageCrawler, DiskMonitor,
+                                        HealScanner)
+        from .object.update_tracker import DataUpdateTracker
         self.disk_monitor = DiskMonitor(sets).start()
+        # data-update tracker: every mutation marks the bloom; the heal
+        # scanner prunes unchanged work (cmd/data-update-tracker.go)
+        _tpath = os.path.join(self.spec.drives[0], ".minio.sys",
+                              "tracker", "update-tracker.bin") \
+            if self.spec.drives else ""
+        self.update_tracker = DataUpdateTracker(_tpath)
+        self.s3.api.update_tracker = self.update_tracker
+        self._peer_rpc.get_update_tracker = \
+            self.update_tracker.rotate_snapshot
+        self.heal_scanner = None
         self.crawler = None
         if this == 0:
+            self.heal_scanner = HealScanner(
+                self.object_layer, self.update_tracker,
+                peer_snapshots=self.notification.tracker_rotate_all
+            ).start()
             # one crawler per cluster (first node), like the reference's
             # leader-ish crawler cadence; usage cache feeds quota and the
             # crawler enforces lifecycle expiry
@@ -323,6 +339,15 @@ class ClusterNode:
         if getattr(self, "crawler", None) is not None:
             self.crawler.close()
             self.crawler = None
+        if getattr(self, "heal_scanner", None) is not None:
+            self.heal_scanner.close()
+            self.heal_scanner = None
+        if getattr(self, "update_tracker", None) is not None:
+            try:
+                self.update_tracker.flush()
+            except Exception:  # noqa: BLE001 — hints only
+                pass
+            self.update_tracker = None
         if getattr(self, "events", None) is not None:
             self.events.close()
             self.events = None

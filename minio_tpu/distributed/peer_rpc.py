@@ -41,6 +41,9 @@ class PeerRPCServer:
         self.get_trace: Callable[[], list] = lambda: []
         self.get_bucket_usage: Callable[[], dict] = lambda: {}
         self.obd_drive_paths: list[str] = []
+        # leader heal-scanner pulls + rotates this node's data-update
+        # tracker each pass (None until the cluster wires it)
+        self.get_update_tracker: Optional[Callable[[], dict]] = None
 
         h = self.handler
         h.register("server-info", lambda a, b: {
@@ -60,6 +63,12 @@ class PeerRPCServer:
         h.register("profiling-stop", self._profiling_stop)
         h.register("console-log", self._console_log)
         h.register("obd", self._obd)
+        h.register("tracker-rotate", self._tracker_rotate)
+
+    def _tracker_rotate(self, args, body):
+        if self.get_update_tracker is None:
+            return {}
+        return self.get_update_tracker()
 
     def _profiling_start(self, args, body):
         from ..utils import profiling
@@ -183,6 +192,12 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return None
 
+    def tracker_rotate(self) -> Optional[dict]:
+        try:
+            return self.rc.call_json("tracker-rotate")
+        except (NetworkError, RPCError):
+            return None
+
     @property
     def online(self) -> bool:
         return self.rc.online
@@ -266,6 +281,13 @@ class NotificationSys:
     def obd_all(self) -> list[dict]:
         return [r for r in self._broadcast(lambda p: p.obd())
                 if isinstance(r, dict)]
+
+    def tracker_rotate_all(self) -> list[Optional[dict]]:
+        """One entry per peer: the rotated tracker snapshot, or None
+        for an unreachable peer (the scanner must then assume-changed
+        for that peer's window)."""
+        return [r if isinstance(r, dict) else None
+                for r in self._broadcast(lambda p: p.tracker_rotate())]
 
 
 # ---------------------------------------------------------------------------

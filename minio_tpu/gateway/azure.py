@@ -93,38 +93,123 @@ class AzureGatewayObjects:
 
     # -- objects -----------------------------------------------------------
 
+    # bodies above this stage as blocks instead of one in-memory PUT
+    STREAM_THRESHOLD = 16 << 20
+    STAGE_CHUNK = 8 << 20
+
     @staticmethod
-    def _meta_split(metadata: dict) -> tuple[dict, str]:
-        """user metadata -> (x-ms-meta dict, content type); S3 metadata
-        keys are not valid C# identifiers, so prefix-strip like the
-        reference's s3MetaToAzureProperties."""
+    def _encode_meta_key(key: str) -> str:
+        """S3 metadata/control keys (x-amz-meta-*, X-Amz-Tagging,
+        object-lock headers, etag) are not valid Azure metadata
+        identifiers; base32 keeps them reversible without loss (the
+        reference's s3MetaToAzureProperties does a lossier mangle)."""
+        enc = base64.b32encode(key.lower().encode()).decode()
+        return "k" + enc.rstrip("=").lower()
+
+    @staticmethod
+    def _decode_meta_key(name: str) -> Optional[str]:
+        if not name.startswith("k"):
+            return None
+        enc = name[1:].upper()
+        enc += "=" * (-len(enc) % 8)
+        try:
+            return base64.b32decode(enc).decode()
+        except Exception:  # noqa: BLE001 — foreign metadata
+            return None
+
+    @classmethod
+    def _meta_split(cls, metadata: dict) -> tuple[dict, str]:
+        """user metadata -> (azure metadata dict, content type). EVERY
+        key except content-type round-trips (tagging, object-lock,
+        legal-hold and custom metadata must survive the gateway)."""
         meta, ctype = {}, ""
         for k, v in (metadata or {}).items():
             lk = k.lower()
             if lk == "content-type":
                 ctype = v
-            elif lk.startswith("x-amz-meta-"):
-                meta[lk[len("x-amz-meta-"):].replace("-", "_")] = v
+            else:
+                meta[cls._encode_meta_key(lk)] = str(v)
         return meta, ctype
+
+    @classmethod
+    def _meta_join(cls, headers: dict) -> dict:
+        user = {}
+        for k, v in headers.items():
+            if not k.startswith("x-ms-meta-"):
+                continue
+            name = k[len("x-ms-meta-"):]
+            decoded = cls._decode_meta_key(name)
+            user[decoded if decoded is not None
+                 else f"x-amz-meta-{name}"] = v
+        return user
+
+    def _read_all(self, reader, size: int) -> bytes:
+        if isinstance(reader, (bytes, bytearray)):
+            return bytes(reader)
+        if not isinstance(reader, HashReader):
+            reader = HashReader(reader, size)
+        body = reader.read() if size < 0 else reader.read(size)
+        reader.verify()
+        reader.close()
+        return body
 
     def put_object(self, bucket: str, key: str, reader, size: int = -1,
                    opts: Optional[PutOptions] = None) -> ObjectInfo:
         opts = opts or PutOptions()
-        if isinstance(reader, (bytes, bytearray)):
-            body = bytes(reader)
-        else:
-            if not isinstance(reader, HashReader):
-                reader = HashReader(reader, size)
-            body = reader.read() if size < 0 else reader.read(size)
-            reader.verify()
-            reader.close()
-        meta, ctype = self._meta_split(opts.metadata)
+        if not isinstance(reader, (bytes, bytearray)) and \
+                (size < 0 or size > self.STREAM_THRESHOLD):
+            return self._put_object_streamed(bucket, key, reader, size,
+                                             opts)
+        body = self._read_all(reader, size)
+        etag = hashlib.md5(body).hexdigest()
+        md = dict(opts.metadata)
+        md["etag"] = etag            # service ETags are not md5: pin it
+        meta, ctype = self._meta_split(md)
         try:
             self.c.put_blob(bucket, key, body, meta, ctype)
         except AzureClientError as e:
             raise _map_err(e, bucket, key) from None
         return ObjectInfo(bucket=bucket, name=key, size=len(body),
-                          etag=hashlib.md5(body).hexdigest())
+                          etag=etag)
+
+    def _put_object_streamed(self, bucket: str, key: str, reader,
+                             size: int, opts: PutOptions) -> ObjectInfo:
+        """Large/unknown-size PUT: stage STAGE_CHUNK blocks, commit via
+        Put Block List — constant memory, like the multipart path."""
+        if not isinstance(reader, HashReader):
+            reader = HashReader(reader, size)
+        uid = _uuid.uuid4().hex
+        ids: list[str] = []
+        md5 = hashlib.md5()
+        total = 0
+        try:
+            while True:
+                chunk = reader.read(self.STAGE_CHUNK)
+                if not chunk:
+                    break
+                md5.update(chunk)
+                total += len(chunk)
+                bid = _block_id(uid, 0, len(ids))
+                self.c.put_block(bucket, key, bid, chunk)
+                ids.append(bid)
+            reader.verify()
+        except AzureClientError as e:
+            raise _map_err(e, bucket, key) from None
+        finally:
+            reader.close()
+        etag = md5.hexdigest()
+        md = dict(opts.metadata)
+        md["etag"] = etag
+        meta, ctype = self._meta_split(md)
+        try:
+            if not ids:              # empty object
+                self.c.put_blob(bucket, key, b"", meta, ctype)
+            else:
+                self.c.put_block_list(bucket, key, ids, meta, ctype)
+        except AzureClientError as e:
+            raise _map_err(e, bucket, key) from None
+        return ObjectInfo(bucket=bucket, name=key, size=total,
+                          etag=etag)
 
     def get_object_info(self, bucket: str, key: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
@@ -132,12 +217,12 @@ class AzureGatewayObjects:
             h = self.c.get_blob_props(bucket, key)
         except AzureClientError as e:
             raise _map_err(e, bucket, key) from None
-        user = {f"x-amz-meta-{k[len('x-ms-meta-'):]}": v
-                for k, v in h.items() if k.startswith("x-ms-meta-")}
+        user = self._meta_join(h)
+        etag = user.pop("etag", "") or h.get("etag", "").strip('"')
         return ObjectInfo(
             bucket=bucket, name=key,
             size=int(h.get("content-length", 0) or 0),
-            etag=h.get("etag", "").strip('"'),
+            etag=etag,
             mod_time=_http_date_ts(h.get("last-modified", "")),
             content_type=h.get("content-type", ""),
             user_defined=user)
@@ -149,8 +234,16 @@ class AzureGatewayObjects:
         info = self.get_object_info(bucket, key, opts)
         if length < 0:
             length = info.size - offset
+        if length <= 0:
+            return info, iter(())
         try:
-            _h, stream = self.c.get_blob(bucket, key, offset, length)
+            # full-object reads go without a Range header (a range of
+            # "bytes=0--1" on a zero-byte blob is a 416 on real Azure)
+            if offset == 0 and length >= info.size:
+                _h, stream = self.c.get_blob(bucket, key)
+            else:
+                _h, stream = self.c.get_blob(bucket, key, offset,
+                                             length)
         except AzureClientError as e:
             raise _map_err(e, bucket, key) from None
         return info, stream
@@ -198,17 +291,73 @@ class AzureGatewayObjects:
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", delimiter: str = "",
                      max_keys: int = 1000):
+        """S3 markers are key names; Azure markers are opaque
+        continuation tokens. A token cache maps the last key of each
+        served page to Azure's token; on a cache miss (server restart,
+        foreign marker) the gateway pages from the start and skips up
+        to the marker — slower but correct against real Azure (feeding
+        a key name into Azure's marker parameter is a 400)."""
         self.get_bucket_info(bucket)
-        try:
-            blobs, prefixes, next_marker = self.c.list_blobs(
-                bucket, prefix, delimiter, marker, max_keys)
-        except AzureClientError as e:
-            raise _map_err(e, bucket) from None
-        objs = [ObjectInfo(bucket=bucket, name=b["name"],
-                           size=b["size"], etag=b["etag"],
-                           mod_time=_http_date_ts(b["last_modified"]))
-                for b in blobs]
-        return objs, prefixes, bool(next_marker)
+        cache = getattr(self, "_list_tokens", None)
+        if cache is None:
+            cache = self._list_tokens = {}
+        # start from the cached page token for this marker (may be ""
+        # on a miss => page from the start); ALWAYS filter keys <=
+        # marker, so a mid-page cut resumes correctly either way
+        token = cache.get((bucket, prefix, delimiter, marker), "") \
+            if marker else ""
+
+        objs: list[ObjectInfo] = []
+        prefixes: list[str] = []
+        truncated = False
+        while True:
+            page_token = token
+            try:
+                blobs, pfx, next_tok = self.c.list_blobs(
+                    bucket, prefix, delimiter, page_token,
+                    max_results=max(max_keys, 1000))
+            except AzureClientError as e:
+                raise _map_err(e, bucket) from None
+            for p in pfx:
+                if marker and p <= marker:
+                    continue
+                if p not in prefixes:
+                    prefixes.append(p)
+            kept = 0
+            for b in blobs:
+                if marker and b["name"] <= marker:
+                    continue
+                kept += 1
+                meta_etag = self._decode_etag_meta(b.get("metadata"))
+                objs.append(ObjectInfo(
+                    bucket=bucket, name=b["name"], size=b["size"],
+                    etag=meta_etag or b["etag"],
+                    mod_time=_http_date_ts(b["last_modified"])))
+            if len(objs) + len(prefixes) >= max_keys:
+                cut = max_keys - len(prefixes)
+                dropped = len(objs) - cut
+                objs = objs[:cut]
+                truncated = bool(next_tok) or dropped > 0
+                if objs and truncated:
+                    # the next page re-fetches from THIS page's token
+                    # and skips past the last served key
+                    cache[(bucket, prefix, delimiter,
+                           objs[-1].name)] = page_token
+                if len(cache) > 4096:
+                    cache.clear()      # bounded; misses just rescan
+                break
+            if not next_tok:
+                break
+            token = next_tok
+        return objs, prefixes, truncated
+
+    @classmethod
+    def _decode_etag_meta(cls, meta: Optional[dict]) -> str:
+        """Pinned md5 ETag out of a listing's blob metadata."""
+        for name, v in (meta or {}).items():
+            if cls._decode_meta_key(name) == "etag":
+                return v
+        return ""
 
     def list_object_versions(self, bucket: str, prefix: str = "",
                              marker: str = "", max_keys: int = 1000):
@@ -238,13 +387,7 @@ class AzureGatewayObjects:
     def put_object_part(self, bucket, key, uid, part_number, reader,
                         size=-1):
         mpu = self._up(bucket, key, uid)
-        if isinstance(reader, (bytes, bytearray)):
-            body = bytes(reader)
-        else:
-            if not isinstance(reader, HashReader):
-                reader = HashReader(reader, size)
-            body = reader.read() if size < 0 else reader.read(size)
-            reader.close()
+        body = self._read_all(reader, size)   # verify()s declared size
         etag = hashlib.md5(body).hexdigest()
         ids = []
         try:
@@ -286,16 +429,18 @@ class AzureGatewayObjects:
                 raise api_errors.InvalidPart(cp.part_number)
             block_ids.extend(stored[1])
             total += stored[2]
-        meta, ctype = self._meta_split(mpu["metadata"])
+        part_etags = "".join(mpu["parts"][cp.part_number][0]
+                             for cp in parts)
+        etag = hashlib.md5(bytes.fromhex(part_etags)).hexdigest() \
+            + f"-{len(parts)}"
+        md = dict(mpu["metadata"])
+        md["etag"] = etag
+        meta, ctype = self._meta_split(md)
         try:
             self.c.put_block_list(bucket, key, block_ids, meta, ctype)
         except AzureClientError as e:
             raise _map_err(e, bucket, key) from None
         self._mpu.pop(uid, None)
-        part_etags = "".join(mpu["parts"][cp.part_number][0]
-                             for cp in parts)
-        etag = hashlib.md5(bytes.fromhex(part_etags)).hexdigest() \
-            + f"-{len(parts)}"
         return ObjectInfo(bucket=bucket, name=key, size=total, etag=etag)
 
     # -- misc --------------------------------------------------------------

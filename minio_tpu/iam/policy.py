@@ -41,6 +41,61 @@ def _ip_in_cidr(have: str, want: str) -> bool:
         return False
 
 
+def _to_num(s: str):
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+def _to_date(s: str):
+    """ISO 8601 (with Z or offset) or epoch seconds -> unix ts."""
+    s = s.strip()
+    n = _to_num(s)
+    if n is not None:
+        return n
+    import datetime as _dt
+    try:
+        return _dt.datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+_CMP = {"Equals": lambda a, b: a == b,
+        "LessThan": lambda a, b: a < b,
+        "LessThanEquals": lambda a, b: a <= b,
+        "GreaterThan": lambda a, b: a > b,
+        "GreaterThanEquals": lambda a, b: a >= b}
+
+
+def _op_hit(base: str, vals: list[str], have: str):
+    """One positive condition operator over one context value: True /
+    False on a known operator, None when the operator is unknown
+    (deny-safe at the caller)."""
+    if base == "StringEquals":
+        return have in vals
+    if base == "StringEqualsIgnoreCase":
+        return have.lower() in [v.lower() for v in vals]
+    if base == "StringLike":
+        return any(_wild_match(v, have) for v in vals)
+    if base == "IpAddress":
+        return any(_ip_in_cidr(have, v) for v in vals)
+    if base == "Bool":
+        return have.lower() in [v.lower() for v in vals]
+    for family, conv in (("Numeric", _to_num), ("Date", _to_date)):
+        if base.startswith(family):
+            cmp = _CMP.get(base[len(family):])
+            if cmp is None:
+                return None
+            h = conv(have)
+            if h is None:
+                return False                   # unparsable: never match
+            return any(cmp(h, w) for w in
+                       (conv(v) for v in vals) if w is not None)
+    return None
+
+
 @dataclasses.dataclass
 class PolicyArgs:
     """One authorization query (reference policy.Args)."""
@@ -93,49 +148,40 @@ class Statement:
         return any(_wild_match(p, account) for p in self.principals)
 
     def _conditions_match(self, ctx: dict) -> bool:
-        # AWS/reference semantics: a NEGATED operator evaluates true when
-        # the condition key is absent from the request context; a positive
-        # operator evaluates false. Unknown operators are false (note this
-        # is only safe because the evaluator treats a non-applying Deny as
-        # "no opinion", same as the reference's unresolvable conditions).
+        # AWS/reference operator matrix (pkg/policy/condition): String*,
+        # Numeric*, Date*, Bool, IpAddress, Null, with Not- and
+        # IfExists- modifiers. A NEGATED operator evaluates true when
+        # the condition key is absent from the request context; a
+        # positive operator evaluates false (unless IfExists). Unknown
+        # operators are false — safe because a non-applying Deny is "no
+        # opinion", same as the reference's unresolvable conditions.
         for op, kv in self.conditions.items():
-            neg = op.startswith("StringNot") or op == "NotIpAddress"
-            like = op.endswith("Like")
-            if op in ("StringEquals", "StringNotEquals", "StringLike",
-                      "StringNotLike"):
+            if op == "Null":
                 for key, want in kv.items():
                     vals = want if isinstance(want, list) else [want]
-                    have = ctx.get(key)
-                    if have is None:
-                        if neg:
-                            continue
+                    want_null = str(vals[0]).lower() in ("true", "1")
+                    if (ctx.get(key) is None) != want_null:
                         return False
-                    hit = any(_wild_match(v, have) if like else v == have
-                              for v in vals)
-                    if hit == neg:
-                        return False
-            elif op in ("IpAddress", "NotIpAddress"):
-                for key, want in kv.items():
-                    vals = want if isinstance(want, list) else [want]
-                    have = ctx.get(key)
-                    if have is None:
-                        if neg:
-                            continue
-                        return False
-                    hit = any(_ip_in_cidr(have, v) for v in vals)
-                    if hit == neg:
-                        return False
-            elif op == "Bool":
-                for key, want in kv.items():
-                    vals = want if isinstance(want, list) else [want]
-                    have = ctx.get(key)
-                    if have is None:
-                        return False
-                    if str(have).lower() not in \
-                            [str(v).lower() for v in vals]:
-                        return False
-            else:
-                return False                   # unknown operator: no match
+                continue
+            base = op
+            if_exists = base.endswith("IfExists")
+            if if_exists:
+                base = base[:-len("IfExists")]
+            neg = "Not" in base
+            base = base.replace("Not", "", 1)
+            for key, want in kv.items():
+                vals = [str(v) for v in
+                        (want if isinstance(want, list) else [want])]
+                have = ctx.get(key)
+                if have is None:
+                    if neg or if_exists:
+                        continue
+                    return False
+                hit = _op_hit(base, vals, str(have))
+                if hit is None:
+                    return False               # unknown operator
+                if hit == neg:
+                    return False
         return True
 
     def applies(self, args: PolicyArgs) -> bool:

@@ -155,6 +155,119 @@ class DiskMonitor:
         return healed
 
 
+class HealScanner:
+    """Bloom-hinted background heal (the consumer that makes the
+    data-update tracker load-bearing — reference data-update-tracker
+    feeds the heal crawl the same way): each pass heals only objects
+    the tracker says could have changed since the last COMPLETED pass,
+    pruning unchanged buckets outright. False positives cost a redundant
+    heal check; false negatives cannot happen (the tracker answers
+    "changed" whenever its history can't prove otherwise)."""
+
+    def __init__(self, object_layer, tracker, interval: float = 300.0,
+                 peer_snapshots: Optional[Callable] = None):
+        self.obj = object_layer
+        self.tracker = tracker
+        self.interval = interval
+        # cluster fan-in: callable returning one rotated tracker
+        # snapshot per peer (mutations through OTHER nodes' S3
+        # endpoints mark THEIR trackers; the scanner must see them all
+        # or it would prune objects peers changed — heal false
+        # negatives)
+        self.peer_snapshots = peer_snapshots
+        self._peer_covered: dict[int, int] = {}
+        self.last_cycle = 0          # 0 = never ran: full first pass
+        self.healed = 0
+        self.skipped_buckets = 0
+        self.scanned = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HealScanner":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — keep scanning
+                pass
+
+    def scan_once(self) -> int:
+        """One hinted heal pass; returns objects heal-checked."""
+        from .update_tracker import TrackerSnapshot
+        # everything marked from here on belongs to the NEXT pass
+        pass_cycle = self.tracker.advance_cycle() - 1
+        # the last completed pass covered every cycle <= last_cycle, so
+        # this pass needs mutations from the cycles AFTER it (asking
+        # since=last_cycle would re-heal the previous pass's changes on
+        # every subsequent pass, forever)
+        since = self.last_cycle + 1
+
+        snaps: list[tuple[int, Optional[TrackerSnapshot]]] = []
+        degraded = False
+        if self.peer_snapshots is not None:
+            for idx, raw in enumerate(self.peer_snapshots()):
+                if raw:
+                    snaps.append((idx, TrackerSnapshot(raw)))
+                else:
+                    # unreachable peer: its mutation window is unknown,
+                    # so this pass cannot prune anything
+                    degraded = True
+                    snaps.append((idx, None))
+        full = not self.last_cycle or degraded
+
+        def changed(b: str, o: str = "") -> bool:
+            if full:
+                return True
+            if self.tracker.changed_since(since, b, o):
+                return True
+            return any(
+                s.changed_since(self._peer_covered.get(idx, 0) + 1,
+                                b, o)
+                for idx, s in snaps if s is not None)
+
+        checked = 0
+        for vol in self.obj.list_buckets():
+            b = vol.name
+            if not changed(b):
+                self.skipped_buckets += 1
+                continue
+            marker = ""
+            while True:
+                try:
+                    objs, _, trunc = self.obj.list_objects(
+                        b, "", marker, "", 1000)
+                except api_errors.ObjectApiError:
+                    break
+                for oi in objs:
+                    if not changed(b, oi.name):
+                        continue
+                    self.scanned += 1
+                    checked += 1
+                    try:
+                        res = self.obj.heal_object(b, oi.name)
+                        if getattr(res, "disks_healed", 0):
+                            self.healed += res.disks_healed
+                    except api_errors.ObjectApiError:
+                        pass
+                if not trunc or not objs:
+                    break
+                marker = objs[-1].name
+        self.last_cycle = pass_cycle
+        # every reachable peer's rotated window was covered this pass
+        # (pruned or scanned under its hints)
+        for idx, s in snaps:
+            if s is not None:
+                self._peer_covered[idx] = s.cycle - 1
+        return checked
+
+
 class DataUsageCrawler:
     """Periodic bucket/object scan feeding usage accounting and
     per-object actions (lifecycle enforcement plugs in via `actions`)."""

@@ -1920,6 +1920,15 @@ class S3ApiHandlers:
                 self.events.send(event_name, bucket, key)
             except Exception:  # noqa: BLE001 — events are best-effort
                 pass
+        # the data-update tracker rides every mutation signal (reference
+        # cmd/data-update-tracker.go marks its bloom on object writes)
+        tracker = getattr(self, "update_tracker", None)
+        if tracker is not None and \
+                not event_name.startswith("s3:ObjectAccessed"):
+            try:
+                tracker.mark(bucket, key)
+            except Exception:  # noqa: BLE001 — hints are best-effort
+                pass
         # async replication rides the same mutation signals
         # (mustReplicate check happens inside the pool)
         if self.replication is not None and key:

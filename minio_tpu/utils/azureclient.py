@@ -186,7 +186,7 @@ class AzureBlobClient:
                    ) -> tuple[list[dict], list[str], str]:
         """Returns (blobs, common_prefixes, next_marker)."""
         q = {"restype": "container", "comp": "list",
-             "maxresults": str(max_results)}
+             "maxresults": str(max_results), "include": "metadata"}
         if prefix:
             q["prefix"] = prefix
         if delimiter:
@@ -198,6 +198,7 @@ class AzureBlobClient:
         blobs = []
         for el in root.iter("Blob"):
             props = el.find("Properties")
+            meta_el = el.find("Metadata")
             blobs.append({
                 "name": el.findtext("Name") or "",
                 "size": int(props.findtext("Content-Length") or 0)
@@ -206,6 +207,9 @@ class AzureBlobClient:
                 if props is not None else "",
                 "last_modified": props.findtext("Last-Modified") or ""
                 if props is not None else "",
+                "metadata": {m.tag: (m.text or "")
+                             for m in meta_el} if meta_el is not None
+                else {},
             })
         prefixes = [el.findtext("Name") or ""
                     for el in root.iter("BlobPrefix")]
