@@ -47,10 +47,13 @@ def _parse(argv: list[str]) -> argparse.Namespace:
 
     g = sub.add_parser("gateway", help="serve the S3 API over a "
                        "foreign backend (cmd/gateway-main.go)")
-    g.add_argument("kind", choices=("nas", "s3", "azure"))
+    g.add_argument("kind", choices=("nas", "s3", "azure", "gcs",
+                                    "hdfs"))
     g.add_argument("target", nargs="?", default="",
                    help="nas: /mount/path; s3: host:port; "
-                   "azure: blob endpoint host:port")
+                   "azure: blob endpoint host:port; gcs: endpoint "
+                   "host:port (default storage.googleapis.com); "
+                   "hdfs: namenode host:port")
     g.add_argument("--address", default=":9000")
     g.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
@@ -96,7 +99,7 @@ def _run_gateway(args, creds: Credentials) -> int:
             secret_key=os.environ.get("MINIO_GATEWAY_SECRET_KEY",
                                       creds.secret_key),
             region=args.region)
-    else:
+    elif args.kind == "azure":
         account = os.environ.get("MINIO_AZURE_ACCOUNT", "")
         key = os.environ.get("MINIO_AZURE_KEY", "")
         if not account or not key:
@@ -107,6 +110,25 @@ def _run_gateway(args, creds: Credentials) -> int:
                          "windows.net:443", 443)
         layer = new_gateway("azure", account=account, key_b64=key,
                             host=h, port=p, secure=(p == 443))
+    elif args.kind == "gcs":
+        ak = os.environ.get("MINIO_GCS_ACCESS_KEY", "")
+        sk = os.environ.get("MINIO_GCS_SECRET_KEY", "")
+        if not ak or not sk:
+            print("gateway gcs needs MINIO_GCS_ACCESS_KEY and "
+                  "MINIO_GCS_SECRET_KEY (HMAC interop keys)",
+                  file=sys.stderr)
+            return 2
+        h, p = host_port(args.target or "storage.googleapis.com:443",
+                         443)
+        layer = new_gateway("gcs", access_key=ak, secret_key=sk,
+                            host=h, port=p, secure=(p == 443))
+    else:
+        if not args.target:
+            print("gateway hdfs needs a namenode host:port",
+                  file=sys.stderr)
+            return 2
+        h, p = host_port(args.target, 9870)
+        layer = new_gateway("hdfs", host=h, port=p)
 
     lh, lp = host_port(args.address, 9000)
     srv = S3Server(layer, creds=creds, region=args.region,

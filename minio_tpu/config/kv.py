@@ -53,6 +53,11 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
                      "format": "namespace", "password": ""},
     "notify_kafka": {"enable": "off", "brokers": "", "topic": ""},
     "notify_mqtt": {"enable": "off", "broker": "", "topic": ""},
+    "notify_nats": {"enable": "off", "address": "",
+                    "subject": "minioevents"},
+    "notify_elasticsearch": {"enable": "off", "url": "",
+                             "index": "minioevents",
+                             "format": "namespace"},
 }
 
 
@@ -269,6 +274,8 @@ class ConfigSys:
     CONFIG_REDIS_ARN = "arn:minio:sqs::_:redis"
     CONFIG_KAFKA_ARN = "arn:minio:sqs::_:kafka"
     CONFIG_MQTT_ARN = "arn:minio:sqs::_:mqtt"
+    CONFIG_NATS_ARN = "arn:minio:sqs::_:nats"
+    CONFIG_ELASTIC_ARN = "arn:minio:sqs::_:elasticsearch"
 
     def apply(self, api, events=None, trace=None) -> None:
         """Push config into a running S3ApiHandlers + subsystems.
@@ -301,16 +308,27 @@ class ConfigSys:
             def _on(subsys: str) -> bool:
                 return self.get(subsys, "enable").lower() in ("on",
                                                               "true", "1")
+
+            def _register(target_factory) -> None:
+                # a malformed notify config (e.g. bad NATS subject)
+                # must not crash boot/apply: log and leave the target
+                # unregistered
+                try:
+                    events.register_target(target_factory())
+                except Exception as e:  # noqa: BLE001
+                    from ..utils.console import get_console
+                    get_console().log_line(
+                        "ERROR", f"notify target rejected: {e}")
             from ..features.events import (KafkaTarget, MQTTTarget,
                                            RedisTarget, WebhookTarget)
             if _on("notify_webhook"):
-                events.register_target(WebhookTarget(
+                _register(lambda: WebhookTarget(
                     self.CONFIG_WEBHOOK_ARN,
                     self.get("notify_webhook", "endpoint")))
             else:
                 events.unregister_target(self.CONFIG_WEBHOOK_ARN)
             if _on("notify_redis"):
-                events.register_target(RedisTarget(
+                _register(lambda: RedisTarget(
                     self.CONFIG_REDIS_ARN,
                     self.get("notify_redis", "address"),
                     self.get("notify_redis", "key"),
@@ -319,7 +337,7 @@ class ConfigSys:
             else:
                 events.unregister_target(self.CONFIG_REDIS_ARN)
             if _on("notify_kafka"):
-                events.register_target(KafkaTarget(
+                _register(lambda: KafkaTarget(
                     self.CONFIG_KAFKA_ARN,
                     [b.strip() for b in
                      self.get("notify_kafka", "brokers").split(",")
@@ -328,9 +346,26 @@ class ConfigSys:
             else:
                 events.unregister_target(self.CONFIG_KAFKA_ARN)
             if _on("notify_mqtt"):
-                events.register_target(MQTTTarget(
+                _register(lambda: MQTTTarget(
                     self.CONFIG_MQTT_ARN,
                     self.get("notify_mqtt", "broker"),
                     self.get("notify_mqtt", "topic")))
             else:
                 events.unregister_target(self.CONFIG_MQTT_ARN)
+            from ..features.events import (ElasticsearchTarget,
+                                           NATSTarget)
+            if _on("notify_nats"):
+                _register(lambda: NATSTarget(
+                    self.CONFIG_NATS_ARN,
+                    self.get("notify_nats", "address"),
+                    self.get("notify_nats", "subject")))
+            else:
+                events.unregister_target(self.CONFIG_NATS_ARN)
+            if _on("notify_elasticsearch"):
+                _register(lambda: ElasticsearchTarget(
+                    self.CONFIG_ELASTIC_ARN,
+                    self.get("notify_elasticsearch", "url"),
+                    self.get("notify_elasticsearch", "index"),
+                    format=self.get("notify_elasticsearch", "format")))
+            else:
+                events.unregister_target(self.CONFIG_ELASTIC_ARN)

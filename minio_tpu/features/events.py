@@ -26,6 +26,7 @@ import queue
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 import uuid as _uuid
 import xml.etree.ElementTree as ET
@@ -284,6 +285,102 @@ class MQTTTarget:
                 raise OSError(f"MQTT CONNACK refused: {ack.hex()}")
             s.sendall(publish)
             s.sendall(b"\xe0\x00")             # DISCONNECT
+
+
+class NATSTarget:
+    """Event delivery over the real NATS text protocol
+    (pkg/event/target/nats.go): INFO -> CONNECT(verbose) -> +OK ->
+    PUB subject len / payload -> +OK."""
+
+    def __init__(self, arn: str, addr: str, subject: str,
+                 timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        # the subject is interpolated into the PUB frame: whitespace or
+        # control characters would corrupt (or inject) protocol
+        # commands, so reject them at configuration time
+        if not subject or any(c.isspace() or ord(c) < 0x21
+                              for c in subject):
+            raise ValueError(
+                f"invalid NATS subject {subject!r}: must be non-empty "
+                "without whitespace/control characters")
+        self.arn, self.addr, self.subject = arn, addr, subject
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 4222), timeout=self.timeout)
+
+    @staticmethod
+    def _expect_ok(f) -> None:
+        line = f.readline()
+        if line.strip().startswith(b"-ERR"):
+            raise OSError(f"NATS error: {line.strip().decode()}")
+        if not line.strip().startswith(b"+OK"):
+            raise OSError(f"unexpected NATS reply: {line[:80]!r}")
+
+    def send(self, record: dict) -> None:
+        body = json.dumps(record).encode()
+        with self._connect() as s:
+            f = s.makefile("rb")
+            info = f.readline()
+            if not info.startswith(b"INFO"):
+                raise OSError(f"not a NATS server: {info[:80]!r}")
+            s.sendall(b'CONNECT {"verbose":true,"pedantic":false,'
+                      b'"name":"minio-tpu"}\r\n')
+            self._expect_ok(f)
+            s.sendall(b"PUB %s %d\r\n%s\r\n" % (
+                self.subject.encode(), len(body), body))
+            self._expect_ok(f)
+
+
+class ElasticsearchTarget:
+    """Event delivery to an Elasticsearch index over its HTTP document
+    API (pkg/event/target/elasticsearch.go): format="namespace" keeps
+    one doc per object key (PUT /index/_doc/<id>, DELETE on removal);
+    format="access" appends (POST /index/_doc)."""
+
+    def __init__(self, arn: str, url: str, index: str,
+                 format: str = "namespace", timeout: float = 5.0):
+        self.arn = arn
+        self.url = url.rstrip("/")
+        self.index = index
+        self.format = format
+        self.timeout = timeout
+
+    def _doc_id(self, record: dict) -> str:
+        rec = record["Records"][0]
+        bucket = rec["s3"]["bucket"]["name"]
+        key = rec["s3"]["object"]["key"]
+        import urllib.parse as _up
+        return _up.quote(f"{bucket}/{key}", safe="")
+
+    def send(self, record: dict) -> None:
+        rec = record["Records"][0]
+        body = json.dumps(record).encode()
+        if self.format == "access":
+            req = urllib.request.Request(
+                f"{self.url}/{self.index}/_doc", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+        elif rec["eventName"].startswith("s3:ObjectRemoved"):
+            req = urllib.request.Request(
+                f"{self.url}/{self.index}/_doc/{self._doc_id(record)}",
+                method="DELETE")
+        else:
+            req = urllib.request.Request(
+                f"{self.url}/{self.index}/_doc/{self._doc_id(record)}",
+                data=body, method="PUT",
+                headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and req.get_method() == "DELETE":
+                return                 # deleting a never-indexed doc
+            raise
 
 
 class KafkaTarget:

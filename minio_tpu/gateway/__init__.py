@@ -18,5 +18,11 @@ def new_gateway(kind: str, **kw):
     if kind == "azure":
         from .azure import AzureGateway
         return AzureGateway(**kw).object_layer()
+    if kind == "gcs":
+        from .gcs import GCSGateway
+        return GCSGateway(**kw).object_layer()
+    if kind == "hdfs":
+        from .hdfs import HDFSGateway
+        return HDFSGateway(**kw).object_layer()
     raise ValueError(f"unknown gateway kind {kind!r} "
-                     "(supported: nas, s3, azure)")
+                     "(supported: nas, s3, azure, gcs, hdfs)")

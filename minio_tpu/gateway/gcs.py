@@ -1,0 +1,44 @@
+"""GCS gateway: ObjectLayer over Google Cloud Storage's XML API
+(reference cmd/gateway/gcs/gateway-gcs.go drives the JSON API with
+OAuth; GCS's documented XML interoperability surface speaks the S3
+dialect with HMAC service-account keys — which this build already
+implements natively, so the gateway rides the existing S3 client
+pointed at storage.googleapis.com with path-style addressing).
+
+This is the pragmatic tpu-build mapping: one authenticated transport
+(SigV4/HMAC) covers both AWS-compatible and GCS backends; the
+JSON-API-only features (customer metadata via PATCH, compose) fall
+back to the S3-dialect equivalents GCS exposes.
+"""
+
+from __future__ import annotations
+
+from ..s3.credentials import Credentials
+from ..utils.s3client import S3Client
+from .s3 import S3GatewayObjects
+
+
+class GCSGatewayObjects(S3GatewayObjects):
+    """ObjectLayer over GCS (XML interoperability API)."""
+
+    def storage_info(self) -> dict:
+        out = super().storage_info()
+        out["backend"] = "gateway-gcs"
+        return out
+
+
+class GCSGateway:
+    """`minio gateway gcs` factory: HMAC key + secret from the GCS
+    interoperability settings; host override for testing/private
+    endpoints."""
+
+    def __init__(self, access_key: str, secret_key: str,
+                 host: str = "storage.googleapis.com",
+                 port: int = 443, secure: bool = True,
+                 region: str = "auto"):
+        self.client = S3Client(host, port,
+                               Credentials(access_key, secret_key),
+                               region, secure=secure)
+
+    def object_layer(self) -> GCSGatewayObjects:
+        return GCSGatewayObjects(self.client)

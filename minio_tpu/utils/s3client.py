@@ -41,8 +41,10 @@ class S3ClientError(Exception):
 
 class S3Client:
     def __init__(self, host: str, port: int, creds: Credentials,
-                 region: str = "us-east-1", timeout: float = 60.0):
+                 region: str = "us-east-1", timeout: float = 60.0,
+                 secure: bool = False):
         self.host, self.port = host, port
+        self.secure = secure
         self.creds = creds
         self.region = region
         self.timeout = timeout
@@ -59,7 +61,9 @@ class S3Client:
         hdrs = sig.sign_v4(method, urllib.parse.quote(path), query, hdrs,
                            hashlib.sha256(body).hexdigest(), self.creds,
                            self.region)
-        conn = http.client.HTTPConnection(self.host, self.port,
+        conn_cls = http.client.HTTPSConnection if self.secure \
+            else http.client.HTTPConnection
+        conn = conn_cls(self.host, self.port,
                                           timeout=self.timeout)
         conn.request(method, urllib.parse.quote(path) +
                      (f"?{qs}" if qs else ""), body=body, headers=hdrs)

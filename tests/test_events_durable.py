@@ -387,3 +387,125 @@ def test_replication_survives_restart(tmp_path):
     finally:
         src.close()
         dst.close()
+
+
+# ---------------------------------------------------------------------------
+# NATS target: real text protocol against an in-process server
+# ---------------------------------------------------------------------------
+
+class FakeNATS:
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.published: list[tuple[str, bytes]] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    conn.sendall(b'INFO {"server_id":"fake"}\r\n')
+                    f = conn.makefile("rb")
+                    line = f.readline()          # CONNECT {...}
+                    assert line.startswith(b"CONNECT")
+                    conn.sendall(b"+OK\r\n")
+                    line = f.readline()          # PUB subj n
+                    parts = line.split()
+                    if parts and parts[0] == b"PUB":
+                        n = int(parts[2])
+                        payload = f.read(n + 2)[:-2]
+                        self.published.append(
+                            (parts[1].decode(), payload))
+                        conn.sendall(b"+OK\r\n")
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_nats_target_publish():
+    from minio_tpu.features.events import NATSTarget
+    srv = FakeNATS()
+    try:
+        t = NATSTarget("arn:minio:sqs::1:nats",
+                       f"127.0.0.1:{srv.port}", "minio.events")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "nt"))
+        deadline = time.monotonic() + 5
+        while not srv.published and time.monotonic() < deadline:
+            time.sleep(0.01)
+        subj, payload = srv.published[0]
+        assert subj == "minio.events"
+        assert json.loads(payload)["Records"][0]["s3"]["object"]["key"] \
+            == "nt"
+    finally:
+        srv.close()
+
+    # a non-NATS endpoint is rejected cleanly
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead.listen(1)
+    port = dead.getsockname()[1]
+
+    def junk():
+        conn, _ = dead.accept()
+        conn.sendall(b"HTTP/1.1 400 nope\r\n\r\n")
+        conn.close()
+
+    threading.Thread(target=junk, daemon=True).start()
+    from minio_tpu.features.events import NATSTarget as NT
+    with pytest.raises(OSError, match="not a NATS server"):
+        NT("a", f"127.0.0.1:{port}", "s").send(
+            event_record("s3:ObjectCreated:Put", "b", "k"))
+    dead.close()
+
+
+# ---------------------------------------------------------------------------
+# Elasticsearch target: document API against an in-process HTTP server
+# ---------------------------------------------------------------------------
+
+def test_elasticsearch_target_namespace_and_access():
+    import http.server
+    from minio_tpu.features.events import ElasticsearchTarget
+
+    calls: list[tuple[str, str, bytes]] = []
+
+    class ES(http.server.BaseHTTPRequestHandler):
+        def _h(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            body = self.rfile.read(n) if n else b""
+            calls.append((self.command, self.path, body))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+        do_PUT = do_POST = do_DELETE = _h
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), ES)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        t = ElasticsearchTarget("arn:minio:sqs::1:elasticsearch", url,
+                                "events")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "x/y"))
+        t.send(event_record("s3:ObjectRemoved:Delete", "b", "x/y"))
+        acc = ElasticsearchTarget("a2", url, "log", format="access")
+        acc.send(event_record("s3:ObjectCreated:Put", "b", "z"))
+        assert calls[0][0] == "PUT" and \
+            calls[0][1] == "/events/_doc/b%2Fx%2Fy"
+        assert json.loads(calls[0][2])["Records"][0]["eventName"] == \
+            "s3:ObjectCreated:Put"
+        assert calls[1][0] == "DELETE" and \
+            calls[1][1] == "/events/_doc/b%2Fx%2Fy"
+        assert calls[2][0] == "POST" and calls[2][1] == "/log/_doc"
+    finally:
+        srv.shutdown()

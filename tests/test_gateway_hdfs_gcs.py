@@ -1,0 +1,268 @@
+"""HDFS (WebHDFS REST) and GCS (XML/HMAC interop) gateways — the last
+two reference gateway kinds (cmd/gateway/{hdfs,gcs}). The HDFS tests
+run against an in-process WebHDFS namenode (incl. the two-step
+redirected CREATE); the GCS gateway rides the S3 dialect, driven here
+against a live endpoint standing in for storage.googleapis.com.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.gateway import new_gateway
+from minio_tpu.object import api_errors
+from minio_tpu.object.engine import PutOptions
+
+
+class FakeWebHDFS(http.server.BaseHTTPRequestHandler):
+    """WebHDFS v1 subset with namenode->datanode redirect on CREATE
+    (the two-step write real clusters require)."""
+
+    fs: dict = {}      # path -> bytes (files); dirs tracked separately
+    dirs: set = set()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload: dict, status: int = 200):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _not_found(self, path):
+        self._json({"RemoteException": {
+            "exception": "FileNotFoundException",
+            "message": f"File does not exist: {path}"}}, 404)
+
+    def _dispatch(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = {k: v[0] for k, v in
+             urllib.parse.parse_qs(parsed.query).items()}
+        path = urllib.parse.unquote(
+            parsed.path[len("/webhdfs/v1"):]) or "/"
+        op = q.get("op", "").upper()
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(n) if n else b""
+        m = self.command
+
+        if m == "PUT" and op == "MKDIRS":
+            self.dirs.add(path)
+            p = path
+            while "/" in p[1:]:
+                p = p.rsplit("/", 1)[0]
+                self.dirs.add(p)
+            return self._json({"boolean": True})
+        if m == "PUT" and op == "CREATE":
+            if "redirected" not in q:
+                # namenode: redirect to the "datanode" (same server)
+                self.send_response(307)
+                loc = (f"http://127.0.0.1:{self.server.server_address[1]}"
+                       f"/webhdfs/v1{urllib.parse.quote(path)}"
+                       f"?op=CREATE&redirected=true")
+                self.send_header("Location", loc)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return None
+            self.fs[path] = body
+            return self._json({}, 200)
+        if m == "GET" and op == "OPEN":
+            if path not in self.fs:
+                return self._not_found(path)
+            data = self.fs[path]
+            off = int(q.get("offset", 0))
+            ln = int(q.get("length", len(data) - off))
+            out = data[off:off + ln]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+            return None
+        if m == "GET" and op == "GETFILESTATUS":
+            if path in self.fs:
+                return self._json({"FileStatus": {
+                    "type": "FILE", "length": len(self.fs[path]),
+                    "modificationTime": 1700000000000,
+                    "pathSuffix": ""}})
+            if path in self.dirs:
+                return self._json({"FileStatus": {
+                    "type": "DIRECTORY", "length": 0,
+                    "modificationTime": 1700000000000,
+                    "pathSuffix": ""}})
+            return self._not_found(path)
+        if m == "GET" and op == "LISTSTATUS":
+            if path not in self.dirs:
+                return self._not_found(path)
+            prefix = path.rstrip("/") + "/"
+            entries = []
+            for d in sorted(self.dirs):
+                if d.startswith(prefix) and "/" not in d[len(prefix):] \
+                        and d != path:
+                    entries.append({"type": "DIRECTORY", "length": 0,
+                                    "modificationTime": 1700000000000,
+                                    "pathSuffix": d[len(prefix):]})
+            for f in sorted(self.fs):
+                if f.startswith(prefix) and "/" not in f[len(prefix):]:
+                    entries.append({"type": "FILE",
+                                    "length": len(self.fs[f]),
+                                    "modificationTime": 1700000000000,
+                                    "pathSuffix": f[len(prefix):]})
+            return self._json({"FileStatuses": {"FileStatus": entries}})
+        if m == "DELETE" and op == "DELETE":
+            recursive = q.get("recursive") == "true"
+            if path in self.fs:
+                del self.fs[path]
+                return self._json({"boolean": True})
+            if path in self.dirs:
+                kids = [f for f in list(self.fs) + list(self.dirs)
+                        if f.startswith(path + "/")]
+                if kids and not recursive:
+                    return self._json({"boolean": False})
+                for f in kids:
+                    self.fs.pop(f, None)
+                    self.dirs.discard(f)
+                self.dirs.discard(path)
+                return self._json({"boolean": True})
+            return self._json({"boolean": False})
+        return self._json({"RemoteException": {
+            "exception": "UnsupportedOperationException",
+            "message": op}}, 400)
+
+    do_GET = do_PUT = do_DELETE = _dispatch
+
+
+@pytest.fixture()
+def hdfs_gw():
+    FakeWebHDFS.fs = {}
+    FakeWebHDFS.dirs = set()
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeWebHDFS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    gw = new_gateway("hdfs", host="127.0.0.1",
+                     port=srv.server_address[1])
+    yield gw
+    srv.shutdown()
+
+
+def test_hdfs_bucket_and_object_roundtrip(hdfs_gw):
+    gw = hdfs_gw
+    gw.make_bucket("hb")
+    assert gw.bucket_exists("hb")
+    assert "hb" in [v.name for v in gw.list_buckets()]
+    with pytest.raises(api_errors.BucketExists):
+        gw.make_bucket("hb")
+
+    payload = bytes(range(256)) * 100
+    info = gw.put_object("hb", "dir/f.bin", payload)
+    assert info.size == len(payload)
+    got = gw.get_object_info("hb", "dir/f.bin")
+    assert got.size == len(payload)
+    _i, stream = gw.get_object("hb", "dir/f.bin")
+    assert b"".join(stream) == payload
+    _i, stream = gw.get_object("hb", "dir/f.bin", offset=10, length=50)
+    assert b"".join(stream) == payload[10:60]
+
+    with pytest.raises(api_errors.ObjectNotFound):
+        gw.get_object_info("hb", "missing")
+    gw.delete_object("hb", "dir/f.bin")
+    with pytest.raises(api_errors.ObjectNotFound):
+        gw.get_object_info("hb", "dir/f.bin")
+    gw.delete_bucket("hb")
+    assert not gw.bucket_exists("hb")
+
+
+def test_hdfs_listing_and_multipart(hdfs_gw):
+    gw = hdfs_gw
+    gw.make_bucket("hb")
+    for k in ("a/1", "a/2", "b/1", "top"):
+        gw.put_object("hb", k, b"x")
+    objs, prefixes, _ = gw.list_objects("hb", delimiter="/")
+    assert [o.name for o in objs] == ["top"]
+    assert sorted(prefixes) == ["a/", "b/"]
+    objs, _p, _ = gw.list_objects("hb", prefix="a/")
+    assert [o.name for o in objs] == ["a/1", "a/2"]
+
+    from minio_tpu.object import CompletePart
+    uid = gw.new_multipart_upload("hb", "mp", None)
+    p1 = gw.put_object_part("hb", "mp", uid, 1, b"AA" * 500)
+    p2 = gw.put_object_part("hb", "mp", uid, 2, b"BB" * 500)
+    info = gw.complete_multipart_upload(
+        "hb", "mp", uid, [CompletePart(1, p1.etag),
+                          CompletePart(2, p2.etag)])
+    _i, stream = gw.get_object("hb", "mp")
+    assert b"".join(stream) == b"AA" * 500 + b"BB" * 500
+
+
+def test_gcs_gateway_rides_xml_hmac_dialect(tmp_path):
+    """The GCS gateway speaks the XML/HMAC interop dialect — driven
+    against a live endpoint standing in for storage.googleapis.com."""
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+    creds = Credentials("gcshmackey12", "gcshmacsecret12")
+    drives = [str(tmp_path / f"g{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1,
+                                   set_drive_count=4, parity=2,
+                                   block_size=1 << 16)
+    srv = S3Server(sets, creds=creds).start()
+    try:
+        gw = new_gateway("gcs", access_key=creds.access_key,
+                         secret_key=creds.secret_key,
+                         host="127.0.0.1", port=srv.port, secure=False,
+                         region="us-east-1")
+        assert gw.storage_info()["backend"] == "gateway-gcs"
+        gw.make_bucket("gcsb")
+        gw.put_object("gcsb", "o", b"gcs data", opts=PutOptions())
+        _i, stream = gw.get_object("gcsb", "o")
+        assert b"".join(stream) == b"gcs data"
+        assert [v.name for v in gw.list_buckets()] == ["gcsb"]
+        gw.delete_object("gcsb", "o")
+        with pytest.raises(api_errors.ObjectNotFound):
+            gw.get_object_info("gcsb", "o")
+    finally:
+        srv.stop()
+        sets.close()
+
+
+def test_hdfs_delete_nonempty_and_marker_order(hdfs_gw):
+    """Review r3: non-empty buckets refuse plain deletes; marker
+    pagination uses S3 key order even when a file sorts before a
+    sibling directory's subtree."""
+    gw = hdfs_gw
+    gw.make_bucket("hb2")
+    gw.put_object("hb2", "a!", b"bang")       # 'a!' < 'a/b' in S3 order
+    gw.put_object("hb2", "a/b", b"sub")
+    with pytest.raises(api_errors.BucketNotEmpty):
+        gw.delete_bucket("hb2")
+
+    objs, _p, _t = gw.list_objects("hb2")
+    assert [o.name for o in objs] == ["a!", "a/b"]
+    # paginate 1 at a time across the order boundary
+    page1, _p, t1 = gw.list_objects("hb2", max_keys=1)
+    assert [o.name for o in page1] == ["a!"] and t1
+    page2, _p, _t = gw.list_objects("hb2", marker="a!", max_keys=1)
+    assert [o.name for o in page2] == ["a/b"]
+    # LIST and HEAD agree on the ETag
+    head = gw.get_object_info("hb2", "a/b")
+    assert objs[1].etag == head.etag
+
+    gw.delete_object("hb2", "a!")
+    gw.delete_object("hb2", "a/b")
+    gw.delete_bucket("hb2")                  # now empty: allowed
+
+
+def test_nats_subject_validation():
+    from minio_tpu.features.events import NATSTarget
+    with pytest.raises(ValueError):
+        NATSTarget("a", "h:4222", "minio events")
+    with pytest.raises(ValueError):
+        NATSTarget("a", "h:4222", "x\r\nPUB evil 1")
+    with pytest.raises(ValueError):
+        NATSTarget("a", "h:4222", "")
