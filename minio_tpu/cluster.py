@@ -221,6 +221,8 @@ class ClusterNode:
                      if getattr(self, "crawler", None) is not None
                      else {})
         self._peer_rpc.obd_drive_paths = list(self.spec.drives)
+        self._peer_rpc.get_bandwidth = \
+            lambda: self.s3.api.bandwidth.report()
         # console-log ring: name this node's singleton so merged
         # cluster logs attribute lines to their origin
         from .utils.console import get_console

@@ -44,6 +44,7 @@ class PeerRPCServer:
         # leader heal-scanner pulls + rotates this node's data-update
         # tracker each pass (None until the cluster wires it)
         self.get_update_tracker: Optional[Callable[[], dict]] = None
+        self.get_bandwidth: Callable[[], dict] = lambda: {}
 
         h = self.handler
         h.register("server-info", lambda a, b: {
@@ -64,6 +65,7 @@ class PeerRPCServer:
         h.register("console-log", self._console_log)
         h.register("obd", self._obd)
         h.register("tracker-rotate", self._tracker_rotate)
+        h.register("bandwidth", lambda a, b: self.get_bandwidth())
 
     def _tracker_rotate(self, args, body):
         if self.get_update_tracker is None:
@@ -198,6 +200,12 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return None
 
+    def bandwidth(self) -> dict:
+        try:
+            return self.rc.call_json("bandwidth") or {}
+        except (NetworkError, RPCError):
+            return {}
+
     @property
     def online(self) -> bool:
         return self.rc.online
@@ -288,6 +296,10 @@ class NotificationSys:
         for that peer's window)."""
         return [r if isinstance(r, dict) else None
                 for r in self._broadcast(lambda p: p.tracker_rotate())]
+
+    def bandwidth_all(self) -> list[dict]:
+        return [r for r in self._broadcast(lambda p: p.bandwidth())
+                if isinstance(r, dict)]
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,14 @@ class WebHDFSError(Exception):
         self.exception = exception
 
 
+class _noop:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
 class WebHDFSClient:
     """Minimal WebHDFS v1 client (op=MKDIRS/CREATE/OPEN/LISTSTATUS/
     GETFILESTATUS/DELETE)."""
@@ -48,15 +56,18 @@ class WebHDFSClient:
                 + urllib.parse.urlencode(q))
 
     def _call(self, method: str, path: str, op: str, data: bytes = b"",
-              follow_redirect: bool = False, **params):
+              follow_redirect: bool = False, body_on_hop0: bool = True,
+              want_stream: bool = False, **params):
+        """One WebHDFS op. With follow_redirect and body_on_hop0=False
+        the documented two-step write runs: the namenode hop carries NO
+        body, only the redirected datanode hop uploads the data."""
         url = self._url(path, op, **params)
-        for _hop in range(3):
-            req = urllib.request.Request(url, data=data or None,
-                                         method=method)
+        for hop in range(3):
+            send = data if (data and (hop > 0 or body_on_hop0)) \
+                else None
+            req = urllib.request.Request(url, data=send, method=method)
             try:
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout) as resp:
-                    return resp.read()
+                resp = urllib.request.urlopen(req, timeout=self.timeout)
             except urllib.error.HTTPError as e:
                 if e.code in (301, 302, 307) and follow_redirect:
                     url = e.headers.get("Location", "")
@@ -70,6 +81,15 @@ class WebHDFSClient:
                     raise WebHDFSError(e.code, "HTTP",
                                        body[:200].decode(
                                            errors="replace")) from None
+            except urllib.error.URLError as e:
+                # connection-level failures (refused, broken pipe) must
+                # map like HTTP ones, not escape as raw URLError
+                raise WebHDFSError(0, "Unreachable",
+                                   str(e.reason)) from None
+            with resp if not want_stream else _noop():
+                if want_stream:
+                    return resp
+                return resp.read()
         raise WebHDFSError(310, "TooManyRedirects", url)
 
     def mkdirs(self, path: str) -> bool:
@@ -79,17 +99,32 @@ class WebHDFSClient:
     def create(self, path: str, data: bytes,
                overwrite: bool = True) -> None:
         self._call("PUT", path, "CREATE", data=data,
-                   follow_redirect=True, overwrite=str(overwrite).lower())
+                   follow_redirect=True, body_on_hop0=False,
+                   overwrite=str(overwrite).lower())
 
-    def open(self, path: str, offset: int = 0,
-             length: int = -1) -> bytes:
+    def open(self, path: str, offset: int = 0, length: int = -1,
+             chunk: int = 1 << 20):
+        """Streamed read: yields chunks from the (redirected) datanode
+        response — a multi-GB object never materializes whole."""
         params = {}
         if offset:
             params["offset"] = offset
         if length >= 0:
             params["length"] = length
-        return self._call("GET", path, "OPEN", follow_redirect=True,
-                          **params)
+        resp = self._call("GET", path, "OPEN", follow_redirect=True,
+                          want_stream=True, **params)
+
+        def gen():
+            try:
+                while True:
+                    piece = resp.read(chunk)
+                    if not piece:
+                        return
+                    yield piece
+            finally:
+                resp.close()
+
+        return gen()
 
     def status(self, path: str) -> dict:
         return json.loads(self._call("GET", path,
@@ -133,9 +168,12 @@ class HDFSGatewayObjects:
     # -- buckets -----------------------------------------------------------
 
     def make_bucket(self, bucket: str) -> None:
+        # single-status existence check: listing ALL buckets would turn
+        # a transient root LISTSTATUS failure into a silently-accepted
+        # duplicate create
+        if self.bucket_exists(bucket):
+            raise api_errors.BucketExists(bucket)
         try:
-            if bucket in [v.name for v in self.list_buckets()]:
-                raise api_errors.BucketExists(bucket)
             self.c.mkdirs(self._p(bucket))
         except WebHDFSError as e:
             raise _map_err(e, bucket) from None
@@ -199,8 +237,9 @@ class HDFSGatewayObjects:
             self.c.create(self._p(bucket, key), body)
         except WebHDFSError as e:
             raise _map_err(e, bucket, key) from None
-        return ObjectInfo(bucket=bucket, name=key, size=len(body),
-                          etag=hashlib.md5(body).hexdigest())
+        # ETag must match what HEAD/GET/LIST will report (HDFS keeps no
+        # md5 xattr; a PUT-only md5 would 412 every If-Match later)
+        return self.get_object_info(bucket, key)
 
     def get_object_info(self, bucket: str, key: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
@@ -226,10 +265,10 @@ class HDFSGatewayObjects:
         if length <= 0:
             return info, iter(())
         try:
-            data = self.c.open(self._p(bucket, key), offset, length)
+            stream = self.c.open(self._p(bucket, key), offset, length)
         except WebHDFSError as e:
             raise _map_err(e, bucket, key) from None
-        return info, iter((data,))
+        return info, stream
 
     def delete_object(self, bucket: str, key: str, version_id: str = "",
                       versioned: bool = False) -> ObjectInfo:

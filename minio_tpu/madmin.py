@@ -228,3 +228,7 @@ class AdminClient:
     def obd_info(self) -> list[dict]:
         """Per-node OBD bundles (drive latency probes, cpu/mem)."""
         return self._json("GET", "obdinfo")["nodes"]
+
+    def bandwidth(self) -> dict:
+        """Cluster-merged per-bucket byte rates/totals."""
+        return self._json("GET", "bandwidth")["buckets"]

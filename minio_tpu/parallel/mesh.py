@@ -104,6 +104,57 @@ def sharded_put_step(mesh: Mesh, k: int, m: int):
     return jax.jit(fn)
 
 
+def sharded_get_step(mesh: Mesh, k: int, m: int, present_mask: int):
+    """Multi-chip fused verify+decode (the r3 flagship in SPMD form):
+    survivors (B, k, S) in decode `used` order, column-sharded ->
+    (missing data rows, survivor HighwayHash256 digests).
+
+    The decode matmul is GF-columnwise independent (zero collectives);
+    the digest pass reshards survivors SP->TP with an all_to_all so
+    each device hashes whole shard rows — identical collective pattern
+    to the PUT pipeline, so GET-with-failures scales the same way.
+
+    Requires k % sp == 0 (shard rows split across the sp axis for
+    hashing).
+    """
+    dm, _used, missing = rs_matrix.missing_data_matrix(
+        k, m, present_mask)
+    m2 = rs_tpu._bit_expand_cached(dm.tobytes(), dm.shape)
+    from ..bitrot import MAGIC_HIGHWAYHASH_KEY
+    from ..ops import highwayhash_jax
+    sp_size = mesh.devices.shape[1]
+    # the digest all_to_all splits shard rows across sp: pad k up to a
+    # multiple (padded rows hash garbage nobody reads; the matmul is
+    # untouched)
+    k_pad = -(-k // sp_size) * sp_size
+
+    def local_step(survivors):  # (B/dp, k, S/sp)
+        out = rs_tpu.gf_matmul_xla(jnp.asarray(m2, jnp.bfloat16),
+                                   survivors)
+        padded = jnp.pad(survivors, ((0, 0), (0, k_pad - k), (0, 0))) \
+            if k_pad != k else survivors
+        rows = jax.lax.all_to_all(padded, "sp", split_axis=1,
+                                  concat_axis=2, tiled=True)
+        b_loc, r_loc, s_full = rows.shape
+        digests = highwayhash_jax._hh256_impl(
+            rows.reshape(b_loc * r_loc, s_full), s_full,
+            bytes(MAGIC_HIGHWAYHASH_KEY)).reshape(b_loc, r_loc, 32)
+        return out, digests
+
+    from jax.experimental.shard_map import shard_map
+    fn = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None, "sp"),),
+        out_specs=(P("dp", None, "sp"), P("dp", "sp", None)),
+        check_rep=False)
+    jitted = jax.jit(fn)
+
+    def run(survivors):
+        out, digests = jitted(survivors)
+        return out, digests[:, :k]            # drop the pad rows
+    return run, missing
+
+
 def sharded_heal_step(mesh: Mesh, k: int, m: int, present_mask: int):
     """Multi-chip heal: survivors (B, k, S) -> missing shards, sp/dp
     sharded. Byte-column independence means zero collectives in the hot

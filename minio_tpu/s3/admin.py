@@ -174,6 +174,13 @@ class AdminHandlers:
                 entries.extend(self.node.notification.console_log_all(n))
             entries.sort(key=lambda e: e.get("ts", 0))
             return self._json({"entries": entries[-1000:]})
+        if sub == "bandwidth" and m == "GET":
+            self._auth(ctx, "admin:BandwidthMonitor")
+            from ..utils.bandwidth import merge_reports
+            reports = [self.api.bandwidth.report()]
+            if self.node is not None:
+                reports.extend(self.node.notification.bandwidth_all())
+            return self._json({"buckets": merge_reports(reports)})
         if sub == "obdinfo" and m == "GET":
             self._auth(ctx, "admin:OBDInfo")
             from ..utils.obd import local_obd

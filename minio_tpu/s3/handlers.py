@@ -189,6 +189,8 @@ class S3ApiHandlers:
         self.replication = None   # optional ReplicationPool
         from .trace import TraceSys
         self.trace = TraceSys()   # request tracing + audit hub
+        from ..utils.bandwidth import BandwidthMonitor
+        self.bandwidth = BandwidthMonitor()  # per-bucket byte rates
         self.config = None        # optional ConfigSys (admin KV)
         # upload-session metadata cache: immutable after create, so part
         # uploads don't re-read the session journal per part
@@ -1106,6 +1108,7 @@ class S3ApiHandlers:
             PutOptions(metadata=metadata, versioned=versioned,
                        parity=self._parity_for(
                            ctx.header("x-amz-storage-class"))))
+        self.bandwidth.record(bucket, "rx", max(ctx.content_length, 0))
         headers = {"ETag": f'"{info.etag}"', **sse_headers}
         if info.version_id and info.version_id != "null":
             headers["x-amz-version-id"] = info.version_id
@@ -1224,7 +1227,9 @@ class S3ApiHandlers:
             if ctx.query1(qk):
                 headers[hk] = ctx.query1(qk)
         self._notify("s3:ObjectAccessed:Get", bucket, key)
-        return HTTPResponse(status=status, headers=headers, stream=stream)
+        return HTTPResponse(status=status, headers=headers,
+                            stream=self.bandwidth.counting_stream(
+                                bucket, stream))
 
     def _get_transformed(self, ctx, bucket, key, info, opts, md
                          ) -> HTTPResponse:
@@ -1278,7 +1283,9 @@ class S3ApiHandlers:
             headers["Content-Range"] = (
                 f"bytes {offset}-{offset + length - 1}/{actual}")
         self._notify("s3:ObjectAccessed:Get", bucket, key)
-        return HTTPResponse(status=status, headers=headers, stream=stream)
+        return HTTPResponse(status=status, headers=headers,
+                            stream=self.bandwidth.counting_stream(
+                                bucket, stream))
 
     def _multipart_meta(self, bucket: str, key: str,
                         upload_id: str) -> dict:
@@ -1599,6 +1606,9 @@ class S3ApiHandlers:
             size = -1
         part = self.obj.put_object_part(bucket, key, upload_id,
                                         part_number, reader, size)
+        # multipart is the standard large-upload path — its ingress
+        # must count toward the bucket's bandwidth like single PUTs
+        self.bandwidth.record(bucket, "rx", max(ctx.content_length, 0))
         return HTTPResponse(headers={"ETag": f'"{part.etag}"'})
 
     def copy_object_part(self, ctx, bucket, key) -> HTTPResponse:

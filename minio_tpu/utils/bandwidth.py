@@ -1,0 +1,92 @@
+"""Per-bucket bandwidth monitor (reference pkg/bandwidth + admin
+/bandwidth): rolling byte-rate measurement for ingress (PUT bodies)
+and egress (GET streams), aggregated cluster-wide over the peer plane.
+
+A 10-slot one-second ring per (bucket, direction) gives a smoothed
+bytes/sec without unbounded state; totals accumulate forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+WINDOW_SLOTS = 10          # seconds of rate window
+
+
+class _Meter:
+    __slots__ = ("slots", "head", "total")
+
+    def __init__(self):
+        self.slots = [0] * WINDOW_SLOTS
+        self.head = int(time.monotonic())   # second of the newest slot
+        self.total = 0
+
+    def record(self, n: int, now: float) -> None:
+        sec = int(now)
+        if sec > self.head:
+            if sec - self.head >= WINDOW_SLOTS:
+                self.slots = [0] * WINDOW_SLOTS
+            else:
+                for s in range(self.head + 1, sec + 1):
+                    self.slots[s % WINDOW_SLOTS] = 0
+            self.head = sec
+        self.slots[sec % WINDOW_SLOTS] += n
+        self.total += n
+
+    def rate(self, now: float) -> float:
+        self.record(0, now)            # expire stale slots
+        return sum(self.slots) / WINDOW_SLOTS
+
+
+class BandwidthMonitor:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._meters: dict[tuple[str, str], _Meter] = {}
+
+    def record(self, bucket: str, direction: str, n: int) -> None:
+        """direction: 'rx' (client->server bytes) or 'tx'."""
+        if n <= 0 or not bucket:
+            return
+        now = time.monotonic()
+        with self._mu:
+            meter = self._meters.get((bucket, direction))
+            if meter is None:
+                meter = self._meters[(bucket, direction)] = _Meter()
+            meter.record(n, now)
+
+    def counting_stream(self, bucket: str, stream):
+        """Wrap a GET chunk iterator, recording egress as it flows."""
+        def gen():
+            for chunk in stream:
+                self.record(bucket, "tx", len(chunk))
+                yield chunk
+        return gen()
+
+    def report(self) -> dict:
+        """{bucket: {rx_bps, tx_bps, rx_total, tx_total}}"""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        with self._mu:
+            for (bucket, direction), meter in self._meters.items():
+                b = out.setdefault(bucket, {
+                    "rx_bps": 0.0, "tx_bps": 0.0,
+                    "rx_total": 0, "tx_total": 0})
+                b[f"{direction}_bps"] = round(meter.rate(now), 1)
+                b[f"{direction}_total"] = meter.total
+        return out
+
+
+def merge_reports(reports: list[dict]) -> dict:
+    """Sum per-bucket meters across nodes (cluster-wide view)."""
+    merged: dict[str, dict] = {}
+    for rep in reports:
+        if not isinstance(rep, dict):
+            continue
+        for bucket, vals in rep.items():
+            b = merged.setdefault(bucket, {
+                "rx_bps": 0.0, "tx_bps": 0.0,
+                "rx_total": 0, "tx_total": 0})
+            for key in b:
+                b[key] = round(b[key] + vals.get(key, 0), 1)
+    return merged

@@ -303,3 +303,37 @@ def test_admin_bucket_quota_and_remote_targets(server):
     mc.remove_remote_target("qb", arn)
     assert mc.list_remote_targets("qb") == []
     assert arn not in server.api.replication.targets
+
+
+def test_admin_bandwidth_monitor(server):
+    """Per-bucket ingress/egress rates flow into admin /bandwidth
+    (reference pkg/bandwidth + admin BandwidthMonitor)."""
+    from minio_tpu.madmin import AdminClient
+    mc = AdminClient("127.0.0.1", server.port, CREDS.access_key,
+                     CREDS.secret_key)
+    c = Client(server.port)
+    assert c.request("PUT", "/bwbucket")[0] == 200
+    body = b"z" * 50_000
+    assert c.request("PUT", "/bwbucket/o", body=body)[0] == 200
+    st, got = c.request("GET", "/bwbucket/o")
+    assert st == 200 and got == body
+
+    buckets = mc.bandwidth()
+    bw = buckets.get("bwbucket")
+    assert bw is not None
+    assert bw["rx_total"] >= len(body)
+    assert bw["tx_total"] >= len(body)
+    assert bw["rx_bps"] > 0 and bw["tx_bps"] > 0
+
+
+def test_bandwidth_meter_window():
+    from minio_tpu.utils.bandwidth import (BandwidthMonitor,
+                                           merge_reports)
+    m = BandwidthMonitor()
+    m.record("b", "rx", 1000)
+    rep = m.report()
+    assert rep["b"]["rx_total"] == 1000 and rep["b"]["rx_bps"] == 100.0
+    merged = merge_reports([rep, {"b": {"rx_bps": 50.0, "tx_bps": 0,
+                                        "rx_total": 10, "tx_total": 0}}])
+    assert merged["b"]["rx_total"] == 1010
+    assert merged["b"]["rx_bps"] == 150.0

@@ -67,7 +67,7 @@ def test_live_server_device_path_concurrent_puts(device_server):
     def put(name: str, body: bytes) -> None:
         try:
             client = S3TestClient("127.0.0.1", srv.port)
-            barrier.wait(10)
+            barrier.wait(30)
             st, _, _ = client.request("PUT", f"/devbkt/{name}", body=body)
             assert st == 200, f"PUT {name} -> {st}"
         except Exception as e:  # noqa: BLE001
@@ -86,7 +86,7 @@ def test_live_server_device_path_concurrent_puts(device_server):
     # dispatches (the whole point of the cross-request batch former).
     # Thread overlap is load-dependent, so allow extra volleys before
     # calling it a failure.
-    for round_ in range(3):
+    for round_ in range(5):
         if sched.coalesced > 0:
             break
         vb = threading.Barrier(n_streams)
@@ -94,7 +94,7 @@ def test_live_server_device_path_concurrent_puts(device_server):
 
         def volley(name):
             client = S3TestClient("127.0.0.1", srv.port)
-            vb.wait(10)
+            vb.wait(30)
             client.request("PUT", f"/devbkt/{name}",
                            body=payloads[name])
 

@@ -266,3 +266,19 @@ def test_nats_subject_validation():
         NATSTarget("a", "h:4222", "x\r\nPUB evil 1")
     with pytest.raises(ValueError):
         NATSTarget("a", "h:4222", "")
+
+
+def test_hdfs_put_etag_matches_head_and_streamed_get(hdfs_gw):
+    """Review r3: the PUT-returned ETag must equal HEAD/LIST's, and
+    GETs stream instead of materializing (iterator yields chunks)."""
+    gw = hdfs_gw
+    gw.make_bucket("hb3")
+    payload = bytes(range(256)) * 8192        # 2 MiB
+    info = gw.put_object("hb3", "big", payload)
+    assert info.etag == gw.get_object_info("hb3", "big").etag
+    objs, _p, _t = gw.list_objects("hb3")
+    assert objs[0].etag == info.etag
+    _i, stream = gw.get_object("hb3", "big")
+    chunks = list(stream)
+    assert len(chunks) >= 2                   # 1 MiB chunking
+    assert b"".join(chunks) == payload
