@@ -116,13 +116,22 @@ def bench_device() -> tuple[float, dict]:
             else "xla+hh256"}
     for name, mode in (("decode_3miss_gibs", "decode"),
                        ("heal_4miss_gibs", "heal")):
-        info[name] = round(
-            _bench_matrix_op(slope_time, dd, data, mode), 2)
+        gibs, ratio = _bench_matrix_op(slope_time, dd, data, mode,
+                                       put_ref=lambda: slope_time(
+                                           lambda d: put_step(d, K, M),
+                                           dd))
+        info[name] = round(gibs, 2)
+        info[name.replace("_gibs", "_vs_put")] = round(ratio, 2)
     info["secondary_note"] = (
         "decode/heal rows are FUSED verify+reconstruct: each includes "
         "HighwayHash256 bitrot verification of all 12 survivor shards "
         "in the same device program (heal also digests the rebuilt "
-        "shards for their new frames); identity gated vs host oracle")
+        "shards for their new frames); identity gated vs host oracle. "
+        "The *_vs_put ratios are measured against an ADJACENT put_step "
+        "re-measurement in the same chip window — the shared dev slice "
+        "throttles under sustained load, so only same-window ratios "
+        "are comparable (interleaved A/B measured decode at 0.77x and "
+        "heal at ~1.0x of put_step's time)")
     info["config5_multipart_16p4_sha256_gibs"] = round(
         _bench_config5(slope_time), 2)
     return gib, info
@@ -159,7 +168,8 @@ def _bench_config5(slope_time) -> float:
     return BATCH * k5 * s5 / best / 2**30
 
 
-def _bench_matrix_op(slope_time, dd, data_host, mode: str) -> float:
+def _bench_matrix_op(slope_time, dd, data_host, mode: str,
+                     put_ref=None) -> tuple[float, float]:
     """Secondary kernels for BASELINE configs #3/#4, FUSED with bitrot
     verification (r3): one device program per batch hashes every
     survivor shard (HighwayHash256 streaming-bitrot verify — the
@@ -204,7 +214,15 @@ def _bench_matrix_op(slope_time, dd, data_host, mode: str) -> float:
             "device heal output digest diverges"
 
     best = slope_time(op, dd)
-    return BATCH * K * S / best / 2**30
+    # adjacent same-window put_step reference: the chip throttles under
+    # sustained load, so absolute numbers from different moments of the
+    # bench are incomparable — the ratio is the stable signal
+    ratio = 0.0
+    if put_ref is not None:
+        ref = put_ref()
+        if ref:
+            ratio = ref / best          # >1 = faster than put_step
+    return BATCH * K * S / best / 2**30, ratio
 
 
 def bench_cpu_baseline() -> tuple[float, dict]:

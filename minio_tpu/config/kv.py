@@ -55,6 +55,11 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "notify_mqtt": {"enable": "off", "broker": "", "topic": ""},
     "notify_nats": {"enable": "off", "address": "",
                     "subject": "minioevents"},
+    "notify_nsq": {"enable": "off", "address": "",
+                   "topic": "minioevents"},
+    "notify_amqp": {"enable": "off", "address": "", "exchange": "",
+                    "routing_key": "minioevents", "user": "guest",
+                    "password": "guest", "vhost": "/"},
     "notify_elasticsearch": {"enable": "off", "url": "",
                              "index": "minioevents",
                              "format": "namespace"},
@@ -275,6 +280,8 @@ class ConfigSys:
     CONFIG_KAFKA_ARN = "arn:minio:sqs::_:kafka"
     CONFIG_MQTT_ARN = "arn:minio:sqs::_:mqtt"
     CONFIG_NATS_ARN = "arn:minio:sqs::_:nats"
+    CONFIG_NSQ_ARN = "arn:minio:sqs::_:nsq"
+    CONFIG_AMQP_ARN = "arn:minio:sqs::_:amqp"
     CONFIG_ELASTIC_ARN = "arn:minio:sqs::_:elasticsearch"
 
     def apply(self, api, events=None, trace=None) -> None:
@@ -361,6 +368,25 @@ class ConfigSys:
                     self.get("notify_nats", "subject")))
             else:
                 events.unregister_target(self.CONFIG_NATS_ARN)
+            from ..features.events import AMQPTarget, NSQTarget
+            if _on("notify_amqp"):
+                _register(lambda: AMQPTarget(
+                    self.CONFIG_AMQP_ARN,
+                    self.get("notify_amqp", "address"),
+                    exchange=self.get("notify_amqp", "exchange"),
+                    routing_key=self.get("notify_amqp", "routing_key"),
+                    user=self.get("notify_amqp", "user"),
+                    password=self.get("notify_amqp", "password"),
+                    vhost=self.get("notify_amqp", "vhost")))
+            else:
+                events.unregister_target(self.CONFIG_AMQP_ARN)
+            if _on("notify_nsq"):
+                _register(lambda: NSQTarget(
+                    self.CONFIG_NSQ_ARN,
+                    self.get("notify_nsq", "address"),
+                    self.get("notify_nsq", "topic")))
+            else:
+                events.unregister_target(self.CONFIG_NSQ_ARN)
             if _on("notify_elasticsearch"):
                 _register(lambda: ElasticsearchTarget(
                     self.CONFIG_ELASTIC_ARN,

@@ -280,8 +280,8 @@ class RPCServer:
 
             do_GET = do_POST = _go
 
-        self._httpd = ThreadingHTTPServer((address, port), _H)
-        self._httpd.daemon_threads = True
+        from ..s3.server import _DeepBacklogServer
+        self._httpd = _DeepBacklogServer((address, port), _H)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -335,6 +335,210 @@ class NATSTarget:
             self._expect_ok(f)
 
 
+class AMQPTarget:
+    """Event delivery over real AMQP 0-9-1 (pkg/event/target/amqp.go):
+    protocol header, Connection.Start/Tune/Open handshake with PLAIN
+    auth, Channel.Open, then Basic.Publish with a content header +
+    body frame to the configured exchange/routing key."""
+
+    FRAME_METHOD, FRAME_HEADER, FRAME_BODY = 1, 2, 3
+    FRAME_END = 0xCE
+
+    def __init__(self, arn: str, addr: str, exchange: str = "",
+                 routing_key: str = "minioevents",
+                 user: str = "guest", password: str = "guest",
+                 vhost: str = "/", timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        # shortstr fields are capped at 255 bytes on the wire; reject
+        # at configuration time so _register skips the target instead
+        # of every send() failing forever
+        for name, v in (("exchange", exchange),
+                        ("routing_key", routing_key), ("user", user),
+                        ("vhost", vhost)):
+            if len(v.encode()) > 255 or any(ord(c) < 0x20 for c in v):
+                raise ValueError(
+                    f"invalid AMQP {name} {v!r}: max 255 bytes, no "
+                    "control characters")
+        self.arn, self.addr = arn, addr
+        self.exchange, self.routing_key = exchange, routing_key
+        self.user, self.password, self.vhost = user, password, vhost
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 5672), timeout=self.timeout)
+
+    # -- wire encoding -----------------------------------------------------
+
+    @staticmethod
+    def _shortstr(s: str) -> bytes:
+        b = s.encode()
+        return bytes([len(b)]) + b
+
+    @staticmethod
+    def _longstr(b: bytes) -> bytes:
+        return len(b).to_bytes(4, "big") + b
+
+    def _frame(self, ftype: int, channel: int, payload: bytes) -> bytes:
+        return (bytes([ftype]) + channel.to_bytes(2, "big")
+                + len(payload).to_bytes(4, "big") + payload
+                + bytes([self.FRAME_END]))
+
+    def _method(self, channel: int, cls: int, meth: int,
+                args: bytes) -> bytes:
+        return self._frame(self.FRAME_METHOD, channel,
+                           cls.to_bytes(2, "big")
+                           + meth.to_bytes(2, "big") + args)
+
+    @classmethod
+    def _read_frame(cls, f) -> tuple[int, int, bytes]:
+        head = f.read(7)
+        if len(head) < 7:
+            raise OSError("AMQP connection closed")
+        ftype = head[0]
+        channel = int.from_bytes(head[1:3], "big")
+        size = int.from_bytes(head[3:7], "big")
+        payload = f.read(size)
+        if f.read(1) != bytes([cls.FRAME_END]):
+            raise OSError("AMQP framing error")
+        return ftype, channel, payload
+
+    def _expect_method(self, f, cls_id: int, meth_id: int) -> bytes:
+        ftype, _ch, payload = self._read_frame(f)
+        if ftype != self.FRAME_METHOD or len(payload) < 4:
+            raise OSError("AMQP: expected method frame")
+        got_cls = int.from_bytes(payload[:2], "big")
+        got_meth = int.from_bytes(payload[2:4], "big")
+        if (got_cls, got_meth) != (cls_id, meth_id):
+            raise OSError(f"AMQP: expected {cls_id}.{meth_id}, "
+                          f"got {got_cls}.{got_meth}")
+        return payload[4:]
+
+    def send(self, record: dict) -> None:
+        body = json.dumps(record).encode()
+        with self._connect() as s:
+            f = s.makefile("rb")
+            s.sendall(b"AMQP\x00\x00\x09\x01")
+            self._expect_method(f, 10, 10)          # Connection.Start
+            plain = self._longstr(
+                b"\x00" + self.user.encode() + b"\x00"
+                + self.password.encode())
+            s.sendall(self._method(
+                0, 10, 11,                          # Start-Ok
+                (0).to_bytes(4, "big")              # empty client table
+                + self._shortstr("PLAIN") + plain
+                + self._shortstr("en_US")))
+            tune = self._expect_method(f, 10, 30)   # Tune
+            offered = int.from_bytes(tune[2:6], "big") \
+                if len(tune) >= 6 else 0
+            # the client's frame-max must not exceed the server's offer
+            # (0 = no server limit)
+            frame_max = min(offered or 131072, 131072)
+            s.sendall(self._method(
+                0, 10, 31,                          # Tune-Ok
+                (0).to_bytes(2, "big")
+                + frame_max.to_bytes(4, "big")
+                + (0).to_bytes(2, "big")))
+            s.sendall(self._method(
+                0, 10, 40,                          # Open (vhost)
+                self._shortstr(self.vhost)
+                + self._shortstr("") + b"\x00"))
+            self._expect_method(f, 10, 41)          # Open-Ok
+            s.sendall(self._method(1, 20, 10,       # Channel.Open
+                                   self._shortstr("")))
+            self._expect_method(f, 20, 11)          # Channel.Open-Ok
+            s.sendall(self._method(
+                1, 60, 40,                          # Basic.Publish
+                (0).to_bytes(2, "big")
+                + self._shortstr(self.exchange)
+                + self._shortstr(self.routing_key) + b"\x00"))
+            header = ((60).to_bytes(2, "big")       # content header
+                      + (0).to_bytes(2, "big")
+                      + len(body).to_bytes(8, "big")
+                      + (0x8000).to_bytes(2, "big")  # content-type set
+                      + self._shortstr("application/json"))
+            s.sendall(self._frame(self.FRAME_HEADER, 1, header))
+            # split the body at frame-max (8 bytes of frame overhead)
+            chunk = max(frame_max - 8, 1)
+            for at in range(0, len(body), chunk):
+                s.sendall(self._frame(self.FRAME_BODY, 1,
+                                      body[at:at + chunk]))
+            s.sendall(self._method(0, 10, 50,       # Connection.Close
+                                   (200).to_bytes(2, "big")
+                                   + self._shortstr("bye")
+                                   + (0).to_bytes(4, "big")))
+            # the broker reports async publish failures (unroutable
+            # exchange etc.) as Channel.Close/Connection.Close before
+            # our Close-Ok — fire-and-forget here would ack-and-delete
+            # a lost event from the durable queue
+            ftype, _ch, payload = self._read_frame(f)
+            if ftype == self.FRAME_METHOD and len(payload) >= 4:
+                cls_id = int.from_bytes(payload[:2], "big")
+                meth_id = int.from_bytes(payload[2:4], "big")
+                if (cls_id, meth_id) == (10, 51):   # Close-Ok: clean
+                    return
+                if meth_id == 40 or (cls_id, meth_id) == (10, 50):
+                    code = int.from_bytes(payload[4:6], "big") \
+                        if len(payload) >= 6 else 0
+                    raise OSError(
+                        f"AMQP publish refused ({cls_id}.{meth_id} "
+                        f"reply-code {code})")
+            raise OSError("AMQP: unexpected reply to Connection.Close")
+
+
+class NSQTarget:
+    """Event delivery over the real NSQ TCP protocol
+    (pkg/event/target/nsq.go): '  V2' magic, PUB <topic> with a 4-byte
+    big-endian size prefix, OK frame response."""
+
+    def __init__(self, arn: str, addr: str, topic: str,
+                 timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        # the topic is interpolated into the PUB command line: NSQ
+        # names are [.a-zA-Z0-9_-], 1..64 chars — reject anything else
+        # at configuration time (same reasoning as NATSTarget)
+        import re as _re
+        if not _re.fullmatch(r"[.a-zA-Z0-9_-]{1,64}(#ephemeral)?",
+                             topic):
+            raise ValueError(
+                f"invalid NSQ topic {topic!r}: must match "
+                "[.a-zA-Z0-9_-]{{1,64}} with optional #ephemeral")
+        self.arn, self.addr, self.topic = arn, addr, topic
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 4150), timeout=self.timeout)
+
+    def send(self, record: dict) -> None:
+        body = json.dumps(record).encode()
+        with self._connect() as s:
+            s.sendall(b"  V2")
+            s.sendall(b"PUB %s\n" % self.topic.encode()
+                      + len(body).to_bytes(4, "big") + body)
+            # response frame: [size u32][frame_type i32][data]
+            head = b""
+            while len(head) < 8:
+                chunk = s.recv(8 - len(head))
+                if not chunk:
+                    raise OSError("NSQ connection closed")
+                head += chunk
+            size = int.from_bytes(head[:4], "big")
+            frame_type = int.from_bytes(head[4:8], "big", signed=True)
+            data = b""
+            while len(data) < size - 4:
+                chunk = s.recv(size - 4 - len(data))
+                if not chunk:
+                    break
+                data += chunk
+            if frame_type == 1 or not data.startswith(b"OK"):
+                raise OSError(f"NSQ error: {data[:80]!r}")
+
+
 class ElasticsearchTarget:
     """Event delivery to an Elasticsearch index over its HTTP document
     API (pkg/event/target/elasticsearch.go): format="namespace" keeps

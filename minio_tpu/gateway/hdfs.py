@@ -31,14 +31,6 @@ class WebHDFSError(Exception):
         self.exception = exception
 
 
-class _noop:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
-
-
 class WebHDFSClient:
     """Minimal WebHDFS v1 client (op=MKDIRS/CREATE/OPEN/LISTSTATUS/
     GETFILESTATUS/DELETE)."""
@@ -62,10 +54,10 @@ class WebHDFSClient:
         the documented two-step write runs: the namenode hop carries NO
         body, only the redirected datanode hop uploads the data."""
         url = self._url(path, op, **params)
-        for hop in range(3):
-            send = data if (data and (hop > 0 or body_on_hop0)) \
-                else None
-            req = urllib.request.Request(url, data=send, method=method)
+        for hop in range(4):
+            send_body = bool(data) and (hop > 0 or body_on_hop0)
+            req = urllib.request.Request(
+                url, data=data if send_body else None, method=method)
             try:
                 resp = urllib.request.urlopen(req, timeout=self.timeout)
             except urllib.error.HTTPError as e:
@@ -86,9 +78,17 @@ class WebHDFSClient:
                 # map like HTTP ones, not escape as raw URLError
                 raise WebHDFSError(0, "Unreachable",
                                    str(e.reason)) from None
-            with resp if not want_stream else _noop():
-                if want_stream:
-                    return resp
+            if data and not send_body:
+                # the endpoint accepted without redirecting (HttpFS
+                # proxies data directly): it never saw the payload —
+                # returning success here would write an empty file
+                resp.read()
+                resp.close()
+                body_on_hop0 = True
+                continue
+            if want_stream:
+                return resp
+            with resp:
                 return resp.read()
         raise WebHDFSError(310, "TooManyRedirects", url)
 

@@ -113,9 +113,8 @@ def sharded_get_step(mesh: Mesh, k: int, m: int, present_mask: int):
     the digest pass reshards survivors SP->TP with an all_to_all so
     each device hashes whole shard rows — identical collective pattern
     to the PUT pipeline, so GET-with-failures scales the same way.
-
-    Requires k % sp == 0 (shard rows split across the sp axis for
-    hashing).
+    k that doesn't divide the sp axis is zero-padded for the digest
+    reshard (pad-row digests are dropped before returning).
     """
     dm, _used, missing = rs_matrix.missing_data_matrix(
         k, m, present_mask)

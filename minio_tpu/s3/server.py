@@ -12,6 +12,14 @@ import ssl
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _DeepBacklogServer(ThreadingHTTPServer):
+    """socketserver's default listen backlog is 5: a burst of concurrent
+    clients overflows the accept queue and gets connection resets (the
+    reference listener accepts with a deep backlog too)."""
+    request_queue_size = 128
+    daemon_threads = True
 from typing import Optional
 
 from . import signature as sig
@@ -205,10 +213,9 @@ class S3Server:
         self.api = S3ApiHandlers(object_layer, region=region, creds=creds,
                                  iam=iam)
         self.extra_routers: list = []
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _DeepBacklogServer(
             (address, port),
             _make_handler_class(self.api, self.extra_routers))
-        self._httpd.daemon_threads = True
         self.tls = bool(certfile)
         if certfile:
             import ssl

@@ -226,7 +226,15 @@ def test_peer_plane_verbs(cluster):
     conn.request("GET", "/minio/health/live")
     conn.getresponse().read()
     conn.close()
-    merged = a.notification.trace_all()
+    # the trace entry is recorded asynchronously wrt the response —
+    # poll briefly instead of racing it
+    import time as _time
+    deadline = _time.time() + 5
+    while _time.time() < deadline:
+        merged = a.notification.trace_all()
+        if any(e.get("path") == "/minio/health/live" for e in merged):
+            break
+        _time.sleep(0.1)
     assert any(e.get("path") == "/minio/health/live" for e in merged)
 
 

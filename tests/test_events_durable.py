@@ -509,3 +509,221 @@ def test_elasticsearch_target_namespace_and_access():
         assert calls[2][0] == "POST" and calls[2][1] == "/log/_doc"
     finally:
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# NSQ target: real TCP protocol against an in-process nsqd
+# ---------------------------------------------------------------------------
+
+def test_nsq_target_publish():
+    from minio_tpu.features.events import NSQTarget
+
+    published = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    assert f.read(4) == b"  V2"
+                    line = f.readline()           # PUB <topic>\n
+                    assert line.startswith(b"PUB ")
+                    topic = line.split()[1].decode()
+                    n = int.from_bytes(f.read(4), "big")
+                    body = f.read(n)
+                    published.append((topic, body))
+                    data = b"OK"
+                    conn.sendall(
+                        (len(data) + 4).to_bytes(4, "big")
+                        + (0).to_bytes(4, "big") + data)
+                except Exception:
+                    pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    try:
+        t = NSQTarget("arn:minio:sqs::1:nsq", f"127.0.0.1:{port}",
+                      "minio-events")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "nq"))
+        deadline = time.monotonic() + 5
+        while not published and time.monotonic() < deadline:
+            time.sleep(0.01)
+        topic, payload = published[0]
+        assert topic == "minio-events"
+        assert json.loads(payload)["Records"][0]["s3"]["object"]["key"] \
+            == "nq"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# AMQP target: real 0-9-1 handshake + publish against a fake broker
+# ---------------------------------------------------------------------------
+
+class FakeAMQP:
+    """Speaks enough broker-side AMQP 0-9-1 to accept one publish."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.published: list[tuple[str, bytes]] = []
+        self.auth: list[bytes] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @staticmethod
+    def _frame(ftype, channel, payload):
+        return (bytes([ftype]) + channel.to_bytes(2, "big")
+                + len(payload).to_bytes(4, "big") + payload + b"\xce")
+
+    @classmethod
+    def _method(cls, channel, c, m, args=b""):
+        return cls._frame(1, channel, c.to_bytes(2, "big")
+                          + m.to_bytes(2, "big") + args)
+
+    @staticmethod
+    def _read_frame(f):
+        head = f.read(7)
+        if len(head) < 7:
+            return None, None, None
+        size = int.from_bytes(head[3:7], "big")
+        payload = f.read(size)
+        assert f.read(1) == b"\xce"
+        return head[0], int.from_bytes(head[1:3], "big"), payload
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    assert f.read(8) == b"AMQP\x00\x00\x09\x01"
+                    conn.sendall(self._method(
+                        0, 10, 10,
+                        b"\x00\x09" + (0).to_bytes(4, "big")
+                        + (5).to_bytes(4, "big") + b"PLAIN"
+                        + (5).to_bytes(4, "big") + b"en_US"))
+                    _t, _c, p = self._read_frame(f)   # Start-Ok
+                    self.auth.append(p)
+                    conn.sendall(self._method(
+                        0, 10, 30, (0).to_bytes(2, "big")
+                        + (131072).to_bytes(4, "big")
+                        + (0).to_bytes(2, "big")))
+                    self._read_frame(f)               # Tune-Ok
+                    self._read_frame(f)               # Open
+                    conn.sendall(self._method(0, 10, 41, b"\x00"))
+                    self._read_frame(f)               # Channel.Open
+                    conn.sendall(self._method(
+                        1, 20, 11, (0).to_bytes(4, "big")))
+                    _t, _c, pub = self._read_frame(f)  # Basic.Publish
+                    at = 6                     # cls+meth+reserved
+                    elen = pub[at]
+                    at += 1 + elen
+                    rlen = pub[at]
+                    rkey = pub[at + 1:at + 1 + rlen].decode()
+                    _t, _c, hdr = self._read_frame(f)  # content header
+                    body_size = int.from_bytes(hdr[4:12], "big")
+                    body = b""
+                    while len(body) < body_size:       # chunked frames
+                        _t, _c, piece = self._read_frame(f)
+                        body += piece
+                    self.published.append((rkey, body))
+                    self._read_frame(f)                # Connection.Close
+                    conn.sendall(self._method(0, 10, 51))  # Close-Ok
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_amqp_target_publish():
+    from minio_tpu.features.events import AMQPTarget
+    broker = FakeAMQP()
+    try:
+        t = AMQPTarget("arn:minio:sqs::1:amqp",
+                       f"127.0.0.1:{broker.port}",
+                       routing_key="minio.amqp", user="u1",
+                       password="p1")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "aq"))
+        deadline = time.monotonic() + 5
+        while not broker.published and time.monotonic() < deadline:
+            time.sleep(0.01)
+        rkey, body = broker.published[0]
+        assert rkey == "minio.amqp"
+        assert json.loads(body)["Records"][0]["s3"]["object"]["key"] \
+            == "aq"
+        # PLAIN credentials travelled in Start-Ok
+        assert b"\x00u1\x00p1" in broker.auth[0]
+    finally:
+        broker.close()
+
+
+def test_amqp_publish_refused_surfaces_error():
+    """A broker that answers with Channel.Close (unroutable exchange)
+    must make send() raise — fire-and-forget would delete the event
+    from the durable queue despite the loss (review r3)."""
+    from minio_tpu.features.events import AMQPTarget
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        with conn:
+            f = conn.makefile("rb")
+            f.read(8)
+            conn.sendall(FakeAMQP._method(
+                0, 10, 10, b"\x00\x09" + (0).to_bytes(4, "big")
+                + (5).to_bytes(4, "big") + b"PLAIN"
+                + (5).to_bytes(4, "big") + b"en_US"))
+            FakeAMQP._read_frame(f)               # Start-Ok
+            conn.sendall(FakeAMQP._method(
+                0, 10, 30, (0).to_bytes(2, "big")
+                + (4096).to_bytes(4, "big") + (0).to_bytes(2, "big")))
+            FakeAMQP._read_frame(f)               # Tune-Ok
+            FakeAMQP._read_frame(f)               # Open
+            conn.sendall(FakeAMQP._method(0, 10, 41, b"\x00"))
+            FakeAMQP._read_frame(f)               # Channel.Open
+            conn.sendall(FakeAMQP._method(
+                1, 20, 11, (0).to_bytes(4, "big")))
+            # drain publish + header + body frames, then refuse
+            while True:
+                t, _c, p = FakeAMQP._read_frame(f)
+                if t == 1 and p[:4] == (10).to_bytes(2, "big") \
+                        + (50).to_bytes(2, "big"):
+                    break
+            conn.sendall(FakeAMQP._method(
+                1, 20, 40, (404).to_bytes(2, "big")
+                + bytes([9]) + b"NOT_FOUND"
+                + (60).to_bytes(2, "big") + (40).to_bytes(2, "big")))
+
+    threading.Thread(target=serve, daemon=True).start()
+    t = AMQPTarget("a", f"127.0.0.1:{port}")
+    with pytest.raises(OSError, match="refused"):
+        t.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+    srv.close()
+
+
+def test_amqp_nsq_config_validation():
+    from minio_tpu.features.events import AMQPTarget, NSQTarget
+    with pytest.raises(ValueError):
+        NSQTarget("a", "h:4150", "bad topic")
+    with pytest.raises(ValueError):
+        NSQTarget("a", "h:4150", "")
+    with pytest.raises(ValueError):
+        AMQPTarget("a", "h:5672", routing_key="x" * 300)
+    with pytest.raises(ValueError):
+        AMQPTarget("a", "h:5672", exchange="e\nvil")

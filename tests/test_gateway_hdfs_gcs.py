@@ -282,3 +282,37 @@ def test_hdfs_put_etag_matches_head_and_streamed_get(hdfs_gw):
     chunks = list(stream)
     assert len(chunks) >= 2                   # 1 MiB chunking
     assert b"".join(chunks) == payload
+
+
+def test_hdfs_httpfs_direct_write(tmp_path):
+    """An HttpFS-style endpoint that accepts CREATE without redirecting
+    must still receive the payload (review r3: the two-step writer sent
+    no body on hop 0 and would have written an empty file)."""
+    class DirectWebHDFS(FakeWebHDFS):
+        def _dispatch(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            q = {k: v[0] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+            if self.command == "PUT" and \
+                    q.get("op", "").upper() == "CREATE":
+                path = urllib.parse.unquote(
+                    parsed.path[len("/webhdfs/v1"):])
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.fs[path] = self.rfile.read(n) if n else b""
+                return self._json({}, 201)      # no redirect
+            return super()._dispatch()
+
+    DirectWebHDFS.fs = {}
+    DirectWebHDFS.dirs = set()
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          DirectWebHDFS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        gw = new_gateway("hdfs", host="127.0.0.1",
+                         port=srv.server_address[1])
+        gw.make_bucket("hb")
+        gw.put_object("hb", "direct", b"payload-via-httpfs")
+        _i, stream = gw.get_object("hb", "direct")
+        assert b"".join(stream) == b"payload-via-httpfs"
+    finally:
+        srv.shutdown()
