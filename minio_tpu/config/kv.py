@@ -63,6 +63,10 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "notify_elasticsearch": {"enable": "off", "url": "",
                              "index": "minioevents",
                              "format": "namespace"},
+    "notify_postgres": {"enable": "off", "address": "",
+                        "database": "", "table": "minioevents",
+                        "user": "postgres", "password": "",
+                        "format": "namespace"},
 }
 
 
@@ -282,6 +286,7 @@ class ConfigSys:
     CONFIG_NATS_ARN = "arn:minio:sqs::_:nats"
     CONFIG_NSQ_ARN = "arn:minio:sqs::_:nsq"
     CONFIG_AMQP_ARN = "arn:minio:sqs::_:amqp"
+    CONFIG_POSTGRES_ARN = "arn:minio:sqs::_:postgresql"
     CONFIG_ELASTIC_ARN = "arn:minio:sqs::_:elasticsearch"
 
     def apply(self, api, events=None, trace=None) -> None:
@@ -387,6 +392,18 @@ class ConfigSys:
                     self.get("notify_nsq", "topic")))
             else:
                 events.unregister_target(self.CONFIG_NSQ_ARN)
+            from ..features.events import PostgresTarget
+            if _on("notify_postgres"):
+                _register(lambda: PostgresTarget(
+                    self.CONFIG_POSTGRES_ARN,
+                    self.get("notify_postgres", "address"),
+                    self.get("notify_postgres", "database"),
+                    self.get("notify_postgres", "table"),
+                    user=self.get("notify_postgres", "user"),
+                    password=self.get("notify_postgres", "password"),
+                    format=self.get("notify_postgres", "format")))
+            else:
+                events.unregister_target(self.CONFIG_POSTGRES_ARN)
             if _on("notify_elasticsearch"):
                 _register(lambda: ElasticsearchTarget(
                     self.CONFIG_ELASTIC_ARN,

@@ -9,17 +9,19 @@ queue directory, every matched event is persisted BEFORE dispatch and
 deleted only after the target accepts it — pending events survive a
 process restart (at-least-once).
 
-Targets: webhook (HTTP POST), redis (real RESP2 wire protocol —
-namespace HSET / access-log RPUSH like pkg/event/target/redis.go),
-mqtt (real MQTT 3.1.1 CONNECT/PUBLISH), kafka (produce logic behind a
-pluggable producer — the broker wire protocol needs a client lib this
-image doesn't ship), memory (tests / ListenNotification feed).
+Targets (all real wire protocols, offline-tested against in-process
+fakes): webhook (HTTP POST), redis (RESP2), mqtt (3.1.1), nats (text
+protocol), nsq (V2 TCP), amqp (0-9-1), postgres (v3 protocol),
+elasticsearch (document API), kafka (produce logic behind a pluggable
+producer — the broker binary protocol needs a client lib this image
+doesn't ship), memory (tests / ListenNotification feed).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import hashlib
 import json
 import os
 import queue
@@ -537,6 +539,134 @@ class NSQTarget:
                 data += chunk
             if frame_type == 1 or not data.startswith(b"OK"):
                 raise OSError(f"NSQ error: {data[:80]!r}")
+
+
+class PostgresTarget:
+    """Event delivery over the PostgreSQL v3 wire protocol
+    (pkg/event/target/postgresql.go): startup + cleartext/MD5 password
+    auth, then simple-query INSERTs. format="namespace" upserts one row
+    per object key (and deletes on removal events); format="access"
+    appends. The table must exist with (key TEXT PRIMARY KEY, value
+    TEXT) / (event TEXT) columns — same contract as the reference.
+    SCRAM auth is not implemented (use md5 or trust for this target).
+    """
+
+    def __init__(self, arn: str, addr: str, database: str, table: str,
+                 user: str = "postgres", password: str = "",
+                 format: str = "namespace", timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        import re as _re
+        if not _re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]{0,62}", table):
+            raise ValueError(
+                f"invalid Postgres table name {table!r}")
+        self.arn, self.addr = arn, addr
+        self.database, self.table = database, table
+        self.user, self.password = user, password
+        self.format = format
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 5432), timeout=self.timeout)
+
+    # -- wire plumbing -----------------------------------------------------
+
+    @staticmethod
+    def _msg(tag: bytes, payload: bytes) -> bytes:
+        return tag + (len(payload) + 4).to_bytes(4, "big") + payload
+
+    @staticmethod
+    def _read_msg(f) -> tuple[bytes, bytes]:
+        tag = f.read(1)
+        if not tag:
+            raise OSError("postgres connection closed")
+        size = int.from_bytes(f.read(4), "big")
+        return tag, f.read(size - 4)
+
+    def _auth(self, s, f) -> None:
+        params = (b"user\x00" + self.user.encode() + b"\x00"
+                  b"database\x00" + self.database.encode() + b"\x00\x00")
+        s.sendall((len(params) + 8).to_bytes(4, "big")
+                  + (196608).to_bytes(4, "big") + params)  # proto 3.0
+        while True:
+            tag, payload = self._read_msg(f)
+            if tag == b"E":
+                raise OSError(f"postgres error: {payload[:120]!r}")
+            if tag != b"R":
+                continue
+            code = int.from_bytes(payload[:4], "big")
+            if code == 0:                       # AuthenticationOk
+                break
+            if code == 3:                       # cleartext password
+                s.sendall(self._msg(
+                    b"p", self.password.encode() + b"\x00"))
+            elif code == 5:                     # md5 password
+                salt = payload[4:8]
+                inner = hashlib.md5(
+                    self.password.encode()
+                    + self.user.encode()).hexdigest()
+                digest = hashlib.md5(
+                    inner.encode() + salt).hexdigest()
+                s.sendall(self._msg(
+                    b"p", b"md5" + digest.encode() + b"\x00"))
+            else:
+                raise OSError(
+                    f"unsupported postgres auth method {code} "
+                    "(scram not implemented; use md5 or trust)")
+        # drain ParameterStatus/BackendKeyData until ReadyForQuery
+        while True:
+            tag, payload = self._read_msg(f)
+            if tag == b"Z":
+                return
+            if tag == b"E":
+                raise OSError(f"postgres error: {payload[:120]!r}")
+
+    def _query(self, s, f, sql: str) -> None:
+        s.sendall(self._msg(b"Q", sql.encode() + b"\x00"))
+        err = None
+        while True:
+            tag, payload = self._read_msg(f)
+            if tag == b"E":
+                err = payload[:200]
+            if tag == b"Z":
+                break
+        if err is not None:
+            raise OSError(f"postgres query failed: {err!r}")
+
+    @staticmethod
+    def _lit(s: str) -> str:
+        """SQL string literal with quotes doubled (simple-query
+        protocol has no parameter binding)."""
+        return "'" + s.replace("'", "''") + "'"
+
+    def send(self, record: dict) -> None:
+        rec = record["Records"][0]
+        obj_key = (rec["s3"]["bucket"]["name"] + "/"
+                   + rec["s3"]["object"]["key"])
+        payload = json.dumps(record)
+        if self.format == "access":
+            sql = (f"INSERT INTO {self.table} (event) VALUES "
+                   f"({self._lit(payload)})")
+        elif rec["eventName"].startswith("s3:ObjectRemoved"):
+            sql = (f"DELETE FROM {self.table} WHERE key = "
+                   f"{self._lit(obj_key)}")
+        else:
+            sql = (f"INSERT INTO {self.table} (key, value) VALUES "
+                   f"({self._lit(obj_key)}, {self._lit(payload)}) "
+                   f"ON CONFLICT (key) DO UPDATE SET value = "
+                   f"EXCLUDED.value")
+        with self._connect() as s:
+            f = s.makefile("rb")
+            self._auth(s, f)
+            # quote-doubling literals are only injection-safe with
+            # standard conforming strings (a legacy server with the
+            # setting off treats backslash as an escape, letting an
+            # object key ending in '\' swallow the closing quote)
+            self._query(s, f, "SET standard_conforming_strings = on")
+            self._query(s, f, sql)
+            s.sendall(self._msg(b"X", b""))     # Terminate
 
 
 class ElasticsearchTarget:

@@ -727,3 +727,122 @@ def test_amqp_nsq_config_validation():
         AMQPTarget("a", "h:5672", routing_key="x" * 300)
     with pytest.raises(ValueError):
         AMQPTarget("a", "h:5672", exchange="e\nvil")
+
+
+# ---------------------------------------------------------------------------
+# Postgres target: real v3 wire protocol against a fake server
+# ---------------------------------------------------------------------------
+
+class FakePostgres:
+    """Speaks enough server-side pg v3: startup, md5 auth challenge,
+    simple-query with OK/error replies."""
+
+    def __init__(self, password: str = ""):
+        self.password = password
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.queries: list[str] = []
+        self.fail_next: bool = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @staticmethod
+    def _msg(tag: bytes, payload: bytes = b"") -> bytes:
+        return tag + (len(payload) + 4).to_bytes(4, "big") + payload
+
+    def _serve(self):
+        import hashlib as hl
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    size = int.from_bytes(f.read(4), "big")
+                    startup = f.read(size - 4)
+                    params = startup[4:].split(b"\x00")
+                    user = params[params.index(b"user") + 1].decode()
+                    if self.password:
+                        salt = b"SALT"
+                        conn.sendall(self._msg(
+                            b"R", (5).to_bytes(4, "big") + salt))
+                        tag = f.read(1)
+                        n = int.from_bytes(f.read(4), "big")
+                        pw = f.read(n - 4).rstrip(b"\x00")
+                        inner = hl.md5(self.password.encode()
+                                       + user.encode()).hexdigest()
+                        want = b"md5" + hl.md5(
+                            inner.encode() + salt).hexdigest().encode()
+                        if tag != b"p" or pw != want:
+                            conn.sendall(self._msg(
+                                b"E", b"SFATAL\x00Mbad password\x00\x00"))
+                            continue
+                    conn.sendall(self._msg(b"R", (0).to_bytes(4, "big")))
+                    conn.sendall(self._msg(b"Z", b"I"))
+                    while True:
+                        tag = f.read(1)
+                        if not tag or tag == b"X":
+                            break
+                        n = int.from_bytes(f.read(4), "big")
+                        payload = f.read(n - 4)
+                        if tag != b"Q":
+                            continue
+                        sql = payload.rstrip(b"\x00").decode()
+                        self.queries.append(sql)
+                        if self.fail_next:
+                            self.fail_next = False
+                            conn.sendall(self._msg(
+                                b"E", b"SERROR\x00Mno such table\x00\x00"))
+                        else:
+                            conn.sendall(self._msg(b"C", b"INSERT 0 1\x00"))
+                        conn.sendall(self._msg(b"Z", b"I"))
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_postgres_target_md5_auth_and_formats():
+    from minio_tpu.features.events import PostgresTarget
+    srv = FakePostgres(password="pgpass")
+    try:
+        t = PostgresTarget("arn:minio:sqs::1:postgresql",
+                           f"127.0.0.1:{srv.port}", "minio", "events",
+                           user="minio", password="pgpass")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "x'y"))
+        t.send(event_record("s3:ObjectRemoved:Delete", "b", "x'y"))
+        acc = PostgresTarget("a2", f"127.0.0.1:{srv.port}", "minio",
+                             "log", user="minio", password="pgpass",
+                             format="access")
+        acc.send(event_record("s3:ObjectCreated:Put", "b", "z"))
+        # every connection pins standard_conforming_strings before
+        # its statement (quote-doubled literals are only safe then)
+        sets = [q for q in srv.queries
+                if q == "SET standard_conforming_strings = on"]
+        stmts = [q for q in srv.queries
+                 if not q.startswith("SET ")]
+        assert len(sets) == 3
+        assert stmts[0].startswith(
+            "INSERT INTO events (key, value) VALUES ('b/x''y'")
+        assert "ON CONFLICT" in stmts[0]
+        assert stmts[1] == "DELETE FROM events WHERE key = 'b/x''y'"
+        assert stmts[2].startswith("INSERT INTO log (event)")
+
+        # SQL errors surface (durable queue must retry, not ack)
+        srv.fail_next = True
+        with pytest.raises(OSError, match="query failed"):
+            t.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+        # wrong password -> auth error
+        bad = PostgresTarget("a3", f"127.0.0.1:{srv.port}", "minio",
+                             "events", user="minio", password="wrong")
+        with pytest.raises(OSError, match="postgres error"):
+            bad.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+        # injection-shaped table names rejected at config time
+        with pytest.raises(ValueError):
+            PostgresTarget("a4", "h:5432", "db", "evil; DROP TABLE x")
+    finally:
+        srv.close()
