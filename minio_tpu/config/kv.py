@@ -67,6 +67,10 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
                         "database": "", "table": "minioevents",
                         "user": "postgres", "password": "",
                         "format": "namespace"},
+    "notify_mysql": {"enable": "off", "address": "",
+                     "database": "", "table": "minioevents",
+                     "user": "root", "password": "",
+                     "format": "namespace"},
 }
 
 
@@ -287,6 +291,7 @@ class ConfigSys:
     CONFIG_NSQ_ARN = "arn:minio:sqs::_:nsq"
     CONFIG_AMQP_ARN = "arn:minio:sqs::_:amqp"
     CONFIG_POSTGRES_ARN = "arn:minio:sqs::_:postgresql"
+    CONFIG_MYSQL_ARN = "arn:minio:sqs::_:mysql"
     CONFIG_ELASTIC_ARN = "arn:minio:sqs::_:elasticsearch"
 
     def apply(self, api, events=None, trace=None) -> None:
@@ -392,7 +397,18 @@ class ConfigSys:
                     self.get("notify_nsq", "topic")))
             else:
                 events.unregister_target(self.CONFIG_NSQ_ARN)
-            from ..features.events import PostgresTarget
+            from ..features.events import MySQLTarget, PostgresTarget
+            if _on("notify_mysql"):
+                _register(lambda: MySQLTarget(
+                    self.CONFIG_MYSQL_ARN,
+                    self.get("notify_mysql", "address"),
+                    self.get("notify_mysql", "database"),
+                    self.get("notify_mysql", "table"),
+                    user=self.get("notify_mysql", "user"),
+                    password=self.get("notify_mysql", "password"),
+                    format=self.get("notify_mysql", "format")))
+            else:
+                events.unregister_target(self.CONFIG_MYSQL_ARN)
             if _on("notify_postgres"):
                 _register(lambda: PostgresTarget(
                     self.CONFIG_POSTGRES_ARN,

@@ -11,10 +11,11 @@ process restart (at-least-once).
 
 Targets (all real wire protocols, offline-tested against in-process
 fakes): webhook (HTTP POST), redis (RESP2), mqtt (3.1.1), nats (text
-protocol), nsq (V2 TCP), amqp (0-9-1), postgres (v3 protocol),
-elasticsearch (document API), kafka (produce logic behind a pluggable
-producer — the broker binary protocol needs a client lib this image
-doesn't ship), memory (tests / ListenNotification feed).
+protocol), nsq (V2 TCP), amqp (0-9-1), postgres (v3 protocol), mysql
+(handshake v10 + native-password auth), elasticsearch (document API),
+kafka (produce logic behind a pluggable producer — the broker binary
+protocol needs a client lib this image doesn't ship), memory (tests /
+ListenNotification feed).
 """
 
 from __future__ import annotations
@@ -546,9 +547,9 @@ class PostgresTarget:
     (pkg/event/target/postgresql.go): startup + cleartext/MD5 password
     auth, then simple-query INSERTs. format="namespace" upserts one row
     per object key (and deletes on removal events); format="access"
-    appends. The table must exist with (key TEXT PRIMARY KEY, value
-    TEXT) / (event TEXT) columns — same contract as the reference.
-    SCRAM auth is not implemented (use md5 or trust for this target).
+    appends. Reference table contract: namespace = (key TEXT PRIMARY
+    KEY, value TEXT/JSONB), access = (event_time TIMESTAMP, event_data
+    TEXT/JSONB). SCRAM auth is not implemented (use md5 or trust).
     """
 
     def __init__(self, arn: str, addr: str, database: str, table: str,
@@ -586,8 +587,13 @@ class PostgresTarget:
         return tag, f.read(size - 4)
 
     def _auth(self, s, f) -> None:
+        # standard_conforming_strings rides the StartupMessage options
+        # (quote-doubled literals are only injection-safe with it on;
+        # pinning here costs no extra round trip)
         params = (b"user\x00" + self.user.encode() + b"\x00"
-                  b"database\x00" + self.database.encode() + b"\x00\x00")
+                  b"database\x00" + self.database.encode() + b"\x00"
+                  b"options\x00-c standard_conforming_strings=on\x00"
+                  b"\x00")
         s.sendall((len(params) + 8).to_bytes(4, "big")
                   + (196608).to_bytes(4, "big") + params)  # proto 3.0
         while True:
@@ -647,8 +653,9 @@ class PostgresTarget:
                    + rec["s3"]["object"]["key"])
         payload = json.dumps(record)
         if self.format == "access":
-            sql = (f"INSERT INTO {self.table} (event) VALUES "
-                   f"({self._lit(payload)})")
+            # reference access schema: (event_time, event_data)
+            sql = (f"INSERT INTO {self.table} (event_time, event_data)"
+                   f" VALUES (now(), {self._lit(payload)})")
         elif rec["eventName"].startswith("s3:ObjectRemoved"):
             sql = (f"DELETE FROM {self.table} WHERE key = "
                    f"{self._lit(obj_key)}")
@@ -660,13 +667,142 @@ class PostgresTarget:
         with self._connect() as s:
             f = s.makefile("rb")
             self._auth(s, f)
-            # quote-doubling literals are only injection-safe with
-            # standard conforming strings (a legacy server with the
-            # setting off treats backslash as an escape, letting an
-            # object key ending in '\' swallow the closing quote)
-            self._query(s, f, "SET standard_conforming_strings = on")
             self._query(s, f, sql)
             s.sendall(self._msg(b"X", b""))     # Terminate
+
+
+class MySQLTarget:
+    """Event delivery over the MySQL client/server protocol
+    (pkg/event/target/mysql.go): handshake v10 with
+    mysql_native_password auth (SHA1(pw) XOR SHA1(salt+SHA1(SHA1(pw)))),
+    then COM_QUERY statements. Same table contract and formats as the
+    Postgres target. caching_sha2_password is not implemented — create
+    the notify user WITH mysql_native_password."""
+
+    CLIENT_LONG_PASSWORD = 0x1
+    CLIENT_CONNECT_WITH_DB = 0x8
+    CLIENT_PROTOCOL_41 = 0x200
+    CLIENT_SECURE_CONNECTION = 0x8000
+    CLIENT_PLUGIN_AUTH = 0x80000
+
+    def __init__(self, arn: str, addr: str, database: str, table: str,
+                 user: str = "root", password: str = "",
+                 format: str = "namespace", timeout: float = 5.0,
+                 connect: Optional[Callable[[], socket.socket]] = None):
+        import re as _re
+        if not _re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]{0,63}", table):
+            raise ValueError(f"invalid MySQL table name {table!r}")
+        if database and not _re.fullmatch(
+                r"[A-Za-z0-9_$-]{1,64}", database):
+            raise ValueError(f"invalid MySQL database name {database!r}")
+        self.arn, self.addr = arn, addr
+        self.database, self.table = database, table
+        self.user, self.password = user, password
+        self.format = format
+        self.timeout = timeout
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self) -> socket.socket:
+        from ..utils import host_port
+        return socket.create_connection(
+            host_port(self.addr, 3306), timeout=self.timeout)
+
+    # -- packet plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _read_packet(f) -> tuple[int, bytes]:
+        head = f.read(4)
+        if len(head) < 4:
+            raise OSError("mysql connection closed")
+        size = int.from_bytes(head[:3], "little")
+        return head[3], f.read(size)
+
+    @staticmethod
+    def _packet(seq: int, payload: bytes) -> bytes:
+        return (len(payload).to_bytes(3, "little") + bytes([seq])
+                + payload)
+
+    def _scramble(self, salt: bytes) -> bytes:
+        if not self.password:
+            return b""
+        h1 = hashlib.sha1(self.password.encode()).digest()
+        h2 = hashlib.sha1(h1).digest()
+        h3 = hashlib.sha1(salt + h2).digest()
+        return bytes(a ^ b for a, b in zip(h1, h3))
+
+    @staticmethod
+    def _check_ok(payload: bytes, what: str) -> None:
+        if payload[:1] == b"\xff":
+            code = int.from_bytes(payload[1:3], "little")
+            raise OSError(f"mysql {what} failed ({code}): "
+                          f"{payload[9:120]!r}")
+
+    def send(self, record: dict) -> None:
+        rec = record["Records"][0]
+        obj_key = (rec["s3"]["bucket"]["name"] + "/"
+                   + rec["s3"]["object"]["key"])
+        payload = json.dumps(record)
+
+        def lit(s: str) -> str:
+            # quote-doubling only: the connection pins
+            # NO_BACKSLASH_ESCAPES, making backslashes literal in every
+            # deployment (mirrors the Postgres target's
+            # standard_conforming_strings pin)
+            return "'" + s.replace("'", "''") + "'"
+
+        if self.format == "access":
+            # reference access schema: (event_time, event_data)
+            sql = (f"INSERT INTO {self.table} (event_time, event_data)"
+                   f" VALUES (NOW(), {lit(payload)})")
+        elif rec["eventName"].startswith("s3:ObjectRemoved"):
+            sql = (f"DELETE FROM {self.table} WHERE `key` = "
+                   f"{lit(obj_key)}")
+        else:
+            sql = (f"REPLACE INTO {self.table} (`key`, value) VALUES "
+                   f"({lit(obj_key)}, {lit(payload)})")
+
+        with self._connect() as s:
+            f = s.makefile("rb")
+            _seq, greet = self._read_packet(f)
+            self._check_ok(greet, "handshake")
+            if greet[:1] != b"\x0a":
+                raise OSError("unsupported mysql protocol version")
+            at = greet.index(b"\x00", 1) + 1    # server version string
+            at += 4                             # thread id
+            salt = greet[at:at + 8]
+            at += 8 + 1                         # salt part 1 + filler
+            at += 2 + 1 + 2 + 2 + 1 + 10        # caps, charset, status…
+            salt += greet[at:at + 12]           # salt part 2 (of 13-1)
+            caps = (self.CLIENT_LONG_PASSWORD | self.CLIENT_PROTOCOL_41
+                    | self.CLIENT_SECURE_CONNECTION
+                    | self.CLIENT_PLUGIN_AUTH)
+            if self.database:
+                caps |= self.CLIENT_CONNECT_WITH_DB
+            token = self._scramble(salt)
+            resp = (caps.to_bytes(4, "little")
+                    + (1 << 24).to_bytes(4, "little")   # max packet
+                    + bytes([33]) + bytes(23)           # utf8 + filler
+                    + self.user.encode() + b"\x00"
+                    + bytes([len(token)]) + token)
+            if self.database:
+                # selected in the handshake (CLIENT_CONNECT_WITH_DB):
+                # no per-event USE round trip, no identifier splicing
+                resp += self.database.encode() + b"\x00"
+            resp += b"mysql_native_password\x00"
+            s.sendall(self._packet(1, resp))
+            _seq, auth = self._read_packet(f)
+            self._check_ok(auth, "auth")
+            if auth[:1] == b"\xfe":
+                raise OSError(
+                    "mysql requested an auth method switch "
+                    "(caching_sha2_password?); create the notify user "
+                    "WITH mysql_native_password")
+            for stmt in ("SET SESSION sql_mode = "
+                         "'NO_BACKSLASH_ESCAPES'", sql):
+                s.sendall(self._packet(0, b"\x03" + stmt.encode()))
+                _seq, reply = self._read_packet(f)
+                self._check_ok(reply, "query")
+            s.sendall(self._packet(0, b"\x01"))     # COM_QUIT
 
 
 class ElasticsearchTarget:

@@ -819,18 +819,13 @@ def test_postgres_target_md5_auth_and_formats():
                              "log", user="minio", password="pgpass",
                              format="access")
         acc.send(event_record("s3:ObjectCreated:Put", "b", "z"))
-        # every connection pins standard_conforming_strings before
-        # its statement (quote-doubled literals are only safe then)
-        sets = [q for q in srv.queries
-                if q == "SET standard_conforming_strings = on"]
-        stmts = [q for q in srv.queries
-                 if not q.startswith("SET ")]
-        assert len(sets) == 3
+        stmts = srv.queries
         assert stmts[0].startswith(
             "INSERT INTO events (key, value) VALUES ('b/x''y'")
         assert "ON CONFLICT" in stmts[0]
         assert stmts[1] == "DELETE FROM events WHERE key = 'b/x''y'"
-        assert stmts[2].startswith("INSERT INTO log (event)")
+        assert stmts[2].startswith(
+            "INSERT INTO log (event_time, event_data) VALUES (now()")
 
         # SQL errors surface (durable queue must retry, not ack)
         srv.fail_next = True
@@ -844,5 +839,118 @@ def test_postgres_target_md5_auth_and_formats():
         # injection-shaped table names rejected at config time
         with pytest.raises(ValueError):
             PostgresTarget("a4", "h:5432", "db", "evil; DROP TABLE x")
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# MySQL target: handshake v10 + native-password auth + COM_QUERY
+# ---------------------------------------------------------------------------
+
+class FakeMySQL:
+    def __init__(self, password: str = ""):
+        self.password = password
+        self.salt = b"abcdefgh" + b"ijklmnopqrst"   # 8 + 12 bytes
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.queries: list[str] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @staticmethod
+    def _packet(seq, payload):
+        return (len(payload).to_bytes(3, "little") + bytes([seq])
+                + payload)
+
+    @staticmethod
+    def _read(f):
+        head = f.read(4)
+        if len(head) < 4:
+            return None
+        return f.read(int.from_bytes(head[:3], "little"))
+
+    def _expected_token(self, user):
+        import hashlib as hl
+        if not self.password:
+            return b""
+        h1 = hl.sha1(self.password.encode()).digest()
+        h2 = hl.sha1(h1).digest()
+        h3 = hl.sha1(self.salt + h2).digest()
+        return bytes(a ^ b for a, b in zip(h1, h3))
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    f = conn.makefile("rb")
+                    greet = (b"\x0a" + b"8.0.0-fake\x00"
+                             + (7).to_bytes(4, "little")
+                             + self.salt[:8] + b"\x00"
+                             + (0xffff).to_bytes(2, "little")
+                             + bytes([33])
+                             + (2).to_bytes(2, "little")
+                             + (0x8000 >> 16).to_bytes(2, "little")
+                             + bytes([21]) + bytes(10)
+                             + self.salt[8:] + b"\x00"
+                             + b"mysql_native_password\x00")
+                    conn.sendall(self._packet(0, greet))
+                    resp = self._read(f)
+                    user_end = resp.index(b"\x00", 32)
+                    user = resp[32:user_end].decode()
+                    tlen = resp[user_end + 1]
+                    token = resp[user_end + 2:user_end + 2 + tlen]
+                    if token != self._expected_token(user):
+                        conn.sendall(self._packet(
+                            2, b"\xff" + (1045).to_bytes(2, "little")
+                            + b"#28000" + b"Access denied"))
+                        continue
+                    conn.sendall(self._packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))
+                    while True:
+                        cmd = self._read(f)
+                        if cmd is None or cmd[:1] == b"\x01":
+                            break
+                        if cmd[:1] == b"\x03":
+                            self.queries.append(cmd[1:].decode())
+                            conn.sendall(self._packet(
+                                1, b"\x00\x01\x00\x02\x00\x00\x00"))
+                except Exception:
+                    pass
+
+    def close(self):
+        self.sock.close()
+
+
+def test_mysql_target_auth_and_formats():
+    from minio_tpu.features.events import MySQLTarget
+    srv = FakeMySQL(password="mypass")
+    try:
+        t = MySQLTarget("arn:minio:sqs::1:mysql",
+                        f"127.0.0.1:{srv.port}", "minio", "events",
+                        user="minio", password="mypass")
+        t.send(event_record("s3:ObjectCreated:Put", "b", "m'y\\k"))
+        t.send(event_record("s3:ObjectRemoved:Delete", "b", "m'y\\k"))
+        sets = [q for q in srv.queries if q.startswith("SET SESSION")]
+        stmts = [q for q in srv.queries if not q.startswith("SET ")]
+        assert len(sets) == 2      # sql_mode pinned per connection
+        assert not any(q.startswith("USE ") for q in srv.queries)
+        # NO_BACKSLASH_ESCAPES pinned => quote doubling only, the
+        # backslash in the key stays single
+        assert stmts[0].startswith(
+            "REPLACE INTO events (`key`, value) VALUES "
+            "('b/m''y\\k'")
+        assert stmts[1] == "DELETE FROM events WHERE `key` = " \
+            "'b/m''y\\k'"
+
+        bad = MySQLTarget("a2", f"127.0.0.1:{srv.port}", "minio",
+                          "events", user="minio", password="wrong")
+        with pytest.raises(OSError, match="auth failed"):
+            bad.send(event_record("s3:ObjectCreated:Put", "b", "k"))
+        with pytest.raises(ValueError):
+            MySQLTarget("a3", "h:3306", "db", "bad table")
     finally:
         srv.close()
