@@ -107,11 +107,35 @@ class QueueStore:
     so whatever is on disk at startup is exactly the undelivered
     backlog."""
 
-    def __init__(self, directory: str, limit: int = 10000):
+    def __init__(self, directory: str, limit: int = 10000,
+                 fsync: Optional[bool] = None):
         self.dir = directory
         self.limit = limit
+        if fsync is None:
+            fsync = os.environ.get("MINIO_TPU_QUEUE_FSYNC", "").lower() \
+                in ("1", "on", "true")
+        self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
         self._mu = threading.Lock()
+        # Crash mid-put leaves '.tmp-*' files that keys() skips — sweep
+        # them so they can't accumulate invisibly forever. Only stale
+        # ones: a fresh store may be constructed over a directory an
+        # older store object is actively put()ing into (admin config
+        # re-apply), and an unconditional sweep would delete the
+        # in-flight tmp file between json.dump and os.replace.
+        now = time.time()
+        try:
+            for name in os.listdir(directory):
+                if not name.startswith(".tmp-"):
+                    continue
+                p = os.path.join(directory, name)
+                try:
+                    if now - os.path.getmtime(p) > 60.0:
+                        os.remove(p)
+                except OSError:
+                    pass
+        except OSError:
+            pass
         # O(1) limit enforcement: count once at startup, maintain on
         # put/delete (a per-put listdir is O(n^2) as backlog grows)
         self._count = len(self.keys())
@@ -126,7 +150,23 @@ class QueueStore:
             tmp = os.path.join(self.dir, f".tmp-{key}")
             with open(tmp, "w") as f:
                 json.dump(record, f)
+                if self.fsync:
+                    # opt-in (MINIO_TPU_QUEUE_FSYNC=on): survives power
+                    # loss, but an fsync per event on the request
+                    # thread serializes the PUT hot path; process-crash
+                    # durability already holds via atomic rename +
+                    # redrive.
+                    f.flush()
+                    os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.dir, key))
+            if self.fsync:
+                # the rename itself is only durable once the directory
+                # metadata is flushed
+                dfd = os.open(self.dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
             self._count += 1
             return key
 
@@ -1066,7 +1106,14 @@ class EventNotifier:
                     raise OSError(f"no target registered for {arn}")
                 target.send(record)
                 if store_key is not None:
-                    self._stores[arn].delete(store_key)
+                    # The target may have been unregistered while the
+                    # send was in flight (admin config re-apply); only
+                    # delete from a still-mounted store — a KeyError
+                    # here would land in the retry path and re-send the
+                    # already-delivered event.
+                    store = self._stores.get(arn)
+                    if store is not None:
+                        store.delete(store_key)
                     with self._mu:
                         self._inflight.discard((arn, store_key))
             except Exception:  # noqa: BLE001 — retry with backoff

@@ -22,6 +22,7 @@ import json
 import secrets
 import threading
 import time
+import urllib.parse
 from typing import Callable, Optional
 
 from ..s3.credentials import Credentials, generate_credentials
@@ -67,6 +68,11 @@ class IAMSys:
     # ------------------------------------------------------------------
 
     def _path(self, *parts: str) -> str:
+        # The entity name (last part) may be a federated subject like
+        # 'oidc:tenant/user' — percent-encode it so distinct subjects
+        # can never collide on disk ('a/b' vs 'a_b') and the stored
+        # name decodes back to the exact subject on load.
+        parts = parts[:-1] + (urllib.parse.quote(parts[-1], safe=""),)
         return "/".join((IAM_PREFIX,) + parts) + ".json"
 
     def _save(self, path: str, payload: dict) -> None:
@@ -100,7 +106,8 @@ class IAMSys:
         for oi in objs:
             if not oi.name.endswith(".json"):
                 continue
-            name = oi.name[len(f"{IAM_PREFIX}/{prefix}/"):-len(".json")]
+            name = urllib.parse.unquote(
+                oi.name[len(f"{IAM_PREFIX}/{prefix}/"):-len(".json")])
             try:
                 _, stream = self.obj.get_object(MINIO_META_BUCKET, oi.name)
                 out[name] = json.loads(b"".join(stream).decode())
@@ -343,8 +350,7 @@ class IAMSys:
         if policy_names is not None:
             with self._mu:
                 self.user_policy[subject] = list(policy_names)
-                self._save(self._path("policydb/users",
-                                      subject.replace("/", "_")),
+                self._save(self._path("policydb/users", subject),
                            {"policy": list(policy_names)})
         self._notify()
         return cred

@@ -420,7 +420,8 @@ class FSObjects:
                        "actual_size": reader.actual_size
                        if reader.actual_size >= 0 else total}, f)
         return ObjectPartInfo(number=part_number, etag=etag, size=total,
-                              actual_size=total)
+                              actual_size=reader.actual_size
+                              if reader.actual_size >= 0 else total)
 
     def list_object_parts(self, bucket: str, key: str, upload_id: str,
                           part_marker: int = 0, max_parts: int = 1000

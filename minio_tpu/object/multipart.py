@@ -197,7 +197,12 @@ class MultipartMixin(ErasureObjects):
             meta.write_unique_file_info(
                 self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
                 write_quorum)
-            return PartInfo(part_number, etag, total, total, now())
+            # actual_size = client (plaintext) bytes; total = stored
+            # bytes (ciphertext under SSE) — keep the returned PartInfo
+            # consistent with the session journal entry above
+            return PartInfo(part_number, etag, total,
+                            reader.actual_size
+                            if reader.actual_size >= 0 else total, now())
 
     def list_object_parts(self, bucket: str, object_name: str,
                           upload_id: str, part_marker: int = 0,

@@ -1088,8 +1088,12 @@ class S3ApiHandlers:
     def put_object(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:PutObject", bucket, key)
         self.obj.get_bucket_info(bucket)
-        self._enforce_quota(bucket, max(ctx.content_length, 0))
+        # _put_reader resolves the true payload size (including
+        # x-amz-decoded-content-length for aws-chunked streams, where
+        # Content-Length covers the chunk framing) — quota must gate on
+        # that, or chunked PUTs bypass it entirely.
         reader, size = self._put_reader(ctx)
+        self._enforce_quota(bucket, size)
         metadata = _extract_metadata(ctx)
         if ctx.header("x-amz-tagging"):
             metadata["X-Amz-Tagging"] = ctx.header("x-amz-tagging")
@@ -1108,7 +1112,11 @@ class S3ApiHandlers:
             PutOptions(metadata=metadata, versioned=versioned,
                        parity=self._parity_for(
                            ctx.header("x-amz-storage-class"))))
-        self.bandwidth.record(bucket, "rx", max(ctx.content_length, 0))
+        # Count the client bytes actually received: `size` is the
+        # resolved payload length (decoded length for aws-chunked
+        # streams), unlike Content-Length (framing included) or
+        # info.size (post-compression/SSE stored size).
+        self.bandwidth.record(bucket, "rx", max(size, 0))
         headers = {"ETag": f'"{info.etag}"', **sse_headers}
         if info.version_id and info.version_id != "null":
             headers["x-amz-version-id"] = info.version_id
@@ -1594,6 +1602,10 @@ class S3ApiHandlers:
         reader, size = self._put_reader(ctx)
         if size > MAX_PART_SIZE:
             raise S3Error("EntityTooLarge")
+        # multipart must not bypass bucket quota (the reference
+        # enforces in PutObjectPart too); size is the resolved
+        # plaintext length, aws-chunked included
+        self._enforce_quota(bucket, size)
         # SSE upload: encrypt the part under the session's object key
         from ..features import crypto as sse
         md = self._multipart_meta(bucket, key, upload_id)
@@ -1607,8 +1619,10 @@ class S3ApiHandlers:
         part = self.obj.put_object_part(bucket, key, upload_id,
                                         part_number, reader, size)
         # multipart is the standard large-upload path — its ingress
-        # must count toward the bucket's bandwidth like single PUTs
-        self.bandwidth.record(bucket, "rx", max(ctx.content_length, 0))
+        # must count toward the bucket's bandwidth like single PUTs;
+        # actual_size is the client (plaintext) byte count even when
+        # the part was SSE-wrapped above (size would be ciphertext)
+        self.bandwidth.record(bucket, "rx", max(part.actual_size, 0))
         return HTTPResponse(headers={"ETag": f'"{part.etag}"'})
 
     def copy_object_part(self, ctx, bucket, key) -> HTTPResponse:

@@ -89,14 +89,17 @@ class AzureBlobClient:
         hdrs.setdefault("x-ms-version", API_VERSION)
         hdrs["content-length"] = str(len(body))
         hdrs["host"] = f"{self.host}:{self.port}"
+        # Sign the percent-encoded path: Azure canonicalizes the escaped
+        # URI path (the official SDKs sign EscapedPath), so the string
+        # signed must be byte-identical to the one on the request line.
+        enc_path = urllib.parse.quote(path)
         sig = shared_key_signature(self.account, self.key_b64, method,
-                                   path, query, hdrs)
+                                   enc_path, query, hdrs)
         hdrs["authorization"] = f"SharedKey {self.account}:{sig}"
         qs = urllib.parse.urlencode(query)
         conn = self._connect()
-        conn.request(method, urllib.parse.quote(path)
-                     + (f"?{qs}" if qs else ""), body=body,
-                     headers=hdrs)
+        conn.request(method, enc_path + (f"?{qs}" if qs else ""),
+                     body=body, headers=hdrs)
         resp = conn.getresponse()
         if resp.status >= 300:
             data = resp.read()

@@ -31,3 +31,10 @@ try:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "native: exercises the C++ library under ASan/UBSan "
+        "(make -C native sanitize; run with `pytest -m native`)")

@@ -74,7 +74,10 @@ class FakeAzureBlob(http.server.BaseHTTPRequestHandler):
         query = {k: v[0] for k, v in
                  urllib.parse.parse_qs(parsed.query,
                                        keep_blank_values=True).items()}
-        if not self._check_sig(path, query):
+        # Real Azure canonicalizes the *escaped* request path, so the
+        # fake verifies the signature over the raw (still-encoded)
+        # request-line path — a client signing the unencoded path fails.
+        if not self._check_sig(parsed.path, query):
             return self._fail(403, "AuthenticationFailed")
         n = int(self.headers.get("Content-Length", 0) or 0)
         body = self.rfile.read(n) if n else b""
@@ -318,6 +321,22 @@ def test_azure_multipart_block_commit(gw, azure_server):
     with pytest.raises(api_errors.InvalidPart):
         gw.complete_multipart_upload("cont", "bad", uid2,
                                      [CompletePart(1, "wrong")])
+
+
+def test_azure_special_char_names_sign_encoded_path(gw):
+    """Advisor r3 (medium): SharedKey must sign the percent-encoded
+    request path. Names that quote() rewrites (space, '#', unicode,
+    '+') only authenticate when client and service canonicalize the
+    same escaped string — the fake verifies over the raw request-line
+    path, so signing the unencoded path would 403 here."""
+    gw.make_bucket("cont")
+    for key in ("dir with space/a b", "hash#frag", "uni-ü-ß",
+                "plus+sign"):
+        gw.put_object("cont", key, key.encode())
+        _i, stream = gw.get_object("cont", key)
+        assert b"".join(stream) == key.encode()
+        assert gw.get_object_info("cont", key).size == len(key.encode())
+        gw.delete_object("cont", key)
 
 
 def test_azure_bad_signature_rejected(azure_server):

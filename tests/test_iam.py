@@ -254,6 +254,21 @@ def test_iamsys_persistence_roundtrip(object_layer):
     assert iam2.get_credentials("frank") is None
 
 
+def test_federated_subject_policy_files_never_collide(object_layer):
+    """Advisor r3: 'oidc:a/b' and 'oidc:a_b' must map to distinct
+    policy-DB files — lossy '/'→'_' mangling let one identity's policy
+    overwrite another's on disk."""
+    iam = IAMSys(object_layer, root_cred=CREDS)
+    iam.assume_role_with_claims("oidc:a/b", ["readonly"])
+    iam.assume_role_with_claims("oidc:a_b", ["readwrite"])
+    assert iam.user_policy["oidc:a/b"] == ["readonly"]
+    assert iam.user_policy["oidc:a_b"] == ["readwrite"]
+    # both mappings survive a reload from disk under their exact subject
+    iam2 = IAMSys(object_layer, root_cred=CREDS)
+    assert iam2.user_policy["oidc:a/b"] == ["readonly"]
+    assert iam2.user_policy["oidc:a_b"] == ["readwrite"]
+
+
 # ---------------------------------------------------------------------------
 # end-to-end over HTTP (signed requests + STS)
 # ---------------------------------------------------------------------------
