@@ -217,6 +217,21 @@ class PutObjReader(HashReader):
         out, self._out = self._out[:n], self._out[n:]
         return out
 
+    def readinto_full(self, mv: memoryview) -> int:
+        """Transformed streams can't land zero-copy (the ciphertext is
+        produced chunkwise here, not in the caller's buffer) — override
+        the inherited fast path, which would touch HashReader state this
+        wrapper never initializes."""
+        want = len(mv)
+        got = 0
+        while got < want:
+            chunk = self.read(want - got)
+            if not chunk:
+                break
+            mv[got:got + len(chunk)] = chunk
+            got += len(chunk)
+        return got
+
 
 # ---------------------------------------------------------------------------
 # request-level helpers (consumed by the S3 handlers)

@@ -28,12 +28,18 @@ DEVICE_MIN_BYTES = int(os.environ.get("MINIO_TPU_DEVICE_MIN_BYTES",
                                       str(8 << 20)))
 
 
+_IS_TPU: Optional[bool] = None
+
+
 def _device_is_tpu() -> bool:
-    try:
-        import jax
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    global _IS_TPU
+    if _IS_TPU is None:
+        try:
+            import jax
+            _IS_TPU = jax.devices()[0].platform == "tpu"
+        except Exception:
+            _IS_TPU = False
+    return _IS_TPU
 
 
 class Codec:
@@ -94,6 +100,28 @@ class Codec:
             out = np.stack([rs_ref.encode(batch[i], self.m)
                             for i in range(batch.shape[0])])
         return out[0] if single else out
+
+    def encode_parity_batch(self, data: np.ndarray, *, force: str = ""
+                            ) -> np.ndarray:
+        """(B, k, S) data shards -> (B, m, S) parity ONLY — the PUT hot
+        path writes data rows straight out of the read buffer, so no
+        full-array concat happens (encode_batch's concatenate was one
+        whole extra pass over the payload)."""
+        b, _k, s = data.shape
+        if self.m == 0:
+            return np.zeros((b, 0, s), dtype=np.uint8)
+        path = force or self._route(data.nbytes)
+        if path == "device":
+            return np.asarray(
+                rs_tpu.encode(data, self.k, self.m))[:, self.k:]
+        parity = np.empty((b, self.m, s), dtype=np.uint8)
+        if path == "native" and native.available():
+            for i in range(b):
+                parity[i] = native.gf_matmul(self._parity_matrix, data[i])
+        else:
+            for i in range(b):
+                parity[i] = rs_ref.encode(data[i], self.m)[self.k:]
+        return parity
 
     def _route(self, nbytes: int) -> str:
         if _device_is_tpu() and nbytes >= DEVICE_MIN_BYTES:

@@ -29,6 +29,7 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
+from ..utils import stagetimer
 from ..storage.api import StorageAPI
 from ..storage.datatypes import (BLOCK_SIZE_V1, ChecksumInfo, FileInfo,
                                  ObjectInfo, new_file_info, now)
@@ -243,7 +244,8 @@ class ErasureObjects:
                 total = self._encode_stream(reader, codec, writers,
                                             write_quorum, bucket,
                                             object_name)
-                reader.verify()
+                with stagetimer.stage("put.hash_verify"):
+                    reader.verify()
             finally:
                 reader.close()  # stop the async hasher even on failure
             etag = opts.metadata.pop("etag", "") or reader.md5_current_hex()
@@ -259,9 +261,11 @@ class ErasureObjects:
                 ChecksumInfo(1, self.bitrot_algo.value, b"")]
 
             # per-drive metadata then commit (2-phase: tmp -> final)
-            with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
-                self._commit(shuffled, writers, tmp_id, fi, bucket,
-                             object_name, write_quorum)
+            with stagetimer.stage("put.lock+commit"):
+                with self.ns.new_lock(
+                        f"{bucket}/{object_name}").write_locked():
+                    self._commit(shuffled, writers, tmp_id, fi, bucket,
+                                 object_name, write_quorum)
         except Exception:
             self._cleanup_tmp(shuffled, tmp_id)
             raise
@@ -271,18 +275,59 @@ class ErasureObjects:
                        write_quorum: int, bucket: str,
                        object_name: str) -> int:
         """The PUT hot loop: read blocks, batch-encode, batch-hash,
-        fan-out framed writes. Returns total bytes."""
-        total = 0
-        pending: list[bytes] = []
+        fan-out framed writes. Returns total bytes.
 
-        def flush(blocks: list[bytes]) -> None:
-            if not blocks:
-                return
-            if len(blocks) > 1:
-                # full blocks share a shard length: one device batch
-                data = np.stack([codec.split(b) for b in blocks])
+        Copy discipline (the fork's zero-copy QAT ingest,
+        cmd/erasure-encode.go:102-124, generalized): blocks are read
+        straight into a padded (B, k*S) buffer so the shard split is a
+        reshape VIEW, the data shards are written from that same buffer,
+        and only the parity rows are newly allocated. The old path
+        copied every byte 3 extra times (concat, split, stack)."""
+        total = 0
+        k, s_len = codec.k, codec.shard_size
+        bs = self.block_size
+        cap = ENCODE_BATCH_BLOCKS
+        # zero-initialized: the pad tail (k*S - block_size bytes) must
+        # read as zeros for klauspost-identical shard bytes, and full
+        # blocks never write into it
+        buf = np.zeros((cap, k * s_len), dtype=np.uint8)
+        nb = 0
+
+        def flush_full(n_rows: int) -> None:
+            if n_rows:
+                self._encode_write(codec,
+                                   buf[:n_rows].reshape(n_rows, k, s_len),
+                                   writers, write_quorum)
+
+        while True:
+            row = buf[nb]
+            with stagetimer.stage("put.read_stream"):
+                n = _read_full_into(reader, row[:bs])
+            if n == 0:
+                break
+            total += n
+            if n == bs:
+                nb += 1
+                if nb == cap:
+                    flush_full(nb)
+                    nb = 0
             else:
-                data = codec.split(blocks[0])[None, ...]
+                # short last block: its shard length differs — encode
+                # the pending full rows first, then it alone
+                flush_full(nb)
+                nb = 0
+                with stagetimer.stage("put.split"):
+                    data = codec.split(row[:n])[None, ...]
+                self._encode_write(codec, data, writers, write_quorum)
+                break
+        flush_full(nb)
+        return total
+
+    def _encode_write(self, codec: Codec, data: np.ndarray, writers,
+                      write_quorum: int) -> None:
+        """Encode+digest one (B, k, S) batch and fan the framed shard
+        writes out — data rows go to the writers as views of `data`."""
+        with stagetimer.stage("put.encode+digest"):
             # fused device encode+digest when routed there (one program,
             # one round-trip); the cross-request scheduler coalesces
             # concurrent PUT streams into shared dispatches
@@ -293,47 +338,46 @@ class ErasureObjects:
                 fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
             if fused is not None:
                 full, digests = fused
+                data_rows, parity = full[:, :codec.k], full[:, codec.k:]
+                dd, dp = digests[:, :codec.k], digests[:, codec.k:]
             else:
-                full = codec.encode_batch(data) if len(blocks) > 1 else \
-                    codec.encode_batch(data[0])[None, ...]
-                b_, n_, s_ = full.shape
-                digests = bitrot_mod.hash_shards_batch(
-                    full.reshape(b_ * n_, s_), self.bitrot_algo
-                ).reshape(b_, n_, -1)
-            for bi in range(full.shape[0]):
-                self._write_shards(full[bi], digests[bi], writers,
-                                   write_quorum, bucket, object_name)
+                b_ = data.shape[0]
+                data_rows = data
+                parity = codec.encode_parity_batch(data)
+                dd = bitrot_mod.hash_shards_batch(
+                    data.reshape(b_ * codec.k, -1), self.bitrot_algo
+                ).reshape(b_, codec.k, -1)
+                if codec.m:
+                    dp = bitrot_mod.hash_shards_batch(
+                        parity.reshape(b_ * codec.m, -1), self.bitrot_algo
+                    ).reshape(b_, codec.m, -1)
+                else:
+                    dp = np.zeros((b_, 0, dd.shape[-1]), dtype=np.uint8)
+        with stagetimer.stage("put.shard_write"):
+            self._write_shards_batch(data_rows, parity, dd, dp, writers,
+                                     write_quorum)
 
-        while True:
-            block = _read_full(reader, self.block_size)
-            if not block:
-                break
-            total += len(block)
-            if len(block) == self.block_size:
-                pending.append(block)
-                if len(pending) >= ENCODE_BATCH_BLOCKS:
-                    flush(pending)
-                    pending = []
-            else:
-                flush(pending)
-                pending = []
-                flush([block])
-                break
-        flush(pending)
-        return total
+    def _write_shards_batch(self, data: np.ndarray, parity: np.ndarray,
+                            dd: np.ndarray, dp: np.ndarray,
+                            writers, write_quorum: int) -> None:
+        """parallelWriter.Write, batched: writer i gets ALL B of its
+        [digest‖block] frames in one call (cmd/erasure-encode.go:38-72's
+        per-disk goroutine — but fanned out once per encode batch, not
+        once per block: B× fewer pool tasks, and the frames are handed
+        over as memoryviews of the encode output, copy-free until the
+        writer's own buffer). Data and parity arrive as separate arrays
+        so the data rows stay views of the read buffer."""
+        B, k = data.shape[0], data.shape[1]
 
-    def _write_shards(self, shards: np.ndarray, digests: np.ndarray,
-                      writers, write_quorum: int, bucket: str,
-                      object_name: str) -> None:
-        """parallelWriter.Write: write shard i to writer i, tolerate
-        failures down to write quorum (cmd/erasure-encode.go:38-72)."""
         def write(i: int, w) -> None:
-            w.write_with_digest(shards[i].tobytes(), digests[i].tobytes())
+            rows, digs, j = (data, dd, i) if i < k else \
+                (parity, dp, i - k)
+            for bi in range(B):
+                w.write_with_digest(rows[bi, j].data, digs[bi, j].data)
 
-        idx = list(range(len(writers)))
         _, errs = meta.for_each_disk(
-            [writers[i] for i in idx],  # type: ignore[misc]
-            lambda i, w: write(i, w))
+            list(writers),  # type: ignore[arg-type]
+            write)
         for i, e in enumerate(errs):
             if e is not None:
                 writers[i] = None
@@ -350,13 +394,13 @@ class ErasureObjects:
                 raise serr.DiskNotFound(f"writer {i}")
             w.close()  # flushes remaining frames (empty file for 0-byte)
 
-        _, errs = meta.for_each_disk(shuffled, close_writer)
+        with stagetimer.stage("put.commit.close_writers"):
+            _, errs = meta.for_each_disk(shuffled, close_writer)
         for i, e in enumerate(errs):
             if e is not None:
                 writers[i] = None
 
-        import copy
-        metas = [copy.deepcopy(fi) for _ in range(len(shuffled))]
+        metas = [fi.light_copy() for _ in range(len(shuffled))]
         if not self.bitrot_algo.streaming:
             # whole-file digests are per-drive (each shard differs)
             for i, w in enumerate(writers):
@@ -365,14 +409,17 @@ class ErasureObjects:
                         c.hash = w.digest()
         disks_for_meta = [d if writers[i] is not None else None
                           for i, d in enumerate(shuffled)]
-        meta.write_unique_file_info(disks_for_meta, MINIO_META_TMP_BUCKET,
-                                    tmp_id, metas, write_quorum)
+        with stagetimer.stage("put.commit.write_meta"):
+            meta.write_unique_file_info(disks_for_meta,
+                                        MINIO_META_TMP_BUCKET,
+                                        tmp_id, metas, write_quorum)
 
         def rename(i, d):
             d.rename_data(MINIO_META_TMP_BUCKET, tmp_id, fi.data_dir,
                           bucket, object_name)
 
-        _, errs = meta.for_each_disk(disks_for_meta, rename)
+        with stagetimer.stage("put.commit.rename"):
+            _, errs = meta.for_each_disk(disks_for_meta, rename)
         err = meta.reduce_write_quorum_errs(
             errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
@@ -1019,3 +1066,23 @@ def _read_full(reader, n: int) -> bytes:
             break
         buf += chunk
     return buf
+
+
+def _read_full_into(reader, view: np.ndarray) -> int:
+    """io.ReadFull into a caller buffer: fills `view` (a uint8 array
+    slice) unless EOF; returns bytes read. Uses the reader's zero-copy
+    readinto_full when it has one (HashReader), else falls back to
+    read()+copy (chunked-signature readers, plain streams)."""
+    fn = getattr(reader, "readinto_full", None)
+    if fn is not None:
+        return fn(memoryview(view))  # type: ignore[arg-type]
+    n = len(view)
+    got = 0
+    while got < n:
+        chunk = reader.read(n - got)
+        if not chunk:
+            break
+        ln = len(chunk)
+        view[got:got + ln] = np.frombuffer(chunk, dtype=np.uint8)
+        got += ln
+    return got

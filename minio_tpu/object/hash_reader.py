@@ -15,17 +15,28 @@ device pipeline exactly like the fork's async Accel_write_data/MD5Sum
 from __future__ import annotations
 
 import hashlib
+import os
 import queue
 import threading
+import time
 from typing import BinaryIO, Optional
 
 from . import api_errors
+from ..utils import stagetimer
+
+# Overlapping the digest with encode+write only pays when there is a
+# second core to run it on; on a single-core host the queue handoff is
+# pure overhead.
+_DEFAULT_ASYNC = (os.cpu_count() or 1) > 1
 
 
 class HashReader:
     def __init__(self, stream: BinaryIO, size: int = -1,
                  md5_hex: str = "", sha256_hex: str = "",
-                 actual_size: int = -1, async_hash: bool = True):
+                 actual_size: int = -1,
+                 async_hash: Optional[bool] = None):
+        if async_hash is None:
+            async_hash = _DEFAULT_ASYNC
         self._stream = stream
         self.size = size
         self.actual_size = actual_size if actual_size >= 0 else size
@@ -50,9 +61,19 @@ class HashReader:
             chunk = self._q.get()
             if chunk is None:
                 return
+            self._update(chunk)
+
+    def _update(self, chunk) -> None:
+        if stagetimer.ENABLED:
+            t0 = time.perf_counter()
             self._md5.update(chunk)
             if self._sha256 is not None:
                 self._sha256.update(chunk)
+            stagetimer.add("put.md5+sha256", time.perf_counter() - t0)
+            return
+        self._md5.update(chunk)
+        if self._sha256 is not None:
+            self._sha256.update(chunk)
 
     def read(self, n: int = -1) -> bytes:
         if self.size >= 0:
@@ -67,10 +88,46 @@ class HashReader:
             if self._q is not None:
                 self._q.put(chunk)
             else:
-                self._md5.update(chunk)
-                if self._sha256 is not None:
-                    self._sha256.update(chunk)
+                self._update(chunk)
         return chunk
+
+    def readinto_full(self, mv: memoryview) -> int:
+        """Fill `mv` completely unless EOF; hashes the filled prefix.
+        The zero-copy seam of the PUT hot loop: bytes land once in the
+        caller's encode buffer (the fork's Accel_get_next_buff pattern,
+        cmd/erasure-encode.go:104)."""
+        want = len(mv)
+        if self.size >= 0:
+            remaining = self.size - self.bytes_read
+            if remaining <= 0:
+                return 0
+            if want > remaining:
+                mv = mv[:remaining]
+                want = remaining
+        stream = self._stream
+        readinto = getattr(stream, "readinto", None)
+        got = 0
+        while got < want:
+            if readinto is not None:
+                n = readinto(mv[got:])
+                if not n:
+                    break
+                got += n
+            else:
+                chunk = stream.read(want - got)
+                if not chunk:
+                    break
+                mv[got:got + len(chunk)] = chunk
+                got += len(chunk)
+        if got:
+            self.bytes_read += got
+            if self._q is not None:
+                # async hashing must own a stable copy — the caller
+                # reuses the buffer for the next block
+                self._q.put(bytes(mv[:got]))
+            else:
+                self._update(mv[:got])
+        return got
 
     def _drain(self) -> None:
         if self._q is not None and self._worker is not None:

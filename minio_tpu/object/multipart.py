@@ -110,7 +110,7 @@ class MultipartMixin(ErasureObjects):
         if opts.versioned:
             fi.metadata["x-minio-internal-versioned"] = "true"
 
-        metas = [copy.deepcopy(fi) for _ in self.disks]
+        metas = [fi.light_copy() for _ in self.disks]
         meta.write_unique_file_info(self.disks, MINIO_META_MULTIPART_BUCKET,
                                     path, metas, write_quorum)
         return upload_id
@@ -193,7 +193,7 @@ class MultipartMixin(ErasureObjects):
             session_fi.erasure.checksums.append(
                 ChecksumInfo(part_number, self.bitrot_algo.value, b""))
             session_fi.mod_time = now()
-            metas = [copy.deepcopy(session_fi) for _ in self.disks]
+            metas = [session_fi.light_copy() for _ in self.disks]
             meta.write_unique_file_info(
                 self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
                 write_quorum)
@@ -330,7 +330,7 @@ class MultipartMixin(ErasureObjects):
             if extra:
                 meta.for_each_disk(self.disks, drop_extra)
 
-            metas = [copy.deepcopy(fi) for _ in self.disks]
+            metas = [fi.light_copy() for _ in self.disks]
             with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
                 meta.write_unique_file_info(
                     self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,

@@ -29,6 +29,7 @@ from ..object.engine import GetOptions, PutOptions
 from ..object.hash_reader import HashReader
 from ..object.multipart import CompletePart
 from ..storage.datatypes import ObjectInfo
+from ..utils import stagetimer
 from . import signature as sig
 from xml.sax.saxutils import escape as _sax_escape
 
@@ -49,6 +50,7 @@ class HTTPResponse:
     headers: dict[str, str] = dataclasses.field(default_factory=dict)
     body: bytes = b""
     stream: Optional[Iterator[bytes]] = None   # used instead of body if set
+    long_poll: bool = False   # idle event stream: exempt from admission
 
     def with_xml(self, payload: bytes) -> "HTTPResponse":
         self.headers["Content-Type"] = "application/xml"
@@ -173,17 +175,54 @@ def _parse_range(header: str, size: int) -> Optional[tuple[int, int]]:
         return None
 
 
+class _ReleasingStream:
+    """Response-body wrapper that returns its admission slot when the
+    stream is exhausted or closed (whichever comes first)."""
+
+    def __init__(self, inner, sem: threading.BoundedSemaphore):
+        self._inner = inner
+        self._sem = sem
+        self._released = False
+
+    def __iter__(self):
+        try:
+            for chunk in self._inner:
+                yield chunk
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        try:
+            close = getattr(self._inner, "close", None)
+            if close is not None:
+                close()
+        finally:
+            if not self._released:
+                self._released = True
+                self._sem.release()
+
+
 class S3ApiHandlers:
     def __init__(self, object_layer, region: str = "us-east-1",
                  creds: Optional[Credentials] = None,
-                 iam=None, max_clients: int = 256):
+                 iam=None, max_clients: Optional[int] = None):
         self.obj = object_layer
         self.region = region
         self.root_cred = creds or global_credentials()
         self.iam = iam            # optional IAMSys (policy checks + users)
         self.bucket_meta = BucketMetadataSys(object_layer)
-        # RAM-budgeted admission gate (cmd/handler-api.go:100 analog)
+        # Admission gate (cmd/handler-api.go:100 analog). Default is
+        # CPU-proportional: each data-path request runs real erasure and
+        # hashing work, so admitting far more streams than cores only
+        # convoys the GIL and splits the cache working set (excess
+        # requests queue here instead). The cluster boot overrides this
+        # with the full RAM+CPU budget (requests_budget).
+        if max_clients is None:
+            max_clients = int(os.environ.get("MINIO_TPU_MAX_CLIENTS", 0)) \
+                or max(4, 4 * (os.cpu_count() or 1))
         self._admission = threading.BoundedSemaphore(max_clients)
+        self.request_deadline = float(os.environ.get(
+            "MINIO_TPU_REQUEST_DEADLINE", "10"))
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPool
@@ -239,6 +278,12 @@ class S3ApiHandlers:
                      object_name: str = "") -> None:
         """Verify the request signature and (if IAM is wired) that the
         caller may perform `action` (cmd/auth-handler.go checkRequestAuthType)."""
+        with stagetimer.stage("auth"):
+            self._authenticate(ctx, action, bucket, object_name)
+
+    def _authenticate(self, ctx: RequestContext,
+                      action: str = "", bucket: str = "",
+                      object_name: str = "") -> None:
         at = ctx.auth_type
         if at == sig.AUTH_SIGNED:
             body_sha = ctx.header("x-amz-content-sha256",
@@ -443,11 +488,33 @@ class S3ApiHandlers:
     # ------------------------------------------------------------------
 
     def handle(self, ctx: RequestContext) -> HTTPResponse:
-        with self._admission:
+        # Admission covers the FULL request lifetime — the reference's
+        # maxClients gate wraps ServeHTTP including the response body
+        # (cmd/handler-api.go:100), so a streaming GET holds its slot
+        # until the body is fully written (slot released by the
+        # _ReleasingStream when the server closes/exhausts it). Bound
+        # the wait like the reference's deadline: saturated slots must
+        # shed load with 503, not wedge every caller forever. Bind the
+        # semaphore once — set_max_clients may swap self._admission
+        # mid-request, and acquire/release must hit the same object.
+        sem = self._admission
+        if not sem.acquire(timeout=self.request_deadline):
+            return self._error_response(
+                ctx, S3Error("SlowDown",
+                             "server is busy, retry the request"))
+        release = True
+        try:
             try:
-                return self._route(ctx)
+                resp = self._route(ctx)
             except Exception as e:  # noqa: BLE001 — map to S3 error XML
                 return self._error_response(ctx, api_error_from(e))
+            if resp.stream is not None and not resp.long_poll:
+                resp.stream = _ReleasingStream(resp.stream, sem)
+                release = False
+            return resp
+        finally:
+            if release:
+                sem.release()
 
     def _error_response(self, ctx: RequestContext,
                         err: S3Error) -> HTTPResponse:
@@ -584,9 +651,11 @@ class S3ApiHandlers:
                         continue
                     yield (_json.dumps(record) + "\n").encode()
 
+        # long_poll: a listener mostly idles — it must not pin one of
+        # the (CPU-sized) admission slots for its whole lifetime
         return HTTPResponse(
             headers={"Content-Type": "application/x-ndjson"},
-            stream=stream())
+            stream=stream(), long_poll=True)
 
     def post_policy_upload(self, ctx, bucket) -> HTTPResponse:
         """Browser form upload (PostPolicyBucketHandler,

@@ -46,6 +46,18 @@ class _BodyReader:
         self.remaining -= len(chunk)
         return chunk
 
+    def readinto(self, b) -> int:
+        """Zero-copy into the caller's buffer (the PUT hot loop reads
+        straight into its encode buffer through here)."""
+        if self.remaining <= 0:
+            return 0
+        mv = memoryview(b)
+        if len(mv) > self.remaining:
+            mv = mv[:self.remaining]
+        n = self.raw.readinto(mv) or 0
+        self.remaining -= n
+        return n
+
     def drain(self) -> None:
         while self.remaining > 0:
             if not self.read(min(self.remaining, 1 << 16)):
@@ -117,6 +129,16 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                     self.wfile.write(body)
             except BrokenPipeError:
                 pass
+            finally:
+                if resp.stream is not None:
+                    # releases the admission slot a streaming response
+                    # holds, even when the client hung up mid-body
+                    close = getattr(resp.stream, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
 
         def _dispatch(self) -> None:
             # chunked request bodies have no Content-Length: without

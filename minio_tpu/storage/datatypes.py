@@ -142,6 +142,18 @@ class FileInfo:
             remaining -= part.size
         raise ValueError(f"offset {offset} beyond object size")
 
+    def light_copy(self) -> "FileInfo":
+        """Per-drive copy for writeUniqueFileInfo: drives differ only in
+        erasure.index and (whole-file bitrot) per-drive checksum hashes,
+        so share the payload (metadata dict, parts list) and copy just
+        the erasure branch — a full deepcopy per drive was the PUT
+        commit path's largest host cost."""
+        e = self.erasure
+        new_e = dataclasses.replace(
+            e, distribution=list(e.distribution),
+            checksums=[dataclasses.replace(c) for c in e.checksums])
+        return dataclasses.replace(self, erasure=new_e)
+
     def to_object_info(self, bucket: str, object_name: str) -> "ObjectInfo":
         actual = int(self.metadata.get("X-Minio-Internal-actual-size",
                                        self.size))

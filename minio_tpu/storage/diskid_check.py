@@ -151,6 +151,13 @@ class DiskIDCheck(StorageAPI):
     def append_file(self, volume, path, buf):
         return self._call(self.inner.append_file, volume, path, buf)
 
+    def open_appender(self, volume, path):
+        # identity-guarded like every other write verb: the shard-write
+        # hot path must not stream frames onto a swapped drive (callers
+        # probe has_appender() first — delegated via __getattr__ — so
+        # this is only reached when the backend really supports it)
+        return self._call(self.inner.open_appender, volume, path)
+
     def create_file(self, volume, path, size, reader):
         return self._call(self.inner.create_file, volume, path, size,
                           reader)

@@ -260,6 +260,30 @@ class XLStorage(StorageAPI):
         except OSError as e:
             raise errors.FaultyDisk(str(e)) from e
 
+    def has_appender(self) -> bool:
+        """Capability probe for open_appender — wrappers delegate this,
+        so a guard wrapper can expose open_appender unconditionally
+        while the probe still reflects the backend's real support."""
+        return True
+
+    def open_appender(self, volume: str, path: str):
+        """Persistent append handle for the shard-write hot path: the
+        bitrot writer streams [digest‖block] frames straight into the
+        OS file instead of re-buffering them in Python and re-opening
+        the file per flush (one memcpy pass saved per shard file).
+        Local drives only — remote disks keep the buffered append_file
+        batches (one RPC per flush, not per frame)."""
+        if not os.path.isdir(self._vol_dir(volume)):
+            raise errors.VolumeNotFound(volume)
+        fp = self._file_path(volume, path)
+        try:
+            os.makedirs(os.path.dirname(fp), exist_ok=True)
+            return open(fp, "ab")
+        except NotADirectoryError:
+            raise errors.FileParentIsFile(fp) from None
+        except OSError as e:
+            raise errors.FaultyDisk(str(e)) from e
+
     def create_file(self, volume: str, path: str, size: int,
                     reader: BinaryIO) -> None:
         """Stream `size` bytes (exactly) from reader into a fresh file

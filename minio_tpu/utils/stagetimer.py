@@ -1,0 +1,82 @@
+"""Per-stage wall-time accounting for the live-server data path.
+
+The reference answers "where does a PUT spend its time" with pprof; this
+build needs the same answer without a profiler attached: bench_e2e.py
+enables the collector, the hot path marks stages (auth, hash-reader,
+split, encode, shard write, commit, lock), and the bench prints the
+aggregate breakdown. Disabled (the default) the cost is one dict lookup
+and an `if` per stage — safe to leave in production paths.
+
+Stages nest across threads; each accumulates exclusive wall time per
+(name) key with a call count, summed over all threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+ENABLED = False
+
+_lock = threading.Lock()
+_acc: "defaultdict[str, list]" = defaultdict(lambda: [0.0, 0])
+
+
+class _Stage:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = 0.0
+
+    def __enter__(self):
+        if ENABLED:
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if ENABLED:
+            dt = time.perf_counter() - self.t0
+            with _lock:
+                slot = _acc[self.name]
+                slot[0] += dt
+                slot[1] += 1
+        return False
+
+
+def stage(name: str) -> _Stage:
+    return _Stage(name)
+
+
+def add(name: str, seconds: float, count: int = 1) -> None:
+    """Record time measured externally (e.g. inside a hashing thread)."""
+    if ENABLED:
+        with _lock:
+            slot = _acc[name]
+            slot[0] += seconds
+            slot[1] += count
+
+
+def enable() -> None:
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def reset() -> None:
+    with _lock:
+        _acc.clear()
+
+
+def report() -> Dict[str, dict]:
+    """name -> {seconds, calls}, sorted by descending time."""
+    with _lock:
+        items = sorted(_acc.items(), key=lambda kv: -kv[1][0])
+        return {k: {"seconds": round(v[0], 4), "calls": v[1]}
+                for k, v in items}

@@ -15,6 +15,11 @@
 #include <cstring>
 #include <cstddef>
 
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#define HH_X86 1
+#endif
+
 namespace {
 
 struct HHState {
@@ -139,12 +144,75 @@ inline void ModularReduction(uint64_t a3_unmasked, uint64_t a2, uint64_t a1,
   *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
 }
 
-inline void ProcessAll(const uint8_t* data, size_t size, HHState* s) {
-  size_t i;
-  for (i = 0; i + 32 <= size; i += 32) {
-    UpdatePacket(data + i, s);
+#ifdef HH_X86
+// AVX2 packet loop: the whole HHState maps onto four __m256i (one per
+// 4 x u64 register file). The zipper-merge byte permutation — derived
+// from the scalar mask/shift cascade above — is a single in-lane
+// per-128-bit pshufb:
+//   dst byte j of each half <- src byte {3,12,2,5,14,1,15,0,
+//                                        11,4,10,13,9,6,8,7}[j]
+// and Update's cross-half pairing (lanes {1,0} and {3,2}) is exactly
+// the two 128-bit lanes of a 256-bit register.
+__attribute__((target("avx2"))) inline __m256i ZipperMergeV(__m256i v) {
+  const __m256i mask = _mm256_setr_epi8(
+      3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7,
+      3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7);
+  return _mm256_shuffle_epi8(v, mask);
+}
+
+__attribute__((target("avx2")))
+void ProcessPacketsAVX2(const uint8_t* data, size_t n_packets, HHState* s) {
+  __m256i v0 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->v0));
+  __m256i v1 = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->v1));
+  __m256i mul0 =
+      _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->mul0));
+  __m256i mul1 =
+      _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s->mul1));
+  for (size_t i = 0; i < n_packets; ++i) {
+    const __m256i lanes = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(data + i * 32));
+    // v1 += mul0 + lanes
+    v1 = _mm256_add_epi64(v1, _mm256_add_epi64(mul0, lanes));
+    // mul0 ^= (v1 & 0xffffffff) * (v0 >> 32)   [mul_epu32 = lo32*lo32]
+    mul0 = _mm256_xor_si256(
+        mul0, _mm256_mul_epu32(v1, _mm256_srli_epi64(v0, 32)));
+    // v0 += mul1
+    v0 = _mm256_add_epi64(v0, mul1);
+    // mul1 ^= (v0 & 0xffffffff) * (v1 >> 32)
+    mul1 = _mm256_xor_si256(
+        mul1, _mm256_mul_epu32(v0, _mm256_srli_epi64(v1, 32)));
+    // v0 += zipper(v1); then v1 += zipper(updated v0)
+    v0 = _mm256_add_epi64(v0, ZipperMergeV(v1));
+    v1 = _mm256_add_epi64(v1, ZipperMergeV(v0));
   }
-  if ((size & 31) != 0) UpdateRemainder(data + i, size & 31, s);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->v0), v0);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->v1), v1);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->mul0), mul0);
+  _mm256_storeu_si256(reinterpret_cast<__m256i*>(s->mul1), mul1);
+}
+
+bool DetectAVX2() {
+  return __builtin_cpu_supports("avx2");
+}
+const bool g_has_avx2 = DetectAVX2();
+#endif  // HH_X86
+
+inline void ProcessPackets(const uint8_t* data, size_t n_packets,
+                           HHState* s) {
+#ifdef HH_X86
+  if (g_has_avx2) {
+    ProcessPacketsAVX2(data, n_packets, s);
+    return;
+  }
+#endif
+  for (size_t i = 0; i < n_packets; ++i) UpdatePacket(data + i * 32, s);
+}
+
+inline void ProcessAll(const uint8_t* data, size_t size, HHState* s) {
+  const size_t n_packets = size / 32;
+  ProcessPackets(data, n_packets, s);
+  if ((size & 31) != 0)
+    UpdateRemainder(data + n_packets * 32, size & 31, s);
 }
 
 inline uint64_t Finalize64(HHState* s) {
@@ -212,8 +280,17 @@ void hh_init(const uint8_t* key32, uint8_t* state128) {
 void hh_update_packets(uint8_t* state128, const uint8_t* data, size_t size) {
   HHState s;
   std::memcpy(&s, state128, sizeof(HHState));
-  for (size_t i = 0; i + 32 <= size; i += 32) UpdatePacket(data + i, &s);
+  ProcessPackets(data, size / 32, &s);
   std::memcpy(state128, &s, sizeof(HHState));
+}
+
+// 1 when the AVX2 packet loop is in use (tests/bench introspection).
+int hh_has_avx2() {
+#ifdef HH_X86
+  return g_has_avx2 ? 1 : 0;
+#else
+  return 0;
+#endif
 }
 
 // Final call: append remainder (< 32 bytes) and emit 256-bit digest.
