@@ -234,6 +234,10 @@ class ClusterNode:
         from .s3.admin import mount_admin
         self.admin = mount_admin(self.s3, self)
 
+        # -- web JSON-RPC control surface (cmd/web-router.go) --------------
+        from .s3.web import mount as mount_web
+        self.web = mount_web(self.s3)
+
         # -- config KV (newAllSubsystems ConfigSys + lookupConfigs) --------
         from .config import ConfigSys
         self.config = ConfigSys(self.object_layer, secret=sk)

@@ -1,0 +1,630 @@
+"""Web JSON-RPC control surface (reference cmd/web-handlers.go:1-2291,
+cmd/web-router.go, cmd/jwt.go — the server capability behind the
+browser SPA; the SPA itself is out of scope, VERDICT r3 missing #1).
+
+Mounted by S3Server as an extra router:
+
+  POST /minio/webrpc                      JSON-RPC 2.0 endpoint
+  PUT  /minio/web/upload/<bucket>/<key>   browser upload path
+  GET  /minio/web/download/<bucket>/<key>?token=   browser download
+  POST /minio/web/zip?token=              zip-of-prefix download
+
+RPC methods (gorilla json2's "Web.X" names, case-insensitive):
+Login, ServerInfo, StorageInfo, MakeBucket, DeleteBucket, ListBuckets,
+ListObjects, RemoveObject, GenerateAuth, SetAuth, CreateURLToken,
+PresignedGet, GetBucketPolicy, SetBucketPolicy, ListAllBucketPolicies.
+
+Auth model mirrors the reference: Login verifies credentials and mints
+a JWT signed with THAT account's secret key (cmd/jwt.go
+authenticateWeb); requests carry it as `Authorization: Bearer <jwt>`;
+download/zip accept a short-lived URL token minted by CreateURLToken
+(authenticateURL) since browsers can't set headers on navigation.
+Verification decodes the unverified subject claim, looks the account
+up, then verifies the HMAC with that account's secret — so revoking a
+user (or rotating a secret) invalidates outstanding tokens.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import time
+import urllib.parse
+import zipfile
+from binascii import Error as binascii_error
+from typing import Optional
+
+from ..object import api_errors as oerr
+from .credentials import Credentials
+from .handlers import HTTPResponse, RequestContext, S3ApiHandlers
+from .s3errors import S3Error
+from . import signature as sig
+
+UI_VERSION = "minio-tpu-web-1"
+SESSION_EXPIRY_S = 24 * 3600          # web session token
+URL_TOKEN_EXPIRY_S = 3600             # download/zip token
+
+
+# ---------------------------------------------------------------------------
+# minimal JWT (HS256) — web tokens are signed with the ACCOUNT's secret
+# ---------------------------------------------------------------------------
+
+def _b64url(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def jwt_encode(claims: dict, secret: str) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    mac = hmac.new(secret.encode(), f"{header}.{payload}".encode(),
+                   hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(mac)}"
+
+
+def jwt_claims_unverified(token: str) -> dict:
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise S3Error("AccessDenied", "malformed token")
+    try:
+        claims = json.loads(_b64url_dec(parts[1]))
+    except (ValueError, UnicodeDecodeError, binascii_error):
+        raise S3Error("AccessDenied", "malformed token") from None
+    if not isinstance(claims, dict):
+        raise S3Error("AccessDenied", "malformed token")
+    return claims
+
+
+def jwt_verify(token: str, secret: str) -> dict:
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise S3Error("AccessDenied", "malformed token")
+    mac = hmac.new(secret.encode(), f"{parts[0]}.{parts[1]}".encode(),
+                   hashlib.sha256).digest()
+    if not hmac.compare_digest(_b64url(mac), parts[2]):
+        raise S3Error("AccessDenied", "invalid token signature")
+    claims = jwt_claims_unverified(token)
+    if float(claims.get("exp", 0)) < time.time():
+        raise S3Error("AccessDenied", "token expired")
+    return claims
+
+
+class _RPCError(Exception):
+    def __init__(self, message: str, code: int = 1):
+        super().__init__(message)
+        self.code = code
+
+
+class WebHandlers:
+    """The RPC + upload/download surface; holds no state of its own —
+    everything delegates to the S3 handler layer's object layer, bucket
+    metadata, and IAM."""
+
+    def __init__(self, api: S3ApiHandlers):
+        self.api = api
+
+    # -- auth --------------------------------------------------------------
+
+    def _lookup(self, access_key: str) -> Optional[Credentials]:
+        root = self.api.root_cred
+        if access_key == root.access_key:
+            return root
+        if self.api.iam is not None:
+            return self.api.iam.get_credentials(access_key)
+        return None
+
+    def _mint(self, cred: Credentials, typ: str, expiry_s: int) -> str:
+        return jwt_encode({"sub": cred.access_key, "typ": typ,
+                           "exp": time.time() + expiry_s}, cred.secret_key)
+
+    def _token_auth(self, token: str,
+                    want_typ: tuple = ("web",)) -> tuple[Credentials, bool]:
+        """token -> (credentials, is_owner); raises AccessDenied."""
+        if not token:
+            raise S3Error("AccessDenied", "no auth token")
+        claims = jwt_claims_unverified(token)
+        cred = self._lookup(str(claims.get("sub", "")))
+        if cred is None or cred.status != "on":
+            raise S3Error("AccessDenied", "no such user")
+        claims = jwt_verify(token, cred.secret_key)
+        if claims.get("typ") not in want_typ:
+            raise S3Error("AccessDenied", "wrong token type")
+        # root-derived service/STS creds are owners too (_is_owner
+        # checks parent_user like the reference's IsOwner)
+        return cred, self.api._is_owner(cred)
+
+    def _request_auth(self, ctx: RequestContext,
+                      want_typ: tuple = ("web",)
+                      ) -> tuple[Credentials, bool]:
+        auth = ctx.header("authorization")
+        token = auth[len("Bearer "):] if auth.startswith("Bearer ") else ""
+        if not token:
+            token = ctx.query1("token")
+        return self._token_auth(token, want_typ)
+
+    def _allowed(self, cred: Credentials, owner: bool, action: str,
+                 bucket: str, obj: str = "") -> bool:
+        if owner:
+            return True
+        if self.api.iam is None:
+            return False
+        return self.api.iam.is_allowed(cred, action, bucket, obj)
+
+    def _require(self, cred, owner, action, bucket, obj="") -> None:
+        if not self._allowed(cred, owner, action, bucket, obj):
+            raise _RPCError("access denied", code=403)
+
+    # -- router ------------------------------------------------------------
+
+    def router(self, ctx: RequestContext) -> HTTPResponse:
+        path = urllib.parse.unquote(ctx.req.path)
+        if path == "/minio/webrpc" and ctx.req.method == "POST":
+            return self._rpc(ctx)
+        if path.startswith("/minio/web/upload/"):
+            return self._upload(ctx, path[len("/minio/web/upload/"):])
+        if path.startswith("/minio/web/download/"):
+            return self._download(ctx, path[len("/minio/web/download/"):])
+        if path == "/minio/web/zip" and ctx.req.method == "POST":
+            return self._zip(ctx)
+        return HTTPResponse(status=404, body=b"not found")
+
+    # -- JSON-RPC ----------------------------------------------------------
+
+    def _rpc(self, ctx: RequestContext) -> HTTPResponse:
+        try:
+            req = json.loads(ctx.read_body() or b"{}")
+        except ValueError:
+            return self._rpc_response(None, error={"code": -32700,
+                                                   "message": "parse error"})
+        if not isinstance(req, dict):
+            return self._rpc_response(None, error={
+                "code": -32600, "message": "invalid request"})
+        rid = req.get("id")
+        method = str(req.get("method", ""))
+        name = method.split(".", 1)[-1].lower()
+        params = req.get("params", {})
+        if isinstance(params, list):
+            params = params[0] if params else {}
+        if not isinstance(params, dict):
+            return self._rpc_response(rid, error={
+                "code": -32602, "message": "params must be an object"})
+        fn = getattr(self, f"rpc_{name}", None)
+        if fn is None:
+            return self._rpc_response(rid, error={
+                "code": -32601, "message": f"unknown method {method}"})
+        try:
+            return self._rpc_response(rid, result=fn(ctx, params or {}))
+        except _RPCError as e:
+            return self._rpc_response(rid, error={"code": e.code,
+                                                  "message": str(e)})
+        except (S3Error, oerr.ObjectApiError) as e:
+            return self._rpc_response(rid, error={"code": 1,
+                                                  "message": str(e)})
+
+    @staticmethod
+    def _rpc_response(rid, result=None, error=None) -> HTTPResponse:
+        body: dict = {"jsonrpc": "2.0", "id": rid}
+        if error is not None:
+            body["error"] = error
+        else:
+            body["result"] = result
+        return HTTPResponse(
+            headers={"Content-Type": "application/json"},
+            body=json.dumps(body).encode())
+
+    # -- RPC methods -------------------------------------------------------
+
+    def rpc_login(self, ctx, args) -> dict:
+        username = str(args.get("username", ""))
+        password = str(args.get("password", ""))
+        cred = self._lookup(username)
+        if cred is None or cred.status != "on" or not hmac.compare_digest(
+                cred.secret_key, password):
+            raise _RPCError("invalid credentials", code=403)
+        return {"token": self._mint(cred, "web", SESSION_EXPIRY_S),
+                "uiVersion": UI_VERSION}
+
+    def rpc_serverinfo(self, ctx, args) -> dict:
+        self._request_auth(ctx)
+        import platform
+        return {"MinioVersion": UI_VERSION,
+                "MinioPlatform": platform.platform(),
+                "MinioRuntime": platform.python_version(),
+                "uiVersion": UI_VERSION}
+
+    def rpc_storageinfo(self, ctx, args) -> dict:
+        self._request_auth(ctx)
+        info = {}
+        su = getattr(self.api.obj, "storage_info", None)
+        if su is not None:
+            try:
+                info = su()
+            except Exception:  # noqa: BLE001 — best effort, like reference
+                info = {}
+        return {"storageInfo": info, "uiVersion": UI_VERSION}
+
+    def rpc_makebucket(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        self._require(cred, owner, "s3:CreateBucket", bucket)
+        self.api.obj.make_bucket(bucket)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_deletebucket(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        self._require(cred, owner, "s3:DeleteBucket", bucket)
+        self.api.obj.delete_bucket(bucket)
+        self.api.bucket_meta.delete(bucket)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_listbuckets(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        out = []
+        for b in self.api.obj.list_buckets():
+            if self._allowed(cred, owner, "s3:ListBucket", b.name):
+                out.append({"name": b.name,
+                            "creationDate": _iso(b.created)})
+        return {"buckets": out, "uiVersion": UI_VERSION}
+
+    def rpc_listobjects(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        prefix = str(args.get("prefix", ""))
+        marker = str(args.get("marker", ""))
+        self._require(cred, owner, "s3:ListBucket", bucket)
+        objs, prefixes, truncated = self.api.obj.list_objects(
+            bucket, prefix=prefix, delimiter="/", marker=marker,
+            max_keys=1000)
+        objects = [{"name": p, "size": 0, "contentType": "",
+                    "lastModified": ""} for p in prefixes]
+        objects += [{"name": o.name, "size": o.size,
+                     "contentType": o.content_type,
+                     "lastModified": _iso(o.mod_time)} for o in objs]
+        reply = {"objects": objects, "uiVersion": UI_VERSION,
+                 "istruncated": bool(truncated)}
+        if truncated:
+            # the marker must be the lexicographically LAST entry
+            # returned — objects and common prefixes interleave in
+            # sorted order, so a prefix can be the page's last item
+            last = ""
+            if objs:
+                last = objs[-1].name
+            if prefixes:
+                last = max(last, prefixes[-1])
+            if last:
+                reply["nextmarker"] = last
+        return reply
+
+    def rpc_removeobject(self, ctx, args) -> dict:
+        """Reference RemoveObject: a list of keys; a key ending in '/'
+        removes the whole prefix recursively."""
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        objects = list(args.get("objects", []))
+        for key in objects:
+            key = str(key)
+            if key.endswith("/") or key == "":
+                self._require(cred, owner, "s3:ListBucket", bucket)
+                marker = ""
+                while True:
+                    objs, _p, trunc = self.api.obj.list_objects(
+                        bucket, prefix=key, marker=marker, max_keys=1000)
+                    for o in objs:
+                        self._require(cred, owner, "s3:DeleteObject",
+                                      bucket, o.name)
+                        self.api.obj.delete_object(bucket, o.name)
+                    if not trunc or not objs:
+                        break
+                    marker = objs[-1].name
+            else:
+                self._require(cred, owner, "s3:DeleteObject", bucket, key)
+                self.api.obj.delete_object(bucket, key)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_generateauth(self, ctx, args) -> dict:
+        _cred, owner = self._request_auth(ctx)
+        if not owner:
+            raise _RPCError("access denied", code=403)
+        from .credentials import generate_credentials
+        new = generate_credentials()
+        return {"accessKey": new.access_key, "secretKey": new.secret_key,
+                "uiVersion": UI_VERSION}
+
+    def rpc_setauth(self, ctx, args) -> dict:
+        """Non-owner secret rotation (owner creds come from config/env,
+        not the browser — reference errChangeCredNotAllowed)."""
+        cred, owner = self._request_auth(ctx)
+        if owner:
+            raise _RPCError("owner credentials cannot be changed here",
+                            code=403)
+        if self.api.iam is None:
+            raise _RPCError("IAM not configured", code=500)
+        if not hmac.compare_digest(cred.secret_key,
+                                   str(args.get("currentSecretKey", ""))):
+            raise _RPCError("current secret key does not match", code=403)
+        new_secret = str(args.get("newSecretKey", ""))
+        if len(new_secret) < 8:
+            raise _RPCError("secret key must be at least 8 chars")
+        # add_user overwrites the identity record in place; policy
+        # mappings live in policydb and survive the rotation
+        self.api.iam.add_user(cred.access_key, new_secret)
+        new_cred = self._lookup(cred.access_key)
+        assert new_cred is not None
+        return {"token": self._mint(new_cred, "web", SESSION_EXPIRY_S),
+                "uiVersion": UI_VERSION, "peerErrMsgs": {}}
+
+    def rpc_createurltoken(self, ctx, args) -> dict:
+        cred, _owner = self._request_auth(ctx)
+        return {"token": self._mint(cred, "url", URL_TOKEN_EXPIRY_S),
+                "uiVersion": UI_VERSION}
+
+    def rpc_presignedget(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        obj = str(args.get("objectName", ""))
+        host = str(args.get("hostName", ctx.header("host")))
+        try:
+            expiry = int(args.get("expiry", 0) or 0)
+        except (TypeError, ValueError):
+            raise _RPCError("expiry must be an integer") from None
+        if not (0 < expiry < 604800):
+            expiry = 604800
+        if not bucket or not obj:
+            raise _RPCError("Bucket and Object are mandatory arguments.")
+        self._require(cred, owner, "s3:GetObject", bucket, obj)
+        path = "/" + urllib.parse.quote(f"{bucket}/{obj}")
+        qs = sig.presign_v4("GET", path, {}, {"host": host}, cred,
+                            self.api.region, expiry)
+        return {"url": f"{host}{path}?{qs}", "uiVersion": UI_VERSION}
+
+    # canned policy names per reference web UI semantics
+    _POLICY_ACTIONS = {
+        "readonly": ["s3:GetObject"],
+        "writeonly": ["s3:PutObject"],
+        "readwrite": ["s3:GetObject", "s3:PutObject", "s3:DeleteObject"],
+    }
+
+    def rpc_getbucketpolicy(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        prefix = str(args.get("prefix", ""))
+        self._require(cred, owner, "s3:GetBucketPolicy", bucket)
+        return {"policy": self._classify_policy(bucket, prefix),
+                "uiVersion": UI_VERSION}
+
+    def rpc_listallbucketpolicies(self, ctx, args) -> dict:
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        self._require(cred, owner, "s3:GetBucketPolicy", bucket)
+        policies = []
+        for st in self._bucket_statements(bucket):
+            kind = self._statement_kind(st)
+            if kind == "none":
+                continue
+            for res in st.resources:
+                policies.append({"prefix": res.split(":::", 1)[-1],
+                                 "policy": kind})
+        return {"policies": policies, "uiVersion": UI_VERSION}
+
+    def rpc_setbucketpolicy(self, ctx, args) -> dict:
+        """Canned policy ∈ none|readonly|readwrite|writeonly applied to
+        bucket[/prefix] (reference SetBucketPolicy web args)."""
+        cred, owner = self._request_auth(ctx)
+        bucket = str(args.get("bucketName", ""))
+        prefix = str(args.get("prefix", ""))
+        kind = str(args.get("policy", "none"))
+        self._require(cred, owner, "s3:PutBucketPolicy", bucket)
+        if kind not in ("none", "readonly", "readwrite", "writeonly"):
+            raise _RPCError(f"invalid policy {kind}")
+        res_obj = f"arn:aws:s3:::{bucket}/{prefix}*" if prefix else \
+            f"arn:aws:s3:::{bucket}/*"
+        statements = []
+        if kind != "none":
+            statements = [
+                {"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                 "Action": ["s3:GetBucketLocation", "s3:ListBucket"],
+                 "Resource": [f"arn:aws:s3:::{bucket}"]},
+                {"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                 "Action": self._POLICY_ACTIONS[kind],
+                 "Resource": [res_obj]},
+            ]
+        doc = json.dumps({"Version": "2012-10-17",
+                          "Statement": statements}) if statements else ""
+        self.api.bucket_meta.update(bucket, policy_json=doc)
+        return {"uiVersion": UI_VERSION}
+
+    def _bucket_statements(self, bucket: str) -> list:
+        """Parsed statements of the bucket policy via the shared policy
+        machinery (iam/policy.py) — not a second JSON walker."""
+        from ..iam.policy import Policy
+        doc = self.api.bucket_meta.get(bucket).policy_json
+        if not doc:
+            return []
+        try:
+            return Policy.from_json(doc).statements
+        except (ValueError, KeyError):
+            return []
+
+    @staticmethod
+    def _statement_kind(st) -> str:
+        if st.effect != "Allow":
+            return "none"   # a Deny granting nothing must not read back
+        actions = set(st.actions)
+        if "s3:PutObject" in actions and "s3:GetObject" in actions:
+            return "readwrite"
+        if "s3:PutObject" in actions:
+            return "writeonly"
+        if "s3:GetObject" in actions:
+            return "readonly"
+        return "none"
+
+    def _classify_policy(self, bucket: str, prefix: str) -> str:
+        want = f"arn:aws:s3:::{bucket}/{prefix}*" if prefix else \
+            f"arn:aws:s3:::{bucket}/*"
+        for st in self._bucket_statements(bucket):
+            if want in st.resources:
+                kind = self._statement_kind(st)
+                if kind != "none":
+                    return kind
+        return "none"
+
+    # -- upload / download / zip ------------------------------------------
+
+    def _upload(self, ctx: RequestContext, rest: str) -> HTTPResponse:
+        if ctx.req.method != "PUT":
+            return HTTPResponse(status=405)
+        bucket, _, key = rest.partition("/")
+        cred, owner = self._request_auth(ctx, want_typ=("web", "url"))
+        if not key:
+            raise S3Error("InvalidArgument", "missing object name")
+        if not self._allowed(cred, owner, "s3:PutObject", bucket, key):
+            raise S3Error("AccessDenied")
+        from ..object.hash_reader import HashReader
+        size = max(ctx.content_length, 0)
+        reader = HashReader(ctx.body_stream, size)
+        metadata = {}
+        if ctx.header("content-type"):
+            metadata["content-type"] = ctx.header("content-type")
+        from ..object.engine import PutOptions
+        versioned = self.api.bucket_meta.versioning_enabled(bucket)
+        info = self.api.obj.put_object(
+            bucket, key, reader, size,
+            PutOptions(metadata=metadata, versioned=versioned))
+        self.api.bandwidth.record(bucket, "rx", max(size, 0))
+        return HTTPResponse(headers={"ETag": f'"{info.etag}"'})
+
+    def _download(self, ctx: RequestContext, rest: str) -> HTTPResponse:
+        if ctx.req.method != "GET":
+            return HTTPResponse(status=405)
+        bucket, _, key = rest.partition("/")
+        cred, owner = self._request_auth(ctx, want_typ=("web", "url"))
+        if not self._allowed(cred, owner, "s3:GetObject", bucket, key):
+            raise S3Error("AccessDenied")
+        info = self.api.obj.get_object_info(bucket, key)
+        _info, stream = self.api.obj.get_object(bucket, key, 0, info.size)
+        self.api.bandwidth.record(bucket, "tx", info.size)
+        name = key.rsplit("/", 1)[-1] or "download"
+        return HTTPResponse(
+            headers={
+                "Content-Type": "application/octet-stream",
+                "Content-Length": str(info.size),
+                "Content-Disposition": _attachment(name),
+            },
+            stream=stream)
+
+    def _zip(self, ctx: RequestContext) -> HTTPResponse:
+        """Zip-of-prefix download (reference DownloadZip): body names a
+        bucket, a prefix, and entries; entries ending in '/' expand
+        recursively. Spooled to a temp file so huge selections don't
+        live in memory, streamed out in chunks."""
+        cred, owner = self._request_auth(ctx, want_typ=("web", "url"))
+        try:
+            args = json.loads(ctx.read_body() or b"{}")
+        except ValueError:
+            raise S3Error("InvalidArgument", "malformed body") from None
+        bucket = str(args.get("bucketName", ""))
+        prefix = str(args.get("prefix", ""))
+        objects = [str(o) for o in args.get("objects", [])]
+        if not bucket or not objects:
+            raise S3Error("InvalidArgument", "bucketName/objects required")
+
+        keys: list[str] = []
+        for entry in objects:
+            full = prefix + entry
+            if entry.endswith("/") or entry == "":
+                if not self._allowed(cred, owner, "s3:ListBucket", bucket):
+                    raise S3Error("AccessDenied")
+                marker = ""
+                while True:
+                    objs, _p, trunc = self.api.obj.list_objects(
+                        bucket, prefix=full, marker=marker, max_keys=1000)
+                    keys.extend(o.name for o in objs)
+                    if not trunc or not objs:
+                        break
+                    marker = objs[-1].name
+            else:
+                keys.append(full)
+        for k in keys:
+            if not self._allowed(cred, owner, "s3:GetObject", bucket, k):
+                raise S3Error("AccessDenied")
+
+        import tempfile
+        spool = tempfile.SpooledTemporaryFile(max_size=64 << 20)
+        total = 0
+        with zipfile.ZipFile(spool, "w", zipfile.ZIP_DEFLATED) as zf:
+            for k in keys:
+                info = self.api.obj.get_object_info(bucket, k)
+                _i, stream = self.api.obj.get_object(bucket, k, 0,
+                                                     info.size)
+                arcname = k[len(prefix):] if k.startswith(prefix) else k
+                zi = zipfile.ZipInfo(arcname or k)
+                # zf.open honors the ZipInfo's own compress_type
+                # (default STORED), not the archive default
+                zi.compress_type = zipfile.ZIP_DEFLATED
+                with zf.open(zi, "w", force_zip64=True) as dst:
+                    for chunk in stream:
+                        dst.write(chunk)
+                total += info.size
+        self.api.bandwidth.record(bucket, "tx", total)
+        size = spool.tell()
+        spool.seek(0)
+
+        def gen():
+            try:
+                while True:
+                    chunk = spool.read(1 << 20)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                spool.close()
+
+        return HTTPResponse(
+            headers={"Content-Type": "application/zip",
+                     "Content-Length": str(size),
+                     "Content-Disposition": _attachment(f"{bucket}.zip")},
+            stream=gen())
+
+
+def _iso(t: float) -> str:
+    import datetime
+    return datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+_HEADER_UNSAFE = re.compile(r'[\x00-\x1f\x7f"\\]')
+
+
+def _attachment(filename: str) -> str:
+    """Content-Disposition value with the filename made header-safe:
+    object keys are attacker-chosen, and send_header performs no CR/LF
+    validation — an unsanitized key would split the response headers."""
+    safe = _HEADER_UNSAFE.sub("_", filename)
+    return f'attachment; filename="{safe}"'
+
+
+def mount(server) -> WebHandlers:
+    """Attach the web surface to an S3Server (before S3 routing)."""
+    web = WebHandlers(server.api)
+
+    def route(ctx: RequestContext) -> HTTPResponse:
+        try:
+            return web.router(ctx)
+        except (S3Error, oerr.ObjectApiError) as e:
+            status = getattr(e, "status", 400) or 400
+            return HTTPResponse(status=status if isinstance(status, int)
+                                else 400,
+                                body=str(e).encode())
+        except Exception:  # noqa: BLE001 — never abort the connection
+            return HTTPResponse(status=500, body=b"internal error")
+
+    server.register_router("/minio/webrpc", route)
+    server.register_router("/minio/web/", route)
+    return web
