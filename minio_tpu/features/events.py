@@ -20,19 +20,23 @@ ListenNotification feed).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import fnmatch
 import hashlib
+import hmac
 import json
 import os
 import queue
 import socket
+import struct
 import threading
 import time
 import urllib.error
 import urllib.request
 import uuid as _uuid
 import xml.etree.ElementTree as ET
+import zlib
 from typing import Callable, Optional
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
@@ -584,12 +588,13 @@ class NSQTarget:
 
 class PostgresTarget:
     """Event delivery over the PostgreSQL v3 wire protocol
-    (pkg/event/target/postgresql.go): startup + cleartext/MD5 password
-    auth, then simple-query INSERTs. format="namespace" upserts one row
-    per object key (and deletes on removal events); format="access"
-    appends. Reference table contract: namespace = (key TEXT PRIMARY
-    KEY, value TEXT/JSONB), access = (event_time TIMESTAMP, event_data
-    TEXT/JSONB). SCRAM auth is not implemented (use md5 or trust).
+    (pkg/event/target/postgresql.go): startup + cleartext/MD5/
+    SCRAM-SHA-256 password auth (the modern server default; mutual
+    proof verification per RFC 7677), then simple-query INSERTs.
+    format="namespace" upserts one row per object key (and deletes on
+    removal events); format="access" appends. Reference table
+    contract: namespace = (key TEXT PRIMARY KEY, value TEXT/JSONB),
+    access = (event_time TIMESTAMP, event_data TEXT/JSONB).
     """
 
     def __init__(self, arn: str, addr: str, database: str, table: str,
@@ -657,10 +662,64 @@ class PostgresTarget:
                     inner.encode() + salt).hexdigest()
                 s.sendall(self._msg(
                     b"p", b"md5" + digest.encode() + b"\x00"))
+            elif code == 10:                    # SASL (RFC 5802/7677)
+                # modern servers default to scram-sha-256 — speak it
+                mechs = [m.decode() for m in
+                         payload[4:].split(b"\x00") if m]
+                if "SCRAM-SHA-256" not in mechs:
+                    raise OSError(
+                        "postgres offers no SCRAM-SHA-256 "
+                        f"mechanism (got {mechs})")
+                import secrets as _secrets
+                nonce = base64.b64encode(
+                    _secrets.token_bytes(18)).decode()
+                # user is empty in gs2: the startup message names it
+                first_bare = f"n=,r={nonce}"
+                init = b"n,," + first_bare.encode()
+                body = (b"SCRAM-SHA-256\x00"
+                        + len(init).to_bytes(4, "big") + init)
+                s.sendall(self._msg(b"p", body))
+                scram_state = (nonce, first_bare)
+            elif code == 11:                    # SASLContinue
+                nonce, first_bare = scram_state
+                server_first = payload[4:].decode()
+                fields = dict(kv.split("=", 1) for kv in
+                              server_first.split(","))
+                srv_nonce, salt_b64 = fields["r"], fields["s"]
+                iters = int(fields["i"])
+                if not srv_nonce.startswith(nonce):
+                    raise OSError("postgres scram: server nonce "
+                                  "does not extend ours")
+                salted = hashlib.pbkdf2_hmac(
+                    "sha256", self.password.encode(),
+                    base64.b64decode(salt_b64), iters)
+                ckey = hmac.new(salted, b"Client Key",
+                                hashlib.sha256).digest()
+                stored = hashlib.sha256(ckey).digest()
+                final_bare = f"c=biws,r={srv_nonce}"
+                auth_msg = ",".join(
+                    (first_bare, server_first, final_bare)).encode()
+                csig = hmac.new(stored, auth_msg,
+                                hashlib.sha256).digest()
+                proof = bytes(a ^ b for a, b in zip(ckey, csig))
+                skey = hmac.new(salted, b"Server Key",
+                                hashlib.sha256).digest()
+                scram_verify = hmac.new(skey, auth_msg,
+                                        hashlib.sha256).digest()
+                s.sendall(self._msg(
+                    b"p", (final_bare + ",p="
+                           + base64.b64encode(proof).decode()
+                           ).encode()))
+            elif code == 12:                    # SASLFinal
+                fields = dict(kv.split("=", 1) for kv in
+                              payload[4:].decode().split(","))
+                if base64.b64decode(fields.get("v", "")) != \
+                        scram_verify:
+                    raise OSError("postgres scram: bad server "
+                                  "signature (not the real server?)")
             else:
                 raise OSError(
-                    f"unsupported postgres auth method {code} "
-                    "(scram not implemented; use md5 or trust)")
+                    f"unsupported postgres auth method {code}")
         # drain ParameterStatus/BackendKeyData until ReadyForQuery
         while True:
             tag, payload = self._read_msg(f)
@@ -714,10 +773,11 @@ class PostgresTarget:
 class MySQLTarget:
     """Event delivery over the MySQL client/server protocol
     (pkg/event/target/mysql.go): handshake v10 with
-    mysql_native_password auth (SHA1(pw) XOR SHA1(salt+SHA1(SHA1(pw)))),
-    then COM_QUERY statements. Same table contract and formats as the
-    Postgres target. caching_sha2_password is not implemented — create
-    the notify user WITH mysql_native_password."""
+    mysql_native_password or caching_sha2_password (the 8.0+ default;
+    fast-auth path — the full-auth RSA exchange needs TLS and fails
+    with a clear action), honoring server AuthSwitchRequest, then
+    COM_QUERY statements. Same table contract and formats as the
+    Postgres target."""
 
     CLIENT_LONG_PASSWORD = 0x1
     CLIENT_CONNECT_WITH_DB = 0x8
@@ -763,12 +823,23 @@ class MySQLTarget:
                 + payload)
 
     def _scramble(self, salt: bytes) -> bytes:
+        """mysql_native_password token."""
         if not self.password:
             return b""
         h1 = hashlib.sha1(self.password.encode()).digest()
         h2 = hashlib.sha1(h1).digest()
         h3 = hashlib.sha1(salt + h2).digest()
         return bytes(a ^ b for a, b in zip(h1, h3))
+
+    def _scramble_sha2(self, nonce: bytes) -> bytes:
+        """caching_sha2_password token: XOR(SHA256(pw),
+        SHA256(SHA256(SHA256(pw)) || nonce)) — the modern server
+        default (8.0+)."""
+        if not self.password:
+            return b""
+        h1 = hashlib.sha256(self.password.encode()).digest()
+        h2 = hashlib.sha256(hashlib.sha256(h1).digest() + nonce).digest()
+        return bytes(a ^ b for a, b in zip(h1, h2))
 
     @staticmethod
     def _check_ok(payload: bytes, what: str) -> None:
@@ -813,12 +884,20 @@ class MySQLTarget:
             at += 8 + 1                         # salt part 1 + filler
             at += 2 + 1 + 2 + 2 + 1 + 10        # caps, charset, status…
             salt += greet[at:at + 12]           # salt part 2 (of 13-1)
+            at += 12 + 1                        # salt part 2 + NUL
+            end = greet.find(b"\x00", at)
+            plugin = greet[at:end if end >= 0 else None].decode(
+                "ascii", "replace") or "mysql_native_password"
             caps = (self.CLIENT_LONG_PASSWORD | self.CLIENT_PROTOCOL_41
                     | self.CLIENT_SECURE_CONNECTION
                     | self.CLIENT_PLUGIN_AUTH)
             if self.database:
                 caps |= self.CLIENT_CONNECT_WITH_DB
-            token = self._scramble(salt)
+            if plugin == "caching_sha2_password":
+                token = self._scramble_sha2(salt)
+            else:
+                plugin = "mysql_native_password"
+                token = self._scramble(salt)
             resp = (caps.to_bytes(4, "little")
                     + (1 << 24).to_bytes(4, "little")   # max packet
                     + bytes([33]) + bytes(23)           # utf8 + filler
@@ -828,15 +907,46 @@ class MySQLTarget:
                 # selected in the handshake (CLIENT_CONNECT_WITH_DB):
                 # no per-event USE round trip, no identifier splicing
                 resp += self.database.encode() + b"\x00"
-            resp += b"mysql_native_password\x00"
+            resp += plugin.encode() + b"\x00"
             s.sendall(self._packet(1, resp))
-            _seq, auth = self._read_packet(f)
+            seq, auth = self._read_packet(f)
             self._check_ok(auth, "auth")
             if auth[:1] == b"\xfe":
-                raise OSError(
-                    "mysql requested an auth method switch "
-                    "(caching_sha2_password?); create the notify user "
-                    "WITH mysql_native_password")
+                # AuthSwitchRequest: plugin name NUL, then new nonce
+                end = auth.index(b"\x00", 1)
+                new_plugin = auth[1:end].decode("ascii", "replace")
+                new_salt = auth[end + 1:].rstrip(b"\x00")
+                if new_plugin == "mysql_native_password":
+                    token = self._scramble(new_salt)
+                elif new_plugin == "caching_sha2_password":
+                    token = self._scramble_sha2(new_salt)
+                else:
+                    raise OSError(
+                        f"mysql requested unsupported auth plugin "
+                        f"{new_plugin!r}")
+                s.sendall(self._packet(seq + 1, token))
+                seq, auth = self._read_packet(f)
+                self._check_ok(auth, "auth switch")
+                plugin = new_plugin
+            if plugin == "caching_sha2_password" and \
+                    auth[:1] == b"\x01":
+                # AuthMoreData: 0x03 = fast-auth success (an OK packet
+                # follows); 0x04 = full auth, which needs TLS or the
+                # server RSA key exchange — fail with a clear action
+                if auth[1:2] == b"\x03":
+                    _seq, auth = self._read_packet(f)
+                    self._check_ok(auth, "auth")
+                elif auth[1:2] == b"\x04":
+                    raise OSError(
+                        "mysql caching_sha2_password full "
+                        "authentication requires TLS (no cached "
+                        "entry for this user); connect once with "
+                        "another client to prime the cache, or "
+                        "create the notify user WITH "
+                        "mysql_native_password")
+                else:
+                    raise OSError("mysql: unexpected AuthMoreData "
+                                  f"{auth[1:2]!r}")
             for stmt in ("SET SESSION sql_mode = "
                          "'NO_BACKSLASH_ESCAPES'", sql):
                 s.sendall(self._packet(0, b"\x03" + stmt.encode()))
@@ -893,39 +1003,283 @@ class ElasticsearchTarget:
             raise
 
 
+# -- Kafka wire protocol (pkg/event/target/kafka.go semantics) --------------
+#
+# The reference drives Kafka through sarama; this speaks the protocol
+# itself: ApiVersions v0 handshake, Metadata v1 for leader discovery,
+# Produce v2 with a MessageSet v1 (magic-1 messages: CRC32, timestamp,
+# key = object key, value = event JSON). Partition choice mirrors
+# sarama's default hash partitioner: FNV-1a(key) mod numPartitions.
+
+_K_PRODUCE, _K_METADATA, _K_APIVERSIONS = 0, 3, 18
+
+
+def _k_str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    raw = s.encode()
+    return struct.pack(">h", len(raw)) + raw
+
+
+def _k_bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _KReader:
+    """Big-endian cursor over one Kafka response payload."""
+
+    def __init__(self, raw: bytes):
+        self.raw, self.at = raw, 0
+
+    def take(self, n: int) -> bytes:
+        if self.at + n > len(self.raw):
+            raise OSError("kafka: truncated response")
+        out = self.raw[self.at:self.at + n]
+        self.at += n
+        return out
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self.take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.take(8))[0]
+
+    def string(self) -> str:
+        n = self.i16()
+        return "" if n < 0 else self.take(n).decode()
+
+
+def _fnv1a32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _sarama_partition(key: bytes, n: int) -> int:
+    """sarama's default hash partitioner, bit-for-bit: p = int32(fnv1a)
+    % n with Go's truncate-toward-zero modulo, negated if negative
+    (even the int32-min overflow case matches)."""
+    h = _fnv1a32(key)
+    if h >= 1 << 31:
+        h -= 1 << 32                    # int32 view
+    p = h - int(h / n) * n              # Go %: truncated, sign of h
+    return -p if p < 0 else p
+
+
+class _KafkaConn:
+    """One broker connection: framed request/response with correlation
+    id checking."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 timeout: float):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self.client_id = client_id
+        self._corr = 0
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def call(self, api_key: int, api_version: int, body: bytes
+             ) -> _KReader:
+        self._corr += 1
+        header = struct.pack(">hhi", api_key, api_version, self._corr) \
+            + _k_str(self.client_id)
+        msg = header + body
+        self.sock.sendall(struct.pack(">i", len(msg)) + msg)
+        raw = self._read_exact(4)
+        (size,) = struct.unpack(">i", raw)
+        payload = self._read_exact(size)
+        r = _KReader(payload)
+        corr = r.i32()
+        if corr != self._corr:
+            raise OSError(f"kafka: correlation id {corr} != {self._corr}")
+        return r
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("kafka: connection closed")
+            buf += chunk
+        return buf
+
+
 class KafkaTarget:
-    """Kafka-shaped target (pkg/event/target/kafka.go): key = object
-    key, value = event JSON, routed to `topic`. The broker wire
-    protocol requires a client library this image doesn't ship, so the
-    producer is pluggable: pass `producer(topic, key, value)` (tests
-    inject one; production wires kafka-python/confluent when present).
-    """
+    """Kafka target speaking the real produce protocol
+    (pkg/event/target/kafka.go): key = object key, value = event JSON,
+    routed to `topic` on the partition leader. A custom `producer`
+    remains injectable for embedding."""
 
     def __init__(self, arn: str, brokers: list[str], topic: str,
-                 producer: Optional[Callable] = None):
+                 producer: Optional[Callable] = None,
+                 client_id: str = "minio-tpu", timeout: float = 10.0):
         self.arn, self.brokers, self.topic = arn, brokers, topic
-        self._producer = producer    # resolved lazily on first send:
-        # building a broker connection in __init__ would run inside
-        # ConfigSys.apply() on node startup and crash the boot when the
-        # broker is temporarily down — deferring lets the queuestore
-        # retry machinery absorb the outage instead
+        self.client_id, self.timeout = client_id, timeout
+        self._producer = producer    # wire client built lazily on first
+        # send: connecting in __init__ would run inside ConfigSys.apply
+        # on node startup and crash the boot when the broker is down —
+        # deferring lets the queuestore retry machinery absorb it
+        self._meta: Optional[tuple[dict, dict]] = None
+        self._conns: dict[int, _KafkaConn] = {}   # node id -> conn
+        self._mu = threading.Lock()
 
-    def _default_producer(self) -> Callable:
-        try:
-            from kafka import KafkaProducer  # type: ignore
-        except ImportError:
-            raise OSError(
-                "no kafka client library available; inject a "
-                "producer or install kafka-python") from None
-        kp = KafkaProducer(bootstrap_servers=self.brokers)
+    # -- wire producer -----------------------------------------------------
 
-        def produce(topic, key, value):
-            kp.send(topic, key=key, value=value).get(timeout=10)
-        return produce
+    def _connect_any(self) -> _KafkaConn:
+        last: Optional[Exception] = None
+        for b in self.brokers:
+            host, _, port = b.partition(":")
+            try:
+                conn = _KafkaConn(host, int(port or 9092),
+                                  self.client_id, self.timeout)
+                self._handshake(conn)
+                return conn
+            except (OSError, ValueError) as e:
+                last = e
+        raise OSError(f"kafka: no broker reachable: {last}")
+
+    @staticmethod
+    def _handshake(conn: _KafkaConn) -> None:
+        """ApiVersions v0: confirm the broker speaks Produce v2 and
+        Metadata v1 before using them."""
+        r = conn.call(_K_APIVERSIONS, 0, b"")
+        err = r.i16()
+        if err:
+            raise OSError(f"kafka: ApiVersions error {err}")
+        supported = {}
+        for _ in range(r.i32()):
+            key, lo, hi = r.i16(), r.i16(), r.i16()
+            supported[key] = (lo, hi)
+        for key, need in ((_K_PRODUCE, 2), (_K_METADATA, 1)):
+            lo, hi = supported.get(key, (0, -1))
+            if not lo <= need <= hi:
+                raise OSError(
+                    f"kafka: broker lacks api {key} v{need}")
+
+    def _metadata(self, conn: _KafkaConn
+                  ) -> tuple[dict[int, tuple[str, int]],
+                             dict[int, int]]:
+        """Metadata v1 for the topic: returns ({node: (host, port)},
+        {partition: leader_node})."""
+        body = struct.pack(">i", 1) + _k_str(self.topic)
+        r = conn.call(_K_METADATA, 1, body)
+        brokers = {}
+        for _ in range(r.i32()):
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            r.string()                       # rack (nullable)
+            brokers[node] = (host, port)
+        r.i32()                              # controller id
+        leaders: dict[int, int] = {}
+        for _ in range(r.i32()):             # topics
+            terr = r.i16()
+            name = r.string()
+            r.i8()                           # is_internal
+            nparts = r.i32()
+            for _ in range(nparts):
+                perr = r.i16()
+                pid = r.i32()
+                leader = r.i32()
+                for _ in range(r.i32()):
+                    r.i32()                  # replicas
+                for _ in range(r.i32()):
+                    r.i32()                  # isr
+                if name == self.topic and perr == 0:
+                    leaders[pid] = leader
+            if name == self.topic and terr:
+                raise OSError(f"kafka: topic {name} error {terr}")
+        if not leaders:
+            raise OSError(f"kafka: topic {self.topic} has no partitions")
+        return brokers, leaders
+
+    @staticmethod
+    def _message_set(key: bytes, value: bytes) -> bytes:
+        """MessageSet v1: one magic-1 message, CRC over everything
+        after the crc field."""
+        ts_ms = int(time.time() * 1000)
+        content = struct.pack(">bbq", 1, 0, ts_ms) \
+            + _k_bytes(key) + _k_bytes(value)
+        msg = struct.pack(">I", zlib.crc32(content)) + content
+        return struct.pack(">qi", 0, len(msg)) + msg
+
+    def _reset(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+        self._meta = None
+
+    def _leader_conn(self, node: int, host: str, port: int
+                     ) -> _KafkaConn:
+        conn = self._conns.get(node)
+        if conn is None:
+            conn = _KafkaConn(host, port, self.client_id, self.timeout)
+            self._conns[node] = conn
+        return conn
+
+    def _wire_produce(self, topic: str, key: bytes, value: bytes
+                      ) -> None:
+        """Metadata and leader connections are cached across events —
+        one produce is one request on a standing connection, not two
+        fresh TCP connects + handshake + metadata per event. Any
+        OSError drops the cache and retries once (leader moved, broker
+        restarted); the second failure surfaces to the queuestore."""
+        with self._mu:
+            for attempt in (0, 1):
+                try:
+                    if self._meta is None:
+                        conn = self._connect_any()
+                        try:
+                            self._meta = self._metadata(conn)
+                        finally:
+                            conn.close()
+                    brokers, leaders = self._meta
+                    pids = sorted(leaders)
+                    pid = pids[_sarama_partition(key, len(pids))]
+                    host, port = brokers[leaders[pid]]
+                    conn = self._leader_conn(leaders[pid], host, port)
+                    mset = self._message_set(key, value)
+                    body = (struct.pack(">hi", 1,
+                                        int(self.timeout * 1000))
+                            + struct.pack(">i", 1) + _k_str(topic)
+                            + struct.pack(">i", 1)
+                            + struct.pack(">i", pid)
+                            + struct.pack(">i", len(mset)) + mset)
+                    r = conn.call(_K_PRODUCE, 2, body)
+                    for _ in range(r.i32()):         # topics
+                        r.string()
+                        for _ in range(r.i32()):     # partition responses
+                            r.i32()                  # partition
+                            err = r.i16()
+                            r.i64()                  # base offset
+                            r.i64()                  # log append time
+                            if err:
+                                raise OSError(
+                                    f"kafka: produce error {err}")
+                    return
+                except OSError:
+                    self._reset()
+                    if attempt:
+                        raise
 
     def send(self, record: dict) -> None:
         if self._producer is None:
-            self._producer = self._default_producer()
+            self._producer = self._wire_produce
         rec = record["Records"][0]
         key = rec["s3"]["object"]["key"].encode()
         self._producer(self.topic, key, json.dumps(record).encode())

@@ -319,14 +319,27 @@ class WebHandlers:
                     for o in objs:
                         self._require(cred, owner, "s3:DeleteObject",
                                       bucket, o.name)
-                        self.api.obj.delete_object(bucket, o.name)
+                        self._delete_one(ctx, cred, bucket, o.name)
                     if not trunc or not objs:
                         break
                     marker = objs[-1].name
             else:
                 self._require(cred, owner, "s3:DeleteObject", bucket, key)
-                self.api.obj.delete_object(bucket, key)
+                self._delete_one(ctx, cred, bucket, key)
         return {"uiVersion": UI_VERSION}
+
+    def _delete_one(self, ctx, cred, bucket: str, key: str) -> None:
+        """Delete with the SAME semantics as the S3 DELETE path: WORM
+        retention enforced, versioned buckets get a delete marker, and
+        the removal event fires (the first web cut bypassed all three)."""
+        versioned = self.api.bucket_meta.versioning_enabled(bucket)
+        ctx.cred = cred                 # governance-bypass check input
+        self.api._enforce_object_lock(ctx, bucket, key, "", versioned)
+        try:
+            self.api.obj.delete_object(bucket, key, versioned=versioned)
+        except oerr.ObjectNotFound:
+            pass
+        self.api._notify("s3:ObjectRemoved:Delete", bucket, key)
 
     def rpc_generateauth(self, ctx, args) -> dict:
         _cred, owner = self._request_auth(ctx)
@@ -486,18 +499,30 @@ class WebHandlers:
             raise S3Error("InvalidArgument", "missing object name")
         if not self._allowed(cred, owner, "s3:PutObject", bucket, key):
             raise S3Error("AccessDenied")
-        from ..object.hash_reader import HashReader
+        from .handlers import MAX_OBJECT_SIZE
         size = max(ctx.content_length, 0)
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        # same enforcement as the S3 PUT path: quota, bucket default
+        # retention, creation event
+        self.api._enforce_quota(bucket, size)
+        from ..object.hash_reader import HashReader
         reader = HashReader(ctx.body_stream, size)
         metadata = {}
         if ctx.header("content-type"):
             metadata["content-type"] = ctx.header("content-type")
+        from ..features import objectlock as olock
+        lock_cfg = self.api.bucket_meta.get(bucket).object_lock_xml
+        if lock_cfg:
+            olock.DefaultRetention.from_config_xml(lock_cfg).apply_to(
+                metadata)
         from ..object.engine import PutOptions
         versioned = self.api.bucket_meta.versioning_enabled(bucket)
         info = self.api.obj.put_object(
             bucket, key, reader, size,
             PutOptions(metadata=metadata, versioned=versioned))
         self.api.bandwidth.record(bucket, "rx", max(size, 0))
+        self.api._notify("s3:ObjectCreated:Put", bucket, key)
         return HTTPResponse(headers={"ETag": f'"{info.etag}"'})
 
     def _download(self, ctx: RequestContext, rest: str) -> HTTPResponse:
