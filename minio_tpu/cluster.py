@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Optional
 
@@ -212,7 +213,25 @@ class ClusterNode:
         self.s3.api.bucket_meta.on_change = \
             lambda b: self.notification.reload_bucket_metadata(b)
         self._peer_rpc.reload_iam = self.iam.load
+        self._peer_rpc.apply_iam_delta = self.iam.apply_delta
         self.iam.on_change = self.notification.reload_iam
+        self.iam.on_delta = self.notification.iam_delta
+        # bounded staleness: a delta lost to a transient partition (the
+        # sender's per-peer reload fallback failing too) must not
+        # diverge this node forever — refresh the whole cache on an
+        # interval like the reference's IAM refresh loop
+        refresh_s = float(os.environ.get("MINIO_TPU_IAM_REFRESH_S",
+                                         "300"))
+        self._iam_refresh_stop = threading.Event()
+
+        def _iam_refresh_loop():
+            while not self._iam_refresh_stop.wait(refresh_s):
+                try:
+                    self.iam.load()
+                except Exception:  # noqa: BLE001 — retry next tick
+                    pass
+
+        threading.Thread(target=_iam_refresh_loop, daemon=True).start()
         self._peer_rpc.get_storage_info = self.object_layer.storage_info
         self._peer_rpc.get_trace = \
             lambda: list(self.s3.api.trace.recent)
@@ -339,6 +358,8 @@ class ClusterNode:
 
     def shutdown(self) -> None:
         """Idempotent; safe on a partially-booted node."""
+        if getattr(self, "_iam_refresh_stop", None) is not None:
+            self._iam_refresh_stop.set()
         if getattr(self, "disk_monitor", None) is not None:
             self.disk_monitor.close()
             self.disk_monitor = None

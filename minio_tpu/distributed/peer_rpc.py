@@ -35,6 +35,12 @@ class PeerRPCServer:
         self.get_locks: Callable[[], dict] = lambda: {}
         self.reload_bucket_metadata: Callable[[str], None] = lambda b: None
         self.reload_iam: Callable[[], None] = lambda: None
+        # granular IAM delta application (reference per-entity
+        # LoadUser/LoadGroup/LoadPolicy verbs); falls back to reload_iam
+        self.apply_iam_delta: Optional[Callable[[str, str], None]] = None
+        # bounded-staleness self-heal: peers also refresh the full IAM
+        # cache periodically (cluster wires this), so a delta lost to a
+        # transient partition can't diverge a node forever
         self.signal_service: Callable[[str], None] = lambda sig: None
         self.get_metrics: Callable[[], dict] = lambda: {}
         self.get_storage_info: Callable[[], dict] = lambda: {}
@@ -53,6 +59,7 @@ class PeerRPCServer:
         h.register("locks", lambda a, b: self.get_locks())
         h.register("reload-bucket-metadata", self._reload_bm)
         h.register("reload-iam", lambda a, b: self.reload_iam())
+        h.register("iam-delta", self._iam_delta)
         h.register("signal", self._signal)
         h.register("metrics", lambda a, b: self.get_metrics())
         h.register("storage-info", lambda a, b: self.get_storage_info())
@@ -64,6 +71,11 @@ class PeerRPCServer:
         h.register("profiling-stop", self._profiling_stop)
         h.register("console-log", self._console_log)
         h.register("obd", self._obd)
+        # OBD net perf: the caller times pushing a payload here; this
+        # side only confirms how much arrived (cmd/obdinfo.go's
+        # peer-to-peer net throughput probes)
+        h.register("net-probe", lambda a, b: {
+            "node": self.node_id, "received": len(b)})
         h.register("tracker-rotate", self._tracker_rotate)
         h.register("bandwidth", lambda a, b: self.get_bandwidth())
 
@@ -74,12 +86,16 @@ class PeerRPCServer:
 
     def _profiling_start(self, args, body):
         from ..utils import profiling
-        return {"node": self.node_id, "started": profiling.start()}
+        kinds = profiling.parse_kinds(args.get("kinds", "cpu")) or ["cpu"]
+        return {"node": self.node_id,
+                "started": {k: profiling.start(k) for k in kinds}}
 
     def _profiling_stop(self, args, body):
         from ..utils import profiling
+        kinds = profiling.parse_kinds(args.get("kinds", "cpu")) or ["cpu"]
         return {"node": self.node_id,
-                "profile": profiling.stop_text() or ""}
+                "profiles": {k: profiling.stop_text(k) or ""
+                             for k in kinds}}
 
     def _console_log(self, args, body):
         from ..utils.console import get_console
@@ -95,6 +111,24 @@ class PeerRPCServer:
         out = local_obd(self.obd_drive_paths)
         out["node"] = self.node_id
         return out
+
+    def _iam_delta(self, args, body):
+        # one RPC carries the whole mutation cascade (remove_user emits
+        # user + mapping + every derived svcacct/sts in one batch)
+        pairs: list = []
+        if body:
+            try:
+                raw = json.loads(body.decode())
+                pairs = [(str(k), str(n)) for k, n in raw]
+            except (ValueError, TypeError):
+                pairs = []
+        if not pairs and args.get("kind"):
+            pairs = [(args.get("kind", ""), args.get("name", ""))]
+        if self.apply_iam_delta is not None:
+            for kind, name in pairs:
+                self.apply_iam_delta(kind, name)
+        else:
+            self.reload_iam()
 
     def _reload_bm(self, args, body):
         self.reload_bucket_metadata(args.get("bucket", ""))
@@ -138,6 +172,13 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return False
 
+    def iam_delta(self, pairs: list) -> bool:
+        try:
+            self.rc.call_json("iam-delta", payload=list(pairs))
+            return True
+        except (NetworkError, RPCError):
+            return False
+
     def signal_service(self, sig: str) -> bool:
         try:
             self.rc.call("signal", {"sig": sig})
@@ -169,15 +210,17 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return {}
 
-    def profiling_start(self) -> Optional[dict]:
+    def profiling_start(self, kinds: str = "cpu") -> Optional[dict]:
         try:
-            return self.rc.call_json("profiling-start")
+            return self.rc.call_json("profiling-start",
+                                     {"kinds": kinds})
         except (NetworkError, RPCError):
             return None
 
-    def profiling_stop(self) -> Optional[dict]:
+    def profiling_stop(self, kinds: str = "cpu") -> Optional[dict]:
         try:
-            return self.rc.call_json("profiling-stop")
+            return self.rc.call_json("profiling-stop",
+                                     {"kinds": kinds})
         except (NetworkError, RPCError):
             return None
 
@@ -193,6 +236,36 @@ class PeerRPCClient:
             return self.rc.call_json("obd")
         except (NetworkError, RPCError):
             return None
+
+    def net_probe(self, size: int = 4 << 20) -> Optional[dict]:
+        """Timed payload push to this peer: internode throughput + a
+        small-ping RTT (the OBD net perf section). Each RestClient call
+        opens a fresh connection, so a warm-up ping runs first and the
+        empty-call baseline (connect + request overhead) is subtracted
+        from the payload timing — the reported throughput approximates
+        the transfer itself, not TCP setup."""
+        import json as _json
+        try:
+            self.rc.call("net-probe", body=b"")     # warm-up, untimed
+            rtt = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                self.rc.call("net-probe", body=b"")
+                dt = time.perf_counter() - t0
+                rtt = dt if rtt is None else min(rtt, dt)
+            payload = b"\x00" * size
+            t0 = time.perf_counter()
+            raw = self.rc.call("net-probe", body=payload)
+            dt = max(time.perf_counter() - t0 - (rtt or 0.0), 1e-9)
+            out = _json.loads(raw.decode()) if raw else None
+        except (NetworkError, RPCError, ValueError):
+            return None
+        if not isinstance(out, dict) or out.get("received") != size:
+            return None
+        return {"peer": f"{self.rc.host}:{self.rc.port}",
+                "bytes": size,
+                "rtt_us": round((rtt or 0.0) * 1e6),
+                "throughput_mib_s": round(size / dt / 2**20, 2)}
 
     def tracker_rotate(self) -> Optional[dict]:
         try:
@@ -247,6 +320,16 @@ class NotificationSys:
     def reload_iam(self) -> list:
         return self._broadcast(lambda p: p.reload_iam())
 
+    def iam_delta(self, pairs: list) -> list:
+        """Per-entity IAM propagation: one small RPC per peer carrying
+        the mutation's whole (kind, name) batch — not an O(all-entities)
+        store re-walk. A peer that misses the delta gets a wholesale
+        reload attempt instead; one that misses both is offline and
+        re-syncs via its periodic refresh / boot-time load."""
+        def one(p: PeerRPCClient) -> bool:
+            return p.iam_delta(pairs) or p.reload_iam()
+        return self._broadcast(one)
+
     def top_locks(self) -> dict:
         merged: dict = {}
         for locks in self._broadcast(lambda p: p.locks()):
@@ -270,11 +353,11 @@ class NotificationSys:
         merged.sort(key=lambda e: e.get("time", ""))
         return merged
 
-    def profiling_start_all(self) -> list:
-        return self._broadcast(lambda p: p.profiling_start())
+    def profiling_start_all(self, kinds: str = "cpu") -> list:
+        return self._broadcast(lambda p: p.profiling_start(kinds))
 
-    def profiling_stop_all(self) -> list:
-        return self._broadcast(lambda p: p.profiling_stop())
+    def profiling_stop_all(self, kinds: str = "cpu") -> list:
+        return self._broadcast(lambda p: p.profiling_stop(kinds))
 
     def console_log_all(self, count: int = 0) -> list[dict]:
         """Cluster-wide console entries, time-ordered."""
@@ -289,6 +372,26 @@ class NotificationSys:
     def obd_all(self) -> list[dict]:
         return [r for r in self._broadcast(lambda p: p.obd())
                 if isinstance(r, dict)]
+
+    def net_obd(self, size: int = 4 << 20) -> list[dict]:
+        """This node's view of the interconnect: timed payload push to
+        every peer, SEQUENTIALLY — concurrent probes would share the
+        NIC and report contention, not per-link capacity (the reference
+        probes peers one at a time for the same reason). Unreachable
+        peers are reported as such rather than dropped."""
+        out = []
+        for p in self.peers:
+            r = None
+            try:
+                r = p.net_probe(size)
+            except Exception:  # noqa: BLE001 — per-peer result
+                r = None
+            if isinstance(r, dict):
+                out.append(r)
+            else:
+                out.append({"peer": f"{p.rc.host}:{p.rc.port}",
+                            "error": "unreachable"})
+        return out
 
     def tracker_rotate_all(self) -> list[Optional[dict]]:
         """One entry per peer: the rotated tracker snapshot, or None

@@ -58,6 +58,9 @@ class IAMSys:
         # cluster hook: called with no args after every mutation so peers
         # reload (reference NotificationSys.LoadUser/LoadPolicy etc.)
         self.on_change: Optional[Callable[[], None]] = None
+        # granular peer propagation: called with the mutation's whole
+        # [(kind, name), ...] batch; when unset, on_change (wholesale)
+        self.on_delta: Optional[Callable[[list], None]] = None
         # bucket policy lookup seam (bucket -> policy JSON or "")
         self.bucket_policy_lookup: Optional[Callable[[str], str]] = None
         if self.obj is not None:
@@ -154,12 +157,117 @@ class IAMSys:
                 if not c.is_expired() or c.expiration > now:
                     self.sts_creds[ak] = c
 
-    def _notify(self) -> None:
+    def _notify(self, kind: str = "", name: str = "") -> None:
+        self._notify_batch([(kind, name)] if kind else [])
+
+    def _notify_batch(self, pairs: list) -> None:
+        """Propagate a mutation to peers. With (kind, name) deltas and
+        an on_delta hook, peers reload ONLY those entities — in ONE
+        broadcast round for the whole batch (reference granular
+        LoadUser/LoadGroup/LoadPolicy peer verbs,
+        cmd/peer-rest-common.go:38-46); wholesale reload is the
+        fallback, not the steady state (it is O(all users) per change).
+        """
+        if pairs and self.on_delta is not None:
+            try:
+                self.on_delta(pairs)
+                return
+            except Exception:  # noqa: BLE001 — fall back to full reload
+                pass
         if self.on_change is not None:
             try:
                 self.on_change()
             except Exception:  # noqa: BLE001 — peers reload lazily anyway
                 pass
+
+    def _read_one(self, prefix: str, name: str) -> Optional[dict]:
+        """Current on-disk record of one IAM entity, or None when it no
+        longer exists (delta application reads the store, so a delete
+        and a create are the same verb)."""
+        if self.obj is None:
+            return None
+        from ..object import api_errors
+        try:
+            _, stream = self.obj.get_object(
+                MINIO_META_BUCKET, self._path(prefix, name))
+            return json.loads(b"".join(stream).decode())
+        except (api_errors.ObjectApiError, ValueError):
+            return None
+
+    def apply_delta(self, kind: str, name: str) -> None:
+        """Refresh one entity from the store (the receiving side of the
+        peer delta verbs). Unknown kinds degrade to a full load."""
+        d = None
+        if kind in ("user", "group", "policy", "user-policy",
+                    "group-policy", "svcacct", "sts"):
+            prefix = {"user": "users", "group": "groups",
+                      "policy": "policies",
+                      "user-policy": "policydb/users",
+                      "group-policy": "policydb/groups",
+                      "svcacct": "svcaccts", "sts": "sts"}[kind]
+            d = self._read_one(prefix, name)
+        with self._mu:
+            if kind == "user":
+                if d is None:
+                    self.users.pop(name, None)
+                else:
+                    self.users[name] = Credentials(
+                        access_key=name,
+                        secret_key=d.get("secret_key", ""),
+                        status=d.get("status", "on"))
+                return
+            if kind == "group":
+                if d is None:
+                    self.groups.pop(name, None)
+                else:
+                    self.groups[name] = d
+                return
+            if kind == "policy":
+                if d is None:
+                    self.policies.pop(name, None)
+                    if name in CANNED_POLICIES:
+                        self.policies[name] = CANNED_POLICIES[name]
+                else:
+                    try:
+                        self.policies[name] = Policy.from_json(
+                            json.dumps(d))
+                    except (ValueError, KeyError):
+                        pass
+                return
+            if kind == "user-policy":
+                if d is None:
+                    self.user_policy.pop(name, None)
+                else:
+                    self.user_policy[name] = list(d.get("policy", []))
+                return
+            if kind == "group-policy":
+                if d is None:
+                    self.group_policy.pop(name, None)
+                else:
+                    self.group_policy[name] = list(d.get("policy", []))
+                return
+            if kind == "svcacct":
+                if d is None:
+                    self.svc_accounts.pop(name, None)
+                else:
+                    self.svc_accounts[name] = Credentials(
+                        access_key=name,
+                        secret_key=d.get("secret_key", ""),
+                        parent_user=d.get("parent", ""),
+                        status=d.get("status", "on"))
+                return
+            if kind == "sts":
+                if d is None:
+                    self.sts_creds.pop(name, None)
+                else:
+                    self.sts_creds[name] = Credentials(
+                        access_key=name,
+                        secret_key=d.get("secret_key", ""),
+                        session_token=d.get("session_token", ""),
+                        expiration=d.get("expiration", 0.0),
+                        parent_user=d.get("parent", ""))
+                return
+        self.load()
 
     # ------------------------------------------------------------------
     # users / groups / policies CRUD (cmd/admin-handlers-users.go surface)
@@ -174,7 +282,7 @@ class IAMSys:
                        {"secret_key": secret_key, "status": status})
             self.users[access_key] = Credentials(
                 access_key=access_key, secret_key=secret_key, status=status)
-        self._notify()
+        self._notify("user", access_key)
 
     def set_user_status(self, access_key: str, status: str) -> None:
         with self._mu:
@@ -184,9 +292,11 @@ class IAMSys:
             u.status = status
             self._save(self._path("users", access_key),
                        {"secret_key": u.secret_key, "status": status})
-        self._notify()
+        self._notify("user", access_key)
 
     def remove_user(self, access_key: str) -> None:
+        dropped_svc: list[str] = []
+        dropped_sts: list[str] = []
         with self._mu:
             self.users.pop(access_key, None)
             self.user_policy.pop(access_key, None)
@@ -197,11 +307,16 @@ class IAMSys:
                 if c.parent_user == access_key:
                     self.svc_accounts.pop(ak, None)
                     self._delete(self._path("svcaccts", ak))
+                    dropped_svc.append(ak)
             for ak, c in list(self.sts_creds.items()):
                 if c.parent_user == access_key:
                     self.sts_creds.pop(ak, None)
                     self._delete(self._path("sts", ak))
-        self._notify()
+                    dropped_sts.append(ak)
+        self._notify_batch(
+            [("user", access_key), ("user-policy", access_key)]
+            + [("svcacct", ak) for ak in dropped_svc]
+            + [("sts", ak) for ak in dropped_sts])
 
     def list_users(self) -> list[str]:
         with self._mu:
@@ -217,7 +332,7 @@ class IAMSys:
                 if m not in g["members"]:
                     g["members"].append(m)
             self._save(self._path("groups", group), g)
-        self._notify()
+        self._notify("group", group)
 
     def remove_members_from_group(self, group: str,
                                   members: list[str]) -> None:
@@ -233,7 +348,7 @@ class IAMSys:
                 self.group_policy.pop(group, None)
                 self._delete(self._path("groups", group))
                 self._delete(self._path("policydb/groups", group))
-        self._notify()
+        self._notify_batch([("group", group), ("group-policy", group)])
 
     def set_policy(self, name: str, policy: Policy) -> None:
         """Create/replace a named policy document."""
@@ -241,7 +356,7 @@ class IAMSys:
             self.policies[name] = policy
             self._save(self._path("policies", name),
                        json.loads(policy.to_json()))
-        self._notify()
+        self._notify("policy", name)
 
     def delete_policy(self, name: str) -> None:
         with self._mu:
@@ -249,7 +364,7 @@ class IAMSys:
                 raise IAMError(f"cannot delete canned policy {name}")
             self.policies.pop(name, None)
             self._delete(self._path("policies", name))
-        self._notify()
+        self._notify("policy", name)
 
     def attach_policy(self, names: str | list[str], user: str = "",
                       group: str = "") -> None:
@@ -271,7 +386,10 @@ class IAMSys:
                            {"policy": names})
             else:
                 raise IAMError("user or group required")
-        self._notify()
+        if user:
+            self._notify("user-policy", user)
+        else:
+            self._notify("group-policy", group)
 
     # ------------------------------------------------------------------
     # service accounts + STS
@@ -292,7 +410,7 @@ class IAMSys:
             self._save(self._path("svcaccts", access_key),
                        {"secret_key": secret_key, "parent": parent_user,
                         "status": "on"})
-        self._notify()
+        self._notify("svcacct", access_key)
         return cred
 
     def _mint_sts(self, parent: str, duration_seconds: int
@@ -323,7 +441,7 @@ class IAMSys:
         cred = self._mint_sts(
             parent_cred.parent_user or parent_cred.access_key,
             duration_seconds)
-        self._notify()
+        self._notify("sts", cred.access_key)
         return cred
 
     def assume_role_with_claims(self, subject: str,
@@ -347,12 +465,14 @@ class IAMSys:
             if duration_seconds <= 0:
                 raise IAMError("identity token already expired")
         cred = self._mint_sts(subject, duration_seconds)
+        pairs = [("sts", cred.access_key)]
         if policy_names is not None:
             with self._mu:
                 self.user_policy[subject] = list(policy_names)
                 self._save(self._path("policydb/users", subject),
                            {"policy": list(policy_names)})
-        self._notify()
+            pairs.append(("user-policy", subject))
+        self._notify_batch(pairs)
         return cred
 
     # ------------------------------------------------------------------

@@ -169,15 +169,21 @@ class AdminClient:
     def cluster_trace(self) -> list[dict]:
         return self._json("GET", "trace/cluster")["entries"]
 
-    def profiling_start(self) -> dict:
-        return self._json("POST", "profiling/start")
+    def profiling_start(self, profiler_type: str = "cpu") -> dict:
+        """profiler_type: comma list of 'cpu' (cProfile) and 'mem'
+        (tracemalloc) — the reference's profilerType=cpu,mem."""
+        return self._json("POST", "profiling/start",
+                          {"profilerType": profiler_type})
 
-    def profiling_stop(self) -> dict[str, str]:
-        """Stop cluster-wide profiling; returns {node: profile_text}
-        extracted from the server's zip (one entry per node)."""
+    def profiling_stop(self, profiler_type: str = "cpu"
+                       ) -> dict[str, str]:
+        """Stop cluster-wide profiling; returns
+        {profile-<kind>-<node>.txt: text} extracted from the server's
+        zip (one entry per kind per node)."""
         import io
         import zipfile
-        blob = self._request("POST", "profiling/stop")
+        blob = self._request("POST", "profiling/stop",
+                             {"profilerType": profiler_type})
         out: dict[str, str] = {}
         with zipfile.ZipFile(io.BytesIO(blob)) as zf:
             for name in zf.namelist():

@@ -158,10 +158,12 @@ class AdminHandlers:
             return self._json(self.top_locks())
         if sub == "profiling/start" and m == "POST":
             self._auth(ctx, "admin:Profiling")
-            return self._json(self._profiling_start())
+            return self._json(self._profiling_start(
+                ctx.query1("profilerType", "cpu")))
         if sub == "profiling/stop" and m == "POST":
             self._auth(ctx, "admin:Profiling")
-            return self._profiling_stop()
+            return self._profiling_stop(
+                ctx.query1("profilerType", "cpu"))
         if sub == "consolelog" and m == "GET":
             self._auth(ctx, "admin:ConsoleLog")
             try:
@@ -187,10 +189,15 @@ class AdminHandlers:
             drives = list(self.node.spec.drives) \
                 if self.node is not None else []
             nodes = [local_obd(drives)]
+            net: list = []
             if self.node is not None:
                 nodes[0]["node"] = self.node.spec.addr
                 nodes.extend(self.node.notification.obd_all())
-            return self._json({"nodes": nodes})
+                # internode throughput/RTT from this node's viewpoint
+                # (cmd/obdinfo.go net perf; size kept small so the
+                # bundle stays interactive)
+                net = self.node.notification.net_obd(size=1 << 20)
+            return self._json({"nodes": nodes, "net": net})
         if sub == "trace/cluster" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
             entries = list(self.api.trace.recent)
@@ -424,46 +431,70 @@ class AdminHandlers:
             self.api.replication.mount_target_entry(entry)
         return self._json({"arn": entry["arn"]})
 
-    def _profiling_start(self) -> dict:
-        """Start CPU profiling on EVERY node: locally via the process
-        profiler, cluster-wide via the peer fan-out (reference admin
-        profiling/start, cmd/admin-handlers.go:461-525 + peer verb
-        peerRESTMethodStartProfiling; cProfile is the Python-native
-        equivalent of the pprof cpu kind)."""
+    def _profiling_start(self, kinds: str = "cpu") -> dict:
+        """Start profiling on EVERY node: locally via the process
+        profilers, cluster-wide via the peer fan-out (reference admin
+        profiling/start?profilerType=cpu,mem,
+        cmd/admin-handlers.go:461-525 + peerRESTMethodStartProfiling;
+        cProfile = pprof-cpu, tracemalloc = pprof-heap)."""
         from ..utils import profiling
-        out = {"status": "started" if profiling.start()
-               else "already running", "kind": "cpu"}
+        wanted = profiling.parse_kinds(kinds)
+        bad = [k for k in profiling.split_raw(kinds)
+               if k not in profiling.KINDS]
+        if bad or not wanted:
+            raise S3Error("AdminInvalidArgument",
+                          f"unknown profiler type(s) {bad or kinds!r}; "
+                          f"supported: {', '.join(profiling.KINDS)}")
+        out = {"kinds": {k: ("started" if profiling.start(k)
+                             else "already running") for k in wanted}}
         if self.node is not None:
-            peers = self.node.notification.profiling_start_all()
+            peers = self.node.notification.profiling_start_all(
+                ",".join(wanted))
             out["peers"] = [p for p in peers if isinstance(p, dict)]
         return out
 
-    def _profiling_stop(self) -> HTTPResponse:
-        """Stop everywhere and return one zip with a profile per node
-        (reference downloads a zip of all nodes' profiles)."""
+    def _profiling_stop(self, kinds: str = "cpu") -> HTTPResponse:
+        """Stop everywhere and return one zip with a profile per
+        (kind, node) (reference downloads a zip of all nodes'
+        profiles)."""
         import io
         import zipfile
         from ..utils import profiling
-        local = profiling.stop_text()
-        if local is None and self.node is None:
-            raise S3Error("AdminInvalidArgument", "profiling not running")
-        profiles: list[tuple[str, str]] = []
+        wanted = profiling.parse_kinds(kinds)
+        bad = [k for k in profiling.split_raw(kinds)
+               if k not in profiling.KINDS]
+        if bad or not wanted:
+            # stop must reject what start rejects — a typo'd stop
+            # otherwise tears down someone else's cpu profile
+            raise S3Error("AdminInvalidArgument",
+                          f"unknown profiler type(s) {bad or kinds!r}; "
+                          f"supported: {', '.join(profiling.KINDS)}")
         local_name = self.node.spec.addr if self.node is not None \
             else "local"
-        if local is not None:
-            profiles.append((local_name, local))
+        profiles: list[tuple[str, str, str]] = []
+        for k in wanted:
+            local = profiling.stop_text(k)
+            if local is not None:
+                profiles.append((k, local_name, local))
         if self.node is not None:
-            for res in self.node.notification.profiling_stop_all():
-                if isinstance(res, dict) and res.get("profile"):
-                    profiles.append((res.get("node", "peer"),
+            for res in self.node.notification.profiling_stop_all(
+                    ",".join(wanted)):
+                if not isinstance(res, dict):
+                    continue
+                for k, text in (res.get("profiles") or {}).items():
+                    if text:
+                        profiles.append((k, res.get("node", "peer"),
+                                         text))
+                if res.get("profile"):          # legacy single-kind
+                    profiles.append(("cpu", res.get("node", "peer"),
                                      res["profile"]))
         if not profiles:
             raise S3Error("AdminInvalidArgument", "profiling not running")
         buf = io.BytesIO()
         with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-            for node, text in profiles:
+            for kind, node, text in profiles:
                 safe = node.replace(":", "_").replace("/", "_")
-                zf.writestr(f"profile-cpu-{safe}.txt", text)
+                zf.writestr(f"profile-{kind}-{safe}.txt", text)
         return HTTPResponse(body=buf.getvalue(),
                             headers={"Content-Type": "application/zip"})
 

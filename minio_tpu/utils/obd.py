@@ -59,6 +59,26 @@ def probe_drive(path: str, size: int = 64 << 10) -> dict:
     return info
 
 
+def _process_info() -> dict:
+    """This server process's own footprint (reference OBD bundles
+    process detail alongside host cpu/mem)."""
+    out: dict = {"pid": os.getpid()}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("Threads:"):
+                    out["threads"] = int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        out["open_fds"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    return out
+
+
 def local_obd(drive_paths: list[str] | None = None) -> dict:
     """This node's OBD facts; the peer plane fans this out cluster-wide."""
     try:
@@ -72,5 +92,6 @@ def local_obd(drive_paths: list[str] | None = None) -> dict:
                 "load1": round(load1, 3), "load5": round(load5, 3),
                 "load15": round(load15, 3)},
         "mem": _meminfo(),
+        "process": _process_info(),
         "drives": [probe_drive(p) for p in (drive_paths or [])],
     }

@@ -1,7 +1,10 @@
-"""Process-global CPU profiler (admin profiling + peer fan-out share
-one profiler per process — reference cmd/admin-handlers.go:461-525
-globalProfiler; cProfile is the Python-native equivalent of the Go
-pprof cpu kind)."""
+"""Process-global profilers (admin profiling + peer fan-out share one
+profiler per process — reference cmd/admin-handlers.go:461-525
+globalProfiler). Two kinds, mirroring the reference's cpu/mem pprof
+set: "cpu" = cProfile (the Python-native pprof-cpu equivalent),
+"mem" = tracemalloc (allocation sites by size, the pprof-heap
+equivalent). Go's block/mutex kinds have no Python analog.
+"""
 
 from __future__ import annotations
 
@@ -9,37 +12,81 @@ import cProfile
 import io
 import pstats
 import threading
+import tracemalloc
 from typing import Optional
 
+KINDS = ("cpu", "mem")
+
+
+def parse_kinds(raw: str) -> list[str]:
+    """One parser for every surface (admin HTTP, peer RPC): tolerant
+    of whitespace, preserving order, silently dropping unknowns —
+    callers that must REJECT unknowns compare against split_raw()."""
+    return [k for k in split_raw(raw) if k in KINDS]
+
+
+def split_raw(raw: str) -> list[str]:
+    return [k.strip() for k in raw.split(",") if k.strip()]
+
 _profiler: Optional[cProfile.Profile] = None
+_mem_running = False
 _mu = threading.Lock()
 
 
-def start() -> bool:
-    """Begin profiling; False when already running."""
-    global _profiler
+def start(kind: str = "cpu") -> bool:
+    """Begin profiling `kind`; False when already running (or the kind
+    is unknown)."""
+    global _profiler, _mem_running
     with _mu:
-        if _profiler is not None:
-            return False
-        _profiler = cProfile.Profile()
-        _profiler.enable()
-        return True
+        if kind == "cpu":
+            if _profiler is not None:
+                return False
+            _profiler = cProfile.Profile()
+            _profiler.enable()
+            return True
+        if kind == "mem":
+            if _mem_running or tracemalloc.is_tracing():
+                return False
+            tracemalloc.start(10)       # keep 10 frames per alloc site
+            _mem_running = True
+            return True
+        return False
 
 
-def running() -> bool:
+def running(kind: str = "cpu") -> bool:
     with _mu:
-        return _profiler is not None
+        if kind == "cpu":
+            return _profiler is not None
+        if kind == "mem":
+            return _mem_running
+        return False
 
 
-def stop_text(top: int = 60) -> Optional[str]:
-    """Stop and render the profile (None when not running)."""
-    global _profiler
-    with _mu:
-        prof, _profiler = _profiler, None
-    if prof is None:
-        return None
-    prof.disable()
-    buf = io.StringIO()
-    pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
-        .print_stats(top)
-    return buf.getvalue()
+def stop_text(kind: str = "cpu", top: int = 60) -> Optional[str]:
+    """Stop `kind` and render the profile (None when not running)."""
+    global _profiler, _mem_running
+    if kind == "cpu":
+        with _mu:
+            prof, _profiler = _profiler, None
+        if prof is None:
+            return None
+        prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+            .print_stats(top)
+        return buf.getvalue()
+    if kind == "mem":
+        with _mu:
+            if not _mem_running:
+                return None
+            _mem_running = False
+        snap = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        lines = [f"traced current={current} peak={peak} bytes",
+                 "top allocation sites by size:"]
+        for stat in snap.statistics("lineno")[:top]:
+            lines.append(f"  {stat.size:>12d} B  {stat.count:>8d} x  "
+                         f"{stat.traceback}")
+        return "\n".join(lines) + "\n"
+    return None
