@@ -45,6 +45,8 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
                         "claim_name": "policy", "claim_prefix": ""},
     "identity_ldap": {"server_addr": "", "user_dn_format": ""},
     "kms_secret_key": {"key": ""},
+    "kms_kes": {"enable": "off", "endpoint": "", "key_name": "",
+                "api_key": ""},
     "logger_webhook": {"enable": "off", "endpoint": ""},
     "audit_webhook": {"enable": "off", "endpoint": ""},
     "notify_webhook": {"enable": "off", "endpoint": "",
@@ -307,14 +309,27 @@ class ConfigSys:
         except ValueError:
             reqs = 0
         api.set_max_clients(reqs if reqs > 0 else 256)
-        kms = self.get("kms_secret_key", "key")
-        if kms:
+        # KMS precedence: a configured KES endpoint (the production
+        # SSE-S3 shape, cmd/crypto/kes.go) wins over a static key
+        if self.get("kms_kes", "enable").lower() in ("on", "true", "1"):
+            from ..features.kms import KESClient
             try:
-                key = bytes.fromhex(kms)
-                if len(key) == 32:
-                    api.sse_master_key = key
+                api.kms = KESClient(
+                    self.get("kms_kes", "endpoint"),
+                    self.get("kms_kes", "key_name"),
+                    api_key=self.get("kms_kes", "api_key"))
             except ValueError:
-                pass
+                pass                     # bad endpoint: keep prior KMS
+        else:
+            kms = self.get("kms_secret_key", "key")
+            if kms:
+                from ..features.kms import StaticKMS
+                try:
+                    key = bytes.fromhex(kms)
+                    if len(key) == 32:
+                        api.kms = StaticKMS(key)
+                except ValueError:
+                    pass
         if trace is not None:
             if self.get("audit_webhook", "enable").lower() in ("on",
                                                                "true", "1"):

@@ -117,13 +117,15 @@ class PeerRPCServer:
         # user + mapping + every derived svcacct/sts in one batch)
         pairs: list = []
         if body:
-            try:
-                raw = json.loads(body.decode())
-                pairs = [(str(k), str(n)) for k, n in raw]
-            except (ValueError, TypeError):
-                pairs = []
-        if not pairs and args.get("kind"):
+            # malformed body MUST error (-> 500 -> the sender falls
+            # back to a wholesale reload); a silent 200 ack would drop
+            # the delta with no recovery until the periodic refresh
+            raw = json.loads(body.decode())
+            pairs = [(str(k), str(n)) for k, n in raw]
+        elif args.get("kind"):
             pairs = [(args.get("kind", ""), args.get("name", ""))]
+        if not pairs:
+            raise ValueError("empty iam-delta")
         if self.apply_iam_delta is not None:
             for kind, name in pairs:
                 self.apply_iam_delta(kind, name)
@@ -260,8 +262,14 @@ class PeerRPCClient:
             out = _json.loads(raw.decode()) if raw else None
         except (NetworkError, RPCError, ValueError):
             return None
-        if not isinstance(out, dict) or out.get("received") != size:
+        if not isinstance(out, dict):
             return None
+        if out.get("received") != size:
+            # reachable but truncated (proxy/body limit) — distinct
+            # from peer-down so the operator chases the right problem
+            return {"peer": f"{self.rc.host}:{self.rc.port}",
+                    "error": "short receive",
+                    "expected": size, "received": out.get("received")}
         return {"peer": f"{self.rc.host}:{self.rc.port}",
                 "bytes": size,
                 "rtt_us": round((rtt or 0.0) * 1e6),

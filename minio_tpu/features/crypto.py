@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import json
 import os
 import secrets
 from typing import Iterator, Optional
@@ -44,6 +45,9 @@ MK_COMPRESS = "X-Minio-Internal-Compression"
 # matches storage.datatypes.to_object_info's actual-size key, so
 # ObjectInfo.actual_size is correct for transformed objects too
 MK_ACTUAL = "X-Minio-Internal-actual-size"
+MK_KMS = "X-Minio-Internal-Sse-Kms-Key-Id"
+MK_KMS_SEALED = "X-Minio-Internal-Sse-Kms-Sealed-Key"
+MK_KMS_CTX = "X-Minio-Internal-Sse-Kms-Context"
 
 COMPRESSIBLE_EXT = (".txt", ".log", ".csv", ".json", ".tar", ".xml",
                     ".bin")
@@ -248,6 +252,16 @@ def master_key_from_env() -> Optional[bytes]:
     return key if len(key) == 32 else None
 
 
+def kms_from_env():
+    """The SSE-S3 KMS for this process: MINIO_SSE_MASTER_KEY gives a
+    StaticKMS; the config subsystems (kms_secret_key, kms_kes) replace
+    it at apply time. None = SSE-S3 requests fail with
+    ServerSideEncryptionConfigurationNotFoundError."""
+    from .kms import StaticKMS
+    key = master_key_from_env()
+    return StaticKMS(key) if key is not None else None
+
+
 def parse_ssec_headers(header) -> Optional[bytes]:
     """Returns the 32-byte client key, or None when no SSE-C requested.
     `header` is a callable(name, default="")."""
@@ -280,8 +294,7 @@ def is_compressible(key: str, content_type: str) -> bool:
 def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
                          raw_size: int, metadata: dict,
                          ssec_key: Optional[bytes],
-                         sse_s3: bool, master_key: Optional[bytes],
-                         compress: bool):
+                         sse_s3: bool, kms, compress: bool):
     """Build the transformed reader + metadata for a PUT.
 
     Returns (reader, size) — size is the stored byte count when
@@ -298,7 +311,8 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
 
     if ssec_key is not None or sse_s3:
         oek, nonce_base = create_sse_seals(metadata, ssec_key, sse_s3,
-                                           master_key)
+                                           kms,
+                                           kms_context={"object": key_name})
         transforms.append(Encryptor(oek, nonce_base))
         if size >= 0:
             size = encrypted_size(size)
@@ -310,14 +324,18 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
 
 
 def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
-                     sse_s3: bool, master_key: Optional[bytes],
-                     multipart: bool = False
+                     sse_s3: bool, kms, multipart: bool = False,
+                     kms_context: Optional[dict] = None
                      ) -> Optional[tuple[bytes, bytes]]:
     """Generate + seal a fresh object key into `metadata`; returns
     (object key, nonce base) for callers that wrap a stream now (the
     single-PUT path), or None when no SSE was requested. Multipart
     uploads seal at create and encrypt each part later with a per-part
-    nonce (cmd/encryption-v1.go multipart part math analog)."""
+    nonce (cmd/encryption-v1.go multipart part math analog).
+
+    SSE-S3 sealing chain (cmd/crypto KES/master shapes): the KMS mints
+    a DEK; the per-object key is sealed under the DEK; only the DEK's
+    ciphertext (remote KMS) and the sealed OEK persist in metadata."""
     from ..s3.s3errors import S3Error
     if ssec_key is not None:
         sealing = ssec_key
@@ -325,10 +343,25 @@ def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
         metadata[MK_KEYMD5] = base64.b64encode(
             hashlib.md5(ssec_key).digest()).decode()
     elif sse_s3:
-        if master_key is None:
+        if kms is None:
             raise S3Error("ServerSideEncryptionConfigurationNotFoundError")
-        sealing = master_key
+        from .kms import KMSError
+        ctx = dict(kms_context or {})
+        try:
+            dek, dek_ct = kms.generate_key(ctx)
+        except KMSError as e:
+            # fail closed: a down KMS must refuse the PUT, not fall
+            # back to plaintext or a stale key
+            raise S3Error("InternalError", f"KMS generate-key: {e}") \
+                from e
+        sealing = dek
         metadata[MK_SSE] = "S3"
+        if dek_ct:
+            metadata[MK_KMS] = getattr(kms, "key_id", "kms")
+            metadata[MK_KMS_SEALED] = base64.b64encode(dek_ct).decode()
+            metadata[MK_KMS_CTX] = base64.b64encode(json.dumps(
+                ctx, sort_keys=True,
+                separators=(",", ":")).encode()).decode()
     else:
         return None
     oek = secrets.token_bytes(32)
@@ -349,7 +382,7 @@ def part_nonce(nonce_base: bytes, part_number: int) -> bytes:
 
 
 def resolve_get_key(info_metadata: dict, header,
-                    master_key: Optional[bytes]) -> Optional[tuple]:
+                    kms) -> Optional[tuple]:
     """For an encrypted object: returns (oek, nonce_base). Raises on
     missing/wrong keys. None when the object is not encrypted."""
     from ..s3.s3errors import S3Error
@@ -368,9 +401,22 @@ def resolve_get_key(info_metadata: dict, header,
             raise S3Error("AccessDenied", "SSE-C key does not match")
         sealing = key
     else:
-        if master_key is None:
+        if kms is None:
             raise S3Error("ServerSideEncryptionConfigurationNotFoundError")
-        sealing = master_key
+        from .kms import KMSError
+        dek_ct = base64.b64decode(info_metadata.get(MK_KMS_SEALED, ""))
+        try:
+            ctx = json.loads(base64.b64decode(
+                info_metadata.get(MK_KMS_CTX, "") or "e30=").decode())
+        except (ValueError, UnicodeDecodeError):
+            ctx = {}
+        try:
+            sealing = kms.decrypt_key(dek_ct, ctx,
+                                      key_id=info_metadata.get(MK_KMS,
+                                                               ""))
+        except KMSError as e:
+            raise S3Error("InternalError", f"KMS decrypt-key: {e}") \
+                from e
     try:
         oek = unseal_key(sealing, sealed)
     except Exception:

@@ -183,7 +183,9 @@ class IAMSys:
     def _read_one(self, prefix: str, name: str) -> Optional[dict]:
         """Current on-disk record of one IAM entity, or None when it no
         longer exists (delta application reads the store, so a delete
-        and a create are the same verb)."""
+        and a create are the same verb). A TRANSIENT store error must
+        not read as "deleted" — it raises, and apply_delta degrades to
+        a full reload instead of evicting a live credential."""
         if self.obj is None:
             return None
         from ..object import api_errors
@@ -191,12 +193,13 @@ class IAMSys:
             _, stream = self.obj.get_object(
                 MINIO_META_BUCKET, self._path(prefix, name))
             return json.loads(b"".join(stream).decode())
-        except (api_errors.ObjectApiError, ValueError):
+        except (api_errors.ObjectNotFound, ValueError):
             return None
 
     def apply_delta(self, kind: str, name: str) -> None:
         """Refresh one entity from the store (the receiving side of the
         peer delta verbs). Unknown kinds degrade to a full load."""
+        from ..object import api_errors
         d = None
         if kind in ("user", "group", "policy", "user-policy",
                     "group-policy", "svcacct", "sts"):
@@ -205,7 +208,16 @@ class IAMSys:
                       "user-policy": "policydb/users",
                       "group-policy": "policydb/groups",
                       "svcacct": "svcaccts", "sts": "sts"}[kind]
-            d = self._read_one(prefix, name)
+            try:
+                d = self._read_one(prefix, name)
+            except api_errors.ObjectApiError:
+                # quorum blip on the read: keep the cached entry and
+                # resync wholesale rather than evicting a live identity
+                try:
+                    self.load()
+                except api_errors.ObjectApiError:
+                    pass
+                return
         with self._mu:
             if kind == "user":
                 if d is None:

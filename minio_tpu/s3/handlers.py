@@ -235,8 +235,11 @@ class S3ApiHandlers:
         # uploads don't re-read the session journal per part
         from collections import OrderedDict
         self._mpu_meta: "OrderedDict[str, dict]" = OrderedDict()
+        # resolved SSE-S3 object keys per upload (bounds KMS round
+        # trips to one per upload, not one per part)
+        self._mpu_keys: "OrderedDict[str, tuple]" = OrderedDict()
         from ..features import crypto as sse
-        self.sse_master_key = sse.master_key_from_env()  # SSE-S3 KMS seam
+        self.kms = sse.kms_from_env()        # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
         self.cors_allow_origin = "*"   # config api.cors_allow_origin
@@ -1208,7 +1211,7 @@ class S3ApiHandlers:
         reader2, size2 = sse.setup_put_transforms(
             key_name=key, raw_reader=reader, raw_size=size,
             metadata=metadata, ssec_key=ssec_key, sse_s3=sse_s3,
-            master_key=self.sse_master_key, compress=compress)
+            kms=self.kms, compress=compress)
         headers = {}
         if sse_s3:
             headers["x-amz-server-side-encryption"] = "AES256"
@@ -1315,7 +1318,7 @@ class S3ApiHandlers:
         plaintext range (reference DecryptBlocksRequestR + s2 reader
         stack, cmd/object-api-utils.go:626-697)."""
         from ..features import crypto as sse
-        enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+        enc = sse.resolve_get_key(md, ctx.header, self.kms)
         compressed = bool(md.get(sse.MK_COMPRESS))
         actual = self._plain_size(info, md)
         rng = _parse_range(ctx.header("range"), actual)
@@ -1377,6 +1380,26 @@ class S3ApiHandlers:
                 self._mpu_meta.popitem(last=False)
         return md
 
+    def _mpu_sse_key(self, bucket: str, key: str, upload_id: str,
+                     md: dict, ctx) -> tuple:
+        """Resolved (oek, nonce_base) for a multipart SSE session.
+        SSE-S3 resolutions are cached per upload — under a remote KMS,
+        resolve_get_key is one decrypt-key HTTP round trip, and a
+        1000-part upload must not make 1000 of them. SSE-C is NEVER
+        cached: each part request must present (and re-verify) the
+        client's key headers."""
+        from ..features import crypto as sse
+        if md.get(sse.MK_SSE) != "S3":
+            return sse.resolve_get_key(md, ctx.header, self.kms)
+        cache_key = f"{bucket}/{key}/{upload_id}"
+        enc = self._mpu_keys.get(cache_key)
+        if enc is None:
+            enc = sse.resolve_get_key(md, ctx.header, self.kms)
+            self._mpu_keys[cache_key] = enc
+            while len(self._mpu_keys) > 1024:
+                self._mpu_keys.popitem(last=False)
+        return enc
+
     def _sse_s3_requested(self, ctx, ssec_key) -> bool:
         """Validate x-amz-server-side-encryption: only AES256 (SSE-S3)
         is supported — aws:kms etc. must error, never silently store
@@ -1410,7 +1433,7 @@ class S3ApiHandlers:
                     + name[len(prefix):], default)
             return ctx.header(name, default)
 
-        enc = sse.resolve_get_key(md, src_header, self.sse_master_key)
+        enc = sse.resolve_get_key(md, src_header, self.kms)
         plain_size = self._plain_size(src_info, md)
         if enc is not None and md.get(sse.MK_SSE_MP) and src_info.parts:
             return (self._mp_decrypt_stream(opts, src_bucket, src_key,
@@ -1499,7 +1522,7 @@ class S3ApiHandlers:
         md = info.user_defined or {}
         if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
             if md.get(sse.MK_SSE) == "C":
-                sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+                sse.resolve_get_key(md, ctx.header, self.kms)
             headers.update(self._sse_response_headers(md))
             headers["Content-Length"] = str(self._plain_size(info, md))
         else:
@@ -1602,7 +1625,7 @@ class S3ApiHandlers:
             reader2, size2 = sse.setup_put_transforms(
                 key_name=key, raw_reader=reader, raw_size=plain_size,
                 metadata=metadata, ssec_key=tgt_ssec, sse_s3=tgt_sse_s3,
-                master_key=self.sse_master_key, compress=False)
+                kms=self.kms, compress=False)
             versioned = self.bucket_meta.versioning_enabled(bucket)
             info = self.obj.put_object(
                 bucket, key, reader2, size2,
@@ -1652,7 +1675,8 @@ class S3ApiHandlers:
                           "SSE multipart is not supported on this "
                           "backend")
         sse.create_sse_seals(metadata, ssec_key, sse_s3,
-                             self.sse_master_key, multipart=True)
+                             self.kms, multipart=True,
+                             kms_context={"object": key})
         upload_id = self.obj.new_multipart_upload(
             bucket, key, PutOptions(metadata=metadata))
         return HTTPResponse().with_xml(
@@ -1679,7 +1703,7 @@ class S3ApiHandlers:
         from ..features import crypto as sse
         md = self._multipart_meta(bucket, key, upload_id)
         if md.get(sse.MK_SSE):
-            enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+            enc = self._mpu_sse_key(bucket, key, upload_id, md, ctx)
             reader = sse.PutObjReader(
                 reader, [sse.Encryptor(enc[0],
                                        sse.part_nonce(enc[1],
@@ -1846,7 +1870,7 @@ class S3ApiHandlers:
         from ..features import crypto as sse
         md = info.user_defined or {}
         if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
-            enc = sse.resolve_get_key(md, ctx.header, self.sse_master_key)
+            enc = sse.resolve_get_key(md, ctx.header, self.kms)
             _, stream = self.obj.get_object(bucket, key, 0, info.size)
             if enc is not None:
                 stream = sse.decrypt_stream(stream, enc[0], enc[1])

@@ -80,9 +80,13 @@ def stop_text(kind: str = "cpu", top: int = 60) -> Optional[str]:
             if not _mem_running:
                 return None
             _mem_running = False
-        snap = tracemalloc.take_snapshot()
-        current, peak = tracemalloc.get_traced_memory()
-        tracemalloc.stop()
+            # snapshot + stop stay under the lock: a concurrent
+            # start("mem") between flag-clear and stop() would see
+            # is_tracing() True, report "already running", and then
+            # have its tracing torn down here
+            snap = tracemalloc.take_snapshot()
+            current, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
         lines = [f"traced current={current} peak={peak} bytes",
                  "top allocation sites by size:"]
         for stat in snap.statistics("lineno")[:top]:

@@ -246,11 +246,16 @@ def test_iam_delta_propagation_not_wholesale(cluster):
     full_loads = {"n": 0}
     orig_load = b.iam.load
 
-    def counting_load():
+    def counting_load(*a_, **kw):
         full_loads["n"] += 1
         orig_load()
 
-    b.iam.load = counting_load
+    # intercept the RPC-reload hook itself: ClusterNode captured the
+    # bound method at boot, so patching b.iam.load alone would miss
+    # wholesale reload-iam verbs (and the periodic refresh thread calls
+    # b.iam.load by attribute, which must NOT count here)
+    orig_hook = b._peer_rpc.reload_iam
+    b._peer_rpc.reload_iam = counting_load
     try:
         a.iam.add_user("deltauser", "deltasecret1")
         a.iam.attach_policy("readonly", user="deltauser")
@@ -278,7 +283,7 @@ def test_iam_delta_propagation_not_wholesale(cluster):
         assert "deltapol" not in b.iam.policies
         assert full_loads["n"] == 0
     finally:
-        b.iam.load = orig_load
+        b._peer_rpc.reload_iam = orig_hook
 
 
 def test_obd_net_probe(cluster):

@@ -64,7 +64,8 @@ def server(tmp_path_factory):
     sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
                                    parity=2, block_size=1 << 17)
     srv = S3Server(sets, creds=CREDS, region=REGION).start()
-    srv.api.sse_master_key = MASTER
+    from minio_tpu.features.kms import StaticKMS
+    srv.api.kms = StaticKMS(MASTER)
     srv.api.compression_enabled = True
     yield srv
     srv.stop()
@@ -327,7 +328,8 @@ def test_multipart_sse_on_fs_backend(tmp_path):
     from minio_tpu.object.fs import FSObjects
     fs = FSObjects(str(tmp_path / "fsmp"))
     srv = S3Server(fs, creds=CREDS, region=REGION).start()
-    srv.api.sse_master_key = MASTER
+    from minio_tpu.features.kms import StaticKMS
+    srv.api.kms = StaticKMS(MASTER)
     try:
         c = Client(srv.port)
         assert c.request("PUT", "/fsb")[0] == 200
