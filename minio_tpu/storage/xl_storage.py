@@ -58,8 +58,100 @@ def _check_path_safe(p: str) -> None:
             raise errors.FileAccessDenied(p)
 
 
+class _DirectWriter:
+    """Sequential O_DIRECT file writer (reference CreateFile's
+    odirectWriter, cmd/xl-storage.go:1664 + cmd/fallocate_linux.go):
+    bytes stage in a page-aligned mmap buffer and flush to the kernel
+    in ALIGN-multiple chunks, bypassing the page cache — big PUTs must
+    not evict a node's read cache. The unaligned tail is written after
+    clearing O_DIRECT via fcntl (Linux semantics: alignment applies
+    per-write, the flag can be dropped mid-file)."""
+
+    ALIGN = 4096
+    BUF = 1 << 20
+
+    def __init__(self, path: str, truncate: bool = True):
+        import mmap
+        # raises OSError on filesystems without O_DIRECT — callers
+        # fall back to buffered IO. Non-truncating mode appends (the
+        # open_appender contract); O_DIRECT appends stay aligned only
+        # from an empty/aligned file, which open_appender checks.
+        flags = os.O_WRONLY | os.O_CREAT | os.O_DIRECT \
+            | (os.O_TRUNC if truncate else os.O_APPEND)
+        self.fd = os.open(path, flags, 0o644)
+        self._buf = mmap.mmap(-1, self.BUF)     # page-aligned
+        self._fill = 0
+        self._closed = False
+
+    def fileno(self) -> int:
+        return self.fd
+
+    def _flush_exact(self, view) -> None:
+        """os.write may consume a partial (aligned) prefix — e.g. disk
+        full mid-flush returns a short count, not an exception; a
+        silent short write would corrupt the shard mid-file."""
+        at = 0
+        while at < len(view):
+            n = os.write(self.fd, view[at:])
+            if n <= 0:
+                raise OSError(f"short O_DIRECT write ({at}/{len(view)})")
+            at += n
+
+    def write(self, data) -> int:
+        mv = memoryview(data).cast("B") if not isinstance(data, bytes) \
+            else memoryview(data)
+        n = len(mv)
+        at = 0
+        while at < n:
+            take = min(self.BUF - self._fill, n - at)
+            self._buf[self._fill:self._fill + take] = mv[at:at + take]
+            self._fill += take
+            at += take
+            if self._fill == self.BUF:
+                self._flush_exact(memoryview(self._buf)[:self.BUF])
+                self._fill = 0
+        return n
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            aligned = (self._fill // self.ALIGN) * self.ALIGN
+            if aligned:
+                self._flush_exact(memoryview(self._buf)[:aligned])
+            tail = self._fill - aligned
+            if tail:
+                import fcntl
+                flags = fcntl.fcntl(self.fd, fcntl.F_GETFL)
+                fcntl.fcntl(self.fd, fcntl.F_SETFL,
+                            flags & ~os.O_DIRECT)
+                self._flush_exact(
+                    memoryview(self._buf)[aligned:self._fill])
+        finally:
+            self._buf.close()
+            os.close(self.fd)
+
+    def __del__(self):
+        # abandoned writers (a failed shard write drops the handle
+        # without close) must not leak the raw fd + pinned mmap the
+        # way GC-closed io objects don't
+        try:
+            if not self._closed:
+                self._closed = True
+                self._buf.close()
+                os.close(self.fd)
+        except (OSError, AttributeError):
+            pass
+
+
+def _direct_io_default() -> bool:
+    return os.environ.get("MINIO_TPU_DIRECT_IO", "").lower() in (
+        "1", "on", "true")
+
+
 class XLStorage(StorageAPI):
-    def __init__(self, root: str):
+    def __init__(self, root: str, direct_io: Optional[bool] = None):
         self.root = os.path.abspath(root)
         try:
             os.makedirs(self.root, exist_ok=True)
@@ -73,6 +165,11 @@ class XLStorage(StorageAPI):
         self._lock = threading.Lock()
         self._online = True
         self._healing = False
+        # O_DIRECT shard writes (MINIO_TPU_DIRECT_IO=on): page-cache
+        # bypass on the PUT path; falls back to buffered per-file when
+        # the filesystem refuses (tmpfs)
+        self.direct_io = _direct_io_default() if direct_io is None \
+            else direct_io
 
     # -- identity ----------------------------------------------------------
 
@@ -278,6 +375,19 @@ class XLStorage(StorageAPI):
         fp = self._file_path(volume, path)
         try:
             os.makedirs(os.path.dirname(fp), exist_ok=True)
+            if self.direct_io:
+                # append semantics must match the buffered path: only
+                # go direct when the append offset is aligned (fresh
+                # tmp shard files — the hot path — start at zero)
+                try:
+                    existing = os.path.getsize(fp)
+                except OSError:
+                    existing = 0
+                if existing % _DirectWriter.ALIGN == 0:
+                    try:
+                        return _DirectWriter(fp, truncate=False)
+                    except OSError:
+                        pass      # fs without O_DIRECT: buffered
             return open(fp, "ab")
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
@@ -294,7 +404,15 @@ class XLStorage(StorageAPI):
             raise errors.VolumeNotFound(volume)
         try:
             os.makedirs(os.path.dirname(fp), exist_ok=True)
-            with open(fp, "wb") as f:
+            f = None
+            if self.direct_io and size >= _DirectWriter.ALIGN:
+                try:
+                    f = _DirectWriter(fp)
+                except OSError:
+                    f = None              # tmpfs etc.: buffered
+            if f is None:
+                f = open(fp, "wb")
+            try:
                 if size > 0:
                     try:
                         os.posix_fallocate(f.fileno(), 0, size)
@@ -316,6 +434,8 @@ class XLStorage(StorageAPI):
                         break
                 if size >= 0 and remaining > 0:
                     raise errors.LessData(path)
+            finally:
+                f.close()
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
         except (errors.StorageError,):

@@ -338,3 +338,140 @@ def test_shard_file_math():
     # offset never exceeds file size
     total = 3 * BLOCK_SIZE_V1 + 17
     assert ei.shard_file_offset(0, total, total) == ei.shard_file_size(total)
+
+
+# ---------------------------------------------------------------------------
+# O_DIRECT drive path (VERDICT r3 item 6; cmd/xl-storage.go:1664 +
+# cmd/fallocate_linux.go)
+# ---------------------------------------------------------------------------
+
+def test_direct_io_aligned_writer_roundtrip(tmp_path):
+    """The O_DIRECT appender produces byte-identical files across
+    alignment edge cases (page-multiple, sub-page tail, tiny writes)."""
+    import minio_tpu.storage.xl_storage as xs
+    drive = xs.XLStorage(str(tmp_path / "d"), direct_io=True)
+    drive.make_vol("v")
+    cases = {
+        "empty": [b""],
+        "subpage": [b"a" * 4095],
+        "page": [b"b" * 4096],
+        "page_plus": [b"c" * 4097],
+        "frames": [b"\x01" * 32, b"\x02" * 87382,
+                   b"\x03" * 32, b"\x04" * 87382],
+        "big": [bytes(range(256)) * 5000],          # 1.28 MB > BUF
+    }
+    for name, chunks in cases.items():
+        w = drive.open_appender("v", name)
+        for c in chunks:
+            w.write(c)
+        w.close()
+        assert drive.read_all("v", name) == b"".join(chunks), name
+    # the direct path really engaged on this filesystem (ext4 /tmp) —
+    # unless the fs refuses O_DIRECT, in which case fallback is the
+    # point being tested elsewhere
+    w = drive.open_appender("v", "probe")
+    engaged = isinstance(w, xs._DirectWriter)
+    w.close()
+    import os as _os
+    # ext4 supports O_DIRECT; only skip the engagement assert on
+    # filesystems that don't
+    try:
+        fd = _os.open(str(tmp_path / "o_direct_probe"),
+                      _os.O_WRONLY | _os.O_CREAT | _os.O_DIRECT)
+        _os.close(fd)
+        supports = True
+    except OSError:
+        supports = False
+    assert engaged == supports
+
+
+def test_direct_io_appender_appends_like_buffered(tmp_path):
+    """Review r4: open_appender must APPEND under direct IO exactly as
+    the buffered path does — aligned existing sizes go direct, an
+    unaligned existing file falls back to buffered append, and nothing
+    ever truncates."""
+    import minio_tpu.storage.xl_storage as xs
+    drive = xs.XLStorage(str(tmp_path / "d"), direct_io=True)
+    drive.make_vol("v")
+    # aligned existing content (one page): direct append is legal
+    w = drive.open_appender("v", "f")
+    w.write(b"a" * 4096)
+    w.close()
+    w = drive.open_appender("v", "f")
+    w.write(b"b" * 100)
+    w.close()
+    assert drive.read_all("v", "f") == b"a" * 4096 + b"b" * 100
+    # now unaligned: a further appender must NOT truncate or misalign
+    w = drive.open_appender("v", "f")
+    assert not isinstance(w, xs._DirectWriter)
+    w.write(b"c")
+    w.close()
+    assert drive.read_all("v", "f") == b"a" * 4096 + b"b" * 100 + b"c"
+
+
+def test_direct_io_fallback_when_fs_refuses(tmp_path, monkeypatch):
+    """Filesystems without O_DIRECT (older tmpfs, some network FS)
+    refuse at open: the drive must degrade to buffered IO, not fail.
+    Simulated deterministically — modern kernels accept O_DIRECT even
+    on tmpfs, so a real mount can't pin this behavior."""
+    import io as _io
+    import minio_tpu.storage.xl_storage as xs
+
+    class Refuses(xs._DirectWriter):
+        def __init__(self, path, truncate=True):
+            raise OSError(22, "Invalid argument")
+
+    monkeypatch.setattr(xs, "_DirectWriter", Refuses)
+    drive = xs.XLStorage(str(tmp_path / "d"), direct_io=True)
+    drive.make_vol("v")
+    w = drive.open_appender("v", "f")
+    assert isinstance(w, _io.IOBase)      # plain buffered file
+    w.write(b"payload")
+    w.close()
+    assert drive.read_all("v", "f") == b"payload"
+    drive.create_file("v", "cf", 5000, _io.BytesIO(b"z" * 5000))
+    assert drive.read_all("v", "cf") == b"z" * 5000
+
+
+def test_direct_io_create_file(tmp_path):
+    """create_file over the O_DIRECT writer: fallocate + aligned
+    stream + unaligned tail, exact-size enforcement intact."""
+    import io as _io
+    import minio_tpu.storage.xl_storage as xs
+    drive = xs.XLStorage(str(tmp_path / "d"), direct_io=True)
+    drive.make_vol("v")
+    payload = bytes(range(256)) * 20000 + b"tail"   # 5.12 MB + 4
+    drive.create_file("v", "big", len(payload), _io.BytesIO(payload))
+    assert drive.read_all("v", "big") == payload
+    from minio_tpu.storage import errors as serr
+    import pytest as _pytest
+    with _pytest.raises(serr.LessData):
+        drive.create_file("v", "short", 100, _io.BytesIO(b"x"))
+
+
+def test_direct_io_full_engine_put_get(tmp_path):
+    """End-to-end: an erasure engine over direct-io drives round-trips
+    objects (the bitrot frame cadence is maximally unaligned)."""
+    import os as _os
+    import minio_tpu.storage.xl_storage as xs
+    from minio_tpu.object.sets import ErasureSets
+    try:
+        fd = _os.open(str(tmp_path / "probe"),
+                      _os.O_WRONLY | _os.O_CREAT | _os.O_DIRECT)
+        _os.close(fd)
+    except OSError:
+        import pytest as _pytest
+        _pytest.skip("filesystem lacks O_DIRECT")
+    _os.environ["MINIO_TPU_DIRECT_IO"] = "on"
+    try:
+        sets = ErasureSets.from_drives(
+            [str(tmp_path / f"d{i}") for i in range(4)], 1, 4, 2,
+            block_size=1 << 16)
+        sets.make_bucket("b")
+        payload = _os.urandom(300_000)
+        sets.put_object("b", "o", payload)
+        _, stream = sets.get_object("b", "o")
+        assert b"".join(stream) == payload
+        sets.close()
+    finally:
+        _os.environ.pop("MINIO_TPU_DIRECT_IO", None)
