@@ -262,6 +262,25 @@ class ClusterNode:
         self.config = ConfigSys(self.object_layer, secret=sk)
         self.s3.api.config = self.config
 
+        # -- bucket federation over etcd DNS (cmd/etcd.go) -----------------
+        etcd_ep = self.config.get("etcd", "endpoints")
+        fed_domain = self.config.get("etcd", "domain")
+        if etcd_ep and fed_domain:
+            from .distributed.etcd import EtcdClient
+            from .features.federation import BucketFederation
+            try:
+                fed = BucketFederation(
+                    EtcdClient(etcd_ep.split(",")[0].strip()),
+                    fed_domain, self.spec.host, self.spec.port,
+                    cluster_addrs=[(n.host, n.port)
+                                   for n in self.nodes])
+                self.s3.api.federation = fed
+                # reference initFederatorBackend: buckets that predate
+                # federation (or an etcd restore) get re-registered
+                fed.register_existing(self.object_layer)
+            except ValueError:
+                pass              # bad endpoint: federation stays off
+
         # -- live bucket features (events, replication, lifecycle) ---------
         from .features import EventNotifier, ReplicationPool
         from .features.lifecycle import (crawler_action, mpu_abort_action,

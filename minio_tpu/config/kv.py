@@ -39,7 +39,7 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "storage_class": {"standard": "", "rrs": ""},
     "heal": {"interval": "10s", "max_io": "4"},
     "scanner": {"interval": "60s"},
-    "etcd": {"endpoints": ""},
+    "etcd": {"endpoints": "", "domain": ""},
     "identity_openid": {"config_url": "", "client_id": "",
                         "jwks": "", "jwks_file": "",
                         "claim_name": "policy", "claim_prefix": ""},

@@ -1,0 +1,119 @@
+"""etcd v3 KV client over the JSON gRPC-gateway API (the transport
+etcd ships for non-gRPC clients: POST /v3/kv/{put,range,deleterange}
+with base64 keys/values).
+
+The reference links the etcd Go client (cmd/etcd.go) for federation
+(bucket DNS on CoreDNS/etcd, cmd/config/dns) and the IAM etcd store
+(cmd/iam-etcd-store.go). This speaks the same server surface over
+plain HTTP so the seam is testable against an in-process fake — the
+pattern every notify target in this repo uses.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import urllib.parse
+from typing import Callable, Optional
+
+
+class EtcdError(Exception):
+    pass
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode()
+
+
+def _prefix_range_end(key: bytes) -> bytes:
+    """etcd prefix query: range_end = key with last byte + 1
+    (clientv3.GetPrefix semantics)."""
+    end = bytearray(key)
+    for i in reversed(range(len(end))):
+        if end[i] < 0xFF:
+            end[i] += 1
+            return bytes(end[:i + 1])
+    return b"\x00"
+
+
+class EtcdClient:
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 connect: Optional[Callable[[], object]] = None):
+        u = urllib.parse.urlsplit(endpoint)
+        if u.scheme not in ("http", "https") or not u.hostname:
+            raise ValueError(f"bad etcd endpoint {endpoint!r}")
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self._host = u.hostname
+        self._port = u.port or (443 if u.scheme == "https" else 80)
+        self._secure = u.scheme == "https"
+        self._connect = connect or self._default_connect
+
+    def _default_connect(self):
+        cls = http.client.HTTPSConnection if self._secure \
+            else http.client.HTTPConnection
+        return cls(self._host, self._port, timeout=self.timeout)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        body = json.dumps(payload).encode()
+        try:
+            conn = self._connect()
+            try:
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            # HTTPException (BadStatusLine, IncompleteRead…) must also
+            # map to EtcdError or the local-only degradation path in
+            # federation.owner_of never fires
+            raise EtcdError(f"etcd unreachable: {e}") from e
+        if resp.status != 200:
+            raise EtcdError(
+                f"etcd {path} failed ({resp.status}): {data[:200]!r}")
+        try:
+            out = json.loads(data.decode() or "{}")
+        except ValueError:
+            raise EtcdError("etcd returned malformed JSON") from None
+        return out if isinstance(out, dict) else {}
+
+    def put(self, key: str, value: bytes) -> None:
+        self._post("/v3/kv/put", {"key": _b64(key.encode()),
+                                  "value": _b64(value)})
+
+    def get(self, key: str) -> Optional[bytes]:
+        out = self._post("/v3/kv/range", {"key": _b64(key.encode())})
+        kvs = out.get("kvs") or []
+        if not kvs:
+            return None
+        try:
+            return base64.b64decode(kvs[0].get("value", ""))
+        except ValueError:
+            raise EtcdError("etcd returned undecodable value") from None
+
+    def get_prefix(self, prefix: str) -> dict[str, bytes]:
+        kb = prefix.encode()
+        out = self._post("/v3/kv/range", {
+            "key": _b64(kb),
+            "range_end": _b64(_prefix_range_end(kb))})
+        result: dict[str, bytes] = {}
+        for kv in out.get("kvs") or []:
+            try:
+                k = base64.b64decode(kv.get("key", "")).decode()
+                result[k] = base64.b64decode(kv.get("value", ""))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return result
+
+    def delete(self, key: str) -> None:
+        self._post("/v3/kv/deleterange", {"key": _b64(key.encode())})
+
+    def delete_prefix(self, prefix: str) -> None:
+        kb = prefix.encode()
+        self._post("/v3/kv/deleterange", {
+            "key": _b64(kb),
+            "range_end": _b64(_prefix_range_end(kb))})

@@ -1,0 +1,203 @@
+"""Bucket federation over etcd DNS records (reference cmd/etcd.go +
+cmd/config/dns + the bucket-forwarding middleware, cmd/routers.go:46).
+
+Each cluster registers its buckets as CoreDNS-style SRV records under
+``/skydns/<reversed domain>/<bucket>/<node>`` (JSON {host, port, ttl})
+— the exact layout cmd/config/dns writes, so a real CoreDNS serving
+the etcd backend resolves ``bucket.domain`` to this cluster; every
+node of the owning cluster gets a record (the reference registers all
+endpoints). A request for a bucket this cluster doesn't own is
+forwarded transparently to the owning cluster: federated deployments
+share credentials (the reference requires it), so the client's SigV4 —
+which covers the Host header the client sent, not the forwarder's
+address — verifies at the owner unchanged.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Iterator, Optional
+
+from ..distributed.etcd import EtcdClient, EtcdError
+from ..s3.handlers import HTTPResponse, RequestContext
+
+DEFAULT_TTL = 30
+LOCAL_CACHE_TTL_S = 2.0
+
+
+def _reverse_domain(domain: str) -> str:
+    return "/".join(reversed(domain.strip(".").split(".")))
+
+
+class BucketFederation:
+    def __init__(self, etcd: EtcdClient, domain: str,
+                 self_host: str, self_port: int,
+                 cluster_addrs: Optional[list[tuple[str, int]]] = None,
+                 timeout: float = 30.0):
+        self.etcd = etcd
+        self.domain = domain.strip(".")
+        self.self_host, self.self_port = self_host, self_port
+        # every node of THIS cluster: records are written for all of
+        # them and recognized as "ours" on lookup — a DELETE handled by
+        # node n2 must also clear n1's record or it goes stale forever
+        self.cluster_addrs = list(cluster_addrs
+                                  or [(self_host, self_port)])
+        if (self_host, self_port) not in self.cluster_addrs:
+            self.cluster_addrs.append((self_host, self_port))
+        self.timeout = timeout
+        self._base = f"/skydns/{_reverse_domain(self.domain)}"
+        # short positive-existence cache for LOCAL buckets: without it
+        # every request would stat the bucket twice (here + in the
+        # handler). Negative results are never cached, so new federated
+        # buckets and fresh local creates are visible immediately.
+        self._local_mu = threading.Lock()
+        self._local: dict[str, float] = {}
+
+    # -- DNS record CRUD (cmd/config/dns/etcd_dns.go shapes) --------------
+
+    def _bucket_prefix(self, bucket: str) -> str:
+        return f"{self._base}/{bucket}/"
+
+    def register(self, bucket: str) -> None:
+        for host, port in self.cluster_addrs:
+            rec = json.dumps({"host": host, "port": port,
+                              "ttl": DEFAULT_TTL}).encode()
+            self.etcd.put(self._bucket_prefix(bucket) + f"{host}:{port}",
+                          rec)
+
+    def unregister(self, bucket: str) -> None:
+        # every record of THIS cluster; another cluster may legically
+        # hold the same name in a different zone, so never the prefix
+        for host, port in self.cluster_addrs:
+            self.etcd.delete(self._bucket_prefix(bucket)
+                             + f"{host}:{port}")
+
+    def register_existing(self, obj) -> None:
+        """Startup sweep (reference initFederatorBackend): buckets that
+        predate federation (or an etcd restore) get their records
+        (re)published."""
+        try:
+            buckets = obj.list_buckets()
+        except Exception:  # noqa: BLE001 — best effort at boot
+            return
+        for b in buckets:
+            try:
+                self.register(b.name)
+            except EtcdError:
+                return             # etcd down: next boot/create retries
+
+    def lookup(self, bucket: str) -> list[tuple[str, int]]:
+        out = []
+        for _k, raw in self.etcd.get_prefix(
+                self._bucket_prefix(bucket)).items():
+            try:
+                rec = json.loads(raw.decode())
+                out.append((str(rec["host"]), int(rec["port"])))
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+        return out
+
+    def list_buckets(self) -> list[str]:
+        names = set()
+        plen = len(self._base) + 1
+        for k in self.etcd.get_prefix(self._base + "/"):
+            rest = k[plen:]
+            if "/" in rest:
+                names.add(rest.split("/", 1)[0])
+        return sorted(names)
+
+    # -- request forwarding (setBucketForwardingHandler analog) -----------
+
+    def owner_of(self, bucket: str) -> Optional[tuple[str, int]]:
+        """The (host, port) to forward to, or None when the bucket is
+        unknown to the federation or owned by this very cluster."""
+        try:
+            records = self.lookup(bucket)
+        except EtcdError:
+            return None               # etcd down: serve local-only
+        ours = set(self.cluster_addrs)
+        for rec in records:
+            if rec in ours:
+                return None
+        return records[0] if records else None
+
+    def forward(self, ctx: RequestContext, host: str, port: int
+                ) -> HTTPResponse:
+        """Transparent byte-level proxy of the current request to the
+        owning cluster; request and response bodies both stream (a
+        multi-GiB federated PUT never materializes here)."""
+        body = ctx.body_stream if ctx.content_length > 0 else b""
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=self.timeout)
+        path = ctx.req.path + (f"?{ctx.req.raw_query}"
+                               if ctx.req.raw_query else "")
+        headers = dict(ctx.req.headers)
+        headers["connection"] = "close"
+        try:
+            conn.request(ctx.req.method, path, body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            return HTTPResponse(
+                status=503,
+                body=b"federated bucket owner unreachable")
+        out_headers = {}
+        for k, v in resp.getheaders():
+            if k.lower() in ("connection", "transfer-encoding",
+                             "content-length"):
+                continue
+            out_headers[k] = v
+        length = resp.getheader("Content-Length")
+        if length is not None:
+            out_headers["Content-Length"] = length
+
+        def stream() -> Iterator[bytes]:
+            try:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        return
+                    yield chunk
+            except (OSError, http.client.HTTPException):
+                return            # owner died mid-body: truncate
+            finally:
+                conn.close()
+
+        return HTTPResponse(status=resp.status, headers=out_headers,
+                            stream=stream())
+
+    def _is_local(self, bucket: str, obj) -> bool:
+        now = time.monotonic()
+        with self._local_mu:
+            exp = self._local.get(bucket, 0.0)
+            if exp > now:
+                return True
+        from ..object import api_errors
+        try:
+            obj.get_bucket_info(bucket)
+        except api_errors.BucketNotFound:
+            return False
+        except api_errors.ObjectApiError:
+            return True           # local trouble: not a federation case
+        with self._local_mu:
+            self._local[bucket] = now + LOCAL_CACHE_TTL_S
+            if len(self._local) > 4096:
+                self._local = {b: e for b, e in self._local.items()
+                               if e > now}
+        return True
+
+    def maybe_forward(self, ctx: RequestContext, bucket: str, obj
+                      ) -> Optional[HTTPResponse]:
+        """Forward when the bucket exists in the federation but not
+        here. Local buckets always serve locally, with a short
+        positive-existence cache so the hot path doesn't stat twice."""
+        if self._is_local(bucket, obj):
+            return None
+        owner = self.owner_of(bucket)
+        if owner is None:
+            return None
+        return self.forward(ctx, owner[0], owner[1])

@@ -243,6 +243,7 @@ class S3ApiHandlers:
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
         self.cors_allow_origin = "*"   # config api.cors_allow_origin
+        self.federation = None    # optional BucketFederation (etcd DNS)
 
     def set_max_clients(self, n: int) -> None:
         """Re-size the admission gate once topology is known (the
@@ -533,6 +534,15 @@ class S3ApiHandlers:
         key = parts[1] if len(parts) > 1 else ""
         m = ctx.req.method
 
+        # federation middleware (setBucketForwardingHandler,
+        # cmd/routers.go:46): a bucket another cluster owns is proxied
+        # there BEFORE auth — the owner verifies the client's SigV4
+        # (federated deployments share credentials)
+        if bucket and self.federation is not None:
+            fwd = self.federation.maybe_forward(ctx, bucket, self.obj)
+            if fwd is not None:
+                return fwd
+
         if not bucket:
             if m == "GET":
                 return self.list_buckets(ctx)
@@ -766,6 +776,21 @@ class S3ApiHandlers:
     def list_buckets(self, ctx) -> HTTPResponse:
         self.authenticate(ctx, "s3:ListAllMyBuckets")
         buckets = self.obj.list_buckets()
+        if self.federation is not None:
+            # federated mode merges DNS bucket names into the listing
+            # (reference ListBucketsHandler in federated deployments) —
+            # clients discover remote-cluster buckets they can then
+            # address transparently through this endpoint
+            local = {b.name for b in buckets}
+            try:
+                remote = [n for n in self.federation.list_buckets()
+                          if n not in local]
+            except Exception:  # noqa: BLE001 — etcd down: local only
+                remote = []
+            import types
+            for name in remote:
+                buckets.append(types.SimpleNamespace(name=name,
+                                                     created=0.0))
         return HTTPResponse().with_xml(xmlgen.list_buckets_response(
             "minio", buckets))
 
@@ -794,6 +819,11 @@ class S3ApiHandlers:
                 "</ObjectLockConfiguration>")
         else:
             self.obj.make_bucket(bucket)
+        if self.federation is not None:
+            try:
+                self.federation.register(bucket)
+            except Exception:  # noqa: BLE001 — DNS best-effort, like ref
+                pass
         self._notify("s3:BucketCreated:*", bucket, "")
         return HTTPResponse(headers={"Location": f"/{bucket}"})
 
@@ -807,6 +837,11 @@ class S3ApiHandlers:
         force = ctx.header("x-minio-force-delete") == "true"
         self.obj.delete_bucket(bucket, force=force)
         self.bucket_meta.delete(bucket)
+        if self.federation is not None:
+            try:
+                self.federation.unregister(bucket)
+            except Exception:  # noqa: BLE001 — DNS best-effort
+                pass
         self._notify("s3:BucketRemoved:*", bucket, "")
         return HTTPResponse(status=204)
 

@@ -1,0 +1,245 @@
+"""Bucket federation over etcd DNS (VERDICT r3 missing #4; reference
+cmd/etcd.go + cmd/config/dns + the bucket-forwarding middleware at
+cmd/routers.go:46): etcd v3 KV client against an in-process fake,
+CoreDNS-layout record CRUD, and two live S3 "clusters" transparently
+serving each other's buckets with client signatures intact."""
+
+from __future__ import annotations
+
+import base64
+import http.server
+import json
+import threading
+
+import pytest
+
+from minio_tpu.distributed.etcd import EtcdClient, EtcdError
+from minio_tpu.features.federation import BucketFederation
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3.server import S3Server
+from tests.test_s3 import CREDS, REGION, S3TestClient
+
+
+class FakeEtcd(http.server.BaseHTTPRequestHandler):
+    """etcd v3 JSON gateway subset: kv/put, kv/range (point + prefix),
+    kv/deleterange."""
+
+    store: dict = {}
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        try:
+            req = json.loads(self.rfile.read(n) or b"{}")
+        except ValueError:
+            return self._reply(400, {})
+        key = base64.b64decode(req.get("key", "")).decode()
+        range_end = base64.b64decode(req.get("range_end", "")).decode()
+        if self.path == "/v3/kv/put":
+            self.store[key] = base64.b64decode(req.get("value", ""))
+            return self._reply(200, {})
+        if self.path == "/v3/kv/range":
+            if range_end:
+                kvs = [(k, v) for k, v in sorted(self.store.items())
+                       if key <= k < range_end]
+            else:
+                kvs = [(key, self.store[key])] if key in self.store \
+                    else []
+            return self._reply(200, {"kvs": [
+                {"key": base64.b64encode(k.encode()).decode(),
+                 "value": base64.b64encode(v).decode()}
+                for k, v in kvs]})
+        if self.path == "/v3/kv/deleterange":
+            if range_end:
+                for k in [k for k in self.store
+                          if key <= k < range_end]:
+                    del self.store[k]
+            else:
+                self.store.pop(key, None)
+            return self._reply(200, {})
+        return self._reply(404, {})
+
+    def _reply(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def etcd_server():
+    FakeEtcd.store = {}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeEtcd)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_etcd_kv_client(etcd_server):
+    c = EtcdClient(f"http://127.0.0.1:{etcd_server}")
+    assert c.get("missing") is None
+    c.put("a/b/one", b"1")
+    c.put("a/b/two", b"2")
+    c.put("a/c", b"3")
+    assert c.get("a/b/one") == b"1"
+    assert c.get_prefix("a/b/") == {"a/b/one": b"1", "a/b/two": b"2"}
+    c.delete("a/b/one")
+    assert c.get("a/b/one") is None
+    c.delete_prefix("a/")
+    assert c.get_prefix("a/") == {}
+    with pytest.raises(EtcdError, match="unreachable"):
+        EtcdClient("http://127.0.0.1:1", timeout=0.4).get("x")
+    with pytest.raises(ValueError):
+        EtcdClient("not-a-url")
+
+
+def _cluster(tmp_path, name, etcd_port, domain="fed.example.com"):
+    sets = ErasureSets.from_drives(
+        [str(tmp_path / f"{name}-d{i}") for i in range(4)], 1, 4, 2,
+        block_size=1 << 16)
+    srv = S3Server(sets, creds=CREDS, region=REGION).start()
+    fed = BucketFederation(EtcdClient(f"http://127.0.0.1:{etcd_port}"),
+                           domain, "127.0.0.1", srv.port)
+    srv.api.federation = fed
+    return srv, sets, fed
+
+
+def test_dns_record_layout(etcd_server, tmp_path):
+    """Records land in the CoreDNS/skydns layout the reference writes,
+    so a real CoreDNS on the same etcd would resolve bucket.domain."""
+    srv, sets, fed = _cluster(tmp_path, "a", etcd_server)
+    try:
+        c = S3TestClient("127.0.0.1", srv.port)
+        assert c.request("PUT", "/fedbucket")[0] == 200
+        key = (f"/skydns/com/example/fed/fedbucket/"
+               f"127.0.0.1:{srv.port}")
+        assert key in FakeEtcd.store
+        rec = json.loads(FakeEtcd.store[key])
+        assert rec["host"] == "127.0.0.1" and rec["port"] == srv.port
+        assert fed.list_buckets() == ["fedbucket"]
+        assert c.request("DELETE", "/fedbucket")[0] == 204
+        assert key not in FakeEtcd.store
+    finally:
+        srv.stop()
+        sets.close()
+
+
+def test_multinode_records_and_startup_sweep(etcd_server, tmp_path):
+    """Review r4: records are written for EVERY node of the owning
+    cluster and unregister clears them all (a DELETE handled by node 2
+    must not leave node 1's record stale); register_existing publishes
+    buckets that predate federation."""
+    c = EtcdClient(f"http://127.0.0.1:{etcd_server}")
+    fed = BucketFederation(c, "fed.example.com", "10.0.0.1", 9000,
+                           cluster_addrs=[("10.0.0.1", 9000),
+                                          ("10.0.0.2", 9000)])
+    fed.register("multi")
+    assert len(fed.lookup("multi")) == 2
+    # a sibling node's federation object (same cluster_addrs) sees the
+    # bucket as its own
+    sib = BucketFederation(c, "fed.example.com", "10.0.0.2", 9000,
+                           cluster_addrs=[("10.0.0.1", 9000),
+                                          ("10.0.0.2", 9000)])
+    assert sib.owner_of("multi") is None
+    # unregister from the OTHER node removes both records
+    sib.unregister("multi")
+    assert fed.lookup("multi") == []
+
+    # startup sweep: pre-existing buckets get published
+    sets = ErasureSets.from_drives(
+        [str(tmp_path / f"sw-d{i}") for i in range(4)], 1, 4, 2,
+        block_size=1 << 16)
+    try:
+        sets.make_bucket("preexisting")
+        fed.register_existing(sets)
+        assert "preexisting" in fed.list_buckets()
+    finally:
+        sets.close()
+
+
+def test_cross_cluster_forwarding(etcd_server, tmp_path):
+    """A bucket owned by cluster A serves through cluster B: B's
+    router forwards the raw request to A (shared creds, signature
+    verified at the owner), responses stream back. Unknown buckets
+    still 404, and A's own requests never loop."""
+    a_srv, a_sets, _ = _cluster(tmp_path, "a", etcd_server)
+    b_srv, b_sets, _ = _cluster(tmp_path, "b", etcd_server)
+    try:
+        ca = S3TestClient("127.0.0.1", a_srv.port)
+        cb = S3TestClient("127.0.0.1", b_srv.port)
+        assert ca.request("PUT", "/abucket")[0] == 200
+        payload = b"federated-payload" * 1000
+        assert ca.request("PUT", "/abucket/obj",
+                          body=payload)[0] == 200
+
+        # read A's object THROUGH B
+        st, _, got = cb.request("GET", "/abucket/obj")
+        assert st == 200 and got == payload
+        # write through B lands on A
+        assert cb.request("PUT", "/abucket/via-b",
+                          body=b"hello-a")[0] == 200
+        st, _, got = ca.request("GET", "/abucket/via-b")
+        assert st == 200 and got == b"hello-a"
+        # listing through B sees both
+        st, _, body = cb.request("GET", "/abucket")
+        assert st == 200 and b"via-b" in body
+        # delete through B
+        assert cb.request("DELETE", "/abucket/via-b")[0] == 204
+        assert ca.request("GET", "/abucket/via-b")[0] == 404
+
+        # a bucket in NO cluster is still NoSuchBucket on both
+        assert cb.request("GET", "/ghostbucket/x")[0] == 404
+        assert ca.request("GET", "/ghostbucket/x")[0] == 404
+
+        # ListBuckets on B merges the federation's bucket names
+        st, _, body = cb.request("GET", "/")
+        assert st == 200 and b"abucket" in body
+
+        # B's own buckets serve locally even with federation on
+        assert cb.request("PUT", "/bbucket")[0] == 200
+        assert cb.request("PUT", "/bbucket/o", body=b"local")[0] == 200
+        st, _, got = cb.request("GET", "/bbucket/o")
+        assert st == 200 and got == b"local"
+        # ... and A forwards to B for it
+        st, _, got = ca.request("GET", "/bbucket/o")
+        assert st == 200 and got == b"local"
+    finally:
+        a_srv.stop()
+        b_srv.stop()
+        a_sets.close()
+        b_sets.close()
+
+
+def test_forwarding_survives_etcd_and_owner_outage(etcd_server,
+                                                   tmp_path):
+    """etcd down: local buckets keep serving (federation degrades to
+    local-only). Owner down: the forwarder answers 503, not a hang."""
+    a_srv, a_sets, _ = _cluster(tmp_path, "a", etcd_server)
+    b_srv, b_sets, b_fed = _cluster(tmp_path, "b", etcd_server)
+    try:
+        ca = S3TestClient("127.0.0.1", a_srv.port)
+        cb = S3TestClient("127.0.0.1", b_srv.port)
+        assert ca.request("PUT", "/abucket2")[0] == 200
+        assert cb.request("PUT", "/blocal")[0] == 200
+
+        # owner A goes down: forwarding from B reports 503
+        a_srv.stop()
+        b_fed.timeout = 1.0
+        st, _, _ = cb.request("GET", "/abucket2/x")
+        assert st == 503
+
+        # etcd down: B's local bucket still serves
+        b_fed.etcd = EtcdClient("http://127.0.0.1:1", timeout=0.4)
+        assert cb.request("PUT", "/blocal/o", body=b"v")[0] == 200
+        st, _, got = cb.request("GET", "/blocal/o")
+        assert st == 200 and got == b"v"
+        # unknown bucket with etcd down: NoSuchBucket, not 500
+        assert cb.request("GET", "/abucket2/x")[0] == 404
+    finally:
+        b_srv.stop()
+        a_sets.close()
+        b_sets.close()
