@@ -269,8 +269,9 @@ class ClusterNode:
             from .distributed.etcd import EtcdClient
             from .features.federation import BucketFederation
             try:
+                etcd_client = EtcdClient(etcd_ep.split(",")[0].strip())
                 fed = BucketFederation(
-                    EtcdClient(etcd_ep.split(",")[0].strip()),
+                    etcd_client,
                     fed_domain, self.spec.host, self.spec.port,
                     cluster_addrs=[(n.host, n.port)
                                    for n in self.nodes])
@@ -278,6 +279,13 @@ class ClusterNode:
                 # reference initFederatorBackend: buckets that predate
                 # federation (or an etcd restore) get re-registered
                 fed.register_existing(self.object_layer)
+                # etcd configured => IAM moves to the etcd store
+                # (cmd/iam-etcd-store.go): users/policies/service
+                # accounts created on ANY federated cluster are
+                # visible to all of them; identities that predate etcd
+                # are seeded into it on first switch
+                from .iam.store import EtcdIAMStore
+                self.iam.migrate_to_store(EtcdIAMStore(etcd_client))
             except ValueError:
                 pass              # bad endpoint: federation stays off
 

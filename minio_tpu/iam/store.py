@@ -1,0 +1,159 @@
+"""IAM persistence backends (reference cmd/iam-object-store.go and
+cmd/iam-etcd-store.go).
+
+One interface, two stores:
+
+* ``ObjectIAMStore`` — JSON blobs under ``.minio.sys/config/iam/``
+  through the ObjectLayer itself (erasure-coded, survives drive loss) —
+  the default, and the only option without etcd.
+* ``EtcdIAMStore`` — the same records as etcd keys. With federation
+  this is what makes a set of clusters ONE deployment: users, policies
+  and service accounts created on any cluster are visible to all of
+  them (the reference switches IAM to etcd automatically when etcd is
+  configured). Cross-cluster visibility is bounded by the periodic IAM
+  refresh (no watch: the etcd JSON gateway's watch is a streaming gRPC
+  bridge; polling at MINIO_TPU_IAM_REFRESH_S matches this build's
+  bounded-staleness design for intra-cluster deltas).
+
+Transient backend trouble raises ``IAMStoreError`` — callers must be
+able to distinguish "the record is gone" (None) from "the backend
+hiccuped" (exception), or a network blip would read as a user
+deletion.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from typing import Optional
+
+IAM_PREFIX = "config/iam"
+MINIO_META_BUCKET = ".minio.sys"
+ETCD_BASE = "minio/iam"
+
+
+class IAMStoreError(Exception):
+    """Transient persistence failure (quorum blip, etcd unreachable)."""
+
+
+def entity_path(prefix: str, name: str) -> str:
+    """Relative record path; the entity name is percent-encoded so
+    federated subjects like 'oidc:a/b' can never collide with 'oidc:a_b'
+    and decode back exactly on load."""
+    return (f"{IAM_PREFIX}/{prefix}/"
+            f"{urllib.parse.quote(name, safe='')}.json")
+
+
+class ObjectIAMStore:
+    def __init__(self, obj):
+        self.obj = obj
+
+    def save(self, path: str, payload: dict) -> None:
+        from ..object import api_errors
+        try:
+            self.obj.put_object(MINIO_META_BUCKET, path,
+                                json.dumps(payload).encode())
+        except api_errors.ObjectApiError as e:
+            raise IAMStoreError(str(e)) from e
+
+    def delete(self, path: str) -> None:
+        from ..object import api_errors
+        try:
+            self.obj.delete_object(MINIO_META_BUCKET, path)
+        except api_errors.ObjectNotFound:
+            pass
+        except api_errors.ObjectApiError as e:
+            # a failed revocation must surface — the refresh loop would
+            # otherwise resurrect the "deleted" credential from the
+            # record that never left the store
+            raise IAMStoreError(str(e)) from e
+
+    def read_all(self, prefix: str) -> dict[str, dict]:
+        from ..object import api_errors
+        out: dict[str, dict] = {}
+        try:
+            objs, _, _ = self.obj.list_objects(
+                MINIO_META_BUCKET, prefix=f"{IAM_PREFIX}/{prefix}/",
+                max_keys=10000)
+        except api_errors.ObjectApiError as e:
+            # a listing failure is backend trouble, not an empty store
+            # — returning {} here would wipe the caller's cache
+            raise IAMStoreError(str(e)) from e
+        for oi in objs:
+            if not oi.name.endswith(".json"):
+                continue
+            name = urllib.parse.unquote(
+                oi.name[len(f"{IAM_PREFIX}/{prefix}/"):-len(".json")])
+            try:
+                _, stream = self.obj.get_object(MINIO_META_BUCKET,
+                                                oi.name)
+                out[name] = json.loads(b"".join(stream).decode())
+            except (api_errors.ObjectApiError, ValueError):
+                continue
+        return out
+
+    def read_one(self, prefix: str, name: str) -> Optional[dict]:
+        from ..object import api_errors
+        try:
+            _, stream = self.obj.get_object(
+                MINIO_META_BUCKET, entity_path(prefix, name))
+            return json.loads(b"".join(stream).decode())
+        except (api_errors.ObjectNotFound, ValueError):
+            return None
+        except api_errors.ObjectApiError as e:
+            raise IAMStoreError(str(e)) from e
+
+
+class EtcdIAMStore:
+    def __init__(self, etcd):
+        self.etcd = etcd
+
+    @staticmethod
+    def _key(path: str) -> str:
+        return f"{ETCD_BASE}/{path}"
+
+    def save(self, path: str, payload: dict) -> None:
+        from ..distributed.etcd import EtcdError
+        try:
+            self.etcd.put(self._key(path),
+                          json.dumps(payload).encode())
+        except EtcdError as e:
+            raise IAMStoreError(str(e)) from e
+
+    def delete(self, path: str) -> None:
+        from ..distributed.etcd import EtcdError
+        try:
+            self.etcd.delete(self._key(path))
+        except EtcdError as e:
+            raise IAMStoreError(str(e)) from e
+
+    def read_all(self, prefix: str) -> dict[str, dict]:
+        from ..distributed.etcd import EtcdError
+        base = f"{ETCD_BASE}/{IAM_PREFIX}/{prefix}/"
+        out: dict[str, dict] = {}
+        try:
+            kvs = self.etcd.get_prefix(base)
+        except EtcdError as e:
+            raise IAMStoreError(str(e)) from e
+        for k, raw in kvs.items():
+            if not k.endswith(".json"):
+                continue
+            name = urllib.parse.unquote(k[len(base):-len(".json")])
+            try:
+                out[name] = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    def read_one(self, prefix: str, name: str) -> Optional[dict]:
+        from ..distributed.etcd import EtcdError
+        try:
+            raw = self.etcd.get(self._key(entity_path(prefix, name)))
+        except EtcdError as e:
+            raise IAMStoreError(str(e)) from e
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None

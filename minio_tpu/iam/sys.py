@@ -44,8 +44,15 @@ class IAMSys:
     """
 
     def __init__(self, object_layer=None, root_cred: Optional[Credentials]
-                 = None):
+                 = None, store=None):
+        from .store import ObjectIAMStore
         self.obj = object_layer
+        # persistence backend (cmd/iam-object-store.go vs
+        # cmd/iam-etcd-store.go): defaults to the object layer; an
+        # EtcdIAMStore makes IAM shared across federated clusters
+        self.store = store if store is not None else (
+            ObjectIAMStore(object_layer)
+            if object_layer is not None else None)
         self.root = root_cred
         self._mu = threading.RLock()
         self.users: dict[str, Credentials] = {}
@@ -63,7 +70,7 @@ class IAMSys:
         self.on_delta: Optional[Callable[[list], None]] = None
         # bucket policy lookup seam (bucket -> policy JSON or "")
         self.bucket_policy_lookup: Optional[Callable[[str], str]] = None
-        if self.obj is not None:
+        if self.store is not None:
             self.load()
 
     # ------------------------------------------------------------------
@@ -71,84 +78,72 @@ class IAMSys:
     # ------------------------------------------------------------------
 
     def _path(self, *parts: str) -> str:
-        # The entity name (last part) may be a federated subject like
-        # 'oidc:tenant/user' — percent-encode it so distinct subjects
-        # can never collide on disk ('a/b' vs 'a_b') and the stored
-        # name decodes back to the exact subject on load.
-        parts = parts[:-1] + (urllib.parse.quote(parts[-1], safe=""),)
-        return "/".join((IAM_PREFIX,) + parts) + ".json"
+        # one encoder for reads AND writes: store.entity_path owns the
+        # percent-encoding (federated subjects like 'oidc:a/b' must
+        # never collide with 'oidc:a_b', and the write path and the
+        # delta read path must build byte-identical keys)
+        from .store import entity_path
+        return entity_path("/".join(parts[:-1]), parts[-1])
 
     def _save(self, path: str, payload: dict) -> None:
-        if self.obj is None:
-            return
-        self.obj.put_object(MINIO_META_BUCKET, path,
-                            json.dumps(payload).encode())
+        if self.store is not None:
+            self.store.save(path, payload)
 
     def _delete(self, path: str) -> None:
-        if self.obj is None:
-            return
-        from ..object import api_errors
-        try:
-            self.obj.delete_object(MINIO_META_BUCKET, path)
-        except api_errors.ObjectApiError:
-            pass
+        if self.store is not None:
+            self.store.delete(path)
 
     def _read_all(self, prefix: str) -> dict[str, dict]:
-        """name (sans .json) -> parsed payload for every object under
-        config/iam/<prefix>/."""
-        if self.obj is None:
+        """name -> parsed payload for every record under
+        config/iam/<prefix>/ in the configured store."""
+        if self.store is None:
             return {}
-        from ..object import api_errors
-        out = {}
-        try:
-            objs, _, _ = self.obj.list_objects(
-                MINIO_META_BUCKET, prefix=f"{IAM_PREFIX}/{prefix}/",
-                max_keys=10000)
-        except api_errors.ObjectApiError:
-            return {}
-        for oi in objs:
-            if not oi.name.endswith(".json"):
-                continue
-            name = urllib.parse.unquote(
-                oi.name[len(f"{IAM_PREFIX}/{prefix}/"):-len(".json")])
-            try:
-                _, stream = self.obj.get_object(MINIO_META_BUCKET, oi.name)
-                out[name] = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
-                continue
-        return out
+        return self.store.read_all(prefix)
 
     def load(self) -> None:
-        """(Re)build the cache from the meta bucket (reference
-        IAMSys.Load)."""
+        """(Re)build the cache from the store (reference IAMSys.Load).
+        Every prefix is read BEFORE the cache mutates, and a transient
+        store failure keeps the existing cache — a backend blip must
+        never read as "all identities deleted"."""
+        from .store import IAMStoreError
+        try:
+            raw_users = self._read_all("users")
+            raw_groups = self._read_all("groups")
+            raw_policies = self._read_all("policies")
+            raw_upol = self._read_all("policydb/users")
+            raw_gpol = self._read_all("policydb/groups")
+            raw_svc = self._read_all("svcaccts")
+            raw_sts = self._read_all("sts")
+        except IAMStoreError:
+            return                # keep the current cache
         with self._mu:
             self.users = {
                 ak: Credentials(access_key=ak,
                                 secret_key=d.get("secret_key", ""),
                                 status=d.get("status", "on"))
-                for ak, d in self._read_all("users").items()}
-            self.groups = self._read_all("groups")
+                for ak, d in raw_users.items()}
+            self.groups = raw_groups
             self.policies = dict(CANNED_POLICIES)
-            for name, d in self._read_all("policies").items():
+            for name, d in raw_policies.items():
                 try:
                     self.policies[name] = Policy.from_json(json.dumps(d))
                 except (ValueError, KeyError):
                     continue
             self.user_policy = {
                 ak: list(d.get("policy", []))
-                for ak, d in self._read_all("policydb/users").items()}
+                for ak, d in raw_upol.items()}
             self.group_policy = {
                 g: list(d.get("policy", []))
-                for g, d in self._read_all("policydb/groups").items()}
+                for g, d in raw_gpol.items()}
             self.svc_accounts = {
                 ak: Credentials(access_key=ak,
                                 secret_key=d.get("secret_key", ""),
                                 parent_user=d.get("parent", ""),
                                 status=d.get("status", "on"))
-                for ak, d in self._read_all("svcaccts").items()}
+                for ak, d in raw_svc.items()}
             now = time.time()
             self.sts_creds = {}
-            for ak, d in self._read_all("sts").items():
+            for ak, d in raw_sts.items():
                 c = Credentials(access_key=ak,
                                 secret_key=d.get("secret_key", ""),
                                 session_token=d.get("session_token", ""),
@@ -156,6 +151,57 @@ class IAMSys:
                                 parent_user=d.get("parent", ""))
                 if not c.is_expired() or c.expiration > now:
                     self.sts_creds[ak] = c
+
+    def migrate_to_store(self, new_store) -> None:
+        """Switch persistence backends (the object-store → etcd move
+        when federation is first configured). An empty target is seeded
+        from the current cache so identities that predate etcd survive
+        the switch; a non-empty target is authoritative (another
+        federated cluster already populated it) and replaces the cache.
+        An unreachable target keeps the current store untouched."""
+        from .store import IAMStoreError
+        try:
+            existing = new_store.read_all("users")
+        except IAMStoreError:
+            return
+        old_store = self.store
+        self.store = new_store
+        if existing:
+            self.load()
+            return
+        with self._mu:
+            try:
+                for ak, c in self.users.items():
+                    self._save(self._path("users", ak),
+                               {"secret_key": c.secret_key,
+                                "status": c.status})
+                for g, info in self.groups.items():
+                    self._save(self._path("groups", g), info)
+                for name, pol in self.policies.items():
+                    if name not in CANNED_POLICIES:
+                        self._save(self._path("policies", name),
+                                   json.loads(pol.to_json()))
+                for ak, names in self.user_policy.items():
+                    self._save(self._path("policydb/users", ak),
+                               {"policy": list(names)})
+                for g, names in self.group_policy.items():
+                    self._save(self._path("policydb/groups", g),
+                               {"policy": list(names)})
+                for ak, c in self.svc_accounts.items():
+                    self._save(self._path("svcaccts", ak),
+                               {"secret_key": c.secret_key,
+                                "parent": c.parent_user,
+                                "status": c.status})
+                for ak, c in self.sts_creds.items():
+                    self._save(self._path("sts", ak),
+                               {"secret_key": c.secret_key,
+                                "session_token": c.session_token,
+                                "expiration": c.expiration,
+                                "parent": c.parent_user})
+            except IAMStoreError:
+                # partial seed: fall back to the old store; the next
+                # boot retries the migration from the durable copy
+                self.store = old_store
 
     def _notify(self, kind: str = "", name: str = "") -> None:
         self._notify_batch([(kind, name)] if kind else [])
@@ -181,25 +227,20 @@ class IAMSys:
                 pass
 
     def _read_one(self, prefix: str, name: str) -> Optional[dict]:
-        """Current on-disk record of one IAM entity, or None when it no
+        """Current stored record of one IAM entity, or None when it no
         longer exists (delta application reads the store, so a delete
         and a create are the same verb). A TRANSIENT store error must
-        not read as "deleted" — it raises, and apply_delta degrades to
-        a full reload instead of evicting a live credential."""
-        if self.obj is None:
+        not read as "deleted" — it raises IAMStoreError, and
+        apply_delta degrades to a full reload instead of evicting a
+        live credential."""
+        if self.store is None:
             return None
-        from ..object import api_errors
-        try:
-            _, stream = self.obj.get_object(
-                MINIO_META_BUCKET, self._path(prefix, name))
-            return json.loads(b"".join(stream).decode())
-        except (api_errors.ObjectNotFound, ValueError):
-            return None
+        return self.store.read_one(prefix, name)
 
     def apply_delta(self, kind: str, name: str) -> None:
         """Refresh one entity from the store (the receiving side of the
         peer delta verbs). Unknown kinds degrade to a full load."""
-        from ..object import api_errors
+        from .store import IAMStoreError
         d = None
         if kind in ("user", "group", "policy", "user-policy",
                     "group-policy", "svcacct", "sts"):
@@ -210,12 +251,12 @@ class IAMSys:
                       "svcacct": "svcaccts", "sts": "sts"}[kind]
             try:
                 d = self._read_one(prefix, name)
-            except api_errors.ObjectApiError:
-                # quorum blip on the read: keep the cached entry and
+            except IAMStoreError:
+                # backend blip on the read: keep the cached entry and
                 # resync wholesale rather than evicting a live identity
                 try:
                     self.load()
-                except api_errors.ObjectApiError:
+                except IAMStoreError:
                     pass
                 return
         with self._mu:

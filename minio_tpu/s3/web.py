@@ -198,6 +198,7 @@ class WebHandlers:
         if fn is None:
             return self._rpc_response(rid, error={
                 "code": -32601, "message": f"unknown method {method}"})
+        from ..iam.store import IAMStoreError
         try:
             return self._rpc_response(rid, result=fn(ctx, params or {}))
         except _RPCError as e:
@@ -206,6 +207,9 @@ class WebHandlers:
         except (S3Error, oerr.ObjectApiError) as e:
             return self._rpc_response(rid, error={"code": 1,
                                                   "message": str(e)})
+        except IAMStoreError as e:
+            return self._rpc_response(rid, error={
+                "code": 500, "message": f"identity store: {e}"})
 
     @staticmethod
     def _rpc_response(rid, result=None, error=None) -> HTTPResponse:
