@@ -643,27 +643,36 @@ class ErasureObjects:
         while bn <= end_block:
             group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
             group = []
-            for b in range(bn, group_end + 1):
-                block_off = b * fi.erasure.block_size
-                block_len = min(fi.erasure.block_size,
-                                part.size - block_off)
-                shard_len = -(-block_len // k)
-                shards, digests, had_errors = self._read_block_shards_raw(
-                    readers, b, shard_size, shard_len, k, n,
-                    collect_digests=defer_verify)
-                heal_required = heal_required or had_errors
-                group.append([b, block_off, block_len, shard_len, shards,
-                              digests])
-            if self._verify_and_reconstruct_group(
-                    codec, group, k, n, readers, shard_size,
-                    part_algo or self.bitrot_algo):
-                heal_required = True
-            for b, block_off, block_len, shard_len, shards, _dg in group:
-                data = np.concatenate([s[:shard_len]
-                                       for s in shards[:k]])
-                begin = max(offset - block_off, 0)
-                end = min(offset + length - block_off, block_len)
-                yield data.tobytes()[begin:end]
+            with stagetimer.stage("get.read_shards"):
+                for b in range(bn, group_end + 1):
+                    block_off = b * fi.erasure.block_size
+                    block_len = min(fi.erasure.block_size,
+                                    part.size - block_off)
+                    shard_len = -(-block_len // k)
+                    shards, digests, had_errors = \
+                        self._read_block_shards_raw(
+                            readers, b, shard_size, shard_len, k, n,
+                            collect_digests=defer_verify)
+                    heal_required = heal_required or had_errors
+                    group.append([b, block_off, block_len, shard_len,
+                                  shards, digests])
+            with stagetimer.stage("get.verify+decode"):
+                if self._verify_and_reconstruct_group(
+                        codec, group, k, n, readers, shard_size,
+                        part_algo or self.bitrot_algo):
+                    heal_required = True
+            with stagetimer.stage("get.join"):
+                out = []
+                for b, block_off, block_len, shard_len, shards, _dg \
+                        in group:
+                    data = np.concatenate([s[:shard_len]
+                                           for s in shards[:k]])
+                    begin = max(offset - block_off, 0)
+                    end = min(offset + length - block_off, block_len)
+                    # slice the view FIRST: tobytes on the full block
+                    # then slicing again was two payload copies
+                    out.append(data[begin:end].tobytes())
+            yield from out
             bn = group_end + 1
 
         for r in readers:
