@@ -605,16 +605,20 @@ class ErasureObjects:
         till = fi.erasure.shard_file_offset(offset, length, part.size)
         path = f"{object_name}/{fi.data_dir}/part.{part.number}"
 
-        readers: list[Optional[object]] = [None] * n
-        for i, d in enumerate(disks):
-            if d is None or smeta[i] is None:
-                continue
-            csum = smeta[i].erasure.get_checksum_info(part.number)
-            algo = (bitrot_mod.BitrotAlgorithm.from_string(csum.algorithm)
-                    if csum else self.bitrot_algo)
-            readers[i] = bitrot_io.new_bitrot_reader(
-                d, bucket, path, till, algo,
-                csum.hash if csum else b"", shard_size)
+        def make_readers() -> list:
+            out: list[Optional[object]] = [None] * n
+            for i, d in enumerate(disks):
+                if d is None or smeta[i] is None:
+                    continue
+                csum = smeta[i].erasure.get_checksum_info(part.number)
+                algo = (bitrot_mod.BitrotAlgorithm.from_string(
+                    csum.algorithm) if csum else self.bitrot_algo)
+                out[i] = bitrot_io.new_bitrot_reader(
+                    d, bucket, path, till, algo,
+                    csum.hash if csum else b"", shard_size)
+            return out
+
+        readers = make_readers()
 
         start_block = offset // fi.erasure.block_size
         end_block = (offset + length - 1) // fi.erasure.block_size
@@ -644,15 +648,36 @@ class ErasureObjects:
             group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
             group = []
             with stagetimer.stage("get.read_shards"):
-                for b in range(bn, group_end + 1):
+                blocks = list(range(bn, group_end + 1))
+                geoms = []
+                for b in blocks:
                     block_off = b * fi.erasure.block_size
                     block_len = min(fi.erasure.block_size,
                                     part.size - block_off)
-                    shard_len = -(-block_len // k)
-                    shards, digests, had_errors = \
-                        self._read_block_shards_raw(
-                            readers, b, shard_size, shard_len, k, n,
-                            collect_digests=defer_verify)
+                    geoms.append((b, block_off, block_len,
+                                  -(-block_len // k)))
+                try:
+                    reads = self._read_group_shards_raw(
+                        readers, blocks, shard_size,
+                        [g[3] for g in geoms], k, n,
+                        collect_digests=defer_verify)
+                except api_errors.InsufficientReadQuorum:
+                    # group-granular hedging can lose quorum where
+                    # block-granular recovery still succeeds (distinct
+                    # readers corrupted at distinct blocks): rebuild
+                    # the readers the group attempt burned and degrade
+                    # to per-block hedged reads
+                    for r in readers:
+                        if r is not None:
+                            r.close()
+                    readers = make_readers()
+                    heal_required = True
+                    reads = [self._read_block_shards_raw(
+                        readers, g[0], shard_size, g[3], k, n,
+                        collect_digests=defer_verify) for g in geoms]
+                for (b, block_off, block_len, shard_len), \
+                        (shards, digests, had_errors) in zip(geoms,
+                                                             reads):
                     heal_required = heal_required or had_errors
                     group.append([b, block_off, block_len, shard_len,
                                   shards, digests])
@@ -800,6 +825,76 @@ class ErasureObjects:
             group[gi][5] = [None] * n
         return heal
 
+    def _read_group_shards_raw(self, readers, blocks: list,
+                               shard_size: int, shard_lens: list,
+                               k: int, n: int,
+                               collect_digests: bool = False) -> list:
+        """Group form of _read_block_shards_raw: ONE pool task per
+        reader streams every block of the group sequentially (the
+        frames are adjacent on disk), instead of a k-way fan-out per
+        block — GET_BATCH_BLOCKS× fewer pool tasks, and each shard
+        file is read in order. Hedging stays reader-granular: a reader
+        that fails anywhere is dropped and extras re-read the whole
+        group. Returns [(shards, digests, had_errors)] per block."""
+        nb = len(blocks)
+        per_reader: list = [None] * n          # i -> [(data, dg)]*nb
+        tried = [False] * n
+        had_errors = False
+
+        def try_read(indices: list[int]) -> None:
+            def read_one(j, r):
+                if r is None or tried[indices[j]]:
+                    raise serr.DiskNotFound(f"reader {indices[j]}")
+                out = []
+                for b, sl in zip(blocks, shard_lens):
+                    off = b * shard_size
+                    if collect_digests and isinstance(
+                            r, bitrot_io.StreamingBitrotReader):
+                        frames = r.read_frames(off, sl)
+                        out.append((frames[0][1] if frames else b"",
+                                    frames[0][0] if frames else None))
+                    else:
+                        out.append((r.read_at(off, sl), None))
+                return out
+
+            results, errs = meta.for_each_disk(
+                [readers[i] for i in indices], read_one)
+            for j, (res, e) in enumerate(zip(results, errs)):
+                i = indices[j]
+                tried[i] = True
+                if e is None and res is not None:
+                    per_reader[i] = res
+                elif e is not None:
+                    readers[i] = None
+
+        try_read([i for i in range(k) if readers[i] is not None])
+        got = sum(1 for r in per_reader if r is not None)
+        while got < k:
+            extras = [i for i in range(n)
+                      if readers[i] is not None and not tried[i]]
+            if not extras:
+                break
+            had_errors = True
+            try_read(extras[:k - got])
+            got = sum(1 for r in per_reader if r is not None)
+        if got < k:
+            raise api_errors.InsufficientReadQuorum(
+                f"{got} readable shards < k={k}")
+        if any(per_reader[i] is None for i in range(k)):
+            had_errors = True
+
+        out = []
+        for bi in range(nb):
+            shards: list = [None] * n
+            digests: list = [None] * n
+            for i in range(n):
+                if per_reader[i] is not None:
+                    shards[i] = np.frombuffer(per_reader[i][bi][0],
+                                              dtype=np.uint8)
+                    digests[i] = per_reader[i][bi][1]
+            out.append((shards, digests, had_errors))
+        return out
+
     def _read_block_shards_raw(self, readers, block_num: int,
                                shard_size: int, shard_len: int, k: int,
                                n: int, collect_digests: bool = False
@@ -812,54 +907,13 @@ class ErasureObjects:
         With collect_digests, streaming readers skip per-frame host
         verification and return each frame's stored digest instead
         (digests[i] is None when the shard was verified at read time) —
-        the deferred-verify feed for the fused device program."""
-        offset = block_num * shard_size
-        shards: list[Optional[np.ndarray]] = [None] * n
-        digests: list[Optional[bytes]] = [None] * n
-        tried = [False] * n
-        had_errors = False
+        the deferred-verify feed for the fused device program.
 
-        def try_read(indices: list[int]) -> None:
-            def read_one(j, r):
-                if r is None or tried[indices[j]]:
-                    raise serr.DiskNotFound(f"reader {indices[j]}")
-                if collect_digests and isinstance(
-                        r, bitrot_io.StreamingBitrotReader):
-                    frames = r.read_frames(offset, shard_len)
-                    dg = frames[0][0] if frames else None
-                    data = frames[0][1] if frames else b""
-                    return indices[j], data, dg
-                return indices[j], r.read_at(offset, shard_len), None
-
-            results, errs = meta.for_each_disk(
-                [readers[i] for i in indices],
-                read_one)
-            for j, (res, e) in enumerate(zip(results, errs)):
-                i = indices[j]
-                tried[i] = True
-                if e is None and res is not None:
-                    shards[i] = np.frombuffer(res[1], dtype=np.uint8)
-                    digests[i] = res[2]
-                elif e is not None:
-                    readers[i] = None
-
-        # preference: data shards first (avoids reconstruct entirely)
-        try_read([i for i in range(k) if readers[i] is not None])
-        got = sum(1 for s in shards if s is not None)
-        while got < k:
-            extras = [i for i in range(n)
-                      if readers[i] is not None and not tried[i]]
-            if not extras:
-                break
-            had_errors = True
-            try_read(extras[:k - got])
-            got = sum(1 for s in shards if s is not None)
-        if got < k:
-            raise api_errors.InsufficientReadQuorum(
-                f"{got} readable shards < k={k}")
-        if any(shards[i] is None for i in range(k)):
-            had_errors = True
-        return shards, digests, had_errors
+        One hedged-read state machine: this is the single-block form of
+        _read_group_shards_raw."""
+        return self._read_group_shards_raw(
+            readers, [block_num], shard_size, [shard_len], k, n,
+            collect_digests=collect_digests)[0]
 
     # ------------------------------------------------------------------
     # DELETE (cmd/erasure-object.go:727-820)

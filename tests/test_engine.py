@@ -279,6 +279,42 @@ def test_bitrot_corruption_detected_and_recovered(eng, tmp_path):
     assert b"".join(it) == data
 
 
+def test_group_read_falls_back_to_per_block_hedging(eng, tmp_path):
+    """Review r4: distinct readers corrupted at distinct blocks defeat
+    group-granular hedging (quorum needs k survivors across the WHOLE
+    group) — the read must degrade to per-block hedging, where every
+    individual block still has >= k clean shards, and serve the object."""
+    data = payload(3 * BLOCK + 7)
+    eng.put_object("bucket", "gfb", data)
+    fi = eng._read_one("bucket", "gfb")
+    dist = fi.erasure.distribution       # drive i holds shard dist[i]-1
+    shard_size = -(-BLOCK // K)
+    frame = 32 + shard_size              # digest || payload
+    import glob
+    parts = sorted(glob.glob(str(tmp_path / "d*" / "bucket" / "gfb" /
+                                 "*" / "part.1")))
+
+    def corrupt(shard_idx: int, block_idx: int) -> None:
+        f = parts[dist.index(shard_idx + 1)]
+        with open(f, "r+b") as fh:
+            fh.seek(block_idx * frame + 40)   # inside the payload
+            fh.write(b"\xff\xff\xff\xff")
+
+    # one DATA shard corrupt at the LAST full block; both PARITY
+    # shards corrupt at block 0: a whole-group read loses 3 of 6
+    # readers (k=4 group-wide quorum impossible), while per block
+    # there are always >= 4 clean shards
+    corrupt(0, 2)
+    corrupt(K, 0)
+    corrupt(K + 1, 0)
+
+    flagged = []
+    eng.on_degraded_read = lambda b, o: flagged.append(o)
+    _oi, it = eng.get_object("bucket", "gfb")
+    assert b"".join(it) == data
+    assert "gfb" in flagged              # degraded read queues a heal
+
+
 def test_delete_missing_object_maps_to_not_found(eng):
     with pytest.raises(api_errors.ObjectNotFound):
         eng.delete_object("bucket", "never-existed")
