@@ -11,11 +11,13 @@ process restart (at-least-once).
 
 Targets (all real wire protocols, offline-tested against in-process
 fakes): webhook (HTTP POST), redis (RESP2), mqtt (3.1.1), nats (text
-protocol), nsq (V2 TCP), amqp (0-9-1), postgres (v3 protocol), mysql
-(handshake v10 + native-password auth), elasticsearch (document API),
-kafka (produce logic behind a pluggable producer — the broker binary
-protocol needs a client lib this image doesn't ship), memory (tests /
-ListenNotification feed).
+protocol), nsq (V2 TCP), amqp (0-9-1), postgres (v3 protocol with
+SCRAM-SHA-256 auth), mysql (handshake v10, native-password +
+caching_sha2 auth), elasticsearch (document API), kafka (binary
+broker protocol: ApiVersions/Metadata handshake + Produce v2 carrying
+a MessageSet v1 of magic-1 messages with CRC32 framing — KafkaTarget
+below, no client lib needed), memory (tests / ListenNotification
+feed).
 """
 
 from __future__ import annotations

@@ -154,54 +154,103 @@ class IAMSys:
 
     def migrate_to_store(self, new_store) -> None:
         """Switch persistence backends (the object-store → etcd move
-        when federation is first configured). An empty target is seeded
-        from the current cache so identities that predate etcd survive
-        the switch; a non-empty target is authoritative (another
+        when federation is first configured). An unseeded target is
+        seeded from the current cache so identities that predate etcd
+        survive the switch; a SEEDED target is authoritative (another
         federated cluster already populated it) and replaces the cache.
-        An unreachable target keeps the current store untouched."""
+        An unreachable target keeps the current store untouched.
+
+        "Seeded" means the ``format/seed-complete`` marker is present —
+        written only AFTER every record landed. A seed that dies
+        partway leaves no marker, so the next boot re-seeds instead of
+        adopting the partial store as authoritative and silently
+        dropping every identity that only the old store held
+        (ADVICE r4). Re-seeding skips records the target already has:
+        a concurrently-seeding federated peer's writes are never
+        clobbered, and an interrupted seed resumes where it stopped.
+
+        ``self.store`` stays on the OLD store until the marker lands:
+        the bulk seed runs unlocked (many etcd round trips must not
+        stall auth checks), so concurrent mutations keep committing to
+        the old, durable store; a failed seed therefore abandons
+        nothing. A short locked pass then reconciles whatever mutated
+        during the bulk copy and cuts over atomically."""
         from .store import IAMStoreError
         try:
-            existing = new_store.read_all("users")
+            seeded = new_store.read_one("format", "seed-complete")
         except IAMStoreError:
             return
-        old_store = self.store
-        self.store = new_store
-        if existing:
+        if seeded:
+            self.store = new_store
             self.load()
             return
+        prefixes = ("users", "groups", "policies", "policydb/users",
+                    "policydb/groups", "svcaccts", "sts")
         with self._mu:
-            try:
-                for ak, c in self.users.items():
-                    self._save(self._path("users", ak),
-                               {"secret_key": c.secret_key,
-                                "status": c.status})
-                for g, info in self.groups.items():
-                    self._save(self._path("groups", g), info)
-                for name, pol in self.policies.items():
-                    if name not in CANNED_POLICIES:
-                        self._save(self._path("policies", name),
-                                   json.loads(pol.to_json()))
-                for ak, names in self.user_policy.items():
-                    self._save(self._path("policydb/users", ak),
-                               {"policy": list(names)})
-                for g, names in self.group_policy.items():
-                    self._save(self._path("policydb/groups", g),
-                               {"policy": list(names)})
-                for ak, c in self.svc_accounts.items():
-                    self._save(self._path("svcaccts", ak),
-                               {"secret_key": c.secret_key,
-                                "parent": c.parent_user,
-                                "status": c.status})
-                for ak, c in self.sts_creds.items():
-                    self._save(self._path("sts", ak),
-                               {"secret_key": c.secret_key,
-                                "session_token": c.session_token,
-                                "expiration": c.expiration,
-                                "parent": c.parent_user})
-            except IAMStoreError:
-                # partial seed: fall back to the old store; the next
-                # boot retries the migration from the durable copy
-                self.store = old_store
+            snap = self._iam_records()
+        try:
+            present = {p: new_store.read_all(p) for p in prefixes}
+            ours: set = set()       # records THIS seed wrote
+            for prefix in prefixes:
+                for name, payload in snap[prefix].items():
+                    if name not in present[prefix]:
+                        new_store.save(self._path(prefix, name),
+                                       payload)
+                        ours.add((prefix, name))
+            with self._mu:
+                # reconcile mutations that landed during the bulk seed
+                # (bounded by the mutation rate, not the record count)
+                now = self._iam_records()
+                for prefix in prefixes:
+                    for name, payload in now[prefix].items():
+                        if snap[prefix].get(name) != payload:
+                            new_store.save(self._path(prefix, name),
+                                           payload)
+                    for name in snap[prefix]:
+                        if name not in now[prefix] and \
+                                (prefix, name) in ours:
+                            new_store.delete(self._path(prefix, name))
+                # marker LAST: until it lands, no cluster treats this
+                # store as authoritative
+                new_store.save(self._path("format", "seed-complete"),
+                               {"complete": True, "at": time.time()})
+                self.store = new_store
+        except IAMStoreError:
+            # partial seed: self.store never moved, so every mutation
+            # acknowledged meanwhile is durable in the old store; the
+            # next boot retries (no marker → the partial target is
+            # never adopted)
+            return
+        # records seeded by a concurrent peer (skipped above) become
+        # visible by loading the now-complete store
+        self.load()
+
+    def _iam_records(self) -> dict[str, dict[str, dict]]:
+        """prefix -> name -> stored payload for the whole cache, in the
+        exact shape the store persists (caller holds ``_mu``)."""
+        return {
+            "users": {ak: {"secret_key": c.secret_key,
+                           "status": c.status}
+                      for ak, c in self.users.items()},
+            "groups": {g: dict(info)
+                       for g, info in self.groups.items()},
+            "policies": {n: json.loads(p.to_json())
+                         for n, p in self.policies.items()
+                         if n not in CANNED_POLICIES},
+            "policydb/users": {ak: {"policy": list(v)}
+                               for ak, v in self.user_policy.items()},
+            "policydb/groups": {g: {"policy": list(v)}
+                                for g, v in self.group_policy.items()},
+            "svcaccts": {ak: {"secret_key": c.secret_key,
+                              "parent": c.parent_user,
+                              "status": c.status}
+                         for ak, c in self.svc_accounts.items()},
+            "sts": {ak: {"secret_key": c.secret_key,
+                         "session_token": c.session_token,
+                         "expiration": c.expiration,
+                         "parent": c.parent_user}
+                    for ak, c in self.sts_creds.items()},
+        }
 
     def _notify(self, kind: str = "", name: str = "") -> None:
         self._notify_batch([(kind, name)] if kind else [])

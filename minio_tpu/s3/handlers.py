@@ -1448,17 +1448,40 @@ class S3ApiHandlers:
                           "supported (use AES256)")
         return True
 
+    def _plaintext_stream(self, bucket, key, info, header, opts
+                          ) -> tuple[Iterator[bytes], int]:
+        """Full plaintext stream + size of a stored object, decrypting
+        and decompressing as its metadata requires. ONE decode stack
+        shared by the copy-source and web download paths (the ranged
+        S3 GET keeps its own package-range arithmetic in
+        _get_transformed). `header` is a callable(name, default="")
+        supplying SSE-C key headers; without them an SSE-C object
+        raises AccessDenied from resolve_get_key."""
+        from ..features import crypto as sse
+        md = info.user_defined or {}
+        if not (md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS)):
+            _, stream = self.obj.get_object(bucket, key, 0, info.size,
+                                            opts)
+            return stream, info.size
+        enc = sse.resolve_get_key(md, header, self.kms)
+        plain_size = self._plain_size(info, md)
+        if enc is not None and md.get(sse.MK_SSE_MP) and info.parts:
+            return (self._mp_decrypt_stream(opts, bucket, key, info,
+                                            enc, 0, plain_size),
+                    plain_size)
+        _, stream = self.obj.get_object(bucket, key, 0, info.size,
+                                        opts)
+        if enc is not None:
+            stream = sse.decrypt_stream(stream, enc[0], enc[1])
+        if md.get(sse.MK_COMPRESS):
+            stream = sse.decompress_stream(stream)
+        return stream, plain_size
+
     def _copy_source_plaintext(self, ctx, src_bucket, src_key, src_info,
                                opts) -> tuple[Iterator[bytes], int]:
         """Plaintext stream + size of a copy source, decrypting with the
         x-amz-copy-source-* SSE-C headers (or the master key) and
         decompressing as needed."""
-        from ..features import crypto as sse
-        md = src_info.user_defined or {}
-        if not (md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS)):
-            _, stream = self.obj.get_object(src_bucket, src_key, 0,
-                                            src_info.size, opts)
-            return stream, src_info.size
 
         def src_header(name, default=""):
             prefix = "x-amz-server-side-encryption-customer"
@@ -1468,19 +1491,8 @@ class S3ApiHandlers:
                     + name[len(prefix):], default)
             return ctx.header(name, default)
 
-        enc = sse.resolve_get_key(md, src_header, self.kms)
-        plain_size = self._plain_size(src_info, md)
-        if enc is not None and md.get(sse.MK_SSE_MP) and src_info.parts:
-            return (self._mp_decrypt_stream(opts, src_bucket, src_key,
-                                            src_info, enc, 0, plain_size),
-                    plain_size)
-        _, stream = self.obj.get_object(src_bucket, src_key, 0,
-                                        src_info.size, opts)
-        if enc is not None:
-            stream = sse.decrypt_stream(stream, enc[0], enc[1])
-        if md.get(sse.MK_COMPRESS):
-            stream = sse.decompress_stream(stream)
-        return stream, plain_size
+        return self._plaintext_stream(src_bucket, src_key, src_info,
+                                      src_header, opts)
 
     @staticmethod
     def _plain_size(info, md: dict) -> int:

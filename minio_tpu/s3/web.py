@@ -90,7 +90,11 @@ def jwt_verify(token: str, secret: str) -> dict:
     if not hmac.compare_digest(_b64url(mac), parts[2]):
         raise S3Error("AccessDenied", "invalid token signature")
     claims = jwt_claims_unverified(token)
-    if float(claims.get("exp", 0)) < time.time():
+    try:
+        exp = float(claims.get("exp", 0))
+    except (TypeError, ValueError):
+        raise S3Error("AccessDenied", "malformed token") from None
+    if exp < time.time():
         raise S3Error("AccessDenied", "token expired")
     return claims
 
@@ -498,7 +502,10 @@ class WebHandlers:
         if ctx.req.method != "PUT":
             return HTTPResponse(status=405)
         bucket, _, key = rest.partition("/")
-        cred, owner = self._request_auth(ctx, want_typ=("web", "url"))
+        # "web" sessions only: the 1-hour token minted by CreateURLToken
+        # exists for download/zip navigation and must not authorize PUTs
+        # (reference authenticateURL scope; ADVICE r4)
+        cred, owner = self._request_auth(ctx, want_typ=("web",))
         if not key:
             raise S3Error("InvalidArgument", "missing object name")
         if not self._allowed(cred, owner, "s3:PutObject", bucket, key):
@@ -529,6 +536,20 @@ class WebHandlers:
         self.api._notify("s3:ObjectCreated:Put", bucket, key)
         return HTTPResponse(headers={"ETag": f'"{info.etag}"'})
 
+    def _plain_object(self, ctx, bucket: str, key: str
+                      ) -> tuple[object, "Iterator[bytes]", int]:
+        """Plaintext (info, stream, size) for a web download — the same
+        SSE/compression seam as the S3 GET/copy paths (ADVICE r4: the
+        first cut returned stored ciphertext/compressed bytes with the
+        stored size). SSE-C objects are rejected with AccessDenied
+        inside _plaintext_stream: a browser navigation cannot present
+        client key headers."""
+        from ..object.engine import GetOptions
+        info = self.api.obj.get_object_info(bucket, key)
+        stream, size = self.api._plaintext_stream(
+            bucket, key, info, ctx.header, GetOptions())
+        return info, stream, size
+
     def _download(self, ctx: RequestContext, rest: str) -> HTTPResponse:
         if ctx.req.method != "GET":
             return HTTPResponse(status=405)
@@ -536,14 +557,13 @@ class WebHandlers:
         cred, owner = self._request_auth(ctx, want_typ=("web", "url"))
         if not self._allowed(cred, owner, "s3:GetObject", bucket, key):
             raise S3Error("AccessDenied")
-        info = self.api.obj.get_object_info(bucket, key)
-        _info, stream = self.api.obj.get_object(bucket, key, 0, info.size)
-        self.api.bandwidth.record(bucket, "tx", info.size)
+        _info, stream, size = self._plain_object(ctx, bucket, key)
+        self.api.bandwidth.record(bucket, "tx", size)
         name = key.rsplit("/", 1)[-1] or "download"
         return HTTPResponse(
             headers={
                 "Content-Type": "application/octet-stream",
-                "Content-Length": str(info.size),
+                "Content-Length": str(size),
                 "Content-Disposition": _attachment(name),
             },
             stream=stream)
@@ -589,9 +609,7 @@ class WebHandlers:
         total = 0
         with zipfile.ZipFile(spool, "w", zipfile.ZIP_DEFLATED) as zf:
             for k in keys:
-                info = self.api.obj.get_object_info(bucket, k)
-                _i, stream = self.api.obj.get_object(bucket, k, 0,
-                                                     info.size)
+                _i, stream, size = self._plain_object(ctx, bucket, k)
                 arcname = k[len(prefix):] if k.startswith(prefix) else k
                 zi = zipfile.ZipInfo(arcname or k)
                 # zf.open honors the ZipInfo's own compress_type
@@ -600,7 +618,7 @@ class WebHandlers:
                 with zf.open(zi, "w", force_zip64=True) as dst:
                     for chunk in stream:
                         dst.write(chunk)
-                total += info.size
+                total += size
         self.api.bandwidth.record(bucket, "tx", total)
         size = spool.tell()
         spool.seek(0)

@@ -332,6 +332,63 @@ def test_federated_clusters_share_iam(etcd_server, tmp_path):
         b_sets.close()
 
 
+def test_iam_migration_partial_seed_recovery(etcd_server, tmp_path):
+    """ADVICE r4: a seed that dies partway must NOT leave an etcd store
+    the next boot adopts as authoritative — without the seed-complete
+    marker the migration re-seeds the missing records instead of
+    silently dropping every identity only the old store held."""
+    from minio_tpu.iam.store import EtcdIAMStore, IAMStoreError
+    from minio_tpu.iam.sys import IAMSys
+
+    class DiesAfter(EtcdIAMStore):
+        """Store that fails after `budget` saves (mid-seed crash)."""
+
+        def __init__(self, etcd, budget):
+            super().__init__(etcd)
+            self.budget = budget
+
+        def save(self, path, payload):
+            if self.budget <= 0:
+                raise IAMStoreError("injected: etcd gone")
+            self.budget -= 1
+            super().save(path, payload)
+
+    sets = ErasureSets.from_drives(
+        [str(tmp_path / f"pseed-d{i}") for i in range(4)], 1, 4, 2,
+        block_size=1 << 16)
+    try:
+        iam = IAMSys(sets, root_cred=CREDS)
+        for i in range(4):
+            iam.add_user(f"user{i}", f"user{i}-secret-long")
+        iam.attach_policy("readonly", user="user0")
+        old_store = iam.store
+
+        # seed dies after 2 saves: partial etcd content, NO marker
+        url = f"http://127.0.0.1:{etcd_server}"
+        dying = DiesAfter(EtcdClient(url), budget=2)
+        iam.migrate_to_store(dying)
+        assert iam.store is old_store          # fell back
+        assert iam.get_credentials("user3") is not None
+        live = EtcdIAMStore(EtcdClient(url))
+        assert live.read_one("format", "seed-complete") is None
+        assert live.read_all("users")          # partial content exists
+
+        # next migration: partial store is NOT authoritative — it
+        # re-seeds the missing records and writes the marker
+        iam.migrate_to_store(live)
+        assert iam.store is live
+        assert live.read_one("format", "seed-complete")
+        for i in range(4):
+            assert iam.get_credentials(f"user{i}") is not None
+        fresh = IAMSys(root_cred=CREDS, store=EtcdIAMStore(
+            EtcdClient(url)))
+        for i in range(4):
+            assert fresh.get_credentials(f"user{i}") is not None
+        assert fresh.user_policy["user0"] == ["readonly"]
+    finally:
+        sets.close()
+
+
 def test_iam_migration_to_etcd(etcd_server, tmp_path):
     """Review r4: switching to the etcd store must carry existing
     identities over (empty target is seeded), and a populated target

@@ -290,6 +290,97 @@ def test_iam_user_scoping_and_setauth(web_server):
     assert _login(srv.port, "webuser", "newsecret99")
 
 
+def test_web_download_transformed_objects(web_server):
+    """ADVICE r4: web download/zip must route through the same
+    SSE/compression seam as the S3 GET path — a compressed or SSE-S3
+    object downloads as plaintext with the plaintext Content-Length;
+    SSE-C downloads are rejected (no client key headers on a browser
+    navigation)."""
+    import hashlib
+    import os
+    from minio_tpu.features import crypto as sse
+    from minio_tpu.features.kms import StaticKMS
+    from minio_tpu.object.engine import PutOptions
+    from minio_tpu.object.hash_reader import HashReader
+
+    srv, _iam = web_server
+    token = _login(srv.port)
+    _call(srv.port, "MakeBucket", {"bucketName": "xform"}, token=token)
+    old_kms = srv.api.kms
+    srv.api.kms = StaticKMS(hashlib.sha256(b"web-master").digest())
+    try:
+        payload = b"web-plaintext " * 4096
+
+        def put(key, ssec_key=None, sse_s3=False, compress=False):
+            md = {}
+            reader, size = sse.setup_put_transforms(
+                key_name=key,
+                raw_reader=HashReader(io.BytesIO(payload), len(payload)),
+                raw_size=len(payload), metadata=md, ssec_key=ssec_key,
+                sse_s3=sse_s3, kms=srv.api.kms, compress=compress)
+            srv.api.obj.put_object("xform", key, reader, size,
+                                   PutOptions(metadata=md))
+
+        put("comp.txt", compress=True)
+        put("enc.txt", sse_s3=True)
+        put("both.txt", sse_s3=True, compress=True)
+        put("ssec.txt", ssec_key=os.urandom(32))
+
+        for k in ("comp.txt", "enc.txt", "both.txt"):
+            st, hdrs, data = _http(
+                srv.port, "GET",
+                f"/minio/web/download/xform/{k}?token={token}")
+            assert st == 200 and data == payload, k
+            assert hdrs["content-length"] == str(len(payload))
+        st, _, _ = _http(
+            srv.port, "GET",
+            f"/minio/web/download/xform/ssec.txt?token={token}")
+        assert st == 403
+
+        # the zip path decodes through the same seam
+        st, _, data = _http(
+            srv.port, "POST", f"/minio/web/zip?token={token}",
+            body=json.dumps({"bucketName": "xform", "prefix": "",
+                             "objects": ["comp.txt",
+                                         "enc.txt"]}).encode())
+        assert st == 200
+        zf = zipfile.ZipFile(io.BytesIO(data))
+        assert zf.read("comp.txt") == payload
+        assert zf.read("enc.txt") == payload
+    finally:
+        srv.api.kms = old_kms
+
+
+def test_url_token_scope_and_malformed_exp(web_server):
+    """ADVICE r4: CreateURLToken tokens must not authorize uploads, and
+    a token with a non-numeric exp claim is AccessDenied, not a 500."""
+    srv, _iam = web_server
+    token = _login(srv.port)
+    _call(srv.port, "MakeBucket", {"bucketName": "scope"}, token=token)
+    url_token = _call(srv.port, "CreateURLToken",
+                      token=token)["result"]["token"]
+    st, _, _ = _http(srv.port, "PUT", "/minio/web/upload/scope/x",
+                     body=b"x",
+                     headers={"Authorization": f"Bearer {url_token}",
+                              "Content-Length": "1"})
+    assert st == 403
+    st, _, _ = _http(srv.port, "PUT", "/minio/web/upload/scope/x",
+                     body=b"x",
+                     headers={"Authorization": f"Bearer {token}",
+                              "Content-Length": "1"})
+    assert st == 200
+    # the url token's actual purpose still works
+    st, _, data = _http(
+        srv.port, "GET",
+        f"/minio/web/download/scope/x?token={url_token}")
+    assert st == 200 and data == b"x"
+    bad = jwt_encode({"sub": CREDS.access_key, "typ": "web",
+                      "exp": "never"}, CREDS.secret_key)
+    out = _call(srv.port, "ListBuckets", token=bad)
+    assert "error" in out
+    assert out["error"].get("code") != 500
+
+
 def test_presigned_get_and_policy_rpcs(web_server):
     srv, _iam = web_server
     token = _login(srv.port)
