@@ -165,9 +165,13 @@ class IAMSys:
         partway leaves no marker, so the next boot re-seeds instead of
         adopting the partial store as authoritative and silently
         dropping every identity that only the old store held
-        (ADVICE r4). Re-seeding skips records the target already has:
-        a concurrently-seeding federated peer's writes are never
-        clobbered, and an interrupted seed resumes where it stopped.
+        (ADVICE r4). An UNMARKED target is scratch space: the seed
+        overwrites it from the snapshot and deletes records the
+        snapshot doesn't have — leftovers of a prior crashed seed must
+        not resurrect identities that were deleted (in the durable old
+        store) between the attempts. Two clusters racing the very
+        first migration can overwrite each other's pre-marker writes;
+        both then converge on the marked store via the final load().
 
         ``self.store`` stays on the OLD store until the marker lands:
         the bulk seed runs unlocked (many etcd round trips must not
@@ -189,14 +193,15 @@ class IAMSys:
         with self._mu:
             snap = self._iam_records()
         try:
-            present = {p: new_store.read_all(p) for p in prefixes}
-            ours: set = set()       # records THIS seed wrote
+            stale = {p: new_store.read_all(p) for p in prefixes}
             for prefix in prefixes:
                 for name, payload in snap[prefix].items():
-                    if name not in present[prefix]:
+                    if stale[prefix].get(name) != payload:
                         new_store.save(self._path(prefix, name),
                                        payload)
-                        ours.add((prefix, name))
+                for name in stale[prefix]:
+                    if name not in snap[prefix]:
+                        new_store.delete(self._path(prefix, name))
             with self._mu:
                 # reconcile mutations that landed during the bulk seed
                 # (bounded by the mutation rate, not the record count)
@@ -207,8 +212,7 @@ class IAMSys:
                             new_store.save(self._path(prefix, name),
                                            payload)
                     for name in snap[prefix]:
-                        if name not in now[prefix] and \
-                                (prefix, name) in ours:
+                        if name not in now[prefix]:
                             new_store.delete(self._path(prefix, name))
                 # marker LAST: until it lands, no cluster treats this
                 # store as authoritative
@@ -221,8 +225,6 @@ class IAMSys:
             # next boot retries (no marker → the partial target is
             # never adopted)
             return
-        # records seeded by a concurrent peer (skipped above) become
-        # visible by loading the now-complete store
         self.load()
 
     def _iam_records(self) -> dict[str, dict[str, dict]]:

@@ -122,3 +122,21 @@ def recover_matrix(data_shards: int, parity_shards: int,
     r = gf256.gf_matmul(em[list(missing)], d)
     r.setflags(write=False)
     return r, used, missing
+
+
+def recover_rows(data_shards: int, parity_shards: int,
+                 present_mask: int, rows) -> tuple[np.ndarray, list[int]]:
+    """Recover matrix filtered to the requested shard indices: returns
+    (matrix (R x k) uint8 C-contiguous, shard index per output row).
+    Empty/None `rows` keeps every missing shard. The ONE copy of the
+    heal row-selection invariant, shared by the single-device codec and
+    the mesh heal step."""
+    rec, _used, rec_missing = recover_matrix(data_shards, parity_shards,
+                                             present_mask)
+    if rows:
+        keep = [r for r, idx in enumerate(rec_missing) if idx in rows]
+    else:
+        keep = list(range(len(rec_missing)))
+    idxs = [rec_missing[r] for r in keep]
+    rec = np.ascontiguousarray(np.asarray(rec, dtype=np.uint8)[keep])
+    return rec, idxs

@@ -74,14 +74,14 @@ class BatchScheduler:
             return None
         if codec.m == 0:
             return None
-        # No device, no reason to queue: without a TPU the dispatch
-        # always CPU-routes, so the grace window + wakeup round-trip
-        # (~max_wait per encode batch) would be pure hot-path overhead.
-        # With a TPU present, small batches still enqueue — coalescing
-        # with concurrent streams is what pushes them over the device
-        # routing threshold.
-        from ..object.codec import _device_is_tpu
-        if not _device_is_tpu():
+        # No device, no reason to queue: without a TPU (or an active
+        # multi-device mesh) the dispatch always CPU-routes, so the
+        # grace window + wakeup round-trip (~max_wait per encode batch)
+        # would be pure hot-path overhead. With a device path present,
+        # small batches still enqueue — coalescing with concurrent
+        # streams is what pushes them over the routing threshold.
+        from ..object.codec import _device_is_tpu, _mesh_active
+        if not _device_is_tpu() and _mesh_active() is None:
             return None
         key = (codec.k, codec.m, data.shape[-1], algo.value)
         p = _Pending(np.ascontiguousarray(data, np.uint8))

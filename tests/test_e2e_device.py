@@ -36,6 +36,12 @@ BLOCK = 1 << 16
 def device_server(monkeypatch, tmp_path):
     monkeypatch.setattr(codec_mod, "_device_is_tpu", lambda: True)
     monkeypatch.setattr(codec_mod, "DEVICE_MIN_BYTES", 0)
+    # pin the SINGLE-device fused path: with _device_is_tpu faked true
+    # on the 8-device virtual CPU mesh the codec would otherwise mesh-
+    # dispatch (that serving path has its own e2e in test_mesh.py), and
+    # the first cold compile of the 8-device program mid-PUT-storm can
+    # blow the request timeouts
+    monkeypatch.setenv("MINIO_TPU_MESH", "0")
     sched = BatchScheduler(max_wait=0.2)
     drives = [str(tmp_path / f"d{i}") for i in range(6)]
     sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=6,

@@ -373,17 +373,30 @@ def test_iam_migration_partial_seed_recovery(etcd_server, tmp_path):
         assert live.read_one("format", "seed-complete") is None
         assert live.read_all("users")          # partial content exists
 
+        # a user deleted (durably, in the old store) between the
+        # attempts must NOT be resurrected by the crashed seed's
+        # leftovers in etcd (review r5: unmarked target is scratch)
+        seeded_names = {k for k in live.read_all("users")}
+        victim = sorted(n for n in seeded_names if n != "user0")[0]
+        iam.remove_user(victim)
+
         # next migration: partial store is NOT authoritative — it
-        # re-seeds the missing records and writes the marker
+        # re-seeds from the current cache and writes the marker
         iam.migrate_to_store(live)
         assert iam.store is live
         assert live.read_one("format", "seed-complete")
         for i in range(4):
-            assert iam.get_credentials(f"user{i}") is not None
+            name = f"user{i}"
+            want_alive = name != victim
+            assert (iam.get_credentials(name) is not None) == want_alive
         fresh = IAMSys(root_cred=CREDS, store=EtcdIAMStore(
             EtcdClient(url)))
+        assert fresh.get_credentials(victim) is None, \
+            "crashed-seed leftover resurrected a deleted identity"
         for i in range(4):
-            assert fresh.get_credentials(f"user{i}") is not None
+            name = f"user{i}"
+            if name != victim:
+                assert fresh.get_credentials(name) is not None
         assert fresh.user_policy["user0"] == ["readonly"]
     finally:
         sets.close()
