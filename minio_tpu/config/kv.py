@@ -34,6 +34,7 @@ SUBSYSTEMS: dict[str, dict[str, str]] = {
     "api": {"requests_max": "0", "cors_allow_origin": "*"},
     "region": {"name": "us-east-1"},
     "compression": {"enable": "off",
+                    "algorithm": "s2",
                     "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
                     "mime_types": "text/*,application/json"},
     "storage_class": {"standard": "", "rrs": ""},
@@ -304,6 +305,10 @@ class ConfigSys:
         api.cors_allow_origin = self.get("api", "cors_allow_origin")
         api.compression_enabled = \
             self.get("compression", "enable").lower() in ("on", "true", "1")
+        # "s2" = snappy framing, readable by the reference binary;
+        # "zstd" = better ratio, this framework only
+        api.compression_algorithm = \
+            self.get("compression", "algorithm").lower() or "s2"
         try:
             reqs = int(self.get("api", "requests_max") or 0)
         except ValueError:

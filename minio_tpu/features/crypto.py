@@ -6,19 +6,21 @@ packages each AEAD-sealed (cmd/encryption-v1.go:195-364) — and
 compresses eligible objects inline with S2, keeping the *actual* size in
 internal metadata (cmd/object-api-utils.go:869, isCompressible).
 
-This rebuild keeps the same architecture with stdlib-available
-primitives: AES-256-GCM packages (64 KiB plaintext each, nonce =
-base^seq, 16-byte tag) and zstandard for compression. The ETag stays the
-MD5 of the CLIENT bytes: PutObjReader pairs the raw hashing reader with
-the transformed stream (reference PutObjReader, cmd/object-api-utils.go).
+This rebuild keeps the same architecture: AES-256-GCM packages (64 KiB
+plaintext each, nonce = base^seq, 16-byte tag) for SSE, and snappy
+framing (features/snappy.py — S2-interoperable, the same wire format
+family as the reference) or zstd (config choice, no interop) for
+compression. The ETag stays the MD5 of the CLIENT bytes: PutObjReader
+pairs the raw hashing reader with the transformed stream (reference
+PutObjReader, cmd/object-api-utils.go).
 
 Internal metadata keys (never exposed over the API):
     X-Minio-Internal-Sse:             "S3" | "C"
     X-Minio-Internal-Sse-Sealed-Key:  base64(nonce||ct||tag) of the OEK
     X-Minio-Internal-Sse-Iv:          base64 12-byte package nonce base
     X-Minio-Internal-Sse-Key-Md5:     SSE-C client key MD5 (verification)
-    X-Minio-Internal-Compression:     "zstd"
-    X-Minio-Internal-Actual-Size:     plaintext byte count
+    X-Minio-Internal-compression:     "klauspost/compress/s2" | "zstd"
+    X-Minio-Internal-actual-size:     plaintext byte count
 """
 
 from __future__ import annotations
@@ -41,7 +43,30 @@ MK_SSE_MP = "X-Minio-Internal-Sse-Multipart"
 MK_SEALED = "X-Minio-Internal-Sse-Sealed-Key"
 MK_IV = "X-Minio-Internal-Sse-Iv"
 MK_KEYMD5 = "X-Minio-Internal-Sse-Key-Md5"
-MK_COMPRESS = "X-Minio-Internal-Compression"
+# exact reference bytes (cmd/object-handlers.go:997 writes
+# ReservedMetadataPrefix+"compression"): the reference binary looks
+# this key up case-SENSITIVELY when reading our disks
+MK_COMPRESS = "X-Minio-Internal-compression"
+
+# MK_COMPRESS values. S2/snappy is the interop default: snappy framing
+# is a strict subset of the S2 stream format, so objects written here
+# are readable by the reference binary and vice versa (within the
+# decoded block subset — features/snappy.py). zstd remains available
+# behind config (compression.algorithm=zstd) with no cross-binary
+# interop.
+COMPRESS_S2 = "klauspost/compress/s2"      # cmd/object-handlers.go:69
+COMPRESS_SNAPPY_V1 = "golang/snappy/LZ77"  # cmd/object-handlers.go:68
+COMPRESS_ZSTD = "zstd"
+
+# pre-r5 builds wrote the key with a capital C; metadata lookups are
+# case-sensitive, so reads must accept both spellings forever
+MK_COMPRESS_LEGACY = "X-Minio-Internal-Compression"
+
+
+def stored_compression(md: dict) -> str:
+    """The stored compression algorithm under either key spelling
+    ('' when the object is not compressed)."""
+    return md.get(MK_COMPRESS) or md.get(MK_COMPRESS_LEGACY) or ""
 # matches storage.datatypes.to_object_info's actual-size key, so
 # ObjectInfo.actual_size is correct for transformed objects too
 MK_ACTUAL = "X-Minio-Internal-actual-size"
@@ -147,7 +172,15 @@ def decrypt_stream(chunks: Iterator[bytes], oek: bytes, nonce_base: bytes,
                           _AAD + seq.to_bytes(8, "little"))
 
 
-def decompress_stream(chunks: Iterator[bytes]) -> Iterator[bytes]:
+def decompress_stream(chunks: Iterator[bytes],
+                      algo: str = COMPRESS_ZSTD) -> Iterator[bytes]:
+    """Stored-compression decoder, dispatched on the MK_COMPRESS value
+    (both S2 v2 and golang/snappy v1 streams ride the framing
+    reader)."""
+    if algo in (COMPRESS_S2, COMPRESS_SNAPPY_V1):
+        from . import snappy as _snappy
+        yield from _snappy.decompress_stream(chunks)
+        return
     import zstandard
     d = zstandard.ZstdDecompressor().decompressobj()
     for chunk in chunks:
@@ -294,7 +327,8 @@ def is_compressible(key: str, content_type: str) -> bool:
 def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
                          raw_size: int, metadata: dict,
                          ssec_key: Optional[bytes],
-                         sse_s3: bool, kms, compress: bool):
+                         sse_s3: bool, kms, compress: bool,
+                         compress_algo: str = COMPRESS_S2):
     """Build the transformed reader + metadata for a PUT.
 
     Returns (reader, size) — size is the stored byte count when
@@ -305,8 +339,13 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
     size = raw_size
 
     if compress:
-        metadata[MK_COMPRESS] = "zstd"
-        transforms.append(ZstdCompress())
+        if compress_algo == COMPRESS_ZSTD:
+            metadata[MK_COMPRESS] = COMPRESS_ZSTD
+            transforms.append(ZstdCompress())
+        else:
+            from .snappy import SnappyFramedCompress
+            metadata[MK_COMPRESS] = COMPRESS_S2
+            transforms.append(SnappyFramedCompress())
         size = -1
 
     if ssec_key is not None or sse_s3:

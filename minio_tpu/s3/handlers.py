@@ -242,6 +242,10 @@ class S3ApiHandlers:
         self.kms = sse.kms_from_env()        # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
+        # "s2" (snappy framing, reference-interoperable — the default)
+        # or "zstd" (better ratio, no cross-binary interop)
+        self.compression_algorithm = os.environ.get(
+            "MINIO_COMPRESS_ALGORITHM", "s2").lower()
         self.cors_allow_origin = "*"   # config api.cors_allow_origin
         self.federation = None    # optional BucketFederation (etcd DNS)
 
@@ -1246,7 +1250,8 @@ class S3ApiHandlers:
         reader2, size2 = sse.setup_put_transforms(
             key_name=key, raw_reader=reader, raw_size=size,
             metadata=metadata, ssec_key=ssec_key, sse_s3=sse_s3,
-            kms=self.kms, compress=compress)
+            kms=self.kms, compress=compress,
+            compress_algo=self._compress_algo())
         headers = {}
         if sse_s3:
             headers["x-amz-server-side-encryption"] = "AES256"
@@ -1319,7 +1324,7 @@ class S3ApiHandlers:
                                 headers=self._obj_response_headers(info))
         from ..features import crypto as sse
         md = info.user_defined or {}
-        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+        if md.get(sse.MK_SSE) or sse.stored_compression(md):
             return self._get_transformed(ctx, bucket, key, info, opts, md)
         rng = _parse_range(ctx.header("range"), info.size)
         offset, length = (0, info.size) if rng is None else rng
@@ -1346,6 +1351,11 @@ class S3ApiHandlers:
                             stream=self.bandwidth.counting_stream(
                                 bucket, stream))
 
+    def _compress_algo(self) -> str:
+        from ..features import crypto as sse
+        return sse.COMPRESS_ZSTD if self.compression_algorithm == \
+            "zstd" else sse.COMPRESS_S2
+
     def _get_transformed(self, ctx, bucket, key, info, opts, md
                          ) -> HTTPResponse:
         """GET of an encrypted and/or compressed object: decrypt the
@@ -1354,7 +1364,7 @@ class S3ApiHandlers:
         stack, cmd/object-api-utils.go:626-697)."""
         from ..features import crypto as sse
         enc = sse.resolve_get_key(md, ctx.header, self.kms)
-        compressed = bool(md.get(sse.MK_COMPRESS))
+        compressed = bool(sse.stored_compression(md))
         actual = self._plain_size(info, md)
         rng = _parse_range(ctx.header("range"), actual)
         offset, length = (0, actual) if rng is None else rng
@@ -1373,7 +1383,9 @@ class S3ApiHandlers:
                                             opts)
             if enc is not None:
                 stream = sse.decrypt_stream(stream, enc[0], enc[1])
-            stream = sse.decompress_stream(stream)
+            stream = sse.decompress_stream(
+                    stream, sse.stored_compression(md)
+                    or sse.COMPRESS_ZSTD)
             stream = _skip_take(stream, offset, length)
         else:
             # package-aligned ciphertext range
@@ -1459,7 +1471,7 @@ class S3ApiHandlers:
         raises AccessDenied from resolve_get_key."""
         from ..features import crypto as sse
         md = info.user_defined or {}
-        if not (md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS)):
+        if not (md.get(sse.MK_SSE) or sse.stored_compression(md)):
             _, stream = self.obj.get_object(bucket, key, 0, info.size,
                                             opts)
             return stream, info.size
@@ -1473,8 +1485,10 @@ class S3ApiHandlers:
                                         opts)
         if enc is not None:
             stream = sse.decrypt_stream(stream, enc[0], enc[1])
-        if md.get(sse.MK_COMPRESS):
-            stream = sse.decompress_stream(stream)
+        if sse.stored_compression(md):
+            stream = sse.decompress_stream(
+                    stream, sse.stored_compression(md)
+                    or sse.COMPRESS_ZSTD)
         return stream, plain_size
 
     def _copy_source_plaintext(self, ctx, src_bucket, src_key, src_info,
@@ -1567,7 +1581,7 @@ class S3ApiHandlers:
         headers = self._obj_response_headers(info)
         from ..features import crypto as sse
         md = info.user_defined or {}
-        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+        if md.get(sse.MK_SSE) or sse.stored_compression(md):
             if md.get(sse.MK_SSE) == "C":
                 sse.resolve_get_key(md, ctx.header, self.kms)
             headers.update(self._sse_response_headers(md))
@@ -1623,7 +1637,7 @@ class S3ApiHandlers:
         from ..features import crypto as sse
         src_md = src_info.user_defined or {}
         src_transformed = bool(src_md.get(sse.MK_SSE)
-                               or src_md.get(sse.MK_COMPRESS))
+                               or sse.stored_compression(src_md))
         # target transform request (re-encrypt / encrypt-on-copy), or an
         # explicit source key (decrypt-on-copy)?
         tgt_ssec = sse.parse_ssec_headers(ctx.header)
@@ -1640,7 +1654,8 @@ class S3ApiHandlers:
                 # (seals, compression flag, actual size) must survive a
                 # metadata REPLACE or the copy is unreadable
                 for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
-                           sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL,
+                           sse.MK_KEYMD5, sse.MK_COMPRESS,
+                           sse.MK_COMPRESS_LEGACY, sse.MK_ACTUAL,
                            sse.MK_SSE_MP):
                     if ik in src_md:
                         metadata[ik] = src_md[ik]
@@ -1654,7 +1669,8 @@ class S3ApiHandlers:
             metadata["content-type"] = src_info.content_type
             if re_transform:
                 for ik in (sse.MK_SSE, sse.MK_SEALED, sse.MK_IV,
-                           sse.MK_KEYMD5, sse.MK_COMPRESS, sse.MK_ACTUAL,
+                           sse.MK_KEYMD5, sse.MK_COMPRESS,
+                           sse.MK_COMPRESS_LEGACY, sse.MK_ACTUAL,
                            sse.MK_SSE_MP):
                     metadata.pop(ik, None)
 
@@ -1916,13 +1932,15 @@ class S3ApiHandlers:
         # decrypt/decompress transparently via the transformed GET path
         from ..features import crypto as sse
         md = info.user_defined or {}
-        if md.get(sse.MK_SSE) or md.get(sse.MK_COMPRESS):
+        if md.get(sse.MK_SSE) or sse.stored_compression(md):
             enc = sse.resolve_get_key(md, ctx.header, self.kms)
             _, stream = self.obj.get_object(bucket, key, 0, info.size)
             if enc is not None:
                 stream = sse.decrypt_stream(stream, enc[0], enc[1])
-            if md.get(sse.MK_COMPRESS):
-                stream = sse.decompress_stream(stream)
+            if sse.stored_compression(md):
+                stream = sse.decompress_stream(
+                    stream, sse.stored_compression(md)
+                    or sse.COMPRESS_ZSTD)
             data = b"".join(stream)
         else:
             _, stream = self.obj.get_object(bucket, key, 0, info.size)

@@ -74,6 +74,26 @@ def get_lib() -> ctypes.CDLL | None:
                                   ctypes.c_int]
         lib.gf_has_gfni.restype = ctypes.c_int
         lib.gf_has_gfni.argtypes = []
+        # snappy/S2 codec (absent in a stale pre-r5 .so: make rebuilds,
+        # but guard the lookup so an unwritable tree degrades cleanly)
+        try:
+            lib.snappy_crc32c.restype = ctypes.c_uint32
+            lib.snappy_crc32c.argtypes = [u8p, ctypes.c_size_t]
+            lib.snappy_max_compressed_length.restype = ctypes.c_size_t
+            lib.snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
+            lib.snappy_compress_block.restype = ctypes.c_int
+            lib.snappy_compress_block.argtypes = [
+                u8p, ctypes.c_size_t, u8p,
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.snappy_uncompressed_length.restype = ctypes.c_int64
+            lib.snappy_uncompressed_length.argtypes = [u8p,
+                                                       ctypes.c_size_t]
+            lib.snappy_uncompress_block.restype = ctypes.c_int64
+            lib.snappy_uncompress_block.argtypes = [
+                u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+            lib.snappy_ok = True
+        except AttributeError:
+            lib.snappy_ok = False
         _lib = lib
         return _lib
 
@@ -138,3 +158,55 @@ def gf_matmul(matrix: np.ndarray, data: np.ndarray,
 def has_gfni() -> bool:
     lib = get_lib()
     return bool(lib and lib.gf_has_gfni())
+
+
+# ---------------------------------------------------------------------------
+# snappy/S2 block codec + CRC32C
+# ---------------------------------------------------------------------------
+
+def snappy_available() -> bool:
+    lib = get_lib()
+    return bool(lib and getattr(lib, "snappy_ok", False))
+
+
+def crc32c(data: bytes | memoryview) -> int:
+    lib = get_lib()
+    assert lib is not None and lib.snappy_ok
+    d = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.snappy_crc32c(_u8p(d), d.size))
+
+
+def snappy_compress_block(data: bytes | memoryview) -> bytes:
+    """One snappy block (<= 65536 bytes — the framing chunk limit; the
+    C hash table stores 16-bit positions)."""
+    lib = get_lib()
+    assert lib is not None and lib.snappy_ok
+    d = np.frombuffer(data, dtype=np.uint8)
+    assert d.size <= 65536
+    out = np.empty(int(lib.snappy_max_compressed_length(d.size)),
+                   dtype=np.uint8)
+    n = ctypes.c_size_t(0)
+    lib.snappy_compress_block(_u8p(d), d.size, _u8p(out),
+                              ctypes.byref(n))
+    return out[:n.value].tobytes()
+
+
+def snappy_uncompress_block(data: bytes | memoryview,
+                            max_out: int = 1 << 24) -> bytes:
+    """Decode one snappy/S2 block; raises ValueError on corrupt input
+    and NotImplementedError on S2 encodings outside the subset."""
+    lib = get_lib()
+    assert lib is not None and lib.snappy_ok
+    d = np.frombuffer(data, dtype=np.uint8)
+    want = int(lib.snappy_uncompressed_length(_u8p(d), d.size))
+    if want < 0 or want > max_out:
+        raise ValueError("corrupt snappy block header")
+    out = np.empty(want, dtype=np.uint8)
+    got = int(lib.snappy_uncompress_block(_u8p(d), d.size, _u8p(out),
+                                          want))
+    if got == -2:
+        raise NotImplementedError(
+            "S2 extended repeat encoding outside the decoded subset")
+    if got != want:
+        raise ValueError("corrupt snappy block")
+    return out.tobytes()

@@ -1,0 +1,376 @@
+// Snappy block-format codec + CRC32C — the CPU side of the S2-interop
+// compression path (reference cmd/object-api-utils.go:869
+// newS2CompressReader / s2.NewReader).
+//
+// The WRITE side emits pure snappy block format, which every S2 reader
+// accepts (snappy is a strict subset of S2), wrapped by the Python
+// framing layer (minio_tpu/features/snappy.py) into the snappy framing
+// format — also valid S2 stream input. The READ side decodes snappy
+// blocks plus the S2 repeat-offset extension in its unextended form;
+// extended repeat-length encodings return -2 ("unsupported") rather
+// than risk mis-decoding a format we cannot validate offline. Every
+// framed chunk is CRC32C-checked, so even a wrong guess would surface
+// as a checksum error, never as corrupt payload bytes.
+//
+// Build: part of libminio_tpu_native.so (make -C native).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#include <cpuid.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli). Hardware SSE4.2 when available, else slicing table.
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+
+static void crc32c_init_table() {
+    const uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+        crc32c_table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = crc32c_table[0][i];
+        for (int t = 1; t < 8; t++) {
+            c = crc32c_table[0][c & 0xff] ^ (c >> 8);
+            crc32c_table[t][i] = c;
+        }
+    }
+}
+
+#if defined(__x86_64__)
+static int has_sse42_cached = -1;
+static bool has_sse42() {
+    if (has_sse42_cached < 0) {
+        unsigned a, b, c, d;
+        has_sse42_cached =
+            (__get_cpuid(1, &a, &b, &c, &d) && (c & bit_SSE4_2)) ? 1 : 0;
+    }
+    return has_sse42_cached == 1;
+}
+
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, size_t n) {
+    uint64_t c = crc;
+    while (n >= 8) {
+        uint64_t v;
+        memcpy(&v, p, 8);
+        c = _mm_crc32_u64(c, v);
+        p += 8; n -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    while (n--) c32 = _mm_crc32_u8(c32, *p++);
+    return c32;
+}
+#endif
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t n) {
+    static const bool once = [] { crc32c_init_table(); return true; }();
+    (void)once;
+    while (n >= 8) {
+        uint64_t v;
+        memcpy(&v, p, 8);
+        v ^= crc;
+        crc = crc32c_table[7][v & 0xff] ^
+              crc32c_table[6][(v >> 8) & 0xff] ^
+              crc32c_table[5][(v >> 16) & 0xff] ^
+              crc32c_table[4][(v >> 24) & 0xff] ^
+              crc32c_table[3][(v >> 32) & 0xff] ^
+              crc32c_table[2][(v >> 40) & 0xff] ^
+              crc32c_table[1][(v >> 48) & 0xff] ^
+              crc32c_table[0][(v >> 56) & 0xff];
+        p += 8; n -= 8;
+    }
+    while (n--)
+        crc = crc32c_table[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    return crc;
+}
+
+uint32_t snappy_crc32c(const uint8_t* data, size_t n) {
+    uint32_t crc = 0xffffffffu;
+#if defined(__x86_64__)
+    if (has_sse42())
+        crc = crc32c_hw(crc, data, n);
+    else
+#endif
+        crc = crc32c_sw(crc, data, n);
+    return crc ^ 0xffffffffu;
+}
+
+// ---------------------------------------------------------------------------
+// snappy block compress (golang/snappy-compatible output)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t load32(const uint8_t* p) {
+    uint32_t v; memcpy(&v, p, 4); return v;
+}
+static inline uint64_t load64(const uint8_t* p) {
+    uint64_t v; memcpy(&v, p, 8); return v;
+}
+
+size_t snappy_max_compressed_length(size_t n) {
+    // worst case: varint header + all-literal with 1 extra tag byte
+    // per 2^32... use the canonical bound 32 + n + n/6
+    return 32 + n + n / 6;
+}
+
+static uint8_t* emit_varint(uint8_t* dst, uint64_t v) {
+    while (v >= 0x80) {
+        *dst++ = (uint8_t)(v) | 0x80;
+        v >>= 7;
+    }
+    *dst++ = (uint8_t)v;
+    return dst;
+}
+
+static uint8_t* emit_literal(uint8_t* dst, const uint8_t* src, size_t n) {
+    if (n == 0) return dst;
+    size_t n1 = n - 1;
+    if (n1 < 60) {
+        *dst++ = (uint8_t)(n1 << 2);
+    } else if (n1 < (1u << 8)) {
+        *dst++ = 60 << 2;
+        *dst++ = (uint8_t)n1;
+    } else if (n1 < (1u << 16)) {
+        *dst++ = 61 << 2;
+        *dst++ = (uint8_t)n1; *dst++ = (uint8_t)(n1 >> 8);
+    } else if (n1 < (1u << 24)) {
+        *dst++ = 62 << 2;
+        *dst++ = (uint8_t)n1; *dst++ = (uint8_t)(n1 >> 8);
+        *dst++ = (uint8_t)(n1 >> 16);
+    } else {
+        *dst++ = 63 << 2;
+        *dst++ = (uint8_t)n1; *dst++ = (uint8_t)(n1 >> 8);
+        *dst++ = (uint8_t)(n1 >> 16); *dst++ = (uint8_t)(n1 >> 24);
+    }
+    memcpy(dst, src, n);
+    return dst + n;
+}
+
+static uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t length) {
+    // long matches: chunks of <=64 via copy2
+    while (length >= 68) {
+        *dst++ = (63 << 2) | 2;                 // copy2, len 64
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+        length -= 64;
+    }
+    if (length > 64) {
+        *dst++ = (59 << 2) | 2;                 // copy2, len 60
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+        length -= 60;
+    }
+    if (length >= 12 || offset >= 2048) {
+        *dst++ = (uint8_t)(((length - 1) << 2) | 2);   // copy2
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+    } else {
+        // copy1: 4 <= length <= 11, offset < 2048
+        *dst++ = (uint8_t)(((offset >> 8) << 5) |
+                           ((length - 4) << 2) | 1);
+        *dst++ = (uint8_t)offset;
+    }
+    return dst;
+}
+
+int snappy_compress_block(const uint8_t* src, size_t n,
+                          uint8_t* dst, size_t* dst_len) {
+    uint8_t* d = emit_varint(dst, n);
+    if (n < 16) {
+        d = emit_literal(d, src, n);
+        *dst_len = (size_t)(d - dst);
+        return 0;
+    }
+
+    // hash table of positions; size scales with input (max 1<<14)
+    const int max_table_bits = 14;
+    int table_bits = 8;
+    while (table_bits < max_table_bits &&
+           (size_t(1) << table_bits) < n)
+        table_bits++;
+    uint32_t shift = 32 - table_bits;
+    uint16_t table[1 << 14];
+    memset(table, 0, sizeof(uint16_t) * (size_t(1) << table_bits));
+
+    // s_limit leaves margin so 8-byte loads stay in bounds
+    size_t s_limit = n - 15;
+    size_t next_emit = 0;
+    size_t s = 1;
+    const uint32_t mul = 0x1e35a7bd;
+
+    while (s < s_limit) {
+        // find a match, skipping faster the longer we go without one
+        size_t skip = 32;
+        size_t candidate;
+        uint32_t h = (load32(src + s) * mul) >> shift;
+        for (;;) {
+            candidate = table[h];
+            table[h] = (uint16_t)s;
+            if (candidate < s && s - candidate < (1u << 16) &&
+                load32(src + candidate) == load32(src + s))
+                break;
+            s += (skip >> 5);
+            skip++;
+            if (s >= s_limit) goto tail;
+            h = (load32(src + s) * mul) >> shift;
+        }
+
+        d = emit_literal(d, src + next_emit, s - next_emit);
+
+        // extend the match forward
+        {
+            size_t base = s;
+            size_t m_start = candidate;
+            size_t matched = 4;
+            s += 4; candidate += 4;
+            bool mismatched = false;
+            while (s + 8 <= n) {
+                uint64_t x = load64(src + s) ^ load64(src + candidate);
+                if (x != 0) {
+                    matched += (size_t)(__builtin_ctzll(x) >> 3);
+                    mismatched = true;
+                    break;
+                }
+                s += 8; candidate += 8; matched += 8;
+            }
+            if (!mismatched) {
+                while (s < n && src[s] == src[candidate]) {
+                    s++; candidate++; matched++;
+                }
+            }
+            s = base + matched;
+            d = emit_copy(d, base - m_start, matched);
+            next_emit = s;
+            if (s >= s_limit) break;
+            // re-seed the table at s-1 and s for denser matching
+            uint32_t h2 = (load32(src + s - 1) * mul) >> shift;
+            table[h2] = (uint16_t)(s - 1);
+        }
+    }
+tail:
+    if (next_emit < n)
+        d = emit_literal(d, src + next_emit, n - next_emit);
+    *dst_len = (size_t)(d - dst);
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// snappy/S2 block decompress
+// ---------------------------------------------------------------------------
+
+int64_t snappy_uncompressed_length(const uint8_t* src, size_t n) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (size_t i = 0; i < n && i < 10; i++) {
+        v |= (uint64_t)(src[i] & 0x7f) << shift;
+        if (!(src[i] & 0x80))
+            return (int64_t)v;
+        shift += 7;
+    }
+    return -1;
+}
+
+// returns bytes written, -1 on corrupt input, -2 on an S2 encoding
+// outside the supported subset
+int64_t snappy_uncompress_block(const uint8_t* src, size_t n,
+                                uint8_t* dst, size_t dst_cap) {
+    size_t s = 0;
+    // varint length header
+    uint64_t want = 0;
+    {
+        int shift = 0;
+        for (;;) {
+            if (s >= n || shift > 63) return -1;
+            uint8_t b = src[s++];
+            want |= (uint64_t)(b & 0x7f) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+    }
+    if (want > dst_cap) return -1;
+
+    size_t d = 0;
+    size_t last_offset = 0;          // S2 repeat state
+    while (s < n) {
+        uint8_t tag = src[s];
+        size_t length, offset;
+        switch (tag & 3) {
+        case 0: {                    // literal
+            length = tag >> 2;
+            s++;
+            if (length >= 60) {
+                size_t extra = length - 59;     // 1..4 bytes
+                if (s + extra > n) return -1;
+                length = 0;
+                for (size_t i = 0; i < extra; i++)
+                    length |= (size_t)src[s + i] << (8 * i);
+                s += extra;
+            }
+            length += 1;
+            if (s + length > n || d + length > dst_cap) return -1;
+            memcpy(dst + d, src + s, length);
+            s += length; d += length;
+            continue;
+        }
+        case 1: {                    // copy1 (or S2 repeat)
+            if (s + 2 > n) return -1;
+            length = ((tag >> 2) & 0x7);
+            offset = ((size_t)(tag & 0xe0) << 3) | src[s + 1];
+            s += 2;
+            if (offset == 0) {
+                // S2 repeat-offset. Lengths 4..8 (codes 0..4) are the
+                // unextended form; codes 5..7 signal extended length
+                // bytes whose exact bias we cannot validate offline —
+                // refuse rather than risk a wrong reconstruction.
+                if (length >= 5) return -2;
+                length += 4;
+                offset = last_offset;
+                if (offset == 0) return -1;     // repeat before any copy
+            } else {
+                length += 4;
+            }
+            break;
+        }
+        case 2: {                    // copy2
+            if (s + 3 > n) return -1;
+            length = (tag >> 2) + 1;
+            offset = (size_t)src[s + 1] | ((size_t)src[s + 2] << 8);
+            s += 3;
+            if (offset == 0) return -2;         // S2 extended repeat
+            break;
+        }
+        default: {                   // copy4
+            if (s + 5 > n) return -1;
+            length = (tag >> 2) + 1;
+            offset = (size_t)src[s + 1] | ((size_t)src[s + 2] << 8) |
+                     ((size_t)src[s + 3] << 16) |
+                     ((size_t)src[s + 4] << 24);
+            s += 5;
+            if (offset == 0) return -2;
+            break;
+        }
+        }
+        if (offset > d || d + length > dst_cap) return -1;
+        last_offset = offset;
+        // overlapping copies must proceed byte-wise when offset < length
+        if (offset >= length) {
+            memcpy(dst + d, dst + d - offset, length);
+            d += length;
+        } else {
+            for (size_t i = 0; i < length; i++, d++)
+                dst[d] = dst[d - offset];
+        }
+    }
+    if (d != want) return -1;
+    return (int64_t)d;
+}
+
+}  // extern "C"

@@ -80,6 +80,29 @@ lib.hh_final256(native._u8p(state), native._u8p(tail), 7,
                 native._u8p(out))
 assert out.tobytes() == native.hh256(
     key, np.concatenate([data, tail])), "streaming mismatch"
+
+# snappy block codec: roundtrip fuzz + CRC32C vectors under ASan/UBSan
+# (match finding does raw pointer walks over caller buffers)
+assert native.crc32c(b"123456789") == 0xE3069283
+import random as _random
+_rng = _random.Random(11)
+for _trial in range(40):
+    n = _rng.randrange(0, 65536)
+    base = bytes(_rng.randrange(256)
+                 for _ in range(_rng.randrange(1, 200)))
+    blob = (base * (n // max(len(base), 1) + 1))[:n]
+    if _rng.random() < 0.5:
+        blob = bytes(_rng.randrange(256) for _ in range(n))
+    comp = native.snappy_compress_block(blob)
+    assert native.snappy_uncompress_block(comp) == blob, n
+# corrupt inputs must error, not overrun
+for bad in (b"", b"\xff" * 12, b"\x05\x00", b"\x04\x08ab\x01\x09"):
+    try:
+        native.snappy_uncompress_block(bad)
+    except (ValueError, NotImplementedError):
+        pass
+    else:
+        raise AssertionError(f"corrupt block accepted: {bad!r}")
 print("sanitized identity matrices OK")
 """
 
