@@ -180,7 +180,13 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
             try:
                 for prefix, router in extra_routers:
                     if self.path.startswith(prefix):
-                        respond(router(ctx))
+                        resp = router(ctx)
+                        if resp is None:
+                            # router declined (e.g. the web UI owns
+                            # only exact paths under /minio/): keep
+                            # matching later-registered routers
+                            continue
+                        respond(resp)
                         return
                 respond(api.handle(ctx))
             finally:

@@ -166,6 +166,12 @@ class WebHandlers:
 
     # -- router ------------------------------------------------------------
 
+    #: exact paths the static UI answers for; anything else under
+    #: /minio/ belongs to admin/storage/lock/peer routers (the server
+    #: continues matching when a router returns None)
+    _UI_PATHS = ("/minio", "/minio/", "/minio/index.html",
+                 "/minio/login")
+
     def router(self, ctx: RequestContext) -> HTTPResponse:
         path = urllib.parse.unquote(ctx.req.path)
         if path == "/minio/webrpc" and ctx.req.method == "POST":
@@ -177,6 +183,26 @@ class WebHandlers:
         if path == "/minio/web/zip" and ctx.req.method == "POST":
             return self._zip(ctx)
         return HTTPResponse(status=404, body=b"not found")
+
+    def ui(self, ctx: RequestContext) -> Optional[HTTPResponse]:
+        """The static browser page (reference browser/app SPA as one
+        build-chain-free HTML file, s3/webui.html). Returns None for
+        paths outside _UI_PATHS so later-mounted /minio/* routers keep
+        working."""
+        path = urllib.parse.unquote(ctx.req.path).split("?", 1)[0]
+        if path not in self._UI_PATHS:
+            return None
+        if ctx.req.method not in ("GET", "HEAD"):
+            return HTTPResponse(status=405)
+        page = _ui_page()
+        return HTTPResponse(headers={
+            "Content-Type": "text/html; charset=utf-8",
+            "Cache-Control": "no-store",
+            "X-Frame-Options": "DENY",
+            "Content-Security-Policy":
+                "default-src 'self'; style-src 'unsafe-inline'; "
+                "script-src 'unsafe-inline'; img-src 'self' data:",
+        }, body=page)
 
     # -- JSON-RPC ----------------------------------------------------------
 
@@ -208,7 +234,15 @@ class WebHandlers:
         except _RPCError as e:
             return self._rpc_response(rid, error={"code": e.code,
                                                   "message": str(e)})
-        except (S3Error, oerr.ObjectApiError) as e:
+        except S3Error as e:
+            # token problems (expired/forged/no such user — raised as
+            # AccessDenied by _token_auth) map to 401 so the UI can
+            # return to the login screen; IAM *authorization* denials
+            # use _RPCError 403 above and must NOT end the session
+            code = 401 if e.code == "AccessDenied" else 1
+            return self._rpc_response(rid, error={"code": code,
+                                                  "message": str(e)})
+        except oerr.ObjectApiError as e:
             return self._rpc_response(rid, error={"code": 1,
                                                   "message": str(e)})
         except IAMStoreError as e:
@@ -657,6 +691,20 @@ def _attachment(filename: str) -> str:
     return f'attachment; filename="{safe}"'
 
 
+_UI_PAGE_CACHE: Optional[bytes] = None
+
+
+def _ui_page() -> bytes:
+    global _UI_PAGE_CACHE
+    if _UI_PAGE_CACHE is None:
+        import os
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "webui.html")
+        with open(path, "rb") as f:
+            _UI_PAGE_CACHE = f.read()
+    return _UI_PAGE_CACHE
+
+
 def mount(server) -> WebHandlers:
     """Attach the web surface to an S3Server (before S3 routing)."""
     web = WebHandlers(server.api)
@@ -674,4 +722,14 @@ def mount(server) -> WebHandlers:
 
     server.register_router("/minio/webrpc", route)
     server.register_router("/minio/web/", route)
+    # the human-facing page: exact-path match with fall-through, so the
+    # prefix never shadows admin/health/internode routers regardless of
+    # mount order
+    def ui_route(ctx: RequestContext) -> Optional[HTTPResponse]:
+        try:
+            return web.ui(ctx)
+        except Exception:  # noqa: BLE001 — never abort the connection
+            return HTTPResponse(status=500, body=b"internal error")
+
+    server.register_router("/minio", ui_route)
     return web

@@ -1,0 +1,183 @@
+"""Static browser UI (VERDICT r4 #3): the /minio/ page serves, exact-
+path routing never shadows other /minio/* routers, and the endpoint
+sequence the page's JS drives (login -> buckets -> upload -> list ->
+url-token download -> share -> delete) round-trips over HTTP."""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import pytest
+
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3.server import S3Server
+from minio_tpu.s3.web import mount
+from tests.test_s3 import CREDS, REGION
+from tests.test_web import _call, _http, _login
+
+
+@pytest.fixture(scope="module")
+def ui_server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("uidrives")
+    sets = ErasureSets.from_drives(
+        [str(root / f"d{i}") for i in range(4)], 1, 4, 2,
+        block_size=1 << 16)
+    iam = IAMSys(sets, root_cred=CREDS)
+    srv = S3Server(sets, creds=CREDS, region=REGION, iam=iam).start()
+    from minio_tpu.s3.admin import mount_admin
+    mount_admin(srv)                  # before web, like cluster boot
+    mount(srv)
+
+    # a router registered AFTER web.mount under /minio/, like the
+    # cluster's storage/lock/peer RPC mounts
+    from minio_tpu.s3.handlers import HTTPResponse
+    srv.register_router("/minio/fakerpc/",
+                        lambda ctx: HTTPResponse(status=299,
+                                                 body=b"rpc-ok"))
+    yield srv
+    srv.stop()
+    sets.close()
+
+
+def test_ui_page_serves(ui_server):
+    srv = ui_server
+    for path in ("/minio/", "/minio", "/minio/index.html",
+                 "/minio/login"):
+        st, hdrs, body = _http(srv.port, "GET", path)
+        assert st == 200, path
+        assert hdrs["content-type"].startswith("text/html"), path
+        text = body.decode()
+        assert "minio-tpu" in text and "/minio/webrpc" in text, path
+        assert "content-security-policy" in hdrs, path
+    # POST to the page is not a thing
+    st, _, _ = _http(srv.port, "POST", "/minio/")
+    assert st == 405
+
+
+def test_ui_routing_never_shadows_other_minio_routes(ui_server):
+    srv = ui_server
+    # a later-mounted internode router still gets its traffic
+    st, _, body = _http(srv.port, "GET", "/minio/fakerpc/ping")
+    assert st == 299 and body == b"rpc-ok"
+    # health (mounted before web) still answers
+    st, _, _ = _http(srv.port, "GET",
+                     "/minio/health/live")
+    assert st in (200, 204)
+    # an unknown /minio/* path falls through to S3 routing (its error
+    # shape), not the UI page
+    st, hdrs, _ = _http(srv.port, "GET", "/minio/unknown-thing")
+    assert not hdrs.get("content-type", "").startswith("text/html")
+
+
+def test_session_vs_authorization_error_codes(ui_server):
+    """The page logs out ONLY when the session token is dead: token
+    failures are JSON-RPC code 401; IAM authorization denials are 403
+    (review r5 — a readonly user browsing must not be kicked out)."""
+    import time as _time
+
+    from minio_tpu.s3.web import jwt_encode
+
+    srv = ui_server
+    # expired session token -> 401
+    expired = jwt_encode({"sub": CREDS.access_key, "typ": "web",
+                          "exp": _time.time() - 5}, CREDS.secret_key)
+    out = _call(srv.port, "ListBuckets", token=expired)
+    assert out["error"]["code"] == 401
+    # forged signature -> 401
+    forged = jwt_encode({"sub": CREDS.access_key, "typ": "web",
+                         "exp": _time.time() + 600}, "wrong")
+    out = _call(srv.port, "ListBuckets", token=forged)
+    assert out["error"]["code"] == 401
+
+    # a real but non-owner user hitting an authorization wall -> 403
+    srv.api.iam.add_user("uiviewer", "uiviewer-secret1")
+    srv.api.iam.attach_policy("readonly", user="uiviewer")
+    vtoken = _login(srv.port, "uiviewer", "uiviewer-secret1")
+    out = _call(srv.port, "GetBucketPolicy",
+                {"bucketName": "somebucket", "prefix": ""},
+                token=vtoken)
+    assert out["error"]["code"] == 403
+    # and the session keeps working afterwards
+    assert "result" in _call(srv.port, "ListBuckets", token=vtoken)
+
+
+def test_ui_head_request(ui_server):
+    srv = ui_server
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+    conn.request("HEAD", "/minio/")
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type", "").startswith("text/html")
+    conn.close()
+
+
+def test_ui_endpoint_flow_roundtrip(ui_server):
+    """The exact call sequence webui.html's JS makes, over plain
+    HTTP."""
+    srv = ui_server
+    token = _login(srv.port)                         # Web.Login
+    assert "result" in _call(srv.port, "ServerInfo", token=token)
+    assert "result" in _call(srv.port, "MakeBucket",
+                             {"bucketName": "uibkt"}, token=token)
+    names = [b["name"] for b in _call(
+        srv.port, "ListBuckets", token=token)["result"]["buckets"]]
+    assert "uibkt" in names
+
+    # upload (fetch PUT with Bearer), under a prefix like the page does
+    body = b"ui-payload-" * 1000
+    st, _, _ = _http(srv.port, "PUT", "/minio/web/upload/uibkt/docs/f.bin",
+                     body=body,
+                     headers={"Authorization": f"Bearer {token}",
+                              "Content-Length": str(len(body))})
+    assert st == 200
+
+    # delimiter listing shows the prefix, then the object inside it
+    out = _call(srv.port, "ListObjects", {"bucketName": "uibkt"},
+                token=token)["result"]
+    assert {o["name"] for o in out["objects"]} == {"docs/"}
+    out = _call(srv.port, "ListObjects",
+                {"bucketName": "uibkt", "prefix": "docs/"},
+                token=token)["result"]
+    assert [o["name"] for o in out["objects"]] == ["docs/f.bin"]
+
+    # download via CreateURLToken exactly like the page's <a> click
+    url_token = _call(srv.port, "CreateURLToken",
+                      token=token)["result"]["token"]
+    st, hdrs, got = _http(
+        srv.port, "GET",
+        f"/minio/web/download/uibkt/docs/f.bin?token={url_token}")
+    assert st == 200 and got == body
+    assert "attachment" in hdrs.get("content-disposition", "")
+
+    # share: presigned URL works unauthenticated
+    out = _call(srv.port, "PresignedGet",
+                {"bucketName": "uibkt", "objectName": "docs/f.bin",
+                 "hostName": f"127.0.0.1:{srv.port}", "expiry": 600},
+                token=token)["result"]
+    path = out["url"].split(str(srv.port), 1)[1]
+    st, _, got = _http(srv.port, "GET", path)
+    assert st == 200 and got == body
+
+    # policy dropdown -> SetBucketPolicy -> GetBucketPolicy readback
+    assert "result" in _call(
+        srv.port, "SetBucketPolicy",
+        {"bucketName": "uibkt", "prefix": "", "policy": "readonly"},
+        token=token)
+    assert _call(srv.port, "GetBucketPolicy",
+                 {"bucketName": "uibkt", "prefix": ""},
+                 token=token)["result"]["policy"] == "readonly"
+
+    # delete object then bucket, like the page's delete buttons
+    assert "result" in _call(
+        srv.port, "RemoveObject",
+        {"bucketName": "uibkt", "objects": ["docs/f.bin"]},
+        token=token)
+    out = _call(srv.port, "ListObjects",
+                {"bucketName": "uibkt", "prefix": "docs/"},
+                token=token)["result"]
+    assert out["objects"] == []
+    assert "result" in _call(srv.port, "DeleteBucket",
+                             {"bucketName": "uibkt"}, token=token)
