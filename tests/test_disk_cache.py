@@ -1,0 +1,239 @@
+"""Disk-cache depth (VERDICT r4 #4): range entries, streamed fills
+with bounded memory, incremental cache-side bitrot, watermark LRU.
+Complements tests/test_gateway_cache.py's basic hit/invalidation
+coverage."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from minio_tpu.object.cache import CacheObjects
+from minio_tpu.object.fs import FSObjects
+
+BLOCK = 1 << 14                       # small cache block for tests
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    fs = FSObjects(str(tmp_path / "origin"))
+    fs.make_bucket("b")
+    cache = CacheObjects(fs, str(tmp_path / "cache"),
+                         budget_bytes=64 << 20, block_size=BLOCK)
+    return fs, cache
+
+
+def test_ranged_miss_caches_aligned_span(stack):
+    fs, cache = stack
+    payload = os.urandom(BLOCK * 6 + 777)
+    fs.put_object("b", "o", payload)
+
+    # ranged miss: only the covering aligned span lands in the cache
+    _, s = cache.get_object("b", "o", offset=BLOCK + 100, length=300)
+    assert b"".join(s) == payload[BLOCK + 100:BLOCK + 400]
+    assert cache.misses == 1
+    meta = cache._load_entry("b", "o")
+    assert meta["ranges"] == [
+        {"start": BLOCK, "end": 2 * BLOCK, "file": f"r{BLOCK}"}]
+
+    # a hit fully inside the cached span serves from cache
+    _, s = cache.get_object("b", "o", offset=BLOCK + 500, length=100)
+    assert b"".join(s) == payload[BLOCK + 500:BLOCK + 600]
+    assert cache.hits == 1
+
+    # a span NOT covered is a miss and caches its own range
+    _, s = cache.get_object("b", "o", offset=4 * BLOCK, length=BLOCK)
+    assert b"".join(s) == payload[4 * BLOCK:5 * BLOCK]
+    assert cache.misses == 2
+    meta = cache._load_entry("b", "o")
+    assert {r["start"] for r in meta["ranges"]} == {BLOCK, 4 * BLOCK}
+
+    # a request spanning cached+uncached blocks is a miss (no single
+    # covering span) and fills its full aligned span
+    _, s = cache.get_object("b", "o", offset=BLOCK, length=3 * BLOCK)
+    assert b"".join(s) == payload[BLOCK:4 * BLOCK]
+    assert cache.misses == 3
+    _, s = cache.get_object("b", "o", offset=BLOCK, length=3 * BLOCK)
+    assert b"".join(s) == payload[BLOCK:4 * BLOCK]
+    assert cache.hits == 2
+
+    # the tail range (unaligned object end) caches and serves
+    _, s = cache.get_object("b", "o", offset=BLOCK * 6, length=777)
+    assert b"".join(s) == payload[BLOCK * 6:]
+    _, s = cache.get_object("b", "o", offset=BLOCK * 6 + 700, length=77)
+    assert b"".join(s) == payload[BLOCK * 6 + 700:]
+    assert cache.hits == 3
+
+
+def test_whole_object_entry_serves_any_range(stack):
+    fs, cache = stack
+    payload = os.urandom(3 * BLOCK + 5)
+    fs.put_object("b", "w", payload)
+    _, s = cache.get_object("b", "w")
+    assert b"".join(s) == payload
+    for off, ln in [(0, 10), (BLOCK - 1, 2), (2 * BLOCK, BLOCK + 5),
+                    (0, len(payload))]:
+        _, s = cache.get_object("b", "w", offset=off, length=ln)
+        assert b"".join(s) == payload[off:off + ln], (off, ln)
+    assert cache.misses == 1 and cache.hits == 4
+
+
+def test_corrupt_block_detected_mid_stream_and_evicted(stack):
+    """Incremental verification: blocks before the corruption stream
+    verified; the corrupt block is never served — the rest comes from
+    the backend and the bad file is evicted."""
+    fs, cache = stack
+    payload = os.urandom(5 * BLOCK)
+    fs.put_object("b", "c", payload)
+    b"".join(cache.get_object("b", "c")[1])          # populate
+
+    d = cache._entry_dir("b", "c")
+    # corrupt the PAYLOAD of the third frame (frame = 32-digest+block)
+    with open(os.path.join(d, "data"), "r+b") as f:
+        f.seek(2 * (32 + BLOCK) + 32 + 7)
+        f.write(b"\xff")
+    _, s = cache.get_object("b", "c")
+    assert b"".join(s) == payload                    # bytes all correct
+    # the corrupt file is gone; next read is a clean miss that refills
+    meta = cache._load_entry("b", "c")
+    assert meta["ranges"] == []
+    before = cache.misses
+    _, s = cache.get_object("b", "c")
+    assert b"".join(s) == payload
+    assert cache.misses == before + 1
+    _, s = cache.get_object("b", "c")
+    assert b"".join(s) == payload                    # refilled → hit
+
+
+def test_partial_fill_never_committed(stack):
+    """A client that hangs up mid-download must not leave a partial
+    cache entry that later reads would trust."""
+    fs, cache = stack
+    payload = os.urandom(6 * BLOCK)
+    fs.put_object("b", "p", payload)
+    _, s = cache.get_object("b", "p")
+    next(s)                                          # one block only
+    s.close()                                        # client hangup
+    meta = cache._load_entry("b", "p")
+    assert (meta or {}).get("ranges", []) == []
+    d = cache._entry_dir("b", "p")
+    leftovers = [f for f in os.listdir(d) if f != "meta.json"]
+    assert leftovers == []
+    # and the object still reads fine (miss -> refill)
+    _, s = cache.get_object("b", "p")
+    assert b"".join(s) == payload
+
+
+def test_watermark_lru_prefers_cold_entries(tmp_path):
+    fs = FSObjects(str(tmp_path / "o"))
+    fs.make_bucket("b")
+    cache = CacheObjects(fs, str(tmp_path / "c"),
+                         budget_bytes=200_000, block_size=BLOCK)
+    for i in range(12):
+        fs.put_object("b", f"k{i}", bytes(BLOCK))
+        b"".join(cache.get_object("b", f"k{i}")[1])
+        time.sleep(0.01)
+    # keep k0 hot: its clock refreshes on every hit
+    b"".join(cache.get_object("b", "k0")[1])
+    time.sleep(0.01)
+    for i in range(12, 16):
+        fs.put_object("b", f"k{i}", bytes(BLOCK))
+        b"".join(cache.get_object("b", f"k{i}")[1])
+    assert cache._usage() <= 200_000 * 0.95
+    # the hot entry survived the purge; a cold early one did not
+    hits_before = cache.hits
+    b"".join(cache.get_object("b", "k0")[1])
+    assert cache.hits == hits_before + 1
+    misses_before = cache.misses
+    b"".join(cache.get_object("b", "k1")[1])
+    assert cache.misses == misses_before + 1
+
+
+def test_oversized_object_reads_through(stack):
+    fs, cache = stack
+    cache.budget = 1 << 20                # max entry = 100 KiB
+    payload = os.urandom(300_000)
+    fs.put_object("b", "huge", payload)
+    _, s = cache.get_object("b", "huge")
+    assert b"".join(s) == payload
+    meta = cache._load_entry("b", "huge")
+    assert meta is None or meta.get("ranges", []) == []
+    # but a small RANGE of the huge object still caches
+    _, s = cache.get_object("b", "huge", offset=BLOCK, length=100)
+    assert b"".join(s) == payload[BLOCK:BLOCK + 100]
+    meta = cache._load_entry("b", "huge")
+    assert meta and len(meta["ranges"]) == 1
+
+
+_RSS_CHILD = r"""
+import os, resource, sys
+sys.path.insert(0, os.environ["REPO"])
+from minio_tpu.object.cache import CacheObjects
+
+SIZE = 256 << 20
+CHUNK = 1 << 20
+
+class FakeInfo:
+    etag = "fixed"; size = SIZE; content_type = "application/x"
+    user_defined = {}; mod_time = 0.0
+
+class FakeInner:
+    def get_object_info(self, b, k, opts=None):
+        return FakeInfo()
+    def get_object(self, b, k, offset=0, length=-1, opts=None):
+        n = SIZE - offset if length < 0 else length
+        def gen():
+            left = n
+            blob = b"\xab" * CHUNK
+            while left > 0:
+                yield blob[:min(CHUNK, left)]
+                left -= min(CHUNK, left)
+        return FakeInfo(), gen()
+
+cache = CacheObjects(FakeInner(), os.environ["CACHEDIR"],
+                     budget_bytes=SIZE * 20)
+# a tiny warm-up fill loads every code path (incl. the hash kernels),
+# so the big fill's delta over this high-water is pure buffering
+_, warm = cache.get_object("b", "big", offset=0, length=1 << 20)
+for _chunk in warm:
+    pass
+base_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+_, stream = cache.get_object("b", "big")
+total = 0
+for chunk in stream:
+    total += len(chunk)
+assert total == SIZE, total
+meta = cache._load_entry("b", "big")
+assert any(r["start"] == 0 and r["end"] == SIZE
+           for r in meta["ranges"]), "fill did not commit"
+rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print(f"rss_mb={rss_mb:.0f} base_mb={base_mb:.0f}")
+assert rss_mb - base_mb < 100, \
+    f"streamed 256 MiB fill grew RSS by {rss_mb - base_mb:.0f} MB"
+"""
+
+
+def test_fill_memory_is_bounded(tmp_path):
+    """A 256 MiB fill must stream at constant memory (the r4 cache
+    buffered the entire object in RAM — VERDICT weak: cache.py:146)."""
+    cachedir = "/dev/shm/mt-cache-test" if os.path.isdir("/dev/shm") \
+        else str(tmp_path / "c")
+    env = dict(os.environ,
+               REPO=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))),
+               CACHEDIR=cachedir)
+    try:
+        proc = subprocess.run([sys.executable, "-c", _RSS_CHILD],
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "rss_mb=" in proc.stdout
+    finally:
+        import shutil
+        shutil.rmtree(cachedir, ignore_errors=True)
