@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import bz2
 import csv as _csv
+import datetime as _dt
 import gzip
 import io
 import json
@@ -14,7 +15,8 @@ import xml.etree.ElementTree as ET
 import zlib
 from typing import Iterator, Optional
 
-from .sql import Aggregator, Query, SQLError, evaluate, parse
+from .sql import (Aggregator, Query, SQLError, evaluate,
+                  format_sql_timestamp, parse)
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 
@@ -199,12 +201,20 @@ def _fmt_value(v) -> str:
         return "true" if v else "false"
     if isinstance(v, float) and v.is_integer():
         return str(int(v))
+    if isinstance(v, _dt.datetime):
+        return format_sql_timestamp(v)
+    return str(v)
+
+
+def _json_default(v):
+    if isinstance(v, _dt.datetime):
+        return format_sql_timestamp(v)
     return str(v)
 
 
 def _emit(row: dict, req: SelectRequest) -> bytes:
     if req.output_format == "JSON":
-        return (json.dumps(row, default=str)
+        return (json.dumps(row, default=_json_default)
                 + req.out_record_delim).encode()
     buf = io.StringIO()
     w = _csv.writer(buf, delimiter=req.out_delim,

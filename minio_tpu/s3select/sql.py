@@ -12,20 +12,103 @@ Grammar:
     term       := literal | column | alias.column | _N | -term
                   | term (+|-|*|/|%) term | (expr)
                   | COUNT(*) | SUM/AVG/MIN/MAX/COUNT(expr)
-                  | LOWER/UPPER/LENGTH/TRIM(expr) | CAST(expr AS type)
+                  | LOWER/UPPER/LENGTH/CHAR_LENGTH(expr)
+                  | TRIM([[LEADING|TRAILING|BOTH] [chars] FROM] s)
+                  | SUBSTRING(s FROM a [FOR n]) | SUBSTRING(s, a[, n])
+                  | COALESCE(a, ...) | NULLIF(a, b)
+                  | EXTRACT(part FROM ts) | UTCNOW()
+                  | DATE_ADD(part, qty, ts) | DATE_DIFF(part, t1, t2)
+                  | TO_TIMESTAMP(s) | TO_STRING(ts, 'pattern')
+                  | CAST(expr AS type)   -- incl. TIMESTAMP
 
-Values are Python str/float/int/bool/None; comparisons coerce numerics
-like the reference's typed values.
+Values are Python str/float/int/bool/None/datetime; comparisons coerce
+numerics like the reference's typed values; timestamp semantics mirror
+pkg/s3select/sql/{funceval,timestampfuncs,stringfuncs}.go (TO_STRING /
+TO_TIMESTAMP are implemented here although the reference returns
+errNotImplemented for them, funceval.go:140).
 """
 
 from __future__ import annotations
 
+import datetime as _dt
 import re
 from typing import Any, Optional
 
 
 class SQLError(Exception):
     pass
+
+
+# -- SQL timestamps ---------------------------------------------------------
+# The reference's accepted layouts (pkg/s3select/sql/timestampfuncs.go:23):
+# 2006T | 2006-01T | 2006-01-02T | ..T15:04Z07:00 | ..:05 | ..05.frac
+
+_TS_PATTERNS = [
+    re.compile(r"^(\d{4})T$"),
+    re.compile(r"^(\d{4})-(\d{2})T$"),
+    re.compile(r"^(\d{4})-(\d{2})-(\d{2})T$"),
+    re.compile(r"^(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2})"
+               r"(?::(\d{2})(\.\d+)?)?(Z|[+-]\d{2}:\d{2})$"),
+]
+
+
+def parse_sql_timestamp(s: str) -> _dt.datetime:
+    s = s.strip()
+    for rx in _TS_PATTERNS:
+        m = rx.match(s)
+        if not m:
+            continue
+        g = m.groups()
+        if len(g) <= 3:                        # date-only layouts
+            y = int(g[0])
+            mo = int(g[1]) if len(g) > 1 else 1
+            d = int(g[2]) if len(g) > 2 else 1
+            return _dt.datetime(y, mo, d, tzinfo=_dt.timezone.utc)
+        y, mo, d, hh, mm = (int(x) for x in g[:5])
+        ss = int(g[5]) if g[5] else 0
+        # microseconds from the DIGITS (float math truncates .000249
+        # into 248 µs); digits past µs precision are dropped
+        micro = int(g[6][1:7].ljust(6, "0")) if g[6] else 0
+        tz = g[7]
+        if tz == "Z":
+            tzinfo = _dt.timezone.utc
+        else:
+            sign = 1 if tz[0] == "+" else -1
+            tzinfo = _dt.timezone(sign * _dt.timedelta(
+                hours=int(tz[1:3]), minutes=int(tz[4:6])))
+        return _dt.datetime(y, mo, d, hh, mm, ss, micro, tzinfo=tzinfo)
+    raise SQLError(f"invalid timestamp {s!r}")
+
+
+def format_sql_timestamp(t: _dt.datetime) -> str:
+    """Reference FormatSQLTimestamp: shortest layout that keeps every
+    nonzero component (timestampfuncs.go:54)."""
+    off = t.utcoffset() or _dt.timedelta(0)
+
+    def tzs() -> str:
+        if not off:
+            return "Z"
+        total = int(off.total_seconds())
+        sign = "+" if total >= 0 else "-"
+        total = abs(total)
+        return f"{sign}{total // 3600:02d}:{total % 3600 // 60:02d}"
+
+    if t.microsecond:
+        frac = f"{t.microsecond / 1e6:.9f}"[2:].rstrip("0")
+        return (f"{t.year:04d}-{t.month:02d}-{t.day:02d}T"
+                f"{t.hour:02d}:{t.minute:02d}:{t.second:02d}"
+                f".{frac}{tzs()}")
+    if t.second:
+        return (f"{t.year:04d}-{t.month:02d}-{t.day:02d}T"
+                f"{t.hour:02d}:{t.minute:02d}:{t.second:02d}{tzs()}")
+    if t.hour or t.minute or off:
+        return (f"{t.year:04d}-{t.month:02d}-{t.day:02d}T"
+                f"{t.hour:02d}:{t.minute:02d}{tzs()}")
+    if t.day != 1:
+        return f"{t.year:04d}-{t.month:02d}-{t.day:02d}T"
+    if t.month != 1:
+        return f"{t.year:04d}-{t.month:02d}T"
+    return f"{t.year:04d}T"
 
 
 _TOKEN_RE = re.compile(r"""
@@ -138,7 +221,10 @@ class Query:
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
 _SCALAR_FUNCS = {"lower", "upper", "length", "char_length",
-                 "character_length", "trim", "abs"}
+                 "character_length", "trim", "abs", "coalesce",
+                 "nullif", "utcnow", "to_timestamp", "to_string"}
+_DATE_PARTS = {"year", "month", "day", "hour", "minute", "second",
+               "timezone_hour", "timezone_minute"}
 
 
 class Parser:
@@ -341,6 +427,14 @@ class Parser:
                     if not self.accept_op(")"):
                         raise SQLError("unclosed COUNT(*)")
                     return Agg("count", None)
+                if fname == "substring":
+                    return self._substring()
+                if fname == "extract":
+                    return self._extract()
+                if fname == "trim":
+                    return self._trim()
+                if fname in ("date_add", "date_diff"):
+                    return self._date_fn(fname)
                 args = []
                 if not self.accept_op(")"):
                     while True:
@@ -364,6 +458,82 @@ class Parser:
                 return Col(str(v2))
             return Col(name)
         raise SQLError(f"unexpected token {v!r}")
+
+
+    # -- special function forms (reference funceval.go grammar) ------------
+
+    def _accept_ident(self, *names: str) -> Optional[str]:
+        k, v = self.peek()
+        if k == "ident" and v.lower() in names:
+            self.next()
+            return v.lower()
+        return None
+
+    def _close(self, what: str) -> None:
+        if not self.accept_op(")"):
+            raise SQLError(f"unclosed {what}")
+
+    def _substring(self) -> Node:
+        """SUBSTRING(s FROM start [FOR len]) | SUBSTRING(s, start
+        [, len]) — both forms, like the reference
+        (funceval.go:281)."""
+        s = self.expr()
+        args = [s]
+        if self.accept_kw("from"):
+            args.append(self.additive())
+            if self._accept_ident("for"):
+                args.append(self.additive())
+        else:
+            if not self.accept_op(","):
+                raise SQLError("SUBSTRING needs FROM or ','")
+            args.append(self.additive())
+            if self.accept_op(","):
+                args.append(self.additive())
+        self._close("SUBSTRING")
+        return Func("substring", args)
+
+    def _extract(self) -> Node:
+        """EXTRACT(part FROM timestamp)."""
+        k, part = self.next()
+        if k != "ident" or part.lower() not in _DATE_PARTS:
+            raise SQLError(f"bad EXTRACT part {part!r}")
+        self.expect_kw("from")
+        e = self.expr()
+        self._close("EXTRACT")
+        return Func(f"extract_{part.lower()}", [e])
+
+    def _trim(self) -> Node:
+        """TRIM([[LEADING|TRAILING|BOTH] [chars] FROM] s)."""
+        where = self._accept_ident("leading", "trailing", "both")
+        if self.accept_kw("from"):              # TRIM(LEADING FROM s)
+            e = self.expr()
+            self._close("TRIM")
+            return Func("trim_full",
+                        [Lit(where or "both"), Lit(None), e])
+        first = self.expr()
+        if self.accept_kw("from"):              # TRIM([pos] chars FROM s)
+            e = self.expr()
+            self._close("TRIM")
+            return Func("trim_full",
+                        [Lit(where or "both"), first, e])
+        if where is not None:
+            raise SQLError("TRIM with position needs FROM")
+        self._close("TRIM")
+        return Func("trim", [first])
+
+    def _date_fn(self, fname: str) -> Node:
+        """DATE_ADD(part, qty, ts) / DATE_DIFF(part, ts1, ts2)."""
+        k, part = self.next()
+        if k != "ident" or part.lower() not in _DATE_PARTS:
+            raise SQLError(f"bad {fname.upper()} date part {part!r}")
+        if not self.accept_op(","):
+            raise SQLError(f"{fname.upper()} needs 3 arguments")
+        a = self.expr()
+        if not self.accept_op(","):
+            raise SQLError(f"{fname.upper()} needs 3 arguments")
+        b = self.expr()
+        self._close(fname.upper())
+        return Func(f"{fname}_{part.lower()}", [a, b])
 
 
 def _like_regex(pat: str, esc: str) -> "re.Pattern":
@@ -402,8 +572,37 @@ def _num(v) -> Optional[float]:
         return None
 
 
+def _aware(t: _dt.datetime) -> _dt.datetime:
+    """Naive datetimes (e.g. pyarrow timestamps without a zone) compare
+    as UTC instants — mixing naive and aware must never TypeError."""
+    return t.replace(tzinfo=_dt.timezone.utc) if t.tzinfo is None \
+        else t
+
+
+def _try_ts(v) -> Optional[_dt.datetime]:
+    if isinstance(v, _dt.datetime):
+        return _aware(v)
+    if isinstance(v, str):
+        try:
+            return parse_sql_timestamp(v)
+        except SQLError:
+            return None
+    return None
+
+
 def _coerce_pair(a, b):
-    """Numeric comparison when both sides look numeric, else string."""
+    """Numeric comparison when both sides look numeric; a datetime on
+    either side compares as an INSTANT (the other side is parsed as a
+    SQL timestamp — '...T10:30Z' equals '...T12:30+02:00'); else
+    string."""
+    if isinstance(a, _dt.datetime) or isinstance(b, _dt.datetime):
+        ta, tb = _try_ts(a), _try_ts(b)
+        if ta is not None and tb is not None:
+            return ta, tb
+        if isinstance(a, _dt.datetime):
+            a = format_sql_timestamp(_aware(a))
+        if isinstance(b, _dt.datetime):
+            b = format_sql_timestamp(_aware(b))
     na, nb = _num(a), _num(b)
     if na is not None and nb is not None:
         return na, nb
@@ -516,6 +715,130 @@ def _truthy(v) -> bool:
     return bool(v) and v is not None
 
 
+def _as_timestamp(v) -> _dt.datetime:
+    if isinstance(v, _dt.datetime):
+        return v
+    if isinstance(v, str):
+        return parse_sql_timestamp(v)
+    raise SQLError(f"expected a timestamp, got {v!r}")
+
+
+def _add_months(t: _dt.datetime, months: int) -> _dt.datetime:
+    """Month arithmetic with Go time.AddDate's overflow semantics
+    (Jan 31 + 1 month normalizes into March, not clamps to Feb 28)."""
+    total = (t.year * 12 + t.month - 1) + months
+    y, m = divmod(total, 12)
+    base = _dt.datetime(y, m + 1, 1, t.hour, t.minute, t.second,
+                        t.microsecond, tzinfo=t.tzinfo)
+    return base + _dt.timedelta(days=t.day - 1)
+
+
+def _date_diff(part: str, t1: _dt.datetime, t2: _dt.datetime) -> int:
+    """Reference dateDiff (timestampfuncs.go:146): years/months/days
+    compare calendar fields; hours/minutes/seconds compare the exact
+    duration."""
+    if t2 < t1:
+        return -_date_diff(part, t2, t1)
+    if part == "year":
+        dy = t2.year - t1.year
+        if (t2.month, t2.day) >= (t1.month, t1.day):
+            return dy
+        return dy - 1
+    if part == "month":
+        months = 12 * (t2.year - t1.year)
+        if t2.month >= t1.month:
+            months += t2.month - t1.month
+        else:
+            months += 12 + t2.month - t1.month
+        if t2.day < t1.day:
+            months -= 1
+        return months
+    if part == "day":
+        return (t2.date() - t1.date()).days
+    secs = (t2 - t1).total_seconds()
+    if part == "hour":
+        return int(secs // 3600)
+    if part == "minute":
+        return int(secs // 60)
+    if part == "second":
+        return int(secs)
+    raise SQLError(f"DATE_DIFF does not support {part.upper()}")
+
+
+_TO_STRING_RX = re.compile(
+    r"yyyy|yy|y|MMMM|MMM|MM|M|dd|d|HH|H|hh|h|mm|m|ss|s|SSS|a|XXX|X"
+    r"|'(?:[^']|'')*'|.")
+
+_MONTHS = ["January", "February", "March", "April", "May", "June",
+           "July", "August", "September", "October", "November",
+           "December"]
+
+
+def _to_string(t: _dt.datetime, fmt: str) -> str:
+    """TO_STRING(ts, pattern) with the Ion/java-style tokens the S3
+    Select docs describe (y/M/d/H/h/m/s/a/X, quoted literals). The
+    reference leaves TO_STRING unimplemented (funceval.go:140) — this
+    implements the documented surface."""
+    def off_str(colon: bool) -> str:
+        off = t.utcoffset() or _dt.timedelta(0)
+        if not off:
+            return "Z"
+        total = int(off.total_seconds())
+        sign = "+" if total >= 0 else "-"
+        total = abs(total)
+        sep = ":" if colon else ""
+        return f"{sign}{total // 3600:02d}{sep}{total % 3600 // 60:02d}"
+
+    out = []
+    for tok in _TO_STRING_RX.findall(fmt):
+        if tok == "yyyy":
+            out.append(f"{t.year:04d}")
+        elif tok == "yy":
+            out.append(f"{t.year % 100:02d}")
+        elif tok == "y":
+            out.append(str(t.year))
+        elif tok == "MMMM":
+            out.append(_MONTHS[t.month - 1])
+        elif tok == "MMM":
+            out.append(_MONTHS[t.month - 1][:3])
+        elif tok == "MM":
+            out.append(f"{t.month:02d}")
+        elif tok == "M":
+            out.append(str(t.month))
+        elif tok == "dd":
+            out.append(f"{t.day:02d}")
+        elif tok == "d":
+            out.append(str(t.day))
+        elif tok == "HH":
+            out.append(f"{t.hour:02d}")
+        elif tok == "H":
+            out.append(str(t.hour))
+        elif tok in ("hh", "h"):
+            h12 = t.hour % 12 or 12
+            out.append(f"{h12:02d}" if tok == "hh" else str(h12))
+        elif tok == "mm":
+            out.append(f"{t.minute:02d}")
+        elif tok == "m":
+            out.append(str(t.minute))
+        elif tok == "ss":
+            out.append(f"{t.second:02d}")
+        elif tok == "s":
+            out.append(str(t.second))
+        elif tok == "SSS":
+            out.append(f"{t.microsecond // 1000:03d}")
+        elif tok == "a":
+            out.append("AM" if t.hour < 12 else "PM")
+        elif tok == "XXX":
+            out.append(off_str(True))
+        elif tok == "X":
+            out.append(off_str(False))
+        elif tok.startswith("'"):
+            out.append(tok[1:-1].replace("''", "'"))
+        else:
+            out.append(tok)
+    return "".join(out)
+
+
 def _scalar_fn(name: str, args: list):
     a = args[0] if args else None
     if name == "lower":
@@ -526,9 +849,108 @@ def _scalar_fn(name: str, args: list):
         return len(str(a)) if a is not None else None
     if name == "trim":
         return str(a).strip() if a is not None else None
+    if name == "trim_full":
+        where, chars, s = args
+        if s is None:
+            return None
+        s = str(s)
+        cutset = str(chars) if chars is not None else " "
+        if where == "leading":
+            return s.lstrip(cutset)
+        if where == "trailing":
+            return s.rstrip(cutset)
+        return s.strip(cutset)
     if name == "abs":
         n = _num(a)
         return abs(n) if n is not None else None
+    if name == "substring":
+        # reference evalSQLSubstring (stringfuncs.go:144): 1-based,
+        # start < 1 clamps to 1, start past the end yields "", a
+        # negative length errors, an oversized one clamps
+        if a is None:
+            return None
+        s = str(a)
+        try:
+            start = int(_num(args[1]))
+        except (TypeError, ValueError):
+            raise SQLError("SUBSTRING start must be a number") from None
+        length = None
+        if len(args) > 2:
+            try:
+                length = int(_num(args[2]))
+            except (TypeError, ValueError):
+                raise SQLError(
+                    "SUBSTRING length must be a number") from None
+            if length < 0:
+                raise SQLError("negative SUBSTRING length")
+        start = max(start, 1)
+        if start > len(s):
+            return ""
+        i = start - 1
+        return s[i:] if length is None else s[i:i + length]
+    if name == "coalesce":
+        for v in args:
+            if v is not None:
+                return v
+        return None
+    if name == "nullif":
+        v1, v2 = (args + [None, None])[:2]
+        if v1 is None or v2 is None:
+            return v1
+        a2, b2 = _coerce_pair(v1, v2)
+        return None if a2 == b2 else v1
+    if name == "utcnow":
+        if args:
+            raise SQLError("UTCNOW takes no arguments")
+        return _dt.datetime.now(_dt.timezone.utc)
+    if name == "to_timestamp":
+        return None if a is None else _as_timestamp(a)
+    if name == "to_string":
+        if a is None:
+            return None
+        if len(args) != 2 or not isinstance(args[1], str):
+            raise SQLError("TO_STRING(ts, 'pattern')")
+        return _to_string(_as_timestamp(a), args[1])
+    if name.startswith("extract_"):
+        part = name[len("extract_"):]
+        if a is None:
+            return None
+        t = _as_timestamp(a)
+        if part in ("timezone_hour", "timezone_minute"):
+            # Go's / and % truncate toward zero: -05:30 extracts
+            # hour -5, minute -30 (timestampfuncs.go:105-110)
+            total = int((t.utcoffset()
+                         or _dt.timedelta(0)).total_seconds())
+            hours = int(total / 3600)
+            if part == "timezone_hour":
+                return hours
+            return int((total - hours * 3600) / 60)
+        return getattr(t, part)
+    if name.startswith("date_add_"):
+        part = name[len("date_add_"):]
+        qty_v, ts_v = args
+        qty = _num(qty_v)
+        if qty is None:
+            raise SQLError("DATE_ADD quantity must be a number")
+        t = _as_timestamp(ts_v)
+        qty = int(qty)
+        if part == "year":
+            return _add_months(t, 12 * qty)
+        if part == "month":
+            return _add_months(t, qty)
+        if part == "day":
+            return t + _dt.timedelta(days=qty)
+        if part == "hour":
+            return t + _dt.timedelta(hours=qty)
+        if part == "minute":
+            return t + _dt.timedelta(minutes=qty)
+        if part == "second":
+            return t + _dt.timedelta(seconds=qty)
+        raise SQLError(f"DATE_ADD does not support {part.upper()}")
+    if name.startswith("date_diff_"):
+        part = name[len("date_diff_"):]
+        return _date_diff(part, _as_timestamp(args[0]),
+                          _as_timestamp(args[1]))
     if name.startswith("cast_"):
         typ = name[5:]
         if a is None:
@@ -544,9 +966,13 @@ def _scalar_fn(name: str, args: list):
                 raise SQLError(f"cannot cast {a!r} to float")
             return n
         if typ in ("string", "varchar", "char", "text"):
+            if isinstance(a, _dt.datetime):
+                return format_sql_timestamp(a)
             return str(a)
         if typ in ("bool", "boolean"):
             return str(a).lower() in ("true", "1")
+        if typ == "timestamp":
+            return _as_timestamp(a)
         raise SQLError(f"unknown cast type {typ}")
     raise SQLError(f"unknown function {name}")
 
@@ -575,6 +1001,8 @@ class Aggregator:
             if v is None:
                 continue
             st["n"] += 1
+            if isinstance(v, _dt.datetime):
+                v = _aware(v)       # MIN/MAX over mixed-zone rows
             n = _num(v)
             if n is not None:
                 st["sum"] += n
