@@ -111,17 +111,29 @@ def _run_gateway(args, creds: Credentials) -> int:
         layer = new_gateway("azure", account=account, key_b64=key,
                             host=h, port=p, secure=(p == 443))
     elif args.kind == "gcs":
+        # JSON API (the reference's mode): a service-account key file
+        # via GOOGLE_APPLICATION_CREDENTIALS / MINIO_GCS_CREDENTIALS.
+        # XML interop fallback: HMAC keys.
+        sa = os.environ.get("MINIO_GCS_CREDENTIALS", "") or \
+            os.environ.get("GOOGLE_APPLICATION_CREDENTIALS", "")
         ak = os.environ.get("MINIO_GCS_ACCESS_KEY", "")
         sk = os.environ.get("MINIO_GCS_SECRET_KEY", "")
-        if not ak or not sk:
-            print("gateway gcs needs MINIO_GCS_ACCESS_KEY and "
-                  "MINIO_GCS_SECRET_KEY (HMAC interop keys)",
-                  file=sys.stderr)
-            return 2
         h, p = host_port(args.target or "storage.googleapis.com:443",
                          443)
-        layer = new_gateway("gcs", access_key=ak, secret_key=sk,
-                            host=h, port=p, secure=(p == 443))
+        if sa:
+            layer = new_gateway(
+                "gcs", credentials_json=sa,
+                project=os.environ.get("MINIO_GCS_PROJECT", ""),
+                host=h, port=p, secure=(p == 443))
+        elif ak and sk:
+            layer = new_gateway("gcs", access_key=ak, secret_key=sk,
+                                host=h, port=p, secure=(p == 443))
+        else:
+            print("gateway gcs needs GOOGLE_APPLICATION_CREDENTIALS/"
+                  "MINIO_GCS_CREDENTIALS (JSON API) or "
+                  "MINIO_GCS_ACCESS_KEY + MINIO_GCS_SECRET_KEY "
+                  "(HMAC interop)", file=sys.stderr)
+            return 2
     else:
         if not args.target:
             print("gateway hdfs needs a namenode host:port",

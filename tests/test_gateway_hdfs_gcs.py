@@ -217,7 +217,7 @@ def test_gcs_gateway_rides_xml_hmac_dialect(tmp_path):
                          secret_key=creds.secret_key,
                          host="127.0.0.1", port=srv.port, secure=False,
                          region="us-east-1")
-        assert gw.storage_info()["backend"] == "gateway-gcs"
+        assert gw.storage_info()["backend"] == "gateway-gcs-xml"
         gw.make_bucket("gcsb")
         gw.put_object("gcsb", "o", b"gcs data", opts=PutOptions())
         _i, stream = gw.get_object("gcsb", "o")
