@@ -88,13 +88,25 @@ class RestClient:
         return f"http://{self.host}:{self.port}{self.service_path}"
 
     def call(self, verb: str, args: Optional[dict] = None,
-             body: bytes = b"", stream_response: bool = False):
-        """POST the verb. Returns response bytes (or an HTTPResponse when
-        stream_response for large reads)."""
+             body: bytes = b"", stream_response: bool = False,
+             body_length: Optional[int] = None):
+        """POST the verb. Returns response bytes (or a streamed reader
+        when stream_response for large reads).
+
+        `body` may be bytes, OR an iterable/file-like streamed to the
+        wire in chunks with `body_length` as Content-Length — large
+        shard bodies (CreateFile, heal writes) never materialize on
+        the sending side (reference storage-rest streaming verbs)."""
         if not self._online:
             raise NetworkError(f"{self.host}:{self.port} is offline")
         qs = urllib.parse.urlencode(args or {})
         path = f"{self.service_path}/{verb}" + (f"?{qs}" if qs else "")
+        if isinstance(body, (bytes, bytearray, memoryview)):
+            length = len(body)
+        else:
+            assert body_length is not None, \
+                "streaming bodies need body_length"
+            length = body_length
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         try:
@@ -102,7 +114,7 @@ class RestClient:
                 "Authorization":
                     "Bearer " + make_token(self.access_key,
                                            self.secret_key),
-                "Content-Length": str(len(body)),
+                "Content-Length": str(length),
             })
             resp = conn.getresponse()
             if resp.status != 200:
@@ -192,9 +204,16 @@ class RPCHandler:
         self.prefix = prefix.rstrip("/")
         self.access_key, self.secret_key = access_key, secret_key
         self._verbs: dict[str, Callable] = {}
+        self._stream_verbs: set[str] = set()
 
-    def register(self, verb: str, fn: Callable) -> None:
+    def register(self, verb: str, fn: Callable,
+                 stream_body: bool = False) -> None:
+        """stream_body verbs receive the request-body READER instead of
+        bytes — big uploads (CreateFile) pass through to the drive
+        without staging in RAM."""
         self._verbs[verb] = fn
+        if stream_body:
+            self._stream_verbs.add(verb)
 
     def route(self, ctx) -> "HTTPResponse":
         from ..s3.handlers import HTTPResponse
@@ -212,7 +231,8 @@ class RPCHandler:
             return HTTPResponse(status=404, body=json.dumps(
                 {"kind": "unknown-verb", "message": verb}).encode())
         args = {k: v[0] for k, v in ctx.req.query.items()}
-        body = ctx.read_body()
+        body = ctx.body_stream if verb in self._stream_verbs \
+            else ctx.read_body()
         try:
             out = fn(args, body)
         except Exception as e:  # noqa: BLE001 — serialize to the caller
@@ -222,6 +242,24 @@ class RPCHandler:
             return HTTPResponse(body=b"")
         if isinstance(out, (bytes, bytearray)):
             return HTTPResponse(body=bytes(out))
+        if hasattr(out, "__next__") or hasattr(out, "read"):
+            # streamed response (big shard reads): chunked on the wire
+            if hasattr(out, "read"):
+                reader = out
+
+                def gen():
+                    try:
+                        while True:
+                            chunk = reader.read(1 << 20)
+                            if not chunk:
+                                return
+                            yield chunk
+                    finally:
+                        close = getattr(reader, "close", None)
+                        if close is not None:
+                            close()
+                out = gen()
+            return HTTPResponse(stream=out)
         return HTTPResponse(body=json.dumps(out).encode(),
                             headers={"Content-Type": "application/json"})
 
@@ -248,7 +286,7 @@ class RPCServer:
                 pass
 
             def _go(self):
-                import io as _io
+                from ..s3.server import _BodyReader
                 parsed = _up.urlsplit(self.path)
                 headers = {k.lower(): v for k, v in self.headers.items()}
                 req = sig.Request(
@@ -257,22 +295,47 @@ class RPCServer:
                                        keep_blank_values=True),
                     headers=headers, raw_query=parsed.query)
                 length = int(headers.get("content-length", 0) or 0)
-                # read the body eagerly: keeps the keep-alive socket clean
-                # no matter what the handler does with it
-                raw = self.rfile.read(length) if length else b""
-                ctx = RequestContext(req, _io.BytesIO(raw), length)
-                resp = None
-                for prefix, h in handlers:
-                    if parsed.path.startswith(prefix):
-                        resp = h.route(ctx)
-                        break
+                # lazy bounded reader: stream verbs (CreateFile) pass
+                # big bodies straight to the drive; drain() afterwards
+                # keeps the keep-alive socket clean either way
+                body_reader = _BodyReader(self.rfile, length)
+                ctx = RequestContext(req, body_reader, length)
+                try:
+                    resp = None
+                    for prefix, h in handlers:
+                        if parsed.path.startswith(prefix):
+                            resp = h.route(ctx)
+                            break
+                finally:
+                    body_reader.drain()
                 if resp is None:
                     from ..s3.handlers import HTTPResponse
                     resp = HTTPResponse(status=404, body=b"not found")
-                body = resp.body
                 self.send_response(resp.status)
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
+                if resp.stream is not None:
+                    # chunked streamed response (big shard reads)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        for chunk in resp.stream:
+                            if chunk:
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    except BrokenPipeError:
+                        self.close_connection = True
+                    finally:
+                        close = getattr(resp.stream, "close", None)
+                        if close is not None:
+                            try:
+                                close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                    return
+                body = resp.body
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if body:

@@ -1,0 +1,220 @@
+"""Streamed remote CreateFile/ReadFileStream (VERDICT r4 weak #5 /
+next-round #7): large shard bodies flow through the storage RPC in
+bounded chunks — no whole-shard staging on either end — with a
+subprocess RSS assertion for a big remote write+read."""
+
+from __future__ import annotations
+
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from minio_tpu.distributed.storage_rpc import (RemoteStorage,
+                                               StorageRPCServer)
+from minio_tpu.distributed.transport import RPCServer
+from minio_tpu.storage import errors as serr
+from minio_tpu.storage import new_format_erasure_v3
+from minio_tpu.storage.xl_storage import XLStorage
+
+AK, SK = "streamkey", "streamsecret123"
+
+
+@pytest.fixture()
+def node(tmp_path):
+    fmts = new_format_erasure_v3(1, 1)
+    drive = XLStorage(str(tmp_path / "d0"))
+    drive.write_format(fmts[0][0])
+    srv = StorageRPCServer({"/d0": drive}, AK, SK)
+    host = RPCServer().start()
+    host.mount(srv.handler)
+    remote = RemoteStorage("127.0.0.1", host.port, "/d0", AK, SK)
+    yield drive, remote, srv
+    remote.close()
+    host.stop()
+    drive.close()
+
+
+class ChunkTracker(io.RawIOBase):
+    """Reader that records how the client consumes it: a streaming
+    sender issues many bounded read(n) calls; a buffering one slurps
+    everything at once."""
+
+    def __init__(self, total: int, chunk: int = 64 << 10):
+        self.total = total
+        self.served = 0
+        self.max_read = 0
+        self.calls = 0
+
+    def read(self, n: int = -1) -> bytes:
+        self.calls += 1
+        if n is None or n < 0:
+            n = self.total - self.served
+        self.max_read = max(self.max_read, n)
+        n = min(n, self.total - self.served)
+        if n <= 0:
+            return b""
+        start = self.served
+        self.served += n
+        # deterministic but position-dependent content
+        return bytes(((start + i) * 31 + 7) & 0xFF for i in range(n))
+
+
+def _expected(total: int) -> bytes:
+    return bytes(((i) * 31 + 7) & 0xFF for i in range(total))
+
+
+def test_create_file_streams_in_bounded_chunks(node):
+    drive, remote, _srv = node
+    remote.make_vol("v")
+    total = 8 << 20
+    tracker = ChunkTracker(total)
+    remote.create_file("v", "big/shard.bin", total, tracker)
+    # the client pulled bounded chunks, never the whole body at once
+    assert tracker.max_read <= 1 << 20, tracker.max_read
+    assert tracker.calls >= total // (1 << 20)
+    # bytes landed intact on the serving drive
+    got = drive.read_file("v", "big/shard.bin", 0, total)
+    assert got == _expected(total)
+
+
+def test_read_file_stream_is_chunked_and_correct(node):
+    drive, remote, _srv = node
+    remote.make_vol("v")
+    payload = _expected(4 << 20)
+    drive.create_file("v", "r/shard.bin", len(payload),
+                      io.BytesIO(payload))
+    stream = remote.read_file_stream("v", "r/shard.bin", 0,
+                                     len(payload))
+    # file-like, incremental reads
+    first = stream.read(1000)
+    assert first == payload[:1000]
+    rest = b""
+    while True:
+        chunk = stream.read(1 << 20)
+        if not chunk:
+            break
+        rest += chunk
+    stream.close()
+    assert first + rest == payload
+    # ranged stream
+    stream = remote.read_file_stream("v", "r/shard.bin", 4096, 1 << 20)
+    got = b""
+    while True:
+        chunk = stream.read(1 << 18)
+        if not chunk:
+            break
+        got += chunk
+    stream.close()
+    assert got == payload[4096:4096 + (1 << 20)]
+
+
+def test_read_file_stream_falls_back_without_verb(node):
+    """Peers that predate the streaming verb still serve via the
+    buffered readfile path."""
+    _drive, remote, srv = node
+    remote.make_vol("v")
+    payload = _expected(1 << 16)
+    remote.create_file("v", "fb.bin", len(payload),
+                       io.BytesIO(payload))
+    del srv.handler._verbs["readfilestream"]
+    stream = remote.read_file_stream("v", "fb.bin", 0, len(payload))
+    assert stream.read(-1) == payload
+
+
+def test_short_body_surfaces_as_error(node):
+    drive, remote, _srv = node
+    remote.make_vol("v")
+
+    class Short(io.RawIOBase):
+        def read(self, n=-1):
+            return b""                    # claims 1 MiB, sends none
+
+    with pytest.raises(serr.StorageError):
+        remote.create_file("v", "short.bin", 1 << 20, Short())
+
+
+def test_missing_file_stream_error_maps(node):
+    _drive, remote, _srv = node
+    remote.make_vol("v")
+    with pytest.raises(serr.StorageError):
+        s = remote.read_file_stream("v", "ghost.bin", 0, 100)
+        s.read(100)
+
+
+_RSS_CHILD = r"""
+import io, os, resource, sys
+sys.path.insert(0, os.environ["REPO"])
+from minio_tpu.distributed.storage_rpc import (RemoteStorage,
+                                               StorageRPCServer)
+from minio_tpu.distributed.transport import RPCServer
+from minio_tpu.storage import new_format_erasure_v3
+from minio_tpu.storage.xl_storage import XLStorage
+
+root = os.environ["WORKDIR"]
+fmts = new_format_erasure_v3(1, 1)
+drive = XLStorage(os.path.join(root, "d0"))
+drive.write_format(fmts[0][0])
+host = RPCServer().start()
+host.mount(StorageRPCServer({"/d0": drive}, "k", "s" * 12).handler)
+remote = RemoteStorage("127.0.0.1", host.port, "/d0", "k", "s" * 12)
+remote.make_vol("v")
+
+TOTAL = 128 << 20
+
+class Zeros(io.RawIOBase):
+    def __init__(self):
+        self.left = TOTAL
+        self.blob = b"\xcd" * (1 << 20)
+    def read(self, n=-1):
+        if n is None or n < 0:
+            n = self.left
+        n = min(n, self.left, len(self.blob))
+        self.left -= n
+        return self.blob[:n]
+
+# warm-up: load every code path before measuring
+remote.create_file("v", "warm.bin", 1 << 20,
+                   io.BytesIO(b"w" * (1 << 20)))
+base_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+# remote heal-style write of a 128 MiB shard (client+server in THIS
+# process: the bound covers both ends)
+remote.create_file("v", "big.bin", TOTAL, Zeros())
+# and stream it back
+stream = remote.read_file_stream("v", "big.bin", 0, TOTAL)
+count = 0
+while True:
+    chunk = stream.read(1 << 20)
+    if not chunk:
+        break
+    count += len(chunk)
+stream.close()
+assert count == TOTAL, count
+rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print(f"rss_mb={rss_mb:.0f} base_mb={base_mb:.0f}")
+assert rss_mb - base_mb < 100, \
+    f"remote shard write/read grew RSS by {rss_mb - base_mb:.0f} MB"
+remote.close(); host.stop(); drive.close()
+"""
+
+
+def test_remote_big_shard_memory_bounded(tmp_path):
+    workdir = "/dev/shm/mt-rpc-stream-test" if \
+        os.path.isdir("/dev/shm") else str(tmp_path / "w")
+    os.makedirs(workdir, exist_ok=True)
+    env = dict(os.environ,
+               REPO=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))),
+               WORKDIR=workdir)
+    try:
+        proc = subprocess.run([sys.executable, "-c", _RSS_CHILD],
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "rss_mb=" in proc.stdout
+    finally:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
