@@ -70,13 +70,27 @@ _ERR_CLASSES = {name: cls for name, cls in vars(serr).items()
 
 def _to_storage_err(e: Exception) -> Exception:
     if isinstance(e, RPCError):
+        # the REMOTE answered: map its storage error by name — never a
+        # transport error, so is_online() stays untouched
         cls = _ERR_CLASSES.get(e.kind)
         if cls is not None:
             return cls(e.message)
         return serr.UnexpectedError(f"{e.kind}: {e.message}")
     if isinstance(e, NetworkError):
-        return serr.DiskNotFound(str(e))
+        # the WIRE broke (refused/reset/timeout/mid-stream): retryable,
+        # quorum-tolerated like a gone drive
+        return serr.NetworkStorageError(str(e))
     return e
+
+
+# Verbs safe to replay on a transport failure (pure reads / existence
+# probes — re-running them cannot double-apply a mutation). Everything
+# else fails fast and lets quorum logic treat the drive as gone.
+_IDEMPOTENT_VERBS = frozenset({
+    "diskinfo", "getdiskid", "listvols", "statvol", "readversion",
+    "readversions", "listdir", "readfile", "readall", "walk",
+    "checkfile", "checkparts", "verifyfile", "readfilestream",
+})
 
 
 # ---------------------------------------------------------------------------
@@ -240,6 +254,24 @@ class StorageRPCServer:
 # client — a remote drive as a StorageAPI
 # ---------------------------------------------------------------------------
 
+class _RemoteStream:
+    """Wraps a streamed RPC response so a mid-stream transport failure
+    raises the retryable NetworkStorageError instead of leaking raw
+    socket/NetworkError exceptions into shard-read plumbing."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def read(self, n: int = -1) -> bytes:
+        try:
+            return self._inner.read(n)
+        except NetworkError as e:
+            raise serr.NetworkStorageError(str(e)) from e
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class RemoteStorage(StorageAPI):
     """StorageAPI over the wire. `disk` names the remote drive (its
     endpoint path on the serving node)."""
@@ -258,7 +290,8 @@ class RemoteStorage(StorageAPI):
         a = {"disk": self.disk}
         a.update(args or {})
         try:
-            return self.rc.call(verb, a, body)
+            return self.rc.call(verb, a, body,
+                                idempotent=verb in _IDEMPOTENT_VERBS)
         except (RPCError, NetworkError) as e:
             raise _to_storage_err(e) from None
 
@@ -415,12 +448,15 @@ class RemoteStorage(StorageAPI):
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> BinaryIO:
         """Streamed shard read (chunked response); falls back to the
-        buffered verb against peers that predate it."""
+        buffered verb against peers that predate it. A mid-stream
+        disconnect surfaces as the retryable NetworkStorageError (NOT a
+        generic storage error) so hedged readers re-read elsewhere."""
         args = {"disk": self.disk, "volume": volume, "path": path,
                 "offset": str(offset), "length": str(length)}
         try:
-            return self.rc.call("readfilestream", args,
-                                stream_response=True)
+            return _RemoteStream(self.rc.call("readfilestream", args,
+                                              stream_response=True,
+                                              idempotent=True))
         except RPCError as e:
             if e.kind != "unknown-verb":
                 raise _to_storage_err(e) from None

@@ -5,22 +5,51 @@ The reference's cmd/rest/client.go: each RPC is
 JWT bearer derived from the cluster credentials. The client keeps a
 persistent connection pool, marks the host offline on network error and
 probes it back online in the background (cmd/rest/client.go:179-).
+
+Robustness semantics (the failure-plane PR):
+  * every call runs under a wall-clock deadline; idempotent verbs get
+    bounded, jittered, exponentially backed-off retries inside that
+    deadline before the host is declared offline;
+  * only TRUE transport failures (refused/reset/timeout/unreachable)
+    flip `online` — a remote that answered with an error payload
+    (RPCError) or sent a malformed response is alive;
+  * the offline health probe backs off exponentially (capped at
+    `MINIO_TPU_PROBE_BACKOFF_MAX`) instead of hammering a dead peer
+    once a second forever.
 """
 
 from __future__ import annotations
 
 import base64
+import errno as _errno
 import hashlib
 import hmac
 import http.client
 import json
+import os
+import random
+import socket
 import threading
 import time
 import urllib.parse
 from typing import Callable, Optional
 
+from ..utils import backoff_delay
+
 DEFAULT_TIMEOUT = 30.0
 HEALTH_PROBE_INTERVAL = 1.0
+HEALTH_PROBE_MAX = float(os.environ.get("MINIO_TPU_PROBE_BACKOFF_MAX",
+                                        "30"))
+# retries for idempotent verbs (attempts = retries + 1), inside the
+# per-call deadline
+RPC_RETRIES = int(os.environ.get("MINIO_TPU_RPC_RETRIES", "2"))
+RPC_RETRY_BACKOFF = float(os.environ.get("MINIO_TPU_RPC_RETRY_BACKOFF",
+                                         "0.05"))
+RPC_RETRY_BACKOFF_MAX = float(os.environ.get(
+    "MINIO_TPU_RPC_RETRY_BACKOFF_MAX", "2.0"))
+# tolerated clock skew between nodes on token expiry (internode auth
+# must not flap because two hosts' clocks drift a few seconds apart)
+TOKEN_CLOCK_SKEW = 30.0
 
 
 class RPCError(Exception):
@@ -33,7 +62,35 @@ class RPCError(Exception):
 
 
 class NetworkError(Exception):
-    """Transport-level failure — the peer may be down."""
+    """Transport-level failure — the peer may be down.
+
+    `conn_failure` distinguishes connection-level failures (refused,
+    reset, timeout, unreachable — the peer process is likely gone) from
+    protocol-level ones (malformed response, mid-stream disconnect —
+    the peer answered, so `online` must not flip)."""
+
+    def __init__(self, message: str = "", conn_failure: bool = False):
+        super().__init__(message)
+        self.conn_failure = conn_failure
+
+
+_CONN_ERRNOS = {_errno.ECONNREFUSED, _errno.ECONNRESET,
+                _errno.ECONNABORTED, _errno.EPIPE, _errno.ETIMEDOUT,
+                _errno.EHOSTUNREACH, _errno.ENETUNREACH,
+                _errno.EHOSTDOWN if hasattr(_errno, "EHOSTDOWN") else
+                _errno.EHOSTUNREACH}
+
+
+def _is_conn_failure(e: Exception) -> bool:
+    """True for failures that mean 'the peer is unreachable' rather than
+    'the peer misbehaved' — only these flip a host offline."""
+    if isinstance(e, (ConnectionError, socket.timeout, socket.gaierror,
+                      TimeoutError)):
+        return True
+    if isinstance(e, OSError) and e.errno in _CONN_ERRNOS:
+        return True
+    return isinstance(e, (http.client.NotConnected,
+                          http.client.ImproperConnectionState))
 
 
 # ---------------------------------------------------------------------------
@@ -55,7 +112,9 @@ def verify_token(token: str, access_key: str, secret_key: str) -> bool:
     try:
         decoded = base64.urlsafe_b64decode(token.encode()).decode()
         ak, expiry, mac = decoded.rsplit(":", 2)
-        expired = int(expiry) < time.time()
+        # tolerate small clock skew: a token minted by a slightly-slow
+        # peer clock must not flap internode auth at the expiry edge
+        expired = int(expiry) + TOKEN_CLOCK_SKEW < time.time()
     except (ValueError, UnicodeDecodeError):
         return False
     if ak != access_key or expired:
@@ -89,16 +148,62 @@ class RestClient:
 
     def call(self, verb: str, args: Optional[dict] = None,
              body: bytes = b"", stream_response: bool = False,
-             body_length: Optional[int] = None):
+             body_length: Optional[int] = None,
+             idempotent: bool = False,
+             deadline: Optional[float] = None):
         """POST the verb. Returns response bytes (or a streamed reader
         when stream_response for large reads).
 
         `body` may be bytes, OR an iterable/file-like streamed to the
         wire in chunks with `body_length` as Content-Length — large
         shard bodies (CreateFile, heal writes) never materialize on
-        the sending side (reference storage-rest streaming verbs)."""
+        the sending side (reference storage-rest streaming verbs).
+
+        `idempotent` verbs with a replayable (bytes) body retry bounded
+        times with jittered exponential backoff on transport failures;
+        `deadline` (default `timeout`) bounds when new attempts/backoffs
+        may START and caps each attempt's per-socket-op timeout — a peer
+        that keeps trickling bytes can still hold one attempt past it
+        (socket timeouts reset per recv). The host is marked offline
+        only when a connection-level failure survives the retries."""
         if not self._online:
-            raise NetworkError(f"{self.host}:{self.port} is offline")
+            raise NetworkError(f"{self.host}:{self.port} is offline",
+                               conn_failure=True)
+        end = time.monotonic() + (deadline if deadline is not None
+                                  else self.timeout)
+        attempts = 1
+        if idempotent and isinstance(body, (bytes, bytearray, memoryview)):
+            attempts += RPC_RETRIES
+        last: Optional[NetworkError] = None
+        for attempt in range(attempts):
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                return self._call_once(verb, args, body, stream_response,
+                                       body_length,
+                                       timeout=min(self.timeout,
+                                                   remaining))
+            except NetworkError as e:
+                last = e
+                if attempt + 1 >= attempts:
+                    break
+                backoff = backoff_delay(RPC_RETRY_BACKOFF,
+                                        RPC_RETRY_BACKOFF_MAX, attempt)
+                if time.monotonic() + backoff >= end:
+                    break
+                time.sleep(backoff)
+        if last is None:
+            last = NetworkError(
+                f"{self.host}:{self.port} {verb}: deadline exceeded",
+                conn_failure=True)
+        if last.conn_failure:
+            self.mark_offline()
+        raise last
+
+    def _call_once(self, verb: str, args: Optional[dict], body,
+                   stream_response: bool, body_length: Optional[int],
+                   timeout: float):
         qs = urllib.parse.urlencode(args or {})
         path = f"{self.service_path}/{verb}" + (f"?{qs}" if qs else "")
         if isinstance(body, (bytes, bytearray, memoryview)):
@@ -108,7 +213,7 @@ class RestClient:
                 "streaming bodies need body_length"
             length = body_length
         conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+                                          timeout=timeout)
         try:
             conn.request("POST", path, body=body, headers={
                 "Authorization":
@@ -135,8 +240,11 @@ class RestClient:
             return data
         except (OSError, http.client.HTTPException) as e:
             conn.close()
-            self.mark_offline()
-            raise NetworkError(str(e)) from e
+            # the peer answering garbage (BadStatusLine, short body) is
+            # NOT a dead peer: only connection-level failures may flip
+            # the host offline (decided by call() after retries)
+            raise NetworkError(str(e),
+                               conn_failure=_is_conn_failure(e)) from e
 
     def call_json(self, verb: str, args: Optional[dict] = None,
                   payload=None):
@@ -156,8 +264,13 @@ class RestClient:
             self._prober.start()
 
     def _probe_loop(self) -> None:
+        # exponential backoff (capped): a host that stays dead gets
+        # probed ever less often instead of a fixed 1 s hammer; the
+        # first probe still fires fast so a blip recovers quickly
+        delay = HEALTH_PROBE_INTERVAL
         while not self._online:
-            time.sleep(HEALTH_PROBE_INTERVAL)
+            time.sleep(delay * (0.75 + random.random() / 2))
+            delay = min(delay * 2, HEALTH_PROBE_MAX)
             try:
                 conn = http.client.HTTPConnection(self.host, self.port,
                                                   timeout=2.0)
@@ -181,7 +294,14 @@ class _StreamedResponse:
         self.resp = resp
 
     def read(self, n: int = -1) -> bytes:
-        return self.resp.read(n)
+        try:
+            return self.resp.read(n)
+        except (OSError, http.client.HTTPException) as e:
+            # a mid-stream disconnect is a RETRYABLE transport fault,
+            # not a generic storage error — hedged readers re-read from
+            # another drive; the peer is not declared offline for it
+            self._conn.close()
+            raise NetworkError(f"mid-stream: {e}") from e
 
     def close(self) -> None:
         self._conn.close()

@@ -100,6 +100,11 @@ class AdminClient:
     def heal_status(self, token: str) -> dict:
         return self._json("GET", "heal/status", {"token": token})
 
+    def mrf_status(self) -> dict:
+        """MRF heal-queue stats (pending/healed/requeued/failed/dropped;
+        zones nested for server-sets backends)."""
+        return self._json("GET", "mrf")
+
     # -- IAM ---------------------------------------------------------------
 
     def add_user(self, access_key: str, secret_key: str) -> None:

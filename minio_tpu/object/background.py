@@ -1,6 +1,13 @@
-"""Background plane: disk reconnect/new-disk heal + data-usage crawler.
+"""Background plane: MRF heal queue, disk reconnect/new-disk heal,
+data-usage crawler.
 
 The reference runs these from serverMain (cmd/server-main.go:487-493):
+  * the MRF ("most recently failed") heal queue
+    (cmd/background-heal-ops.go + maintainMRFList,
+    cmd/erasure-sets.go:1641): writes that succeeded at quorum but lost
+    some drives, and reads that had to reconstruct, enqueue the object
+    for an immediate background heal — degraded objects regain full
+    redundancy without waiting for the next scanner sweep.
   * monitorLocalDisksAndHeal (cmd/background-newdisks-heal-ops.go) +
     connectDisks/monitorAndConnectEndpoints (cmd/erasure-sets.go:200-281):
     dead drive slots are re-probed, returning drives re-admitted after a
@@ -16,33 +23,232 @@ The reference runs these from serverMain (cmd/server-main.go:487-493):
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import json
+import os
 import threading
 import time
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from ..storage import errors as serr
+from ..utils import backoff_delay
 from ..storage.format import read_format_from, write_format_to
 from ..storage.xl_storage import MINIO_META_BUCKET, XLStorage
 from . import api_errors
-from .sets import ErasureSets
+
+if TYPE_CHECKING:  # sets.py imports MRFHealer — avoid the cycle at runtime
+    from .sets import ErasureSets
 
 DATA_USAGE_OBJECT = "datausage/usage.json"
 
+# MRF knobs (documented in README "Fault model & self-healing"). The
+# retry window must OUTLAST the drive-recovery cadence (DiskMonitor
+# re-probes every 10 s, the transport health probe backs off to 30 s) —
+# with these defaults the schedule spans ~40 s before giving up, so a
+# drive blip heals through MRF instead of always falling to the scanner.
+MRF_QUEUE_SIZE = int(os.environ.get("MINIO_TPU_MRF_QUEUE_SIZE", "10000"))
+MRF_MAX_RETRIES = int(os.environ.get("MINIO_TPU_MRF_MAX_RETRIES", "10"))
+MRF_BACKOFF_BASE = float(os.environ.get("MINIO_TPU_MRF_BACKOFF_BASE",
+                                        "0.05"))
+MRF_BACKOFF_MAX = float(os.environ.get("MINIO_TPU_MRF_BACKOFF_MAX",
+                                       "15.0"))
 
-class DiskMonitor:
-    """Re-admit returning drives; format + sweep-heal fresh ones."""
 
-    def __init__(self, sets: ErasureSets, interval: float = 10.0):
-        self.sets = sets
-        self.interval = interval
+class MRFHealer:
+    """Bounded background heal queue with retry + exponential backoff.
+
+    Fed by the engine's degraded-read AND degraded-write hooks: an
+    object written (or read) with fewer than N healthy drives enqueues
+    `(bucket, object, version)` and a daemon drains entries through
+    `heal_fn` immediately — the reference's healMRFRoutine
+    (cmd/background-heal-ops.go) rather than waiting for the scanner.
+
+    * entries dedup on (bucket, object, version) while queued/in-flight;
+    * a failed heal requeues with capped exponential backoff up to
+      `max_retries`, then counts as `failed` (the scanner's sweep is the
+      backstop);
+    * the queue is bounded: overflow drops the entry (`dropped` stat) —
+      losing an MRF hint is safe, losing memory under a fault storm is
+      not.
+    """
+
+    def __init__(self, heal_fn: Callable[[str, str, str], object],
+                 maxsize: Optional[int] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_max: Optional[float] = None):
+        self.heal_fn = heal_fn
+        # None means "env default"; explicit zeros are honored
+        # (max_retries=0 = heal once, backoff_base=0 = retry instantly)
+        self.maxsize = MRF_QUEUE_SIZE if maxsize is None else maxsize
+        self.max_retries = (MRF_MAX_RETRIES if max_retries is None
+                            else max_retries)
+        self.backoff_base = (MRF_BACKOFF_BASE if backoff_base is None
+                             else backoff_base)
+        self.backoff_max = (MRF_BACKOFF_MAX if backoff_max is None
+                            else backoff_max)
+        self._cond = threading.Condition()
+        self._heap: list[tuple] = []   # (ready_at, seq, b, o, v, attempt)
+        self._seq = 0
+        # keys currently queued in the heap (dedup)
+        self._pending: set[tuple[str, str, str]] = set()
+        # keys whose heal is RUNNING -> re-arm flag: a hint arriving
+        # mid-heal (object re-degraded) requeues a fresh entry when the
+        # running heal finishes, instead of being silently dropped
+        self._inflight: dict[tuple[str, str, str], bool] = {}
+        self._closed = False
+        # stats (admin `mrf` endpoint / metrics)
+        self.queued = 0
+        self.healed = 0
+        self.requeued = 0
+        self.failed = 0
+        self.dropped = 0
+        self.skipped = 0               # object vanished before heal
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+
+    def enqueue(self, bucket: str, object_name: str,
+                version_id: str = "") -> bool:
+        key = (bucket, object_name, version_id)
+        with self._cond:
+            if self._closed or key in self._pending:
+                return False
+            if key in self._inflight:
+                # heal already running on possibly-stale state: re-arm
+                # so it requeues once finished (the hint is preserved)
+                self._inflight[key] = True
+                return True
+            return self._push(key, 0)
+
+    def _push(self, key: tuple, attempt: int,
+              delay: float = 0.0) -> bool:
+        """Queue (or requeue) an entry; caller holds the lock."""
+        if len(self._heap) >= self.maxsize:
+            self.dropped += 1
+            return False
+        self._pending.add(key)
+        self._seq += 1
+        heapq.heappush(self._heap, (time.monotonic() + delay, self._seq,
+                                    key[0], key[1], key[2], attempt))
+        if attempt == 0:
+            self.queued += 1
+        else:
+            self.requeued += 1
+        # notify_all: drain() waiters share this condition — waking only
+        # the FIFO-head waiter could wake a drainer instead of the
+        # consumer loop and leave the new entry sitting unprocessed
+        self._cond.notify_all()
+        return True
+
+    # -- consumer ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and (
+                        not self._heap
+                        or self._heap[0][0] > time.monotonic()):
+                    if not self._heap:
+                        self._cond.wait()     # idle: block until notify
+                    else:
+                        self._cond.wait(max(
+                            self._heap[0][0] - time.monotonic(), 0.001))
+                if self._closed:
+                    return
+                _, _, bucket, obj, vid, attempt = heapq.heappop(self._heap)
+                key = (bucket, obj, vid)
+                self._pending.discard(key)
+                self._inflight[key] = False
+            done = True
+            try:
+                res = self.heal_fn(bucket, obj, vid)
+                if getattr(res, "missing_after", 0):
+                    # partial heal: copies are STILL missing (a target
+                    # drive stayed offline) — retry, don't count healed
+                    done = self._retry(key, attempt)
+                else:
+                    with self._cond:
+                        self.healed += 1
+            except (api_errors.ObjectNotFound, api_errors.BucketNotFound,
+                    api_errors.VersionNotFound):
+                with self._cond:
+                    self.skipped += 1   # deleted since: converged
+            except Exception:  # noqa: BLE001 — background heal best-effort
+                done = self._retry(key, attempt)
+            finally:
+                with self._cond:
+                    rearm = self._inflight.pop(key, False)
+                    if done and rearm and not self._closed:
+                        # the object re-degraded while this heal ran:
+                        # fresh entry so the new damage is covered
+                        self._push(key, 0)
+                    self._cond.notify_all()
+
+    def _retry(self, key: tuple, attempt: int) -> bool:
+        """Requeue with backoff; True when the entry is finished
+        (retries exhausted)."""
+        attempt += 1
+        if attempt > self.max_retries:
+            with self._cond:
+                self.failed += 1
+            return True
+        backoff = backoff_delay(self.backoff_base, self.backoff_max,
+                                attempt - 1)
+        with self._cond:
+            if self._closed:
+                return True
+            return not self._push(key, attempt, delay=backoff)
+
+    # -- observability / lifecycle ----------------------------------------
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._heap) + len(self._inflight)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"pending": len(self._heap) + len(self._inflight),
+                    "queued": self.queued, "healed": self.healed,
+                    "requeued": self.requeued, "failed": self.failed,
+                    "dropped": self.dropped, "skipped": self.skipped}
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait for every queued entry to finish (healed, skipped, or
+        retries exhausted). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._heap or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    return not (self._heap or self._inflight)
+                self._cond.wait(remaining)
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class _ScanLoop:
+    """Shared lifecycle + failure bookkeeping of the background scan
+    loops: run scan_once() every `interval` seconds on a daemon thread,
+    counting failures instead of swallowing them silently — a wedged
+    background plane must be observable (`errors`, `consecutive_errors`,
+    `last_error`; exported as minio_*_consecutive_errors gauges)."""
+
+    interval: float
+
+    def _init_loop(self) -> None:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.healed_slots: list[tuple[int, int]] = []   # for tests/admin
+        self.errors = 0
+        self.consecutive_errors = 0
+        self.last_error = ""
 
-    # -- lifecycle ---------------------------------------------------------
-
-    def start(self) -> "DiskMonitor":
+    def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -50,12 +256,28 @@ class DiskMonitor:
     def close(self) -> None:
         self._stop.set()
 
+    def scan_once(self):  # pragma: no cover — subclasses implement
+        raise NotImplementedError
+
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
             try:
                 self.scan_once()
-            except Exception:  # noqa: BLE001 — keep monitoring
-                pass
+                self.consecutive_errors = 0
+            except Exception as e:  # noqa: BLE001 — keep scanning
+                self.errors += 1
+                self.consecutive_errors += 1
+                self.last_error = repr(e)
+
+
+class DiskMonitor(_ScanLoop):
+    """Re-admit returning drives; format + sweep-heal fresh ones."""
+
+    def __init__(self, sets: "ErasureSets", interval: float = 10.0):
+        self.sets = sets
+        self.interval = interval
+        self.healed_slots: list[tuple[int, int]] = []   # for tests/admin
+        self._init_loop()
 
     # -- one scan ----------------------------------------------------------
 
@@ -155,7 +377,7 @@ class DiskMonitor:
         return healed
 
 
-class HealScanner:
+class HealScanner(_ScanLoop):
     """Bloom-hinted background heal (the consumer that makes the
     data-update tracker load-bearing — reference data-update-tracker
     feeds the heal crawl the same way): each pass heals only objects
@@ -180,23 +402,7 @@ class HealScanner:
         self.healed = 0
         self.skipped_buckets = 0
         self.scanned = 0
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> "HealScanner":
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
-        return self
-
-    def close(self) -> None:
-        self._stop.set()
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
-            try:
-                self.scan_once()
-            except Exception:  # noqa: BLE001 — keep scanning
-                pass
+        self._init_loop()
 
     def scan_once(self) -> int:
         """One hinted heal pass; returns objects heal-checked."""
@@ -268,7 +474,7 @@ class HealScanner:
         return checked
 
 
-class DataUsageCrawler:
+class DataUsageCrawler(_ScanLoop):
     """Periodic bucket/object scan feeding usage accounting and
     per-object actions (lifecycle enforcement plugs in via `actions`)."""
 
@@ -286,23 +492,7 @@ class DataUsageCrawler:
         self.persist = persist
         self.usage: dict = {"buckets": {}, "objects_total": 0,
                             "size_total": 0, "last_update": 0.0}
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> "DataUsageCrawler":
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
-        return self
-
-    def close(self) -> None:
-        self._stop.set()
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
-            try:
-                self.scan_once()
-            except Exception:  # noqa: BLE001 — keep crawling
-                pass
+        self._init_loop()
 
     def scan_once(self) -> dict:
         buckets: dict[str, dict] = {}

@@ -89,6 +89,12 @@ class ErasureObjects:
         # or hit bitrot — the sets layer queues a heal (reference
         # deepHealObject trigger, cmd/erasure-object.go:298-303)
         self.on_degraded_read = None
+        # MRF hook: called (bucket, object, version_id) when a write
+        # (PUT / delete / metadata) met quorum but some drives failed —
+        # the degraded object regains full redundancy via the background
+        # heal queue instead of waiting for the next scanner sweep
+        # (reference maintainMRFList, cmd/erasure-sets.go:1641)
+        self.on_degraded_write = None
 
     # ------------------------------------------------------------------
     # helpers
@@ -264,11 +270,15 @@ class ErasureObjects:
             with stagetimer.stage("put.lock+commit"):
                 with self.ns.new_lock(
                         f"{bucket}/{object_name}").write_locked():
-                    self._commit(shuffled, writers, tmp_id, fi, bucket,
-                                 object_name, write_quorum)
+                    lost = self._commit(shuffled, writers, tmp_id, fi,
+                                        bucket, object_name, write_quorum)
         except Exception:
             self._cleanup_tmp(shuffled, tmp_id)
             raise
+        if lost:
+            # quorum met but some drives missed the write: queue an MRF
+            # heal so the object converges back to full redundancy
+            self._notify_degraded(bucket, object_name, fi.version_id)
         return fi.to_object_info(bucket, object_name)
 
     def _encode_stream(self, reader, codec: Codec, writers,
@@ -387,7 +397,10 @@ class ErasureObjects:
                 f"{live} live writers < quorum {write_quorum}")
 
     def _commit(self, shuffled, writers, tmp_id: str, fi: FileInfo,
-                bucket: str, object_name: str, write_quorum: int) -> None:
+                bucket: str, object_name: str, write_quorum: int) -> int:
+        """2-phase commit; returns how many drives MISSED the commit
+        (offline slot, dropped writer, or failed rename) — the MRF
+        degraded-write signal."""
         def close_writer(i, d):
             w = writers[i]
             if w is None:
@@ -424,6 +437,8 @@ class ErasureObjects:
             errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise api_errors.to_object_err(err, bucket, object_name)
+        return sum(1 for i in range(len(shuffled))
+                   if disks_for_meta[i] is None or errs[i] is not None)
 
     def _cleanup_tmp(self, disks, tmp_id: str) -> None:
         def rm(i, d):
@@ -501,6 +516,8 @@ class ErasureObjects:
             if err is not None:
                 raise api_errors.to_object_err(err, bucket, object_name)
             fi.metadata = new_meta
+        if any(e is not None for e in errs):
+            self._notify_degraded(bucket, object_name, version_id)
         return fi.to_object_info(bucket, object_name)
 
     def get_object_info(self, bucket: str, object_name: str,
@@ -937,6 +954,8 @@ class ErasureObjects:
                 if err is not None:
                     raise api_errors.to_object_err(err, bucket, object_name)
                 oi = fi.to_object_info(bucket, object_name)
+                self._flag_degraded_delete(bucket, object_name,
+                                           fi.version_id, errs)
                 return oi
 
             fi = FileInfo(volume=bucket, name=object_name,
@@ -952,8 +971,31 @@ class ErasureObjects:
                 errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
             if err is not None:
                 raise api_errors.to_object_err(err, bucket, object_name)
+        self._flag_degraded_delete(bucket, object_name, version_id, errs)
         return ObjectInfo(bucket=bucket, name=object_name,
                           version_id=version_id)
+
+    def _notify_degraded(self, bucket: str, object_name: str,
+                         version_id: str) -> None:
+        """Best-effort on_degraded_write invocation — the single home of
+        the guard+swallow all degraded write paths share."""
+        if self.on_degraded_write is None:
+            return
+        try:
+            self.on_degraded_write(bucket, object_name, version_id)
+        except Exception:  # noqa: BLE001 — heal queueing is best-effort
+            pass
+
+    def _flag_degraded_delete(self, bucket: str, object_name: str,
+                              version_id: str, errs) -> None:
+        """Queue an MRF heal when a quorum-successful delete/marker write
+        left stale state on some drive (drive gone or write failed). A
+        drive answering FileNotFound is already converged — absence is
+        the goal state of a delete."""
+        if any(e is not None
+               and not isinstance(e, serr.OBJECT_NOT_FOUND_ERRS)
+               for e in errs):
+            self._notify_degraded(bucket, object_name, version_id)
 
     def delete_objects(self, bucket: str, objects: list[str]
                        ) -> list[Optional[Exception]]:

@@ -111,9 +111,14 @@ class HealMixin(ErasureObjects):
                         res.disks_healed += 1
                     except serr.StorageError:
                         pass
-            res.missing_after = sum(
-                1 for i in missing
-                if self.disks[i] is None)
+                if res.disks_healed == 0:
+                    # nothing replicated: fail like the data path does
+                    # ('heal wrote no shards') so the MRF queue retries
+                    # instead of counting an offline drive as healed
+                    raise api_errors.HealFailed(
+                        f"{bucket}/{object_name}: "
+                        "heal wrote no delete markers")
+            res.missing_after = res.missing_before - res.disks_healed
             return res
 
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
@@ -148,8 +153,57 @@ class HealMixin(ErasureObjects):
                 f"heal: only {n_healthy} healthy shards < k={k}")
         to_heal = [i for i in range(len(shuffled))
                    if outdated[i] is not None]
-        if not to_heal or dry_run:
+
+        # metadata-only divergence: a drive that missed an in-place
+        # update_object_metadata (tags/user metadata) still matches on
+        # mod_time/data_dir, so the shard classification calls it
+        # healthy — converge its xl.meta to the metadata a STRICT
+        # majority of healthy copies agree on (quorum metadata writes
+        # always leave a majority; an ambiguous split heals nothing)
+        meta_stale: list[int] = []
+        want_meta: Optional[dict] = None
+        fingerprints = [tuple(sorted(smeta[i].metadata.items()))
+                        if healthy[i] is not None else None
+                        for i in range(len(shuffled))]
+        counts: dict[tuple, int] = {}
+        for fp in fingerprints:
+            if fp is not None:
+                counts[fp] = counts.get(fp, 0) + 1
+        if len(counts) > 1:
+            top = max(counts, key=counts.get)
+            if counts[top] > n_healthy // 2:
+                want_meta = dict(top)
+                meta_stale = [i for i in range(len(shuffled))
+                              if fingerprints[i] is not None
+                              and fingerprints[i] != top]
+                # fi's fingerprint ignores metadata, so the quorum pick
+                # may BE a stale copy — rebuilt drives must get the
+                # majority metadata, not the stale dict
+                fi.metadata = dict(want_meta)
+        res.missing_before += len(meta_stale)
+
+        if dry_run:
             res.missing_after = res.missing_before
+            return res
+
+        for i in meta_stale:
+            f = copy.deepcopy(smeta[i])
+            f.metadata = dict(want_meta)
+            try:
+                shuffled[i].write_metadata(bucket, object_name, f)
+                res.disks_healed += 1
+            except serr.StorageError:
+                pass
+
+        if not to_heal:
+            res.missing_after = res.missing_before - res.disks_healed
+            if res.missing_after > 0:
+                # copies missing on offline slots (or a stale-metadata
+                # write failed): nothing more repairable THIS attempt —
+                # fail so MRF retries instead of counting a no-op healed
+                raise api_errors.HealFailed(
+                    f"{bucket}/{object_name}: {res.missing_after} "
+                    "copies still missing, no healable drive online")
             return res
 
         tmp_id = str(_uuid.uuid4())
@@ -191,10 +245,11 @@ class HealMixin(ErasureObjects):
             # nothing was actually repaired: surface it so callers (MRF
             # queue, admin heal) retry instead of counting it healed —
             # the reference heals with write quorum 1, so zero successes
-            # is a failure (cmd/erasure-lowlevel-heal.go:28)
-            raise api_errors.to_object_err(
-                serr.DiskNotFound("heal wrote no shards"),
-                bucket, object_name)
+            # is a failure (cmd/erasure-lowlevel-heal.go:28). Raised as
+            # an ObjectApiError so per-object sweep handlers skip, not
+            # abort, the pass.
+            raise api_errors.HealFailed(
+                f"{bucket}/{object_name}: heal wrote no shards")
         res.missing_after = res.missing_before - res.disks_healed
         return res
 

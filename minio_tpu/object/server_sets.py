@@ -10,6 +10,7 @@ order; listings merge across zones.
 from __future__ import annotations
 
 import random
+import time
 from typing import Optional
 
 from ..storage.datatypes import ObjectInfo
@@ -264,6 +265,26 @@ class ErasureServerSets:
                 "online_disks": sum(z["online_disks"] for z in zones),
                 "offline_disks": sum(z["offline_disks"] for z in zones),
                 "zones": zones}
+
+    # ------------------------------------------------------------------
+    # MRF heal queue (per-zone queues, aggregated view)
+    # ------------------------------------------------------------------
+
+    def drain_mrf(self, timeout: float = 10.0) -> bool:
+        # one shared deadline: N wedged zones must not stack N timeouts
+        deadline = time.monotonic() + timeout
+        ok = True
+        for z in self.server_sets:
+            ok = z.drain_mrf(max(0.0, deadline - time.monotonic())) and ok
+        return ok
+
+    def mrf_stats(self) -> dict:
+        zones = [z.mrf_stats() for z in self.server_sets]
+        keys = ("pending", "queued", "healed", "requeued", "failed",
+                "dropped", "skipped")
+        out = {k: sum(z.get(k, 0) for z in zones) for k in keys}
+        out["zones"] = zones
+        return out
 
     def close(self) -> None:
         for z in self.server_sets:

@@ -14,8 +14,6 @@ the host control plane; each set's device batches stay independent.
 
 from __future__ import annotations
 
-import queue
-import threading
 import uuid as _uuid
 from typing import Optional
 
@@ -27,6 +25,7 @@ from ..storage.format import (DISTRIBUTION_ALGO_V2, DISTRIBUTION_ALGO_V3,
 from ..storage.xl_storage import XLStorage
 from ..utils.siphash import crc_hash_mod, sip_hash_mod
 from . import ErasureSetObjects, api_errors
+from .background import MRFHealer
 from .engine import GetOptions, PutOptions
 from .nslock import NSLockMap
 
@@ -38,7 +37,8 @@ class ErasureSets:
                  distribution_algo: str = DISTRIBUTION_ALGO_V3,
                  enable_mrf: bool = True,
                  format_ref: Optional[FormatErasureV3] = None,
-                 slot_sources: Optional[list] = None):
+                 slot_sources: Optional[list] = None,
+                 mrf_options: Optional[dict] = None):
         self.sets = sets
         self.deployment_id = deployment_id
         self.distribution_algo = distribution_algo
@@ -48,16 +48,16 @@ class ErasureSets:
         self.format_ref = format_ref
         self.slot_sources = slot_sources
         self._id16 = _uuid.UUID(deployment_id).bytes
-        self._mrf_queue: "queue.Queue[tuple[str, str]]" = queue.Queue(
-            maxsize=10000)
-        self._mrf_thread: Optional[threading.Thread] = None
         self._closed = False
+        self.mrf: Optional[MRFHealer] = None
         if enable_mrf:
+            self.mrf = MRFHealer(self._heal_mrf_entry, **(mrf_options or {}))
             for s in self.sets:
+                # degraded READS (reconstruction/bitrot) and degraded
+                # WRITES (quorum met but drives lost) both feed the MRF
+                # queue (reference maintainMRFList + healMRFRoutine)
                 s.on_degraded_read = self._queue_mrf_heal
-            self._mrf_thread = threading.Thread(
-                target=self._heal_mrf_routine, daemon=True)
-            self._mrf_thread.start()
+                s.on_degraded_write = self._queue_mrf_heal
 
     # ------------------------------------------------------------------
     # construction from drives (format bootstrap)
@@ -105,6 +105,7 @@ class ErasureSets:
         from ..storage.format import read_format_from, write_format_to
         assert len(drives) == set_count * set_drive_count
         enable_mrf = engine_kw.pop("enable_mrf", True)
+        mrf_options = engine_kw.pop("mrf_options", None)
         formats: list[Optional[FormatErasureV3]] = []
         for d in drives:
             if d is None:
@@ -187,7 +188,8 @@ class ErasureSets:
         fmt_ref = FormatErasureV3(id=deployment_id,
                                   sets=[list(s) for s in ref_sets])
         return cls(sets, deployment_id, enable_mrf=enable_mrf,
-                   format_ref=fmt_ref, slot_sources=slot_sources)
+                   format_ref=fmt_ref, slot_sources=slot_sources,
+                   mrf_options=mrf_options)
 
     # ------------------------------------------------------------------
     # routing
@@ -202,42 +204,34 @@ class ErasureSets:
         return self.sets[self.get_hashed_set_index(object_name)]
 
     # ------------------------------------------------------------------
-    # MRF heal queue (cmd/erasure-sets.go:1641-1711)
+    # MRF heal queue (cmd/erasure-sets.go:1641-1711 + background-heal-ops)
     # ------------------------------------------------------------------
 
-    def _queue_mrf_heal(self, bucket: str, object_name: str) -> None:
-        try:
-            self._mrf_queue.put_nowait((bucket, object_name))
-        except queue.Full:
-            pass
+    def _queue_mrf_heal(self, bucket: str, object_name: str,
+                        version_id: str = "") -> None:
+        if self.mrf is not None:
+            self.mrf.enqueue(bucket, object_name, version_id)
 
-    def _heal_mrf_routine(self) -> None:
-        while not self._closed:
-            try:
-                bucket, obj = self._mrf_queue.get(timeout=0.5)
-            except queue.Empty:
-                continue
-            try:
-                self.get_hashed_set(obj).heal_object(bucket, obj)
-            except Exception:  # noqa: BLE001 — background heal best-effort
-                pass
-            finally:
-                self._mrf_queue.task_done()
+    def _heal_mrf_entry(self, bucket: str, object_name: str,
+                        version_id: str = ""):
+        # the HealResultItem must flow back: MRFHealer retries while
+        # result.missing_after > 0 (partial heal, a drive still gone)
+        return self.get_hashed_set(object_name).heal_object(
+            bucket, object_name, version_id)
 
-    def drain_mrf(self, timeout: float = 10.0) -> None:
+    def drain_mrf(self, timeout: float = 10.0) -> bool:
         """Wait for queued MRF heals to COMPLETE (not just dequeue)."""
-        import threading as _t
-        done = _t.Event()
+        if self.mrf is None:
+            return True
+        return self.mrf.drain(timeout)
 
-        def waiter():
-            self._mrf_queue.join()
-            done.set()
-
-        _t.Thread(target=waiter, daemon=True).start()
-        done.wait(timeout)
+    def mrf_stats(self) -> dict:
+        return self.mrf.stats() if self.mrf is not None else {}
 
     def close(self) -> None:
         self._closed = True
+        if self.mrf is not None:
+            self.mrf.close()
 
     # ------------------------------------------------------------------
     # bucket ops (fan out to every set)

@@ -232,6 +232,13 @@ class AdminHandlers:
             if seq is None:
                 raise S3Error("AdminInvalidArgument", "unknown heal token")
             return self._json(seq.to_dict())
+        if sub == "mrf" and m == "GET":
+            # MRF ("most recently failed") heal-queue stats: pending /
+            # healed / requeued / failed / dropped per backend that has
+            # a queue (erasure sets and zones; FS/gateway report {})
+            self._auth(ctx, "admin:Heal")
+            fn = getattr(self.api.obj, "mrf_stats", None)
+            return self._json(fn() if callable(fn) else {})
 
         # -- config KV (cmd/admin-handlers-config-kv.go) -------------------
         if sub == "get-config" and m == "GET":
@@ -611,6 +618,31 @@ class MetricsHandler:
                   self.api.replication.replicated, "Replicated ops")
             gauge("minio_replication_failed_total",
                   self.api.replication.failed, "Failed replication ops")
+        # MRF heal queue (degraded reads/writes awaiting re-redundancy)
+        mrf_fn = getattr(self.api.obj, "mrf_stats", None)
+        if callable(mrf_fn):
+            try:
+                mrf = mrf_fn()
+            except Exception:  # noqa: BLE001
+                mrf = {}
+            gauge("minio_heal_mrf_pending", mrf.get("pending", 0),
+                  "Objects queued for MRF heal")
+            gauge("minio_heal_mrf_healed_total", mrf.get("healed", 0),
+                  "Objects healed via MRF")
+            gauge("minio_heal_mrf_failed_total", mrf.get("failed", 0),
+                  "MRF heals that exhausted retries")
+            gauge("minio_heal_mrf_dropped_total", mrf.get("dropped", 0),
+                  "MRF enqueues dropped (queue full)")
+        # background plane liveness: consecutive scan failures per loop
+        if self.node is not None:
+            for attr, name in (("disk_monitor", "disk_monitor"),
+                               ("heal_scanner", "heal_scanner"),
+                               ("crawler", "crawler")):
+                loop = getattr(self.node, attr, None)
+                if loop is not None:
+                    gauge(f"minio_{name}_consecutive_errors",
+                          getattr(loop, "consecutive_errors", 0),
+                          f"Consecutive failed {name} scans")
         return HTTPResponse(body=("\n".join(lines) + "\n").encode(),
                             headers={"Content-Type": "text/plain"})
 

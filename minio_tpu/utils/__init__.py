@@ -8,3 +8,12 @@ def host_port(addr: str, default_port: int) -> tuple[str, int]:
         return host, int(port)
     except ValueError:
         return addr, default_port
+
+
+def backoff_delay(base: float, cap: float, attempt: int) -> float:
+    """Capped exponential backoff with half-jitter: attempt 0 -> ~base,
+    doubling per attempt up to `cap`, scaled by a uniform factor in
+    [0.5, 1.0) so synchronized retriers de-correlate (the single home
+    of the retry-delay formula: RPC retries, MRF heal requeues)."""
+    import random
+    return min(cap, base * (2 ** attempt)) * (0.5 + random.random() / 2)

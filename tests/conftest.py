@@ -38,3 +38,14 @@ def pytest_configure(config):
         "markers",
         "native: exercises the C++ library under ASan/UBSan "
         "(make -C native sanitize; run with `pytest -m native`)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests driven by a seeded NaughtyDisk "
+        "schedule; cheap seeded subset runs in tier-1, long randomized "
+        "schedules are additionally marked slow. Reproduce any failure "
+        "with MINIO_TPU_CHAOS_SEED=<seed printed in the failing test's "
+        "captured stdout>")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 "
+        "(-m 'not slow'); run with `pytest -m slow`")

@@ -117,6 +117,19 @@ def test_admin_info_and_storage(client):
     assert st == 200 and json.loads(body)["online_disks"] == 4
 
 
+def test_admin_mrf_stats(client, server):
+    """MRF heal-queue stats over the admin API + madmin SDK."""
+    st, body = client.request("GET", "/minio/admin/v3/mrf")
+    assert st == 200
+    stats = json.loads(body)
+    for key in ("pending", "queued", "healed", "failed", "dropped"):
+        assert key in stats
+    from minio_tpu.madmin import AdminClient
+    mc = AdminClient("127.0.0.1", server.port, CREDS.access_key,
+                     CREDS.secret_key)
+    assert mc.mrf_status()["pending"] == stats["pending"]
+
+
 def test_admin_iam_flow(client, server):
     st, _ = client.request("PUT", "/minio/admin/v3/add-user",
                            query={"accessKey": "adminmadeuser"},

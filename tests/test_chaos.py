@@ -1,0 +1,245 @@
+"""Chaos tests: seeded NaughtyDisk schedules drive PUT/GET/heal/MRF
+through drive faults (errors, bitrot flips, truncated streams, short
+writes, offline windows) on <= parity drives.
+
+Invariants (the acceptance bar of the failure-plane PR):
+  * every op against the quorum-healthy set succeeds,
+  * every object reads back byte-identical,
+  * after MRF drain + a deep-scan heal pass, every shard verifies clean
+    on every drive and the MRF queue is empty.
+
+Every test prints its fault-schedule seed; a failing run reproduces
+exactly via MINIO_TPU_CHAOS_SEED=<seed>. The cheap seeded subset runs
+in tier-1; the long randomized schedules are additionally marked slow.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage import XLStorage, errors as serr
+from minio_tpu.storage.naughty import FaultSchedule, NaughtyDisk
+
+pytestmark = pytest.mark.chaos
+
+K, M = 4, 2
+NDISKS = K + M
+BLOCK = 1 << 16
+
+# fast-converging MRF for tests: tight backoff, generous retries
+MRF_TEST_OPTIONS = dict(max_retries=10, backoff_base=0.02,
+                        backoff_max=0.25)
+
+
+def chaos_seed(default: int) -> int:
+    return int(os.environ.get("MINIO_TPU_CHAOS_SEED", "0") or 0) or default
+
+
+def announce(seed: int) -> None:
+    # pytest shows captured stdout on failure: the seed reproduces the
+    # exact fault schedule (MINIO_TPU_CHAOS_SEED=<seed>)
+    print(f"fault-schedule seed={seed} "
+          f"(MINIO_TPU_CHAOS_SEED={seed} reproduces)")
+
+
+def payload(size: int, seed: int = 7) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def make_chaos_sets(tmp_path, schedules: dict,
+                    n: int = NDISKS, parity: int = M
+                    ) -> tuple[ErasureSets, list[NaughtyDisk]]:
+    """1 set x n drives; drives named in `schedules` get a (disarmed)
+    NaughtyDisk wrapper — arm after the fixture is built."""
+    drives: list = []
+    naughty: list[NaughtyDisk] = []
+    for j in range(n):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        sched = schedules.get(j)
+        if sched is not None:
+            nd = NaughtyDisk(d, schedule=sched, enabled=False)
+            naughty.append(nd)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=n, parity=parity,
+        block_size=BLOCK, mrf_options=dict(MRF_TEST_OPTIONS))
+    sets.make_bucket("b")
+    return sets, naughty
+
+
+def run_workload(sets: ErasureSets, n_threads: int = 3,
+                 n_objects: int = 4, seed: int = 0) -> dict[str, bytes]:
+    """Concurrent PUT + immediate GET verify; returns {name: data}."""
+    datas: dict[str, bytes] = {}
+    failures: list = []
+
+    def worker(t: int) -> None:
+        for i in range(n_objects):
+            name = f"o-{t}-{i}"
+            size = (i % 3) * BLOCK + 1000 * (t + 1) + i * 37
+            data = payload(size, seed=seed * 1000 + t * 100 + i)
+            try:
+                sets.put_object("b", name, data)
+                _, it = sets.get_object("b", name)
+                got = b"".join(it)
+                if got != data:
+                    failures.append((name, "byte mismatch"))
+                datas[name] = data
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                failures.append((name, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not failures, failures
+    return datas
+
+
+def assert_converged(sets: ErasureSets, datas: dict[str, bytes],
+                     drain_timeout: float = 30.0) -> None:
+    """MRF drain + deep-scan heal, then: queue empty, bytes identical,
+    every shard verifies clean on every drive."""
+    assert sets.drain_mrf(drain_timeout)
+    for name in datas:
+        sets.heal_object("b", name, deep_scan=True)
+    assert sets.drain_mrf(drain_timeout)
+    assert sets.mrf_stats()["pending"] == 0
+    eng = sets.sets[0]
+    for name, data in datas.items():
+        _, it = sets.get_object("b", name)
+        assert b"".join(it) == data, name
+        for j, d in enumerate(eng.disks):
+            fi = d.read_version("b", name)
+            d.check_parts("b", name, fi)
+            d.verify_file("b", name, fi)
+
+
+# ---------------------------------------------------------------------------
+# cheap seeded subset (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_chaos_flaky_verbs_converge(tmp_path):
+    """Random verb errors + read-bitrot on <= parity drives: no op may
+    fail, bytes stay identical, MRF + heal converge every shard."""
+    seed = chaos_seed(1101)
+    announce(seed)
+    sched = {j: FaultSchedule(seed=seed + j, error_rate=0.2,
+                              bitrot_rate=0.15)
+             for j in range(M)}
+    sets, naughty = make_chaos_sets(tmp_path, sched)
+    try:
+        for nd in naughty:
+            nd.arm()
+        datas = run_workload(sets, seed=seed)
+        for nd in naughty:
+            nd.disarm()
+        assert_converged(sets, datas)
+    finally:
+        sets.close()
+
+
+def test_chaos_truncated_streams_and_short_writes(tmp_path):
+    """Truncated read streams (mid-stream disconnects) on one drive and
+    silent short writes on another stay invisible to clients and heal
+    clean."""
+    seed = chaos_seed(2202)
+    announce(seed)
+    sched = {0: FaultSchedule(seed=seed, truncate_rate=0.4),
+             1: FaultSchedule(seed=seed + 1, truncate_rate=0.3,
+                              bitrot_rate=0.2)}
+    sets, naughty = make_chaos_sets(tmp_path, sched)
+    try:
+        for nd in naughty:
+            nd.arm()
+        datas = run_workload(sets, seed=seed)
+        for nd in naughty:
+            nd.disarm()
+        assert_converged(sets, datas)
+    finally:
+        sets.close()
+
+
+def test_chaos_offline_window_comes_back(tmp_path):
+    """A drive that goes offline mid-workload and comes back: writes
+    succeed at quorum during the outage; the drive converges after."""
+    seed = chaos_seed(3303)
+    announce(seed)
+    sched = {2: FaultSchedule(seed=seed, offline_windows=((5, 60),))}
+    sets, naughty = make_chaos_sets(tmp_path, sched)
+    try:
+        for nd in naughty:
+            nd.arm()
+        datas = run_workload(sets, seed=seed)
+        assert naughty[0].stats.offline_hits > 0
+        for nd in naughty:
+            nd.disarm()
+        assert_converged(sets, datas)
+    finally:
+        sets.close()
+
+
+def test_chaos_schedule_is_deterministic():
+    """Identical seeds replay identical fault decisions; a different
+    seed diverges — the reproduce-from-printed-seed guarantee."""
+    a = FaultSchedule(seed=42, error_rate=0.3, bitrot_rate=0.3,
+                      truncate_rate=0.3, latency_rate=0.3)
+    b = FaultSchedule(seed=42, error_rate=0.3, bitrot_rate=0.3,
+                      truncate_rate=0.3, latency_rate=0.3)
+    c = FaultSchedule(seed=43, error_rate=0.3, bitrot_rate=0.3,
+                      truncate_rate=0.3, latency_rate=0.3)
+
+    def trace(s):
+        return [(s.error_for("read_file", n) is not None,
+                 s.corrupts("read_file", n), s.truncates("read_file", n),
+                 s.latency_for("append_file", n) > 0)
+                for n in range(200)]
+
+    assert trace(a) == trace(b)
+    assert trace(a) != trace(c)
+    # the fault mix is actually exercised at these rates
+    hits = trace(a)
+    assert any(h[0] for h in hits) and any(h[1] for h in hits)
+    assert any(h[2] for h in hits) and any(h[3] for h in hits)
+
+
+# ---------------------------------------------------------------------------
+# long randomized schedules (nightly)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("base_seed", [101, 202, 303])
+def test_chaos_randomized_full_mix(tmp_path, base_seed):
+    """Everything at once on parity-many drives: verb errors, latency,
+    bitrot, truncation, and an offline window — larger workload, full
+    convergence."""
+    seed = chaos_seed(base_seed)
+    announce(seed)
+    sched = {
+        0: FaultSchedule(seed=seed, error_rate=0.25, latency_rate=0.1,
+                         latency=0.001, bitrot_rate=0.2,
+                         truncate_rate=0.15),
+        1: FaultSchedule(seed=seed + 7, error_rate=0.15,
+                         bitrot_rate=0.15, truncate_rate=0.1,
+                         offline_windows=((30, 120), (220, 260))),
+    }
+    sets, naughty = make_chaos_sets(tmp_path, sched)
+    try:
+        for nd in naughty:
+            nd.arm()
+        datas = run_workload(sets, n_threads=4, n_objects=8, seed=seed)
+        for nd in naughty:
+            nd.disarm()
+        assert_converged(sets, datas, drain_timeout=60.0)
+    finally:
+        sets.close()

@@ -34,8 +34,11 @@ def test_token_roundtrip():
     assert verify_token(tok, AK, SK)
     assert not verify_token(tok, AK, "wrong")
     assert not verify_token(tok, "other", SK)
-    old = make_token(AK, SK, ttl=-10)
-    assert not verify_token(old, AK, SK)
+    # expired within the tolerated clock skew: still valid (internode
+    # auth must not flap between hosts with drifting clocks)
+    assert verify_token(make_token(AK, SK, ttl=-10), AK, SK)
+    # expired beyond the skew window: rejected
+    assert not verify_token(make_token(AK, SK, ttl=-60), AK, SK)
 
 
 def test_local_locker_semantics():

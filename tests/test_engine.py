@@ -13,92 +13,11 @@ import pytest
 from minio_tpu.object import (CompletePart, ErasureSetObjects, GetOptions,
                               PutOptions, api_errors)
 from minio_tpu.storage import XLStorage, errors as serr, new_format_erasure_v3
-from minio_tpu.storage.api import StorageAPI
+from minio_tpu.storage.naughty import NaughtyDisk
 
 K, M = 4, 2  # small set: fast tests, same code paths as 12+4
 NDISKS = K + M
 BLOCK = 1 << 16  # 64 KiB blocks keep fixtures fast
-
-
-class NaughtyDisk(StorageAPI):
-    """Programmable fault-injection wrapper (reference naughtyDisk,
-    cmd/naughty-disk_test.go): fails specific verbs with a given error."""
-
-    def __init__(self, inner: StorageAPI):
-        self.inner = inner
-        self.fail_verbs: dict[str, Exception] = {}
-        self.offline = False
-
-    def __getattr__(self, name):
-        if name in ("inner", "fail_verbs", "offline"):
-            raise AttributeError(name)
-        attr = getattr(self.inner, name)
-        if not callable(attr):
-            return attr
-
-        def wrapper(*a, **kw):
-            if self.offline:
-                raise serr.DiskNotFound("naughty: offline")
-            if name in self.fail_verbs:
-                raise self.fail_verbs[name]
-            return attr(*a, **kw)
-
-        return wrapper
-
-    def __str__(self):
-        return f"naughty({self.inner})"
-
-    # abstract-method passthroughs the metaclass requires
-    def is_online(self): return not self.offline
-    def is_local(self): return True
-    def endpoint(self): return self.inner.endpoint()
-    def close(self): return None
-    def get_disk_id(self): return self.inner.get_disk_id()
-    def set_disk_id(self, i): return None
-    def disk_info(self): return self.inner.disk_info()
-    def make_vol(self, v): return self.__getattr__("make_vol")(v)
-    def list_vols(self): return self.__getattr__("list_vols")()
-    def stat_vol(self, v): return self.__getattr__("stat_vol")(v)
-    def delete_vol(self, v, force=False):
-        return self.__getattr__("delete_vol")(v, force)
-    def write_metadata(self, v, p, fi):
-        return self.__getattr__("write_metadata")(v, p, fi)
-    def read_version(self, v, p, vid=""):
-        return self.__getattr__("read_version")(v, p, vid)
-    def read_versions(self, v, p):
-        return self.__getattr__("read_versions")(v, p)
-    def delete_version(self, v, p, fi):
-        return self.__getattr__("delete_version")(v, p, fi)
-    def rename_data(self, sv, sp, dd, dv, dp):
-        return self.__getattr__("rename_data")(sv, sp, dd, dv, dp)
-    def list_dir(self, v, p, count=-1):
-        return self.__getattr__("list_dir")(v, p, count)
-    def read_file(self, v, p, o, l, verifier=None):
-        return self.__getattr__("read_file")(v, p, o, l, verifier)
-    def append_file(self, v, p, b):
-        return self.__getattr__("append_file")(v, p, b)
-    def create_file(self, v, p, s, r):
-        return self.__getattr__("create_file")(v, p, s, r)
-    def read_file_stream(self, v, p, o, l):
-        return self.__getattr__("read_file_stream")(v, p, o, l)
-    def rename_file(self, sv, sp, dv, dp):
-        return self.__getattr__("rename_file")(sv, sp, dv, dp)
-    def check_parts(self, v, p, fi):
-        return self.__getattr__("check_parts")(v, p, fi)
-    def check_file(self, v, p):
-        return self.__getattr__("check_file")(v, p)
-    def delete_file(self, v, p, recursive=False):
-        return self.__getattr__("delete_file")(v, p, recursive)
-    def verify_file(self, v, p, fi):
-        return self.__getattr__("verify_file")(v, p, fi)
-    def write_all(self, v, p, d):
-        return self.__getattr__("write_all")(v, p, d)
-    def read_all(self, v, p):
-        return self.__getattr__("read_all")(v, p)
-    def walk(self, v, d="", m="", recursive=True):
-        if self.offline:
-            raise serr.DiskNotFound("naughty: offline")
-        return self.inner.walk(v, d, m, recursive)
 
 
 def make_engine(tmp_path, n=NDISKS, k=K, m=M, naughty=False):

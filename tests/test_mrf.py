@@ -1,0 +1,458 @@
+"""MRF heal-queue unit tests: retry/backoff/dedup/bounds on MRFHealer,
+the engine's degraded-write hooks, and the background-plane error
+counters (reference background-heal-ops.go + maintainMRFList intents)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.object import ErasureSetObjects, api_errors
+from minio_tpu.object.background import DiskMonitor, MRFHealer
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage import XLStorage, errors as serr, new_format_erasure_v3
+from minio_tpu.storage.naughty import NaughtyDisk
+
+K, M = 4, 2
+NDISKS = K + M
+BLOCK = 1 << 16
+
+
+# ---------------------------------------------------------------------------
+# MRFHealer
+# ---------------------------------------------------------------------------
+
+def _healer(fn, **kw):
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_max", 0.05)
+    return MRFHealer(fn, **kw)
+
+
+def test_mrf_heals_and_drains():
+    healed = []
+    h = _healer(lambda b, o, v: healed.append((b, o, v)))
+    assert h.enqueue("b", "o1")
+    assert h.enqueue("b", "o2", "vid")
+    assert h.drain(5.0)
+    assert ("b", "o1", "") in healed and ("b", "o2", "vid") in healed
+    s = h.stats()
+    assert s["healed"] == 2 and s["pending"] == 0 and s["failed"] == 0
+    h.close()
+
+
+def test_mrf_retries_with_backoff_then_succeeds():
+    attempts = []
+
+    def flaky(b, o, v):
+        attempts.append(time.monotonic())
+        if len(attempts) < 3:
+            raise api_errors.InsufficientReadQuorum("not yet")
+
+    h = _healer(flaky)
+    h.enqueue("b", "o")
+    assert h.drain(5.0)
+    s = h.stats()
+    assert len(attempts) == 3
+    assert s["healed"] == 1 and s["requeued"] == 2 and s["failed"] == 0
+    # exponential: the second gap is at least as long as scheduled base
+    assert attempts[1] - attempts[0] >= 0.004
+    h.close()
+
+
+def test_mrf_gives_up_after_max_retries():
+    n = [0]
+
+    def hopeless(b, o, v):
+        n[0] += 1
+        raise api_errors.InsufficientReadQuorum("never")
+
+    h = _healer(hopeless, max_retries=2)
+    h.enqueue("b", "o")
+    assert h.drain(5.0)
+    s = h.stats()
+    assert n[0] == 3                      # first try + 2 retries
+    assert s["failed"] == 1 and s["healed"] == 0 and s["pending"] == 0
+    h.close()
+
+
+def test_mrf_vanished_object_counts_skipped():
+    h = _healer(lambda b, o, v: (_ for _ in ()).throw(
+        api_errors.ObjectNotFound(b, o)))
+    h.enqueue("b", "gone")
+    assert h.drain(5.0)
+    s = h.stats()
+    assert s["skipped"] == 1 and s["failed"] == 0
+    h.close()
+
+
+def test_mrf_dedups_queued_and_rearms_inflight():
+    gate = threading.Event()
+    healed = []
+
+    def slow(b, o, v):
+        if o == "blocker":
+            gate.wait(5.0)
+        healed.append((b, o, v))
+
+    h = _healer(slow)
+    assert h.enqueue("b", "blocker")
+    time.sleep(0.05)                       # blocker moves in-flight
+    assert h.enqueue("b", "o")             # queued behind it
+    assert not h.enqueue("b", "o")         # duplicate while QUEUED: drop
+    assert h.enqueue("b", "o", "v2")       # distinct version: kept
+    # a hint for an object whose heal is RUNNING is re-armed, not lost:
+    # the heal re-runs once the current one finishes
+    assert h.enqueue("b", "blocker")
+    gate.set()
+    assert h.drain(5.0)
+    assert healed.count(("b", "o", "")) == 1
+    assert healed.count(("b", "o", "v2")) == 1
+    assert healed.count(("b", "blocker", "")) == 2
+    h.close()
+
+
+def test_mrf_partial_heal_retries_until_converged():
+    """A heal that repaired something but left copies missing (target
+    drive still offline) must NOT count healed — it retries until
+    missing_after reaches 0."""
+    from minio_tpu.object.healing import HealResultItem
+    calls = []
+
+    def partial(b, o, v):
+        calls.append(1)
+        return HealResultItem(disks_healed=1,
+                              missing_after=0 if len(calls) >= 3 else 1)
+
+    h = _healer(partial)
+    h.enqueue("b", "o")
+    assert h.drain(5.0)
+    s = h.stats()
+    assert len(calls) == 3
+    assert s["healed"] == 1 and s["requeued"] == 2 and s["failed"] == 0
+    h.close()
+
+
+def test_mrf_bounded_queue_drops_overflow():
+    gate = threading.Event()
+    h = _healer(lambda b, o, v: gate.wait(5.0), maxsize=2)
+    h.enqueue("b", "o1")
+    time.sleep(0.05)          # let o1 move in-flight
+    h.enqueue("b", "o2")
+    h.enqueue("b", "o3")
+    assert not h.enqueue("b", "o4")       # over maxsize: dropped
+    assert h.stats()["dropped"] == 1
+    gate.set()
+    assert h.drain(5.0)
+    h.close()
+
+
+def test_mrf_close_stops_the_drain_thread():
+    h = _healer(lambda b, o, v: None)
+    h.close()
+    assert not h.enqueue("b", "o")        # closed: enqueue refused
+
+
+# ---------------------------------------------------------------------------
+# engine degraded-write hooks
+# ---------------------------------------------------------------------------
+
+def make_engine(tmp_path, naughty_first=1):
+    fmts = new_format_erasure_v3(1, NDISKS)
+    disks = []
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        d.write_format(fmts[0][j])
+        disks.append(NaughtyDisk(d) if j < naughty_first else d)
+    e = ErasureSetObjects(disks, K, M, block_size=BLOCK)
+    e.make_bucket("b")
+    return e
+
+
+def test_put_at_quorum_fires_degraded_write_hook(tmp_path):
+    eng = make_engine(tmp_path)
+    calls = []
+    eng.on_degraded_write = lambda b, o, v: calls.append((b, o, v))
+    eng.put_object("b", "clean", b"x" * 1000)
+    assert calls == []                     # full-redundancy write: quiet
+    eng.disks[0].fail_verbs["append_file"] = serr.FaultyDisk("boom")
+    eng.put_object("b", "deg", b"y" * 1000)
+    assert calls == [("b", "deg", "")]
+
+
+def test_versioned_degraded_put_reports_version(tmp_path):
+    from minio_tpu.object import PutOptions
+    eng = make_engine(tmp_path)
+    calls = []
+    eng.on_degraded_write = lambda b, o, v: calls.append((b, o, v))
+    eng.disks[0].offline = True
+    oi = eng.put_object("b", "v", b"z" * 100,
+                        opts=PutOptions(versioned=True))
+    assert calls == [("b", "v", oi.version_id)]
+
+
+def test_degraded_delete_fires_hook(tmp_path):
+    eng = make_engine(tmp_path)
+    eng.put_object("b", "o", b"d" * 200)
+    calls = []
+    eng.on_degraded_write = lambda b, o, v: calls.append((b, o, v))
+    eng.disks[0].offline = True
+    eng.delete_object("b", "o")
+    assert calls == [("b", "o", "")]
+    # clean delete of a fully-deleted object: drives answering
+    # not-found are converged, no heal needed
+    eng.disks[0].offline = False
+    calls.clear()
+    eng.put_object("b", "o2", b"d")
+    eng.delete_object("b", "o2")
+    assert calls == []
+
+
+def test_degraded_delete_marker_fires_hook(tmp_path):
+    eng = make_engine(tmp_path)
+    from minio_tpu.object import PutOptions
+    eng.put_object("b", "o", b"d", opts=PutOptions(versioned=True))
+    calls = []
+    eng.on_degraded_write = lambda b, o, v: calls.append((b, o, v))
+    eng.disks[0].offline = True
+    oi = eng.delete_object("b", "o", versioned=True)
+    assert calls == [("b", "o", oi.version_id)]
+
+
+def test_mrf_converges_degraded_write_end_to_end(tmp_path):
+    """The full loop: PUT loses a drive at quorum -> MRF queues ->
+    background heal restores the missing shard without any reader."""
+    drives = []
+    nd = None
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j == 0:
+            nd = NaughtyDisk(d)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        mrf_options=dict(max_retries=10, backoff_base=0.02,
+                         backoff_max=0.2))
+    try:
+        sets.make_bucket("b")
+        nd.fail_verbs["append_file"] = serr.FaultyDisk("boom")
+        sets.put_object("b", "o", b"q" * (2 * BLOCK))
+        assert sets.mrf_stats()["queued"] >= 1
+        del nd.fail_verbs["append_file"]   # drive recovers
+        assert sets.drain_mrf(15.0)
+        stats = sets.mrf_stats()
+        assert stats["pending"] == 0 and stats["healed"] >= 1
+        # the failed drive now holds a verifiable shard
+        eng = sets.sets[0]
+        fi = eng.disks[0].read_version("b", "o")
+        eng.disks[0].check_parts("b", "o", fi)
+        eng.disks[0].verify_file("b", "o", fi)
+    finally:
+        sets.close()
+
+
+def test_mrf_replicates_delete_marker_when_drive_returns(tmp_path):
+    """A delete marker written while a drive was offline: the MRF heal
+    must RETRY until the drive is back (a zero-write marker heal is a
+    failure, mirroring the data path's 'heal wrote no shards'), then
+    replicate the marker onto it."""
+    from minio_tpu.object import PutOptions
+    drives = []
+    nd = None
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j == 0:
+            nd = NaughtyDisk(d)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        mrf_options=dict(max_retries=12, backoff_base=0.02,
+                         backoff_max=0.2))
+    try:
+        sets.make_bucket("b")
+        sets.put_object("b", "o", b"d" * 300,
+                        opts=PutOptions(versioned=True))
+        nd.offline = True
+        oi = sets.delete_object("b", "o", versioned=True)
+        time.sleep(0.1)                # let the first heal attempt fail
+        nd.offline = False             # drive returns: retry succeeds
+        assert sets.drain_mrf(15.0)
+        stats = sets.mrf_stats()
+        assert stats["pending"] == 0 and stats["healed"] >= 1
+        fi = nd.inner.read_version("b", "o", oi.version_id)
+        assert fi.deleted               # marker replicated to the drive
+    finally:
+        sets.close()
+
+
+def test_mrf_purges_stale_copy_after_degraded_delete(tmp_path):
+    """Delete that missed a drive: the MRF entry removes the dangling
+    remnant once the drive is back (reference dangling-object GC)."""
+    drives = []
+    nd = None
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j == 0:
+            nd = NaughtyDisk(d)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        mrf_options=dict(max_retries=10, backoff_base=0.02,
+                         backoff_max=0.2))
+    try:
+        sets.make_bucket("b")
+        sets.put_object("b", "o", b"s" * 500)
+        nd.offline = True
+        sets.delete_object("b", "o")
+        nd.offline = False
+        assert sets.drain_mrf(15.0)
+        with pytest.raises(serr.StorageError):
+            nd.inner.read_version("b", "o")
+    finally:
+        sets.close()
+
+
+def test_mrf_partial_heal_end_to_end_not_counted_healed(tmp_path):
+    """PUT degraded on TWO drives, only one recovers: the MRF heal
+    repairs the recovered drive but the entry must not count healed
+    while the other slot is still missing a copy — it retries, then
+    counts failed (heal_object's result flows back through the sets
+    layer to MRFHealer's missing_after check)."""
+    drives, naughty = [], []
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j < 2:
+            nd = NaughtyDisk(d)
+            naughty.append(nd)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        mrf_options=dict(max_retries=2, backoff_base=0.01,
+                         backoff_max=0.05))
+    try:
+        sets.make_bucket("b")
+        naughty[0].offline = True
+        naughty[1].fail_verbs["append_file"] = serr.FaultyDisk("boom")
+        sets.put_object("b", "o", b"p" * (2 * BLOCK))
+        del naughty[1].fail_verbs["append_file"]   # one drive recovers
+        assert sets.drain_mrf(10.0)
+        stats = sets.mrf_stats()
+        assert stats["healed"] == 0 and stats["failed"] == 1
+        # ...but the recovered drive WAS repaired along the way
+        fi = naughty[1].inner.read_version("b", "o")
+        naughty[1].inner.verify_file("b", "o", fi)
+    finally:
+        sets.close()
+
+
+def test_heal_converges_metadata_only_divergence(tmp_path):
+    """A drive that missed an in-place metadata update (same mod_time /
+    data_dir) must be converged to the majority metadata — without a
+    data rewrite, and without the stale copy winning."""
+    eng = make_engine(tmp_path)            # drive 0 wrapped naughty
+    eng.put_object("b", "o", b"m" * 500)
+    nd = eng.disks[0]
+    nd.fail_verbs["write_metadata"] = serr.FaultyDisk("boom")
+    eng.update_object_metadata("b", "o", {"x-amz-meta-tag": "v2"})
+    del nd.fail_verbs["write_metadata"]
+    assert nd.inner.read_version("b", "o").metadata.get(
+        "x-amz-meta-tag") is None          # stale copy on drive 0
+    res = eng.heal_object("b", "o")
+    assert res.disks_healed == 1
+    got = nd.inner.read_version("b", "o").metadata
+    assert got.get("x-amz-meta-tag") == "v2"
+    assert "etag" in got                   # per-copy fields preserved
+    # steady state: a second heal finds nothing to do
+    res = eng.heal_object("b", "o")
+    assert res.missing_before == 0 and res.disks_healed == 0
+
+
+# ---------------------------------------------------------------------------
+# zero-progress heals fail retryably (HealFailed is an ObjectApiError)
+# ---------------------------------------------------------------------------
+
+def test_heal_with_no_healable_drive_raises_object_api_error(tmp_path):
+    """Copies missing on an OFFLINE slot: the heal can repair nothing
+    this attempt — it must fail (so MRF retries and stats don't claim a
+    no-op healed) with an ObjectApiError (so per-object sweep handlers
+    skip it instead of aborting the whole pass)."""
+    eng = make_engine(tmp_path, naughty_first=0)
+    eng.put_object("b", "o", b"x" * 1000)
+    saved = eng.disks[0]
+    eng.disks[0] = None
+    with pytest.raises(api_errors.HealFailed) as ei:
+        eng.heal_object("b", "o")
+    assert isinstance(ei.value, api_errors.ObjectApiError)
+    # dry run still only reports
+    res = eng.heal_object("b", "o", dry_run=True)
+    assert res.missing_before == 1 and res.disks_healed == 0
+    # drive returns: its copy is current again, heal is a clean no-op
+    eng.disks[0] = saved
+    res = eng.heal_object("b", "o")
+    assert res.missing_after == 0
+
+
+def test_mrf_retries_offline_slot_until_failed(tmp_path):
+    """A PUT degraded by an offline slot must NOT count as healed while
+    the slot is still gone: the MRF entry retries, then counts failed
+    (the disk monitor's sweep is the backstop)."""
+    drives = []
+    nd = None
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j == 0:
+            nd = NaughtyDisk(d)
+            drives.append(nd)
+        else:
+            drives.append(d)
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        mrf_options=dict(max_retries=2, backoff_base=0.01,
+                         backoff_max=0.02))
+    try:
+        sets.make_bucket("b")
+        nd.offline = True
+        sets.put_object("b", "o", b"x" * 1000)
+        assert sets.drain_mrf(10.0)
+        stats = sets.mrf_stats()
+        assert stats["failed"] == 1 and stats["healed"] == 0
+    finally:
+        sets.close()
+
+
+# ---------------------------------------------------------------------------
+# background-plane error counters
+# ---------------------------------------------------------------------------
+
+def test_disk_monitor_counts_scan_failures(tmp_path):
+    roots = [str(tmp_path / f"d{i}") for i in range(NDISKS)]
+    sets = ErasureSets.from_drives(roots, 1, NDISKS, M, block_size=BLOCK,
+                                   enable_mrf=False)
+    try:
+        mon = DiskMonitor(sets, interval=0.01)
+        mon.scan_once = lambda: (_ for _ in ()).throw(RuntimeError("wedge"))
+        mon.start()
+        deadline = time.monotonic() + 5
+        while mon.errors < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        mon.close()
+        assert mon.errors >= 2
+        assert mon.consecutive_errors >= 2
+        assert "wedge" in mon.last_error
+    finally:
+        sets.close()

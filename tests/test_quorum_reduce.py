@@ -1,0 +1,138 @@
+"""Quorum error-reduction algebra under mixed error populations
+(reference reduceReadQuorumErrs/reduceWriteQuorumErrs tests,
+cmd/erasure-metadata-utils_test.go): ignored (gone-disk) errors,
+offline drives, bitrot, and the exact-quorum boundary on both sides."""
+
+from __future__ import annotations
+
+import pytest
+
+from minio_tpu.object import api_errors, metadata as meta
+from minio_tpu.storage import errors as serr
+
+IGN = meta.OBJECT_OP_IGNORED_ERRS
+
+
+def errs(*groups):
+    """errs((None, 4), (serr.FileNotFound, 2)) -> flat error list."""
+    out = []
+    for cls, n in groups:
+        for _ in range(n):
+            out.append(None if cls is None else cls("x"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reduce_errs fundamentals
+# ---------------------------------------------------------------------------
+
+def test_reduce_errs_majority_and_tie_prefers_success():
+    n, err = meta.reduce_errs(errs((None, 3), (serr.FileNotFound, 2)), ())
+    assert n == 3 and err is None
+    # exact tie: success wins (a quorum of successes must not be
+    # out-voted by an equal count of one error class)
+    n, err = meta.reduce_errs(errs((None, 3), (serr.FileNotFound, 3)), ())
+    assert n == 3 and err is None
+    # error majority: the representative instance comes back
+    n, err = meta.reduce_errs(errs((None, 2), (serr.FileNotFound, 4)), ())
+    assert n == 4 and isinstance(err, serr.FileNotFound)
+
+
+def test_reduce_errs_ignored_classes_never_vote():
+    population = errs((serr.DiskNotFound, 5), (serr.FileNotFound, 1))
+    n, err = meta.reduce_errs(population, IGN)
+    assert n == 1 and isinstance(err, serr.FileNotFound)
+    # with nothing left after filtering, there is no winner at all
+    n, err = meta.reduce_errs(errs((serr.DiskNotFound, 6)), IGN)
+    assert n == 0 and err is None
+
+
+# ---------------------------------------------------------------------------
+# write quorum — exact boundary on both sides
+# ---------------------------------------------------------------------------
+
+def test_write_quorum_exact_boundary_success_side():
+    # exactly quorum successes + counted errors below quorum: success
+    population = errs((None, 4), (serr.FileNotFound, 2))
+    assert meta.reduce_write_quorum_errs(population, IGN, 4) is None
+    # one short of quorum: InsufficientWriteQuorum
+    population = errs((None, 3), (serr.FileNotFound, 3))
+    err = meta.reduce_write_quorum_errs(population, IGN, 4)
+    assert isinstance(err, api_errors.InsufficientWriteQuorum)
+
+
+def test_write_quorum_exact_boundary_error_side():
+    # exactly quorum drives agree on the SAME error: that error wins
+    # (the op deterministically failed, not a quorum shortfall)
+    population = errs((serr.FileNotFound, 4), (None, 2))
+    err = meta.reduce_write_quorum_errs(population, IGN, 4)
+    assert isinstance(err, serr.FileNotFound)
+    # same error count one short of quorum: shortfall
+    population = errs((serr.FileNotFound, 3), (None, 2),
+                      (serr.VolumeNotFound, 1))
+    err = meta.reduce_write_quorum_errs(population, IGN, 4)
+    assert isinstance(err, api_errors.InsufficientWriteQuorum)
+
+
+def test_write_quorum_offline_drives_do_not_mask_success():
+    # parity-many gone drives (ignored) + quorum successes: success,
+    # even though successes < quorum + ignored count
+    population = errs((None, 4), (serr.DiskNotFound, 2))
+    assert meta.reduce_write_quorum_errs(population, IGN, 4) is None
+    # gone drives can't *create* quorum either
+    population = errs((None, 3), (serr.DiskNotFound, 3))
+    err = meta.reduce_write_quorum_errs(population, IGN, 4)
+    assert isinstance(err, api_errors.InsufficientWriteQuorum)
+
+
+def test_write_quorum_mixed_population():
+    # ignored + offline + bitrot + success all at once: only counted
+    # classes vote; the biggest counted class is the outcome
+    population = (errs((None, 2), (serr.DiskNotFound, 1),
+                       (serr.FaultyDisk, 1))          # ignored classes
+                  + [serr.BitrotHashMismatch("a", "b") for _ in range(3)])
+    err = meta.reduce_write_quorum_errs(population, IGN, 3)
+    assert isinstance(err, serr.BitrotHashMismatch)
+
+
+# ---------------------------------------------------------------------------
+# read quorum — exact boundary on both sides
+# ---------------------------------------------------------------------------
+
+def test_read_quorum_exact_boundary():
+    population = errs((None, 4), (serr.FileNotFound, 2))
+    assert meta.reduce_read_quorum_errs(population, IGN, 4) is None
+    err = meta.reduce_read_quorum_errs(population, IGN, 5)
+    assert isinstance(err, api_errors.InsufficientReadQuorum)
+
+
+def test_read_quorum_bitrot_plus_offline():
+    # bitrot on read-quorum-many drives with the rest offline: the
+    # bitrot error surfaces (deep heal trigger), not a generic shortfall
+    population = (errs((serr.DiskNotFound, 2))
+                  + [serr.BitrotHashMismatch("x", "y") for _ in range(4)])
+    err = meta.reduce_read_quorum_errs(population, IGN, 4)
+    assert isinstance(err, serr.BitrotHashMismatch)
+
+
+def test_read_quorum_all_drives_gone():
+    population = errs((serr.DiskNotFound, 4), (serr.FaultyDisk, 2))
+    err = meta.reduce_read_quorum_errs(population, IGN, 1)
+    assert isinstance(err, api_errors.InsufficientReadQuorum)
+
+
+def test_read_quorum_not_found_maps_through():
+    # a deleted object: quorum-many FileNotFound must come back as
+    # FileNotFound (so callers map to ObjectNotFound), never a quorum
+    # failure
+    population = errs((serr.FileNotFound, 5), (serr.DiskNotFound, 1))
+    err = meta.reduce_read_quorum_errs(population, IGN, 4)
+    assert isinstance(err, serr.FileNotFound)
+
+
+def test_network_storage_error_is_quorum_tolerated():
+    # the retrying transport's NetworkStorageError subclasses
+    # DiskNotFound: a wire blip is a gone drive to quorum logic
+    assert isinstance(serr.NetworkStorageError("reset"), serr.DiskNotFound)
+    population = errs((None, 4), (serr.NetworkStorageError, 2))
+    assert meta.reduce_write_quorum_errs(population, IGN, 4) is None

@@ -1,0 +1,248 @@
+"""Retrying transport tests: idempotent-verb retries under a per-call
+deadline, offline only on true transport failures, exponential probe
+backoff, clock-skew-tolerant internode tokens, and the mid-stream
+disconnect -> retryable NetworkStorageError mapping."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.distributed import transport
+from minio_tpu.distributed.storage_rpc import (
+    STORAGE_RPC_PREFIX, RemoteStorage, StorageRPCServer, _RemoteStream)
+from minio_tpu.distributed.transport import (
+    NetworkError, RestClient, RPCError, RPCHandler, RPCServer,
+    _StreamedResponse, make_token, verify_token)
+from minio_tpu.storage import XLStorage, errors as serr
+
+AK, SK = "minio", "miniosecret"
+
+
+# ---------------------------------------------------------------------------
+# token clock skew
+# ---------------------------------------------------------------------------
+
+def test_token_tolerates_clock_skew():
+    # expired 10 s ago — within the +/-30 s window: still valid
+    assert verify_token(make_token(AK, SK, ttl=-10), AK, SK)
+    # expired beyond the window: rejected
+    assert not verify_token(make_token(AK, SK, ttl=-45), AK, SK)
+    # normal fresh token still verifies, wrong key still fails
+    tok = make_token(AK, SK)
+    assert verify_token(tok, AK, SK)
+    assert not verify_token(tok, AK, "other")
+
+
+# ---------------------------------------------------------------------------
+# retry loop (no sockets: counted fake transport)
+# ---------------------------------------------------------------------------
+
+class CountingClient(RestClient):
+    """RestClient whose wire layer is replaced by a scripted callable."""
+
+    def __init__(self, script, **kw):
+        kw.setdefault("timeout", 5.0)
+        super().__init__("127.0.0.1", 1, "/t/v1", AK, SK, **kw)
+        self.script = script
+        self.attempts = 0
+
+    def _call_once(self, verb, args, body, stream_response, body_length,
+                   timeout):
+        self.attempts += 1
+        return self.script(self.attempts)
+
+
+def test_idempotent_verb_retries_then_succeeds(monkeypatch):
+    monkeypatch.setattr(transport, "RPC_RETRY_BACKOFF", 0.001)
+    c = CountingClient(lambda n: b"ok" if n == 3 else (_ for _ in ()).throw(
+        NetworkError("blip", conn_failure=True)))
+    assert c.call("readall", idempotent=True) == b"ok"
+    assert c.attempts == 3
+    assert c.online                     # transient blip never went offline
+    c.close()
+
+
+def test_non_idempotent_verb_fails_fast(monkeypatch):
+    monkeypatch.setattr(transport, "RPC_RETRY_BACKOFF", 0.001)
+
+    def always_fail(n):
+        raise NetworkError("refused", conn_failure=True)
+
+    c = CountingClient(always_fail)
+    with pytest.raises(NetworkError):
+        c.call("createfile")            # mutation: never replayed
+    assert c.attempts == 1
+    assert not c.online                 # conn failure: offline
+    c.close()
+
+
+def test_conn_failure_marks_offline_after_retries(monkeypatch):
+    monkeypatch.setattr(transport, "RPC_RETRY_BACKOFF", 0.001)
+
+    def always_fail(n):
+        raise NetworkError("refused", conn_failure=True)
+
+    c = CountingClient(always_fail)
+    with pytest.raises(NetworkError):
+        c.call("readall", idempotent=True)
+    assert c.attempts == 1 + transport.RPC_RETRIES
+    assert not c.online
+    c.close()
+
+
+def test_protocol_failure_does_not_flip_online(monkeypatch):
+    monkeypatch.setattr(transport, "RPC_RETRY_BACKOFF", 0.001)
+
+    def garbage(n):
+        raise NetworkError("bad status line", conn_failure=False)
+
+    c = CountingClient(garbage)
+    with pytest.raises(NetworkError):
+        c.call("readall", idempotent=True)
+    assert c.online                     # the peer answered: it is alive
+    c.close()
+
+
+def test_deadline_caps_all_attempts(monkeypatch):
+    monkeypatch.setattr(transport, "RPC_RETRY_BACKOFF", 10.0)
+
+    def always_fail(n):
+        raise NetworkError("blip", conn_failure=True)
+
+    c = CountingClient(always_fail)
+    t0 = time.monotonic()
+    with pytest.raises(NetworkError):
+        # backoff (10 s) would blow the 50 ms deadline: exactly 1 attempt
+        c.call("readall", idempotent=True, deadline=0.05)
+    assert c.attempts == 1
+    assert time.monotonic() - t0 < 1.0
+    c.close()
+
+
+def test_offline_host_fails_fast():
+    c = CountingClient(lambda n: b"ok")
+    c._online = False
+    with pytest.raises(NetworkError):
+        c.call("readall", idempotent=True)
+    assert c.attempts == 0
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# health probe backoff
+# ---------------------------------------------------------------------------
+
+def test_probe_brings_host_back_online(monkeypatch):
+    monkeypatch.setattr(transport, "HEALTH_PROBE_INTERVAL", 0.05)
+    srv = RPCServer(port=0)
+    h = RPCHandler("/t/v1", AK, SK)
+    srv.mount(h)
+    srv.start()
+    try:
+        c = RestClient("127.0.0.1", srv.port, "/t/v1", AK, SK)
+        c.mark_offline()
+        deadline = time.monotonic() + 5
+        while not c.online and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert c.online
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_probe_delay_grows_exponentially(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(transport, "HEALTH_PROBE_INTERVAL", 1.0)
+    monkeypatch.setattr(transport, "HEALTH_PROBE_MAX", 8.0)
+
+    c = RestClient("127.0.0.1", 1, "/t/v1", AK, SK)  # nothing listens
+
+    real_sleep = time.sleep
+
+    def fake_sleep(d):
+        sleeps.append(d)
+        if len(sleeps) >= 6:
+            c._online = True            # stop the loop
+        real_sleep(0)
+
+    monkeypatch.setattr(transport.time, "sleep", fake_sleep)
+    c._online = False
+    c._probe_loop()
+    # jittered exponential: each base delay in [0.75x, 1.25x] of
+    # 1, 2, 4, 8 (capped at HEALTH_PROBE_MAX)
+    for want, got in zip([1, 2, 4, 8, 8, 8], sleeps):
+        assert 0.74 * want <= got <= 1.26 * want, (want, got)
+
+
+# ---------------------------------------------------------------------------
+# mid-stream disconnects
+# ---------------------------------------------------------------------------
+
+class _BrokenResp:
+    def read(self, n=-1):
+        raise ConnectionResetError("peer reset")
+
+
+class _Conn:
+    closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def test_streamed_response_maps_midstream_to_network_error():
+    conn = _Conn()
+    s = _StreamedResponse(conn, _BrokenResp())
+    with pytest.raises(NetworkError):
+        s.read(10)
+    assert conn.closed
+
+
+def test_remote_stream_maps_to_retryable_storage_error():
+    class Broken:
+        def read(self, n=-1):
+            raise NetworkError("mid-stream: reset")
+
+        def close(self):
+            pass
+
+    with pytest.raises(serr.NetworkStorageError):
+        _RemoteStream(Broken()).read(10)
+
+
+# ---------------------------------------------------------------------------
+# RemoteStorage end-to-end: remote errors vs transport errors
+# ---------------------------------------------------------------------------
+
+def test_remote_rpc_error_does_not_flip_online(tmp_path):
+    drive = XLStorage(str(tmp_path / "d0"))
+    srv = RPCServer(port=0)
+    rpc = StorageRPCServer({"/d0": drive}, AK, SK)
+    srv.mount_route(STORAGE_RPC_PREFIX, rpc.handler)
+    srv.start()
+    try:
+        rs = RemoteStorage("127.0.0.1", srv.port, "/d0", AK, SK)
+        with pytest.raises(serr.StorageError):
+            rs.read_all("novol", "nofile")   # remote storage error
+        assert rs.is_online()                # ...but the peer is alive
+        drive.make_vol("v")
+        drive.write_all("v", "f", b"data")
+        assert rs.read_all("v", "f") == b"data"
+    finally:
+        srv.stop()
+
+
+def test_remote_transport_error_maps_to_network_storage_error():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                                # nothing listens on `port`
+    rs = RemoteStorage("127.0.0.1", port, "/d0", AK, SK, timeout=0.5)
+    with pytest.raises(serr.NetworkStorageError):
+        rs.read_all("v", "f")
+    assert not rs.is_online()
+    rs.close()
