@@ -138,6 +138,14 @@ class RestClient:
         self._online = True
         self._mu = threading.Lock()
         self._prober: Optional[threading.Thread] = None
+        # fault counters (surfaced per drive in the OBD bundle):
+        # calls = verbs attempted, net_errors = transport failures
+        # observed (per attempt), retries = extra attempts made,
+        # offline_trips = online→offline transitions
+        self.calls = 0
+        self.net_errors = 0
+        self.retries = 0
+        self.offline_trips = 0
 
     @property
     def online(self) -> bool:
@@ -169,6 +177,8 @@ class RestClient:
         if not self._online:
             raise NetworkError(f"{self.host}:{self.port} is offline",
                                conn_failure=True)
+        with self._mu:
+            self.calls += 1
         end = time.monotonic() + (deadline if deadline is not None
                                   else self.timeout)
         attempts = 1
@@ -179,12 +189,17 @@ class RestClient:
             remaining = end - time.monotonic()
             if remaining <= 0:
                 break
+            if attempt:
+                with self._mu:
+                    self.retries += 1
             try:
                 return self._call_once(verb, args, body, stream_response,
                                        body_length,
                                        timeout=min(self.timeout,
                                                    remaining))
             except NetworkError as e:
+                with self._mu:
+                    self.net_errors += 1
                 last = e
                 if attempt + 1 >= attempts:
                     break
@@ -259,6 +274,7 @@ class RestClient:
             if not self._online:
                 return
             self._online = False
+            self.offline_trips += 1
             self._prober = threading.Thread(target=self._probe_loop,
                                             daemon=True)
             self._prober.start()
@@ -283,6 +299,15 @@ class RestClient:
                     return
             except (OSError, http.client.HTTPException):
                 continue
+
+    def net_counters(self) -> dict:
+        """Transport fault counters for the OBD bundle."""
+        with self._mu:
+            return {"endpoint": f"{self.host}:{self.port}",
+                    "online": self._online, "calls": self.calls,
+                    "net_errors": self.net_errors,
+                    "retries": self.retries,
+                    "offline_trips": self.offline_trips}
 
     def close(self) -> None:
         self._online = True  # stop any probe loop

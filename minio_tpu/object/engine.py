@@ -22,6 +22,8 @@ TPU-first deltas vs the reference's per-block loop:
 from __future__ import annotations
 
 import os
+import threading
+import time
 import uuid as _uuid
 from typing import BinaryIO, Iterator, Optional
 
@@ -287,6 +289,192 @@ class ErasureObjects:
         """The PUT hot loop: read blocks, batch-encode, batch-hash,
         fan-out framed writes. Returns total bytes.
 
+        Two selectable forms (MINIO_TPU_PIPELINE, default on): the
+        pipelined loop overlaps ingest / encode+digest / shard writes
+        across a staging-buffer ring; the serial loop runs them
+        strictly in sequence on this thread. Streams that fit in ONE
+        encode batch stay serial even with the pipeline on — a single
+        batch has nothing to overlap, so the stage hand-off would be
+        pure latency."""
+        from ..parallel import pipeline as pl
+        size = getattr(reader, "size", -1)
+        if pl.ENABLED and (size < 0
+                           or size > ENCODE_BATCH_BLOCKS
+                           * self.block_size):
+            return self._encode_stream_pipelined(reader, codec, writers,
+                                                 write_quorum)
+        return self._encode_stream_serial(reader, codec, writers,
+                                          write_quorum)
+
+    def _encode_stream_pipelined(self, reader, codec: Codec, writers,
+                                 write_quorum: int) -> int:
+        """The PUT hot loop, overlapped (the fork's async-QAT pattern,
+        cmd/erasure-encode.go:113-124, applied to the WHOLE path): a
+        ring of BytePool-backed (B, k·S) staging buffers carries three
+        concurrent stages —
+
+          * this thread ingests batch N+1 straight into a pooled buffer
+            (and fire-and-forgets the device dispatch for it via
+            BatchScheduler.submit, so the reader never blocks on the
+            device),
+          * the encode stage resolves batch N's fused encode+digest
+            (or runs the local CPU fallback),
+          * the write stage fans batch N-1's framed shard writes out.
+
+        Bounded stage queues + the shared buffer ring are the memory
+        bound: a stalled drive backs pressure up to the reader instead
+        of ballooning staging RAM. Same bytes on disk as the serial
+        loop — the pad tail [block_size:k·S] of every row is re-zeroed
+        on each buffer acquisition (klauspost-identical shard bytes are
+        invariant by construction, not by write discipline). The stage
+        threads spin up lazily on the FIRST full batch, so an
+        unknown-length stream that turns out to fit one batch encodes
+        and writes inline with zero pipeline overhead."""
+        from ..parallel import pipeline as pl
+        k, s_len = codec.k, codec.shard_size
+        bs = self.block_size
+        cap = ENCODE_BATCH_BLOCKS
+        known_size = getattr(reader, "size", -1)
+        pool = pl.staging_pool(cap * k * s_len)
+        # per-stage wall seconds [ingest, encode, write]; each slot is
+        # written by exactly one thread
+        stage_s = [0.0, 0.0, 0.0]
+        batches = 0
+        t_start = time.perf_counter()
+
+        def recycle(item) -> None:
+            buf = item.get("buf")
+            if buf is not None:
+                item["buf"] = None
+                pool.put(buf)
+
+        def encode_stage(item):
+            t0 = time.perf_counter()
+            with stagetimer.stage("put.encode+digest"):
+                fut, data = item["fut"], item["data"]
+                fused = fut.result() if fut is not None else \
+                    codec.encode_and_hash_batch(data, self.bitrot_algo)
+                item["rows"] = self._unpack_fused(codec, data, fused)
+            stage_s[1] += time.perf_counter() - t0
+            return item
+
+        def write_stage(item):
+            t0 = time.perf_counter()
+            try:
+                with stagetimer.stage("put.shard_write"):
+                    rows, parity, dd, dp = item["rows"]
+                    self._write_shards_batch(rows, parity, dd, dp,
+                                             writers, write_quorum)
+            finally:
+                recycle(item)
+                stage_s[2] += time.perf_counter() - t0
+
+        pipe = None
+
+        def feed(data) -> None:
+            """Hand the CURRENT buffer (if any) plus `data` to the
+            pipeline, spinning the stage threads up on first use.
+            Buffer ownership transfers to the item BEFORE submit — if
+            submit raises a pending stage error, on_drop recycles the
+            item's buffer and the caller's finally must not recycle it
+            again (a double pool.put would hand one bytearray to two
+            later streams)."""
+            nonlocal batches, buf, pipe
+            if pipe is None:
+                pipe = pl.StagePipeline([encode_stage, write_stage],
+                                        depth=pl.DEPTH, name="put-pipe",
+                                        on_drop=recycle)
+            owned, buf = buf, None
+            fut = (self.scheduler.submit(codec, data, self.bitrot_algo)
+                   if self.scheduler is not None else None)
+            pipe.submit({"buf": owned, "data": data, "fut": fut})
+            batches += 1
+
+        def acquire():
+            t0 = time.perf_counter()
+            b = pool.get(timeout=pl.POOL_TIMEOUT_S)
+            stage_s[0] += time.perf_counter() - t0
+            a = np.frombuffer(b, dtype=np.uint8).reshape(cap, k * s_len)
+            if k * s_len > bs:
+                # pooled reuse: the pad tail must READ as zeros for
+                # klauspost-identical shards — enforce it here rather
+                # than trusting every writer of this ring forever
+                a[:, bs:] = 0
+            return b, a
+
+        total = 0
+        buf = None
+        try:
+            buf, arr = acquire()
+            nb = 0
+            while True:
+                t0 = time.perf_counter()
+                with stagetimer.stage("put.read_stream"):
+                    n = _read_full_into(reader, arr[nb][:bs])
+                stage_s[0] += time.perf_counter() - t0
+                if n == 0:
+                    break
+                total += n
+                if n == bs:
+                    nb += 1
+                    if nb == cap:
+                        feed(arr[:nb].reshape(nb, k, s_len))
+                        nb = 0
+                        if 0 <= known_size == total:
+                            # exact batch multiple: EOF is certain, so
+                            # don't block on a probe buffer the stream
+                            # will never write into
+                            break
+                        buf, arr = acquire()
+                else:
+                    # short last block: its shard length differs —
+                    # flush the pending full rows first, then the
+                    # short block alone (split copies it out of the
+                    # ring; whichever item takes the buffer recycles
+                    # it)
+                    with stagetimer.stage("put.split"):
+                        data = codec.split(arr[nb][:n])[None, ...]
+                    if pipe is None:
+                        # unknown-length stream that fit one batch:
+                        # encode+write inline — no stage threads
+                        if nb:
+                            self._encode_write(
+                                codec, arr[:nb].reshape(nb, k, s_len),
+                                writers, write_quorum)
+                        self._encode_write(codec, data, writers,
+                                           write_quorum)
+                    else:
+                        if nb:
+                            feed(arr[:nb].reshape(nb, k, s_len))
+                        feed(data)
+                    nb = 0
+                    break
+            if nb:
+                if pipe is None:
+                    self._encode_write(codec,
+                                       arr[:nb].reshape(nb, k, s_len),
+                                       writers, write_quorum)
+                else:
+                    feed(arr[:nb].reshape(nb, k, s_len))
+            if pipe is not None:
+                pipe.close()    # join; re-raises the first stage error
+        except BaseException:
+            if pipe is not None:
+                pipe.close(abort=True)
+            raise
+        finally:
+            if buf is not None:
+                pool.put(buf)
+        if pipe is not None:
+            wall = time.perf_counter() - t_start
+            pl.STATS.record_put(wall, sum(stage_s), batches)
+            stagetimer.add_overlap("put.pipeline", wall, sum(stage_s))
+        return total
+
+    def _encode_stream_serial(self, reader, codec: Codec, writers,
+                              write_quorum: int) -> int:
+        """The serial PUT hot loop (MINIO_TPU_PIPELINE=off).
+
         Copy discipline (the fork's zero-copy QAT ingest,
         cmd/erasure-encode.go:102-124, generalized): blocks are read
         straight into a padded (B, k*S) buffer so the shard split is a
@@ -333,6 +521,30 @@ class ErasureObjects:
         flush_full(nb)
         return total
 
+    def _unpack_fused(self, codec: Codec, data: np.ndarray, fused
+                      ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+        """(data_rows, parity, data_digests, parity_digests) from one
+        fused encode+digest result, or the local CPU fallback when the
+        batch didn't ride the device (`fused` is None). data rows stay
+        views of the caller's staging buffer on the CPU path."""
+        if fused is not None:
+            full, digests = fused
+            return (full[:, :codec.k], full[:, codec.k:],
+                    digests[:, :codec.k], digests[:, codec.k:])
+        b_ = data.shape[0]
+        parity = codec.encode_parity_batch(data)
+        dd = bitrot_mod.hash_shards_batch(
+            data.reshape(b_ * codec.k, -1), self.bitrot_algo
+        ).reshape(b_, codec.k, -1)
+        if codec.m:
+            dp = bitrot_mod.hash_shards_batch(
+                parity.reshape(b_ * codec.m, -1), self.bitrot_algo
+            ).reshape(b_, codec.m, -1)
+        else:
+            dp = np.zeros((b_, 0, dd.shape[-1]), dtype=np.uint8)
+        return data, parity, dd, dp
+
     def _encode_write(self, codec: Codec, data: np.ndarray, writers,
                       write_quorum: int) -> None:
         """Encode+digest one (B, k, S) batch and fan the framed shard
@@ -346,23 +558,8 @@ class ErasureObjects:
                     codec, data, self.bitrot_algo)
             else:
                 fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
-            if fused is not None:
-                full, digests = fused
-                data_rows, parity = full[:, :codec.k], full[:, codec.k:]
-                dd, dp = digests[:, :codec.k], digests[:, codec.k:]
-            else:
-                b_ = data.shape[0]
-                data_rows = data
-                parity = codec.encode_parity_batch(data)
-                dd = bitrot_mod.hash_shards_batch(
-                    data.reshape(b_ * codec.k, -1), self.bitrot_algo
-                ).reshape(b_, codec.k, -1)
-                if codec.m:
-                    dp = bitrot_mod.hash_shards_batch(
-                        parity.reshape(b_ * codec.m, -1), self.bitrot_algo
-                    ).reshape(b_, codec.m, -1)
-                else:
-                    dp = np.zeros((b_, 0, dd.shape[-1]), dtype=np.uint8)
+            data_rows, parity, dd, dp = self._unpack_fused(codec, data,
+                                                           fused)
         with stagetimer.stage("put.shard_write"):
             self._write_shards_batch(data_rows, parity, dd, dp, writers,
                                      write_quorum)
@@ -659,20 +856,43 @@ class ErasureObjects:
             == "device")
 
         # blocks are read in groups so a degraded part reconstructs many
-        # blocks per device call instead of one matmul per block
+        # blocks per device call instead of one matmul per block; the
+        # group walk is precomputed so the one-group-lookahead
+        # prefetcher can issue group N+1's reads while group N runs
+        # fused verify+decode and is joined/yielded
+        from ..parallel import pipeline as pl
+        specs: list[tuple[list, list]] = []
         bn = start_block
         while bn <= end_block:
             group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
-            group = []
-            with stagetimer.stage("get.read_shards"):
-                blocks = list(range(bn, group_end + 1))
-                geoms = []
-                for b in blocks:
-                    block_off = b * fi.erasure.block_size
-                    block_len = min(fi.erasure.block_size,
-                                    part.size - block_off)
-                    geoms.append((b, block_off, block_len,
-                                  -(-block_len // k)))
+            blocks = list(range(bn, group_end + 1))
+            geoms = []
+            for b in blocks:
+                block_off = b * fi.erasure.block_size
+                block_len = min(fi.erasure.block_size,
+                                part.size - block_off)
+                geoms.append((b, block_off, block_len,
+                              -(-block_len // k)))
+            specs.append((blocks, geoms))
+            bn = group_end + 1
+
+        # every reader I/O (group reads, hedged re-reads, the
+        # corrupt-block re-reads inside verify) serializes on io_lock:
+        # the bitrot readers are stateful streams shared with the
+        # lookahead thread. reader_gen counts in-place rebuilds of the
+        # readers list so a verify verdict formed against the OLD
+        # readers can't condemn a fresh one by index.
+        io_lock = threading.Lock()
+        reader_gen = [0]
+
+        def read_group(blocks: list, geoms: list) -> tuple[list, bool,
+                                                           float]:
+            """One group's raw shard reads, with the quorum-loss →
+            per-block-hedged-read degradation unchanged; returns
+            (per-block reads, degraded, read seconds)."""
+            t0 = time.perf_counter()
+            degraded = False
+            with io_lock:
                 try:
                     reads = self._read_group_shards_raw(
                         readers, blocks, shard_size,
@@ -687,45 +907,87 @@ class ErasureObjects:
                     for r in readers:
                         if r is not None:
                             r.close()
-                    readers = make_readers()
-                    heal_required = True
+                    readers[:] = make_readers()
+                    reader_gen[0] += 1
+                    degraded = True
                     reads = [self._read_block_shards_raw(
                         readers, g[0], shard_size, g[3], k, n,
                         collect_digests=defer_verify) for g in geoms]
+            return reads, degraded, time.perf_counter() - t0
+
+        lookahead = None
+        try:
+            for si, (blocks, geoms) in enumerate(specs):
+                group = []
+                with stagetimer.stage("get.read_shards"):
+                    if lookahead is not None and lookahead.cancel():
+                        # still queued behind other streams' prefetch
+                        # tasks: reading inline is strictly faster than
+                        # waiting for a task that hasn't started
+                        lookahead = None
+                    if lookahead is not None:
+                        t0 = time.perf_counter()
+                        reads, degraded, read_s = lookahead.result()
+                        lookahead = None
+                        pl.STATS.record_get_group(
+                            True, time.perf_counter() - t0, read_s)
+                    else:
+                        reads, degraded, _ = read_group(blocks, geoms)
+                        pl.STATS.record_get_group(False)
+                # readers-list generation THIS group's frames came from
+                # (the N+1 lookahead may rebuild the list mid-verify)
+                gen_at_read = reader_gen[0]
+                heal_required = heal_required or degraded
+                # issue the NEXT group's reads on the drive pool before
+                # this group's verify+decode — decode overlaps drive
+                # I/O, bounded to ONE group of lookahead staging
+                if pl.ENABLED and si + 1 < len(specs):
+                    lookahead = pl.PREFETCH_POOL.submit(
+                        read_group, *specs[si + 1])
                 for (b, block_off, block_len, shard_len), \
                         (shards, digests, had_errors) in zip(geoms,
                                                              reads):
                     heal_required = heal_required or had_errors
                     group.append([b, block_off, block_len, shard_len,
                                   shards, digests])
-            with stagetimer.stage("get.verify+decode"):
-                if self._verify_and_reconstruct_group(
-                        codec, group, k, n, readers, shard_size,
-                        part_algo or self.bitrot_algo):
-                    heal_required = True
-            with stagetimer.stage("get.join"):
-                out = []
-                for b, block_off, block_len, shard_len, shards, _dg \
-                        in group:
-                    data = np.concatenate([s[:shard_len]
-                                           for s in shards[:k]])
-                    begin = max(offset - block_off, 0)
-                    end = min(offset + length - block_off, block_len)
-                    # slice the view FIRST: tobytes on the full block
-                    # then slicing again was two payload copies
-                    out.append(data[begin:end].tobytes())
-            yield from out
-            bn = group_end + 1
-
-        for r in readers:
-            if r is not None:
-                r.close()
-        if heal_required and not suppress_heal_flag \
-                and self.on_degraded_read is not None:
-            try:
-                self.on_degraded_read(bucket, object_name)
-            except Exception:  # noqa: BLE001 — heal queueing is best-effort
-                pass
+                with stagetimer.stage("get.verify+decode"):
+                    if self._verify_and_reconstruct_group(
+                            codec, group, k, n, readers, shard_size,
+                            part_algo or self.bitrot_algo,
+                            io_lock=io_lock,
+                            reader_gen=(reader_gen, gen_at_read)):
+                        heal_required = True
+                with stagetimer.stage("get.join"):
+                    out = []
+                    for b, block_off, block_len, shard_len, shards, _dg \
+                            in group:
+                        data = np.concatenate([s[:shard_len]
+                                               for s in shards[:k]])
+                        begin = max(offset - block_off, 0)
+                        end = min(offset + length - block_off, block_len)
+                        # slice the view FIRST: tobytes on the full block
+                        # then slicing again was two payload copies
+                        out.append(data[begin:end].tobytes())
+                yield from out
+            if heal_required and not suppress_heal_flag \
+                    and self.on_degraded_read is not None:
+                try:
+                    self.on_degraded_read(bucket, object_name)
+                except Exception:  # noqa: BLE001 — heal is best-effort
+                    pass
+        finally:
+            if lookahead is not None and not lookahead.cancel():
+                # the running lookahead owns reader state: let it
+                # finish before the readers close (an abandoned
+                # generator must not leave a thread racing closed
+                # streams); a still-queued one is simply cancelled
+                try:
+                    lookahead.result()
+                except BaseException:  # noqa: BLE001 — abandoned read
+                    pass
+            for r in readers:
+                if r is not None:
+                    r.close()
 
     def _read_block_shards(self, readers, codec: Codec, block_num: int,
                            shard_size: int, shard_len: int, k: int, n: int
@@ -740,8 +1002,11 @@ class ErasureObjects:
 
     def _verify_and_reconstruct_group(self, codec: Codec, group, k: int,
                                       n: int, readers, shard_size: int,
-                                      algo: bitrot_mod.BitrotAlgorithm
-                                      ) -> bool:
+                                      algo: bitrot_mod.BitrotAlgorithm,
+                                      io_lock: Optional[threading.Lock]
+                                      = None,
+                                      reader_gen: Optional[tuple]
+                                      = None) -> bool:
         """Verify deferred frame digests AND reconstruct the degraded
         blocks of a read group. Degraded blocks sharing one
         (present-mask, shard-length) pattern go through a single fused
@@ -755,6 +1020,18 @@ class ErasureObjects:
         from ..ops import rs_matrix
         heal = False
         corrupt: set[int] = set()
+        if io_lock is None:
+            io_lock = threading.Lock()   # uncontended when no prefetch
+
+        def drop_reader(u: int) -> None:
+            """Condemn the reader a corrupt frame came from — unless a
+            concurrent lookahead rebuilt the readers list since this
+            group was read, in which case index u names a FRESH reader
+            that never served the corrupt frame."""
+            with io_lock:
+                if reader_gen is None or \
+                        reader_gen[0][0] == reader_gen[1]:
+                    readers[u] = None
 
         # 1) degraded buckets: fused verify+decode on device, or
         #    missing-rows-only matmul on host
@@ -790,7 +1067,7 @@ class ErasureObjects:
                             continue
                         if sdig[row, col].tobytes() != exp:
                             shards[u] = None
-                            readers[u] = None
+                            drop_reader(u)
                             bad = True
                         else:
                             digests[u] = None  # verified on device
@@ -823,7 +1100,7 @@ class ErasureObjects:
             for row, (gi, i) in enumerate(items):
                 if got[row].tobytes() != group[gi][5][i]:
                     group[gi][4][i] = None
-                    readers[i] = None
+                    drop_reader(i)
                     corrupt.add(gi)
                 else:
                     group[gi][5][i] = None
@@ -834,8 +1111,9 @@ class ErasureObjects:
         for gi in sorted(corrupt):
             heal = True
             b, _off, _blen, shard_len, _shards, _dg = group[gi]
-            new_shards, _digests, _he = self._read_block_shards_raw(
-                readers, b, shard_size, shard_len, k, n)
+            with io_lock:   # a GET lookahead may hold the readers
+                new_shards, _digests, _he = self._read_block_shards_raw(
+                    readers, b, shard_size, shard_len, k, n)
             if any(new_shards[i] is None for i in range(k)):
                 new_shards = codec.reconstruct(new_shards, data_only=True)
             group[gi][4] = new_shards

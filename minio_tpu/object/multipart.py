@@ -345,4 +345,11 @@ class MultipartMixin(ErasureObjects):
                     errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
                 if err is not None:
                     raise api_errors.to_object_err(err, bucket, object_name)
+            if any(e is not None for e in errs):
+                # commit met quorum but some drives missed the rename:
+                # the completed object is degraded on those drives —
+                # feed the MRF heal queue exactly like a degraded PUT
+                # (ROADMAP follow-up: on_degraded_write previously fired
+                # only from PUT/delete/metadata)
+                self._notify_degraded(bucket, object_name, fi.version_id)
             return fi.to_object_info(bucket, object_name)

@@ -1,32 +1,68 @@
 """Bounded byte-buffer pool (reference pkg/bpool.BytePoolCap, fed to the
 erasure encoder at cmd/erasure-sets.go:374).
 
-PUT streams stage each block in a same-width buffer; pooling them caps
-allocation churn and puts a hard bound on staging memory. get() blocks
-when the pool is exhausted — that back-pressure IS the admission
-control for raw block memory.
+PUT streams stage each block batch in a same-width buffer; pooling them
+caps allocation churn and puts a hard bound on staging memory. get()
+blocks when the pool is exhausted — that back-pressure IS the admission
+control for raw block memory. The pressure is observable: `waits`
+counts gets that had to block, `exhausted` counts gets that timed out
+(surfaced as minio_tpu_pipeline_bpool_* metrics), so a stalled pipeline
+shows up on a dashboard instead of as a silent hang.
 """
 
 from __future__ import annotations
 
 import queue
+import threading
 from typing import Optional
+
+
+class BytePoolExhausted(Exception):
+    """get() timed out: every buffer is checked out and none returned
+    within the deadline — the pipeline is stalled or the pool is
+    undersized for the live stream count."""
 
 
 class BytePool:
     def __init__(self, width: int, capacity: int):
         self.width = width
         self.capacity = capacity
+        self.waits = 0          # get() calls that had to block
+        self.exhausted = 0      # get() calls that timed out
+        self._mu = threading.Lock()
+        self._created = 0       # buffers allocated so far (<= capacity)
         self._q: "queue.Queue[bytearray]" = queue.Queue(maxsize=capacity)
-        for _ in range(capacity):
-            self._q.put(bytearray(width))
 
     def get(self, timeout: Optional[float] = None) -> bytearray:
-        return self._q.get(timeout=timeout)
+        """A pooled buffer; allocated lazily up to `capacity` (an idle
+        pool costs nothing), then blocks (up to `timeout` seconds,
+        forever when None) while all buffers are checked out. Raises
+        BytePoolExhausted on timeout."""
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            pass
+        with self._mu:
+            if self._created < self.capacity:
+                self._created += 1
+                return bytearray(self.width)
+            self.waits += 1
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            with self._mu:
+                self.exhausted += 1
+            raise BytePoolExhausted(
+                f"no {self.width}-byte staging buffer freed within "
+                f"{timeout}s (capacity {self.capacity})") from None
 
     def put(self, buf: bytearray) -> None:
         if len(buf) != self.width:
-            return                       # foreign buffer: drop it
+            # a foreign-width buffer returned here would poison a later
+            # get() with a wrong-geometry staging buffer — caller bug,
+            # surface it
+            raise ValueError(
+                f"foreign buffer: width {len(buf)} != pool {self.width}")
         try:
             self._q.put_nowait(buf)
         except queue.Full:

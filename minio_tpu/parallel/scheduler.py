@@ -37,6 +37,37 @@ class _Pending:
         self.error: Optional[Exception] = None
 
 
+class EncodeFuture:
+    """Handle for one submitted encode+digest group — the non-blocking
+    dispatch seam of the PUT pipeline: the reader thread submits and
+    moves on; the write stage resolves the future when it actually
+    needs the shards (the fork's async QAT kernel launch pattern).
+
+    result() returns (full, digests) or None when the work must take
+    the caller's local CPU path."""
+
+    __slots__ = ("_pending", "_value")
+
+    def __init__(self, pending: Optional[_Pending] = None, value=None):
+        self._pending = pending
+        self._value = value
+
+    def done(self) -> bool:
+        return self._pending is None or self._pending.event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        p = self._pending
+        if p is None:
+            return self._value
+        if not p.event.wait(timeout):
+            raise TimeoutError("encode dispatch did not complete")
+        if p.error is not None:
+            raise p.error
+        if p.full is None:
+            return None
+        return p.full, p.digests
+
+
 class BatchScheduler:
     """Geometry-bucketed device-batch former for encode+bitrot work."""
 
@@ -62,18 +93,19 @@ class BatchScheduler:
 
     # -- caller side -------------------------------------------------------
 
-    def encode_and_hash(self, codec, data: np.ndarray, algo
-                        ) -> Optional[tuple[np.ndarray, np.ndarray]]:
-        """Blocking fused encode+digest via the shared batch former.
-        Returns None when the work can't ride the device path (the
-        caller falls back to its local CPU path)."""
+    def submit(self, codec, data: np.ndarray, algo) -> EncodeFuture:
+        """Non-blocking fused encode+digest dispatch: enqueue the group
+        on the batch former and return immediately. The future resolves
+        to (full, digests), or to None when the work can't ride the
+        device path (the caller falls back to its local CPU path) —
+        declined submissions return an already-done future."""
         from .. import bitrot as bitrot_mod
         if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
                         bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S,
                         bitrot_mod.BitrotAlgorithm.SHA256):
-            return None
+            return EncodeFuture()
         if codec.m == 0:
-            return None
+            return EncodeFuture()
         # No device, no reason to queue: without a TPU (or an active
         # multi-device mesh) the dispatch always CPU-routes, so the
         # grace window + wakeup round-trip (~max_wait per encode batch)
@@ -82,20 +114,21 @@ class BatchScheduler:
         # streams is what pushes them over the routing threshold.
         from ..object.codec import _device_is_tpu, _mesh_active
         if not _device_is_tpu() and _mesh_active() is None:
-            return None
+            return EncodeFuture()
         key = (codec.k, codec.m, data.shape[-1], algo.value)
         p = _Pending(np.ascontiguousarray(data, np.uint8))
         with self._mu:
             if self._stop:
-                return None
+                return EncodeFuture()
             self._buckets.setdefault(key, []).append(p)
             self._kick.notify_all()
-        p.event.wait()
-        if p.error is not None:
-            raise p.error
-        if p.full is None:
-            return None
-        return p.full, p.digests
+        return EncodeFuture(p)
+
+    def encode_and_hash(self, codec, data: np.ndarray, algo
+                        ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Blocking fused encode+digest via the shared batch former
+        (submit + wait)."""
+        return self.submit(codec, data, algo).result()
 
     # -- collector ---------------------------------------------------------
 

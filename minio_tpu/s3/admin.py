@@ -188,7 +188,17 @@ class AdminHandlers:
             from ..utils.obd import local_obd
             drives = list(self.node.spec.drives) \
                 if self.node is not None else []
-            nodes = [local_obd(drives)]
+            # live StorageAPI objects (any wrapper depth) for the
+            # per-drive fault counters; duck-typed — FS/gateway layers
+            # have no erasure sets and report none
+            storage_drives: list = []
+            layers = getattr(self.api.obj, "server_sets", None) \
+                or [self.api.obj]
+            for layer in layers:
+                for eng in getattr(layer, "sets", None) or []:
+                    storage_drives.extend(eng.disks)
+            nodes = [local_obd(drives,
+                               storage_drives=storage_drives or None)]
             net: list = []
             if self.node is not None:
                 nodes[0]["node"] = self.node.spec.addr
@@ -633,6 +643,37 @@ class MetricsHandler:
                   "MRF heals that exhausted retries")
             gauge("minio_heal_mrf_dropped_total", mrf.get("dropped", 0),
                   "MRF enqueues dropped (queue full)")
+        # pipelined data path: overlap accounting (wall vs sum-of-stage
+        # seconds — stage > wall means the stages actually ran
+        # concurrently), GET lookahead savings, staging-pool pressure
+        from ..parallel import pipeline as _pl
+        ps = _pl.STATS.snapshot()
+        gauge("minio_tpu_pipeline_enabled", ps["enabled"],
+              "1 when the pipelined PUT/GET hot loops are selected")
+        gauge("minio_tpu_pipeline_put_streams_total", ps["put_streams"],
+              "PUT streams run through the stage pipeline")
+        gauge("minio_tpu_pipeline_put_batches_total", ps["put_batches"],
+              "Encode batches fed through the PUT pipeline")
+        gauge("minio_tpu_pipeline_put_wall_seconds_total",
+              ps["put_wall_s"], "Wall seconds inside pipelined PUT loops")
+        gauge("minio_tpu_pipeline_put_stage_seconds_total",
+              ps["put_stage_s"],
+              "Summed per-stage seconds (ingest+encode+write) of "
+              "pipelined PUT loops; ratio vs wall = achieved overlap")
+        gauge("minio_tpu_pipeline_get_groups_total", ps["get_groups"],
+              "GET block groups read")
+        gauge("minio_tpu_pipeline_get_prefetched_total",
+              ps["get_prefetched"],
+              "GET block groups served via the one-group lookahead")
+        gauge("minio_tpu_pipeline_get_prefetch_saved_seconds_total",
+              ps["get_prefetch_saved_s"],
+              "Drive-read seconds hidden behind verify+decode by the "
+              "GET lookahead")
+        gauge("minio_tpu_pipeline_bpool_waits_total", ps["bpool_waits"],
+              "Staging-buffer gets that had to block (back-pressure)")
+        gauge("minio_tpu_pipeline_bpool_exhausted_total",
+              ps["bpool_exhausted"],
+              "Staging-buffer gets that timed out (pipeline stalled)")
         # background plane liveness: consecutive scan failures per loop
         if self.node is not None:
             for attr, name in (("disk_monitor", "disk_monitor"),

@@ -363,9 +363,15 @@ class NaughtyDisk(StorageAPI):
                 self.stats.truncated += 1
             limit = max(1, length // 2)
         if sched.corrupts("read_file_stream", n):
-            with self._mu:
-                self.stats.bitrot += 1
             flip_at = sched.fault_offset("read_file_stream", n, length)
+            if 0 <= limit <= flip_at:
+                # the flip lands past the truncation point: no byte is
+                # actually mutated, so the stat must not claim one
+                # (FaultStats records what was INJECTED, not rolled)
+                flip_at = -1
+            else:
+                with self._mu:
+                    self.stats.bitrot += 1
         if limit < 0 and flip_at < 0:
             return stream
         return _TruncatedStream(stream, limit, flip_at)

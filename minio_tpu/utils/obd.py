@@ -59,6 +59,44 @@ def probe_drive(path: str, size: int = 64 << 10) -> dict:
     return info
 
 
+def drive_fault_counters(disks) -> list[dict]:
+    """Per-drive fault counters from the live StorageAPI objects (ROADMAP
+    follow-up: surface NaughtyDisk/transport faults in OBD): unwrap each
+    drive's wrapper chain (DiskIDCheck → NaughtyDisk → XLStorage /
+    RemoteStorage) and collect whatever counters it carries —
+
+      * NaughtyDisk fault-injection stats (errors, latency, bitrot,
+        truncated, offline_hits) — what chaos actually injected;
+      * RemoteStorage transport counters (calls, net_errors, retries,
+        offline_trips) — what the internode plane actually suffered.
+
+    Drives with neither report only their identity; a None slot reports
+    offline. Duck-typed so gateways/FS layers return []."""
+    out: list[dict] = []
+    for i, d in enumerate(disks):
+        entry: dict = {"index": i,
+                       "drive": str(d) if d is not None else None,
+                       "online": d is not None}
+        cur, hops = d, 0
+        while cur is not None and hops < 8:
+            hops += 1
+            stats = getattr(cur, "stats", None)
+            if stats is not None and hasattr(stats, "offline_hits"):
+                entry["faults"] = {
+                    "errors": stats.errors, "latency": stats.latency,
+                    "bitrot": stats.bitrot,
+                    "truncated": stats.truncated,
+                    "offline_hits": stats.offline_hits,
+                    "total_ops": getattr(cur, "total_ops", 0),
+                }
+            rc = getattr(cur, "rc", None)
+            if rc is not None and hasattr(rc, "net_counters"):
+                entry["transport"] = rc.net_counters()
+            cur = getattr(cur, "inner", None)
+        out.append(entry)
+    return out
+
+
 def _process_info() -> dict:
     """This server process's own footprint (reference OBD bundles
     process detail alongside host cpu/mem)."""
@@ -79,13 +117,16 @@ def _process_info() -> dict:
     return out
 
 
-def local_obd(drive_paths: list[str] | None = None) -> dict:
-    """This node's OBD facts; the peer plane fans this out cluster-wide."""
+def local_obd(drive_paths: list[str] | None = None,
+              storage_drives=None) -> dict:
+    """This node's OBD facts; the peer plane fans this out cluster-wide.
+    `storage_drives` (live StorageAPI objects, any wrapper depth) adds
+    per-drive fault counters alongside the latency probes."""
     try:
         load1, load5, load15 = os.getloadavg()
     except OSError:
         load1 = load5 = load15 = 0.0
-    return {
+    out = {
         "hostname": socket.gethostname(),
         "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "cpu": {"count": os.cpu_count() or 0,
@@ -95,3 +136,6 @@ def local_obd(drive_paths: list[str] | None = None) -> dict:
         "process": _process_info(),
         "drives": [probe_drive(p) for p in (drive_paths or [])],
     }
+    if storage_drives is not None:
+        out["drive_faults"] = drive_fault_counters(storage_drives)
+    return out

@@ -204,6 +204,10 @@ def test_metrics_endpoint(server):
     assert r.status == 200
     assert "minio_disks_online 4" in text
     assert "minio_capacity_raw_total_bytes" in text
+    # pipelined data path overlap accounting is always exported
+    assert "minio_tpu_pipeline_enabled" in text
+    assert "minio_tpu_pipeline_put_wall_seconds_total" in text
+    assert "minio_tpu_pipeline_bpool_waits_total" in text
 
 def test_admin_profiling(client, server):
     st, body = client.request("POST", "/minio/admin/v3/profiling/start")

@@ -149,6 +149,40 @@ def test_chaos_flaky_verbs_converge(tmp_path):
         sets.close()
 
 
+def test_chaos_pipelined_put_writer_death_mid_batch(tmp_path,
+                                                    monkeypatch):
+    """Pipelined PUT with a drive dying MID-BATCH (an append_file frame
+    write fails while later batches are still being ingested/encoded):
+    write-quorum semantics hold — the PUT succeeds with <= parity
+    writers lost, the 2-phase commit counts the dead drive, MRF is fed
+    and heals the object back to full redundancy, bytes identical."""
+    from minio_tpu.object import bitrot_io, engine as engine_mod
+    from minio_tpu.parallel import pipeline as pl
+    assert pl.ENABLED        # the default; the test targets this path
+    # small batches + per-frame flushes so the failure lands inside
+    # the write stage of a mid-stream batch, not at writer close
+    monkeypatch.setattr(engine_mod, "ENCODE_BATCH_BLOCKS", 2)
+    monkeypatch.setattr(bitrot_io.StreamingBitrotWriter,
+                        "FLUSH_THRESHOLD", 1)
+    seed = chaos_seed(2201)
+    announce(seed)
+    sets, naughty = make_chaos_sets(tmp_path,
+                                    {0: FaultSchedule(seed=seed)})
+    try:
+        nd = naughty[0]
+        nd.arm()
+        # the 5th frame append on drive 0 fails: mid-stream, mid-batch
+        nd.verb_errors["append_file"] = {5: serr.FaultyDisk("mid-batch")}
+        data = payload(10 * BLOCK + 1234, seed=seed)
+        sets.put_object("b", "o", data)
+        assert nd.stats.calls.get("append_file", 0) >= 5
+        stats = sets.mrf_stats()
+        assert stats["queued"] >= 1        # degraded write fed MRF
+        assert_converged(sets, {"o": data})
+    finally:
+        sets.close()
+
+
 def test_chaos_truncated_streams_and_short_writes(tmp_path):
     """Truncated read streams (mid-stream disconnects) on one drive and
     silent short writes on another stay invisible to clients and heal

@@ -193,6 +193,29 @@ def test_versioned_degraded_put_reports_version(tmp_path):
     assert calls == [("b", "v", oi.version_id)]
 
 
+def test_multipart_commit_at_quorum_fires_degraded_write_hook(tmp_path):
+    """CompleteMultipartUpload that met quorum but lost a drive on the
+    commit rename feeds the MRF queue (ROADMAP follow-up: the multipart
+    commit path previously bypassed on_degraded_write)."""
+    from minio_tpu.object import CompletePart
+    eng = make_engine(tmp_path)
+    calls = []
+    eng.on_degraded_write = lambda b, o, v: calls.append((b, o))
+    uid = eng.new_multipart_upload("b", "mp")
+    part = eng.put_object_part("b", "mp", uid, 1, b"q" * 4000)
+    eng.complete_multipart_upload(
+        "b", "mp", uid, [CompletePart(1, part.etag)])
+    assert calls == []                 # clean commit: quiet
+    uid = eng.new_multipart_upload("b", "mp2")
+    part = eng.put_object_part("b", "mp2", uid, 1, b"r" * 4000)
+    eng.disks[0].fail_verbs["rename_data"] = serr.FaultyDisk("boom")
+    eng.complete_multipart_upload(
+        "b", "mp2", uid, [CompletePart(1, part.etag)])
+    assert calls == [("b", "mp2")]     # degraded commit: MRF fed
+    _, it = eng.get_object("b", "mp2")
+    assert b"".join(it) == b"r" * 4000
+
+
 def test_degraded_delete_fires_hook(tmp_path):
     eng = make_engine(tmp_path)
     eng.put_object("b", "o", b"d" * 200)

@@ -104,16 +104,45 @@ def test_scheduler_propagates_errors(device_codec, monkeypatch):
 
 
 def test_bytepool_backpressure():
+    from minio_tpu.parallel.bpool import BytePoolExhausted
     pool = BytePool(1024, 2)
     a, b = pool.get(), pool.get()
-    with pytest.raises(Exception):
+    with pytest.raises(BytePoolExhausted):
         pool.get(timeout=0.05)
+    assert pool.exhausted == 1 and pool.waits >= 1
     pool.put(a)
     c = pool.get(timeout=1.0)
     assert len(c) == 1024
-    pool.put(bytearray(5))     # wrong width: silently dropped
+    with pytest.raises(ValueError):
+        pool.put(bytearray(5))  # foreign width: rejected loudly
     pool.put(b)
     pool.put(c)
+
+
+def test_scheduler_submit_future_nonblocking():
+    """submit() must return immediately; a declined submission resolves
+    to None (the caller's CPU fallback) without waiting."""
+    sched = BatchScheduler()
+    codec = Codec(4, 2, 4 * 128)
+    data = np.zeros((1, 4, 128), np.uint8)
+    fut = sched.submit(codec, data,
+                       bitrot_mod.BitrotAlgorithm.BLAKE2B512)
+    assert fut.done() and fut.result() is None
+    sched.close()
+
+
+def test_scheduler_submit_resolves_on_device_route(device_codec):
+    sched = BatchScheduler(max_batch=16, max_wait=0.01)
+    codec = Codec(4, 2, 4 * 256)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (2, 4, 256), dtype=np.uint8)
+    fut = sched.submit(codec, data, HH)
+    out = fut.result(timeout=30)
+    assert out is not None
+    full, _dg = out
+    assert (full == codec.encode_batch(data, force="numpy")).all()
+    assert fut.done()
+    sched.close()
 
 
 def test_requests_budget_formula():
