@@ -335,7 +335,7 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
     from minio_tpu.object import codec as codec_mod
     from minio_tpu.object.sets import ErasureSets
     from minio_tpu.parallel import pipeline as pl
-    from minio_tpu.utils import stagetimer
+    from minio_tpu.utils import stagetimer, telemetry
 
     # the A/B isolates HOST-path overlap: on the axon tunnel host the
     # device cannot sit on this path (~15 MiB/s host->device), matching
@@ -347,10 +347,14 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
         tempfile.gettempdir()
     payload = os.urandom(size)
     was_enabled = pl.ENABLED
+    was_sampling = (telemetry.SPANS.slow_s, telemetry.SPANS.sample)
     out: dict = {"config": {"streams": streams, "size": size,
                             "k": drives - parity, "m": parity,
                             "block": 1 << 20}}
     try:
+        # keep every bench trace: the per-config snapshot reports the
+        # top-5 slowest span trees for stage-level attribution
+        telemetry.SPANS.configure(sample=1.0)
         for mode in ("serial", "pipelined"):
             pl.ENABLED = mode == "pipelined"
             root = tempfile.mkdtemp(prefix=f"bench_ab_{mode}_", dir=base)
@@ -362,17 +366,30 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
                 sets.put_object("bench", "warm", payload)   # warm path
                 stagetimer.enable()
                 stagetimer.reset()
+                telemetry.SPANS.clear()
+
+                def put_one(i: int, prefix: str = "o",
+                            traced: bool = True) -> None:
+                    if traced:
+                        with telemetry.trace("bench.put", mode=mode,
+                                             stream=i):
+                            sets.put_object("bench", f"{prefix}{i}",
+                                            payload)
+                    else:
+                        sets.put_object("bench", f"{prefix}{i}", payload)
+
                 t0 = time.perf_counter()
                 with cf.ThreadPoolExecutor(max_workers=streams) as ex:
-                    list(ex.map(lambda i: sets.put_object(
-                        "bench", f"o{i}", payload), range(streams)))
+                    list(ex.map(put_one, range(streams)))
                 put_wall = time.perf_counter() - t0
                 t0 = time.perf_counter()
 
                 def read_back(i: int) -> None:
-                    _, it = sets.get_object("bench", f"o{i}")
-                    n = sum(len(c) for c in it)
-                    assert n == size, (i, n)
+                    with telemetry.trace("bench.get", mode=mode,
+                                         stream=i):
+                        _, it = sets.get_object("bench", f"o{i}")
+                        n = sum(len(c) for c in it)
+                        assert n == size, (i, n)
 
                 with cf.ThreadPoolExecutor(max_workers=streams) as ex:
                     list(ex.map(read_back, range(streams)))
@@ -386,7 +403,45 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
                     "get_wall_s": round(get_wall, 2),
                     "stage_percentiles_ms": stagetimer.percentiles(),
                     "overlap": stagetimer.overlap_report(),
+                    # the perf trajectory carries stage-level
+                    # attribution: slowest span trees are per-config
+                    # (SPANS.clear() above); the registry counters are
+                    # PROCESS-CUMULATIVE at snapshot time — labelled
+                    # so, since earlier configs/phases contribute
+                    "telemetry": {
+                        "metrics_cumulative": telemetry.REGISTRY
+                        .snapshot("minio_tpu_"),
+                        "top_spans": telemetry.SPANS.dump(
+                            5, slowest=True),
+                    },
                 }
+                if mode == "pipelined":
+                    # telemetry-on overhead: identical PUT batches with
+                    # and without a root span (span() is a no-op with
+                    # none active). Warm round first, then interleaved
+                    # timed pairs, best-of to shave scheduler noise —
+                    # comparing a cold traced round against a warm
+                    # untraced one would charge the page cache to
+                    # telemetry.
+                    ns = min(streams, 8)
+
+                    def put_round(traced: bool, prefix: str) -> float:
+                        t0 = time.perf_counter()
+                        with cf.ThreadPoolExecutor(
+                                max_workers=ns) as ex:
+                            list(ex.map(
+                                lambda i: put_one(i, prefix=prefix,
+                                                  traced=traced),
+                                range(ns)))
+                        return time.perf_counter() - t0
+
+                    put_round(False, "u")          # warm (untimed)
+                    plain, traced = [], []
+                    for _ in range(2):             # interleaved pairs
+                        plain.append(put_round(False, "u"))
+                        traced.append(put_round(True, "v"))
+                    out["telemetry_overhead_x"] = round(
+                        min(traced) / min(plain), 4)
             finally:
                 stagetimer.disable()
                 sets.close()
@@ -398,6 +453,7 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
     finally:
         pl.ENABLED = was_enabled
         codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        telemetry.SPANS.configure(*was_sampling)
     return out
 
 
@@ -414,10 +470,35 @@ def main() -> int:
     ap.add_argument("--ab-size", type=int,
                     default=int(os.environ.get("BENCH_AB_SIZE",
                                                str(16 << 20))))
+    ap.add_argument("--spans", action="store_true",
+                    help="pretty-print the top-5 slowest span trees of "
+                         "each A/B config to stderr")
     args = ap.parse_args()
+
+    def emit_spans(ab: dict) -> None:
+        if not args.spans or not isinstance(ab, dict):
+            return
+
+        def walk(node, indent=0):
+            attrs = node.get("attrs", {})
+            label = " ".join(f"{k}={v}" for k, v in attrs.items())
+            print(f"{'  ' * indent}{node['name']} "
+                  f"{node['duration_ms']:.2f}ms {label}".rstrip(),
+                  file=sys.stderr)
+            for c in node.get("children", ()):
+                walk(c, indent + 1)
+
+        for mode in ("serial", "pipelined"):
+            trees = (ab.get(mode) or {}).get(
+                "telemetry", {}).get("top_spans") or []
+            print(f"-- {mode}: top {len(trees)} slowest traces --",
+                  file=sys.stderr)
+            for t in trees:
+                walk(t)
 
     if args.ab_only:
         ab = bench_pipeline_ab(args.ab_streams, args.ab_size)
+        emit_spans(ab)
         print(json.dumps({
             "metric": "e2e PutObject pipeline A/B "
                       "(engine path, config #2)",
@@ -438,6 +519,7 @@ def main() -> int:
             "BENCH_PIPELINE_AB", "1").lower() not in ("0", "false", "no"):
         try:
             ab = bench_pipeline_ab(args.ab_streams, args.ab_size)
+            emit_spans(ab)
         except Exception as e:  # noqa: BLE001 — recorded, not fatal
             ab = {"error": repr(e)}
     out = {

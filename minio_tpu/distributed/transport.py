@@ -34,9 +34,22 @@ import time
 import urllib.parse
 from typing import Callable, Optional
 
-from ..utils import backoff_delay
+from ..utils import backoff_delay, telemetry
 
 DEFAULT_TIMEOUT = 30.0
+
+# process-wide transport fault counters (per-endpoint counts stay on
+# each RestClient for the OBD bundle; these aggregates feed Prometheus)
+_RPC_CALLS = telemetry.REGISTRY.counter(
+    "minio_tpu_rpc_calls_total", "Internode RPC verbs attempted")
+_RPC_NET_ERRORS = telemetry.REGISTRY.counter(
+    "minio_tpu_rpc_net_errors_total",
+    "Internode RPC transport failures (per attempt)")
+_RPC_RETRIES = telemetry.REGISTRY.counter(
+    "minio_tpu_rpc_retries_total", "Internode RPC retry attempts")
+_RPC_OFFLINE_TRIPS = telemetry.REGISTRY.counter(
+    "minio_tpu_rpc_offline_trips_total",
+    "Peer online->offline transitions")
 HEALTH_PROBE_INTERVAL = 1.0
 HEALTH_PROBE_MAX = float(os.environ.get("MINIO_TPU_PROBE_BACKOFF_MAX",
                                         "30"))
@@ -179,42 +192,48 @@ class RestClient:
                                conn_failure=True)
         with self._mu:
             self.calls += 1
+        _RPC_CALLS.inc()
         end = time.monotonic() + (deadline if deadline is not None
                                   else self.timeout)
         attempts = 1
         if idempotent and isinstance(body, (bytes, bytearray, memoryview)):
             attempts += RPC_RETRIES
         last: Optional[NetworkError] = None
-        for attempt in range(attempts):
-            remaining = end - time.monotonic()
-            if remaining <= 0:
-                break
-            if attempt:
-                with self._mu:
-                    self.retries += 1
-            try:
-                return self._call_once(verb, args, body, stream_response,
-                                       body_length,
-                                       timeout=min(self.timeout,
-                                                   remaining))
-            except NetworkError as e:
-                with self._mu:
-                    self.net_errors += 1
-                last = e
-                if attempt + 1 >= attempts:
+        with telemetry.span(f"rpc.{verb}",
+                            host=f"{self.host}:{self.port}"):
+            for attempt in range(attempts):
+                remaining = end - time.monotonic()
+                if remaining <= 0:
                     break
-                backoff = backoff_delay(RPC_RETRY_BACKOFF,
-                                        RPC_RETRY_BACKOFF_MAX, attempt)
-                if time.monotonic() + backoff >= end:
-                    break
-                time.sleep(backoff)
-        if last is None:
-            last = NetworkError(
-                f"{self.host}:{self.port} {verb}: deadline exceeded",
-                conn_failure=True)
-        if last.conn_failure:
-            self.mark_offline()
-        raise last
+                if attempt:
+                    with self._mu:
+                        self.retries += 1
+                    _RPC_RETRIES.inc()
+                try:
+                    return self._call_once(verb, args, body,
+                                           stream_response, body_length,
+                                           timeout=min(self.timeout,
+                                                       remaining))
+                except NetworkError as e:
+                    with self._mu:
+                        self.net_errors += 1
+                    _RPC_NET_ERRORS.inc()
+                    last = e
+                    if attempt + 1 >= attempts:
+                        break
+                    backoff = backoff_delay(RPC_RETRY_BACKOFF,
+                                            RPC_RETRY_BACKOFF_MAX,
+                                            attempt)
+                    if time.monotonic() + backoff >= end:
+                        break
+                    time.sleep(backoff)
+            if last is None:
+                last = NetworkError(
+                    f"{self.host}:{self.port} {verb}: deadline exceeded",
+                    conn_failure=True)
+            if last.conn_failure:
+                self.mark_offline()
+            raise last
 
     def _call_once(self, verb: str, args: Optional[dict], body,
                    stream_response: bool, body_length: Optional[int],
@@ -229,13 +248,20 @@ class RestClient:
             length = body_length
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout)
+        headers = {
+            "Authorization":
+                "Bearer " + make_token(self.access_key,
+                                       self.secret_key),
+            "Content-Length": str(length),
+        }
+        cur = telemetry.current_span()
+        if cur is not None:
+            # propagate the trace identity so the serving side joins
+            # this request's span tree (fragment, grafted by span id)
+            headers[telemetry.TRACE_HEADER] = cur.trace_id
+            headers[telemetry.SPAN_HEADER] = cur.span_id
         try:
-            conn.request("POST", path, body=body, headers={
-                "Authorization":
-                    "Bearer " + make_token(self.access_key,
-                                           self.secret_key),
-                "Content-Length": str(length),
-            })
+            conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
             if resp.status != 200:
                 payload = resp.read()
@@ -275,6 +301,7 @@ class RestClient:
                 return
             self._online = False
             self.offline_trips += 1
+            _RPC_OFFLINE_TRIPS.inc()
             self._prober = threading.Thread(target=self._probe_loop,
                                             daemon=True)
             self._prober.start()
@@ -378,8 +405,19 @@ class RPCHandler:
         args = {k: v[0] for k, v in ctx.req.query.items()}
         body = ctx.body_stream if verb in self._stream_verbs \
             else ctx.read_body()
+        # join the caller's trace when it sent one: the handler runs
+        # under a remote-side span recorded as a fragment and grafted
+        # back into the caller's tree by span id
+        tid = ctx.header(telemetry.TRACE_HEADER)
+        join_cm = telemetry.join(
+            f"rpc.server.{verb}", tid,
+            ctx.header(telemetry.SPAN_HEADER)) if tid else None
         try:
-            out = fn(args, body)
+            if join_cm is not None:
+                with join_cm:
+                    out = fn(args, body)
+            else:
+                out = fn(args, body)
         except Exception as e:  # noqa: BLE001 — serialize to the caller
             return HTTPResponse(status=500, body=json.dumps(
                 {"kind": type(e).__name__, "message": str(e)}).encode())

@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
-from ..utils import stagetimer
+from ..utils import stagetimer, telemetry
 from ..storage.api import StorageAPI
 from ..storage.datatypes import (BLOCK_SIZE_V1, ChecksumInfo, FileInfo,
                                  ObjectInfo, new_file_info, now)
@@ -216,6 +216,14 @@ class ErasureObjects:
     def put_object(self, bucket: str, object_name: str, reader,
                    size: int = -1, opts: Optional[PutOptions] = None
                    ) -> ObjectInfo:
+        with telemetry.span("engine.put_object", bucket=bucket,
+                            object=object_name, size=size):
+            return self._put_object(bucket, object_name, reader, size,
+                                    opts)
+
+    def _put_object(self, bucket: str, object_name: str, reader,
+                    size: int = -1, opts: Optional[PutOptions] = None
+                    ) -> ObjectInfo:
         opts = opts or PutOptions()
         if isinstance(reader, (bytes, bytearray)):
             import io as _io
@@ -350,7 +358,9 @@ class ErasureObjects:
 
         def encode_stage(item):
             t0 = time.perf_counter()
-            with stagetimer.stage("put.encode+digest"):
+            with stagetimer.stage("put.encode+digest"), \
+                    telemetry.span("pipeline.encode",
+                                   blocks=item["data"].shape[0]):
                 fut, data = item["fut"], item["data"]
                 fused = fut.result() if fut is not None else \
                     codec.encode_and_hash_batch(data, self.bitrot_algo)
@@ -361,7 +371,8 @@ class ErasureObjects:
         def write_stage(item):
             t0 = time.perf_counter()
             try:
-                with stagetimer.stage("put.shard_write"):
+                with stagetimer.stage("put.shard_write"), \
+                        telemetry.span("pipeline.shard_write"):
                     rows, parity, dd, dp = item["rows"]
                     self._write_shards_batch(rows, parity, dd, dp,
                                              writers, write_quorum)
@@ -549,7 +560,8 @@ class ErasureObjects:
                       write_quorum: int) -> None:
         """Encode+digest one (B, k, S) batch and fan the framed shard
         writes out — data rows go to the writers as views of `data`."""
-        with stagetimer.stage("put.encode+digest"):
+        with stagetimer.stage("put.encode+digest"), \
+                telemetry.span("pipeline.encode", blocks=data.shape[0]):
             # fused device encode+digest when routed there (one program,
             # one round-trip); the cross-request scheduler coalesces
             # concurrent PUT streams into shared dispatches
@@ -560,7 +572,8 @@ class ErasureObjects:
                 fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
             data_rows, parity, dd, dp = self._unpack_fused(codec, data,
                                                            fused)
-        with stagetimer.stage("put.shard_write"):
+        with stagetimer.stage("put.shard_write"), \
+                telemetry.span("pipeline.shard_write"):
             self._write_shards_batch(data_rows, parity, dd, dp, writers,
                                      write_quorum)
 
@@ -579,8 +592,10 @@ class ErasureObjects:
         def write(i: int, w) -> None:
             rows, digs, j = (data, dd, i) if i < k else \
                 (parity, dp, i - k)
-            for bi in range(B):
-                w.write_with_digest(rows[bi, j].data, digs[bi, j].data)
+            with telemetry.span("disk.shard_write", disk=i, blocks=B):
+                for bi in range(B):
+                    w.write_with_digest(rows[bi, j].data,
+                                        digs[bi, j].data)
 
         _, errs = meta.for_each_disk(
             list(writers),  # type: ignore[arg-type]
@@ -776,9 +791,15 @@ class ErasureObjects:
             try:
                 if fi.size == 0 or length == 0:
                     return
-                yield from self._read_object_stream(
-                    bucket, object_name, fi, metas, online, offset, length,
-                    suppress_heal_flag=flagged)
+                # traced_iter (NOT a plain span): the span must only be
+                # current while the read code runs, never across a
+                # yield into the consumer — see telemetry.traced_iter
+                yield from telemetry.traced_iter(
+                    "engine.get_object",
+                    self._read_object_stream(
+                        bucket, object_name, fi, metas, online, offset,
+                        length, suppress_heal_flag=flagged),
+                    bucket=bucket, object=object_name, length=length)
             finally:
                 lock.unlock()
 
@@ -892,7 +913,8 @@ class ErasureObjects:
             (per-block reads, degraded, read seconds)."""
             t0 = time.perf_counter()
             degraded = False
-            with io_lock:
+            with io_lock, telemetry.span("pipeline.read_group",
+                                         blocks=len(blocks)):
                 try:
                     reads = self._read_group_shards_raw(
                         readers, blocks, shard_size,
@@ -942,15 +964,24 @@ class ErasureObjects:
                 # this group's verify+decode — decode overlaps drive
                 # I/O, bounded to ONE group of lookahead staging
                 if pl.ENABLED and si + 1 < len(specs):
-                    lookahead = pl.PREFETCH_POOL.submit(
-                        read_group, *specs[si + 1])
+                    cctx = telemetry.propagating_context()
+                    if cctx is not None:
+                        # lookahead reads attach to this request's tree
+                        # even though they run on the prefetch pool
+                        lookahead = pl.PREFETCH_POOL.submit(
+                            cctx.run, read_group, *specs[si + 1])
+                    else:
+                        lookahead = pl.PREFETCH_POOL.submit(
+                            read_group, *specs[si + 1])
                 for (b, block_off, block_len, shard_len), \
                         (shards, digests, had_errors) in zip(geoms,
                                                              reads):
                     heal_required = heal_required or had_errors
                     group.append([b, block_off, block_len, shard_len,
                                   shards, digests])
-                with stagetimer.stage("get.verify+decode"):
+                with stagetimer.stage("get.verify+decode"), \
+                        telemetry.span("pipeline.verify_decode",
+                                       blocks=len(blocks)):
                     if self._verify_and_reconstruct_group(
                             codec, group, k, n, readers, shard_size,
                             part_algo or self.bitrot_algo,
@@ -1141,15 +1172,17 @@ class ErasureObjects:
                 if r is None or tried[indices[j]]:
                     raise serr.DiskNotFound(f"reader {indices[j]}")
                 out = []
-                for b, sl in zip(blocks, shard_lens):
-                    off = b * shard_size
-                    if collect_digests and isinstance(
-                            r, bitrot_io.StreamingBitrotReader):
-                        frames = r.read_frames(off, sl)
-                        out.append((frames[0][1] if frames else b"",
-                                    frames[0][0] if frames else None))
-                    else:
-                        out.append((r.read_at(off, sl), None))
+                with telemetry.span("disk.shard_read",
+                                    disk=indices[j], blocks=nb):
+                    for b, sl in zip(blocks, shard_lens):
+                        off = b * shard_size
+                        if collect_digests and isinstance(
+                                r, bitrot_io.StreamingBitrotReader):
+                            frames = r.read_frames(off, sl)
+                            out.append((frames[0][1] if frames else b"",
+                                        frames[0][0] if frames else None))
+                        else:
+                            out.append((r.read_at(off, sl), None))
                 return out
 
             results, errs = meta.for_each_disk(

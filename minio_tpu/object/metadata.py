@@ -96,7 +96,16 @@ def for_each_disk(disks: Sequence[Optional[StorageAPI]],
         except Exception as e:  # noqa: BLE001 — per-drive fault isolation
             errs[i] = e
 
-    futures = [_POOL.submit(run, i) for i in range(len(disks))]
+    from ..utils import telemetry
+    if telemetry.current_span() is not None:
+        # carry the caller's span into the pool workers so per-drive
+        # I/O attaches to the request tree; one Context copy per task
+        # (a Context must never run in two threads at once)
+        import contextvars
+        futures = [_POOL.submit(contextvars.copy_context().run, run, i)
+                   for i in range(len(disks))]
+    else:
+        futures = [_POOL.submit(run, i) for i in range(len(disks))]
     for f in futures:
         f.result()
     return results, errs

@@ -31,12 +31,14 @@ Env knobs (documented in README "Pipelined data path"):
 
 from __future__ import annotations
 
+import contextvars
 import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Sequence
 
+from ..utils import telemetry
 from .bpool import BytePool
 
 ENABLED = os.environ.get("MINIO_TPU_PIPELINE", "on").strip().lower() \
@@ -154,6 +156,54 @@ class PipelineStats:
 
 STATS = PipelineStats()
 
+_PIPELINE_GAUGE_HELP = {
+    "enabled": "1 when the pipelined PUT/GET hot loops are selected",
+    "put_streams_total": "PUT streams run through the stage pipeline",
+    "put_batches_total": "Encode batches fed through the PUT pipeline",
+    "put_wall_seconds_total": "Wall seconds inside pipelined PUT loops",
+    "put_stage_seconds_total":
+        "Summed per-stage seconds (ingest+encode+write) of pipelined "
+        "PUT loops; ratio vs wall = achieved overlap",
+    "get_groups_total": "GET block groups read",
+    "get_prefetched_total":
+        "GET block groups served via the one-group lookahead",
+    "get_prefetch_saved_seconds_total":
+        "Drive-read seconds hidden behind verify+decode by the GET "
+        "lookahead",
+    "bpool_waits_total":
+        "Staging-buffer gets that had to block (back-pressure)",
+    "bpool_exhausted_total":
+        "Staging-buffer gets that timed out (pipeline stalled)",
+}
+# snapshot key -> exported suffix (names predate the registry and are
+# kept stable for dashboards/tests)
+_PIPELINE_GAUGE_KEYS = {
+    "enabled": "enabled",
+    "put_streams": "put_streams_total",
+    "put_batches": "put_batches_total",
+    "put_wall_s": "put_wall_seconds_total",
+    "put_stage_s": "put_stage_seconds_total",
+    "get_groups": "get_groups_total",
+    "get_prefetched": "get_prefetched_total",
+    "get_prefetch_saved_s": "get_prefetch_saved_seconds_total",
+    "bpool_waits": "bpool_waits_total",
+    "bpool_exhausted": "bpool_exhausted_total",
+}
+
+
+def _collect_pipeline_metrics() -> None:
+    """Registry collector: refresh minio_tpu_pipeline_* from STATS at
+    exposition time (no polling thread)."""
+    snap = STATS.snapshot()
+    for key, suffix in _PIPELINE_GAUGE_KEYS.items():
+        if key in snap:
+            telemetry.REGISTRY.gauge(
+                f"minio_tpu_pipeline_{suffix}",
+                _PIPELINE_GAUGE_HELP[suffix]).set(snap[key])
+
+
+telemetry.REGISTRY.register_collector(_collect_pipeline_metrics)
+
 
 # ---------------------------------------------------------------------------
 # the stage executor
@@ -185,8 +235,19 @@ class StagePipeline:
                         for _ in stages]
         self._error: Optional[BaseException] = None
         self._err_mu = threading.Lock()
+        # stage workers inherit the creating request's span context so
+        # stage-body spans land in the right tree (one Context copy per
+        # thread — a Context must not run concurrently)
+        tracing = telemetry.current_span() is not None
+
+        def _target(i: int) -> Callable:
+            if not tracing:
+                return lambda: self._run(i)
+            cctx = contextvars.copy_context()
+            return lambda: cctx.run(self._run, i)
+
         self._threads = [
-            threading.Thread(target=self._run, args=(i,),
+            threading.Thread(target=_target(i),
                              name=f"{name}-stage{i}", daemon=True)
             for i in range(len(stages))]
         for t in self._threads:

@@ -18,16 +18,51 @@ from __future__ import annotations
 
 import os
 import threading
+import time
+import weakref
 from typing import Optional
 
 import numpy as np
 
+from ..utils import telemetry
+
 MAX_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_SCHED_MAX_BATCH", "32"))
 MAX_WAIT_S = float(os.environ.get("MINIO_TPU_SCHED_MAX_WAIT_MS", "3")) / 1e3
 
+# live schedulers, summed by the registry collector at exposition time
+_SCHEDULERS: "weakref.WeakSet[BatchScheduler]" = weakref.WeakSet()
+
+
+def _collect_scheduler_metrics() -> None:
+    reg = telemetry.REGISTRY
+    queued_groups = queued_blocks = batches = coalesced = blocks = 0
+    for s in list(_SCHEDULERS):
+        st = s.stats()
+        queued_groups += st["queued_groups"]
+        queued_blocks += st["queued_blocks"]
+        batches += st["batches"]
+        coalesced += st["coalesced"]
+        blocks += st["dispatched_blocks"]
+    reg.gauge("minio_tpu_sched_queue_depth",
+              "Encode groups waiting on the batch former").set(
+        queued_groups)
+    reg.gauge("minio_tpu_sched_queued_blocks",
+              "Blocks waiting on the batch former").set(queued_blocks)
+    reg.gauge("minio_tpu_sched_batches_total",
+              "Fused device dispatches issued").set(batches)
+    reg.gauge("minio_tpu_sched_coalesced_total",
+              "Groups that shared another stream's dispatch").set(
+        coalesced)
+    reg.gauge("minio_tpu_sched_batch_occupancy_blocks",
+              "Mean blocks per fused dispatch (MXU batch fill)").set(
+        round(blocks / batches, 3) if batches else 0)
+
+
+telemetry.REGISTRY.register_collector(_collect_scheduler_metrics)
+
 
 class _Pending:
-    __slots__ = ("data", "event", "full", "digests", "error")
+    __slots__ = ("data", "event", "full", "digests", "error", "span")
 
     def __init__(self, data: np.ndarray):
         self.data = data
@@ -35,6 +70,9 @@ class _Pending:
         self.full: Optional[np.ndarray] = None
         self.digests: Optional[np.ndarray] = None
         self.error: Optional[Exception] = None
+        # submitter's span: the collector thread is shared across
+        # requests, so dispatch spans are attached explicitly
+        self.span = None
 
 
 class EncodeFuture:
@@ -82,9 +120,24 @@ class BatchScheduler:
         self._stop = False
         self.batches = 0              # dispatch counter (tests/metrics)
         self.coalesced = 0            # groups that shared a dispatch
+        self.dispatched_blocks = 0    # blocks through the device path
         self._thread = threading.Thread(target=self._collector,
                                         daemon=True)
         self._thread.start()
+        _SCHEDULERS.add(self)
+
+    def stats(self) -> dict:
+        """Queue depth + dispatch occupancy for the metrics registry."""
+        with self._mu:
+            plists = list(self._buckets.values())
+            queued_groups = sum(len(pl) for pl in plists)
+            queued_blocks = sum(p.data.shape[0] for pl in plists
+                                for p in pl)
+            return {"queued_groups": queued_groups,
+                    "queued_blocks": queued_blocks,
+                    "batches": self.batches,
+                    "coalesced": self.coalesced,
+                    "dispatched_blocks": self.dispatched_blocks}
 
     def close(self) -> None:
         with self._mu:
@@ -117,6 +170,7 @@ class BatchScheduler:
             return EncodeFuture()
         key = (codec.k, codec.m, data.shape[-1], algo.value)
         p = _Pending(np.ascontiguousarray(data, np.uint8))
+        p.span = telemetry.current_span()
         with self._mu:
             if self._stop:
                 return EncodeFuture()
@@ -176,9 +230,22 @@ class BatchScheduler:
             codec = Codec(k, m, s * k)
             for group in groups:
                 data = np.concatenate([p.data for p in group], axis=0)
+                t0_wall, t0 = time.time(), time.perf_counter()
                 out = codec.encode_and_hash_batch(data, algo)
+                dt = time.perf_counter() - t0
                 self.batches += 1
                 self.coalesced += len(group) - 1
+                with self._mu:
+                    self.dispatched_blocks += data.shape[0]
+                for p in group:
+                    if p.span is not None:
+                        # the collector thread serves many requests:
+                        # attach the dispatch to each submitter's tree
+                        # as an externally-timed span
+                        telemetry.attach_span(
+                            p.span, "sched.dispatch", t0_wall, dt,
+                            blocks=int(data.shape[0]),
+                            coalesced=len(group) - 1)
                 if out is None:
                     # CPU routing: let each caller use its own path
                     for p in group:

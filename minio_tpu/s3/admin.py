@@ -18,6 +18,13 @@ import uuid
 from typing import Optional
 
 from . import signature as sig
+# imported at module scope so their metric families/collectors are
+# registered as soon as the admin plane exists (each registers on
+# import: minio_tpu_profiler_running{kind=...}, minio_tpu_sched_*,
+# minio_tpu_rpc_*)
+from ..distributed import transport as _transport  # noqa: F401
+from ..parallel import scheduler as _scheduler  # noqa: F401
+from ..utils import profiling as _profiling  # noqa: F401
 from .handlers import HTTPResponse, RequestContext
 from .s3errors import S3Error
 
@@ -215,6 +222,26 @@ class AdminHandlers:
                 entries.extend(self.node.notification.trace_all())
             entries.sort(key=lambda e: e.get("time", ""))
             return self._json({"entries": entries[-500:]})
+        if sub == "spans" and m == "GET":
+            # tail-sampled span trees (errors, slow requests, sampled
+            # ordinary traffic), RPC fragments grafted in — the "where
+            # did this slow PUT spend its time" endpoint
+            self._auth(ctx, "admin:ServerTrace")
+            from ..utils import telemetry
+            try:
+                n = int(ctx.query1("count", "50") or 50)
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad count") from None
+            slowest = ctx.query1("sort", "recent") == "slowest"
+            return self._json({
+                "spans": telemetry.SPANS.dump(n, slowest=slowest),
+                "kept_total": telemetry.SPANS.kept_total,
+                "dropped_total": telemetry.SPANS.dropped_total,
+                "slow_threshold_ms": round(
+                    telemetry.SPANS.slow_s * 1e3, 3),
+                "sample": telemetry.SPANS.sample,
+            })
         if sub == "trace" and m == "GET":
             self._auth(ctx, "admin:ServerTrace")
             try:
@@ -586,48 +613,51 @@ class HealthHandlers:
 
 
 class MetricsHandler:
-    """Prometheus text exposition (cmd/metrics.go subset)."""
+    """Prometheus text exposition (cmd/metrics.go analog).
+
+    Every sample now comes out of the shared telemetry registry
+    (utils/telemetry.REGISTRY): subsystems that own live state
+    (pipeline overlap, scheduler queue, profilers, RPC transport)
+    register their own collectors; the server-topology gauges below
+    are refreshed here because only this handler holds the api/node
+    handles. Metric names predate the registry and stay stable."""
 
     def __init__(self, api, node=None):
         self.api = api
         self.node = node
+        from ..utils import telemetry
+        self.reg = telemetry.REGISTRY
 
-    def route(self, ctx: RequestContext) -> HTTPResponse:
-        lines = []
-
-        def gauge(name, value, help_=""):
-            if help_:
-                lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-
+    def _collect(self) -> None:
+        g = self.reg.gauge
         try:
             info = self.api.obj.storage_info() if self.api.obj else {}
         except Exception:  # noqa: BLE001
             info = {}
-        gauge("minio_disks_online", info.get("online_disks", 0),
-              "Online drives")
-        gauge("minio_disks_offline", info.get("offline_disks", 0),
-              "Offline drives")
-        gauge("minio_capacity_raw_total_bytes", info.get("total", 0),
-              "Raw capacity")
-        gauge("minio_capacity_raw_free_bytes", info.get("free", 0),
-              "Raw free")
+        g("minio_disks_online", "Online drives").set(
+            info.get("online_disks", 0))
+        g("minio_disks_offline", "Offline drives").set(
+            info.get("offline_disks", 0))
+        g("minio_capacity_raw_total_bytes", "Raw capacity").set(
+            info.get("total", 0))
+        g("minio_capacity_raw_free_bytes", "Raw free").set(
+            info.get("free", 0))
         if self.api.usage is not None:
             u = self.api.usage.usage
-            gauge("minio_usage_object_total", u.get("objects_total", 0),
-                  "Objects")
-            gauge("minio_usage_size_total_bytes", u.get("size_total", 0),
-                  "Logical bytes")
+            g("minio_usage_object_total", "Objects").set(
+                u.get("objects_total", 0))
+            g("minio_usage_size_total_bytes", "Logical bytes").set(
+                u.get("size_total", 0))
+            bg = g("minio_bucket_usage_size_bytes",
+                   "Logical bytes per bucket")
+            bg.clear()          # deleted buckets must drop off
             for b, v in u.get("buckets", {}).items():
-                lines.append(
-                    f'minio_bucket_usage_size_bytes{{bucket="{b}"}} '
-                    f'{v["size"]}')
+                bg.set(v["size"], bucket=b)
         if self.api.replication is not None:
-            gauge("minio_replication_completed_total",
-                  self.api.replication.replicated, "Replicated ops")
-            gauge("minio_replication_failed_total",
-                  self.api.replication.failed, "Failed replication ops")
+            g("minio_replication_completed_total",
+              "Replicated ops").set(self.api.replication.replicated)
+            g("minio_replication_failed_total",
+              "Failed replication ops").set(self.api.replication.failed)
         # MRF heal queue (degraded reads/writes awaiting re-redundancy)
         mrf_fn = getattr(self.api.obj, "mrf_stats", None)
         if callable(mrf_fn):
@@ -635,45 +665,16 @@ class MetricsHandler:
                 mrf = mrf_fn()
             except Exception:  # noqa: BLE001
                 mrf = {}
-            gauge("minio_heal_mrf_pending", mrf.get("pending", 0),
-                  "Objects queued for MRF heal")
-            gauge("minio_heal_mrf_healed_total", mrf.get("healed", 0),
-                  "Objects healed via MRF")
-            gauge("minio_heal_mrf_failed_total", mrf.get("failed", 0),
-                  "MRF heals that exhausted retries")
-            gauge("minio_heal_mrf_dropped_total", mrf.get("dropped", 0),
-                  "MRF enqueues dropped (queue full)")
-        # pipelined data path: overlap accounting (wall vs sum-of-stage
-        # seconds — stage > wall means the stages actually ran
-        # concurrently), GET lookahead savings, staging-pool pressure
-        from ..parallel import pipeline as _pl
-        ps = _pl.STATS.snapshot()
-        gauge("minio_tpu_pipeline_enabled", ps["enabled"],
-              "1 when the pipelined PUT/GET hot loops are selected")
-        gauge("minio_tpu_pipeline_put_streams_total", ps["put_streams"],
-              "PUT streams run through the stage pipeline")
-        gauge("minio_tpu_pipeline_put_batches_total", ps["put_batches"],
-              "Encode batches fed through the PUT pipeline")
-        gauge("minio_tpu_pipeline_put_wall_seconds_total",
-              ps["put_wall_s"], "Wall seconds inside pipelined PUT loops")
-        gauge("minio_tpu_pipeline_put_stage_seconds_total",
-              ps["put_stage_s"],
-              "Summed per-stage seconds (ingest+encode+write) of "
-              "pipelined PUT loops; ratio vs wall = achieved overlap")
-        gauge("minio_tpu_pipeline_get_groups_total", ps["get_groups"],
-              "GET block groups read")
-        gauge("minio_tpu_pipeline_get_prefetched_total",
-              ps["get_prefetched"],
-              "GET block groups served via the one-group lookahead")
-        gauge("minio_tpu_pipeline_get_prefetch_saved_seconds_total",
-              ps["get_prefetch_saved_s"],
-              "Drive-read seconds hidden behind verify+decode by the "
-              "GET lookahead")
-        gauge("minio_tpu_pipeline_bpool_waits_total", ps["bpool_waits"],
-              "Staging-buffer gets that had to block (back-pressure)")
-        gauge("minio_tpu_pipeline_bpool_exhausted_total",
-              ps["bpool_exhausted"],
-              "Staging-buffer gets that timed out (pipeline stalled)")
+            g("minio_heal_mrf_pending",
+              "Objects queued for MRF heal").set(mrf.get("pending", 0))
+            g("minio_heal_mrf_healed_total",
+              "Objects healed via MRF").set(mrf.get("healed", 0))
+            g("minio_heal_mrf_failed_total",
+              "MRF heals that exhausted retries").set(
+                mrf.get("failed", 0))
+            g("minio_heal_mrf_dropped_total",
+              "MRF enqueues dropped (queue full)").set(
+                mrf.get("dropped", 0))
         # background plane liveness: consecutive scan failures per loop
         if self.node is not None:
             for attr, name in (("disk_monitor", "disk_monitor"),
@@ -681,10 +682,17 @@ class MetricsHandler:
                                ("crawler", "crawler")):
                 loop = getattr(self.node, attr, None)
                 if loop is not None:
-                    gauge(f"minio_{name}_consecutive_errors",
-                          getattr(loop, "consecutive_errors", 0),
-                          f"Consecutive failed {name} scans")
-        return HTTPResponse(body=("\n".join(lines) + "\n").encode(),
+                    g(f"minio_{name}_consecutive_errors",
+                      f"Consecutive failed {name} scans").set(
+                        getattr(loop, "consecutive_errors", 0))
+
+    def route(self, ctx: RequestContext) -> HTTPResponse:
+        # _collect runs as this scrape's one-shot collector, NOT a
+        # globally registered one: with several servers in one process
+        # each metrics endpoint must report ITS api/node values, and a
+        # stopped server must stop reporting (registered collectors
+        # live as long as the process-global registry)
+        return HTTPResponse(body=self.reg.render(self._collect).encode(),
                             headers={"Content-Type": "text/plain"})
 
 

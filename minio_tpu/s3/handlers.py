@@ -29,7 +29,7 @@ from ..object.engine import GetOptions, PutOptions
 from ..object.hash_reader import HashReader
 from ..object.multipart import CompletePart
 from ..storage.datatypes import ObjectInfo
-from ..utils import stagetimer
+from ..utils import stagetimer, telemetry
 from . import signature as sig
 from xml.sax.saxutils import escape as _sax_escape
 
@@ -38,6 +38,14 @@ from .credentials import Credentials, global_credentials
 from .s3errors import S3Error, api_error_from
 
 MAX_OBJECT_SIZE = 5 * (1 << 40)          # 5 TiB
+
+# requests shed with 503 SlowDown, by trigger: "admission" (the
+# semaphore wait timed out) or "staging" (BytePool exhaustion — the
+# pipeline's staging rings timed out recently, so new writes would
+# stall anyway; shedding them early is the ROADMAP PR-2 follow-up)
+_SHED_TOTAL = telemetry.REGISTRY.counter(
+    "minio_tpu_requests_shed_total",
+    "Requests shed with 503 SlowDown, by reason")
 MAX_PART_SIZE = 5 * (1 << 30)            # 5 GiB
 MIN_PART_SIZE = 5 * (1 << 20)            # 5 MiB
 MAX_PARTS = 10000
@@ -248,6 +256,16 @@ class S3ApiHandlers:
             "MINIO_COMPRESS_ALGORITHM", "s2").lower()
         self.cors_allow_origin = "*"   # config api.cors_allow_origin
         self.federation = None    # optional BucketFederation (etcd DNS)
+        # staging-pressure load shedding: when the pipeline's BytePool
+        # rings time out (exhausted), new data writes are shed with
+        # SlowDown for `shed_window_s` instead of queueing into a
+        # stalled pipeline. Baselined at construction so pre-existing
+        # process-global counters don't trip a fresh handler.
+        from ..parallel import pipeline as _pl
+        self.shed_window_s = float(os.environ.get(
+            "MINIO_TPU_SHED_WINDOW_S", "5"))
+        self._shed_last_exhausted = _pl.pool_pressure()["exhausted"]
+        self._shed_until = 0.0
 
     def set_max_clients(self, n: int) -> None:
         """Re-size the admission gate once topology is known (the
@@ -505,11 +523,15 @@ class S3ApiHandlers:
         # shed load with 503, not wedge every caller forever. Bind the
         # semaphore once — set_max_clients may swap self._admission
         # mid-request, and acquire/release must hit the same object.
+        if self._should_shed(ctx):
+            _SHED_TOTAL.inc(reason="staging")
+            return self._shed_response(
+                ctx, "staging buffers exhausted, retry the request")
         sem = self._admission
         if not sem.acquire(timeout=self.request_deadline):
-            return self._error_response(
-                ctx, S3Error("SlowDown",
-                             "server is busy, retry the request"))
+            _SHED_TOTAL.inc(reason="admission")
+            return self._shed_response(
+                ctx, "server is busy, retry the request")
         release = True
         try:
             try:
@@ -523,6 +545,44 @@ class S3ApiHandlers:
         finally:
             if release:
                 sem.release()
+
+    def _should_shed(self, ctx: RequestContext) -> bool:
+        """True when this request is a data write AND the staging rings
+        reported exhaustion within the shed window. Admitting more
+        writes while the BytePool times out just queues them into a
+        stalled pipeline — shedding with 503 keeps the retry loop on
+        the client, where it belongs (reference maxClients analog,
+        fed by the PR-2 back-pressure counters). Only APIs that
+        actually stage payload bytes shed — metadata ops on object
+        paths (tagging, CompleteMultipartUpload) never touch the
+        BytePool and completing an upload under pressure RELIEVES it."""
+        if ctx.req.method not in ("PUT", "POST"):
+            return False
+        if "/" not in ctx.req.path.lstrip("/"):
+            return False              # bucket-level op, not a data write
+        from .trace import api_name_of
+        if api_name_of(ctx.req.method, ctx.req.path, ctx.req.query,
+                       ctx.req.headers) not in (
+                "PutObject", "UploadPart", "PostObject"):
+            return False
+        import time as _time
+        now = _time.monotonic()
+        from ..parallel import pipeline as _pl
+        exhausted = _pl.pool_pressure()["exhausted"]
+        if exhausted > self._shed_last_exhausted:
+            self._shed_last_exhausted = exhausted
+            self._shed_until = now + self.shed_window_s
+        return now < self._shed_until
+
+    def _shed_response(self, ctx: RequestContext,
+                       message: str) -> HTTPResponse:
+        """503 SlowDown that also CLOSES the connection: shedding must
+        unload the server, and keep-alive hygiene would otherwise
+        drain the full (possibly multi-GiB) request body off the
+        socket at the very moment the server is overloaded."""
+        resp = self._error_response(ctx, S3Error("SlowDown", message))
+        resp.headers["Connection"] = "close"
+        return resp
 
     def _error_response(self, ctx: RequestContext,
                         err: S3Error) -> HTTPResponse:

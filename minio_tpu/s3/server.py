@@ -23,10 +23,20 @@ class _DeepBacklogServer(ThreadingHTTPServer):
 from typing import Optional
 
 from . import signature as sig
+from ..utils import telemetry
 from .credentials import Credentials
 from .handlers import HTTPResponse, RequestContext, S3ApiHandlers
 
 SERVER_NAME = "MinIO-TPU"
+
+# per-API request latency + time-to-first-byte (reference
+# cmd/metrics.go httpRequestsDuration, labelled by api name)
+_HTTP_DURATION = telemetry.REGISTRY.histogram(
+    "minio_tpu_http_requests_duration_seconds",
+    "Full HTTP request latency (headers to last body byte) per API")
+_HTTP_TTFB = telemetry.REGISTRY.histogram(
+    "minio_tpu_http_ttfb_seconds",
+    "Time to first response byte per API")
 
 
 class _BodyReader:
@@ -101,6 +111,11 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
             body = resp.body
             chunked = resp.stream is not None and \
                 "Content-Length" not in resp.headers
+            if resp.headers.get("Connection", "").lower() == "close":
+                # honor a handler-requested close (load shedding): the
+                # socket is being torn down, so the dispatch loop must
+                # also skip draining the request body
+                self.close_connection = True
             self.send_response(resp.status)
             for k, v in resp.headers.items():
                 self.send_header(k, v)
@@ -170,36 +185,82 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
             # admin/health/metrics routers get first crack at the path
             ctx = self._snapshot()
             import time as _time
+            from ..utils import telemetry
+            from .trace import api_name_of
+            api_name = api_name_of(self.command, ctx.req.path,
+                                   ctx.req.query, ctx.req.headers)
             t0 = _time.perf_counter()
             status = [500]
+            ttfb = [None]
+
+            root_holder = [None]
 
             def respond(resp):
                 status[0] = resp.status
+                # TTFB: handler work is done, the status line goes out
+                # now — streaming body time lands in the full duration
+                if ttfb[0] is None:
+                    ttfb[0] = _time.perf_counter() - t0
+                if resp.long_poll and root_holder[0] is not None:
+                    # an idle event stream runs for minutes by design —
+                    # never "slow"
+                    root_holder[0].slow_exempt = True
                 self._respond(resp)
 
+            # root span: covers routing, the handler AND the response
+            # body (a streaming GET's drive reads happen inside it)
+            root_cm = telemetry.trace(api_name, method=self.command,
+                                      path=ctx.req.path)
+            trace_id = ""
             try:
-                for prefix, router in extra_routers:
-                    if self.path.startswith(prefix):
-                        resp = router(ctx)
-                        if resp is None:
-                            # router declined (e.g. the web UI owns
-                            # only exact paths under /minio/): keep
-                            # matching later-registered routers
-                            continue
-                        respond(resp)
-                        return
-                respond(api.handle(ctx))
+                with root_cm as root:
+                    root_holder[0] = root
+                    if api_name in ("Admin", "Health", "Metrics",
+                                    "WebUI"):
+                        # admin surfaces stream on purpose (`mc admin
+                        # trace` idles for its whole window): keeping
+                        # them as "slow" would crowd the spans ring
+                        # with content-free trees. Errors still keep.
+                        root.slow_exempt = True
+                    trace_id = root.trace_id
+                    for prefix, router in extra_routers:
+                        if self.path.startswith(prefix):
+                            resp = router(ctx)
+                            if resp is None:
+                                # router declined (e.g. the web UI owns
+                                # only exact paths under /minio/): keep
+                                # matching later-registered routers
+                                continue
+                            respond(resp)
+                            if resp.status >= 500:
+                                root.error = f"http {resp.status}"
+                            return
+                    respond(api.handle(ctx))
+                    if status[0] >= 500:
+                        root.error = f"http {status[0]}"
             finally:
                 # keep-alive hygiene: any request-body bytes the handler
                 # didn't consume (auth failure, early error, streaming
-                # trailer) would otherwise be parsed as the next request
-                ctx.body_stream.drain()
+                # trailer) would otherwise be parsed as the next request.
+                # Skipped when the connection is closing anyway (shed
+                # responses) — draining a multi-GiB body into a closing
+                # socket is exactly the load shedding exists to avoid.
+                if not self.close_connection:
+                    ctx.body_stream.drain()
+                dur = _time.perf_counter() - t0
+                try:
+                    _HTTP_DURATION.observe(dur, api=api_name)
+                    if ttfb[0] is not None:
+                        _HTTP_TTFB.observe(ttfb[0], api=api_name)
+                except Exception:  # noqa: BLE001 — telemetry is passive
+                    pass
                 if api.trace is not None:
                     try:
                         api.trace.record(
                             self.command, ctx.req.path, ctx.req.raw_query,
-                            status[0], _time.perf_counter() - t0,
-                            caller=self.client_address[0])
+                            status[0], dur,
+                            caller=self.client_address[0],
+                            api=api_name, trace_id=trace_id)
                     except Exception:  # noqa: BLE001 — tracing is passive
                         pass
 

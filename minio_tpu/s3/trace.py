@@ -6,42 +6,141 @@ endpoint + peer fan-out), and ships structured audit entries to webhook
 targets (cmd/logger/audit.go). Here: a middleware recording method/path/
 status/duration/caller, an in-process hub, an admin streaming endpoint,
 and an optional audit webhook.
+
+Audit shipping runs on ONE bounded-queue worker thread: the old
+thread-per-entry model could fork thousands of daemon threads against a
+slow webhook; now a full queue drops the entry and counts it
+(``minio_tpu_audit_dropped_total``) — audit is best-effort, thread
+explosions are not.
 """
 
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 import urllib.request
 from typing import Optional
 
+from ..utils import telemetry
 from ..utils.pubsub import PubSub
+
+_AUDIT_DROPPED = telemetry.REGISTRY.counter(
+    "minio_tpu_audit_dropped_total",
+    "Audit entries dropped because the webhook queue was full")
+
+
+def api_name_of(method: str, path: str, query: dict,
+                headers: Optional[dict] = None) -> str:
+    """Best-effort S3 API name for one request (the reference tags
+    every route with its api name in the router; here the label is
+    derived at the HTTP edge so the per-API latency histograms need no
+    plumbing through 60 handlers). Unrecognized calls fall into a
+    small set of coarse buckets rather than exploding label
+    cardinality."""
+    headers = headers or {}
+    p = path.lstrip("/")
+    if path.startswith("/minio/admin"):
+        return "Admin"
+    if path.startswith("/minio/health"):
+        return "Health"
+    if path.startswith("/minio/prometheus"):
+        return "Metrics"
+    if path.startswith("/minio/storage"):
+        return "StorageRPC"
+    if path.startswith(("/minio/peer", "/minio/lock")):
+        return "PeerRPC"
+    if path.startswith("/minio/"):
+        return "WebUI"
+    parts = p.split("/", 1)
+    bucket = parts[0]
+    key = parts[1] if len(parts) > 1 else ""
+    if not bucket:
+        return "ListBuckets" if method == "GET" else "STS" \
+            if method == "POST" else method
+    if key:
+        if method == "GET":
+            if "uploadId" in query:
+                return "ListParts"
+            if "tagging" in query:
+                return "GetObjectTagging"
+            return "GetObject"
+        if method == "HEAD":
+            return "HeadObject"
+        if method == "PUT":
+            if "partNumber" in query:
+                return "UploadPartCopy" \
+                    if "x-amz-copy-source" in headers else "UploadPart"
+            if "tagging" in query:
+                return "PutObjectTagging"
+            if "x-amz-copy-source" in headers:
+                return "CopyObject"
+            return "PutObject"
+        if method == "POST":
+            if "uploads" in query:
+                return "CreateMultipartUpload"
+            if "uploadId" in query:
+                return "CompleteMultipartUpload"
+            return "PostObject"
+        if method == "DELETE":
+            if "uploadId" in query:
+                return "AbortMultipartUpload"
+            return "DeleteObject"
+        return method
+    # bucket-level
+    if method == "GET":
+        if "versions" in query:
+            return "ListObjectVersions"
+        if "uploads" in query:
+            return "ListMultipartUploads"
+        if query.get("list-type") == ["2"] or \
+                query.get("list-type") == "2":
+            return "ListObjectsV2"
+        sub = next((q for q in ("location", "versioning", "policy",
+                                "tagging", "lifecycle", "encryption",
+                                "object-lock", "replication",
+                                "notification", "events") if q in query),
+                   None)
+        return f"GetBucket{sub.title().replace('-', '')}" if sub \
+            else "ListObjectsV1"
+    if method == "PUT":
+        return "MakeBucket" if not query else "PutBucketConfig"
+    if method == "HEAD":
+        return "HeadBucket"
+    if method == "DELETE":
+        return "DeleteBucket" if not query else "DeleteBucketConfig"
+    if method == "POST":
+        if "delete" in query:
+            return "DeleteMultipleObjects"
+        return "PostPolicy"
+    return method
 
 
 class TraceSys:
-    def __init__(self, node_name: str = "", ring_size: int = 200):
+    def __init__(self, node_name: str = "", ring_size: int = 200,
+                 audit_queue_size: int = 512):
         from collections import deque
         self.hub = PubSub()
         self.node = node_name
         self.audit_webhook: str = ""           # POST target for audit
         self.requests_total = 0
         self.errors_total = 0
+        self.audit_dropped = 0
         # recent-entry ring: peers pull this for cluster-wide trace
         # (the reference streams over peer REST; a pull ring is the
         # polling equivalent)
         self.recent: "deque[dict]" = deque(maxlen=ring_size)
         self._mu = threading.Lock()
+        self._audit_q: "queue.Queue[dict]" = queue.Queue(
+            maxsize=audit_queue_size)
+        self._audit_worker: Optional[threading.Thread] = None
 
     # -- middleware --------------------------------------------------------
 
     def record(self, method: str, path: str, query: str, status: int,
                duration_s: float, caller: str = "",
-               api: str = "") -> None:
-        with self._mu:
-            self.requests_total += 1
-            if status >= 500:
-                self.errors_total += 1
+               api: str = "", trace_id: str = "") -> None:
         entry = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "node": self.node,
@@ -53,12 +152,48 @@ class TraceSys:
             "duration_ms": round(duration_s * 1e3, 3),
             "caller": caller,
         }
-        self.recent.append(entry)
+        if trace_id:
+            # the span-tree key: `mc admin trace` output joins to the
+            # /minio/admin/v3/spans dump through this id
+            entry["trace_id"] = trace_id
+        with self._mu:
+            self.requests_total += 1
+            if status >= 500:
+                self.errors_total += 1
+            # the ring is read concurrently by the admin trace/cluster
+            # pull — mutate it under the same lock as the counters
+            self.recent.append(entry)
         if self.hub.subscriber_count:
             self.hub.publish(entry)
         if self.audit_webhook:
-            threading.Thread(target=self._ship_audit, args=(entry,),
-                             daemon=True).start()
+            self._enqueue_audit(entry)
+
+    # -- audit worker ------------------------------------------------------
+
+    def _enqueue_audit(self, entry: dict) -> None:
+        try:
+            self._audit_q.put_nowait(entry)
+        except queue.Full:
+            with self._mu:
+                self.audit_dropped += 1
+            _AUDIT_DROPPED.inc()
+            return
+        if self._audit_worker is None or not self._audit_worker.is_alive():
+            with self._mu:
+                if self._audit_worker is None or \
+                        not self._audit_worker.is_alive():
+                    self._audit_worker = threading.Thread(
+                        target=self._audit_loop, daemon=True,
+                        name="audit-ship")
+                    self._audit_worker.start()
+
+    def _audit_loop(self) -> None:
+        while True:
+            entry = self._audit_q.get()
+            try:
+                self._ship_audit(entry)
+            except Exception:  # noqa: BLE001 — audit is best-effort
+                pass
 
     def _ship_audit(self, entry: dict) -> None:
         try:

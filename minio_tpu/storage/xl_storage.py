@@ -26,6 +26,7 @@ import uuid as _uuid
 from typing import BinaryIO, Iterator, Optional
 
 from .. import bitrot as bitrot_mod
+from ..utils import telemetry
 from . import errors
 from .api import BitrotVerifier, StorageAPI
 from .datatypes import DiskInfo, FileInfo, VolInfo
@@ -349,9 +350,10 @@ class XLStorage(StorageAPI):
             raise errors.VolumeNotFound(volume)
         fp = self._file_path(volume, path)
         try:
-            os.makedirs(os.path.dirname(fp), exist_ok=True)
-            with open(fp, "ab") as f:
-                f.write(buf)
+            with telemetry.span("disk.append_file", bytes=len(buf)):
+                os.makedirs(os.path.dirname(fp), exist_ok=True)
+                with open(fp, "ab") as f:
+                    f.write(buf)
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
         except OSError as e:
@@ -399,6 +401,11 @@ class XLStorage(StorageAPI):
         """Stream `size` bytes (exactly) from reader into a fresh file
         (reference CreateFile, cmd/xl-storage.go:1664: fallocate +
         sequential write; errLessData/errMoreData on mismatch)."""
+        with telemetry.span("disk.create_file", size=size):
+            self._create_file(volume, path, size, reader)
+
+    def _create_file(self, volume: str, path: str, size: int,
+                     reader: BinaryIO) -> None:
         fp = self._file_path(volume, path)
         if not os.path.isdir(self._vol_dir(volume)):
             raise errors.VolumeNotFound(volume)
@@ -446,6 +453,13 @@ class XLStorage(StorageAPI):
     def read_file(self, volume: str, path: str, offset: int, length: int,
                   verifier: Optional[BitrotVerifier] = None) -> bytes:
         fp = self._file_path(volume, path)
+        with telemetry.span("disk.read_file", length=length):
+            return self._read_file(fp, volume, path, offset, length,
+                                   verifier)
+
+    def _read_file(self, fp: str, volume: str, path: str, offset: int,
+                   length: int,
+                   verifier: Optional[BitrotVerifier] = None) -> bytes:
         try:
             with open(fp, "rb") as f:
                 if verifier is not None:
@@ -630,6 +644,12 @@ class XLStorage(StorageAPI):
         """Commit a staged write: merge src xl.meta's latest version into
         dst's journal, move the data dir, drop src (reference RenameData,
         cmd/xl-storage.go:2041 — the 2-phase-commit finish)."""
+        with telemetry.span("disk.rename_data"):
+            self._rename_data(src_volume, src_path, data_dir,
+                              dst_volume, dst_path)
+
+    def _rename_data(self, src_volume: str, src_path: str, data_dir: str,
+                     dst_volume: str, dst_path: str) -> None:
         src_meta = self._read_xl_meta(src_volume, src_path)
         fi = src_meta.to_file_info(dst_volume, dst_path)
         try:

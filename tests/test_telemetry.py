@@ -1,0 +1,471 @@
+"""Unified telemetry plane: metrics registry exposition lint, span
+trees across the data path, trace-id propagation over storage RPC,
+audit-queue overflow accounting, staging-pressure load shedding."""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.admin import mount_admin
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+from minio_tpu.utils import telemetry
+
+CREDS = Credentials("telemtestkey", "telemtestsecret1")
+REGION = "us-east-1"
+
+
+# ---------------------------------------------------------------------------
+# registry unit behavior
+# ---------------------------------------------------------------------------
+
+def test_registry_families_and_render():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("minio_unit_total", "ops")
+    c.inc()
+    c.inc(2, api="x")
+    g = reg.gauge("minio_unit_gauge", "level")
+    g.set(3.5)
+    h = reg.histogram("minio_unit_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.render()
+    assert "minio_unit_total 1" in text
+    assert 'minio_unit_total{api="x"} 2' in text
+    assert "minio_unit_gauge 3.5" in text
+    assert 'minio_unit_seconds_bucket{le="0.1"} 1' in text
+    assert 'minio_unit_seconds_bucket{le="+Inf"} 2' in text
+    assert "minio_unit_seconds_count 2" in text
+    # idempotent getter returns the same family; kind mismatch rejects
+    assert reg.counter("minio_unit_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("minio_unit_total")
+    # invalid names/labels rejected
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    with pytest.raises(ValueError):
+        c.inc(1, **{"bad-label": "v"})
+
+
+def test_span_tree_and_tail_sampling():
+    sink = telemetry.SpanSink(capacity=8, slow_s=3600.0, sample=0.0)
+    # fast, error-free trace: dropped
+    root = telemetry.Span("root", "t1")
+    root.finish()
+    assert not sink.offer(root)
+    # error anywhere in the tree: kept (propagated to the root flag)
+    root = telemetry.Span("root", "t2")
+    child = telemetry.Span("child", "t2", parent_id=root.span_id,
+                           root=root)
+    child.mark_error("boom")
+    root.add_child(child)
+    root.finish()
+    assert sink.offer(root)
+    # slow trace: kept
+    sink.configure(slow_s=0.0)
+    root = telemetry.Span("slowroot", "t3")
+    root.finish()
+    assert sink.offer(root)
+    trees = sink.dump()
+    assert trees[0]["name"] == "slowroot"          # newest first
+    assert trees[1]["children"][0]["error"] == "boom"
+
+
+def test_span_budget_caps_trace_size(monkeypatch):
+    """Past MAX_SPANS per trace, span() degrades to the no-op and the
+    root counts the drop — a 10 GiB PUT must not pin 100k Spans."""
+    monkeypatch.setattr(telemetry, "MAX_SPANS", 5)
+    sink = telemetry.SpanSink(capacity=4, slow_s=0.0, sample=0.0)
+    root_cm = telemetry.trace("budget-root")
+    with root_cm as root:
+        for i in range(10):
+            with telemetry.span(f"c{i}"):
+                pass
+    assert root.n_spans == 5 and root.n_dropped == 5
+    assert root.to_dict()["spans_dropped"] == 5
+    assert len(root.children) == 5
+    del sink
+
+
+def test_span_noop_without_active_trace():
+    assert telemetry.current_span() is None
+    with telemetry.span("orphan") as sp:
+        assert sp is None                 # no-op: no root, no recording
+
+
+def test_traced_iter_never_leaks_into_consumer():
+    """The stream span is current only while the inner iterator runs —
+    between chunks (and after abandonment) the consumer's context is
+    untouched (a plain `with span():` in a generator would leak)."""
+    sink = telemetry.SpanSink(capacity=4, slow_s=0.0)
+    with telemetry._SpanCtx(telemetry.Span("root", "tx"), root=False) \
+            as root:
+        seen = []
+
+        def chunks():
+            seen.append(telemetry.current_span())
+            yield b"a"
+            seen.append(telemetry.current_span())
+            yield b"b"
+
+        it = telemetry.traced_iter("stream", chunks())
+        assert next(it) == b"a"
+        assert telemetry.current_span() is root      # not the stream span
+        it.close()                                    # abandoned mid-read
+        assert telemetry.current_span() is root
+    assert seen and seen[0] is not root and seen[0].name == "stream"
+    assert root.children[0].name == "stream"
+    del sink
+
+
+# ---------------------------------------------------------------------------
+# live server: exposition lint + span trees + shed
+# ---------------------------------------------------------------------------
+
+class Client:
+    def __init__(self, port, creds=CREDS):
+        self.port, self.creds = port, creds
+
+    def request(self, method, path, query=None, body=b""):
+        query = {k: [v] for k, v in (query or {}).items()}
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        hdrs = {"host": f"127.0.0.1:{self.port}"}
+        hdrs = sig.sign_v4(method, path, query, hdrs,
+                           hashlib.sha256(body).hexdigest(), self.creds,
+                           REGION)
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=30)
+        conn.request(method, path + (f"?{qs}" if qs else ""), body=body,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("telemdrives")
+    drives = [str(root / f"d{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1, set_drive_count=4,
+                                   parity=2, block_size=1 << 16)
+    srv = S3Server(sets, creds=CREDS, region=REGION).start()
+    mount_admin(srv)
+    was = (telemetry.SPANS.slow_s, telemetry.SPANS.sample)
+    telemetry.SPANS.configure(sample=1.0)    # keep every trace
+    yield srv
+    telemetry.SPANS.configure(*was)
+    srv.stop()
+    sets.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = Client(server.port)
+    assert c.request("PUT", "/telb")[0] == 200
+    # multi-batch payload (8-block batches at 64 KiB blocks): the PUT
+    # rides the pipelined hot loop, the GET runs the group lookahead
+    payload = b"t" * (2 << 20)
+    assert c.request("PUT", "/telb/obj", body=payload)[0] == 200
+    st, got = c.request("GET", "/telb/obj")
+    assert st == 200 and got == payload
+    return c
+
+
+_LINE = r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (?:[0-9eE+.\-]+|\+Inf|NaN)$"
+
+
+def _parse_exposition(text: str):
+    """(families: name -> type, samples: list[sample name]) with
+    HELP/TYPE bookkeeping asserted per line."""
+    import re
+    helped, typed = set(), {}
+    samples = []
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed[name] = kind
+        else:
+            m = re.match(_LINE, line)
+            assert m, f"malformed sample line: {line!r}"
+            samples.append(m.group("name"))
+    return helped, typed, samples
+
+
+def test_metrics_exposition_lint(server, client):
+    st, body = client.request("GET", "/minio/prometheus/metrics")
+    assert st == 200
+    helped, typed, samples = _parse_exposition(body.decode())
+    assert samples, "no samples rendered"
+    base = {}
+    for s in samples:
+        fam = s
+        for suffix in ("_bucket", "_sum", "_count"):
+            if s.endswith(suffix) and s[: -len(suffix)] in typed and \
+                    typed.get(s[: -len(suffix)]) == "histogram":
+                fam = s[: -len(suffix)]
+        base[fam] = base.get(fam, 0) + 1
+        # every sample belongs to a family with # HELP and # TYPE
+        assert fam in helped, f"sample {s} lacks # HELP"
+        assert fam in typed, f"sample {s} lacks # TYPE"
+        assert fam.startswith("minio_"), fam
+    # histograms expose the full triplet
+    for fam, kind in typed.items():
+        if kind != "histogram" or fam not in base:
+            continue
+        assert f"{fam}_sum" in samples and f"{fam}_count" in samples \
+            and any(s == f"{fam}_bucket" for s in samples), fam
+    # the per-API latency histograms migrated in
+    text = body.decode()
+    assert typed.get("minio_tpu_http_requests_duration_seconds") == \
+        "histogram"
+    assert 'minio_tpu_http_requests_duration_seconds_bucket{api="PutObject"' \
+        in text
+    assert 'api="GetObject"' in text
+    assert typed.get("minio_tpu_http_ttfb_seconds") == "histogram"
+    # migrated families all present in ONE registry render
+    for fam in ("minio_disks_online", "minio_tpu_pipeline_enabled",
+                "minio_tpu_pipeline_bpool_waits_total",
+                "minio_tpu_sched_queue_depth",
+                "minio_tpu_profiler_running",
+                "minio_tpu_rpc_calls_total",
+                "minio_tpu_audit_dropped_total",
+                "minio_tpu_requests_shed_total",
+                "minio_heal_mrf_pending"):
+        assert fam in typed, fam
+
+
+def _tree_depth(node: dict) -> int:
+    return 1 + max((_tree_depth(c) for c in node.get("children", ())),
+                   default=0)
+
+
+def _find_spans(node: dict, name: str) -> list:
+    out = [node] if node["name"] == name else []
+    for c in node.get("children", ()):
+        out.extend(_find_spans(c, name))
+    return out
+
+
+def test_put_and_get_span_trees(server, client):
+    st, body = client.request("GET", "/minio/admin/v3/spans",
+                              query={"count": "100"})
+    assert st == 200
+    spans = json.loads(body)["spans"]
+    # the SPANS ring is process-global: filter to THIS module's object
+    # (earlier test files leave their own kept traces behind)
+    puts = [s for s in spans if s["name"] == "PutObject"
+            and s.get("attrs", {}).get("path") == "/telb/obj"]
+    gets = [s for s in spans if s["name"] == "GetObject"
+            and s.get("attrs", {}).get("path") == "/telb/obj"]
+    assert puts and gets
+    put, get = puts[-1], gets[-1]
+    # handler -> engine -> pipeline stage -> shard I/O
+    assert _tree_depth(put) >= 4, json.dumps(put, indent=1)
+    assert _find_spans(put, "engine.put_object")
+    assert _find_spans(put, "pipeline.encode")
+    enc = _find_spans(put, "pipeline.shard_write")
+    assert enc and any(_find_spans(e, "disk.shard_write") for e in enc)
+    assert _tree_depth(get) >= 4, json.dumps(get, indent=1)
+    groups = _find_spans(get, "pipeline.read_group")
+    assert groups and any(_find_spans(g, "disk.shard_read")
+                          for g in groups)
+    # trace ids surfaced on the admin trace entries too
+    entries = [e for e in server.api.trace.recent
+               if e.get("api") == "PutObject"]
+    assert entries and entries[-1].get("trace_id")
+
+
+def test_slowdown_on_staging_pressure(server, client):
+    from minio_tpu.parallel import pipeline as pl
+    api = server.api
+    shed = telemetry.REGISTRY.counter("minio_tpu_requests_shed_total")
+    before = shed.value(reason="staging")
+    # simulate BytePool exhaustion (a get() timing out bumps this)
+    pool = pl.staging_pool(1 << 12)
+    pool.exhausted += 1
+    try:
+        st, body = client.request("PUT", "/telb/shedme", body=b"x" * 64)
+        assert st == 503 and b"SlowDown" in body
+        assert shed.value(reason="staging") == before + 1
+        # bucket-level ops and reads are never shed
+        assert client.request("GET", "/telb/obj")[0] == 200
+        # metadata ops on object paths never stage payload: not shed
+        tags = (b"<Tagging><TagSet><Tag><Key>k</Key><Value>v</Value>"
+                b"</Tag></TagSet></Tagging>")
+        st, _ = client.request("PUT", "/telb/obj", body=tags,
+                               query={"tagging": ""})
+        assert st == 200
+    finally:
+        api._shed_until = 0.0          # expire the pressure window
+    assert client.request("PUT", "/telb/shedme", body=b"x" * 64)[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation across a storage RPC round trip
+# ---------------------------------------------------------------------------
+
+def test_trace_id_propagates_across_storage_rpc(tmp_path):
+    from minio_tpu.distributed.storage_rpc import (RemoteStorage,
+                                                   StorageRPCServer)
+    from minio_tpu.distributed.transport import RPCServer
+    from minio_tpu.storage import new_format_erasure_v3
+    from minio_tpu.storage.xl_storage import XLStorage
+
+    fmts = new_format_erasure_v3(1, 1)
+    d = XLStorage(str(tmp_path / "rd0"))
+    d.write_format(fmts[0][0])
+    host = RPCServer().start()
+    host.mount(StorageRPCServer({"/rd0": d}, "tracekey",
+                                "tracesecret12345").handler)
+    remote = RemoteStorage("127.0.0.1", host.port, "/rd0", "tracekey",
+                           "tracesecret12345")
+    was = (telemetry.SPANS.slow_s, telemetry.SPANS.sample)
+    telemetry.SPANS.configure(sample=1.0)
+    try:
+        with telemetry.trace("rpc-prop-test") as root:
+            remote.make_vol("tv")
+            remote.write_all("tv", "x", b"payload")
+            assert remote.read_all("tv", "x") == b"payload"
+            tid = root.trace_id
+        trees = [t for t in telemetry.SPANS.dump(20)
+                 if t["trace_id"] == tid]
+        assert trees, "trace not kept"
+        tree = trees[0]
+        # client-side rpc spans in the tree
+        client_spans = _find_spans(tree, "rpc.readall")
+        assert client_spans
+        # the REMOTE side recorded a fragment under the same trace id,
+        # grafted beneath the client span that carried the headers
+        server_spans = _find_spans(tree, "rpc.server.readall")
+        assert server_spans and server_spans[0]["remote"] is True
+        assert server_spans[0]["trace_id"] == tid
+        assert any(s["span_id"] == server_spans[0].get("parent_id")
+                   for s in client_spans)
+    finally:
+        telemetry.SPANS.configure(*was)
+        remote.close()
+        host.stop()
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# TraceSys: stream idle timeout + audit overflow accounting
+# ---------------------------------------------------------------------------
+
+def test_tracesys_stream_idle_timeout():
+    from minio_tpu.s3.trace import TraceSys
+    ts = TraceSys()
+    t0 = time.perf_counter()
+    out = list(ts.stream(idle_timeout=0.3))
+    dt = time.perf_counter() - t0
+    assert out == []
+    assert 0.2 <= dt < 2.0, dt
+
+
+def test_audit_overflow_drops_and_counts(monkeypatch):
+    from minio_tpu.s3.trace import TraceSys
+    ts = TraceSys(audit_queue_size=2)
+    ts.audit_webhook = "http://127.0.0.1:9/never"
+    gate = threading.Event()
+    shipped = []
+
+    def slow_ship(entry):
+        gate.wait(5.0)
+        shipped.append(entry)
+
+    monkeypatch.setattr(ts, "_ship_audit", slow_ship)
+    dropped_counter = telemetry.REGISTRY.counter(
+        "minio_tpu_audit_dropped_total")
+    before = dropped_counter.value()
+    for i in range(8):
+        ts.record("GET", f"/p{i}", "", 200, 0.001)
+    assert ts.requests_total == 8
+    assert ts.audit_dropped >= 5          # 1 in flight + 2 queued max
+    assert dropped_counter.value() - before == ts.audit_dropped
+    gate.set()                            # release the worker
+    deadline = time.time() + 5
+    while len(shipped) < 8 - ts.audit_dropped and time.time() < deadline:
+        time.sleep(0.02)
+    # exactly the non-dropped entries ship, on ONE worker thread
+    assert len(shipped) == 8 - ts.audit_dropped
+    workers = [t for t in threading.enumerate()
+               if t.name == "audit-ship"]
+    assert len(workers) <= 1
+
+
+def test_recent_ring_mutation_is_locked():
+    """recent.append now happens under _mu with the counters — hammer
+    record() from several threads and check ring/counter consistency."""
+    from minio_tpu.s3.trace import TraceSys
+    ts = TraceSys(ring_size=10_000)
+
+    def spam(n):
+        for i in range(n):
+            ts.record("GET", f"/r{i}", "", 200, 0.0)
+
+    threads = [threading.Thread(target=spam, args=(500,))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ts.requests_total == 2000
+    assert len(ts.recent) == 2000
+
+
+# ---------------------------------------------------------------------------
+# profiling Kind table + gauges
+# ---------------------------------------------------------------------------
+
+def test_profiling_kind_table_and_gauges():
+    from minio_tpu.utils import profiling
+    assert profiling.parse_kinds(" cpu , mem ,bogus") == ["cpu", "mem"]
+    assert profiling.start("bogus") is False
+    assert profiling.start("cpu") is True
+    try:
+        assert profiling.running("cpu") is True
+        text = telemetry.REGISTRY.render()
+        assert 'minio_tpu_profiler_running{kind="cpu"} 1' in text
+        assert 'minio_tpu_profiler_running{kind="mem"} 0' in text
+    finally:
+        out = profiling.stop_text("cpu")
+    assert out is not None and "cumulative" in out
+    assert profiling.stop_text("cpu") is None       # already stopped
+    text = telemetry.REGISTRY.render()
+    assert 'minio_tpu_profiler_running{kind="cpu"} 0' in text
+
+
+def test_api_name_classifier():
+    from minio_tpu.s3.trace import api_name_of
+    assert api_name_of("PUT", "/b/k", {}, {}) == "PutObject"
+    assert api_name_of("GET", "/b/k", {}, {}) == "GetObject"
+    assert api_name_of("PUT", "/b/k", {"partNumber": ["1"],
+                                       "uploadId": ["u"]}, {}) == \
+        "UploadPart"
+    assert api_name_of("POST", "/b/k", {"uploads": [""]}, {}) == \
+        "CreateMultipartUpload"
+    assert api_name_of("POST", "/b/k", {"uploadId": ["u"]}, {}) == \
+        "CompleteMultipartUpload"
+    assert api_name_of("GET", "/b", {"list-type": ["2"]}, {}) == \
+        "ListObjectsV2"
+    assert api_name_of("GET", "/", {}, {}) == "ListBuckets"
+    assert api_name_of("PUT", "/b", {}, {}) == "MakeBucket"
+    assert api_name_of("DELETE", "/b/k", {}, {}) == "DeleteObject"
+    assert api_name_of("GET", "/minio/prometheus/metrics", {}, {}) == \
+        "Metrics"
+    assert api_name_of("GET", "/minio/admin/v3/info", {}, {}) == "Admin"
