@@ -457,6 +457,97 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
     return out
 
 
+def bench_rebalance_ab(streams: int = 8, size: int = 4 << 20,
+                       drives: int = 8, parity: int = 2,
+                       preload: int = 32) -> dict:
+    """Foreground-PUT latency with vs without an active pool drain
+    (the rebalance-throttle acceptance probe): two pools on tmpfs,
+    pool 0 preloaded, then identical concurrent PUT rounds are timed
+    per-op before and during a live decommission of pool 0. Reports
+    p50/p99 per phase and `put_p99_degradation_x` — the throttle keeps
+    it under ~2x because the walker backs off whenever the foreground
+    shows scheduler/staging pressure."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.object.sets import ErasureSets
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_reb_", dir=base)
+    payload = os.urandom(size)
+    drain_payload = os.urandom(size // 2)
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "drives_per_pool": drives, "m": parity,
+                            "preload": preload}}
+    try:
+        zz = ErasureServerSets([ErasureSets.from_drives(
+            [f"{root}/p{p}d{i}" for i in range(drives)], 1, drives,
+            parity, block_size=1 << 20, enable_mrf=False)
+            for p in (0, 1)])
+        zz.make_bucket("bench")
+        for i in range(preload):                # drain inventory
+            zz.server_sets[0].put_object("bench", f"drain-{i}",
+                                         drain_payload)
+
+        def put_round(prefix: str) -> list[float]:
+            lat: list[float] = []
+            mu = threading.Lock()
+
+            def one(i: int) -> None:
+                t0 = time.perf_counter()
+                # route directly to the ACTIVE pool's engine: the
+                # foreground workload under test, not the zone probe
+                zz.server_sets[1].put_object("bench", f"{prefix}{i}",
+                                             payload)
+                dt = time.perf_counter() - t0
+                with mu:
+                    lat.append(dt)
+
+            with cf.ThreadPoolExecutor(max_workers=streams) as ex:
+                list(ex.map(one, range(streams)))
+            return lat
+
+        def pcts(lat: list[float]) -> dict:
+            xs = sorted(lat)
+            return {"p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                    "p99_ms": round(xs[max(0, int(len(xs) * 0.99) - 1)]
+                                    * 1e3, 2)}
+
+        put_round("warm")                        # warm the path
+        baseline = put_round("base") + put_round("base2")
+        out["baseline"] = pcts(baseline)
+
+        zz.start_decommission(0)        # the real admin code path
+        reb = zz._rebalancer
+        during = put_round("dr") + put_round("dr2")
+        out["during_drain"] = pcts(during)
+        out["drain_status_at_measure"] = {
+            k: reb.status().get(k)
+            for k in ("status", "objects_moved", "objects_failed")}
+        deadline = time.monotonic() + 120
+        while reb.running() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        reb.stop()
+        out["drain_final"] = {k: reb.status().get(k)
+                              for k in ("status", "objects_moved",
+                                        "objects_failed")}
+        out["put_p99_degradation_x"] = round(
+            out["during_drain"]["p99_ms"]
+            / max(out["baseline"]["p99_ms"], 1e-9), 3)
+        zz.close()
+    finally:
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ab-pipeline", action="store_true",
@@ -473,7 +564,20 @@ def main() -> int:
     ap.add_argument("--spans", action="store_true",
                     help="pretty-print the top-5 slowest span trees of "
                          "each A/B config to stderr")
+    ap.add_argument("--ab-rebalance", action="store_true",
+                    help="run ONLY the rebalance-throttle A/B "
+                         "(foreground PUT p50/p99 with vs without an "
+                         "active pool drain)")
     args = ap.parse_args()
+
+    if args.ab_rebalance:
+        print(json.dumps({
+            "metric": "foreground PUT p99 degradation with an active "
+                      "pool drain (rebalance throttle A/B)",
+            "rebalance_ab": bench_rebalance_ab(
+                streams=min(args.ab_streams, 8), size=args.ab_size),
+        }))
+        return 0
 
     def emit_spans(ab: dict) -> None:
         if not args.spans or not isinstance(ab, dict):

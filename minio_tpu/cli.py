@@ -44,6 +44,25 @@ def _parse(argv: list[str]) -> argparse.Namespace:
         "MINIO_REGION", "us-east-1"))
     s.add_argument("--cert", default="", help="TLS certificate file")
     s.add_argument("--key", default="", help="TLS private key file")
+    s.add_argument("--pool", action="append", default=[],
+                   help="extra drive pool /data2/d{1...N} appended "
+                   "after boot (single-node topology expansion); "
+                   "repeatable")
+
+    d = sub.add_parser("decommission",
+                       help="drain a pool's objects into the active "
+                       "pools (admin rebalance surface)")
+    d.add_argument("--url", default="127.0.0.1:9000",
+                   help="server admin endpoint host:port")
+    d.add_argument("--pool", type=int, default=None,
+                   help="pool index to decommission")
+    d.add_argument("--status", action="store_true",
+                   help="print rebalance/topology status and exit")
+    d.add_argument("--cancel", action="store_true",
+                   help="cancel the running drain (pool returns to "
+                   "active)")
+    d.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
 
     g = sub.add_parser("gateway", help="serve the S3 API over a "
                        "foreign backend (cmd/gateway-main.go)")
@@ -170,11 +189,40 @@ def _serve_until_signal(cleanup) -> int:
     return 0
 
 
+def _run_decommission(args, creds: Credentials) -> int:
+    """`minio_tpu decommission` — drive the admin rebalance surface
+    (start / --status / --cancel) against a running node."""
+    import json as _json
+    from .madmin import AdminClient, AdminClientError
+    from .utils import host_port
+    h, p = host_port(args.url, 9000)
+    cli = AdminClient(h, p, creds.access_key, creds.secret_key,
+                      region=args.region)
+    try:
+        if args.status:
+            out = cli.rebalance_status()
+        elif args.cancel:
+            out = cli.cancel_rebalance()
+        elif args.pool is None:
+            print("decommission needs --pool N (or --status/--cancel)",
+                  file=sys.stderr)
+            return 2
+        else:
+            out = cli.start_rebalance(args.pool)
+    except AdminClientError as e:
+        print(f"decommission failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
     if args.cmd == "gateway":
         return _run_gateway(args, creds)
+    if args.cmd == "decommission":
+        return _run_decommission(args, creds)
     kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
               region=args.region,
               certfile=args.cert or None, keyfile=args.key or None)
@@ -202,6 +250,10 @@ def main(argv: list[str] | None = None) -> int:
         expanded = _ell.expand_args(args.drives)
         if len(expanded) == 1:
             # one path: FS backend, no erasure (reference newObjectLayer)
+            if args.pool:
+                print("--pool needs an erasure backend; the FS "
+                      "backend has no pool topology", file=sys.stderr)
+                return 2
             from .cluster import start_fs
             node = start_fs(expanded[0], host or "0.0.0.0", port_n,
                             creds, region=args.region)
@@ -210,6 +262,15 @@ def main(argv: list[str] | None = None) -> int:
             return _serve_until_signal(node.shutdown)
         node = start_single(args.drives, host or "0.0.0.0", port_n,
                             creds, **kw)
+
+    for pool_arg in getattr(args, "pool", []) or []:
+        if args.node:
+            print("--pool expansion is single-node only; distributed "
+                  "pools join via their own --node lists",
+                  file=sys.stderr)
+            node.shutdown()
+            return 2
+        node.add_pool([pool_arg])
 
     info = node.object_layer.storage_info()
     print(f"MinIO-TPU node {node.spec.addr} up: "

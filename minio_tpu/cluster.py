@@ -166,10 +166,15 @@ class ClusterNode:
             ns_lock = NSLockMap()
 
         # -- cross-request device batch former + RAM-budgeted admission ----
+        from .parallel import pipeline as _pipeline
         from .parallel.scheduler import BatchScheduler, requests_budget
         self.scheduler = BatchScheduler()
-        self.s3.api.set_max_clients(
-            requests_budget(block_size, set_drive_count))
+        budget = requests_budget(block_size, set_drive_count)
+        self.s3.api.set_max_clients(budget)
+        # staging rings sized from the SAME admission budget (each
+        # admitted stream keeps ~2 batches in flight), not a flat
+        # 2×cores guess
+        _pipeline.configure_pool_buffers(budget)
 
         # -- format bootstrap (waitForFormatErasure) -----------------------
         deadline = time.monotonic() + format_timeout
@@ -186,8 +191,24 @@ class ClusterNode:
                     raise
                 time.sleep(0.5)
         self.sets = sets
-        self.object_layer = ErasureServerSets([sets])
+        # distributed clusters are single-pool (expansion/decommission
+        # are the single-node surface today): skip the boot-time
+        # cluster-wide topology read — during a concurrent multi-node
+        # boot it races peers still formatting and can trip a remote
+        # drive's offline backoff for nothing (the default map is
+        # all-active, which is exactly a 1-pool cluster's only state)
+        self.object_layer = ErasureServerSets(
+            [sets], load_topology=not self.distributed)
         self.s3.api.set_object_layer(self.object_layer)
+        self._block_size = block_size
+        # a drain interrupted by a restart resumes from its persisted
+        # checkpoint (the pool is still marked draining in the topology
+        # epoch doc) instead of starting over
+        try:
+            self.object_layer.resume_rebalance_if_pending()
+        except Exception:  # noqa: BLE001 — boot must proceed; the
+            # admin rebalance endpoint can restart the drain manually
+            pass
 
         # -- IAM over the object layer (erasure-coded identity store) ------
         if self.s3.api.iam is None:
@@ -362,6 +383,42 @@ class ClusterNode:
                                             self.object_layer),
                 ]).start()
             self.s3.api.usage = self.crawler
+
+    # ------------------------------------------------------------------
+    # topology: online pool expansion
+    # ------------------------------------------------------------------
+
+    def add_pool(self, drive_roots: list[str],
+                 set_drive_count: int = 0,
+                 parity: Optional[int] = None) -> int:
+        """Append one pool of LOCAL drives to the running node (online
+        expansion; single-node form of upstream's server-pool list).
+        Bumps+persists the placement epoch; new writes immediately
+        weigh the new capacity. Returns the new pool index."""
+        paths = ellipses.expand_args(list(drive_roots))
+        if set_drive_count:
+            if len(paths) % set_drive_count:
+                raise ValueError("drives not divisible into sets")
+            set_count = len(paths) // set_drive_count
+        else:
+            set_count, set_drive_count = ellipses.divide_into_sets(
+                len(paths), [len(paths)])
+        if parity is None:
+            parity = set_drive_count // 2
+        sets = ErasureSets.from_drives(
+            paths, set_count, set_drive_count, parity,
+            block_size=self._block_size, scheduler=self.scheduler)
+        idx = self.object_layer.add_pool(sets)
+        for p in paths:
+            if p not in self.local_drives:
+                try:
+                    self.local_drives[p] = XLStorage(p)
+                except serr.StorageError:
+                    pass
+        self.console.log_line(
+            "INFO", f"pool {idx} added ({len(paths)} drives, "
+            f"epoch {self.object_layer.topology.epoch})")
+        return idx
 
     # ------------------------------------------------------------------
 

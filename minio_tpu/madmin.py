@@ -100,6 +100,28 @@ class AdminClient:
     def heal_status(self, token: str) -> dict:
         return self._json("GET", "heal/status", {"token": token})
 
+    # -- topology / rebalance ----------------------------------------------
+
+    def start_rebalance(self, pool: int) -> dict:
+        """Begin decommissioning `pool`: mark it draining and start the
+        background rebalance moving its objects to the active pools."""
+        return self._json("POST", "rebalance", {"pool": str(pool)})
+
+    def rebalance_status(self) -> dict:
+        return self._json("GET", "rebalance")
+
+    def cancel_rebalance(self) -> dict:
+        return self._json("DELETE", "rebalance")
+
+    def topology(self) -> dict:
+        return self._json("GET", "topology")
+
+    def set_pool_state(self, pool: int, state: str) -> dict:
+        """Suspend ("suspended") or resume ("active") a pool for new
+        writes without draining it."""
+        return self._json("POST", "topology",
+                          {"pool": str(pool), "state": state})
+
     def mrf_status(self) -> dict:
         """MRF heal-queue stats (pending/healed/requeued/failed/dropped;
         zones nested for server-sets backends)."""

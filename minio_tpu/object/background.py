@@ -201,6 +201,21 @@ class MRFHealer:
                 return True
             return not self._push(key, attempt, delay=backoff)
 
+    def kick(self) -> int:
+        """Make every queued entry ready NOW, collapsing pending retry
+        backoffs — called when a drive is re-admitted so its objects
+        heal immediately instead of waiting out the fixed retry window
+        (DiskMonitor re-admission hook). Returns entries re-armed."""
+        with self._cond:
+            if not self._heap:
+                return 0
+            now = time.monotonic()
+            self._heap = [(min(ready, now), seq, b, o, v, attempt)
+                          for ready, seq, b, o, v, attempt in self._heap]
+            heapq.heapify(self._heap)
+            self._cond.notify_all()
+            return len(self._heap)
+
     # -- observability / lifecycle ----------------------------------------
 
     def pending(self) -> int:
@@ -290,6 +305,10 @@ class DiskMonitor(_ScanLoop):
             for j in range(len(eng.disks)):
                 if self._probe_slot(i, j):
                     admitted += 1
+        if admitted and self.sets.mrf is not None:
+            # a returning drive makes queued MRF heals winnable NOW:
+            # collapse their retry backoffs instead of waiting them out
+            self.sets.mrf.kick()
         return admitted
 
     def _probe_slot(self, i: int, j: int) -> bool:

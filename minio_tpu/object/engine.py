@@ -53,11 +53,16 @@ RESERVED_BUCKETS = (MINIO_META_BUCKET,)
 class PutOptions:
     def __init__(self, metadata: Optional[dict] = None,
                  version_id: str = "", versioned: bool = False,
-                 parity: Optional[int] = None):
+                 parity: Optional[int] = None,
+                 mod_time: Optional[float] = None):
         self.metadata = dict(metadata or {})
         self.version_id = version_id
         self.versioned = versioned
         self.parity = parity
+        # explicit mod time: server-side copies (rebalance pool moves)
+        # preserve the object's original Last-Modified instead of
+        # stamping the move time
+        self.mod_time = mod_time
 
 
 class GetOptions:
@@ -267,7 +272,7 @@ class ErasureObjects:
             etag = opts.metadata.pop("etag", "") or reader.md5_current_hex()
 
             fi.size = total
-            fi.mod_time = now()
+            fi.mod_time = opts.mod_time if opts.mod_time else now()
             fi.metadata = dict(opts.metadata)
             fi.metadata["etag"] = etag
             fi.add_object_part(1, etag, total,
@@ -697,6 +702,14 @@ class ErasureObjects:
             return True
         except api_errors.ObjectApiError:
             return False
+
+    def latest_file_info(self, bucket: str, object_name: str) -> FileInfo:
+        """Latest version's FileInfo INCLUDING delete markers — the
+        multi-pool newest-wins read probe (get_object_info hides
+        markers behind ObjectNotFound, which would let an older data
+        copy in another pool shadow a newer marker here)."""
+        fi, _, _ = self._object_file_info(bucket, object_name)
+        return fi
 
     def update_object_metadata(self, bucket: str, object_name: str,
                                metadata: dict, version_id: str = ""
@@ -1285,6 +1298,29 @@ class ErasureObjects:
         self._flag_degraded_delete(bucket, object_name, version_id, errs)
         return ObjectInfo(bucket=bucket, name=object_name,
                           version_id=version_id)
+
+    def put_delete_marker(self, bucket: str, object_name: str,
+                          version_id: str = "",
+                          mod_time: Optional[float] = None) -> ObjectInfo:
+        """Replicate a delete marker with an EXPLICIT version id and mod
+        time — the rebalance/replication copy path (delete_object always
+        mints fresh ids, which would break version-history fidelity when
+        a marker moves between pools)."""
+        _k, _m, _, write_quorum = self._default_quorums()
+        fi = FileInfo(volume=bucket, name=object_name,
+                      version_id=version_id or str(_uuid.uuid4()),
+                      deleted=True, mod_time=mod_time or now())
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            _, errs = meta.for_each_disk(
+                self.disks,
+                lambda i, d: d.write_metadata(bucket, object_name, fi))
+            err = meta.reduce_write_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+            if err is not None:
+                raise api_errors.to_object_err(err, bucket, object_name)
+        self._flag_degraded_delete(bucket, object_name, fi.version_id,
+                                   errs)
+        return fi.to_object_info(bucket, object_name)
 
     def _notify_degraded(self, bucket: str, object_name: str,
                          version_id: str) -> None:

@@ -1,0 +1,537 @@
+"""Background pool rebalancer: drain a pool's objects into the active
+pools while serving traffic.
+
+Upstream's decommission (cmd/erasure-server-pool-decom.go) walks every
+bucket of the draining pool, re-PUTs each version through the regular
+object path into the surviving pools, and deletes the source copy only
+after the target write succeeded; progress is checkpointed so a restart
+resumes instead of rescanning. This is that walker, wired to this
+repo's planes:
+
+  * moves ride the PIPELINED encode path (target pool's regular
+    put_object) and the engine's reconstructing reads — a degraded
+    source object (dead drives ≤ parity) is rebuilt on the fly by the
+    same hedged shard readers the heal path uses;
+  * failed moves feed the source pool's MRF heal queue (heal first,
+    move on the next pass) and count in
+    ``minio_tpu_rebalance_failed_total``;
+  * the walker THROTTLES itself off live ``BatchScheduler`` occupancy
+    and ``BytePool`` wait gauges — foreground traffic always wins, the
+    drain takes the idle cycles;
+  * per-object moves are span roots (``rebalance.move``) so slow or
+    failed moves surface in ``/minio/admin/v3/spans``;
+  * the checkpoint (bucket + name marker + counters) persists in the
+    hidden config bucket of every ACTIVE pool after every
+    ``MINIO_TPU_REBALANCE_CHECKPOINT_EVERY`` objects — a kill mid-drain
+    resumes from the marker.
+
+Knobs (README "Topology operations"):
+
+  MINIO_TPU_REBALANCE_CHECKPOINT_EVERY=16   objects between checkpoints
+  MINIO_TPU_REBALANCE_PAGE=256              listing page size
+  MINIO_TPU_REBALANCE_BACKOFF_S=0.05        first backoff when busy
+  MINIO_TPU_REBALANCE_BACKOFF_MAX_S=1.0     backoff cap
+  MINIO_TPU_REBALANCE_BACKOFF_TRIES=8       busy polls before proceeding
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import TYPE_CHECKING, Optional
+
+from ..storage.xl_storage import MINIO_META_BUCKET
+from ..utils import backoff_delay, telemetry
+from . import api_errors
+from .engine import GetOptions, PutOptions
+from .topology import POOL_DRAINING, TOPOLOGY_PREFIX
+
+if TYPE_CHECKING:  # pragma: no cover — typing only
+    from .server_sets import ErasureServerSets
+
+CHECKPOINT_EVERY = int(os.environ.get(
+    "MINIO_TPU_REBALANCE_CHECKPOINT_EVERY", "16"))
+PAGE = int(os.environ.get("MINIO_TPU_REBALANCE_PAGE", "256"))
+BACKOFF_S = float(os.environ.get("MINIO_TPU_REBALANCE_BACKOFF_S", "0.05"))
+BACKOFF_MAX_S = float(os.environ.get(
+    "MINIO_TPU_REBALANCE_BACKOFF_MAX_S", "1.0"))
+BACKOFF_TRIES = int(os.environ.get(
+    "MINIO_TPU_REBALANCE_BACKOFF_TRIES", "8"))
+
+# meta-bucket prefixes that must NOT migrate: per-pool internals (tmp
+# staging, live multipart sessions, bucket metadata replicated per
+# pool) and the topology/checkpoint docs themselves (written to every
+# pool on purpose)
+META_SKIP_PREFIXES = ("tmp/", "multipart/", "buckets/", TOPOLOGY_PREFIX)
+
+
+def _checkpoint_object(pool: int) -> str:
+    return f"{TOPOLOGY_PREFIX}rebalance-{pool}.json"
+
+
+class _IterStream:
+    """File-like adapter over a GET chunk iterator, so a moved object
+    streams source→target block by block instead of materializing in
+    RAM."""
+
+    def __init__(self, it):
+        self._it = it
+        self._buf = b""
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            out = self._buf + b"".join(self._it)
+            self._buf = b""
+            return out
+        while len(self._buf) < n:
+            try:
+                self._buf += next(self._it)
+            except StopIteration:
+                break
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return bytes(out)
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
+def _metrics():
+    reg = telemetry.REGISTRY
+    return (
+        reg.counter("minio_tpu_rebalance_objects_total",
+                    "Object versions moved off draining pools"),
+        reg.counter("minio_tpu_rebalance_bytes_total",
+                    "Bytes moved off draining pools"),
+        reg.counter("minio_tpu_rebalance_failed_total",
+                    "Object moves that failed (fed to MRF, retried "
+                    "next pass)"),
+        reg.gauge("minio_tpu_rebalance_active",
+                  "1 while a pool drain is running"),
+    )
+
+
+class Rebalancer:
+    """One pool drain: a daemon thread walking the source pool and
+    moving every object version into the active pools."""
+
+    def __init__(self, server_sets: "ErasureServerSets", source: int,
+                 resume: bool = False,
+                 checkpoint_every: Optional[int] = None,
+                 page: Optional[int] = None,
+                 busy_fn=None, throttle_s: Optional[float] = None):
+        self.obj = server_sets
+        self.source = source
+        self.checkpoint_every = checkpoint_every or CHECKPOINT_EVERY
+        self.page = page or PAGE
+        # busy probe override (tests); default samples the live
+        # scheduler queue + staging-ring waits
+        self._busy_fn = busy_fn
+        self._throttle_base = BACKOFF_S if throttle_s is None \
+            else throttle_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+        self._last_pool_waits: Optional[int] = None
+        self.state = {
+            "pool": source, "status": "pending",
+            "bucket": "", "marker": "",
+            "objects_moved": 0, "bytes_moved": 0, "objects_failed": 0,
+            "passes": 0, "started": time.time(), "updated": time.time(),
+        }
+        if resume:
+            doc = self.load_checkpoint(server_sets, source)
+            if doc is not None and doc.get("status") not in ("complete",):
+                for k in ("bucket", "marker", "objects_moved",
+                          "bytes_moved", "objects_failed", "passes"):
+                    if k in doc:
+                        self.state[k] = doc[k]
+                self.state["resumed"] = True
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "Rebalancer":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"rebalance-p{self.source}")
+        self._thread.start()
+        return self
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Signal + join the drain thread; True when it actually
+        stopped (callers reactivating the pool must not proceed while
+        a move is still in flight)."""
+        self._stop.set()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout)
+        return not self.running()
+
+    def status(self) -> dict:
+        with self._mu:
+            out = dict(self.state)
+        out["running"] = self.running()
+        return out
+
+    # ------------------------------------------------------------------
+    # the drain loop
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        objects_c, bytes_c, failed_c, active_g = _metrics()
+        active_g.set(1)
+        self._set(status="draining")
+        try:
+            while not self._stop.is_set():
+                moved, failed, remaining = self.run_pass()
+                with self._mu:
+                    self.state["passes"] += 1
+                if self._stop.is_set():
+                    break
+                if moved == 0 and remaining == 0 and failed == 0:
+                    self._set(status="complete", bucket="", marker="")
+                    self._save_checkpoint()
+                    return
+                # stragglers (failed moves healing through MRF, late
+                # multipart commits): next pass sweeps again from the top
+                self._set(bucket="", marker="")
+                if moved == 0:
+                    # nothing progressed: wait for MRF heals before the
+                    # next sweep instead of spinning the listing
+                    self._stop.wait(1.0)
+            self._set(status="stopped")
+            self._save_checkpoint()
+        except Exception as e:  # noqa: BLE001 — surfaced via status
+            self._set(status="failed", error=repr(e))
+            self._save_checkpoint()
+        finally:
+            active_g.set(0)
+
+    def run_pass(self, restart: bool = False) -> tuple[int, int, int]:
+        """One sweep of the source pool from the current checkpoint
+        (`restart=True` sweeps from the top — what the drain loop does
+        between passes). Returns (moved, failed, remaining-at-end)."""
+        if restart:
+            self._set(bucket="", marker="")
+        src = self.obj.server_sets[self.source]
+        moved = failed = 0
+        # lexically sorted INCLUDING the hidden config bucket (config/
+        # IAM objects migrate too): iteration order must match the
+        # checkpoint's `bucket < start_bucket` resume comparison
+        buckets = sorted([v.name for v in src.list_buckets()]
+                         + [MINIO_META_BUCKET])
+        start_bucket = self.state["bucket"]
+        for bucket in buckets:
+            if self._stop.is_set():
+                break
+            if start_bucket and bucket < start_bucket:
+                continue
+            marker = self.state["marker"] \
+                if bucket == start_bucket else ""
+            m, f = self._drain_bucket(src, bucket, marker)
+            moved += m
+            failed += f
+        remaining = 0 if self._stop.is_set() else self._remaining(src)
+        return moved, failed, remaining
+
+    def _drain_bucket(self, src, bucket: str, marker: str
+                      ) -> tuple[int, int]:
+        moved = failed = since_ckpt = 0
+        while not self._stop.is_set():
+            try:
+                page = src.list_object_versions(bucket, "", marker,
+                                                self.page)
+            except api_errors.ObjectApiError:
+                break                       # bucket vanished mid-drain
+            if not page:
+                break
+            groups = self._group(page, bucket)
+            full_page = len(page) >= self.page
+            if full_page and len(groups) > 1:
+                # the page may have cut the LAST object's version list
+                # short: hold its name for the next page
+                groups.pop()
+            if groups:
+                marker = groups[-1][0]
+            else:
+                # a full page of filtered-out names (meta internals):
+                # advance past it instead of stalling the sweep
+                if not full_page:
+                    break
+                marker = page[-1].name
+                continue
+            for name, versions in groups:
+                if self._stop.is_set():
+                    break
+                self._throttle()
+                try:
+                    moved_bytes = self._move_object(bucket, name,
+                                                    versions)
+                except Exception:  # noqa: BLE001 — per-object isolation
+                    failed += 1
+                    self._on_move_failed(bucket, name)
+                else:
+                    moved += 1
+                    with self._mu:
+                        self.state["objects_moved"] += 1
+                        self.state["bytes_moved"] += moved_bytes
+                    objects_c, bytes_c, _, _ = _metrics()
+                    objects_c.inc(len(versions), pool=str(self.source))
+                    bytes_c.inc(moved_bytes, pool=str(self.source))
+                self._set(bucket=bucket, marker=name)
+                since_ckpt += 1
+                if since_ckpt >= self.checkpoint_every:
+                    self._save_checkpoint()
+                    since_ckpt = 0
+            if len(page) < self.page:
+                break
+        if since_ckpt:
+            self._save_checkpoint()
+        return moved, failed
+
+    def _group(self, page, bucket: str) -> list[tuple[str, list]]:
+        """Page of version ObjectInfos -> [(name, versions)] in listing
+        order, meta-bucket internals filtered out."""
+        groups: list[tuple[str, list]] = []
+        for oi in page:
+            if bucket == MINIO_META_BUCKET and \
+                    oi.name.startswith(META_SKIP_PREFIXES):
+                continue
+            if groups and groups[-1][0] == oi.name:
+                groups[-1][1].append(oi)
+            else:
+                groups.append((oi.name, [oi]))
+        return groups
+
+    def _remaining(self, src) -> int:
+        """Movable objects still on the source pool (completion probe)."""
+        remaining = 0
+        buckets = [v.name for v in src.list_buckets()] \
+            + [MINIO_META_BUCKET]
+        for bucket in buckets:
+            try:
+                page = src.list_object_versions(bucket, "", "", self.page)
+            except api_errors.ObjectApiError:
+                continue
+            remaining += len(self._group(page, bucket))
+        return remaining
+
+    # ------------------------------------------------------------------
+    # one object
+    # ------------------------------------------------------------------
+
+    def _move_object(self, bucket: str, name: str, versions: list) -> int:
+        """Copy every version (oldest first, so relative order is
+        preserved wherever mod times tie) into an active pool, then
+        delete the source copies. Source deletion happens only after
+        EVERY version committed at target write quorum — a crash in
+        between leaves the object readable in both pools (newest-wins)
+        and the next pass's idempotency check finishes the job."""
+        src = self.obj.server_sets[self.source]
+        moved_bytes = 0
+        with telemetry.trace("rebalance.move", bucket=bucket,
+                             object=name, pool=self.source):
+            for oi in sorted(versions, key=lambda o: o.mod_time or 0):
+                if self._version_in_active_pool(bucket, name, oi):
+                    continue            # crash-window leftover: done
+                moved_bytes += self._copy_version(src, bucket, name, oi)
+            if self._stop.is_set():
+                # canceled mid-move: leave the source intact — the
+                # copies are idempotent leftovers the next drain (or a
+                # client overwrite after reactivation) supersedes;
+                # purging here could race a write to the re-activated
+                # pool
+                return moved_bytes
+            # a client DELETE that raced the copy must win: versions
+            # gone from the source since we listed them were deleted
+            # (the purge scanned the target before our copy committed),
+            # so roll their fresh target copies back instead of
+            # resurrecting them
+            try:
+                still = {v.version_id
+                         for v in src.list_object_versions(bucket, name,
+                                                           "", 1000)
+                         if v.name == name}
+            except api_errors.ObjectApiError:
+                still = set()
+            for oi in sorted(versions, key=lambda o: o.mod_time or 0):
+                try:
+                    if oi.version_id not in still:
+                        self._rollback_target_copy(bucket, name, oi)
+                    elif oi.version_id:
+                        src.delete_object(bucket, name,
+                                          version_id=oi.version_id)
+                    else:
+                        src.delete_object(bucket, name)
+                except api_errors.ObjectNotFound:
+                    pass                # already gone (raced a delete)
+        return moved_bytes
+
+    def _rollback_target_copy(self, bucket: str, name: str, oi) -> None:
+        for i in self.obj.topology.write_pools():
+            if i == self.source:
+                continue
+            z = self.obj.server_sets[i]
+            try:
+                if oi.version_id:
+                    z.delete_object(bucket, name,
+                                    version_id=oi.version_id)
+                elif z.has_object_versions(bucket, name):
+                    z.delete_object(bucket, name)
+            except api_errors.ObjectApiError:
+                pass
+
+    def _version_in_active_pool(self, bucket: str, name: str, oi) -> bool:
+        for i in self.obj.topology.write_pools():
+            if i == self.source:
+                continue
+            z = self.obj.server_sets[i]
+            try:
+                if oi.delete_marker or oi.version_id:
+                    # prefix-narrowed: O(versions of this object), not
+                    # O(bucket) — and never blind past a 1000-name page
+                    for v in z.list_object_versions(bucket, name, "",
+                                                    1000):
+                        if v.name == name and \
+                                v.version_id == oi.version_id:
+                            return True
+                else:
+                    got = z.get_object_info(bucket, name)
+                    if got.etag == oi.etag and \
+                            got.mod_time == oi.mod_time:
+                        return True
+            except api_errors.ObjectApiError:
+                continue
+        return False
+
+    def _copy_version(self, src, bucket: str, name: str, oi) -> int:
+        if oi.delete_marker:
+            idx = self._target_pool(bucket, name, 1 << 20)
+            self.obj.server_sets[idx].put_delete_marker(
+                bucket, name, oi.version_id, oi.mod_time)
+            return 0
+        info, stream = src.get_object(
+            bucket, name, opts=GetOptions(version_id=oi.version_id))
+        metadata = dict(info.user_defined)
+        if info.etag:
+            metadata["etag"] = info.etag
+        if info.content_type:
+            metadata["content-type"] = info.content_type
+        if info.content_encoding:
+            metadata["content-encoding"] = info.content_encoding
+        idx = self._target_pool(bucket, name, info.size)
+        opts = PutOptions(metadata=metadata,
+                          version_id=info.version_id,
+                          versioned=bool(info.version_id),
+                          mod_time=info.mod_time)
+        reader = _IterStream(stream)
+        try:
+            self.obj.server_sets[idx].put_object(bucket, name, reader,
+                                                 info.size, opts)
+        finally:
+            reader.close()
+        return info.size
+
+    def _target_pool(self, bucket: str, name: str, size: int) -> int:
+        """Active pool for one moved version: keep affinity with an
+        active pool already holding the object's history, else weighted
+        free space — never the source."""
+        for i in self.obj.topology.write_pools():
+            if i != self.source and \
+                    self.obj.server_sets[i].has_object_versions(bucket,
+                                                                name):
+                return i
+        idx = self.obj.get_available_zone_idx(max(size, 1) * 2)
+        if idx < 0 or idx == self.source:
+            raise api_errors.InsufficientWriteQuorum(
+                "no active pool has room for the rebalance target")
+        return idx
+
+    def _on_move_failed(self, bucket: str, name: str) -> None:
+        with self._mu:
+            self.state["objects_failed"] += 1
+        _, _, failed_c, _ = _metrics()
+        failed_c.inc(pool=str(self.source))
+        # heal-first: a move that failed on a degraded source heals
+        # through the MRF queue, then the next sweep retries the move
+        src = self.obj.server_sets[self.source]
+        mrf = getattr(src, "mrf", None)
+        if mrf is not None:
+            mrf.enqueue(bucket, name)
+
+    # ------------------------------------------------------------------
+    # throttle: foreground traffic always wins
+    # ------------------------------------------------------------------
+
+    def _busy(self) -> bool:
+        if self._busy_fn is not None:
+            return bool(self._busy_fn())
+        queued = 0
+        for z in self.obj.server_sets:
+            for eng in getattr(z, "sets", ()):
+                sched = getattr(eng, "scheduler", None)
+                if sched is not None:
+                    queued += sched.stats()["queued_blocks"]
+        if queued > 0:
+            return True
+        from ..parallel import pipeline
+        waits = pipeline.pool_pressure()["waits"]
+        last, self._last_pool_waits = self._last_pool_waits, waits
+        return last is not None and waits > last
+
+    def _throttle(self) -> None:
+        for attempt in range(BACKOFF_TRIES):
+            if self._stop.is_set() or not self._busy():
+                return
+            self._stop.wait(backoff_delay(self._throttle_base,
+                                          BACKOFF_MAX_S, attempt))
+        # still busy after the cap: proceed at the slow cadence anyway
+        # so a permanently-loaded cluster still drains
+
+    # ------------------------------------------------------------------
+    # checkpoint persistence
+    # ------------------------------------------------------------------
+
+    def _set(self, **kw) -> None:
+        with self._mu:
+            self.state.update(kw)
+            self.state["updated"] = time.time()
+
+    def _save_checkpoint(self) -> None:
+        with self._mu:
+            doc = dict(self.state)
+        payload = json.dumps(doc).encode()
+        # every ACTIVE pool gets a copy: the checkpoint must survive the
+        # source pool's removal
+        for i in self.obj.topology.write_pools():
+            if i == self.source:
+                continue
+            try:
+                self.obj.server_sets[i].put_object(
+                    MINIO_META_BUCKET, _checkpoint_object(self.source),
+                    payload)
+            except Exception:  # noqa: BLE001 — best-effort per pool
+                pass
+
+    @staticmethod
+    def load_checkpoint(server_sets: "ErasureServerSets",
+                        pool: int) -> Optional[dict]:
+        best: Optional[dict] = None
+        for z in server_sets.server_sets:
+            try:
+                _, stream = z.get_object(MINIO_META_BUCKET,
+                                         _checkpoint_object(pool))
+                doc = json.loads(b"".join(stream).decode())
+            except (api_errors.ObjectApiError, ValueError):
+                continue
+            if best is None or doc.get("updated", 0) > \
+                    best.get("updated", 0):
+                best = doc
+        return best

@@ -3,8 +3,19 @@
 The reference's top ObjectLayer (cmd/erasure-server-sets.go): multiple
 independent ErasureSets groups. PUT goes to the zone already holding the
 object, else the zone with the most free space weighted by capacity
-(getZoneIdx:195, getAvailableZoneIdx:122); GET/HEAD/DELETE scan zones in
-order; listings merge across zones.
+(getZoneIdx:195, getAvailableZoneIdx:122); GET/HEAD/DELETE scan zones;
+listings merge across zones.
+
+Topology plane (this repo's extension, modeled on upstream pool
+decommission + CRUSH-style placement epochs): the zone list is no
+longer frozen at boot. A persisted :class:`~.topology.TopologyMap`
+gives every pool a state — ``active`` (reads+writes), ``draining``
+(reads only, a background rebalancer is emptying it) or ``suspended``
+(reads only, maintenance). New writes route ONLY to active pools;
+reads scan every pool and the NEWEST version wins (markers included),
+so an object mid-migration — or overwritten while its old home drains
+— always reads correctly. Pools can be appended online
+(:meth:`add_pool`) and drained empty (:meth:`start_decommission`).
 """
 
 from __future__ import annotations
@@ -16,14 +27,24 @@ from typing import Optional
 from ..storage.datatypes import ObjectInfo
 from . import api_errors
 from .sets import ErasureSets
+from .topology import (POOL_ACTIVE, POOL_DRAINING, TopologyError,
+                       TopologyMap, TopologyStore)
 
 DISK_FILL_FRACTION = 0.95  # reference diskFillFraction
 
 
 class ErasureServerSets:
-    def __init__(self, server_sets: list[ErasureSets]):
+    def __init__(self, server_sets: list[ErasureSets],
+                 topology: Optional[TopologyMap] = None,
+                 load_topology: bool = True):
         assert server_sets
         self.server_sets = server_sets
+        self._rebalancer = None        # live Rebalancer (rebalance.py)
+        if topology is None and load_topology:
+            # recover the newest persisted map (highest epoch across
+            # pools); a fresh cluster starts all-active at epoch 0
+            topology = TopologyStore.load(self)
+        self.topology = topology or TopologyMap(len(server_sets))
 
     def single_zone(self) -> bool:
         return len(self.server_sets) == 1
@@ -35,9 +56,14 @@ class ErasureServerSets:
     def _available_space(self, size: int) -> list[int]:
         """Per-zone available bytes after the write, 0 when it would cross
         the fill watermark (getServerSetsAvailableSpace,
-        cmd/erasure-server-sets.go:143-190)."""
+        cmd/erasure-server-sets.go:143-190) — and 0 for every pool the
+        topology excludes from new writes (draining/suspended)."""
+        writable = set(self.topology.write_pools())
         out = []
-        for z in self.server_sets:
+        for i, z in enumerate(self.server_sets):
+            if i not in writable:
+                out.append(0)
+                continue
             info = z.storage_info()
             total, available = info["total"], info["free"]
             if available < size:
@@ -64,14 +90,18 @@ class ErasureServerSets:
         return -1
 
     def get_zone_idx(self, bucket: str, object_name: str, size: int) -> int:
-        """Zone for a PUT: the zone holding ANY version of the object
-        (including a delete marker — version history must stay together)
-        wins; else weighted free space (getZoneIdx,
-        cmd/erasure-server-sets.go:195)."""
+        """Zone for a PUT: the ACTIVE zone holding ANY version of the
+        object (including a delete marker — version history must stay
+        together) wins; else weighted free space among active zones
+        (getZoneIdx, cmd/erasure-server-sets.go:195). A holder that is
+        draining or suspended does NOT get the write — new versions land
+        in an active pool and the newest-wins read keeps them visible
+        while the rebalancer catches the old ones up."""
         if self.single_zone():
             return 0
         for i, z in enumerate(self.server_sets):
-            if z.has_object_versions(bucket, object_name):
+            if self.topology.can_write(i) and \
+                    z.has_object_versions(bucket, object_name):
                 return i
         idx = self.get_available_zone_idx(size * 2)  # ×2 for parity
         if idx < 0:
@@ -127,13 +157,68 @@ class ErasureServerSets:
                 last = e
         raise last or api_errors.ObjectNotFound(bucket, object_name)
 
+    def _zone_for_read(self, bucket: str, object_name: str):
+        """(index, FileInfo) of the zone holding the NEWEST version
+        (delete markers included) — the dual-read rule that keeps GETs
+        correct while an object exists in two pools (mid-rebalance, or
+        overwritten while its old home drains). A pool that cannot
+        answer (offline mid-drain) is skipped so surviving pools still
+        serve; its error only surfaces when NO pool holds the object."""
+        best_i = -1
+        best_fi = None
+        nf: Optional[Exception] = None
+        hard: Optional[Exception] = None
+        for i, z in enumerate(self.server_sets):
+            try:
+                fi = z.latest_file_info(bucket, object_name)
+            except api_errors.ObjectNotFound as e:
+                nf = e
+                continue
+            except api_errors.ObjectApiError as e:
+                hard = e
+                continue
+            if best_fi is None or (fi.mod_time or 0) > \
+                    (best_fi.mod_time or 0):
+                best_i, best_fi = i, fi
+        if best_i < 0:
+            raise hard or nf or api_errors.ObjectNotFound(bucket,
+                                                          object_name)
+        return best_i, best_fi
+
+    def _read_newest(self, bucket, object_name, fn,
+                     marker_is_found: bool = False):
+        """Run `fn(zone)` on the newest-holding zone, re-picking when
+        the copy moved between the pick and the read (a rebalance
+        deletes the source copy only AFTER the target committed, so a
+        re-pick always lands on a live copy; a true concurrent delete
+        converges to ObjectNotFound)."""
+        last: Optional[Exception] = None
+        for _ in range(3):
+            idx, fi = self._zone_for_read(bucket, object_name)
+            if fi.deleted and not marker_is_found:
+                raise api_errors.ObjectNotFound(bucket, object_name)
+            try:
+                return fn(self.server_sets[idx])
+            except api_errors.ObjectNotFound as e:
+                last = e            # moved mid-read: re-pick
+        raise last or api_errors.ObjectNotFound(bucket, object_name)
+
     def get_object(self, bucket, object_name, offset=0, length=-1,
                    opts=None):
+        if not self.single_zone() and not getattr(opts, "version_id", ""):
+            return self._read_newest(
+                bucket, object_name,
+                lambda z: z.get_object(bucket, object_name, offset,
+                                       length, opts))
         return self._first_zone_with(
             lambda z: z.get_object(bucket, object_name, offset, length,
                                    opts), bucket, object_name)
 
     def get_object_info(self, bucket, object_name, opts=None):
+        if not self.single_zone() and not getattr(opts, "version_id", ""):
+            return self._read_newest(
+                bucket, object_name,
+                lambda z: z.get_object_info(bucket, object_name, opts))
         return self._first_zone_with(
             lambda z: z.get_object_info(bucket, object_name, opts),
             bucket, object_name)
@@ -141,20 +226,51 @@ class ErasureServerSets:
     def delete_object(self, bucket, object_name, version_id="",
                       versioned=False):
         self.get_bucket_info(bucket)  # missing bucket must not 204
-        # a versioned delete WRITES a marker — it must land in the zone
-        # holding the object's history, never blindly in zone 0
-        for z in self.server_sets:
-            if z.has_object_versions(bucket, object_name):
-                return z.delete_object(bucket, object_name, version_id,
-                                       versioned)
+        if self.single_zone():
+            return self.server_sets[0].delete_object(
+                bucket, object_name, version_id, versioned)
         if versioned and not version_id:
-            # S3: versioned DELETE of a missing key still writes a marker
-            idx = self.get_available_zone_idx(1 << 20)
-            if idx < 0:
-                raise api_errors.InsufficientWriteQuorum()
+            # a versioned delete WRITES a marker: it must land in an
+            # ACTIVE pool (writes never target draining/suspended
+            # pools); when the newest holder is active, keep affinity
+            # so version history stays together
+            try:
+                idx, _ = self._zone_for_read(bucket, object_name)
+            except api_errors.ObjectNotFound:
+                idx = -1
+            if idx < 0 or not self.topology.can_write(idx):
+                idx = self.get_available_zone_idx(1 << 20)
+                if idx < 0:
+                    raise api_errors.InsufficientWriteQuorum()
             return self.server_sets[idx].delete_object(
                 bucket, object_name, version_id, versioned)
-        raise api_errors.ObjectNotFound(bucket, object_name)
+        if version_id:
+            # remove one specific version from whichever pool holds it
+            last: Optional[Exception] = None
+            for z in self.server_sets:
+                if not z.has_object_versions(bucket, object_name):
+                    continue
+                try:
+                    return z.delete_object(bucket, object_name,
+                                           version_id, versioned)
+                except (api_errors.ObjectNotFound,
+                        api_errors.VersionNotFound) as e:
+                    last = e
+            raise last or api_errors.ObjectNotFound(bucket, object_name)
+        # unversioned delete: purge EVERY pool's copy — an object that
+        # transiently exists in two pools (mid-rebalance) must not
+        # resurrect from the copy a single-zone delete missed
+        out = None
+        found = False
+        for z in self.server_sets:
+            if not z.has_object_versions(bucket, object_name):
+                continue
+            out = z.delete_object(bucket, object_name, version_id,
+                                  versioned)
+            found = True
+        if not found:
+            raise api_errors.ObjectNotFound(bucket, object_name)
+        return out
 
     def delete_objects(self, bucket, objects):
         if self.single_zone():
@@ -178,6 +294,16 @@ class ErasureServerSets:
 
     def update_object_metadata(self, bucket, object_name, metadata,
                                version_id=""):
+        if not self.single_zone() and not version_id:
+            # in-place update must hit the copy reads serve (newest),
+            # not the first zone that happens to hold a shadowed copy
+            # (marker_is_found: the engine answers MethodNotAllowed for
+            # markers itself, matching its single-zone semantics)
+            return self._read_newest(
+                bucket, object_name,
+                lambda z: z.update_object_metadata(bucket, object_name,
+                                                   metadata, version_id),
+                marker_is_found=True)
         return self._first_zone_with(
             lambda z: z.update_object_metadata(bucket, object_name,
                                                metadata, version_id),
@@ -259,12 +385,118 @@ class ErasureServerSets:
 
     def storage_info(self) -> dict:
         zones = [z.storage_info() for z in self.server_sets]
+        for i, z in enumerate(zones):
+            z["pool_state"] = self.topology.state(i)
         return {"total": sum(z["total"] for z in zones),
                 "free": sum(z["free"] for z in zones),
                 "used": sum(z["used"] for z in zones),
                 "online_disks": sum(z["online_disks"] for z in zones),
                 "offline_disks": sum(z["offline_disks"] for z in zones),
+                "topology_epoch": self.topology.epoch,
                 "zones": zones}
+
+    # ------------------------------------------------------------------
+    # topology plane: expansion, decommission, rebalance control
+    # ------------------------------------------------------------------
+
+    def add_pool(self, sets: ErasureSets) -> int:
+        """Online expansion: append one pool, replicate existing bucket
+        namespace onto it, bump+persist the placement epoch. Returns
+        the new pool index."""
+        for vol in self.list_buckets():
+            try:
+                sets.make_bucket(vol.name)
+            except api_errors.BucketExists:
+                pass
+        self.server_sets.append(sets)
+        self.topology.add_pool(POOL_ACTIVE)
+        TopologyStore.save(self, self.topology)
+        # a drain parked for lack of target capacity can proceed now
+        self.resume_rebalance_if_pending()
+        return len(self.server_sets) - 1
+
+    def set_pool_state(self, pool: int, state: str) -> int:
+        """Persisted state transition (suspend/resume a pool for
+        writes). Durable BEFORE it takes effect: the epoch doc is
+        written first, so a crash mid-transition replays it."""
+        prev = self.topology.state(pool) \
+            if 0 <= pool < len(self.server_sets) else None
+        epoch = self.topology.set_state(pool, state)
+        try:
+            TopologyStore.save(self, self.topology)
+        except TopologyError:
+            if prev is not None:        # roll back the in-memory map
+                self.topology.set_state(pool, prev)
+            raise
+        return epoch
+
+    def start_decommission(self, pool: int, **rebalance_kw) -> dict:
+        """Mark `pool` draining and start the background rebalancer
+        moving its objects into the remaining active pools."""
+        from .rebalance import Rebalancer
+        if self._rebalancer is not None and self._rebalancer.running():
+            raise TopologyError(
+                f"a rebalance of pool {self._rebalancer.source} is "
+                "already running")
+        if self.topology.state(pool) != POOL_DRAINING:
+            self.set_pool_state(pool, POOL_DRAINING)
+        # honor a persisted checkpoint by default (a canceled drain
+        # restarted via the admin API continues where it stopped; the
+        # drain loop's final full sweeps still catch earlier names)
+        rebalance_kw.setdefault("resume", True)
+        self._rebalancer = Rebalancer(self, pool, **rebalance_kw)
+        self._rebalancer.start()
+        return {"pool": pool, "epoch": self.topology.epoch,
+                "status": "draining"}
+
+    def resume_rebalance_if_pending(self) -> bool:
+        """Boot hook (re-armed by add_pool): a pool left in `draining`
+        state (process died mid-drain) resumes its rebalance from the
+        persisted checkpoint instead of restarting from scratch. A
+        drain with no active pool to move INTO stays parked until
+        capacity attaches — every move would fail its target choice."""
+        from .rebalance import Rebalancer
+        if self._rebalancer is not None and self._rebalancer.running():
+            return False
+        targets = self.topology.write_pools()
+        for pool in self.topology.draining_pools():
+            if not any(t != pool for t in targets):
+                continue
+            self._rebalancer = Rebalancer(self, pool, resume=True)
+            self._rebalancer.start()
+            return True
+        return False
+
+    def rebalance_status(self) -> dict:
+        out = {"topology": self.topology.to_dict()}
+        if self._rebalancer is not None:
+            out["rebalance"] = self._rebalancer.status()
+        else:
+            # a drain may have finished in a previous process: surface
+            # the persisted checkpoint so status survives restarts
+            from .rebalance import Rebalancer
+            for pool in range(len(self.server_sets)):
+                doc = Rebalancer.load_checkpoint(self, pool)
+                if doc is not None:
+                    out.setdefault("checkpoints", []).append(doc)
+        return out
+
+    def cancel_rebalance(self) -> dict:
+        """Stop the drain and return the pool to active service; the
+        checkpoint is kept so a later decommission resumes where this
+        one stopped. The pool is reactivated only once the walker has
+        ACTUALLY stopped — flipping it active with a move in flight
+        would let the walker's source purge race a client write."""
+        if self._rebalancer is None:
+            raise TopologyError("no rebalance is running")
+        reb = self._rebalancer
+        if not reb.stop():
+            return {"pool": reb.source, "status": "stopping",
+                    "epoch": self.topology.epoch}
+        if self.topology.state(reb.source) == POOL_DRAINING:
+            self.set_pool_state(reb.source, POOL_ACTIVE)
+        return {"pool": reb.source, "status": "canceled",
+                "epoch": self.topology.epoch}
 
     # ------------------------------------------------------------------
     # MRF heal queue (per-zone queues, aggregated view)
@@ -287,5 +519,8 @@ class ErasureServerSets:
         return out
 
     def close(self) -> None:
+        if self._rebalancer is not None:
+            self._rebalancer.stop()
+            self._rebalancer = None
         for z in self.server_sets:
             z.close()

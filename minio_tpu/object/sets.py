@@ -325,6 +325,15 @@ class ErasureSets:
         return self.get_hashed_set(object_name).has_object_versions(
             bucket, object_name)
 
+    def latest_file_info(self, bucket, object_name):
+        return self.get_hashed_set(object_name).latest_file_info(
+            bucket, object_name)
+
+    def put_delete_marker(self, bucket, object_name, version_id="",
+                          mod_time=None):
+        return self.get_hashed_set(object_name).put_delete_marker(
+            bucket, object_name, version_id, mod_time)
+
     # ------------------------------------------------------------------
     # multipart (route by object name)
     # ------------------------------------------------------------------

@@ -45,13 +45,31 @@ ENABLED = os.environ.get("MINIO_TPU_PIPELINE", "on").strip().lower() \
     not in ("off", "0", "false", "no")
 DEPTH = max(1, int(os.environ.get("MINIO_TPU_PIPELINE_DEPTH", "2")))
 # staging ring size: the pool is SHARED by every stream of a geometry,
-# so it must scale with the host's useful concurrency (requests_budget
-# admits ~8×cores; each admitted stream keeps ~2 batches in flight) or
-# it throttles aggregate throughput instead of just bounding memory
+# so it must scale with the ADMITTED concurrency (each admitted stream
+# keeps ~2 batches in flight) or it throttles aggregate throughput
+# instead of just bounding memory. The 2×cores value is only the
+# fallback for pool rings created before the server computes its
+# admission budget — configure_pool_buffers() re-derives the default
+# from requests_budget() at boot (the env knob always wins).
+_POOL_ENV_SET = "MINIO_TPU_PIPELINE_POOL" in os.environ
 POOL_BUFFERS = max(4, int(os.environ.get(
     "MINIO_TPU_PIPELINE_POOL", str(2 * (os.cpu_count() or 4)))))
 POOL_TIMEOUT_S = float(os.environ.get(
     "MINIO_TPU_PIPELINE_POOL_TIMEOUT_S", "60"))
+
+
+def configure_pool_buffers(requests_budget: int) -> int:
+    """Size the staging rings from the RAM-gated admission budget: the
+    budget already bounds in-flight object requests by RAM/2 with ~2
+    staging buffers per request in its per-request footprint, so
+    2×budget buffers per ring is the matching capacity (the old flat
+    2×cores default starved budgets above one stream per core and
+    oversized tiny-RAM hosts). Applies to rings created AFTER the call;
+    MINIO_TPU_PIPELINE_POOL overrides. Returns the effective size."""
+    global POOL_BUFFERS
+    if not _POOL_ENV_SET:
+        POOL_BUFFERS = max(4, 2 * int(requests_budget))
+    return POOL_BUFFERS
 
 # GET lookahead reads run here, NOT on metadata._POOL: a prefetch task
 # fans its per-reader reads out onto _POOL, and a task that waits on

@@ -277,6 +277,44 @@ class AdminHandlers:
             fn = getattr(self.api.obj, "mrf_stats", None)
             return self._json(fn() if callable(fn) else {})
 
+        # -- topology plane: pool states, decommission, rebalance ----------
+        if sub == "rebalance" and m == "POST":
+            # start draining a pool: its objects migrate to the active
+            # pools in the background (upstream decommission start)
+            self._auth(ctx, "admin:Rebalance")
+            try:
+                pool = int(ctx.query1("pool", "-1"))
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad pool index") from None
+            return self._json(self._topology_call(
+                "start_decommission", pool))
+        if sub == "rebalance" and m == "GET":
+            self._auth(ctx, "admin:Rebalance")
+            return self._json(self._topology_call("rebalance_status"))
+        if sub == "rebalance" and m == "DELETE":
+            self._auth(ctx, "admin:Rebalance")
+            return self._json(self._topology_call("cancel_rebalance"))
+        if sub == "topology" and m == "GET":
+            self._auth(ctx, "admin:Rebalance")
+            topo = getattr(self.api.obj, "topology", None)
+            if topo is None:
+                raise S3Error("NotImplemented",
+                              "backend has no pool topology")
+            return self._json(topo.to_dict())
+        if sub == "topology" and m == "POST":
+            # suspend/resume a pool for writes without draining it
+            self._auth(ctx, "admin:Rebalance")
+            try:
+                pool = int(ctx.query1("pool", "-1"))
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad pool index") from None
+            state = ctx.query1("state", "")
+            epoch = self._topology_call("set_pool_state", pool, state)
+            return self._json({"pool": pool, "state": state,
+                               "epoch": epoch})
+
         # -- config KV (cmd/admin-handlers-config-kv.go) -------------------
         if sub == "get-config" and m == "GET":
             self._auth(ctx, "admin:ConfigUpdate")
@@ -421,6 +459,20 @@ class AdminHandlers:
         if self.api.iam is None:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
+
+    def _topology_call(self, method: str, *args):
+        """Dispatch a topology-plane verb on the object layer; backends
+        without pools (FS, gateways) answer NotImplemented and invalid
+        transitions map to AdminInvalidArgument."""
+        from ..object.topology import TopologyError
+        fn = getattr(self.api.obj, method, None)
+        if not callable(fn):
+            raise S3Error("NotImplemented",
+                          "backend has no pool topology")
+        try:
+            return fn(*args)
+        except TopologyError as e:
+            raise S3Error("AdminInvalidArgument", str(e)) from None
 
     def _require_bucket(self, bucket: str) -> None:
         """Quota/remote-target admin must target a REAL bucket —

@@ -354,3 +354,64 @@ def test_bandwidth_meter_window():
                                         "rx_total": 10, "tx_total": 0}}])
     assert merged["b"]["rx_total"] == 1010
     assert merged["b"]["rx_bps"] == 150.0
+
+
+def test_admin_topology_and_rebalance(tmp_path):
+    """The topology admin surface end-to-end over live HTTP + madmin:
+    GET topology, suspend/resume a pool, start a decommission, poll it
+    to completion, and see the rebalance metrics in the exposition."""
+    from minio_tpu.madmin import AdminClient, AdminClientError
+    from minio_tpu.object.server_sets import ErasureServerSets
+
+    def zone(tag):
+        return ErasureSets.from_drives(
+            [str(tmp_path / f"{tag}d{i}") for i in range(4)], 1, 4, 2,
+            block_size=1 << 16, enable_mrf=False)
+
+    zz = ErasureServerSets([zone("p0"), zone("p1")])
+    zz.make_bucket("b")
+    for i in range(4):
+        zz.server_sets[0].put_object("b", f"adm-{i}", b"m" * 500)
+    iam = IAMSys(zz, root_cred=CREDS)
+    srv = S3Server(zz, creds=CREDS, region=REGION, iam=iam).start()
+    mount_admin(srv)
+    cli = AdminClient("127.0.0.1", srv.port, CREDS.access_key,
+                      CREDS.secret_key, region=REGION)
+    try:
+        topo = cli.topology()
+        assert topo["pools"] == ["active", "active"]
+        out = cli.set_pool_state(0, "suspended")
+        assert out["epoch"] == 1
+        assert cli.topology()["pools"][0] == "suspended"
+        cli.set_pool_state(0, "active")
+        with pytest.raises(AdminClientError):
+            cli.start_rebalance(9)              # no such pool
+        with pytest.raises(AdminClientError):
+            cli.cancel_rebalance()              # nothing running
+        out = cli.start_rebalance(0)
+        assert out["status"] == "draining"
+        deadline = time.monotonic() + 60
+        st = {}
+        while time.monotonic() < deadline:
+            st = cli.rebalance_status()
+            if st.get("rebalance", {}).get("status") == "complete":
+                break
+            time.sleep(0.05)
+        assert st["rebalance"]["status"] == "complete", st
+        assert st["rebalance"]["objects_moved"] == 4
+        assert st["topology"]["pools"][0] == "draining"
+        assert zz.server_sets[0].list_object_versions(
+            "b", max_keys=10) == []
+        for i in range(4):
+            _, it = zz.get_object("b", f"adm-{i}")
+            assert b"".join(it) == b"m" * 500
+        text = cli.metrics_text()
+        assert 'minio_tpu_rebalance_objects_total{pool="0"}' in text
+        assert "minio_tpu_rebalance_failed_total" in text
+        # storage info surfaces per-pool states + the epoch
+        info = cli.storage_info()
+        assert info["zones"][0]["pool_state"] == "draining"
+        assert info["topology_epoch"] >= 1
+    finally:
+        srv.stop()
+        zz.close()

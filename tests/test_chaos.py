@@ -248,6 +248,165 @@ def test_chaos_schedule_is_deterministic():
 
 
 # ---------------------------------------------------------------------------
+# topology plane: rebalance under faults
+# ---------------------------------------------------------------------------
+
+def test_chaos_rebalance_pool_death_and_bitrot(tmp_path):
+    """Pool drain under chaos: the whole TARGET pool dies mid-drain
+    (every move fails at write quorum) and the source serves reads
+    with bitrot on <= parity drives. Invariants: no write-quorum
+    object is lost (everything stays readable from the source), failed
+    moves land in the source MRF queue and count in
+    minio_tpu_rebalance_failed_total; after the target recovers the
+    drain converges and the source pool is empty."""
+    from minio_tpu.object.rebalance import Rebalancer
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.object.topology import POOL_DRAINING
+    from minio_tpu.utils import telemetry
+
+    seed = chaos_seed(4404)
+    announce(seed)
+    # source: bitrot on read for <= parity drives (moves reconstruct)
+    src_sched = {j: FaultSchedule(seed=seed + j, bitrot_rate=0.2,
+                                  fault_verbs=("read_file",
+                                               "read_file_stream"))
+                 for j in range(M)}
+    src, src_naughty = make_chaos_sets(tmp_path / "src", src_sched)
+    # target: plain wrappers we can kill wholesale ("pool death")
+    dst_drives = []
+    dst_naughty = []
+    for j in range(NDISKS):
+        nd = NaughtyDisk(XLStorage(str(tmp_path / "dst" / f"d{j}")),
+                         schedule=FaultSchedule(seed=seed + 100 + j),
+                         enabled=False)
+        dst_naughty.append(nd)
+        dst_drives.append(nd)
+    dst = ErasureSets.from_storage(dst_drives, 1, NDISKS, M, block_size=BLOCK,
+                                   mrf_options=dict(MRF_TEST_OPTIONS))
+    dst.make_bucket("b")
+    zz = ErasureServerSets([src, dst])
+    try:
+        datas = {}
+        for i in range(6):
+            name = f"chaos-{i}"
+            data = payload(BLOCK + 211 * i, seed=seed + i)
+            src.put_object("b", name, data)
+            datas[name] = data
+        zz.set_pool_state(0, POOL_DRAINING)
+
+        def failed_total():
+            snap = telemetry.REGISTRY.snapshot(
+                "minio_tpu_rebalance_failed_total")
+            return snap.get("minio_tpu_rebalance_failed_total",
+                            {}).get("pool=0", 0)
+
+        failed_before = failed_total()
+        # pool death: > parity target drives offline -> every move
+        # fails its target write quorum
+        for nd in dst_naughty[:M + 2]:
+            nd.offline = True
+        for nd in src_naughty:
+            nd.arm()
+        reb = Rebalancer(zz, 0, busy_fn=lambda: False)
+        moved, failed, remaining = reb.run_pass()
+        assert moved == 0 and failed == len(datas)
+        assert remaining == len(datas)
+        assert failed_total() - failed_before >= len(datas)
+        # failed moves fed the source MRF queue
+        assert src.mrf_stats()["queued"] >= 1
+        # nothing lost: every object still reads byte-identical
+        for name, data in datas.items():
+            _, it = zz.get_object("b", name)
+            assert b"".join(it) == data, name
+
+        # target pool recovers: the drain converges
+        for nd in dst_naughty:
+            nd.offline = False
+        src.drain_mrf(30.0)
+        moved2, failed2, remaining2 = reb.run_pass(restart=True)
+        assert failed2 == 0 and remaining2 == 0
+        assert moved2 == len(datas)
+        for nd in src_naughty:
+            nd.disarm()
+        assert src.list_object_versions("b", max_keys=20) == []
+        for name, data in datas.items():
+            _, it = zz.get_object("b", name)
+            assert b"".join(it) == data, name
+            assert dst.has_object_versions("b", name)
+    finally:
+        zz.close()
+
+
+# ---------------------------------------------------------------------------
+# RemoteStorage drives: faults injected on the SERVER side of the RPC
+# ---------------------------------------------------------------------------
+
+def test_chaos_remote_storage_faults_over_rpc(tmp_path):
+    """A NaughtyDisk schedule BEHIND storage_rpc: verb errors, bitrot
+    and truncated streams are injected server-side, so every fault
+    crosses the wire through the RPC error-mapping path (wire fault ->
+    serr.* reconstruction, mid-stream truncation -> NetworkStorageError)
+    instead of a local wrapper shortcut. Quorum ops succeed, bytes stay
+    identical, MRF + heal converge every shard."""
+    from minio_tpu.distributed.storage_rpc import (RemoteStorage,
+                                                   StorageRPCServer)
+    from minio_tpu.distributed.transport import RPCServer
+
+    seed = chaos_seed(5505)
+    announce(seed)
+    ak, sk = "chaoskey", "chaossecret1234"
+    naughty: list[NaughtyDisk] = []
+    serving: dict[str, object] = {}
+    for j in range(NDISKS):
+        d = XLStorage(str(tmp_path / f"d{j}"))
+        if j < M:
+            nd = NaughtyDisk(d, schedule=FaultSchedule(
+                seed=seed + j, error_rate=0.15, bitrot_rate=0.15,
+                truncate_rate=0.15), enabled=False)
+            naughty.append(nd)
+            serving[f"/d{j}"] = nd
+        else:
+            serving[f"/d{j}"] = d
+    rpc_srv = StorageRPCServer(serving, ak, sk)
+    host = RPCServer().start()
+    host.mount(rpc_srv.handler)
+    remotes = [RemoteStorage("127.0.0.1", host.port, f"/d{j}", ak, sk)
+               for j in range(NDISKS)]
+    sets = ErasureSets.from_storage(
+        remotes, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK, sources=list(remotes),
+        mrf_options=dict(MRF_TEST_OPTIONS))
+    sets.make_bucket("b")
+    try:
+        for nd in naughty:
+            nd.arm()
+        datas = run_workload(sets, seed=seed)
+        for nd in naughty:
+            nd.disarm()
+        # the schedule really fired behind the RPC server
+        injected = sum(nd.stats.errors + nd.stats.bitrot
+                       + nd.stats.truncated for nd in naughty)
+        assert injected > 0
+        assert sets.drain_mrf(30.0)
+        for name in datas:
+            sets.heal_object("b", name, deep_scan=True)
+        assert sets.drain_mrf(30.0)
+        assert sets.mrf_stats()["pending"] == 0
+        for name, data in datas.items():
+            _, it = sets.get_object("b", name)
+            assert b"".join(it) == data, name
+            for d in sets.sets[0].disks:
+                fi = d.read_version("b", name)
+                d.check_parts("b", name, fi)
+                d.verify_file("b", name, fi)
+    finally:
+        sets.close()
+        for r in remotes:
+            r.close()
+        host.stop()
+
+
+# ---------------------------------------------------------------------------
 # long randomized schedules (nightly)
 # ---------------------------------------------------------------------------
 

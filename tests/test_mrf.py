@@ -458,6 +458,77 @@ def test_mrf_retries_offline_slot_until_failed(tmp_path):
         sets.close()
 
 
+def test_mrf_kick_collapses_pending_backoffs():
+    """kick() makes backed-off entries ready immediately — the
+    re-admission hook's primitive."""
+    gate = {"open": False}
+    attempts = []
+
+    def heal(b, o, v):
+        attempts.append(time.monotonic())
+        if not gate["open"]:
+            raise api_errors.InsufficientReadQuorum("drive still gone")
+
+    # enormous backoff: without kick() the retry would wait ~minutes
+    h = MRFHealer(heal, max_retries=5, backoff_base=120.0,
+                  backoff_max=120.0)
+    try:
+        h.enqueue("b", "o")
+        deadline = time.monotonic() + 5
+        while not attempts and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(attempts) == 1          # first try failed, backed off
+        gate["open"] = True
+        assert h.kick() == 1
+        assert h.drain(5.0)
+        assert h.stats()["healed"] == 1
+        assert len(attempts) == 2
+    finally:
+        h.close()
+
+
+def test_disk_monitor_readmission_kicks_mrf(tmp_path):
+    """A drive coming back online drains its pending MRF entries
+    immediately instead of waiting out the retry window: the PUT that
+    degraded while the drive was wiped heals the moment the monitor
+    re-admits it (ROADMAP PR 1 follow-up)."""
+    import shutil
+    drives = []
+    for j in range(NDISKS):
+        drives.append(XLStorage(str(tmp_path / f"d{j}")))
+    sets = ErasureSets.from_storage(
+        drives, set_count=1, set_drive_count=NDISKS, parity=M,
+        block_size=BLOCK,
+        # backoff far beyond the test horizon: only kick() can finish it
+        mrf_options=dict(max_retries=8, backoff_base=120.0,
+                         backoff_max=120.0))
+    try:
+        sets.make_bucket("b")
+        # kill slot 0 hard (wipe the directory) so the PUT degrades
+        dead_root = drives[0].root
+        sets.sets[0].disks[0] = None
+        shutil.rmtree(dead_root)
+        sets.put_object("b", "o", b"q" * 2000)
+        stats = sets.mrf_stats()
+        assert stats["queued"] >= 1
+        deadline = time.monotonic() + 5
+        while sets.mrf.stats()["pending"] == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)   # first heal attempt fails -> backs off
+        mon = DiskMonitor(sets, interval=3600)
+        admitted = mon.scan_once()         # drive returns: re-admission
+        assert admitted >= 1
+        assert sets.drain_mrf(10.0)        # immediate, despite backoff
+        stats = sets.mrf_stats()
+        assert stats["pending"] == 0 and stats["failed"] == 0
+        # the healed copy verifies on the re-admitted drive
+        d = sets.sets[0].disks[0]
+        fi = d.read_version("b", "o")
+        d.verify_file("b", "o", fi)
+    finally:
+        sets.close()
+
+
 # ---------------------------------------------------------------------------
 # background-plane error counters
 # ---------------------------------------------------------------------------

@@ -344,3 +344,25 @@ def test_obd_surfaces_transport_counters():
     assert t["calls"] >= 1 and t["net_errors"] >= 1
     assert t["offline_trips"] == 1 and t["online"] is False
     rs.rc.close()
+
+
+def test_staging_ring_sized_from_admission_budget(monkeypatch):
+    """configure_pool_buffers() derives the ring capacity from the
+    RAM-gated admission budget (~2 buffers per admitted stream) for
+    rings created after boot; the env knob pins it; tiny budgets keep
+    the floor (ROADMAP PR 2 follow-up)."""
+    from minio_tpu.parallel import pipeline as pl
+    old = pl.POOL_BUFFERS
+    try:
+        monkeypatch.setattr(pl, "_POOL_ENV_SET", False)
+        assert pl.configure_pool_buffers(24) == 48
+        assert pl.POOL_BUFFERS == 48
+        pool = pl.staging_pool(48 * 1024 + 1)   # fresh width -> new ring
+        assert pool.capacity == 48
+        assert pl.configure_pool_buffers(1) == 4          # floor
+        # with MINIO_TPU_PIPELINE_POOL set, the operator's value wins
+        monkeypatch.setattr(pl, "_POOL_ENV_SET", True)
+        pl.POOL_BUFFERS = 7
+        assert pl.configure_pool_buffers(100) == 7
+    finally:
+        pl.POOL_BUFFERS = old

@@ -1,0 +1,354 @@
+"""Topology-plane tests: placement epochs (persisted pool states),
+write routing around draining/suspended pools, newest-wins dual-read,
+online expansion, and the resumable background rebalancer — including
+the end-to-end decommission acceptance flow (drain a pool while GETs
+interleave, kill/resume mid-drain from the checkpoint)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.object import api_errors
+from minio_tpu.object.engine import PutOptions
+from minio_tpu.object.rebalance import Rebalancer
+from minio_tpu.object.server_sets import ErasureServerSets
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.topology import (POOL_ACTIVE, POOL_DRAINING,
+                                       POOL_SUSPENDED, TopologyError,
+                                       TopologyMap, TopologyStore)
+from minio_tpu.storage.xl_storage import MINIO_META_BUCKET
+from minio_tpu.utils import telemetry
+
+BLOCK = 1 << 16
+NEVER_BUSY = dict(busy_fn=lambda: False, throttle_s=0.001)
+# version ids are serialized as UUID bytes in xl.meta
+VID1 = "00000000-0000-4000-8000-000000000001"
+VID2 = "00000000-0000-4000-8000-000000000002"
+VIDM = "00000000-0000-4000-8000-00000000000f"
+
+
+def make_zone(tmp_path, tag: str, enable_mrf: bool = False) -> ErasureSets:
+    return ErasureSets.from_drives(
+        [str(tmp_path / f"{tag}d{i}") for i in range(4)], 1, 4, 2,
+        block_size=BLOCK, enable_mrf=enable_mrf)
+
+
+@pytest.fixture()
+def pools(tmp_path):
+    zz = ErasureServerSets([make_zone(tmp_path, "p0"),
+                            make_zone(tmp_path, "p1")])
+    zz.make_bucket("b")
+    yield zz
+    zz.close()
+
+
+def holders(zz, bucket, name):
+    return [i for i, z in enumerate(zz.server_sets)
+            if z.has_object_versions(bucket, name)]
+
+
+def wait_status(zz, want: str, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = zz.rebalance_status().get("rebalance", {})
+        if st.get("status") == want:
+            return st
+        if st.get("status") == "failed":
+            raise AssertionError(f"rebalance failed: {st}")
+        time.sleep(0.05)
+    raise AssertionError(
+        f"rebalance never reached {want!r}: {zz.rebalance_status()}")
+
+
+# ---------------------------------------------------------------------------
+# placement epochs
+# ---------------------------------------------------------------------------
+
+def test_topology_map_transitions():
+    tm = TopologyMap(3)
+    assert tm.epoch == 0 and tm.write_pools() == [0, 1, 2]
+    assert tm.set_state(1, POOL_DRAINING) == 1
+    assert tm.write_pools() == [0, 2]
+    assert tm.draining_pools() == [1]
+    # idempotent transition does not burn an epoch
+    assert tm.set_state(1, POOL_DRAINING) == 1
+    assert tm.set_state(2, POOL_SUSPENDED) == 2
+    # the LAST active pool can never be demoted
+    with pytest.raises(TopologyError):
+        tm.set_state(0, POOL_DRAINING)
+    with pytest.raises(TopologyError):
+        tm.set_state(7, POOL_ACTIVE)
+    with pytest.raises(TopologyError):
+        tm.set_state(0, "bogus")
+    assert tm.set_state(1, POOL_ACTIVE) == 3
+
+
+def test_epoch_persists_and_reloads(pools):
+    zz = pools
+    epoch = zz.set_pool_state(0, POOL_SUSPENDED)
+    assert epoch == 1
+    # a fresh layer over the same zones recovers the newest epoch
+    zz2 = ErasureServerSets(zz.server_sets)
+    assert zz2.topology.epoch == 1
+    assert zz2.topology.state(0) == POOL_SUSPENDED
+    assert zz2.topology.state(1) == POOL_ACTIVE
+    # highest epoch wins even when one pool missed the update: write a
+    # STALE doc into pool 1 only
+    stale = TopologyMap(2)
+    import json
+    zz.server_sets[1].put_object(
+        MINIO_META_BUCKET, "topology/pools.json",
+        json.dumps(stale.to_dict()).encode())
+    zz.server_sets[0].put_object(
+        MINIO_META_BUCKET, "topology/pools.json",
+        json.dumps({"epoch": 5, "pools": ["active", "draining"]}
+                   ).encode())
+    zz3 = ErasureServerSets(zz.server_sets)
+    assert zz3.topology.epoch == 5
+    assert zz3.topology.state(1) == POOL_DRAINING
+
+
+def test_writes_route_only_to_active(pools):
+    zz = pools
+    zz.set_pool_state(0, POOL_DRAINING)
+    for i in range(8):
+        zz.put_object("b", f"o-{i}", b"x" * 100)
+        assert holders(zz, "b", f"o-{i}") == [1]
+    # multipart sessions open in active pools only
+    uid = zz.new_multipart_upload("b", "mp")
+    assert zz._zone_of_upload("b", "mp", uid) is zz.server_sets[1]
+    zz.abort_multipart_upload("b", "mp", uid)
+    # overwrite of an object held by the DRAINING pool lands active,
+    # and the newest-wins read serves the new bytes
+    zz.server_sets[0].put_object("b", "held", b"old-bytes")
+    zz.put_object("b", "held", b"new-bytes!")
+    assert sorted(holders(zz, "b", "held")) == [0, 1]
+    _, it = zz.get_object("b", "held")
+    assert b"".join(it) == b"new-bytes!"
+    assert zz.get_object_info("b", "held").size == len(b"new-bytes!")
+
+
+def test_newest_marker_shadows_older_data(pools):
+    zz = pools
+    zz.server_sets[0].put_object("b", "o", b"payload")
+    time.sleep(0.01)
+    # a NEWER delete marker in the other pool must shadow the data copy
+    zz.server_sets[1].put_delete_marker("b", "o", VIDM)
+    with pytest.raises(api_errors.ObjectNotFound):
+        zz.get_object_info("b", "o")
+    with pytest.raises(api_errors.ObjectNotFound):
+        zz.get_object("b", "o")
+
+
+def test_unversioned_delete_purges_every_pool(pools):
+    zz = pools
+    zz.server_sets[0].put_object("b", "dup", b"v-old")
+    zz.server_sets[1].put_object("b", "dup", b"v-new")
+    zz.delete_object("b", "dup")
+    assert holders(zz, "b", "dup") == []
+    with pytest.raises(api_errors.ObjectNotFound):
+        zz.get_object_info("b", "dup")
+
+
+def test_add_pool_online_expansion(tmp_path):
+    zz = ErasureServerSets([make_zone(tmp_path, "p0")])
+    zz.make_bucket("b")
+    zz.put_object("b", "pre", b"before-expansion")
+    try:
+        idx = zz.add_pool(make_zone(tmp_path, "p1"))
+        assert idx == 1
+        assert zz.topology.epoch == 1
+        assert len(zz.topology) == 2
+        # namespace replicated onto the new pool
+        assert zz.server_sets[1].bucket_exists("b")
+        # overwrite affinity: the object's history stays in pool 0
+        zz.put_object("b", "pre", b"after-expansion!")
+        assert holders(zz, "b", "pre") == [0]
+        _, it = zz.get_object("b", "pre")
+        assert b"".join(it) == b"after-expansion!"
+        # the persisted epoch doc reaches both pools
+        zz2 = ErasureServerSets(zz.server_sets)
+        assert zz2.topology.epoch == 1 and len(zz2.topology) == 2
+    finally:
+        zz.close()
+
+
+# ---------------------------------------------------------------------------
+# decommission + rebalance
+# ---------------------------------------------------------------------------
+
+def test_last_active_pool_cannot_drain(tmp_path):
+    zz = ErasureServerSets([make_zone(tmp_path, "solo")])
+    try:
+        with pytest.raises(TopologyError):
+            zz.start_decommission(0)
+    finally:
+        zz.close()
+
+
+def test_decommission_end_to_end(pools):
+    """The acceptance flow: 2 pools -> drain pool 0 with interleaved
+    GETs -> everything readable throughout, pool 0 empty, status
+    complete, version history + markers preserved."""
+    zz = pools
+    datas = {}
+    for i in range(6):
+        name = f"e2e-{i}"
+        data = bytes([i]) * (BLOCK + 137 * i)
+        zz.server_sets[i % 2].put_object("b", name, data)
+        datas[name] = data
+    # a versioned object with two versions and a non-latest marker:
+    # v1, marker, then v2 (ids must survive the move)
+    z0 = zz.server_sets[0]
+    z0.put_object("b", "ver", b"v1-bytes",
+                  opts=PutOptions(versioned=True, version_id=VID1))
+    time.sleep(0.01)
+    z0.delete_object("b", "ver", versioned=True)
+    time.sleep(0.01)
+    z0.put_object("b", "ver", b"v2-bytes!",
+                  opts=PutOptions(versioned=True, version_id=VID2))
+    datas["ver"] = b"v2-bytes!"
+
+    stop_reads = threading.Event()
+    read_failures: list = []
+
+    def reader():
+        while not stop_reads.is_set():
+            for name, data in datas.items():
+                try:
+                    _, it = zz.get_object("b", name)
+                    if b"".join(it) != data:
+                        read_failures.append((name, "byte mismatch"))
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    read_failures.append((name, repr(e)))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        out = zz.start_decommission(0, checkpoint_every=2, **NEVER_BUSY)
+        assert out["status"] == "draining"
+        assert zz.topology.state(0) == POOL_DRAINING
+        st = wait_status(zz, "complete")
+    finally:
+        stop_reads.set()
+        t.join()
+    assert not read_failures, read_failures[:5]
+    # pool 0 held e2e-0/2/4 and "ver" — 4 object names moved
+    assert st["objects_moved"] == 4
+    assert st["objects_failed"] == 0
+    # pool 0 holds nothing movable anymore
+    assert zz.server_sets[0].list_object_versions("b", max_keys=10) == []
+    for name, data in datas.items():
+        assert holders(zz, "b", name) == [1], name
+        _, it = zz.get_object("b", name)
+        assert b"".join(it) == data
+    vers = [(v.version_id, v.delete_marker, v.mod_time)
+            for v in zz.server_sets[1].list_object_versions("b", "ver")
+            if v.name == "ver"]
+    assert len(vers) == 3
+    assert {v[0] for v in vers} >= {VID1, VID2}
+    assert any(v[1] for v in vers)          # the marker moved too
+    # moves preserved mod times (newest is still vid-2)
+    assert vers[0][0] == VID2 and not vers[0][1]
+    # rebalance progress metrics counted the work
+    snap = telemetry.REGISTRY.snapshot("minio_tpu_rebalance")
+    moved = snap["minio_tpu_rebalance_objects_total"].get("pool=0", 0)
+    assert moved >= 4           # version moves counted (≥ names moved)
+
+
+def test_rebalance_resumes_from_checkpoint(pools):
+    zz = pools
+    for i in range(10):
+        zz.server_sets[0].put_object("b", f"r-{i:02d}", b"y" * 200)
+    zz.set_pool_state(0, POOL_DRAINING)
+
+    moves = 0
+
+    def busy():
+        nonlocal moves
+        moves += 1
+        if moves == 5:
+            reb.stop()          # kill mid-drain (throttle runs
+        return False            # before each object's move)
+
+    reb = Rebalancer(zz, 0, checkpoint_every=1, busy_fn=busy)
+    reb.start()
+    deadline = time.monotonic() + 30
+    while reb.running() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not reb.running()
+    first = reb.status()
+    assert first["status"] == "stopped"
+    assert 0 < first["objects_moved"] < 10
+    # the persisted checkpoint carries the marker
+    ckpt = Rebalancer.load_checkpoint(zz, 0)
+    assert ckpt is not None and ckpt["marker"]
+
+    # a NEW rebalancer (fresh process) resumes from the checkpoint
+    reb2 = Rebalancer(zz, 0, resume=True, checkpoint_every=1,
+                      **NEVER_BUSY)
+    assert reb2.state.get("resumed")
+    assert reb2.state["marker"] == ckpt["marker"]
+    zz._rebalancer = reb2
+    reb2.start()
+    st = wait_status(zz, "complete")
+    # it finished the job without redoing the first instance's moves
+    # (the one object interrupted MID-move may be finished — and so
+    # counted — by both instances)
+    assert 10 <= st["objects_moved"] <= 11
+    assert zz.server_sets[0].list_object_versions("b", max_keys=20) == []
+    for i in range(10):
+        assert holders(zz, "b", f"r-{i:02d}") == [1]
+
+
+def test_rebalance_throttle_backs_off_on_occupancy(pools):
+    zz = pools
+    calls = []
+    reb = Rebalancer(zz, 0, busy_fn=lambda: calls.append(1) or True,
+                     throttle_s=0.001)
+    t0 = time.monotonic()
+    reb._throttle()
+    from minio_tpu.object import rebalance as rmod
+    assert len(calls) == rmod.BACKOFF_TRIES     # polled, then proceeded
+    assert time.monotonic() - t0 < 5.0
+    # not busy: no sleep at all
+    calls.clear()
+    reb2 = Rebalancer(zz, 0, busy_fn=lambda: calls.append(1) or False)
+    reb2._throttle()
+    assert len(calls) == 1
+
+
+def test_cancel_returns_pool_to_active(pools):
+    zz = pools
+    for i in range(4):
+        zz.server_sets[0].put_object("b", f"c-{i}", b"z" * 100)
+    zz.start_decommission(0, busy_fn=lambda: True, throttle_s=0.2)
+    out = zz.cancel_rebalance()
+    assert out["status"] == "canceled"
+    assert zz.topology.state(0) == POOL_ACTIVE
+    assert zz.topology.write_pools() == [0, 1]
+
+
+def test_meta_bucket_objects_migrate_but_internals_stay(pools):
+    zz = pools
+    # a config-plane object (written through the object layer) on the
+    # draining pool must migrate; the topology doc itself must not
+    zz.server_sets[0].put_object(MINIO_META_BUCKET, "config/test.json",
+                                 b'{"k":"v"}')
+    zz.set_pool_state(0, POOL_DRAINING)
+    reb = Rebalancer(zz, 0, **NEVER_BUSY)
+    zz._rebalancer = reb
+    reb.start()
+    wait_status(zz, "complete")
+    _, it = zz.server_sets[1].get_object(MINIO_META_BUCKET,
+                                         "config/test.json")
+    assert b"".join(it) == b'{"k":"v"}'
+    with pytest.raises(api_errors.ObjectNotFound):
+        zz.server_sets[0].get_object_info(MINIO_META_BUCKET,
+                                          "config/test.json")
+    # the per-pool topology doc is still on pool 0 (deliberately)
+    zz.server_sets[0].get_object_info(MINIO_META_BUCKET,
+                                      "topology/pools.json")

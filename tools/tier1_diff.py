@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+"""tier1_diff — regression gate on the tier-1 FAILURE-NAME SET.
+
+The tier-1 suite carries ~39 environmental failures at the seed
+(missing optional modules, sandbox networking), so its raw exit code
+says nothing about a change: it is nonzero before AND after. What a
+change must not do is add NEW failure names. This tool:
+
+  1. runs the tier-1 pytest command from ROADMAP.md (or parses an
+     existing log via --log),
+  2. extracts the set of FAILED/ERROR test ids,
+  3. diffs it against the committed baseline list (the "Tier-1 failure
+     baseline" section of BASELINE.md),
+  4. exits nonzero ONLY when new failure names appeared.
+
+Fixed (no-longer-failing) names are reported but never fail the gate —
+shrink the baseline with --update once a fix is deliberate.
+
+Usage:
+    python tools/tier1_diff.py                 # run suite + diff
+    python tools/tier1_diff.py --log t1.log    # diff an existing log
+    python tools/tier1_diff.py --log t1.log --update
+                                               # rewrite the baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_MD = os.path.join(REPO, "BASELINE.md")
+SECTION = "## Tier-1 failure baseline"
+
+# the ROADMAP.md "Tier-1 verify" pytest invocation (sans shell plumbing)
+TIER1_CMD = [
+    sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+    "--continue-on-collection-errors", "-p", "no:cacheprovider",
+    "-p", "no:xdist", "-p", "no:randomly",
+]
+TIER1_TIMEOUT_S = 870
+
+_FAIL_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+?)(?:\s+-\s.*)?$")
+
+
+def parse_failures(text: str) -> set[str]:
+    out = set()
+    for line in text.splitlines():
+        m = _FAIL_RE.match(line.strip())
+        if m:
+            out.add(m.group(1).rstrip("-").strip())
+    return out
+
+
+def read_baseline() -> set[str]:
+    try:
+        with open(BASELINE_MD) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    if SECTION not in text:
+        return set()
+    body = text.split(SECTION, 1)[1]
+    # the section runs until the next heading (or EOF)
+    body = re.split(r"\n## ", body, 1)[0]
+    names = set()
+    for line in body.splitlines():
+        m = re.match(r"^- `([^`]+)`", line.strip())
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def write_baseline(names: set[str]) -> None:
+    with open(BASELINE_MD) as f:
+        text = f.read()
+    lines = [SECTION, "",
+             "Failure names (`FAILED`/`ERROR` test ids) present at the "
+             "current baseline; `tools/tier1_diff.py` gates on NEW "
+             "names only. Regenerate with `--update`.", ""]
+    lines += [f"- `{n}`" for n in sorted(names)]
+    block = "\n".join(lines) + "\n"
+    if SECTION in text:
+        head, tail = text.split(SECTION, 1)
+        rest = re.split(r"\n(## .*)", tail, 1)
+        trailer = "\n".join(rest[1:]) if len(rest) > 1 else ""
+        text = head + block + ("\n" + trailer if trailer else "")
+    else:
+        text = text.rstrip("\n") + "\n\n" + block
+    with open(BASELINE_MD, "w") as f:
+        f.write(text)
+
+
+def run_tier1() -> str:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(TIER1_CMD, cwd=REPO, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    try:
+        out, _ = proc.communicate(timeout=TIER1_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        out += "\ntier1_diff: suite TIMED OUT\n"
+    sys.stdout.write(out[-2000:])       # tail for context
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tier1_diff")
+    ap.add_argument("--log", help="parse this pytest log instead of "
+                    "running the suite")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the BASELINE.md failure list from "
+                    "this run")
+    args = ap.parse_args(argv)
+
+    if args.log:
+        with open(args.log) as f:
+            text = f.read()
+    else:
+        text = run_tier1()
+    current = parse_failures(text)
+    if args.update:
+        write_baseline(current)
+        print(f"baseline updated: {len(current)} failure name(s)")
+        return 0
+    baseline = read_baseline()
+    new = sorted(current - baseline)
+    fixed = sorted(baseline - current)
+    print(f"tier-1 failures: {len(current)} current, "
+          f"{len(baseline)} baseline")
+    if fixed:
+        print(f"\nno longer failing ({len(fixed)}) — consider "
+              "--update:")
+        for n in fixed:
+            print(f"  - {n}")
+    if new:
+        print(f"\nNEW failures ({len(new)}):")
+        for n in new:
+            print(f"  + {n}")
+        return 1
+    print("\nno new failure names — gate passes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
