@@ -394,6 +394,40 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
                 with cf.ThreadPoolExecutor(max_workers=streams) as ex:
                     list(ex.map(read_back, range(streams)))
                 get_wall = time.perf_counter() - t0
+
+                # multipart GET A/B (cross-part lookahead probe): one
+                # object of 4 uploaded parts; the pipelined mode should
+                # overlap part N's verify+decode with part N+1's first
+                # group read, which the serial mode cannot
+                mp_parts = 4
+                part_size = max(size // mp_parts, 5 << 20)  # S3 minimum
+                mp_payload = payload[:part_size] \
+                    if len(payload) >= part_size \
+                    else os.urandom(part_size)
+                uid = sets.new_multipart_upload("bench", "mp")
+                etags = []
+                for pn in range(1, mp_parts + 1):
+                    pi = sets.put_object_part(
+                        "bench", "mp", uid, pn, mp_payload, part_size)
+                    etags.append(pi.etag)
+                from minio_tpu.object.multipart import CompletePart
+                sets.complete_multipart_upload(
+                    "bench", "mp", uid,
+                    [CompletePart(i + 1, e)
+                     for i, e in enumerate(etags)])
+                mp_total = mp_parts * part_size
+
+                def read_mp() -> None:
+                    _, it = sets.get_object("bench", "mp")
+                    nread = sum(len(c) for c in it)
+                    assert nread == mp_total, nread
+
+                read_mp()                      # warm
+                t0 = time.perf_counter()
+                mp_rounds = 4
+                for _ in range(mp_rounds):
+                    read_mp()
+                mp_wall = time.perf_counter() - t0
                 stagetimer.disable()
                 total = streams * size
                 out[mode] = {
@@ -401,6 +435,10 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
                     "put_wall_s": round(put_wall, 2),
                     "get_gib_s": round(total / get_wall / 2**30, 3),
                     "get_wall_s": round(get_wall, 2),
+                    "mp_get_gib_s": round(
+                        mp_rounds * mp_total / mp_wall / 2**30, 3),
+                    "mp_config": {"parts": mp_parts,
+                                  "part_size": part_size},
                     "stage_percentiles_ms": stagetimer.percentiles(),
                     "overlap": stagetimer.overlap_report(),
                     # the perf trajectory carries stage-level
@@ -450,6 +488,9 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
             out["pipelined"]["put_gib_s"] / out["serial"]["put_gib_s"], 3)
         out["get_speedup_x"] = round(
             out["pipelined"]["get_gib_s"] / out["serial"]["get_gib_s"], 3)
+        out["mp_get_speedup_x"] = round(
+            out["pipelined"]["mp_get_gib_s"]
+            / out["serial"]["mp_get_gib_s"], 3)
     finally:
         pl.ENABLED = was_enabled
         codec_mod.DEVICE_MIN_BYTES = was_min_bytes
@@ -548,6 +589,93 @@ def bench_rebalance_ab(streams: int = 8, size: int = 4 << 20,
     return out
 
 
+def bench_tier_ab(streams: int = 8, size: int = 4 << 20,
+                  drives: int = 8, parity: int = 2,
+                  preload: int = 32) -> dict:
+    """Foreground-PUT latency with vs without an active tier-transition
+    drain (the tiering-throttle acceptance probe, the --ab-rebalance
+    shape): one pool on tmpfs preloaded with transition inventory, then
+    identical concurrent PUT rounds are timed per-op before and while
+    the TransitionWorker moves that inventory to an fs tier. Reports
+    p50/p99 per phase and `put_p99_degradation_x` — the shared
+    foreground-pressure throttle keeps it bounded because the worker
+    backs off whenever the foreground shows scheduler/staging
+    pressure."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.tier.config import TierConfig, TierManager
+    from minio_tpu.tier.transition import TransitionWorker
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_tier_", dir=base)
+    payload = os.urandom(size)
+    cold_payload = os.urandom(size // 2)
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "drives": drives, "m": parity,
+                            "preload": preload}}
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=1 << 20, enable_mrf=False)
+        sets.make_bucket("bench")
+        for i in range(preload):                # transition inventory
+            sets.put_object("bench", f"cold-{i}", cold_payload)
+        tiers = TierManager(sets)
+        tiers.add(TierConfig("bench-cold", "fs",
+                             {"path": f"{root}/tier"}))
+
+        def put_round(prefix: str) -> list[float]:
+            lat: list[float] = []
+            mu = threading.Lock()
+
+            def one(i: int) -> None:
+                t0 = time.perf_counter()
+                sets.put_object("bench", f"{prefix}{i}", payload)
+                dt = time.perf_counter() - t0
+                with mu:
+                    lat.append(dt)
+
+            with cf.ThreadPoolExecutor(max_workers=streams) as ex:
+                list(ex.map(one, range(streams)))
+            return lat
+
+        def pcts(lat: list[float]) -> dict:
+            xs = sorted(lat)
+            return {"p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                    "p99_ms": round(xs[max(0, int(len(xs) * 0.99) - 1)]
+                                    * 1e3, 2)}
+
+        put_round("warm")                        # warm the path
+        baseline = put_round("base") + put_round("base2")
+        out["baseline"] = pcts(baseline)
+
+        worker = TransitionWorker(sets, tiers).start()
+        for i in range(preload):
+            worker.enqueue("bench", f"cold-{i}", "", "bench-cold")
+        during = put_round("dr") + put_round("dr2")
+        out["during_drain"] = pcts(during)
+        out["drain_status_at_measure"] = worker.stats()
+        worker.drain(120)
+        out["drain_final"] = worker.stats()
+        out["put_p99_degradation_x"] = round(
+            out["during_drain"]["p99_ms"]
+            / max(out["baseline"]["p99_ms"], 1e-9), 3)
+        worker.close()
+        sets.close()
+    finally:
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ab-pipeline", action="store_true",
@@ -568,7 +696,20 @@ def main() -> int:
                     help="run ONLY the rebalance-throttle A/B "
                          "(foreground PUT p50/p99 with vs without an "
                          "active pool drain)")
+    ap.add_argument("--ab-tier", action="store_true",
+                    help="run ONLY the tier-transition-throttle A/B "
+                         "(foreground PUT p50/p99 with vs without the "
+                         "transition worker draining to a tier)")
     args = ap.parse_args()
+
+    if args.ab_tier:
+        print(json.dumps({
+            "metric": "foreground PUT p99 degradation with an active "
+                      "tier-transition drain (tiering throttle A/B)",
+            "tier_ab": bench_tier_ab(
+                streams=min(args.ab_streams, 8), size=args.ab_size),
+        }))
+        return 0
 
     if args.ab_rebalance:
         print(json.dumps({

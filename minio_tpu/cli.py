@@ -64,6 +64,26 @@ def _parse(argv: list[str]) -> argparse.Namespace:
     d.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
 
+    t = sub.add_parser("tier", help="manage remote tiers for ILM "
+                       "transitions (mc admin tier surface)")
+    t.add_argument("action", choices=("add", "ls", "rm", "stats"))
+    t.add_argument("--url", default="127.0.0.1:9000",
+                   help="server admin endpoint host:port")
+    t.add_argument("--name", default="",
+                   help="tier name (add/rm)")
+    t.add_argument("--type", default="fs", dest="tier_type",
+                   choices=("fs", "s3", "azure", "gcs", "hdfs"),
+                   help="tier backend type (add)")
+    t.add_argument("--param", action="append", default=[],
+                   help="backend param key=value (repeatable): fs needs "
+                   "path=...; s3 needs host=, bucket= (+port/access_key/"
+                   "secret_key/prefix/region)")
+    t.add_argument("--force", action="store_true",
+                   help="add: update an existing tier in place; "
+                   "rm: remove even when lifecycle rules reference it")
+    t.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
+
     g = sub.add_parser("gateway", help="serve the S3 API over a "
                        "foreign backend (cmd/gateway-main.go)")
     g.add_argument("kind", choices=("nas", "s3", "azure", "gcs",
@@ -216,6 +236,46 @@ def _run_decommission(args, creds: Credentials) -> int:
     return 0
 
 
+def _run_tier(args, creds: Credentials) -> int:
+    """`minio_tpu tier add|ls|rm|stats` — drive the admin tier registry
+    against a running node."""
+    import json as _json
+    from .madmin import AdminClient, AdminClientError
+    from .utils import host_port
+    h, p = host_port(args.url, 9000)
+    cli = AdminClient(h, p, creds.access_key, creds.secret_key,
+                      region=args.region)
+    try:
+        if args.action == "ls":
+            out = cli.list_tiers()
+        elif args.action == "stats":
+            out = cli.tier_stats()
+        elif args.action == "rm":
+            if not args.name:
+                print("tier rm needs --name", file=sys.stderr)
+                return 2
+            out = cli.remove_tier(args.name, force=args.force)
+        else:
+            if not args.name:
+                print("tier add needs --name", file=sys.stderr)
+                return 2
+            params = {}
+            for kv in args.param:
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    print(f"bad --param {kv!r}: need key=value",
+                          file=sys.stderr)
+                    return 2
+                params[k] = v
+            out = cli.add_tier(args.name, args.tier_type,
+                               update=args.force, **params)
+    except AdminClientError as e:
+        print(f"tier {args.action} failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
@@ -223,6 +283,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_gateway(args, creds)
     if args.cmd == "decommission":
         return _run_decommission(args, creds)
+    if args.cmd == "tier":
+        return _run_tier(args, creds)
     kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
               region=args.region,
               certfile=args.cert or None, keyfile=args.key or None)

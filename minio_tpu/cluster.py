@@ -347,6 +347,15 @@ class ClusterNode:
         self.config.apply(self.s3.api, events=self.events,
                           trace=self.s3.api.trace)
 
+        # -- tiering plane (remote tiers + ILM transitions) ----------------
+        from .tier.config import TierManager
+        self.tiers = TierManager(self.object_layer)
+        try:
+            self.tiers.load()
+        except Exception:  # noqa: BLE001 — boot proceeds; admin re-adds
+            pass
+        self.s3.api.tiers = self.tiers
+
         # -- background plane (initAutoHeal + initDataCrawler) -------------
         from .object.background import (DataUsageCrawler, DiskMonitor,
                                         HealScanner)
@@ -363,24 +372,43 @@ class ClusterNode:
             self.update_tracker.rotate_snapshot
         self.heal_scanner = None
         self.crawler = None
+        self.transition_worker = None
         if this == 0:
             self.heal_scanner = HealScanner(
                 self.object_layer, self.update_tracker,
                 peer_snapshots=self.notification.tracker_rotate_all
             ).start()
+            # one transition worker per cluster, riding the same
+            # crawler cadence lifecycle expiry does: Transition rules
+            # enqueue moves, the worker drains them throttled off
+            # foreground pressure
+            from .tier.transition import (TransitionWorker,
+                                          noncurrent_transition_action,
+                                          restore_reclaim_action,
+                                          transition_action)
+            self.transition_worker = TransitionWorker(
+                self.object_layer, self.tiers).start()
             # one crawler per cluster (first node), like the reference's
             # leader-ish crawler cadence; usage cache feeds quota and the
-            # crawler enforces lifecycle expiry
+            # crawler enforces lifecycle expiry + ILM transitions
             self.crawler = DataUsageCrawler(
                 self.object_layer,
                 actions=[crawler_action(self.s3.api.bucket_meta,
                                         self.object_layer,
-                                        self.events)],
+                                        self.events, tiers=self.tiers),
+                         transition_action(self.s3.api.bucket_meta,
+                                           self.transition_worker),
+                         restore_reclaim_action(self.object_layer,
+                                                self.tiers)],
                 bucket_actions=[
                     mpu_abort_action(self.s3.api.bucket_meta,
                                      self.object_layer),
                     noncurrent_sweep_action(self.s3.api.bucket_meta,
-                                            self.object_layer),
+                                            self.object_layer,
+                                            tiers=self.tiers),
+                    noncurrent_transition_action(
+                        self.s3.api.bucket_meta,
+                        self.transition_worker),
                 ]).start()
             self.s3.api.usage = self.crawler
 
@@ -409,6 +437,11 @@ class ClusterNode:
             paths, set_count, set_drive_count, parity,
             block_size=self._block_size, scheduler=self.scheduler)
         idx = self.object_layer.add_pool(sets)
+        # the running DiskMonitor must cover the new pool's drives too:
+        # a drive dying in a post-boot pool re-admits/heals exactly like
+        # a boot-time one (ROADMAP follow-up from the topology PR)
+        if getattr(self, "disk_monitor", None) is not None:
+            self.disk_monitor.add_pool(sets)
         for p in paths:
             if p not in self.local_drives:
                 try:
@@ -450,6 +483,9 @@ class ClusterNode:
         if getattr(self, "crawler", None) is not None:
             self.crawler.close()
             self.crawler = None
+        if getattr(self, "transition_worker", None) is not None:
+            self.transition_worker.close()
+            self.transition_worker = None
         if getattr(self, "heal_scanner", None) is not None:
             self.heal_scanner.close()
             self.heal_scanner = None

@@ -7,7 +7,15 @@ each crawled object is checked against the bucket's rules and expired
 
 Supported rule surface: Status, Filter/Prefix (+And/Tag ignored-match),
 Expiration{Days|Date}, NoncurrentVersionExpiration{NoncurrentDays},
-AbortIncompleteMultipartUpload{DaysAfterInitiation}.
+AbortIncompleteMultipartUpload{DaysAfterInitiation},
+Transition{Days|Date,StorageClass},
+NoncurrentVersionTransition{NoncurrentDays,StorageClass}.
+
+Transition rules name a remote TIER via StorageClass (the reference's
+ILM tiering, pkg/bucket/lifecycle/transition.go): enforcement rides
+the same crawler hooks (tier/transition.py), and expiry always wins
+over transition when both are due (uploading data the same pass
+deletes it would be pure waste — reference ComputeAction precedence).
 """
 
 from __future__ import annotations
@@ -46,15 +54,42 @@ class Rule:
     expiry_date: float = 0.0          # unix seconds; 0 = unset
     noncurrent_days: int = 0
     abort_mpu_days: int = 0
+    # ILM tiering: move data to the tier named by StorageClass
+    transition_days: int = 0
+    transition_date: float = 0.0      # unix seconds; 0 = unset
+    transition_tier: str = ""
+    noncurrent_transition_days: int = 0
+    noncurrent_transition_tier: str = ""
 
     @property
     def enabled(self) -> bool:
         return self.status == "Enabled"
 
 
+# parsed-config memo for the crawler hot loop: several actions
+# (expiry, transition, noncurrent sweeps) each re-parse the SAME
+# bucket XML once per crawled object otherwise. Keyed by the raw
+# document; bounded by wholesale reset (configs are tiny and few).
+_PARSE_CACHE: dict[str, "Lifecycle"] = {}
+
+
 class Lifecycle:
     def __init__(self, rules: list[Rule]):
         self.rules = rules
+
+    @classmethod
+    def cached(cls, raw: str | bytes) -> "Lifecycle":
+        """from_xml through the memo — the crawler-action entry point
+        (parse errors are never cached and re-raise every call)."""
+        key = raw.decode("utf-8", "replace") \
+            if isinstance(raw, (bytes, bytearray)) else raw
+        lc = _PARSE_CACHE.get(key)
+        if lc is None:
+            lc = cls.from_xml(raw)
+            if len(_PARSE_CACHE) >= 64:
+                _PARSE_CACHE.clear()
+            _PARSE_CACHE[key] = lc
+        return lc
 
     @classmethod
     def from_xml(cls, raw: str | bytes) -> "Lifecycle":
@@ -85,6 +120,22 @@ class Lifecycle:
                 nd = _text(nel, "NoncurrentDays")
                 if nd:
                     r.noncurrent_days = int(nd)
+            tel = _find(rel, "Transition")
+            if tel is not None:
+                days = _text(tel, "Days")
+                if days:
+                    r.transition_days = int(days)
+                date = _text(tel, "Date")
+                if date:
+                    r.transition_date = _dt.datetime.fromisoformat(
+                        date.replace("Z", "+00:00")).timestamp()
+                r.transition_tier = _text(tel, "StorageClass")
+            ntel = _find(rel, "NoncurrentVersionTransition")
+            if ntel is not None:
+                nd = _text(ntel, "NoncurrentDays")
+                if nd:
+                    r.noncurrent_transition_days = int(nd)
+                r.noncurrent_transition_tier = _text(ntel, "StorageClass")
             ael = _find(rel, "AbortIncompleteMultipartUpload")
             if ael is not None:
                 ad = _text(ael, "DaysAfterInitiation")
@@ -125,12 +176,49 @@ class Lifecycle:
                 and object_name.startswith(r.prefix)]
         return min(days) if days else 0
 
+    def transition_due(self, object_name: str, mod_time: float,
+                       now: Optional[float] = None) -> str:
+        """Tier name the current version should transition to NOW, or
+        "". Expiry wins over transition (reference ComputeAction:
+        uploading data the same pass deletes is pure waste), and a rule
+        needs a StorageClass (tier name) to be actionable."""
+        now = now if now is not None else time.time()
+        if self.is_expired(object_name, mod_time, now):
+            return ""
+        for r in self.rules:
+            if not r.enabled or not r.transition_tier \
+                    or not object_name.startswith(r.prefix):
+                continue
+            if r.transition_date and now >= r.transition_date:
+                return r.transition_tier
+            if r.transition_days and \
+                    now >= mod_time + r.transition_days * 86400:
+                return r.transition_tier
+        return ""
+
+    def noncurrent_transition(self, object_name: str) -> tuple[int, str]:
+        """(strictest NoncurrentDays, tier) of the
+        NoncurrentVersionTransition rules applying to this key, or
+        (0, "")."""
+        best: tuple[int, str] = (0, "")
+        for r in self.rules:
+            if not r.enabled or not r.noncurrent_transition_days \
+                    or not r.noncurrent_transition_tier \
+                    or not object_name.startswith(r.prefix):
+                continue
+            if not best[0] or r.noncurrent_transition_days < best[0]:
+                best = (r.noncurrent_transition_days,
+                        r.noncurrent_transition_tier)
+        return best
+
 
 def crawler_action(bucket_meta_sys, object_layer, notifier=None,
-                   now_fn=time.time):
+                   now_fn=time.time, tiers=None):
     """DataUsageCrawler per-object action enforcing lifecycle expiry
     (cmd/data-crawler.go:629-713): current-version Expiration (delete or
-    delete-marker when versioned) and NoncurrentVersionExpiration."""
+    delete-marker when versioned) and NoncurrentVersionExpiration.
+    With a tier manager, expiring a transitioned version also frees its
+    remote copy (best-effort — a tier outage must not block expiry)."""
 
     def act(bucket: str, oi) -> None:
         from ..object import api_errors
@@ -138,16 +226,22 @@ def crawler_action(bucket_meta_sys, object_layer, notifier=None,
         if not bm.lifecycle_xml:
             return
         try:
-            lc = Lifecycle.from_xml(bm.lifecycle_xml)
+            lc = Lifecycle.cached(bm.lifecycle_xml)
         except ET.ParseError:
             return
         now = now_fn()
         if lc.is_expired(oi.name, oi.mod_time, now):
+            versioned = bm.versioning_enabled()
             try:
                 object_layer.delete_object(
-                    bucket, oi.name, versioned=bm.versioning_enabled())
+                    bucket, oi.name, versioned=versioned)
             except api_errors.ObjectApiError:
                 return
+            if tiers is not None and not versioned:
+                # the data version is gone (an unversioned expiry, not
+                # a delete marker): free the remote tier copy too
+                from ..tier.transition import free_remote
+                free_remote(tiers, oi.user_defined or {})
             if notifier is not None:
                 try:
                     notifier.send("s3:ObjectRemoved:Lifecycle", bucket,
@@ -159,7 +253,7 @@ def crawler_action(bucket_meta_sys, object_layer, notifier=None,
 
 
 def noncurrent_sweep_action(bucket_meta_sys, object_layer,
-                            now_fn=time.time):
+                            now_fn=time.time, tiers=None):
     """Per-bucket crawler action enforcing NoncurrentVersionExpiration
     over a paginated bucket-wide version walk.
 
@@ -169,6 +263,11 @@ def noncurrent_sweep_action(bucket_meta_sys, object_layer,
     noncurrent (its successor's mod time, S3 semantics), and the null
     version (empty version id, written before versioning) expires like
     any other noncurrent version.
+
+    With a tier manager, expiring a transitioned noncurrent version
+    also frees its remote copy — this sweep is the main deletion path
+    for tiered data in versioned buckets (current-version expiry only
+    writes markers), so skipping it would leak the tier forever.
     """
 
     def act(bucket: str) -> None:
@@ -177,7 +276,7 @@ def noncurrent_sweep_action(bucket_meta_sys, object_layer,
         if not bm.lifecycle_xml:
             return
         try:
-            lc = Lifecycle.from_xml(bm.lifecycle_xml)
+            lc = Lifecycle.cached(bm.lifecycle_xml)
         except ET.ParseError:
             return
         if not any(r.enabled and r.noncurrent_days for r in lc.rules):
@@ -208,7 +307,11 @@ def noncurrent_sweep_action(bucket_meta_sys, object_layer,
                                 bucket, name,
                                 version_id=vs[i].version_id)
                         except api_errors.ObjectApiError:
-                            pass
+                            continue
+                        if tiers is not None:
+                            from ..tier.transition import free_remote
+                            free_remote(tiers,
+                                        vs[i].user_defined or {})
             if len(versions) < 1000:
                 return
             marker = versions[-1].name
@@ -227,7 +330,7 @@ def mpu_abort_action(bucket_meta_sys, object_layer, now_fn=time.time):
         if not bm.lifecycle_xml:
             return
         try:
-            lc = Lifecycle.from_xml(bm.lifecycle_xml)
+            lc = Lifecycle.cached(bm.lifecycle_xml)
         except ET.ParseError:
             return
         if not any(r.enabled and r.abort_mpu_days for r in lc.rules):

@@ -127,6 +127,34 @@ class AdminClient:
         zones nested for server-sets backends)."""
         return self._json("GET", "mrf")
 
+    # -- tiering -----------------------------------------------------------
+
+    def add_tier(self, name: str, type_: str, update: bool = False,
+                 **params) -> dict:
+        """Register a remote tier (type_: fs|s3|azure|gcs|hdfs; params
+        are backend-specific — fs: path; s3: host/port/bucket/prefix/
+        access_key/secret_key/region)."""
+        query = {"force": "true"} if update else None
+        return self._json("PUT", "tier", query,
+                          json.dumps({"name": name, "type": type_,
+                                      "params": params}).encode())
+
+    def list_tiers(self) -> list[dict]:
+        """Registered tiers (secrets redacted)."""
+        return self._json("GET", "tier")["tiers"]
+
+    def remove_tier(self, name: str, force: bool = False) -> dict:
+        """Remove a tier; `force` overrides the in-use refusal (a tier
+        still named by lifecycle Transition rules answers 409)."""
+        query = {"name": name}
+        if force:
+            query["force"] = "true"
+        return self._json("DELETE", "tier", query)
+
+    def tier_stats(self) -> dict:
+        """Transition-worker queue/throughput counters."""
+        return self._json("GET", "tier/stats")
+
     # -- IAM ---------------------------------------------------------------
 
     def add_user(self, access_key: str, secret_key: str) -> None:

@@ -127,6 +127,17 @@ class MethodNotAllowed(ObjectApiError):
     """e.g. GET on a delete marker."""
 
 
+class InvalidObjectState(ObjectApiError):
+    """GET on a transitioned (tiered) object with no restored local
+    copy — the client must POST ?restore first (S3 InvalidObjectState,
+    the GLACIER-retrieval semantics applied to remote tiers)."""
+
+
+class TierNotFound(ObjectApiError):
+    """A lifecycle rule or restore referenced a tier name that is not
+    in the cluster's tier configuration."""
+
+
 class SignatureDoesNotMatch(ObjectApiError):
     pass
 

@@ -286,36 +286,58 @@ class _ScanLoop:
 
 
 class DiskMonitor(_ScanLoop):
-    """Re-admit returning drives; format + sweep-heal fresh ones."""
+    """Re-admit returning drives; format + sweep-heal fresh ones.
+
+    Covers every POOL of the cluster, including pools appended after
+    boot: ``add_pool`` registers a new pool's drives with the running
+    monitor (topology online-expansion follow-up), so a drive that dies
+    in a post-boot pool heals exactly like a boot-time one."""
 
     def __init__(self, sets: "ErasureSets", interval: float = 10.0):
-        self.sets = sets
+        self.pools: list["ErasureSets"] = [sets]
         self.interval = interval
         self.healed_slots: list[tuple[int, int]] = []   # for tests/admin
         self._init_loop()
 
+    @property
+    def sets(self) -> "ErasureSets":
+        """First (boot-time) pool — the pre-multi-pool API surface."""
+        return self.pools[0]
+
+    def add_pool(self, sets: "ErasureSets") -> None:
+        """Register a pool appended after boot (ClusterNode.add_pool)
+        so its drive slots are probed from the next scan on."""
+        if sets not in self.pools:
+            self.pools.append(sets)
+
     # -- one scan ----------------------------------------------------------
 
     def scan_once(self) -> int:
-        """Probe every slot; returns how many drives were (re)admitted."""
-        if self.sets.format_ref is None or self.sets.slot_sources is None:
-            return 0
+        """Probe every slot of every pool; returns drives (re)admitted."""
         admitted = 0
-        for i, eng in enumerate(self.sets.sets):
-            for j in range(len(eng.disks)):
-                if self._probe_slot(i, j):
-                    admitted += 1
-        if admitted and self.sets.mrf is not None:
-            # a returning drive makes queued MRF heals winnable NOW:
-            # collapse their retry backoffs instead of waiting them out
-            self.sets.mrf.kick()
+        for pool in list(self.pools):
+            admitted += self._scan_pool(pool)
         return admitted
 
-    def _probe_slot(self, i: int, j: int) -> bool:
+    def _scan_pool(self, pool: "ErasureSets") -> int:
+        if pool.format_ref is None or pool.slot_sources is None:
+            return 0
+        admitted = 0
+        for i, eng in enumerate(pool.sets):
+            for j in range(len(eng.disks)):
+                if self._probe_slot(pool, i, j):
+                    admitted += 1
+        if admitted and pool.mrf is not None:
+            # a returning drive makes queued MRF heals winnable NOW:
+            # collapse their retry backoffs instead of waiting them out
+            pool.mrf.kick()
+        return admitted
+
+    def _probe_slot(self, pool: "ErasureSets", i: int, j: int) -> bool:
         from ..storage.diskid_check import DiskIDCheck
-        eng = self.sets.sets[i]
+        eng = pool.sets[i]
         cur = eng.disks[j]
-        want_uuid = self.sets.format_ref.sets[i][j]
+        want_uuid = pool.format_ref.sets[i][j]
 
         def unwrap(d):
             return getattr(d, "inner", d)
@@ -333,13 +355,13 @@ class DiskMonitor(_ScanLoop):
         if cur is not None:
             fmt = fmt_of(unwrap(cur))
             if fmt not in (None, "err") and fmt.this == want_uuid \
-                    and fmt.id == self.sets.deployment_id:
+                    and fmt.id == pool.deployment_id:
                 return False         # healthy and in place
             if fmt == "err" and not isinstance(unwrap(cur), XLStorage):
                 return False         # remote hiccup: transport re-probes
 
         # slot is dead, wiped, or replaced: (re)open from its source
-        src = self.sets.slot_sources[i][j]
+        src = pool.slot_sources[i][j]
         if isinstance(src, str):
             try:
                 drive = XLStorage(src)
@@ -355,7 +377,7 @@ class DiskMonitor(_ScanLoop):
             return False             # unreachable/IO error: try later
 
         if fmt is not None:
-            if fmt.this != want_uuid or fmt.id != self.sets.deployment_id:
+            if fmt.this != want_uuid or fmt.id != pool.deployment_id:
                 return False         # foreign drive: never adopt
             if cur is not None and unwrap(cur) is drive:
                 return False
@@ -364,7 +386,7 @@ class DiskMonitor(_ScanLoop):
 
         # fresh/wiped drive: format it for this slot, admit, sweep-heal
         # (reference HealFormat + healErasureSet)
-        nf = dataclasses.replace(self.sets.format_ref, this=want_uuid)
+        nf = dataclasses.replace(pool.format_ref, this=want_uuid)
         try:
             write_format_to(drive, nf)
         except serr.StorageError:
@@ -372,15 +394,16 @@ class DiskMonitor(_ScanLoop):
         eng.disks[j] = DiskIDCheck(drive, want_uuid)
         self.healed_slots.append((i, j))
         try:
-            self.heal_set_sweep(i)
+            self.heal_set_sweep(i, pool)
         except Exception:  # noqa: BLE001 — MRF/next sweep will retry
             pass
         return True
 
-    def heal_set_sweep(self, set_index: int) -> int:
+    def heal_set_sweep(self, set_index: int,
+                       pool: Optional["ErasureSets"] = None) -> int:
         """Heal every bucket + object of one set (healErasureSet,
         cmd/global-heal.go). Returns objects healed."""
-        eng = self.sets.sets[set_index]
+        eng = (pool or self.sets).sets[set_index]
         healed = 0
         for vol in eng.list_buckets():
             try:

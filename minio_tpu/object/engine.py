@@ -33,8 +33,14 @@ from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
 from ..utils import stagetimer, telemetry
 from ..storage.api import StorageAPI
-from ..storage.datatypes import (BLOCK_SIZE_V1, ChecksumInfo, FileInfo,
-                                 ObjectInfo, new_file_info, now)
+from ..storage.datatypes import (BLOCK_SIZE_V1, RESTORE_EXPIRY_KEY,
+                                 RESTORE_KEY, TRANSITION_COMPLETE,
+                                 TRANSITION_STATUS_KEY,
+                                 TRANSITION_TIER_KEY,
+                                 TRANSITIONED_OBJECT_KEY,
+                                 TRANSITIONED_VERSION_KEY, ChecksumInfo,
+                                 FileInfo, ObjectInfo, is_restored,
+                                 is_transitioned, new_file_info, now)
 from ..storage.xl_storage import (MINIO_META_BUCKET,
                                   MINIO_META_MULTIPART_BUCKET,
                                   MINIO_META_TMP_BUCKET)
@@ -745,6 +751,127 @@ class ErasureObjects:
             self._notify_degraded(bucket, object_name, version_id)
         return fi.to_object_info(bucket, object_name)
 
+    def transition_object(self, bucket: str, object_name: str,
+                          version_id: str = "", tier: str = "",
+                          remote_object: str = "",
+                          remote_version: str = "",
+                          expect_etag: str = "",
+                          expect_mod_time: Optional[float] = None
+                          ) -> ObjectInfo:
+        """Rewrite one version's xl.meta into a zero-data stub carrying
+        the tier name + remote key, then free the local shards — the
+        reference's TransitionObject commit (cmd/erasure-object.go):
+        the caller has ALREADY verified the remote copy; local data is
+        deleted only after the stub landed at write quorum, so a crash
+        anywhere earlier leaves the object fully readable locally.
+
+        Also the restore-expiry reclaim path: re-stubbing a restored
+        copy passes the SAME tier/remote key back in (no re-upload) and
+        this rewrite drops the x-amz-restore state.
+
+        expect_etag/expect_mod_time pin the version's IDENTITY inside
+        the write lock: for unversioned objects nothing else ties the
+        uploaded remote bytes to the version being stubbed — a client
+        overwrite racing the worker's remote upload must abort the
+        commit (PreConditionFailed), not stub the NEW data over the OLD
+        remote copy."""
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            fi, metas, online = self._object_file_info(
+                bucket, object_name, version_id)
+            if fi.deleted:
+                raise api_errors.MethodNotAllowed(
+                    f"{bucket}/{object_name} is a delete marker")
+            if (expect_etag
+                    and fi.metadata.get("etag", "") != expect_etag) or \
+                    (expect_mod_time is not None
+                     and fi.mod_time != expect_mod_time):
+                raise api_errors.PreConditionFailed(
+                    f"{bucket}/{object_name} changed since the remote "
+                    "copy was written")
+            data_dir = fi.data_dir
+            new_meta = dict(fi.metadata)
+            new_meta[TRANSITION_STATUS_KEY] = TRANSITION_COMPLETE
+            new_meta[TRANSITION_TIER_KEY] = tier
+            new_meta[TRANSITIONED_OBJECT_KEY] = remote_object
+            if remote_version:
+                new_meta[TRANSITIONED_VERSION_KEY] = remote_version
+            else:
+                new_meta.pop(TRANSITIONED_VERSION_KEY, None)
+            new_meta.pop(RESTORE_KEY, None)
+            new_meta.pop(RESTORE_EXPIRY_KEY, None)
+
+            def upd(i, d):
+                m = metas[i]
+                if m is None:
+                    raise serr.FileNotFound(object_name)
+                m.metadata = dict(new_meta)
+                m.data_dir = ""        # zero-data stub
+                d.write_metadata(bucket, object_name, m)
+
+            _, errs = meta.for_each_disk(online, upd)
+            _, write_quorum = meta.object_quorum_from_meta(
+                metas, [None] * len(metas), self.parity_shards)
+            err = meta.reduce_write_quorum_errs(
+                errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
+            if err is not None:
+                raise api_errors.to_object_err(err, bucket, object_name)
+            # the stub is durable at quorum: NOW the local shards go
+            # (every drive, not just online — stale copies must not
+            # resurrect the data dir)
+            if data_dir:
+                def rm(i, d):
+                    try:
+                        d.delete_file(bucket,
+                                      f"{object_name}/{data_dir}",
+                                      recursive=True)
+                    except serr.FileNotFound:
+                        pass
+
+                meta.for_each_disk(self.disks, rm)
+            fi.metadata = new_meta
+            fi.data_dir = ""
+        if any(e is not None for e in errs):
+            self._notify_degraded(bucket, object_name, fi.version_id)
+        return fi.to_object_info(bucket, object_name)
+
+    def put_stub_version(self, bucket: str, object_name: str,
+                         info: ObjectInfo) -> ObjectInfo:
+        """Write a transitioned ZERO-DATA stub version from its
+        API-facing ObjectInfo — the rebalance copy path for tiered
+        objects (there are no local shards to move; only the xl.meta
+        pointer travels). Identity (version id, mod time, etag, parts,
+        metadata incl. the tier/remote-key pointers) is preserved; the
+        erasure geometry is re-minted for THIS set, since the stored
+        geometry gates read quorum and the source pool's k may not even
+        fit this pool's drive count."""
+        md = dict(info.user_defined or {})
+        if not (md.get(TRANSITION_STATUS_KEY) == TRANSITION_COMPLETE):
+            raise api_errors.InvalidObjectState(
+                f"{bucket}/{object_name} is not a transitioned stub")
+        k, m, _, write_quorum = self._default_quorums()
+        fi = new_file_info(f"{bucket}/{object_name}", k, m)
+        fi.erasure.block_size = self.block_size
+        fi.volume, fi.name = bucket, object_name
+        fi.data_dir = ""
+        fi.version_id = info.version_id or ""
+        fi.size = info.size
+        fi.mod_time = info.mod_time
+        md["etag"] = info.etag
+        if info.content_type:
+            md["content-type"] = info.content_type
+        if info.content_encoding:
+            md["content-encoding"] = info.content_encoding
+        fi.metadata = md
+        for p in (info.parts or []):
+            fi.add_object_part(p.number, p.etag, p.size, p.actual_size)
+        if not fi.parts:
+            fi.add_object_part(1, info.etag, info.size, info.size)
+        with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            metas = [fi.light_copy() for _ in range(len(self.disks))]
+            meta.write_unique_file_info(self.disks, bucket, object_name,
+                                        metas, write_quorum)
+        return fi.to_object_info(bucket, object_name)
+
     def get_object_info(self, bucket: str, object_name: str,
                         opts: Optional[GetOptions] = None) -> ObjectInfo:
         opts = opts or GetOptions()
@@ -778,6 +905,14 @@ class ErasureObjects:
                     raise api_errors.MethodNotAllowed(
                         f"{bucket}/{object_name} is a delete marker")
                 raise api_errors.ObjectNotFound(bucket, object_name)
+            if is_transitioned(fi.metadata) \
+                    and not is_restored(fi.metadata):
+                # the data lives in a remote tier and no restored local
+                # copy exists: S3 InvalidObjectState until RestoreObject
+                raise api_errors.InvalidObjectState(
+                    f"{bucket}/{object_name} is archived in tier "
+                    f"{fi.metadata.get(TRANSITION_TIER_KEY, '?')!r}; "
+                    "restore it first")
             oi = fi.to_object_info(bucket, object_name)
             if length < 0:
                 length = fi.size - offset
@@ -800,6 +935,15 @@ class ErasureObjects:
             except Exception:  # noqa: BLE001 — heal queueing is best-effort
                 pass
 
+        # idempotent release: the generator's finally AND the wrapper's
+        # close() both funnel here — whichever runs first wins
+        released = [False]
+
+        def release() -> None:
+            if not released[0]:
+                released[0] = True
+                lock.unlock()
+
         def gen() -> Iterator[bytes]:
             try:
                 if fi.size == 0 or length == 0:
@@ -814,16 +958,21 @@ class ErasureObjects:
                         length, suppress_heal_flag=flagged),
                     bucket=bucket, object=object_name, length=length)
             finally:
-                lock.unlock()
+                release()
 
-        return oi, gen()
+        return oi, _UnlockOnClose(gen(), release)
 
     def _read_object_stream(self, bucket, object_name, fi: FileInfo,
                             metas, online, offset: int, length: int,
                             suppress_heal_flag: bool = False
                             ) -> Iterator[bytes]:
         """Per-part block loop (getObjectWithFileInfo,
-        cmd/erasure-object.go:217-323)."""
+        cmd/erasure-object.go:217-323), with CROSS-PART lookahead: the
+        one-group prefetcher no longer stops at a part boundary — while
+        part N's last group runs fused verify+decode, part N+1's FIRST
+        group is already reading on the prefetch pool (its readers are
+        independent streams, so no io_lock is shared across parts)."""
+        from ..parallel import pipeline as pl
         shuffled_disks = meta.shuffle_disks(online, fi.erasure.distribution)
         shuffled_meta = meta.shuffle_parts_metadata(metas,
                                                     fi.erasure.distribution)
@@ -832,206 +981,40 @@ class ErasureObjects:
 
         part_idx, part_off = fi.object_to_part_offset(offset)
         remaining = length
+        plans: list[_PartReadPlan] = []
         for pi in range(part_idx, len(fi.parts)):
             if remaining <= 0:
                 break
             part = fi.parts[pi]
             part_read_off = part_off if pi == part_idx else 0
             part_read_len = min(remaining, part.size - part_read_off)
-            yield from self._read_part(
-                bucket, object_name, fi, shuffled_disks, shuffled_meta,
-                codec, part, part_read_off, part_read_len,
-                suppress_heal_flag)
+            if part_read_len > 0:
+                plans.append(_PartReadPlan(
+                    self, bucket, object_name, fi, shuffled_disks,
+                    shuffled_meta, codec, part, part_read_off,
+                    part_read_len, suppress_heal_flag))
             remaining -= part_read_len
+        try:
+            for i, plan in enumerate(plans):
+                nxt = plans[i + 1] if pl.ENABLED \
+                    and i + 1 < len(plans) else None
+                yield from plan.stream(next_plan=nxt)
+        finally:
+            for plan in plans:
+                plan.close()
 
     def _read_part(self, bucket, object_name, fi: FileInfo, disks, smeta,
                    codec: Codec, part, offset: int, length: int,
                    suppress_heal_flag: bool = False) -> Iterator[bytes]:
-        n = len(disks)
-        k = fi.erasure.data_blocks
-        shard_size = fi.erasure.shard_size()
-        till = fi.erasure.shard_file_offset(offset, length, part.size)
-        path = f"{object_name}/{fi.data_dir}/part.{part.number}"
-
-        def make_readers() -> list:
-            out: list[Optional[object]] = [None] * n
-            for i, d in enumerate(disks):
-                if d is None or smeta[i] is None:
-                    continue
-                csum = smeta[i].erasure.get_checksum_info(part.number)
-                algo = (bitrot_mod.BitrotAlgorithm.from_string(
-                    csum.algorithm) if csum else self.bitrot_algo)
-                out[i] = bitrot_io.new_bitrot_reader(
-                    d, bucket, path, till, algo,
-                    csum.hash if csum else b"", shard_size)
-            return out
-
-        readers = make_readers()
-
-        start_block = offset // fi.erasure.block_size
-        end_block = (offset + length - 1) // fi.erasure.block_size
-        heal_required = False
-
-        # device-routed groups defer per-frame bitrot verification into
-        # the fused verify+decode program (one dispatch hashes AND
-        # reconstructs — cmd/erasure-decode.go:111-150's inseparable
-        # verify-then-decode, device form); small/CPU groups verify
-        # inline at read time as before. The digest comparison must use
-        # the algorithm the frames were WRITTEN with (per-shard
-        # csum.algorithm — it may differ from the server's current
-        # bitrot config), so deferral needs every reader on one
-        # streaming device-kernel algorithm.
-        algos = {r.algo for r in readers if r is not None}
-        part_algo = algos.pop() if len(algos) == 1 else None
-        defer_verify = (
-            part_algo is not None and part_algo.streaming
-            and codec._device_hash_kernel(part_algo) is not None
-            and codec._route(GET_BATCH_BLOCKS * k * shard_size)
-            == "device")
-
-        # blocks are read in groups so a degraded part reconstructs many
-        # blocks per device call instead of one matmul per block; the
-        # group walk is precomputed so the one-group-lookahead
-        # prefetcher can issue group N+1's reads while group N runs
-        # fused verify+decode and is joined/yielded
-        from ..parallel import pipeline as pl
-        specs: list[tuple[list, list]] = []
-        bn = start_block
-        while bn <= end_block:
-            group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
-            blocks = list(range(bn, group_end + 1))
-            geoms = []
-            for b in blocks:
-                block_off = b * fi.erasure.block_size
-                block_len = min(fi.erasure.block_size,
-                                part.size - block_off)
-                geoms.append((b, block_off, block_len,
-                              -(-block_len // k)))
-            specs.append((blocks, geoms))
-            bn = group_end + 1
-
-        # every reader I/O (group reads, hedged re-reads, the
-        # corrupt-block re-reads inside verify) serializes on io_lock:
-        # the bitrot readers are stateful streams shared with the
-        # lookahead thread. reader_gen counts in-place rebuilds of the
-        # readers list so a verify verdict formed against the OLD
-        # readers can't condemn a fresh one by index.
-        io_lock = threading.Lock()
-        reader_gen = [0]
-
-        def read_group(blocks: list, geoms: list) -> tuple[list, bool,
-                                                           float]:
-            """One group's raw shard reads, with the quorum-loss →
-            per-block-hedged-read degradation unchanged; returns
-            (per-block reads, degraded, read seconds)."""
-            t0 = time.perf_counter()
-            degraded = False
-            with io_lock, telemetry.span("pipeline.read_group",
-                                         blocks=len(blocks)):
-                try:
-                    reads = self._read_group_shards_raw(
-                        readers, blocks, shard_size,
-                        [g[3] for g in geoms], k, n,
-                        collect_digests=defer_verify)
-                except api_errors.InsufficientReadQuorum:
-                    # group-granular hedging can lose quorum where
-                    # block-granular recovery still succeeds (distinct
-                    # readers corrupted at distinct blocks): rebuild
-                    # the readers the group attempt burned and degrade
-                    # to per-block hedged reads
-                    for r in readers:
-                        if r is not None:
-                            r.close()
-                    readers[:] = make_readers()
-                    reader_gen[0] += 1
-                    degraded = True
-                    reads = [self._read_block_shards_raw(
-                        readers, g[0], shard_size, g[3], k, n,
-                        collect_digests=defer_verify) for g in geoms]
-            return reads, degraded, time.perf_counter() - t0
-
-        lookahead = None
+        """Single-part convenience (kept for callers outside the main
+        GET loop): one plan, no cross-part prefetch."""
+        plan = _PartReadPlan(self, bucket, object_name, fi, disks, smeta,
+                             codec, part, offset, length,
+                             suppress_heal_flag)
         try:
-            for si, (blocks, geoms) in enumerate(specs):
-                group = []
-                with stagetimer.stage("get.read_shards"):
-                    if lookahead is not None and lookahead.cancel():
-                        # still queued behind other streams' prefetch
-                        # tasks: reading inline is strictly faster than
-                        # waiting for a task that hasn't started
-                        lookahead = None
-                    if lookahead is not None:
-                        t0 = time.perf_counter()
-                        reads, degraded, read_s = lookahead.result()
-                        lookahead = None
-                        pl.STATS.record_get_group(
-                            True, time.perf_counter() - t0, read_s)
-                    else:
-                        reads, degraded, _ = read_group(blocks, geoms)
-                        pl.STATS.record_get_group(False)
-                # readers-list generation THIS group's frames came from
-                # (the N+1 lookahead may rebuild the list mid-verify)
-                gen_at_read = reader_gen[0]
-                heal_required = heal_required or degraded
-                # issue the NEXT group's reads on the drive pool before
-                # this group's verify+decode — decode overlaps drive
-                # I/O, bounded to ONE group of lookahead staging
-                if pl.ENABLED and si + 1 < len(specs):
-                    cctx = telemetry.propagating_context()
-                    if cctx is not None:
-                        # lookahead reads attach to this request's tree
-                        # even though they run on the prefetch pool
-                        lookahead = pl.PREFETCH_POOL.submit(
-                            cctx.run, read_group, *specs[si + 1])
-                    else:
-                        lookahead = pl.PREFETCH_POOL.submit(
-                            read_group, *specs[si + 1])
-                for (b, block_off, block_len, shard_len), \
-                        (shards, digests, had_errors) in zip(geoms,
-                                                             reads):
-                    heal_required = heal_required or had_errors
-                    group.append([b, block_off, block_len, shard_len,
-                                  shards, digests])
-                with stagetimer.stage("get.verify+decode"), \
-                        telemetry.span("pipeline.verify_decode",
-                                       blocks=len(blocks)):
-                    if self._verify_and_reconstruct_group(
-                            codec, group, k, n, readers, shard_size,
-                            part_algo or self.bitrot_algo,
-                            io_lock=io_lock,
-                            reader_gen=(reader_gen, gen_at_read)):
-                        heal_required = True
-                with stagetimer.stage("get.join"):
-                    out = []
-                    for b, block_off, block_len, shard_len, shards, _dg \
-                            in group:
-                        data = np.concatenate([s[:shard_len]
-                                               for s in shards[:k]])
-                        begin = max(offset - block_off, 0)
-                        end = min(offset + length - block_off, block_len)
-                        # slice the view FIRST: tobytes on the full block
-                        # then slicing again was two payload copies
-                        out.append(data[begin:end].tobytes())
-                yield from out
-            if heal_required and not suppress_heal_flag \
-                    and self.on_degraded_read is not None:
-                try:
-                    self.on_degraded_read(bucket, object_name)
-                except Exception:  # noqa: BLE001 — heal is best-effort
-                    pass
+            yield from plan.stream()
         finally:
-            if lookahead is not None and not lookahead.cancel():
-                # the running lookahead owns reader state: let it
-                # finish before the readers close (an abandoned
-                # generator must not leave a thread racing closed
-                # streams); a still-queued one is simply cancelled
-                try:
-                    lookahead.result()
-                except BaseException:  # noqa: BLE001 — abandoned read
-                    pass
-            for r in readers:
-                if r is not None:
-                    r.close()
+            plan.close()
 
     def _read_block_shards(self, readers, codec: Codec, block_num: int,
                            shard_size: int, shard_len: int, k: int, n: int
@@ -1507,6 +1490,276 @@ class ErasureObjects:
     def _read_one(self, bucket: str, object_name: str) -> FileInfo:
         fi, _, _ = self._object_file_info(bucket, object_name)
         return fi
+
+
+class _UnlockOnClose:
+    """GET stream wrapper whose close() releases the namespace read
+    lock even when the stream was NEVER started — closing (or dropping)
+    an unstarted generator skips its ``finally``, so a consumer that
+    errors before reading the first chunk (a failed tier upload, an
+    aborted proxy) would otherwise leak the read lock and wedge every
+    later write-locked op on the object."""
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        try:
+            self._gen.close()
+        finally:
+            self._release()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+
+class _PartReadPlan:
+    """One part's GET read state: stateful bitrot readers, the
+    precomputed group walk, and the one-group lookahead — factored out
+    of the per-part loop so the prefetcher can cross PART boundaries
+    (engine._read_object_stream primes part N+1's first group while
+    part N's last group verifies/decodes).
+
+    Every reader I/O (group reads, hedged re-reads, the corrupt-block
+    re-reads inside verify) serializes on the per-part ``io_lock``: the
+    bitrot readers are stateful streams shared with the lookahead
+    thread. ``reader_gen`` counts in-place rebuilds of the readers list
+    so a verify verdict formed against the OLD readers can't condemn a
+    fresh one by index. Parts never share readers, so cross-part
+    prefetch needs no cross-part locking."""
+
+    def __init__(self, eng: "ErasureObjects", bucket: str,
+                 object_name: str, fi: FileInfo, disks, smeta,
+                 codec: Codec, part, offset: int, length: int,
+                 suppress_heal_flag: bool = False):
+        self.eng = eng
+        self.bucket, self.object_name = bucket, object_name
+        self.fi, self.disks, self.smeta = fi, disks, smeta
+        self.codec, self.part = codec, part
+        self.offset, self.length = offset, length
+        self.suppress_heal_flag = suppress_heal_flag
+        self.n = len(disks)
+        self.k = fi.erasure.data_blocks
+        self.shard_size = fi.erasure.shard_size()
+        self.till = fi.erasure.shard_file_offset(offset, length,
+                                                 part.size)
+        self.path = f"{object_name}/{fi.data_dir}/part.{part.number}"
+        self.readers: Optional[list] = None
+        self.part_algo = None
+        self.defer_verify = False
+        self.io_lock = threading.Lock()
+        self.reader_gen = [0]
+        self.heal_required = False
+        self._pending = None           # live lookahead future
+        self._primed = False           # _pending holds OUR group 0
+
+        # blocks are read in groups so a degraded part reconstructs many
+        # blocks per device call instead of one matmul per block; the
+        # group walk is precomputed so the one-group-lookahead
+        # prefetcher can issue group N+1's reads while group N runs
+        # fused verify+decode and is joined/yielded
+        self.specs: list[tuple[list, list]] = []
+        bn = offset // fi.erasure.block_size
+        end_block = (offset + length - 1) // fi.erasure.block_size
+        while bn <= end_block:
+            group_end = min(bn + GET_BATCH_BLOCKS - 1, end_block)
+            blocks = list(range(bn, group_end + 1))
+            geoms = []
+            for b in blocks:
+                block_off = b * fi.erasure.block_size
+                block_len = min(fi.erasure.block_size,
+                                part.size - block_off)
+                geoms.append((b, block_off, block_len,
+                              -(-block_len // self.k)))
+            self.specs.append((blocks, geoms))
+            bn = group_end + 1
+
+    def _make_readers(self) -> list:
+        out: list[Optional[object]] = [None] * self.n
+        for i, d in enumerate(self.disks):
+            if d is None or self.smeta[i] is None:
+                continue
+            csum = self.smeta[i].erasure.get_checksum_info(
+                self.part.number)
+            algo = (bitrot_mod.BitrotAlgorithm.from_string(
+                csum.algorithm) if csum else self.eng.bitrot_algo)
+            out[i] = bitrot_io.new_bitrot_reader(
+                d, self.bucket, self.path, self.till, algo,
+                csum.hash if csum else b"", self.shard_size)
+        return out
+
+    def _ensure_readers(self) -> None:
+        if self.readers is not None:
+            return
+        self.readers = self._make_readers()
+        # device-routed groups defer per-frame bitrot verification into
+        # the fused verify+decode program (one dispatch hashes AND
+        # reconstructs — cmd/erasure-decode.go:111-150's inseparable
+        # verify-then-decode, device form); small/CPU groups verify
+        # inline at read time as before. The digest comparison must use
+        # the algorithm the frames were WRITTEN with (per-shard
+        # csum.algorithm — it may differ from the server's current
+        # bitrot config), so deferral needs every reader on one
+        # streaming device-kernel algorithm.
+        algos = {r.algo for r in self.readers if r is not None}
+        self.part_algo = algos.pop() if len(algos) == 1 else None
+        self.defer_verify = (
+            self.part_algo is not None and self.part_algo.streaming
+            and self.codec._device_hash_kernel(self.part_algo)
+            is not None
+            and self.codec._route(GET_BATCH_BLOCKS * self.k
+                                  * self.shard_size) == "device")
+
+    def read_group(self, blocks: list, geoms: list) -> tuple[list, bool,
+                                                             float]:
+        """One group's raw shard reads, with the quorum-loss →
+        per-block-hedged-read degradation unchanged; returns
+        (per-block reads, degraded, read seconds)."""
+        t0 = time.perf_counter()
+        degraded = False
+        with self.io_lock, telemetry.span("pipeline.read_group",
+                                          blocks=len(blocks)):
+            readers = self.readers
+            try:
+                reads = self.eng._read_group_shards_raw(
+                    readers, blocks, self.shard_size,
+                    [g[3] for g in geoms], self.k, self.n,
+                    collect_digests=self.defer_verify)
+            except api_errors.InsufficientReadQuorum:
+                # group-granular hedging can lose quorum where
+                # block-granular recovery still succeeds (distinct
+                # readers corrupted at distinct blocks): rebuild
+                # the readers the group attempt burned and degrade
+                # to per-block hedged reads
+                for r in readers:
+                    if r is not None:
+                        r.close()
+                readers[:] = self._make_readers()
+                self.reader_gen[0] += 1
+                degraded = True
+                reads = [self.eng._read_block_shards_raw(
+                    readers, g[0], self.shard_size, g[3], self.k,
+                    self.n, collect_digests=self.defer_verify)
+                    for g in geoms]
+        return reads, degraded, time.perf_counter() - t0
+
+    def _submit(self, spec) -> object:
+        """Queue one group's reads on the prefetch pool, carrying the
+        caller's span context so the reads attach to the request tree."""
+        from ..parallel import pipeline as pl
+        cctx = telemetry.propagating_context()
+        if cctx is not None:
+            return pl.PREFETCH_POOL.submit(cctx.run, self.read_group,
+                                           *spec)
+        return pl.PREFETCH_POOL.submit(self.read_group, *spec)
+
+    def prime(self) -> None:
+        """Issue this part's FIRST group read on the prefetch pool —
+        called by the PREVIOUS part when it reaches its last group, so
+        the drive I/O of part N+1 overlaps part N's verify+decode."""
+        if self._pending is not None or self._primed or not self.specs:
+            return
+        self._ensure_readers()
+        self._pending = self._submit(self.specs[0])
+        self._primed = True
+
+    def stream(self, next_plan: Optional["_PartReadPlan"] = None
+               ) -> Iterator[bytes]:
+        from ..parallel import pipeline as pl
+        self._ensure_readers()
+        readers = self.readers
+        k, n = self.k, self.n
+        offset, length = self.offset, self.length
+        for si, (blocks, geoms) in enumerate(self.specs):
+            group = []
+            with stagetimer.stage("get.read_shards"):
+                lookahead = self._pending
+                self._pending = None
+                if lookahead is not None and lookahead.cancel():
+                    # still queued behind other streams' prefetch
+                    # tasks: reading inline is strictly faster than
+                    # waiting for a task that hasn't started
+                    lookahead = None
+                    self._primed = False
+                if lookahead is not None:
+                    t0 = time.perf_counter()
+                    reads, degraded, read_s = lookahead.result()
+                    pl.STATS.record_get_group(
+                        True, time.perf_counter() - t0, read_s)
+                else:
+                    reads, degraded, _ = self.read_group(blocks, geoms)
+                    pl.STATS.record_get_group(False)
+            # readers-list generation THIS group's frames came from
+            # (the N+1 lookahead may rebuild the list mid-verify)
+            gen_at_read = self.reader_gen[0]
+            self.heal_required = self.heal_required or degraded
+            # issue the NEXT group's reads on the drive pool before
+            # this group's verify+decode — decode overlaps drive
+            # I/O, bounded to ONE group of lookahead staging; at the
+            # LAST group the lookahead crosses into the next part
+            if pl.ENABLED and si + 1 < len(self.specs):
+                self._pending = self._submit(self.specs[si + 1])
+            elif si + 1 == len(self.specs) and next_plan is not None:
+                next_plan.prime()
+            for (b, block_off, block_len, shard_len), \
+                    (shards, digests, had_errors) in zip(geoms, reads):
+                self.heal_required = self.heal_required or had_errors
+                group.append([b, block_off, block_len, shard_len,
+                              shards, digests])
+            with stagetimer.stage("get.verify+decode"), \
+                    telemetry.span("pipeline.verify_decode",
+                                   blocks=len(blocks)):
+                if self.eng._verify_and_reconstruct_group(
+                        self.codec, group, k, n, readers,
+                        self.shard_size,
+                        self.part_algo or self.eng.bitrot_algo,
+                        io_lock=self.io_lock,
+                        reader_gen=(self.reader_gen, gen_at_read)):
+                    self.heal_required = True
+            with stagetimer.stage("get.join"):
+                out = []
+                for b, block_off, block_len, shard_len, shards, _dg \
+                        in group:
+                    data = np.concatenate([s[:shard_len]
+                                           for s in shards[:k]])
+                    begin = max(offset - block_off, 0)
+                    end = min(offset + length - block_off, block_len)
+                    # slice the view FIRST: tobytes on the full block
+                    # then slicing again was two payload copies
+                    out.append(data[begin:end].tobytes())
+            yield from out
+        if self.heal_required and not self.suppress_heal_flag \
+                and self.eng.on_degraded_read is not None:
+            try:
+                self.eng.on_degraded_read(self.bucket, self.object_name)
+            except Exception:  # noqa: BLE001 — heal is best-effort
+                pass
+
+    def close(self) -> None:
+        """Settle any in-flight lookahead, then close the readers (an
+        abandoned generator must not leave a pool thread racing closed
+        streams)."""
+        if self._pending is not None and not self._pending.cancel():
+            try:
+                self._pending.result()
+            except BaseException:  # noqa: BLE001 — abandoned read
+                pass
+        self._pending = None
+        if self.readers is not None:
+            for r in self.readers:
+                if r is not None:
+                    r.close()
+            self.readers = None
 
 
 def _read_full(reader, n: int) -> bytes:

@@ -25,7 +25,7 @@ import numpy as np
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
 from ..storage.api import StorageAPI
-from ..storage.datatypes import FileInfo
+from ..storage.datatypes import FileInfo, is_restored, is_transitioned
 from ..storage.xl_storage import MINIO_META_TMP_BUCKET
 from . import api_errors, bitrot_io, metadata as meta
 from .engine import ErasureObjects
@@ -95,8 +95,11 @@ class HealMixin(ErasureObjects):
                 object_name) from None
 
         fi = meta.pick_valid_file_info(metas, read_quorum)
-        if fi.deleted:
-            # delete markers need only metadata replication
+        if fi.deleted or (is_transitioned(fi.metadata)
+                          and not is_restored(fi.metadata)):
+            # delete markers AND transitioned zero-data stubs need only
+            # metadata replication (a stub's data lives in the remote
+            # tier — there are no local shards to rebuild)
             missing = [i for i, m in enumerate(metas)
                        if m is None or m.mod_time != fi.mod_time]
             res.missing_before = len(missing)

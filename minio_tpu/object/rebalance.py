@@ -43,7 +43,9 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import backoff_delay, telemetry
+from ..utils import telemetry
+from ..utils.pressure import ForegroundPressure
+from ..utils.streams import IterStream as _IterStream
 from . import api_errors
 from .engine import GetOptions, PutOptions
 from .topology import POOL_DRAINING, TOPOLOGY_PREFIX
@@ -62,41 +64,14 @@ BACKOFF_TRIES = int(os.environ.get(
 
 # meta-bucket prefixes that must NOT migrate: per-pool internals (tmp
 # staging, live multipart sessions, bucket metadata replicated per
-# pool) and the topology/checkpoint docs themselves (written to every
-# pool on purpose)
-META_SKIP_PREFIXES = ("tmp/", "multipart/", "buckets/", TOPOLOGY_PREFIX)
+# pool) and the topology/checkpoint/tier-config docs themselves
+# (written to every pool on purpose)
+META_SKIP_PREFIXES = ("tmp/", "multipart/", "buckets/", TOPOLOGY_PREFIX,
+                      "tier/")
 
 
 def _checkpoint_object(pool: int) -> str:
     return f"{TOPOLOGY_PREFIX}rebalance-{pool}.json"
-
-
-class _IterStream:
-    """File-like adapter over a GET chunk iterator, so a moved object
-    streams source→target block by block instead of materializing in
-    RAM."""
-
-    def __init__(self, it):
-        self._it = it
-        self._buf = b""
-
-    def read(self, n: int = -1) -> bytes:
-        if n < 0:
-            out = self._buf + b"".join(self._it)
-            self._buf = b""
-            return out
-        while len(self._buf) < n:
-            try:
-                self._buf += next(self._it)
-            except StopIteration:
-                break
-        out, self._buf = self._buf[:n], self._buf[n:]
-        return bytes(out)
-
-    def close(self) -> None:
-        close = getattr(self._it, "close", None)
-        if close is not None:
-            close()
 
 
 def _metrics():
@@ -128,14 +103,14 @@ class Rebalancer:
         self.checkpoint_every = checkpoint_every or CHECKPOINT_EVERY
         self.page = page or PAGE
         # busy probe override (tests); default samples the live
-        # scheduler queue + staging-ring waits
-        self._busy_fn = busy_fn
+        # scheduler queue + staging-ring waits (utils/pressure.py —
+        # shared with the tier transition worker)
+        self._pressure = ForegroundPressure(server_sets, busy_fn=busy_fn)
         self._throttle_base = BACKOFF_S if throttle_s is None \
             else throttle_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._mu = threading.Lock()
-        self._last_pool_waits: Optional[int] = None
         self.state = {
             "pool": source, "status": "pending",
             "bucket": "", "marker": "",
@@ -412,10 +387,20 @@ class Rebalancer:
         return False
 
     def _copy_version(self, src, bucket: str, name: str, oi) -> int:
+        from ..storage.datatypes import is_restored, is_transitioned
         if oi.delete_marker:
             idx = self._target_pool(bucket, name, 1 << 20)
             self.obj.server_sets[idx].put_delete_marker(
                 bucket, name, oi.version_id, oi.mod_time)
+            return 0
+        if is_transitioned(oi.user_defined or {}) \
+                and not is_restored(oi.user_defined or {}):
+            # a tiered zero-data stub: there are no local shards to
+            # move and GET would refuse (InvalidObjectState) — copy the
+            # xl.meta pointer alone, like a delete marker (the remote
+            # copy stays where it is)
+            idx = self._target_pool(bucket, name, 1 << 20)
+            self.obj.server_sets[idx].put_stub_version(bucket, name, oi)
             return 0
         info, stream = src.get_object(
             bucket, name, opts=GetOptions(version_id=oi.version_id))
@@ -471,29 +456,13 @@ class Rebalancer:
     # ------------------------------------------------------------------
 
     def _busy(self) -> bool:
-        if self._busy_fn is not None:
-            return bool(self._busy_fn())
-        queued = 0
-        for z in self.obj.server_sets:
-            for eng in getattr(z, "sets", ()):
-                sched = getattr(eng, "scheduler", None)
-                if sched is not None:
-                    queued += sched.stats()["queued_blocks"]
-        if queued > 0:
-            return True
-        from ..parallel import pipeline
-        waits = pipeline.pool_pressure()["waits"]
-        last, self._last_pool_waits = self._last_pool_waits, waits
-        return last is not None and waits > last
+        return self._pressure.busy()
 
     def _throttle(self) -> None:
-        for attempt in range(BACKOFF_TRIES):
-            if self._stop.is_set() or not self._busy():
-                return
-            self._stop.wait(backoff_delay(self._throttle_base,
-                                          BACKOFF_MAX_S, attempt))
         # still busy after the cap: proceed at the slow cadence anyway
         # so a permanently-loaded cluster still drains
+        self._pressure.throttle(self._stop, self._throttle_base,
+                                BACKOFF_MAX_S, BACKOFF_TRIES)
 
     # ------------------------------------------------------------------
     # checkpoint persistence

@@ -309,6 +309,40 @@ class ErasureServerSets:
                                                metadata, version_id),
             bucket, object_name)
 
+    def transition_object(self, bucket, object_name, version_id="",
+                          tier="", remote_object="", remote_version="",
+                          expect_etag="", expect_mod_time=None):
+        """Stub-rewrite one version in whichever pool holds it (the
+        tier transition/reclaim commit). Version-targeted like
+        version delete; the latest-version form hits the newest
+        holder, matching what reads serve."""
+        if not self.single_zone() and version_id:
+            last: Optional[Exception] = None
+            for z in self.server_sets:
+                if not z.has_object_versions(bucket, object_name):
+                    continue
+                try:
+                    return z.transition_object(
+                        bucket, object_name, version_id, tier,
+                        remote_object, remote_version, expect_etag,
+                        expect_mod_time)
+                except (api_errors.ObjectNotFound,
+                        api_errors.VersionNotFound) as e:
+                    last = e
+            raise last or api_errors.ObjectNotFound(bucket, object_name)
+        if not self.single_zone():
+            return self._read_newest(
+                bucket, object_name,
+                lambda z: z.transition_object(bucket, object_name,
+                                              version_id, tier,
+                                              remote_object,
+                                              remote_version,
+                                              expect_etag,
+                                              expect_mod_time))
+        return self.server_sets[0].transition_object(
+            bucket, object_name, version_id, tier, remote_object,
+            remote_version, expect_etag, expect_mod_time)
+
     # ------------------------------------------------------------------
     # multipart: session created in the chosen PUT zone; subsequent calls
     # find the zone owning the uploadID

@@ -321,6 +321,17 @@ class ErasureSets:
         return self.get_hashed_set(object_name).update_object_metadata(
             bucket, object_name, metadata, version_id)
 
+    def transition_object(self, bucket, object_name, version_id="",
+                          tier="", remote_object="", remote_version="",
+                          expect_etag="", expect_mod_time=None):
+        return self.get_hashed_set(object_name).transition_object(
+            bucket, object_name, version_id, tier, remote_object,
+            remote_version, expect_etag, expect_mod_time)
+
+    def put_stub_version(self, bucket, object_name, info):
+        return self.get_hashed_set(object_name).put_stub_version(
+            bucket, object_name, info)
+
     def has_object_versions(self, bucket, object_name) -> bool:
         return self.get_hashed_set(object_name).has_object_versions(
             bucket, object_name)

@@ -315,6 +315,58 @@ class AdminHandlers:
             return self._json({"pool": pool, "state": state,
                                "epoch": epoch})
 
+        # -- tiering plane: remote tier registry (cmd/tier-handlers.go) ----
+        if sub == "tier" and m == "GET":
+            self._auth(ctx, "admin:ListTier")
+            tiers = self._tiers()
+            return self._json({"epoch": tiers.epoch,
+                               "tiers": tiers.list(redact=True)})
+        if sub == "tier" and m == "PUT":
+            # add (or with ?force=true update) one named remote tier
+            self._auth(ctx, "admin:SetTier")
+            from ..tier.config import TierConfig, TierConfigError
+            try:
+                body = json.loads(ctx.read_body().decode() or "{}")
+                cfg = TierConfig.from_dict(body)
+            except (ValueError, TierConfigError) as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            update = ctx.query1("force", "") == "true"
+            try:
+                epoch = self._tiers().add(cfg, update=update)
+            except TierConfigError as e:
+                code = "XMinioAdminTierAlreadyExists" \
+                    if "already exists" in str(e) \
+                    else "AdminInvalidArgument"
+                raise S3Error(code, str(e)) from None
+            return self._json({"name": cfg.name, "epoch": epoch})
+        if sub == "tier" and m == "DELETE":
+            self._auth(ctx, "admin:SetTier")
+            from ..object import api_errors as _oerr
+            name = ctx.query1("name", "")
+            # removing a tier that lifecycle rules still reference
+            # strands every transitioned stub behind an unrestorable
+            # pointer — refuse unless ?force=true
+            if ctx.query1("force", "") != "true" and \
+                    self._tier_in_use(name):
+                raise S3Error(
+                    "XMinioAdminTierBackendInUse",
+                    f"tier {name!r} is referenced by a lifecycle "
+                    "Transition rule; detach the rule or pass "
+                    "force=true")
+            try:
+                epoch = self._tiers().remove(name)
+            except _oerr.TierNotFound:
+                raise S3Error("XMinioAdminTierNotFound", name) from None
+            return self._json({"name": name, "epoch": epoch})
+        if sub == "tier/stats" and m == "GET":
+            # transition-worker queue/throughput counters (the madmin
+            # tier-status surface)
+            self._auth(ctx, "admin:ListTier")
+            worker = getattr(self.node, "transition_worker", None) \
+                if self.node is not None else None
+            return self._json(worker.stats() if worker is not None
+                              else {})
+
         # -- config KV (cmd/admin-handlers-config-kv.go) -------------------
         if sub == "get-config" and m == "GET":
             self._auth(ctx, "admin:ConfigUpdate")
@@ -459,6 +511,34 @@ class AdminHandlers:
         if self.api.iam is None:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
+
+    def _tiers(self):
+        if self.api.tiers is None:
+            raise S3Error("NotImplemented",
+                          "backend has no tier configuration")
+        return self.api.tiers
+
+    def _tier_in_use(self, name: str) -> bool:
+        """True when any bucket's lifecycle Transition rule names this
+        tier (best-effort: an unlistable namespace blocks nothing)."""
+        from ..features.lifecycle import Lifecycle
+        try:
+            buckets = [v.name for v in self.api.obj.list_buckets()]
+        except Exception:  # noqa: BLE001 — can't enumerate: don't block
+            return False
+        for b in buckets:
+            xml = self.api.bucket_meta.get(b).lifecycle_xml
+            if not xml:
+                continue
+            try:
+                lc = Lifecycle.from_xml(xml)
+            except Exception:  # noqa: BLE001 — malformed config
+                continue
+            for r in lc.rules:
+                if r.enabled and name in (r.transition_tier,
+                                          r.noncurrent_transition_tier):
+                    return True
+        return False
 
     def _topology_call(self, method: str, *args):
         """Dispatch a topology-plane verb on the object layer; backends

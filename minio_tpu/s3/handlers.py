@@ -30,6 +30,7 @@ from ..object.hash_reader import HashReader
 from ..object.multipart import CompletePart
 from ..storage.datatypes import ObjectInfo
 from ..utils import stagetimer, telemetry
+from ..utils.streams import IterStream as _IterStream
 from . import signature as sig
 from xml.sax.saxutils import escape as _sax_escape
 
@@ -234,6 +235,7 @@ class S3ApiHandlers:
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPool
+        self.tiers = None         # optional TierManager (ILM tiering)
         from .trace import TraceSys
         self.trace = TraceSys()   # request tracing + audit hub
         from ..utils.bandwidth import BandwidthMonitor
@@ -822,6 +824,8 @@ class S3ApiHandlers:
                 return self.new_multipart_upload(ctx, bucket, key)
             if ctx.has_query("uploadId"):
                 return self.complete_multipart_upload(ctx, bucket, key)
+            if ctx.has_query("restore"):
+                return self.restore_object(ctx, bucket, key)
             if ctx.has_query("select") or \
                     ctx.query1("select-type") == "2":
                 return self.select_object_content(ctx, bucket, key)
@@ -1174,10 +1178,23 @@ class S3ApiHandlers:
         if len(keys) > 1000:
             raise S3Error("MalformedXML", "too many objects (max 1000)")
         versioned = self.bucket_meta.versioning_enabled(bucket)
+        # batch deletes must free remote tier copies like the single
+        # DELETE path does (same eff_vid gate: only when a DATA version
+        # is removed, never for marker writes)
+        tiers_live = self.tiers is not None \
+            and getattr(self.tiers, "tiers", None)
         deleted, errors = [], []
         for key, vid in keys:
             if vid == "null":
                 vid = ""  # same normalization as single DELETE
+            tiered_md = None
+            if tiers_live and (vid or not versioned):
+                try:
+                    tiered_md = self.obj.get_object_info(
+                        bucket, key,
+                        GetOptions(version_id=vid)).user_defined or {}
+                except oerr.ObjectApiError:
+                    pass
             try:
                 res = self.obj.delete_object(bucket, key, version_id=vid,
                                              versioned=versioned)
@@ -1186,6 +1203,9 @@ class S3ApiHandlers:
                     entry["delete_marker"] = True
                     entry["delete_marker_version"] = res.version_id
                 deleted.append(entry)
+                if tiered_md is not None:
+                    from ..tier.transition import free_remote
+                    free_remote(self.tiers, tiered_md)
                 self._notify("s3:ObjectRemoved:Delete", bucket, key)
             except oerr.ObjectNotFound:
                 deleted.append({"key": key, "version_id": vid})
@@ -1323,6 +1343,7 @@ class S3ApiHandlers:
         return reader2, size2, headers
 
     def _obj_response_headers(self, info: ObjectInfo) -> dict[str, str]:
+        from ..storage import datatypes as dt
         h = {
             "ETag": f'"{info.etag}"',
             "Last-Modified": _http_date(info.mod_time),
@@ -1341,6 +1362,13 @@ class S3ApiHandlers:
             elif lk in ("cache-control", "content-disposition",
                         "content-language", "expires"):
                 h[k] = v
+        md = info.user_defined or {}
+        if dt.is_transitioned(md):
+            # transitioned objects report the TIER as their storage
+            # class and their restore state (S3 GLACIER semantics)
+            h["x-amz-storage-class"] = md.get(dt.TRANSITION_TIER_KEY, "")
+            if md.get(dt.RESTORE_KEY):
+                h["x-amz-restore"] = md[dt.RESTORE_KEY]
         if info.delete_marker:
             h["x-amz-delete-marker"] = "true"
         return h
@@ -1659,20 +1687,80 @@ class S3ApiHandlers:
         vid = ctx.query1("versionId")
         versioned = self.bucket_meta.versioning_enabled(bucket)
         self._enforce_object_lock(ctx, bucket, key, vid, versioned)
+        # "null" targets the pre-versioning null version, which this
+        # stack stores under the empty version id — normalize ONCE so
+        # the tier-free gate below and delete_object agree on whether
+        # this request removes a DATA version or only writes a marker
+        eff_vid = "" if vid == "null" else vid
+        # a delete that removes a DATA version (explicit version, or an
+        # unversioned delete — not a marker write) must free the remote
+        # tier copy of a transitioned object too. Gated on a NON-EMPTY
+        # registry: with no tiers configured nothing can be
+        # transitioned, and the extra quorum metadata read would tax
+        # every DELETE for nothing. eff_vid (not the raw vid) decides:
+        # ?versionId=null on a versioned bucket is a MARKER write — the
+        # stub stays, so freeing its remote copy would destroy the
+        # archived data.
+        tiered_md = None
+        if self.tiers is not None and getattr(self.tiers, "tiers", None) \
+                and (eff_vid or not versioned):
+            try:
+                tinfo = self.obj.get_object_info(
+                    bucket, key, GetOptions(version_id=eff_vid))
+                tiered_md = tinfo.user_defined or {}
+            except oerr.ObjectApiError:
+                pass
         headers = {}
         try:
             res = self.obj.delete_object(
-                bucket, key, version_id="" if vid == "null" else vid,
-                versioned=versioned)
+                bucket, key, version_id=eff_vid, versioned=versioned)
             if isinstance(res, ObjectInfo):
                 if res.delete_marker:
                     headers["x-amz-delete-marker"] = "true"
                 if res.version_id and res.version_id != "null":
                     headers["x-amz-version-id"] = res.version_id
+            if tiered_md is not None:
+                from ..tier.transition import free_remote
+                free_remote(self.tiers, tiered_md)
         except oerr.ObjectNotFound:
             pass  # S3 DELETE of a missing key is 204
         self._notify("s3:ObjectRemoved:Delete", bucket, key)
         return HTTPResponse(status=204, headers=headers)
+
+    def restore_object(self, ctx, bucket, key) -> HTTPResponse:
+        """POST /bucket/key?restore — pull a transitioned object back as
+        an expiring local copy (S3 RestoreObject; 202 on a fresh
+        restore, 200 when only the expiry window was extended)."""
+        self.authenticate(ctx, "s3:RestoreObject", bucket, key)
+        self.obj.get_bucket_info(bucket)
+        if self.tiers is None:
+            raise S3Error("NotImplemented", "no tier configuration")
+        body = ctx.read_body()
+        days = 1
+        if body.strip():
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML") from None
+            ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+            del_ = root.find("Days")
+            if del_ is None:
+                del_ = root.find(ns + "Days")
+            if del_ is not None and (del_.text or "").strip():
+                try:
+                    days = int(del_.text.strip())
+                except ValueError:
+                    raise S3Error("MalformedXML", "bad Days") from None
+        if days < 1:
+            raise S3Error("InvalidArgument", "restore Days must be >= 1")
+        vid = ctx.query1("versionId")
+        from ..tier.transition import restore_object as _restore
+        out = _restore(self.obj, self.tiers, bucket, key,
+                       version_id="" if vid == "null" else vid,
+                       days=days)
+        self._notify("s3:ObjectRestore:Completed", bucket, key)
+        return HTTPResponse(
+            status=202 if out["status"] == "restored" else 200)
 
     def copy_object(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:PutObject", bucket, key)
@@ -2181,27 +2269,6 @@ class S3ApiHandlers:
                     self.replication.on_delete(bucket, key)
             except Exception:  # noqa: BLE001 — replication is async
                 pass
-
-
-class _IterStream:
-    """File-like over an iterator of byte chunks."""
-
-    def __init__(self, it: Iterator[bytes]):
-        self.it = it
-        self.buf = b""
-        self.eof = False
-
-    def read(self, n: int = -1) -> bytes:
-        while not self.eof and (n < 0 or len(self.buf) < n):
-            try:
-                self.buf += next(self.it)
-            except StopIteration:
-                self.eof = True
-        if n < 0:
-            out, self.buf = self.buf, b""
-        else:
-            out, self.buf = self.buf[:n], self.buf[n:]
-        return out
 
 
 def _parse_max_keys(v: str) -> int:

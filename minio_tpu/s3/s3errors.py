@@ -101,6 +101,15 @@ ERROR_TABLE: dict[str, tuple[int, str]] = {
     "KeyTooLongError": (400, "Your key is too long"),
     "NoSuchLifecycleConfiguration": (404, "The lifecycle configuration "
                                           "does not exist"),
+    "RestoreAlreadyInProgress": (409, "Object restore is already in "
+                                      "progress"),
+    "XMinioAdminTierNotFound": (404, "The remote tier specified does "
+                                     "not exist"),
+    "XMinioAdminTierAlreadyExists": (409, "The remote tier specified "
+                                          "already exists"),
+    "XMinioAdminTierBackendInUse": (409, "The remote tier is referenced "
+                                         "by a lifecycle rule or "
+                                         "transitioned object"),
     "NoSuchTagSet": (404, "The TagSet does not exist"),
     "NoSuchObjectLockConfiguration": (404, "The specified object does not "
                                            "have a ObjectLock "
@@ -180,6 +189,8 @@ def api_error_from(exc: Exception) -> S3Error:
         (oerr.EntityTooLarge, "EntityTooLarge"),
         (oerr.EntityTooSmall, "EntityTooSmall"),
         (oerr.PreConditionFailed, "PreconditionFailed"),
+        (oerr.InvalidObjectState, "InvalidObjectState"),
+        (oerr.TierNotFound, "XMinioAdminTierNotFound"),
         (oerr.InvalidETag, "InvalidDigest"),
         (oerr.MethodNotAllowed, "MethodNotAllowed"),
         (oerr.SignatureDoesNotMatch, "SignatureDoesNotMatch"),

@@ -17,6 +17,33 @@ ERASURE_ALGORITHM = "rs-vandermonde"  # cmd/erasure-metadata.go:34
 BLOCK_SIZE_V1 = 1 << 22               # 4 MiB, cmd/object-api-common.go:31
 NULL_VERSION_ID = "null"
 
+# Tiering-plane metadata keys (reference cmd/erasure-object.go transition
+# metadata, xhttp.AmzRestore): the x-minio-internal- prefix rides xl.meta
+# MetaSys, never leaks into client responses. Defined here (not in
+# tier/) so the engine can gate reads without importing the tier plane.
+TRANSITION_STATUS_KEY = "X-Minio-Internal-transition-status"
+TRANSITION_TIER_KEY = "X-Minio-Internal-transition-tier"
+TRANSITIONED_OBJECT_KEY = "X-Minio-Internal-transitioned-object"
+TRANSITIONED_VERSION_KEY = "X-Minio-Internal-transitioned-versionID"
+TRANSITION_COMPLETE = "complete"
+# restore state of a transitioned object: the S3-visible x-amz-restore
+# header value plus the internal absolute expiry the reclaim sweep uses
+RESTORE_KEY = "x-amz-restore"
+RESTORE_EXPIRY_KEY = "X-Minio-Internal-restore-expiry"
+RESTORE_ONGOING = 'ongoing-request="true"'
+
+
+def is_transitioned(metadata: dict) -> bool:
+    """True when this version's data lives in a remote tier."""
+    return metadata.get(TRANSITION_STATUS_KEY) == TRANSITION_COMPLETE
+
+
+def is_restored(metadata: dict) -> bool:
+    """True when a transitioned version currently has a live local
+    restored copy (restore finished, not yet reclaimed)."""
+    v = metadata.get(RESTORE_KEY, "")
+    return bool(v) and RESTORE_ONGOING not in v
+
 
 @dataclasses.dataclass
 class ChecksumInfo:

@@ -1,0 +1,19 @@
+"""Tiering plane: remote warm/cold tiers behind the hot erasure pools.
+
+The reference's ILM tiering (cmd/tier.go + cmd/tier-handlers.go +
+cmd/erasure-object.go transition paths): operators register named
+remote tiers (S3 / Azure / GCS / filesystem), lifecycle ``Transition``
+rules move cold objects' data there, the local ``xl.meta`` becomes a
+zero-data stub, GETs answer ``InvalidObjectState`` until a
+``RestoreObject`` pulls an expiring local copy back.
+
+  * :mod:`.config`     — persisted, epoch-versioned tier registry
+  * :mod:`.client`     — warm-tier client implementations + chaos wrapper
+  * :mod:`.transition` — background transition worker, crawler actions,
+                         restore + reclaim
+"""
+
+from .client import (FSTierClient, GatewayTierClient, NaughtyTierClient,
+                     TierClientError, TierObjectNotFound,
+                     new_tier_client)  # noqa: F401
+from .config import TierConfig, TierManager, TIER_CONFIG_OBJECT  # noqa: F401

@@ -1,0 +1,233 @@
+"""Persisted tier registry: named remote tiers, epoch-versioned.
+
+The reference keeps tier configs in an encrypted object under the
+hidden config bucket (cmd/tier.go, ``.minio.sys/tier-config.bin``) and
+every lifecycle ``Transition`` rule names one. Here the registry is one
+JSON doc — ``.minio.sys/tier/config.json`` — written to EVERY pool and
+recovered highest-epoch-wins, exactly the durability rule the topology
+plane uses (object/topology.py): any surviving subset of pools can
+recover the newest registry, pools that missed an update converge on
+the next save.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+from ..object import api_errors
+from ..storage.xl_storage import MINIO_META_BUCKET
+from .client import TierClient, TierClientError, new_tier_client
+
+TIER_PREFIX = "tier/"
+TIER_CONFIG_OBJECT = TIER_PREFIX + "config.json"
+
+# params whose values must never leave the server (admin GET redacts)
+_SECRET_PARAMS = ("secret_key", "key_b64", "credentials_json")
+
+
+class TierConfigError(api_errors.ObjectApiError):
+    """Invalid tier operation (duplicate name, unknown name, bad spec)."""
+
+
+@dataclasses.dataclass
+class TierConfig:
+    """One named remote tier: a type tag plus backend params
+    (fs: path; s3: host/port/bucket/prefix/access_key/secret_key/region;
+    azure/gcs: the gateway constructor kwargs + bucket/prefix)."""
+    name: str
+    type: str
+    params: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self, redact: bool = False) -> dict:
+        params = dict(self.params)
+        if redact:
+            for k in _SECRET_PARAMS:
+                if params.get(k):
+                    params[k] = "REDACTED"
+        return {"name": self.name, "type": self.type, "params": params}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TierConfig":
+        name = str(d.get("name", "")).strip()
+        type_ = str(d.get("type", "")).strip()
+        if not name or not type_:
+            raise TierConfigError("tier needs a name and a type")
+        return cls(name=name, type=type_, params=dict(d.get("params") or {}))
+
+
+class TierManager:
+    """The live registry + client cache. Thread-safe; every mutation
+    bumps ``epoch`` and persists BEFORE it takes effect (a crash
+    mid-add replays, never forgets a tier the lifecycle already
+    references)."""
+
+    def __init__(self, object_layer=None):
+        self.obj = object_layer
+        self._mu = threading.Lock()
+        self.epoch = 0
+        self.updated = time.time()
+        self.tiers: dict[str, TierConfig] = {}
+        self._clients: dict[str, TierClient] = {}
+
+    # ------------------------------------------------------------------
+    # registry CRUD
+    # ------------------------------------------------------------------
+
+    def add(self, cfg: TierConfig, update: bool = False) -> int:
+        """Register (or with ``update`` replace) a tier; verifies the
+        client constructs before the registry mutates. Returns the new
+        epoch."""
+        try:
+            client = new_tier_client(cfg.type, cfg.params)
+        except (TierClientError, KeyError, ValueError) as e:
+            raise TierConfigError(f"bad tier spec: {e}") from None
+        with self._mu:
+            if not update and cfg.name in self.tiers:
+                raise TierConfigError(f"tier {cfg.name!r} already exists")
+            prev = self.tiers.get(cfg.name)
+            self.tiers[cfg.name] = cfg
+            self.epoch += 1
+            self.updated = time.time()
+            epoch = self.epoch
+        try:
+            self.save()
+        except Exception:
+            with self._mu:          # roll the in-memory registry back
+                if prev is None:
+                    self.tiers.pop(cfg.name, None)
+                else:
+                    self.tiers[cfg.name] = prev
+            raise
+        with self._mu:
+            self._clients[cfg.name] = client
+        return epoch
+
+    def remove(self, name: str) -> int:
+        with self._mu:
+            if name not in self.tiers:
+                raise api_errors.TierNotFound(name)
+            prev = self.tiers.pop(name)
+            self._clients.pop(name, None)
+            self.epoch += 1
+            self.updated = time.time()
+            epoch = self.epoch
+        try:
+            self.save()
+        except Exception:
+            with self._mu:
+                self.tiers[name] = prev
+            raise
+        return epoch
+
+    def list(self, redact: bool = True) -> list[dict]:
+        with self._mu:
+            return [t.to_dict(redact=redact)
+                    for t in sorted(self.tiers.values(),
+                                    key=lambda t: t.name)]
+
+    def get(self, name: str) -> TierConfig:
+        with self._mu:
+            cfg = self.tiers.get(name)
+        if cfg is None:
+            raise api_errors.TierNotFound(name)
+        return cfg
+
+    def client(self, name: str) -> TierClient:
+        with self._mu:
+            c = self._clients.get(name)
+            cfg = self.tiers.get(name)
+        if c is not None:
+            return c
+        if cfg is None:
+            raise api_errors.TierNotFound(name)
+        c = new_tier_client(cfg.type, cfg.params)
+        with self._mu:
+            self._clients.setdefault(name, c)
+        return c
+
+    def set_client(self, name: str, client: TierClient) -> None:
+        """Swap the live client of a registered tier (chaos tests wrap
+        the real client in a NaughtyTierClient)."""
+        self.get(name)
+        with self._mu:
+            self._clients[name] = client
+
+    @staticmethod
+    def remote_key(bucket: str, object_name: str, version_id: str) -> str:
+        """Mint the remote object key for one transitioned version:
+        unique per version (the reference stores a random remote name in
+        xl.meta too — remote keys must survive local renames and never
+        collide on overwrite)."""
+        import uuid as _uuid
+        vid = version_id or "null"
+        return f"{bucket}/{object_name}/{vid}/{_uuid.uuid4().hex}"
+
+    # ------------------------------------------------------------------
+    # persistence (the topology plane's every-pool, highest-epoch rule)
+    # ------------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._mu:
+            return {"epoch": self.epoch, "updated": self.updated,
+                    "tiers": [t.to_dict() for t in self.tiers.values()]}
+
+    def _pools(self):
+        if self.obj is None:
+            return []
+        return getattr(self.obj, "server_sets", None) or [self.obj]
+
+    def save(self) -> int:
+        """Write the registry to every pool; at least one copy must
+        land or the mutation is rejected (caller rolls back)."""
+        pools = self._pools()
+        if not pools:
+            return 0
+        payload = json.dumps(self.to_dict()).encode()
+        landed = 0
+        last: Optional[Exception] = None
+        for z in pools:
+            try:
+                z.put_object(MINIO_META_BUCKET, TIER_CONFIG_OBJECT,
+                             payload)
+                landed += 1
+            except Exception as e:  # noqa: BLE001 — per-pool durability
+                last = e
+        if landed == 0:
+            raise TierConfigError(
+                f"tier config epoch {self.epoch} not persisted to any "
+                f"pool: {last!r}")
+        return landed
+
+    def load(self) -> bool:
+        """Recover the newest persisted registry (highest epoch across
+        pools); returns True when a doc was found."""
+        best: Optional[dict] = None
+        for z in self._pools():
+            try:
+                _, stream = z.get_object(MINIO_META_BUCKET,
+                                         TIER_CONFIG_OBJECT)
+                doc = json.loads(b"".join(stream).decode())
+            except (api_errors.ObjectApiError, ValueError):
+                continue
+            if best is None or int(doc.get("epoch", 0)) > \
+                    int(best.get("epoch", 0)):
+                best = doc
+        if best is None:
+            return False
+        tiers = {}
+        for d in best.get("tiers", []):
+            try:
+                cfg = TierConfig.from_dict(d)
+            except TierConfigError:
+                continue
+            tiers[cfg.name] = cfg
+        with self._mu:
+            self.epoch = int(best.get("epoch", 0))
+            self.updated = float(best.get("updated", time.time()))
+            self.tiers = tiers
+            self._clients.clear()
+        return True

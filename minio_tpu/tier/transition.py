@@ -1,0 +1,529 @@
+"""ILM transitions + RestoreObject: moving cold data to remote tiers.
+
+The reference enforces lifecycle ``Transition`` rules from the data
+crawler (cmd/data-crawler.go applyActions -> transitionObject,
+cmd/erasure-object.go TransitionObject/RestoreTransitionedObject): the
+object's data streams to the configured tier, the local ``xl.meta``
+becomes a zero-data stub carrying the tier name + remote key, GETs
+answer ``InvalidObjectState`` until ``RestoreObject`` pulls an expiring
+local copy back, and the restore-expiry sweep reclaims that copy.
+
+This module wires that flow to this repo's planes:
+
+  * crawler actions (``transition_action`` for current versions,
+    ``noncurrent_transition_action`` per bucket,
+    ``restore_reclaim_action`` for expired restored copies) feed a
+    bounded :class:`TransitionWorker` queue;
+  * the worker moves object data through the tier client, verifies the
+    remote copy (head size) BEFORE the engine rewrites xl.meta and
+    frees local shards, and throttles off live ``BatchScheduler``
+    occupancy + ``BytePool`` waits exactly like ``object/rebalance.py``
+    (the shared ``utils/pressure.py`` probe);
+  * failed transitions feed the source MRF heal queue (heal first,
+    retry on the next crawler pass) and count in
+    ``minio_tpu_tier_failed_total{tier}``;
+  * per-object moves/restores are span roots (``tier.transition`` /
+    ``tier.restore``) so slow tiers surface in ``/minio/admin/v3/spans``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import xml.etree.ElementTree as ET
+from collections import deque
+from typing import Optional
+
+from ..object import api_errors
+from ..object.engine import GetOptions, PutOptions
+from ..storage.datatypes import (RESTORE_EXPIRY_KEY, RESTORE_KEY,
+                                 TRANSITION_TIER_KEY,
+                                 TRANSITIONED_OBJECT_KEY,
+                                 TRANSITIONED_VERSION_KEY, is_restored,
+                                 is_transitioned)
+from ..utils import telemetry
+from ..utils.pressure import ForegroundPressure
+from ..utils.streams import IterStream
+from .client import TierClientError, TierObjectNotFound
+from .config import TierManager
+
+QUEUE_SIZE = int(os.environ.get("MINIO_TPU_TIER_QUEUE_SIZE", "10000"))
+BACKOFF_S = float(os.environ.get("MINIO_TPU_TIER_BACKOFF_S", "0.05"))
+BACKOFF_MAX_S = float(os.environ.get("MINIO_TPU_TIER_BACKOFF_MAX_S",
+                                     "1.0"))
+BACKOFF_TRIES = int(os.environ.get("MINIO_TPU_TIER_BACKOFF_TRIES", "8"))
+
+
+def _metrics():
+    reg = telemetry.REGISTRY
+    return (
+        reg.counter("minio_tpu_tier_objects_total",
+                    "Object versions transitioned to remote tiers"),
+        reg.counter("minio_tpu_tier_bytes_total",
+                    "Bytes moved to remote tiers"),
+        reg.counter("minio_tpu_tier_failed_total",
+                    "Transitions that failed (fed to MRF, retried on "
+                    "the next crawler pass)"),
+        reg.counter("minio_tpu_tier_restored_total",
+                    "RestoreObject pulls completed"),
+    )
+
+
+def _mrf_enqueue(object_layer, bucket: str, name: str) -> bool:
+    """Feed a failed transition into the MRF heal queue of the layer
+    holding the object (heal-first: a degraded source heals, then the
+    next crawler pass retries the transition)."""
+    layers = getattr(object_layer, "server_sets", None) or [object_layer]
+    for z in layers:
+        mrf = getattr(z, "mrf", None)
+        if mrf is None:
+            continue
+        try:
+            if len(layers) > 1 and not z.has_object_versions(bucket, name):
+                continue
+        except api_errors.ObjectApiError:
+            continue
+        mrf.enqueue(bucket, name)
+        return True
+    return False
+
+
+def free_remote(tiers: Optional[TierManager], metadata: dict) -> bool:
+    """Best-effort delete of a transitioned version's remote copy —
+    called when the stub (or its restored copy) is deleted or expired.
+    Never raises: a tier outage must not fail the local delete."""
+    if tiers is None or not is_transitioned(metadata):
+        return False
+    tier = metadata.get(TRANSITION_TIER_KEY, "")
+    key = metadata.get(TRANSITIONED_OBJECT_KEY, "")
+    if not tier or not key:
+        return False
+    try:
+        tiers.client(tier).delete(key)
+        return True
+    except Exception:  # noqa: BLE001 — best-effort remote cleanup
+        return False
+
+
+class _StrictSizeReader(IterStream):
+    """Iterator reader that REFUSES to end short: a truncated tier
+    stream must abort the local put (which rolls back through the
+    engine's tmp cleanup) instead of committing a short restored copy
+    over the stub."""
+
+    def __init__(self, it, expected: int):
+        super().__init__(it)
+        self.expected = expected
+        self._got = 0
+
+    def read(self, n: int = -1) -> bytes:
+        out = super().read(n)
+        self._got += len(out)
+        if not out and 0 <= self._got < self.expected:
+            raise TierClientError(
+                f"short tier read: {self._got} of {self.expected} bytes")
+        return out
+
+
+class TransitionWorker:
+    """Bounded background queue moving object versions to remote tiers.
+
+    Entries dedup on (bucket, object, version) while queued; overflow
+    drops the hint (the next crawler pass re-finds the object). One
+    daemon drains entries through :meth:`_move`, throttled by the
+    shared foreground-pressure probe."""
+
+    def __init__(self, object_layer, tiers: TierManager,
+                 maxsize: Optional[int] = None,
+                 busy_fn=None, throttle_s: Optional[float] = None):
+        self.obj = object_layer
+        self.tiers = tiers
+        self.maxsize = QUEUE_SIZE if maxsize is None else maxsize
+        self._pressure = ForegroundPressure(object_layer, busy_fn=busy_fn)
+        self._throttle_base = BACKOFF_S if throttle_s is None \
+            else throttle_s
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._pending: set[tuple[str, str, str]] = set()
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # stats (admin surface / tests)
+        self.queued = 0
+        self.moved = 0
+        self.failed = 0
+        self.skipped = 0               # object changed/vanished under us
+        self.dropped = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "TransitionWorker":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tier-transition")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- producer ----------------------------------------------------------
+
+    def enqueue(self, bucket: str, name: str, version_id: str,
+                tier: str, etag: str = "") -> bool:
+        key = (bucket, name, version_id)
+        with self._cond:
+            if self._stop.is_set() or key in self._pending:
+                return False
+            if len(self._queue) >= self.maxsize:
+                self.dropped += 1
+                return False
+            self._pending.add(key)
+            self._queue.append((bucket, name, version_id, tier, etag))
+            self.queued += 1
+            self._cond.notify_all()
+            return True
+
+    # -- observability -----------------------------------------------------
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._queue) + self._inflight
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"pending": len(self._queue) + self._inflight,
+                    "queued": self.queued, "moved": self.moved,
+                    "failed": self.failed, "skipped": self.skipped,
+                    "dropped": self.dropped}
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until every queued entry finished (moved, failed, or
+        skipped). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    return not (self._queue or self._inflight)
+                self._cond.wait(remaining)
+        return True
+
+    # -- consumer ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop.is_set() and not self._queue:
+                    self._cond.wait()
+                if self._stop.is_set():
+                    return
+                bucket, name, vid, tier, etag = self._queue.popleft()
+                self._pending.discard((bucket, name, vid))
+                self._inflight += 1
+            try:
+                self._pressure.throttle(self._stop, self._throttle_base,
+                                        BACKOFF_MAX_S, BACKOFF_TRIES)
+                if self._stop.is_set():
+                    return
+                self._move_one(bucket, name, vid, tier, etag)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _move_one(self, bucket: str, name: str, vid: str, tier: str,
+                  etag: str) -> None:
+        objects_c, bytes_c, failed_c, _ = _metrics()
+        with telemetry.trace("tier.transition", bucket=bucket,
+                             object=name, tier=tier):
+            try:
+                moved = self._move(bucket, name, vid, tier, etag)
+            except (api_errors.ObjectNotFound,
+                    api_errors.VersionNotFound,
+                    api_errors.MethodNotAllowed,
+                    api_errors.InvalidObjectState,
+                    api_errors.PreConditionFailed):
+                # deleted / markered / already-tiered / overwritten
+                # since the scan: converged, nothing to do
+                with self._cond:
+                    self.skipped += 1
+            except Exception:  # noqa: BLE001 — per-object isolation
+                with self._cond:
+                    self.failed += 1
+                failed_c.inc(tier=tier)
+                # heal-first: a degraded source heals through MRF, the
+                # next crawler pass retries the transition
+                _mrf_enqueue(self.obj, bucket, name)
+            else:
+                if moved < 0:
+                    with self._cond:
+                        self.skipped += 1
+                else:
+                    with self._cond:
+                        self.moved += 1
+                    objects_c.inc(tier=tier)
+                    bytes_c.inc(moved, tier=tier)
+
+    def _move(self, bucket: str, name: str, vid: str, tier: str,
+              etag: str) -> int:
+        """Move ONE version's data to `tier`. Returns bytes moved, or
+        -1 when the object changed under us (skip, the crawler will
+        re-evaluate). Local shards are freed only after the remote
+        write verified — a crash anywhere before the stub rewrite
+        leaves the object fully readable locally."""
+        opts = GetOptions(version_id=vid)
+        info, stream = self.obj.get_object(bucket, name, opts=opts)
+        reader = IterStream(stream)
+        try:
+            md = info.user_defined or {}
+            if is_transitioned(md):
+                return -1               # already tiered (or restored)
+            if not vid and etag and info.etag != etag:
+                return -1               # overwritten since the scan
+            client = self.tiers.client(tier)
+            remote_key = self.tiers.remote_key(bucket, name,
+                                               info.version_id)
+            remote_version = client.put(remote_key, reader, info.size)
+        finally:
+            reader.close()
+        # verify the remote copy BEFORE the stub rewrite frees local
+        # shards: a tier that lied about the write must not eat data
+        got = client.head(remote_key)
+        if got != info.size:
+            try:
+                client.delete(remote_key)
+            except TierClientError:
+                pass
+            raise TierClientError(
+                f"remote verify failed: {got} != {info.size} bytes")
+        try:
+            # etag+mod_time pin the version identity INSIDE the commit's
+            # write lock: an unversioned object overwritten while the
+            # upload ran must abort (PreConditionFailed), not stub the
+            # new data over the old remote copy
+            self.obj.transition_object(
+                bucket, name, version_id=info.version_id, tier=tier,
+                remote_object=remote_key, remote_version=remote_version,
+                expect_etag=info.etag, expect_mod_time=info.mod_time)
+        except api_errors.ObjectApiError:
+            # stub rewrite failed or refused: the object is still fully
+            # local — free the orphaned remote copy, then surface
+            try:
+                client.delete(remote_key)
+            except TierClientError:
+                pass
+            raise
+        return info.size
+
+
+# ---------------------------------------------------------------------------
+# crawler actions (the DataUsageCrawler hooks)
+# ---------------------------------------------------------------------------
+
+def transition_action(bucket_meta_sys, worker: TransitionWorker,
+                      now_fn=time.time):
+    """Per-object crawler action: enqueue current versions whose
+    lifecycle Transition rule is due (expiry wins when both apply —
+    crawler_action runs first and deletes; this action re-checks so
+    ordering never transitions an object the same pass expires)."""
+    from ..features.lifecycle import Lifecycle
+
+    def act(bucket: str, oi) -> None:
+        bm = bucket_meta_sys.get(bucket)
+        if not bm.lifecycle_xml:
+            return
+        try:
+            lc = Lifecycle.cached(bm.lifecycle_xml)
+        except ET.ParseError:
+            return
+        md = oi.user_defined or {}
+        if is_transitioned(md):
+            return                      # already tiered / restored copy
+        now = now_fn()
+        tier = lc.transition_due(oi.name, oi.mod_time, now)
+        if tier:
+            worker.enqueue(bucket, oi.name, oi.version_id, tier,
+                           etag=oi.etag)
+
+    return act
+
+
+def noncurrent_transition_action(bucket_meta_sys,
+                                 worker: TransitionWorker,
+                                 now_fn=time.time):
+    """Per-bucket crawler action enforcing NoncurrentVersionTransition
+    over a paginated version walk (the noncurrent_sweep_action shape:
+    a version's clock starts when it BECAME noncurrent — its
+    successor's mod time)."""
+    from ..features.lifecycle import Lifecycle
+
+    def act(bucket: str) -> None:
+        bm = bucket_meta_sys.get(bucket)
+        if not bm.lifecycle_xml:
+            return
+        try:
+            lc = Lifecycle.cached(bm.lifecycle_xml)
+        except ET.ParseError:
+            return
+        if not any(r.enabled and r.noncurrent_transition_days
+                   and r.noncurrent_transition_tier for r in lc.rules):
+            return
+        now = now_fn()
+        marker = ""
+        while True:
+            try:
+                versions = worker.obj.list_object_versions(
+                    bucket, "", marker, 1000)
+            except api_errors.ObjectApiError:
+                return
+            if not versions:
+                return
+            full_page = len(versions) >= 1000
+            names = sorted({v.name for v in versions})
+            if full_page and len(names) > 1:
+                # the page may have cut the LAST key's version list
+                # short (treating its continuation's first entry as
+                # "current" would mis-clock every later version): hold
+                # that key for the next page (the rebalancer's
+                # page-group rule)
+                cut = names.pop()
+                versions = [v for v in versions if v.name != cut]
+                marker = names[-1]
+            else:
+                marker = versions[-1].name
+            by_name: dict[str, list] = {}
+            for v in versions:
+                by_name.setdefault(v.name, []).append(v)
+            for name, vs in by_name.items():
+                days, tier = lc.noncurrent_transition(name)
+                if not days or not tier:
+                    continue
+                vs.sort(key=lambda v: -v.mod_time)
+                for i in range(1, len(vs)):     # index 0 = current
+                    v = vs[i]
+                    if v.delete_marker or \
+                            is_transitioned(v.user_defined or {}):
+                        continue
+                    became_noncurrent = vs[i - 1].mod_time
+                    if became_noncurrent < now - days * 86400:
+                        worker.enqueue(bucket, name, v.version_id, tier,
+                                       etag=v.etag)
+            if not full_page:
+                return
+
+    return act
+
+
+def restore_reclaim_action(object_layer, tiers: TierManager,
+                           now_fn=time.time):
+    """Per-object crawler action reclaiming EXPIRED restored copies:
+    the local data is freed and the version returns to its zero-data
+    stub (the remote copy was never touched by the restore, so no
+    re-upload happens)."""
+
+    def act(bucket: str, oi) -> None:
+        md = oi.user_defined or {}
+        if not is_transitioned(md) or not is_restored(md):
+            return
+        try:
+            expiry = float(md.get(RESTORE_EXPIRY_KEY, 0) or 0)
+        except ValueError:
+            expiry = 0.0
+        if not expiry or expiry > now_fn():
+            return
+        with telemetry.trace("tier.reclaim", bucket=bucket,
+                             object=oi.name):
+            try:
+                object_layer.transition_object(
+                    bucket, oi.name, version_id=oi.version_id,
+                    tier=md.get(TRANSITION_TIER_KEY, ""),
+                    remote_object=md.get(TRANSITIONED_OBJECT_KEY, ""),
+                    remote_version=md.get(TRANSITIONED_VERSION_KEY, ""),
+                    # identity pin: an unversioned restored copy
+                    # overwritten since the scan must NOT be re-stubbed
+                    # over the stale remote pointer
+                    expect_etag=oi.etag)
+            except api_errors.ObjectApiError:
+                pass                    # next pass retries
+
+    return act
+
+
+# ---------------------------------------------------------------------------
+# RestoreObject (POST ?restore)
+# ---------------------------------------------------------------------------
+
+def _http_date(t: float) -> str:
+    from email.utils import formatdate
+    return formatdate(t, usegmt=True)
+
+
+def restore_object(object_layer, tiers: TierManager, bucket: str,
+                   name: str, version_id: str = "", days: int = 1,
+                   now_fn=time.time) -> dict:
+    """Pull a transitioned version back as an expiring local copy.
+
+    Returns {"status": "restored"|"updated", "expiry": ts}. The
+    restored copy keeps its version id, mod time and etag (the put
+    rides PutOptions.mod_time like a rebalance move), plus the
+    ``x-amz-restore`` header state and the absolute expiry the reclaim
+    sweep reads. Raises InvalidObjectState when the version was never
+    transitioned."""
+    if days < 1:
+        raise api_errors.InvalidObjectState("restore Days must be >= 1")
+    opts = GetOptions(version_id=version_id)
+    info = object_layer.get_object_info(bucket, name, opts)
+    md = dict(info.user_defined or {})
+    if not is_transitioned(md):
+        raise api_errors.InvalidObjectState(
+            f"{bucket}/{name} is not in a remote tier")
+    now = now_fn()
+    expiry = now + days * 86400
+    restore_val = (f'ongoing-request="false", '
+                   f'expiry-date="{_http_date(expiry)}"')
+    if is_restored(md):
+        # already local: just extend the expiry window (S3 semantics:
+        # 200 OK, restore period updated)
+        md[RESTORE_KEY] = restore_val
+        md[RESTORE_EXPIRY_KEY] = str(expiry)
+        md["etag"] = info.etag
+        if info.content_type:
+            md["content-type"] = info.content_type
+        object_layer.update_object_metadata(bucket, name, md,
+                                            version_id=version_id)
+        return {"status": "updated", "expiry": expiry}
+
+    tier = md.get(TRANSITION_TIER_KEY, "")
+    remote_key = md.get(TRANSITIONED_OBJECT_KEY, "")
+    client = tiers.client(tier)
+    with telemetry.trace("tier.restore", bucket=bucket, object=name,
+                         tier=tier):
+        try:
+            stream = client.get(remote_key)
+        except TierObjectNotFound:
+            raise api_errors.InvalidObjectState(
+                f"remote copy of {bucket}/{name} is gone") from None
+        metadata = dict(md)
+        metadata["etag"] = info.etag
+        if info.content_type:
+            metadata["content-type"] = info.content_type
+        if info.content_encoding:
+            metadata["content-encoding"] = info.content_encoding
+        metadata[RESTORE_KEY] = restore_val
+        metadata[RESTORE_EXPIRY_KEY] = str(expiry)
+        reader = _StrictSizeReader(stream, info.size)
+        put_opts = PutOptions(metadata=metadata,
+                              version_id=info.version_id,
+                              versioned=bool(info.version_id),
+                              mod_time=info.mod_time)
+        try:
+            object_layer.put_object(bucket, name, reader, info.size,
+                                    put_opts)
+        finally:
+            reader.close()
+    _, _, _, restored_c = _metrics()
+    restored_c.inc(tier=tier)
+    return {"status": "restored", "expiry": expiry}

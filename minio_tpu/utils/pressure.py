@@ -1,0 +1,61 @@
+"""Foreground-pressure probe shared by the background movers.
+
+Both the pool rebalancer (object/rebalance.py) and the tier transition
+worker (tier/transition.py) must yield to foreground traffic: they
+back off whenever the live ``BatchScheduler`` shows queued encode
+blocks or the shared ``BytePool`` staging rings report fresh waits —
+the same two signals the admission plane sheds on. This is the single
+home of that probe so the two movers cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from . import backoff_delay
+
+
+class ForegroundPressure:
+    """Samples scheduler occupancy + staging-ring waits of an object
+    layer (ErasureServerSets, ErasureSets, or anything with ``sets``).
+
+    ``busy_fn`` overrides the probe entirely (tests / custom gating).
+    """
+
+    def __init__(self, object_layer,
+                 busy_fn: Optional[Callable[[], bool]] = None):
+        self.obj = object_layer
+        self._busy_fn = busy_fn
+        self._last_pool_waits: Optional[int] = None
+
+    def _layers(self):
+        return getattr(self.obj, "server_sets", None) or [self.obj]
+
+    def busy(self) -> bool:
+        """True when foreground traffic is visibly queued: any engine's
+        scheduler has blocks waiting for a device batch, or the staging
+        BytePool accumulated NEW waits since the last sample."""
+        if self._busy_fn is not None:
+            return bool(self._busy_fn())
+        queued = 0
+        for z in self._layers():
+            for eng in getattr(z, "sets", ()) or ():
+                sched = getattr(eng, "scheduler", None)
+                if sched is not None:
+                    queued += sched.stats()["queued_blocks"]
+        if queued > 0:
+            return True
+        from ..parallel import pipeline
+        waits = pipeline.pool_pressure()["waits"]
+        last, self._last_pool_waits = self._last_pool_waits, waits
+        return last is not None and waits > last
+
+    def throttle(self, stop_event, base_s: float, max_s: float,
+                 tries: int) -> None:
+        """Back off while busy, up to `tries` capped-exponential waits;
+        after the cap, proceed anyway (a permanently-loaded cluster must
+        still make background progress, just at the slow cadence)."""
+        for attempt in range(tries):
+            if stop_event.is_set() or not self.busy():
+                return
+            stop_event.wait(backoff_delay(base_s, max_s, attempt))

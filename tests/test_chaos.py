@@ -436,3 +436,149 @@ def test_chaos_randomized_full_mix(tmp_path, base_seed):
         assert_converged(sets, datas, drain_timeout=60.0)
     finally:
         sets.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-plane chaos: NaughtyTierClient faults through transition/restore
+# ---------------------------------------------------------------------------
+
+def _tier_env(tmp_path, **worker_kw):
+    from minio_tpu.tier.client import FSTierClient, NaughtyTierClient
+    from minio_tpu.tier.config import TierConfig, TierManager
+    from minio_tpu.tier.transition import TransitionWorker
+    sets = ErasureSets.from_drives(
+        [str(tmp_path / f"d{i}") for i in range(NDISKS)], 1, NDISKS, M,
+        block_size=BLOCK, mrf_options=MRF_TEST_OPTIONS)
+    sets.make_bucket("b")
+    tiers = TierManager(sets)
+    tiers.add(TierConfig("cold", "fs", {"path": str(tmp_path / "tier")}))
+    naughty = NaughtyTierClient(FSTierClient(str(tmp_path / "tier")))
+    tiers.set_client("cold", naughty)
+    worker = TransitionWorker(sets, tiers, busy_fn=lambda: False,
+                              **worker_kw)
+    return sets, tiers, naughty, worker
+
+
+def test_chaos_failed_transition_lands_in_mrf_and_retries(tmp_path):
+    """A tier that 5xxes the upload: the transition fails, the object
+    stays fully readable locally, the failure lands in the MRF queue,
+    and a retry after the tier recovers succeeds."""
+    from minio_tpu.tier.client import TierClientError
+    sets, tiers, naughty, worker = _tier_env(tmp_path)
+    worker.start()
+    body = payload(150_000)
+    info = sets.put_object("b", "obj", body)
+
+    naughty.fail_verbs["put"] = TierClientError("upstream 503")
+    worker.enqueue("b", "obj", "", "cold", etag=info.etag)
+    assert worker.drain(30), worker.stats()
+    assert worker.stats()["failed"] == 1
+    # failure fed the MRF queue (heal-first), and the object is intact
+    assert sets.mrf.queued >= 1
+    assert sets.drain_mrf(10)
+    _, stream = sets.get_object("b", "obj")
+    assert b"".join(stream) == body
+
+    # tier recovers: the retry (next crawler pass re-finds it) succeeds
+    naughty.clear_faults()
+    worker.enqueue("b", "obj", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    assert worker.stats()["moved"] == 1
+    from minio_tpu.object import api_errors
+    with pytest.raises(api_errors.InvalidObjectState):
+        sets.get_object("b", "obj")
+    worker.close()
+    sets.close()
+
+
+def test_chaos_mid_transition_crash_leaves_object_readable(tmp_path):
+    """A 'crash' between the remote upload and the stub rewrite (the
+    verify head fails, so the commit never happens): the object stays
+    fully readable locally and the orphaned remote copy was freed."""
+    from minio_tpu.tier.client import TierClientError
+    sets, tiers, naughty, worker = _tier_env(tmp_path)
+    worker.start()
+    body = payload(120_000, seed=11)
+    info = sets.put_object("b", "crash", body)
+
+    # upload succeeds, then the worker dies before the stub rewrite
+    # (head raising models the process losing the tier mid-commit)
+    naughty.fail_verbs["head"] = TierClientError("conn reset")
+    worker.enqueue("b", "crash", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    assert worker.stats()["failed"] == 1
+    assert naughty.calls["put"] == 1        # the upload DID happen
+    _, stream = sets.get_object("b", "crash")
+    assert b"".join(stream) == body          # fully readable locally
+    # no orphaned metadata: the version is still a plain local object
+    from minio_tpu.storage import datatypes as dt
+    assert not dt.is_transitioned(
+        sets.get_object_info("b", "crash").user_defined)
+    worker.close()
+    sets.close()
+
+
+def test_chaos_short_read_on_restore_keeps_stub(tmp_path):
+    """A tier stream that truncates mid-restore: the local put aborts
+    (no short copy committed over the stub), the object still answers
+    InvalidObjectState, and a clean retry restores the full bytes."""
+    from minio_tpu.object import api_errors
+    from minio_tpu.tier.client import TierClientError
+    from minio_tpu.tier.transition import restore_object
+    sets, tiers, naughty, worker = _tier_env(tmp_path)
+    worker.start()
+    body = payload(200_000, seed=23)
+    info = sets.put_object("b", "trunc", body)
+    worker.enqueue("b", "trunc", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    assert worker.stats()["moved"] == 1
+
+    naughty.short_read_verbs = ("get",)
+    with pytest.raises(TierClientError):
+        restore_object(sets, tiers, "b", "trunc")
+    assert naughty.stats["short_reads"] >= 1
+    # the stub survived the failed restore
+    with pytest.raises(api_errors.InvalidObjectState):
+        sets.get_object("b", "trunc")
+
+    naughty.clear_faults()
+    restore_object(sets, tiers, "b", "trunc")
+    oi, stream = sets.get_object("b", "trunc")
+    assert b"".join(stream) == body
+    assert oi.etag == info.etag
+    worker.close()
+    sets.close()
+
+
+def test_chaos_transition_with_naughty_source_drives(tmp_path):
+    """Faulted SOURCE drives (<= parity) under the transition read: the
+    engine's reconstructing GET feeds the tier the correct bytes, and
+    the restored object round-trips byte-identical."""
+    from minio_tpu.tier.client import FSTierClient
+    from minio_tpu.tier.config import TierConfig, TierManager
+    from minio_tpu.tier.transition import TransitionWorker, restore_object
+    from minio_tpu.object import api_errors
+    seed = chaos_seed(4242)
+    announce(seed)
+    sets, naughties = make_chaos_sets(
+        tmp_path, {0: FaultSchedule(seed=seed, error_rate=0.15),
+                   1: FaultSchedule(seed=seed + 1, bitrot_rate=0.05)})
+    body = payload(180_000, seed=seed & 0xFF)
+    info = sets.put_object("b", "faulty", body)
+    for nd in naughties:
+        nd.arm()
+    tiers = TierManager(sets)
+    tiers.add(TierConfig("cold", "fs", {"path": str(tmp_path / "tier")}))
+    worker = TransitionWorker(sets, tiers, busy_fn=lambda: False).start()
+    worker.enqueue("b", "faulty", "", "cold", etag=info.etag)
+    assert worker.drain(60), worker.stats()
+    assert worker.stats()["moved"] == 1
+    with pytest.raises(api_errors.InvalidObjectState):
+        sets.get_object("b", "faulty")
+    restore_object(sets, tiers, "b", "faulty")
+    _, stream = sets.get_object("b", "faulty")
+    assert b"".join(stream) == body
+    for nd in naughties:
+        nd.disarm()
+    worker.close()
+    sets.close()

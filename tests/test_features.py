@@ -298,3 +298,113 @@ def test_stale_multipart_abort(tmp_path):
                      now_fn=lambda: now + 4 * 86400)("mab")
     assert sets.list_multipart_uploads("mab") == []
     sets.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle Transition / NoncurrentVersionTransition parsing (ILM tiering)
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_transition_parse_days_and_storage_class():
+    lc = Lifecycle.from_xml("""<LifecycleConfiguration>
+      <Rule><ID>t1</ID><Status>Enabled</Status><Prefix>logs/</Prefix>
+        <Transition><Days>30</Days><StorageClass>GLACIER</StorageClass>
+        </Transition>
+        <NoncurrentVersionTransition><NoncurrentDays>7</NoncurrentDays>
+          <StorageClass>DEEP</StorageClass>
+        </NoncurrentVersionTransition>
+      </Rule>
+    </LifecycleConfiguration>""")
+    r = lc.rules[0]
+    assert r.transition_days == 30
+    assert r.transition_tier == "GLACIER"
+    assert r.noncurrent_transition_days == 7
+    assert r.noncurrent_transition_tier == "DEEP"
+    now = time.time()
+    # not due before 30 days, due after; prefix must match
+    assert lc.transition_due("logs/a", now - 10 * 86400, now) == ""
+    assert lc.transition_due("logs/a", now - 31 * 86400, now) == "GLACIER"
+    assert lc.transition_due("other/a", now - 31 * 86400, now) == ""
+    assert lc.noncurrent_transition("logs/a") == (7, "DEEP")
+    assert lc.noncurrent_transition("other/a") == (0, "")
+
+
+def test_lifecycle_transition_parse_date():
+    lc = Lifecycle.from_xml("""<LifecycleConfiguration>
+      <Rule><Status>Enabled</Status><Prefix></Prefix>
+        <Transition><Date>2020-01-01T00:00:00Z</Date>
+          <StorageClass>cold</StorageClass></Transition>
+      </Rule>
+    </LifecycleConfiguration>""")
+    r = lc.rules[0]
+    assert r.transition_date > 0 and r.transition_days == 0
+    # the date is long past: any object is due regardless of age
+    assert lc.transition_due("k", time.time(), time.time()) == "cold"
+
+
+def test_lifecycle_transition_namespaced_xml():
+    ns = "http://s3.amazonaws.com/doc/2006-03-01/"
+    lc = Lifecycle.from_xml(
+        f'<LifecycleConfiguration xmlns="{ns}">'
+        "<Rule><Status>Enabled</Status><Prefix></Prefix>"
+        "<Transition><Days>1</Days><StorageClass>tz</StorageClass>"
+        "</Transition></Rule></LifecycleConfiguration>")
+    assert lc.rules[0].transition_tier == "tz"
+    assert lc.rules[0].transition_days == 1
+
+
+def test_lifecycle_transition_precedence_vs_expiry():
+    """Expiry wins when both are due (transition_due answers "" — the
+    reference's ComputeAction precedence: never upload data the same
+    pass deletes)."""
+    lc = Lifecycle.from_xml("""<LifecycleConfiguration>
+      <Rule><Status>Enabled</Status><Prefix></Prefix>
+        <Expiration><Days>5</Days></Expiration>
+        <Transition><Days>1</Days><StorageClass>cold</StorageClass>
+        </Transition>
+      </Rule>
+    </LifecycleConfiguration>""")
+    now = time.time()
+    # only the transition is due: transition wins
+    assert lc.transition_due("k", now - 2 * 86400, now) == "cold"
+    # both due: expiry wins
+    assert lc.transition_due("k", now - 6 * 86400, now) == ""
+    assert lc.is_expired("k", now - 6 * 86400, now)
+
+
+def test_lifecycle_transition_disabled_and_tierless_rules_ignored():
+    lc = Lifecycle.from_xml("""<LifecycleConfiguration>
+      <Rule><Status>Disabled</Status><Prefix></Prefix>
+        <Transition><Days>1</Days><StorageClass>cold</StorageClass>
+        </Transition></Rule>
+      <Rule><Status>Enabled</Status><Prefix></Prefix>
+        <Transition><Days>1</Days></Transition></Rule>
+    </LifecycleConfiguration>""")
+    now = time.time()
+    # disabled rule + rule with no StorageClass: nothing actionable
+    assert lc.transition_due("k", now - 9 * 86400, now) == ""
+
+
+def test_lifecycle_malformed_xml_raises():
+    import xml.etree.ElementTree as ET
+    with pytest.raises(ET.ParseError):
+        Lifecycle.from_xml("<LifecycleConfiguration><Rule>")
+    with pytest.raises(ValueError):
+        Lifecycle.from_xml("""<LifecycleConfiguration>
+          <Rule><Status>Enabled</Status><Prefix></Prefix>
+            <Transition><Days>NaN</Days>
+              <StorageClass>c</StorageClass></Transition>
+          </Rule></LifecycleConfiguration>""")
+
+
+def test_lifecycle_noncurrent_transition_strictest_rule_wins():
+    lc = Lifecycle.from_xml("""<LifecycleConfiguration>
+      <Rule><Status>Enabled</Status><Prefix></Prefix>
+        <NoncurrentVersionTransition><NoncurrentDays>30</NoncurrentDays>
+          <StorageClass>warm</StorageClass>
+        </NoncurrentVersionTransition></Rule>
+      <Rule><Status>Enabled</Status><Prefix></Prefix>
+        <NoncurrentVersionTransition><NoncurrentDays>7</NoncurrentDays>
+          <StorageClass>cold</StorageClass>
+        </NoncurrentVersionTransition></Rule>
+    </LifecycleConfiguration>""")
+    assert lc.noncurrent_transition("any") == (7, "cold")

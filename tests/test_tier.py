@@ -1,0 +1,701 @@
+"""Tiering-plane tests: tier registry persistence, the transition
+worker (remote move + zero-data stub + local shard reclaim), the
+InvalidObjectState read gate, RestoreObject round trips (etag/version
+fidelity), restore-expiry reclaim, noncurrent transitions, and the
+admin/S3 HTTP surface — including the end-to-end acceptance flow
+PUT → crawler transition → InvalidObjectState → restore → identical
+bytes → expiry reclaim."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from minio_tpu.object import api_errors
+from minio_tpu.object.background import DataUsageCrawler
+from minio_tpu.object.engine import GetOptions, PutOptions
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.server_sets import ErasureServerSets
+from minio_tpu.storage import datatypes as dt
+from minio_tpu.tier.client import (FSTierClient, TierClientError,
+                                   TierObjectNotFound)
+from minio_tpu.tier.config import TierConfig, TierConfigError, TierManager
+from minio_tpu.tier.transition import (TransitionWorker, free_remote,
+                                       noncurrent_transition_action,
+                                       restore_object,
+                                       restore_reclaim_action,
+                                       transition_action)
+
+BLOCK = 1 << 16
+DAY = 86400
+NEVER_BUSY = dict(busy_fn=lambda: False)
+
+LC_TRANSITION = """<LifecycleConfiguration>
+  <Rule><ID>t</ID><Status>Enabled</Status><Prefix></Prefix>
+    <Transition><Days>1</Days><StorageClass>cold</StorageClass>
+    </Transition></Rule>
+</LifecycleConfiguration>"""
+
+
+def make_sets(tmp_path, tag: str = "p0", drives: int = 4,
+              **kw) -> ErasureSets:
+    return ErasureSets.from_drives(
+        [str(tmp_path / f"{tag}d{i}") for i in range(drives)], 1,
+        drives, 2, block_size=BLOCK, **kw)
+
+
+class FakeBucketMeta:
+    """bucket_meta_sys stub: one lifecycle XML for every bucket."""
+
+    def __init__(self, lifecycle_xml: str = "", versioned: bool = False):
+        self.lifecycle_xml = lifecycle_xml
+        self._versioned = versioned
+
+    def get(self, bucket):
+        return self
+
+    def versioning_enabled(self) -> bool:
+        return self._versioned
+
+
+@pytest.fixture()
+def env(tmp_path):
+    sets = make_sets(tmp_path, enable_mrf=False)
+    zz = ErasureServerSets([sets])
+    zz.make_bucket("b")
+    tiers = TierManager(zz)
+    tiers.add(TierConfig("cold", "fs", {"path": str(tmp_path / "tier")}))
+    worker = TransitionWorker(zz, tiers, **NEVER_BUSY).start()
+    yield zz, tiers, worker, tmp_path
+    worker.close()
+    zz.close()
+
+
+# ---------------------------------------------------------------------------
+# tier clients
+# ---------------------------------------------------------------------------
+
+def test_fs_client_round_trip(tmp_path):
+    c = FSTierClient(str(tmp_path / "t"))
+    etag = c.put("b/o/v1/abc", io.BytesIO(b"x" * 1000), 1000)
+    assert etag
+    assert c.head("b/o/v1/abc") == 1000
+    assert b"".join(c.get("b/o/v1/abc")) == b"x" * 1000
+    assert b"".join(c.get("b/o/v1/abc", offset=10, length=5)) == b"xxxxx"
+    c.delete("b/o/v1/abc")
+    with pytest.raises(TierObjectNotFound):
+        c.head("b/o/v1/abc")
+    c.delete("b/o/v1/abc")          # idempotent
+
+
+def test_fs_client_refuses_short_write(tmp_path):
+    c = FSTierClient(str(tmp_path / "t"))
+    with pytest.raises(TierClientError):
+        c.put("k", io.BytesIO(b"short"), 1000)
+    # the staged tmp never became the object
+    with pytest.raises(TierObjectNotFound):
+        c.head("k")
+
+
+def test_fs_client_rejects_escaping_keys(tmp_path):
+    c = FSTierClient(str(tmp_path / "t"))
+    with pytest.raises(TierClientError):
+        c.put("../../etc/shadow", io.BytesIO(b"x"), 1)
+
+
+# ---------------------------------------------------------------------------
+# tier registry persistence
+# ---------------------------------------------------------------------------
+
+def test_tier_config_persists_across_pools_highest_epoch(tmp_path):
+    zz = ErasureServerSets([make_sets(tmp_path, "p0", enable_mrf=False),
+                            make_sets(tmp_path, "p1", enable_mrf=False)])
+    try:
+        tiers = TierManager(zz)
+        tiers.add(TierConfig("cold", "fs",
+                             {"path": str(tmp_path / "t1")}))
+        tiers.add(TierConfig("ice", "fs",
+                             {"path": str(tmp_path / "t2")}))
+        assert tiers.epoch == 2
+
+        # a fresh manager over the same pools recovers the registry
+        t2 = TierManager(zz)
+        assert t2.load()
+        assert t2.epoch == 2
+        assert {t["name"] for t in t2.list()} == {"cold", "ice"}
+
+        # highest epoch wins when one pool holds a stale doc
+        from minio_tpu.storage.xl_storage import MINIO_META_BUCKET
+        from minio_tpu.tier.config import TIER_CONFIG_OBJECT
+        stale = {"epoch": 1, "tiers": [{"name": "old", "type": "fs",
+                                        "params": {"path": "/x"}}]}
+        zz.server_sets[1].put_object(MINIO_META_BUCKET,
+                                     TIER_CONFIG_OBJECT,
+                                     json.dumps(stale).encode())
+        t3 = TierManager(zz)
+        assert t3.load()
+        assert t3.epoch == 2 and "cold" in t3.tiers
+    finally:
+        zz.close()
+
+
+def test_tier_registry_crud_rules(env):
+    zz, tiers, _, tmp_path = env
+    with pytest.raises(TierConfigError):
+        tiers.add(TierConfig("cold", "fs",
+                             {"path": str(tmp_path / "dup")}))
+    tiers.add(TierConfig("cold", "fs", {"path": str(tmp_path / "dup")}),
+              update=True)
+    with pytest.raises(api_errors.TierNotFound):
+        tiers.remove("nope")
+    with pytest.raises(TierConfigError):
+        tiers.add(TierConfig("bad", "fs", {}))        # fs needs path
+    with pytest.raises(TierConfigError):
+        tiers.add(TierConfig("bad", "wat", {}))       # unknown type
+    # secrets are redacted in listings
+    tiers.add(TierConfig("remote", "s3",
+                         {"host": "h", "bucket": "b",
+                          "access_key": "AK", "secret_key": "SECRET"}))
+    listed = {t["name"]: t for t in tiers.list()}
+    assert listed["remote"]["params"]["secret_key"] == "REDACTED"
+    assert listed["remote"]["params"]["access_key"] == "AK"
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end acceptance flow (engine level)
+# ---------------------------------------------------------------------------
+
+def test_e2e_transition_restore_reclaim(env):
+    """PUT → crawler transitions per lifecycle rule → GET returns
+    InvalidObjectState → RestoreObject → GET serves bytes identical to
+    the original (etag/version id preserved) → restore expiry reclaims
+    the local copy."""
+    zz, tiers, worker, tmp_path = env
+    payload = os.urandom(200_000)
+    info = zz.put_object("b", "obj", payload,
+                         opts=PutOptions(versioned=True))
+    orig_vid, orig_etag, orig_mt = info.version_id, info.etag, \
+        info.mod_time
+
+    # drive the REAL crawler action path, clock warped 2 days ahead so
+    # the Days=1 Transition rule is due
+    later = time.time() + 2 * DAY
+    crawler = DataUsageCrawler(
+        zz, persist=False,
+        actions=[transition_action(FakeBucketMeta(LC_TRANSITION),
+                                   worker, now_fn=lambda: later)])
+    crawler.scan_once()
+    assert worker.drain(30), worker.stats()
+    assert worker.stats()["moved"] == 1
+
+    # GET gates, HEAD still serves the stub's metadata
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "obj")
+    oi = zz.get_object_info("b", "obj")
+    assert oi.size == len(payload)
+    md = oi.user_defined
+    assert md[dt.TRANSITION_STATUS_KEY] == dt.TRANSITION_COMPLETE
+    assert md[dt.TRANSITION_TIER_KEY] == "cold"
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    assert tiers.client("cold").head(remote_key) == len(payload)
+
+    # local shards actually reclaimed: no data dirs remain under b/obj
+    for i in range(4):
+        objdir = tmp_path / f"p0d{i}" / "b" / "obj"
+        if objdir.exists():
+            assert sorted(p.name for p in objdir.iterdir()) \
+                == ["xl.meta"], list(objdir.iterdir())
+
+    # restore: identical bytes, same version id + etag + mod time
+    out = restore_object(zz, tiers, "b", "obj", days=1)
+    assert out["status"] == "restored"
+    oi2, stream = zz.get_object("b", "obj")
+    assert b"".join(stream) == payload
+    assert oi2.version_id == orig_vid
+    assert oi2.etag == orig_etag
+    assert oi2.mod_time == pytest.approx(orig_mt, abs=1e-6)
+    assert dt.is_restored(oi2.user_defined)
+
+    # a second restore only extends the window (no re-pull)
+    out2 = restore_object(zz, tiers, "b", "obj", days=7)
+    assert out2["status"] == "updated"
+    assert out2["expiry"] > out["expiry"]
+
+    # restore expiry reclaims the local copy: back to the stub
+    reclaim = restore_reclaim_action(zz, tiers,
+                                     now_fn=lambda: time.time() + 30 * DAY)
+    crawler2 = DataUsageCrawler(zz, persist=False, actions=[reclaim])
+    crawler2.scan_once()
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "obj")
+    # remote copy untouched; version id still intact
+    assert tiers.client("cold").head(remote_key) == len(payload)
+    assert zz.get_object_info("b", "obj").version_id == orig_vid
+
+
+def test_transition_skips_overwritten_object(env):
+    zz, tiers, _, _ = env
+    # a NOT-yet-started worker: the enqueue-time etag is guaranteed to
+    # predate the overwrite when the drain finally runs
+    frozen = TransitionWorker(zz, tiers, **NEVER_BUSY)
+    info = zz.put_object("b", "o", b"old" * 1000)
+    frozen.enqueue("b", "o", "", "cold", etag=info.etag)
+    zz.put_object("b", "o", b"new" * 2000)   # overwrite before the move
+    frozen.start()
+    assert frozen.drain(30)
+    assert frozen.stats()["skipped"] == 1
+    frozen.close()
+    _, stream = zz.get_object("b", "o")
+    assert b"".join(stream) == b"new" * 2000
+
+
+def test_transition_worker_dedups_and_bounds(env):
+    zz, tiers, worker, _ = env
+    worker.close()                  # frozen: entries stay queued
+    small = TransitionWorker(zz, tiers, maxsize=2, **NEVER_BUSY)
+    assert small.enqueue("b", "x", "", "cold")
+    assert not small.enqueue("b", "x", "", "cold")     # dedup
+    assert small.enqueue("b", "y", "", "cold")
+    assert not small.enqueue("b", "z", "", "cold")     # over maxsize
+    assert small.stats()["dropped"] == 1
+    small.close()
+
+
+def test_delete_frees_remote_copy(env):
+    zz, tiers, worker, _ = env
+    payload = b"c" * 50_000
+    info = zz.put_object("b", "gone", payload)
+    worker.enqueue("b", "gone", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    md = zz.get_object_info("b", "gone").user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    client = tiers.client("cold")
+    assert client.head(remote_key) == len(payload)
+    zz.delete_object("b", "gone")
+    assert free_remote(tiers, md)
+    with pytest.raises(TierObjectNotFound):
+        client.head(remote_key)
+
+
+def test_restore_requires_transitioned(env):
+    zz, tiers, _, _ = env
+    zz.put_object("b", "hot", b"h" * 100)
+    with pytest.raises(api_errors.InvalidObjectState):
+        restore_object(zz, tiers, "b", "hot")
+    with pytest.raises(api_errors.InvalidObjectState):
+        restore_object(zz, tiers, "b", "hot", days=0)
+
+
+def test_noncurrent_transition_action(env):
+    zz, tiers, worker, _ = env
+    old = zz.put_object("b", "v", b"old" * 500,
+                        opts=PutOptions(versioned=True))
+    time.sleep(0.01)
+    cur = zz.put_object("b", "v", b"new" * 500,
+                        opts=PutOptions(versioned=True))
+    lc = """<LifecycleConfiguration><Rule>
+      <Status>Enabled</Status><Prefix></Prefix>
+      <NoncurrentVersionTransition><NoncurrentDays>1</NoncurrentDays>
+        <StorageClass>cold</StorageClass></NoncurrentVersionTransition>
+    </Rule></LifecycleConfiguration>"""
+    act = noncurrent_transition_action(
+        FakeBucketMeta(lc), worker, now_fn=lambda: time.time() + 2 * DAY)
+    act("b")
+    assert worker.drain(30), worker.stats()
+    assert worker.stats()["moved"] == 1
+    # the CURRENT version still reads; the noncurrent one is a stub
+    _, stream = zz.get_object("b", "v")
+    assert b"".join(stream) == b"new" * 500
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "v", opts=GetOptions(version_id=old.version_id))
+    # and restores by version id
+    restore_object(zz, tiers, "b", "v", version_id=old.version_id)
+    _, stream = zz.get_object("b", "v",
+                              opts=GetOptions(version_id=old.version_id))
+    assert b"".join(stream) == b"old" * 500
+    assert cur.version_id != old.version_id
+
+
+def test_multipart_object_transitions_whole(env):
+    """A multipart object's parts all live under one data dir: the
+    stub rewrite reclaims every part and restore brings the full
+    concatenation back."""
+    zz, tiers, worker, _ = env
+    from minio_tpu.object.multipart import CompletePart
+    part = os.urandom(5 << 20)
+    uid = zz.new_multipart_upload("b", "mp")
+    etags = [zz.put_object_part("b", "mp", uid, n, part, len(part)).etag
+             for n in (1, 2)]
+    zz.complete_multipart_upload(
+        "b", "mp", uid, [CompletePart(i + 1, e)
+                         for i, e in enumerate(etags)])
+    info = zz.get_object_info("b", "mp")
+    worker.enqueue("b", "mp", "", "cold", etag=info.etag)
+    assert worker.drain(60)
+    assert worker.stats()["moved"] == 1
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "mp")
+    restore_object(zz, tiers, "b", "mp")
+    _, stream = zz.get_object("b", "mp")
+    assert b"".join(stream) == part + part
+
+
+# ---------------------------------------------------------------------------
+# expiry interplay
+# ---------------------------------------------------------------------------
+
+def test_expiry_wins_over_transition(env):
+    zz, tiers, worker, _ = env
+    lc = """<LifecycleConfiguration><Rule>
+      <Status>Enabled</Status><Prefix></Prefix>
+      <Expiration><Days>1</Days></Expiration>
+      <Transition><Days>1</Days><StorageClass>cold</StorageClass>
+      </Transition></Rule></LifecycleConfiguration>"""
+    zz.put_object("b", "both", b"x" * 1000)
+    later = time.time() + 2 * DAY
+    act = transition_action(FakeBucketMeta(lc), worker,
+                            now_fn=lambda: later)
+    act("b", zz.get_object_info("b", "both"))
+    assert worker.pending() == 0        # expiry takes precedence
+
+
+def test_expired_transitioned_object_frees_remote(env):
+    """Lifecycle expiry of an (unversioned) transitioned object deletes
+    the remote copy too (crawler_action's tier hook)."""
+    from minio_tpu.features.lifecycle import crawler_action
+    zz, tiers, worker, _ = env
+    info = zz.put_object("b", "exp", b"e" * 10_000)
+    worker.enqueue("b", "exp", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    md = zz.get_object_info("b", "exp").user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    lc = """<LifecycleConfiguration><Rule>
+      <Status>Enabled</Status><Prefix></Prefix>
+      <Expiration><Days>1</Days></Expiration>
+    </Rule></LifecycleConfiguration>"""
+    act = crawler_action(FakeBucketMeta(lc), zz,
+                         now_fn=lambda: time.time() + 2 * DAY,
+                         tiers=tiers)
+    act("b", zz.get_object_info("b", "exp"))
+    with pytest.raises(api_errors.ObjectNotFound):
+        zz.get_object_info("b", "exp")
+    with pytest.raises(TierObjectNotFound):
+        tiers.client("cold").head(remote_key)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: admin tier CRUD + RestoreObject + headers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_env(tmp_path_factory):
+    from minio_tpu.iam import IAMSys
+    from minio_tpu.s3.admin import mount_admin
+    from minio_tpu.s3.server import S3Server
+    from tests.test_s3 import CREDS, REGION, S3TestClient
+    root = tmp_path_factory.mktemp("tierdrives")
+    drives = [str(root / f"d{i}") for i in range(4)]
+    sets = ErasureSets.from_drives(drives, set_count=1,
+                                   set_drive_count=4, parity=2,
+                                   block_size=BLOCK)
+    iam = IAMSys(sets, root_cred=CREDS)
+    srv = S3Server(sets, creds=CREDS, region=REGION, iam=iam).start()
+    mount_admin(srv)
+    tiers = TierManager(sets)
+    srv.api.tiers = tiers
+    worker = TransitionWorker(sets, tiers, busy_fn=lambda: False).start()
+    client = S3TestClient("127.0.0.1", srv.port)
+    yield srv, client, tiers, worker, root
+    worker.close()
+    srv.stop()
+    sets.close()
+
+
+def test_admin_tier_crud_http(http_env):
+    srv, client, tiers, _, root = http_env
+    status, _, _ = client.request(
+        "PUT", "/minio/admin/v3/tier",
+        body=json.dumps({"name": "http-cold", "type": "fs",
+                         "params": {"path": str(root / "ht")}}).encode())
+    assert status == 200
+    status, _, body = client.request("GET", "/minio/admin/v3/tier")
+    assert status == 200
+    doc = json.loads(body)
+    assert any(t["name"] == "http-cold" for t in doc["tiers"])
+    # duplicate add without force is a conflict
+    status, _, body = client.request(
+        "PUT", "/minio/admin/v3/tier",
+        body=json.dumps({"name": "http-cold", "type": "fs",
+                         "params": {"path": str(root / "ht")}}).encode())
+    assert status == 409, body
+    status, _, _ = client.request("DELETE", "/minio/admin/v3/tier",
+                                  query={"name": "http-cold"})
+    assert status == 200
+    status, _, _ = client.request("DELETE", "/minio/admin/v3/tier",
+                                  query={"name": "http-cold"})
+    assert status == 404
+
+
+def test_restore_object_http_flow(http_env):
+    srv, client, tiers, worker, root = http_env
+    tiers.add(TierConfig("cold", "fs", {"path": str(root / "t")}),
+              update=True)
+    status, _, _ = client.request("PUT", "/tierb")
+    assert status == 200
+    payload = os.urandom(120_000)
+    status, headers, _ = client.request("PUT", "/tierb/doc", body=payload)
+    assert status == 200
+    etag = headers["etag"]
+
+    worker.enqueue("tierb", "doc", "", "cold",
+                   etag=etag.strip('"'))
+    assert worker.drain(30), worker.stats()
+
+    # GET answers 403 InvalidObjectState; HEAD shows tier + no restore
+    status, _, body = client.request("GET", "/tierb/doc")
+    assert status == 403 and b"InvalidObjectState" in body
+    status, headers, _ = client.request("HEAD", "/tierb/doc")
+    assert status == 200
+    assert headers.get("x-amz-storage-class") == "cold"
+    assert "x-amz-restore" not in headers
+
+    # restore with a Days body; 202 on first, 200 on the extension
+    body_xml = (b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+    status, _, body = client.request("POST", "/tierb/doc",
+                                     query={"restore": ""},
+                                     body=body_xml)
+    assert status == 202, body
+    status, _, _ = client.request("POST", "/tierb/doc",
+                                  query={"restore": ""}, body=body_xml)
+    assert status == 200
+
+    status, headers, body = client.request("GET", "/tierb/doc")
+    assert status == 200
+    assert body == payload
+    assert headers["etag"] == etag
+    assert 'ongoing-request="false"' in headers.get("x-amz-restore", "")
+
+    # malformed restore XML is rejected
+    status, _, body = client.request("POST", "/tierb/doc",
+                                     query={"restore": ""},
+                                     body=b"<RestoreRequest><Days>")
+    assert status == 400 and b"MalformedXML" in body
+
+    # restore on a never-transitioned object: InvalidObjectState
+    client.request("PUT", "/tierb/hot", body=b"hot")
+    status, _, body = client.request("POST", "/tierb/hot",
+                                     query={"restore": ""},
+                                     body=body_xml)
+    assert status == 403 and b"InvalidObjectState" in body
+
+    # DELETE frees the remote copy
+    md = srv.api.obj.get_object_info("tierb", "doc").user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    assert tiers.client("cold").head(remote_key) == len(payload)
+    status, _, _ = client.request("DELETE", "/tierb/doc")
+    assert status == 204
+    with pytest.raises(TierObjectNotFound):
+        tiers.client("cold").head(remote_key)
+
+
+def test_madmin_tier_client(http_env):
+    from minio_tpu.madmin import AdminClient
+    from tests.test_s3 import CREDS
+    srv, _, _, _, root = http_env
+    mc = AdminClient("127.0.0.1", srv.port, CREDS.access_key,
+                     CREDS.secret_key)
+    mc.add_tier("sdk-cold", "fs", path=str(root / "sdk"))
+    assert any(t["name"] == "sdk-cold" for t in mc.list_tiers())
+    mc.add_tier("sdk-cold", "fs", update=True, path=str(root / "sdk2"))
+    mc.remove_tier("sdk-cold")
+    assert all(t["name"] != "sdk-cold" for t in mc.list_tiers())
+
+
+def test_tier_metrics_registered(env):
+    zz, tiers, worker, _ = env
+    info = zz.put_object("b", "m", b"m" * 10_000)
+    worker.enqueue("b", "m", "", "cold", etag=info.etag)
+    assert worker.drain(30)
+    from minio_tpu.utils import telemetry
+    snap = telemetry.REGISTRY.snapshot("minio_tpu_tier_")
+    objects = snap.get("minio_tpu_tier_objects_total", {})
+    assert any("cold" in labels and v >= 1
+               for labels, v in objects.items()), snap
+
+
+def test_transition_commit_precondition_aborts_on_overwrite(env):
+    """The stub-rewrite identity pin: a mismatching etag inside the
+    write lock aborts the commit (the unversioned overwrite race)."""
+    zz, tiers, _, _ = env
+    zz.put_object("b", "race", b"current" * 100)
+    with pytest.raises(api_errors.PreConditionFailed):
+        zz.transition_object("b", "race", tier="cold",
+                             remote_object="whatever",
+                             expect_etag="not-the-etag")
+    # and the object is untouched
+    _, stream = zz.get_object("b", "race")
+    assert b"".join(stream) == b"current" * 100
+
+
+def test_admin_tier_delete_refuses_in_use(http_env):
+    srv, client, tiers, _, root = http_env
+    tiers.add(TierConfig("used", "fs", {"path": str(root / "used")}),
+              update=True)
+    client.request("PUT", "/usedb")
+    lc = ('<LifecycleConfiguration><Rule><Status>Enabled</Status>'
+          '<Prefix></Prefix><Transition><Days>9</Days>'
+          '<StorageClass>used</StorageClass></Transition></Rule>'
+          '</LifecycleConfiguration>')
+    status, _, _ = client.request("PUT", "/usedb",
+                                  query={"lifecycle": ""},
+                                  body=lc.encode())
+    assert status == 200
+    status, _, body = client.request("DELETE", "/minio/admin/v3/tier",
+                                     query={"name": "used"})
+    assert status == 409 and b"TierBackendInUse" in body
+    status, _, _ = client.request("DELETE", "/minio/admin/v3/tier",
+                                  query={"name": "used",
+                                         "force": "true"})
+    assert status == 200
+
+
+def test_versioned_null_delete_keeps_remote_copy(http_env):
+    """DELETE ?versionId=null on a VERSIONED bucket writes a marker —
+    the stub stays, so the remote copy must NOT be freed (the review's
+    data-loss scenario)."""
+    srv, client, tiers, worker, root = http_env
+    tiers.add(TierConfig("cold", "fs", {"path": str(root / "t")}),
+              update=True)
+    client.request("PUT", "/verb")
+    status, _, _ = client.request(
+        "PUT", "/verb", query={"versioning": ""},
+        body=b'<VersioningConfiguration><Status>Enabled</Status>'
+             b'</VersioningConfiguration>')
+    assert status == 200
+    payload = b"versioned" * 2000
+    status, h, _ = client.request("PUT", "/verb/doc", body=payload)
+    assert status == 200
+    vid = h["x-amz-version-id"]
+    worker.enqueue("verb", "doc", vid, "cold",
+                   etag=h["etag"].strip('"'))
+    assert worker.drain(30)
+    md = srv.api.obj.get_object_info(
+        "verb", "doc",
+        GetOptions(version_id=vid)).user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    # versionId=null on a versioned bucket: marker write, remote stays
+    status, h, _ = client.request("DELETE", "/verb/doc",
+                                  query={"versionId": "null"})
+    assert status == 204 and h.get("x-amz-delete-marker") == "true"
+    assert tiers.client("cold").head(remote_key) == len(payload)
+    # targeted version delete DOES free it
+    status, _, _ = client.request("DELETE", "/verb/doc",
+                                  query={"versionId": vid})
+    assert status == 204
+    with pytest.raises(TierObjectNotFound):
+        tiers.client("cold").head(remote_key)
+
+
+def test_batch_delete_frees_remote_copies(http_env):
+    srv, client, tiers, worker, root = http_env
+    tiers.add(TierConfig("cold", "fs", {"path": str(root / "t")}),
+              update=True)
+    client.request("PUT", "/batchb")
+    payload = b"bulk" * 3000
+    status, h, _ = client.request("PUT", "/batchb/bulk1", body=payload)
+    assert status == 200
+    worker.enqueue("batchb", "bulk1", "", "cold",
+                   etag=h["etag"].strip('"'))
+    assert worker.drain(30)
+    md = srv.api.obj.get_object_info("batchb", "bulk1").user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    body = (b'<Delete><Object><Key>bulk1</Key></Object>'
+            b'<Object><Key>missing</Key></Object></Delete>')
+    status, _, resp = client.request(
+        "POST", "/batchb", query={"delete": ""}, body=body,
+        headers={"content-md5": ""})
+    assert status == 200, resp
+    with pytest.raises(TierObjectNotFound):
+        tiers.client("cold").head(remote_key)
+
+
+def test_noncurrent_expiry_frees_remote(env):
+    from minio_tpu.features.lifecycle import noncurrent_sweep_action
+    zz, tiers, worker, _ = env
+    old = zz.put_object("b", "ncx", b"old" * 800,
+                        opts=PutOptions(versioned=True))
+    time.sleep(0.01)
+    zz.put_object("b", "ncx", b"new" * 800,
+                  opts=PutOptions(versioned=True))
+    worker.enqueue("b", "ncx", old.version_id, "cold", etag=old.etag)
+    assert worker.drain(30)
+    md = zz.get_object_info(
+        "b", "ncx",
+        GetOptions(version_id=old.version_id)).user_defined
+    remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+    lc = ("<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+          "<Prefix></Prefix><NoncurrentVersionExpiration>"
+          "<NoncurrentDays>1</NoncurrentDays>"
+          "</NoncurrentVersionExpiration></Rule>"
+          "</LifecycleConfiguration>")
+    act = noncurrent_sweep_action(FakeBucketMeta(lc), zz,
+                                  now_fn=lambda: time.time() + 2 * DAY,
+                                  tiers=tiers)
+    act("b")
+    with pytest.raises(api_errors.VersionNotFound):
+        zz.get_object_info("b", "ncx",
+                           GetOptions(version_id=old.version_id))
+    with pytest.raises(TierObjectNotFound):
+        tiers.client("cold").head(remote_key)
+
+
+def test_rebalance_moves_transitioned_stub(tmp_path):
+    """Decommissioning a pool holding a transitioned stub moves the
+    xl.meta pointer (metadata-only) into the active pool; the object
+    still restores from its unchanged remote copy afterwards."""
+    zz = ErasureServerSets([make_sets(tmp_path, "p0", enable_mrf=False),
+                            make_sets(tmp_path, "p1", enable_mrf=False)])
+    try:
+        zz.make_bucket("b")
+        tiers = TierManager(zz)
+        tiers.add(TierConfig("cold", "fs",
+                             {"path": str(tmp_path / "tier")}))
+        payload = os.urandom(120_000)
+        # land the object in pool 0 specifically
+        info = zz.server_sets[0].put_object("b", "stub", payload)
+        worker = TransitionWorker(zz, tiers, **NEVER_BUSY).start()
+        worker.enqueue("b", "stub", "", "cold", etag=info.etag)
+        assert worker.drain(30), worker.stats()
+        worker.close()
+        md = zz.get_object_info("b", "stub").user_defined
+        remote_key = md[dt.TRANSITIONED_OBJECT_KEY]
+
+        zz.start_decommission(0, busy_fn=lambda: False,
+                              throttle_s=0.001)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = zz.rebalance_status().get("rebalance", {})
+            if st.get("status") == "complete":
+                break
+            assert st.get("status") != "failed", st
+            time.sleep(0.05)
+        else:
+            raise AssertionError(zz.rebalance_status())
+
+        # the stub now lives in pool 1 only, still gated, still
+        # pointing at the untouched remote copy
+        assert not zz.server_sets[0].has_object_versions("b", "stub")
+        assert zz.server_sets[1].has_object_versions("b", "stub")
+        with pytest.raises(api_errors.InvalidObjectState):
+            zz.get_object("b", "stub")
+        assert tiers.client("cold").head(remote_key) == len(payload)
+        restore_object(zz, tiers, "b", "stub")
+        oi, stream = zz.get_object("b", "stub")
+        assert b"".join(stream) == payload
+        assert oi.etag == info.etag
+    finally:
+        zz.close()

@@ -352,3 +352,53 @@ def test_meta_bucket_objects_migrate_but_internals_stay(pools):
     # the per-pool topology doc is still on pool 0 (deliberately)
     zz.server_sets[0].get_object_info(MINIO_META_BUCKET,
                                       "topology/pools.json")
+
+
+# ---------------------------------------------------------------------------
+# DiskMonitor covers pools added after boot (tiering-PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_disk_monitor_covers_post_boot_pool(tmp_path):
+    """A drive killed in a pool appended AFTER the monitor started is
+    re-admitted and healed exactly like a boot-time one: add_pool
+    registers the new pool's drive slots with the running monitor."""
+    import shutil
+    from minio_tpu.object.background import DiskMonitor
+    from minio_tpu.storage.xl_storage import XLStorage
+
+    zz = ErasureServerSets([make_zone(tmp_path, "p0")])
+    zz.make_bucket("b")
+    mon = DiskMonitor(zz.server_sets[0], interval=3600)
+    try:
+        # online expansion, then register the new pool with the monitor
+        # (what ClusterNode.add_pool does)
+        pool1 = make_zone(tmp_path, "p1")
+        zz.add_pool(pool1)
+        mon.add_pool(pool1)
+
+        # land an object in the NEW pool and remember its bytes
+        zz.set_pool_state(0, POOL_SUSPENDED)
+        payload = b"post-boot pool data " * 5000
+        zz.put_object("b", "obj", payload)
+        assert zz.server_sets[1].has_object_versions("b", "obj")
+
+        # kill one of the post-boot pool's drives outright (wiped disk)
+        victim = str(tmp_path / "p1d2")
+        shutil.rmtree(victim)
+        assert mon.scan_once() == 1          # re-admitted + formatted
+        assert mon.healed_slots              # swept as a fresh drive
+
+        # the wiped drive carries a valid format for ITS pool again
+        fmt = XLStorage(victim).read_format()
+        assert fmt.id == pool1.deployment_id
+        assert fmt.this in [u for row in pool1.format_ref.sets
+                            for u in row]
+
+        # healed: the object reads back whole, and a second scan is
+        # steady-state for BOTH pools
+        _, stream = zz.get_object("b", "obj")
+        assert b"".join(stream) == payload
+        assert mon.scan_once() == 0
+    finally:
+        mon.close()
+        zz.close()
