@@ -44,6 +44,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -498,6 +499,175 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
     return out
 
 
+def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                     size: int = 16 << 20, drives: int = 16,
+                     parity: int = 4, block: int = 1 << 20,
+                     lost_shards: int = 2, ab: bool = True,
+                     force_device: Optional[bool] = None,
+                     sched_max_wait: Optional[float] = None) -> dict:
+    """Concurrency saturation sweep (ROADMAP item #1's measurement
+    mode): for each stream count, run `streams` concurrent PutObject
+    streams then concurrent healthy GETs then concurrent DEGRADED GETs
+    (`lost_shards` shard files removed per object, so every read group
+    rides the fused verify+decode verb), reporting aggregate GiB/s per
+    phase plus the batch former's per-verb dispatch occupancy (groups
+    and blocks per fused device launch) at that point.
+
+    With `ab`, each point re-runs the GET phases with the scheduler
+    BYPASSED (engines built with scheduler=None → one device dispatch
+    per request bucket) — the per-request-launch baseline the former is
+    supposed to beat once concurrency saturates a single dispatch.
+
+    force_device: route every batch to the device backend regardless of
+    size/platform (the engine-test fixture's trick) — default on when
+    the jax backend is NOT a TPU, so the former is exercised (XLA-CPU)
+    on dev hosts; on a real TPU the natural routing thresholds apply.
+    Caveat: forced XLA-CPU numbers are compile-dominated (coalesced
+    batches hit fresh jit shapes mid-phase) — occupancy stats are
+    meaningful everywhere, the GiB/s and A/B ratios only on a real
+    device where the per-dispatch constant the former amortizes
+    actually exists.
+    """
+    import concurrent.futures as cf
+    import glob
+    import shutil
+    import tempfile
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.parallel.scheduler import BatchScheduler
+
+    if force_device is None:
+        force_device = not codec_mod._device_is_tpu()
+    was_is_tpu = codec_mod._IS_TPU
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    if force_device:
+        codec_mod._IS_TPU = True
+        codec_mod.DEVICE_MIN_BYTES = 0
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    payload = os.urandom(size)
+    out: dict = {"config": {"streams": list(streams), "size": size,
+                            "k": drives - parity, "m": parity,
+                            "block": block, "lost_shards": lost_shards,
+                            "forced_device_route": bool(force_device)},
+                 "points": []}
+
+    def run_point(n_streams: int, use_sched: bool) -> dict:
+        root = tempfile.mkdtemp(
+            prefix=f"bench_sat_{n_streams}_", dir=base)
+        # sched_max_wait widens the coalescing grace window past the
+        # production default — the smoke's tiny 2-stream points need
+        # determinism (3 ms loses to CI scheduling jitter), the real
+        # sweep wants production behavior
+        sched = (BatchScheduler(max_wait=sched_max_wait)
+                 if sched_max_wait is not None else BatchScheduler()) \
+            if use_sched else None
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False, scheduler=sched)
+        res: dict = {}
+        try:
+            sets.make_bucket("bench")
+            sets.put_object("bench", "warm", payload)   # warm the path
+
+            def stat_delta(before: Optional[dict]) -> dict:
+                if sched is None:
+                    return {}
+                now_ = sched.stats()["verbs"]
+                if before is None:
+                    return now_
+                d = {}
+                for verb, vs in now_.items():
+                    b = vs["batches"] - before[verb]["batches"]
+                    c = vs["coalesced"] - before[verb]["coalesced"]
+                    blk = vs["blocks"] - before[verb]["blocks"]
+                    if b:
+                        d[verb] = {
+                            "dispatches": b, "groups": b + c,
+                            "occupancy_groups": round((b + c) / b, 3),
+                            "occupancy_blocks": round(blk / b, 3)}
+                return d
+
+            def put_one(i: int) -> None:
+                sets.put_object("bench", f"o{i}", payload)
+
+            def get_one(i: int) -> None:
+                _, it = sets.get_object("bench", f"o{i}")
+                n = sum(len(c) for c in it)
+                assert n == size, (i, n)
+
+            snap = stat_delta(None)
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=n_streams) as ex:
+                list(ex.map(put_one, range(n_streams)))
+            put_wall = time.perf_counter() - t0
+            res["put_gib_s"] = round(
+                n_streams * size / put_wall / 2**30, 4)
+            res["sched_put"] = stat_delta(snap)
+
+            get_one(0)                     # warm the GET path
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=n_streams) as ex:
+                list(ex.map(get_one, range(n_streams)))
+            res["get_gib_s"] = round(
+                n_streams * size / (time.perf_counter() - t0) / 2**30,
+                4)
+
+            # degrade every object: drop `lost_shards` shard files so
+            # each read group needs the fused verify+decode verb. Loss
+            # is aligned by SHARD INDEX (each object loses data shards
+            # 0..lost-1), not by drive: the per-object distribution
+            # shuffle maps one dead drive to a different shard index
+            # per object, i.e. a different survivor mask per request —
+            # buckets that can never fuse. Index-aligned loss gives
+            # concurrent requests ONE shared erasure pattern, the
+            # coalescible stream the former exists to fuse.
+            eng = sets.sets[0]
+            for i in range(n_streams):
+                dist = eng._read_one("bench",
+                                     f"o{i}").erasure.distribution
+                for j in range(lost_shards):
+                    for f in glob.glob(os.path.join(
+                            root, f"d{dist.index(j + 1)}", "bench",
+                            f"o{i}", "*", "part.1")):
+                        os.remove(f)
+            get_one(0)     # warm (compiles the fused decode program)
+            snap = stat_delta(None)
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=n_streams) as ex:
+                list(ex.map(get_one, range(n_streams)))
+            res["deg_get_gib_s"] = round(
+                n_streams * size / (time.perf_counter() - t0) / 2**30,
+                4)
+            res["sched_deg_get"] = stat_delta(snap)
+        finally:
+            sets.close()
+            if sched is not None:
+                sched.close()
+            shutil.rmtree(root, ignore_errors=True)
+        return res
+
+    try:
+        for s in streams:
+            point: dict = {"streams": s}
+            point.update(run_point(s, True))
+            if ab:
+                bypass = run_point(s, False)
+                point["bypass"] = {
+                    kk: bypass[kk] for kk in
+                    ("put_gib_s", "get_gib_s", "deg_get_gib_s")}
+                base_deg = bypass["deg_get_gib_s"]
+                if base_deg:
+                    point["deg_get_vs_bypass_x"] = round(
+                        point["deg_get_gib_s"] / base_deg, 3)
+            out["points"].append(point)
+    finally:
+        codec_mod._IS_TPU = was_is_tpu
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+    return out
+
+
 def bench_rebalance_ab(streams: int = 8, size: int = 4 << 20,
                        drives: int = 8, parity: int = 2,
                        preload: int = 32) -> dict:
@@ -696,11 +866,48 @@ def main() -> int:
                     help="run ONLY the rebalance-throttle A/B "
                          "(foreground PUT p50/p99 with vs without an "
                          "active pool drain)")
+    ap.add_argument("--saturation", action="store_true",
+                    help="run ONLY the multi-stream saturation sweep: "
+                         "aggregate PUT/GET/degraded-GET GiB/s + batch "
+                         "former per-verb occupancy vs stream count, "
+                         "with a scheduler-bypassed A/B per point")
+    ap.add_argument("--saturation-streams",
+                    default=os.environ.get("BENCH_SAT_STREAMS",
+                                           "1,2,4,8,16,32"),
+                    help="comma-separated stream counts for the sweep")
+    ap.add_argument("--saturation-size", type=int,
+                    default=int(os.environ.get("BENCH_SAT_SIZE",
+                                               str(16 << 20))))
+    ap.add_argument("--saturation-smoke", action="store_true",
+                    help="tiny 2-point sweep (streams 1,2; 4-block "
+                         "objects; 4+2 set) for CI — seconds, not "
+                         "minutes")
     ap.add_argument("--ab-tier", action="store_true",
                     help="run ONLY the tier-transition-throttle A/B "
                          "(foreground PUT p50/p99 with vs without the "
                          "transition worker draining to a tier)")
     args = ap.parse_args()
+
+    if args.saturation or args.saturation_smoke:
+        if args.saturation_smoke:
+            sat = bench_saturation(streams=(1, 2), size=4 << 16,
+                                   drives=6, parity=2, block=1 << 16,
+                                   force_device=True,
+                                   sched_max_wait=0.25)
+        else:
+            sat = bench_saturation(
+                streams=tuple(int(x) for x in
+                              args.saturation_streams.split(",") if x),
+                size=args.saturation_size)
+        top = sat["points"][-1] if sat["points"] else {}
+        print(json.dumps({
+            "metric": "aggregate degraded-GET GiB/s at max streams "
+                      "(multi-verb batch-former saturation sweep)",
+            "value": top.get("deg_get_gib_s"),
+            "unit": "GiB/s",
+            "saturation": sat,
+        }))
+        return 0
 
     if args.ab_tier:
         print(json.dumps({

@@ -1070,6 +1070,11 @@ class ErasureObjects:
             mask = sum(1 << i for i in range(n)
                        if shards[i] is not None)
             buckets.setdefault((mask, entry[3]), []).append(gi)
+        # submit EVERY bucket's fused dispatch before resolving any:
+        # each bucket's grace window then overlaps CONCURRENT requests'
+        # same-pattern buckets (same former key -> one fused launch)
+        # instead of opening only after the previous bucket resolved
+        staged: list[tuple] = []
         for (mask, shard_len), idxs in buckets.items():
             heal = True
             _dm, used, _missing = rs_matrix.missing_data_matrix(
@@ -1079,10 +1084,29 @@ class ErasureObjects:
                 for gi in idxs])                       # (G', k, S)
             # fuse hashing only when digests were actually deferred;
             # inline-verified shards need just the decode matmul
-            fused = codec.verify_and_decode_batch(
-                stacked, mask, shard_len, algo) if any(
-                group[gi][5][u] is not None
-                for gi in idxs for u in used) else None
+            want_fused = any(group[gi][5][u] is not None
+                             for gi in idxs for u in used)
+            fut = None
+            if want_fused and self.scheduler is not None:
+                fut = self.scheduler.submit_decode(
+                    codec, stacked, mask, shard_len, algo)
+            staged.append((mask, shard_len, idxs, used, stacked,
+                           want_fused, fut))
+        for mask, shard_len, idxs, used, stacked, want_fused, fut \
+                in staged:
+            if fut is not None:
+                try:
+                    fused = fut.result()
+                except Exception:  # noqa: BLE001 — a shared-dispatch
+                    # failure must not kill a GET the host can still
+                    # serve: fall back to the local decode + step-2
+                    # host verification of the deferred digests
+                    fused = None
+            elif want_fused:
+                fused = codec.verify_and_decode_batch(
+                    stacked, mask, shard_len, algo)
+            else:
+                fused = None
             if fused is not None:
                 out, missing_idx, sdig = fused
                 for row, gi in enumerate(idxs):

@@ -358,6 +358,11 @@ class HealMixin(ErasureObjects):
                     mask = sum(1 << i for i in range(n)
                                if shards[i] is not None)
                     buckets.setdefault((mask, sl), []).append(gi)
+                # submit every bucket's fused dispatch before resolving
+                # any: each bucket's grace window then overlaps
+                # same-pattern buckets from concurrent heals/GETs on
+                # the shared former (same key -> one fused launch)
+                staged: list[tuple] = []
                 for (mask, sl), gis in buckets.items():
                     _, used, _missing = rs_matrix.recover_matrix(
                         k, self.parity_shards, mask)
@@ -366,11 +371,32 @@ class HealMixin(ErasureObjects):
                         for gi in gis])
                     # fuse hashing only when digests were deferred;
                     # inline-verified survivors need just the matmul
-                    fused = codec.verify_and_recover_batch(
-                        stacked, mask, set(writers.keys()), sl,
-                        verify_algo) if any(
-                        group[gi][3][u] is not None
-                        for gi in gis for u in used) else None
+                    want_fused = any(group[gi][3][u] is not None
+                                     for gi in gis for u in used)
+                    fut = None
+                    if want_fused and self.scheduler is not None:
+                        fut = self.scheduler.submit_recover(
+                            codec, stacked, mask, set(writers.keys()),
+                            sl, verify_algo)
+                    staged.append((mask, sl, gis, used, stacked,
+                                   want_fused, fut))
+                for mask, sl, gis, used, stacked, want_fused, fut \
+                        in staged:
+                    if fut is not None:
+                        try:
+                            fused = fut.result()
+                        except Exception:  # noqa: BLE001 — a shared-
+                            # dispatch failure must not kill a heal the
+                            # host can finish: the declined branch
+                            # below keeps the deferred digests set, so
+                            # the host batch verify still covers them
+                            fused = None
+                    elif want_fused:
+                        fused = codec.verify_and_recover_batch(
+                            stacked, mask, set(writers.keys()), sl,
+                            verify_algo)
+                    else:
+                        fused = None
                     if fused is not None:
                         out, idxs, sdig, odig = fused
                         for row_i, gi in enumerate(gis):

@@ -34,6 +34,7 @@ before returning), so every erasure geometry rides any mesh shape.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Optional
@@ -284,6 +285,23 @@ class _Dispatches:
 
 DISPATCHES = _Dispatches()    # mesh device calls (tests/metrics)
 
+# On NON-TPU backends, host-side mesh dispatches serialize on this
+# lock (held through materialization, so dispatches fully serialize):
+# two threads executing collective (all_to_all) programs concurrently
+# can starve the virtual-device execution pool of each other's
+# participants and deadlock — observed on the 8-virtual-device CPU
+# mesh under concurrent per-request dispatch (the scheduler-bypass
+# A/B), and the same hazard exists for any concurrent direct caller.
+# Real TPU pools keep concurrent dispatch (the scheduler's INFLIGHT
+# overlap): the PjRt TPU client runs concurrent executions safely.
+_DISPATCH_MU = threading.Lock()
+_NULL_MU = contextlib.nullcontext()
+
+
+def _dispatch_guard(mesh: Mesh):
+    devs = mesh.devices.flat
+    return _NULL_MU if devs[0].platform == "tpu" else _DISPATCH_MU
+
 
 def _shardable(mesh: Mesh, b: int, s: int) -> Optional[tuple[int, int]]:
     """(dp, sp) when a (B, *, S) batch can shard over `mesh`: byte
@@ -316,12 +334,14 @@ def mesh_encode_and_hash(mesh: Mesh, data: np.ndarray, k: int, m: int,
         return None
     dp, _sp = geom
     data, b = _pad_batch(np.ascontiguousarray(data, np.uint8), dp)
-    arr = shard_array(mesh, data, P("dp", None, "sp"))
     step = sharded_put_step(mesh, k, m, algo)
-    parity, digests, _total = step(arr)
-    DISPATCHES.bump()
-    full = np.concatenate([data[:b], np.asarray(parity)[:b]], axis=1)
-    return full, np.asarray(digests)[:b]
+    with _dispatch_guard(mesh):
+        arr = shard_array(mesh, data, P("dp", None, "sp"))
+        parity, digests, _total = step(arr)
+        DISPATCHES.bump()
+        full = np.concatenate([data[:b], np.asarray(parity)[:b]],
+                              axis=1)
+        return full, np.asarray(digests)[:b]
 
 
 def mesh_verify_and_decode(mesh: Mesh, survivors: np.ndarray, k: int,
@@ -343,12 +363,13 @@ def mesh_verify_and_decode(mesh: Mesh, survivors: np.ndarray, k: int,
     dp, _sp = geom
     survivors, b = _pad_batch(
         np.ascontiguousarray(survivors, np.uint8), dp)
-    arr = shard_array(mesh, survivors, P("dp", None, "sp"))
     run, missing = sharded_get_step(mesh, k, m, present_mask, algo,
                                     shard_len)
-    out, digests = run(arr)
-    DISPATCHES.bump()
-    return np.asarray(out)[:b], missing, np.asarray(digests)[:b]
+    with _dispatch_guard(mesh):
+        arr = shard_array(mesh, survivors, P("dp", None, "sp"))
+        out, digests = run(arr)
+        DISPATCHES.bump()
+        return np.asarray(out)[:b], missing, np.asarray(digests)[:b]
 
 
 def mesh_verify_and_recover(mesh: Mesh, survivors: np.ndarray, k: int,
@@ -368,10 +389,11 @@ def mesh_verify_and_recover(mesh: Mesh, survivors: np.ndarray, k: int,
     dp, _sp = geom
     survivors, b = _pad_batch(
         np.ascontiguousarray(survivors, np.uint8), dp)
-    arr = shard_array(mesh, survivors, P("dp", None, "sp"))
     run, idxs = sharded_heal_step(mesh, k, m, present_mask,
                                   tuple(sorted(rows)), algo, shard_len)
-    out, sdig, odig = run(arr)
-    DISPATCHES.bump()
-    return (np.asarray(out)[:b], idxs, np.asarray(sdig)[:b],
-            np.asarray(odig)[:b])
+    with _dispatch_guard(mesh):
+        arr = shard_array(mesh, survivors, P("dp", None, "sp"))
+        out, sdig, odig = run(arr)
+        DISPATCHES.bump()
+        return (np.asarray(out)[:b], idxs, np.asarray(sdig)[:b],
+                np.asarray(odig)[:b])

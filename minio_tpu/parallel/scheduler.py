@@ -1,17 +1,41 @@
-"""Cross-request batch scheduler: one device dispatch for many PUTs.
+"""Cross-request batch scheduler: one device dispatch for many requests.
 
-The engine already batches blocks *within* one PUT stream; this
-scheduler batches across CONCURRENT streams (BASELINE config #2: 32
-concurrent 16 MiB PutObject streams) — the reference's per-set shared
-buffer pool + RAM-gated admission generalized into a device-batch
-former (cmd/erasure-sets.go:374, cmd/handler-api.go:46-57).
+The engine already batches blocks *within* one request; this scheduler
+batches across CONCURRENT requests (BASELINE config #2: 32 concurrent
+16 MiB PutObject streams) — the reference's per-set shared buffer pool
++ RAM-gated admission generalized into a device-batch former
+(cmd/erasure-sets.go:374, cmd/handler-api.go:46-57).
 
-Concurrent callers hand (B_i, k, S) block groups to encode_and_hash();
-a collector thread coalesces groups with identical geometry into one
-(ΣB_i, k, S) fused encode+digest device call and scatters results back.
-Under the axon tunnel each dispatch costs ~0.7 s wall — coalescing N
-streams' work into one call divides that constant by N; on real PCIe
-hosts it amortizes the ~10 ms dispatch + keeps MXU batches full.
+PR 2 coalesced the PUT side only; the former is now a MULTI-VERB
+device dispatcher covering every fused program of the data path:
+
+  * ``encode``  — fused RS-encode + per-shard bitrot digest (PUT)
+  * ``decode``  — fused verify + reconstruct-missing-data (degraded GET)
+  * ``recover`` — fused verify + rebuild-rows + re-digest (heal)
+
+Concurrent callers hand (B_i, k, S) block groups to the submit_*
+methods; a collector thread coalesces groups with identical
+(verb, geometry, algorithm, survivor-mask) into one fused (ΣB_i, k, S)
+device call through object/codec.py — which routes to parallel/mesh.py
+``mesh_*`` sharded programs on a multi-chip pool — and scatters results
+back. Under the axon tunnel each dispatch costs ~0.7 s wall —
+coalescing N streams' work into one call divides that constant by N;
+on real PCIe hosts it amortizes the ~10 ms dispatch + keeps MXU
+batches full.
+
+Occupancy smarts (PR 6):
+  * a bucket that already holds >= max_batch blocks dispatches
+    IMMEDIATELY instead of sleeping the grace window;
+  * batch split points round down to multiples of the mesh ``dp`` axis
+    so fused batches shard evenly across chips (no pad rows);
+  * up to MINIO_TPU_SCHED_INFLIGHT (default 2) dispatches run
+    concurrently, so host->device transfer of batch N+1 overlaps
+    device compute of batch N.
+
+Env knobs (README "Cross-request batch former"):
+  MINIO_TPU_SCHED_MAX_BATCH=32    blocks per fused dispatch
+  MINIO_TPU_SCHED_MAX_WAIT_MS=3   coalescing grace window
+  MINIO_TPU_SCHED_INFLIGHT=2      concurrent dispatches in flight
 """
 
 from __future__ import annotations
@@ -20,6 +44,7 @@ import os
 import threading
 import time
 import weakref
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
@@ -28,60 +53,75 @@ from ..utils import telemetry
 
 MAX_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_SCHED_MAX_BATCH", "32"))
 MAX_WAIT_S = float(os.environ.get("MINIO_TPU_SCHED_MAX_WAIT_MS", "3")) / 1e3
+INFLIGHT = max(1, int(os.environ.get("MINIO_TPU_SCHED_INFLIGHT", "2")))
+
+VERBS = ("encode", "decode", "recover")
 
 # live schedulers, summed by the registry collector at exposition time
 _SCHEDULERS: "weakref.WeakSet[BatchScheduler]" = weakref.WeakSet()
 
+# dispatch totals are MONOTONIC — registered as real Counters (bumped at
+# dispatch time, labelled by verb) so Prometheus rate() works; only the
+# instantaneous queue/occupancy values stay exposition-time gauges
+_BATCHES_TOTAL = telemetry.REGISTRY.counter(
+    "minio_tpu_sched_batches_total", "Fused device dispatches issued")
+_COALESCED_TOTAL = telemetry.REGISTRY.counter(
+    "minio_tpu_sched_coalesced_total",
+    "Groups that shared another request's dispatch")
+
 
 def _collect_scheduler_metrics() -> None:
     reg = telemetry.REGISTRY
-    queued_groups = queued_blocks = batches = coalesced = blocks = 0
+    queued_groups = queued_blocks = batches = blocks = 0
+    verbs: dict[str, list[int]] = {v: [0, 0] for v in VERBS}
     for s in list(_SCHEDULERS):
         st = s.stats()
         queued_groups += st["queued_groups"]
         queued_blocks += st["queued_blocks"]
         batches += st["batches"]
-        coalesced += st["coalesced"]
         blocks += st["dispatched_blocks"]
+        for v, vs in st["verbs"].items():
+            verbs[v][0] += vs["batches"]
+            verbs[v][1] += vs["coalesced"]
     reg.gauge("minio_tpu_sched_queue_depth",
-              "Encode groups waiting on the batch former").set(
+              "Work groups waiting on the batch former").set(
         queued_groups)
     reg.gauge("minio_tpu_sched_queued_blocks",
               "Blocks waiting on the batch former").set(queued_blocks)
-    reg.gauge("minio_tpu_sched_batches_total",
-              "Fused device dispatches issued").set(batches)
-    reg.gauge("minio_tpu_sched_coalesced_total",
-              "Groups that shared another stream's dispatch").set(
-        coalesced)
     reg.gauge("minio_tpu_sched_batch_occupancy_blocks",
               "Mean blocks per fused dispatch (MXU batch fill)").set(
         round(blocks / batches, 3) if batches else 0)
+    g = reg.gauge("minio_tpu_sched_batch_occupancy_groups",
+                  "Mean request groups per fused dispatch, by verb")
+    for v, (b, c) in verbs.items():
+        g.set(round((b + c) / b, 3) if b else 0, verb=v)
 
 
 telemetry.REGISTRY.register_collector(_collect_scheduler_metrics)
 
 
 class _Pending:
-    __slots__ = ("data", "event", "full", "digests", "error", "span")
+    __slots__ = ("data", "event", "out", "error", "span")
 
     def __init__(self, data: np.ndarray):
         self.data = data
         self.event = threading.Event()
-        self.full: Optional[np.ndarray] = None
-        self.digests: Optional[np.ndarray] = None
+        self.out = None
         self.error: Optional[Exception] = None
         # submitter's span: the collector thread is shared across
         # requests, so dispatch spans are attached explicitly
         self.span = None
 
 
-class EncodeFuture:
-    """Handle for one submitted encode+digest group — the non-blocking
-    dispatch seam of the PUT pipeline: the reader thread submits and
-    moves on; the write stage resolves the future when it actually
-    needs the shards (the fork's async QAT kernel launch pattern).
+class DispatchFuture:
+    """Handle for one submitted work group — the non-blocking dispatch
+    seam of the data paths: the caller submits and moves on; it
+    resolves the future when it actually needs the result (the fork's
+    async QAT kernel launch pattern).
 
-    result() returns (full, digests) or None when the work must take
+    result() returns the verb's tuple — encode (full, digests); decode
+    (missing, missing_idx, survivor_digests); recover (out, idxs,
+    survivor_digests, out_digests) — or None when the work must take
     the caller's local CPU path."""
 
     __slots__ = ("_pending", "_value")
@@ -98,29 +138,50 @@ class EncodeFuture:
         if p is None:
             return self._value
         if not p.event.wait(timeout):
-            raise TimeoutError("encode dispatch did not complete")
+            raise TimeoutError("batch dispatch did not complete")
         if p.error is not None:
             raise p.error
-        if p.full is None:
-            return None
-        return p.full, p.digests
+        return p.out
+
+
+# back-compat alias (PR 2 name; the PUT pipeline docstrings use it)
+EncodeFuture = DispatchFuture
+
+
+def _mesh_dp() -> int:
+    """Batch-axis width of the active device mesh (1 = single device)."""
+    try:
+        from ..object.codec import _mesh_active
+        mesh = _mesh_active()
+        return int(mesh.devices.shape[0]) if mesh is not None else 1
+    except Exception:  # noqa: BLE001 — a broken backend never stalls dispatch
+        return 1
 
 
 class BatchScheduler:
-    """Geometry-bucketed device-batch former for encode+bitrot work."""
+    """Geometry-bucketed multi-verb device-batch former."""
 
     def __init__(self, max_batch: int = MAX_BATCH_BLOCKS,
-                 max_wait: float = MAX_WAIT_S):
+                 max_wait: float = MAX_WAIT_S,
+                 inflight: int = INFLIGHT):
         self.max_batch = max_batch
         self.max_wait = max_wait
         self._mu = threading.Lock()
-        # (k, m, S, algo_value) -> list[_Pending]
+        # (verb, k, m, S, algo_value, extra) -> list[_Pending]
         self._buckets: dict[tuple, list[_Pending]] = {}
+        self._bucket_blocks: dict[tuple, int] = {}
         self._kick = threading.Condition(self._mu)
         self._stop = False
         self.batches = 0              # dispatch counter (tests/metrics)
         self.coalesced = 0            # groups that shared a dispatch
         self.dispatched_blocks = 0    # blocks through the device path
+        self.verb_stats = {v: {"batches": 0, "coalesced": 0, "blocks": 0}
+                           for v in VERBS}
+        # keeping `inflight` dispatches airborne overlaps batch N+1's
+        # host->device transfer with batch N's compute
+        self._inflight = threading.BoundedSemaphore(max(1, inflight))
+        self._pool = ThreadPoolExecutor(max_workers=max(1, inflight),
+                                        thread_name_prefix="sched-dispatch")
         self._thread = threading.Thread(target=self._collector,
                                         daemon=True)
         self._thread.start()
@@ -137,46 +198,92 @@ class BatchScheduler:
                     "queued_blocks": queued_blocks,
                     "batches": self.batches,
                     "coalesced": self.coalesced,
-                    "dispatched_blocks": self.dispatched_blocks}
+                    "dispatched_blocks": self.dispatched_blocks,
+                    "verbs": {v: dict(s)
+                              for v, s in self.verb_stats.items()}}
 
     def close(self) -> None:
+        """Flush pending groups (CPU-route them: waiters resolve to
+        None and fall back to their local paths), join the collector,
+        and drain the in-flight dispatches."""
         with self._mu:
+            if self._stop:
+                return
             self._stop = True
             self._kick.notify_all()
+        self._thread.join(timeout=10)
+        # in-flight dispatches finish and resolve their waiters
+        self._pool.shutdown(wait=True)
 
     # -- caller side -------------------------------------------------------
 
-    def submit(self, codec, data: np.ndarray, algo) -> EncodeFuture:
-        """Non-blocking fused encode+digest dispatch: enqueue the group
-        on the batch former and return immediately. The future resolves
-        to (full, digests), or to None when the work can't ride the
-        device path (the caller falls back to its local CPU path) —
-        declined submissions return an already-done future."""
+    def _declined(self, codec, algo) -> bool:
         from .. import bitrot as bitrot_mod
         if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
                         bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S,
                         bitrot_mod.BitrotAlgorithm.SHA256):
-            return EncodeFuture()
+            return True
         if codec.m == 0:
-            return EncodeFuture()
+            return True
         # No device, no reason to queue: without a TPU (or an active
         # multi-device mesh) the dispatch always CPU-routes, so the
-        # grace window + wakeup round-trip (~max_wait per encode batch)
-        # would be pure hot-path overhead. With a device path present,
-        # small batches still enqueue — coalescing with concurrent
-        # streams is what pushes them over the routing threshold.
+        # grace window + wakeup round-trip (~max_wait per batch) would
+        # be pure hot-path overhead. With a device path present, small
+        # batches still enqueue — coalescing with concurrent streams is
+        # what pushes them over the routing threshold.
         from ..object.codec import _device_is_tpu, _mesh_active
-        if not _device_is_tpu() and _mesh_active() is None:
-            return EncodeFuture()
-        key = (codec.k, codec.m, data.shape[-1], algo.value)
+        return not _device_is_tpu() and _mesh_active() is None
+
+    def _enqueue(self, key: tuple, data: np.ndarray) -> DispatchFuture:
         p = _Pending(np.ascontiguousarray(data, np.uint8))
         p.span = telemetry.current_span()
+        b = int(p.data.shape[0])
         with self._mu:
             if self._stop:
-                return EncodeFuture()
+                return DispatchFuture()
             self._buckets.setdefault(key, []).append(p)
+            self._bucket_blocks[key] = self._bucket_blocks.get(key, 0) + b
             self._kick.notify_all()
-        return EncodeFuture(p)
+        return DispatchFuture(p)
+
+    def submit(self, codec, data: np.ndarray, algo) -> DispatchFuture:
+        """Non-blocking fused encode+digest dispatch: enqueue the
+        (B, k, S) group on the batch former and return immediately. The
+        future resolves to (full, digests), or to None when the work
+        can't ride the device path (the caller falls back to its local
+        CPU path) — declined submissions return an already-done
+        future."""
+        if self._declined(codec, algo):
+            return DispatchFuture()
+        key = ("encode", codec.k, codec.m, data.shape[-1], algo.value,
+               None)
+        return self._enqueue(key, data)
+
+    def submit_decode(self, codec, survivors: np.ndarray,
+                      present_mask: int, shard_len: int, algo
+                      ) -> DispatchFuture:
+        """Non-blocking fused verify+decode dispatch for a degraded-GET
+        bucket: survivors (B, k, S) stacked in missing_data_matrix
+        `used` order. Resolves to (missing, missing_idx,
+        survivor_digests) or None (caller host-decodes)."""
+        if self._declined(codec, algo):
+            return DispatchFuture()
+        key = ("decode", codec.k, codec.m, survivors.shape[-1],
+               algo.value, (present_mask, shard_len))
+        return self._enqueue(key, survivors)
+
+    def submit_recover(self, codec, survivors: np.ndarray,
+                       present_mask: int, rows, shard_len: int, algo
+                       ) -> DispatchFuture:
+        """Non-blocking fused verify+recover+rehash dispatch for a heal
+        bucket: survivors (B, k, S) in recover_matrix `used` order.
+        Resolves to (out, idxs, survivor_digests, out_digests) or
+        None (caller host-rebuilds)."""
+        if self._declined(codec, algo):
+            return DispatchFuture()
+        key = ("recover", codec.k, codec.m, survivors.shape[-1],
+               algo.value, (present_mask, frozenset(rows), shard_len))
+        return self._enqueue(key, survivors)
 
     def encode_and_hash(self, codec, data: np.ndarray, algo
                         ) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -186,85 +293,152 @@ class BatchScheduler:
 
     # -- collector ---------------------------------------------------------
 
+    def _full_bucket_locked(self) -> bool:
+        return any(b >= self.max_batch
+                   for b in self._bucket_blocks.values())
+
     def _collector(self) -> None:
         while True:
             with self._mu:
                 while not self._buckets and not self._stop:
                     self._kick.wait(0.25)
-                if self._stop:
-                    for plist in self._buckets.values():
-                        for p in plist:
-                            p.event.set()
-                    self._buckets.clear()
-                    return
-                # small grace window lets concurrent streams coalesce
-                self._kick.wait(self.max_wait)
-                # drain EVERY ready geometry bucket this wakeup: mixed
-                # geometries (12+4 PUTs concurrent with 4+2 RRS) must
-                # not serialize behind each other's grace windows
-                # (VERDICT r2 weak #5)
+                if not self._stop and not self._full_bucket_locked():
+                    # small grace window lets concurrent streams
+                    # coalesce — but a bucket that is ALREADY full
+                    # dispatches now (waiting could not improve its
+                    # occupancy, only its latency), and a bucket that
+                    # FILLS mid-window cuts the wait short
+                    deadline = time.monotonic() + self.max_wait
+                    while (not self._stop
+                           and not self._full_bucket_locked()):
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            break
+                        self._kick.wait(rem)
+                # drain EVERY ready bucket this wakeup: mixed verbs and
+                # geometries (12+4 PUTs concurrent with 4+2 degraded
+                # GETs) must not serialize behind each other's grace
+                # windows (VERDICT r2 weak #5)
                 ready = list(self._buckets.items())
                 self._buckets.clear()
+                self._bucket_blocks.clear()
+                stopping = self._stop
             for key, plist in ready:
-                self._dispatch(key, plist)
-
-    def _dispatch(self, key: tuple, plist: list) -> None:
-        from ..object.codec import Codec
-        from .. import bitrot as bitrot_mod
-        k, m, s, algo_value = key
-        algo = bitrot_mod.BitrotAlgorithm.from_string(algo_value)
-        try:
-            # cap one device call at max_batch blocks; loop the rest
-            groups: list[list] = []
-            cur: list = []
-            n_blocks = 0
-            for p in plist:
-                b = p.data.shape[0]
-                if cur and n_blocks + b > self.max_batch:
-                    groups.append(cur)
-                    cur, n_blocks = [], 0
-                cur.append(p)
-                n_blocks += b
-            if cur:
-                groups.append(cur)
-            codec = Codec(k, m, s * k)
-            for group in groups:
-                data = np.concatenate([p.data for p in group], axis=0)
-                t0_wall, t0 = time.time(), time.perf_counter()
-                out = codec.encode_and_hash_batch(data, algo)
-                dt = time.perf_counter() - t0
-                self.batches += 1
-                self.coalesced += len(group) - 1
-                with self._mu:
-                    self.dispatched_blocks += data.shape[0]
-                for p in group:
-                    if p.span is not None:
-                        # the collector thread serves many requests:
-                        # attach the dispatch to each submitter's tree
-                        # as an externally-timed span
-                        telemetry.attach_span(
-                            p.span, "sched.dispatch", t0_wall, dt,
-                            blocks=int(data.shape[0]),
-                            coalesced=len(group) - 1)
-                if out is None:
-                    # CPU routing: let each caller use its own path
-                    for p in group:
-                        p.full = None
+                if stopping:
+                    # close() flush: CPU-route — out stays None, every
+                    # waiter falls back to its local path
+                    for p in plist:
                         p.event.set()
-                    continue
-                full, digests = out
-                at = 0
+                else:
+                    self._split_dispatch(key, plist)
+            if stopping:
+                return
+
+    def _split_dispatch(self, key: tuple, plist: list) -> None:
+        """Split one bucket into <= cap-block groups and launch them on
+        the dispatch pool (bounded to `inflight` airborne at once)."""
+        # round the split cap DOWN to a multiple of the mesh dp axis so
+        # fused batches shard evenly across chips instead of padding
+        cap = self.max_batch
+        dp = _mesh_dp()
+        if dp > 1 and cap > dp:
+            cap -= cap % dp
+        groups: list[list] = []
+        cur: list = []
+        n_blocks = 0
+        for p in plist:
+            b = p.data.shape[0]
+            if cur and n_blocks + b > cap:
+                groups.append(cur)
+                cur, n_blocks = [], 0
+            cur.append(p)
+            n_blocks += b
+        if cur:
+            groups.append(cur)
+        for group in groups:
+            self._inflight.acquire()
+            try:
+                self._pool.submit(self._dispatch_group, key, group)
+            except BaseException:  # noqa: BLE001 — pool gone (close race)
+                # same contract as the stopping flush: CPU-route (out
+                # stays None) so waiters fall back to their local
+                # paths instead of failing work the host can serve
+                self._inflight.release()
                 for p in group:
-                    b = p.data.shape[0]
-                    p.full = full[at:at + b]
-                    p.digests = digests[at:at + b]
-                    at += b
                     p.event.set()
+
+    def _dispatch_group(self, key: tuple, group: list) -> None:
+        try:
+            self._dispatch_one(key, group)
         except Exception as e:  # noqa: BLE001 — surfaced to every waiter
-            for p in plist:
+            for p in group:
                 if not p.event.is_set():
                     p.error = e
                     p.event.set()
+        finally:
+            self._inflight.release()
+
+    def _dispatch_one(self, key: tuple, group: list) -> None:
+        from ..object.codec import Codec
+        from .. import bitrot as bitrot_mod
+        verb, k, m, s, algo_value, extra = key
+        algo = bitrot_mod.BitrotAlgorithm.from_string(algo_value)
+        codec = Codec(k, m, s * k)
+        data = np.concatenate([p.data for p in group], axis=0) \
+            if len(group) > 1 else group[0].data
+        t0_wall, t0 = time.time(), time.perf_counter()
+        if verb == "encode":
+            out = codec.encode_and_hash_batch(data, algo)
+        elif verb == "decode":
+            mask, shard_len = extra
+            out = codec.verify_and_decode_batch(data, mask, shard_len,
+                                                algo)
+        else:
+            mask, rows, shard_len = extra
+            out = codec.verify_and_recover_batch(data, mask, set(rows),
+                                                 shard_len, algo)
+        dt = time.perf_counter() - t0
+        nb = int(data.shape[0])
+        with self._mu:
+            self.batches += 1
+            self.coalesced += len(group) - 1
+            self.dispatched_blocks += nb
+            vs = self.verb_stats[verb]
+            vs["batches"] += 1
+            vs["coalesced"] += len(group) - 1
+            vs["blocks"] += nb
+        _BATCHES_TOTAL.inc(verb=verb)
+        if len(group) > 1:
+            _COALESCED_TOTAL.inc(len(group) - 1, verb=verb)
+        for p in group:
+            if p.span is not None:
+                # the collector/dispatch threads serve many requests:
+                # attach the dispatch to each submitter's tree as an
+                # externally-timed span
+                telemetry.attach_span(
+                    p.span, "sched.dispatch", t0_wall, dt, verb=verb,
+                    blocks=nb, coalesced=len(group) - 1)
+        if out is None:
+            # CPU routing: let each caller use its own path
+            for p in group:
+                p.event.set()
+            return
+        at = 0
+        for p in group:
+            b = p.data.shape[0]
+            if verb == "encode":
+                full, digests = out
+                p.out = (full[at:at + b], digests[at:at + b])
+            elif verb == "decode":
+                missing, missing_idx, sdig = out
+                p.out = (missing[at:at + b], missing_idx,
+                         sdig[at:at + b])
+            else:
+                rec, idxs, sdig, odig = out
+                p.out = (rec[at:at + b], idxs, sdig[at:at + b],
+                         odig[at:at + b])
+            at += b
+            p.event.set()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,31 @@
+"""CI smoke for the bench.py --saturation sweep (2 tiny points): the
+sweep must run end-to-end inside the tier-1 budget, emit
+JSON-serializable results, and show the decode verb actually riding
+the batch former with >1 group per dispatch once streams > 1."""
+
+from __future__ import annotations
+
+import json
+
+import bench
+
+
+def test_saturation_smoke_two_points():
+    out = bench.bench_saturation(streams=(1, 2), size=2 << 16,
+                                 drives=6, parity=2, block=1 << 16,
+                                 ab=True, force_device=True,
+                                 sched_max_wait=0.25)
+    json.dumps(out)                       # BENCH-compatible payload
+    assert out["config"]["forced_device_route"] is True
+    assert [p["streams"] for p in out["points"]] == [1, 2]
+    for p in out["points"]:
+        for key in ("put_gib_s", "get_gib_s", "deg_get_gib_s"):
+            assert p[key] >= 0
+            assert p["bypass"][key] >= 0
+        # degraded GETs exercised the decode verb on the former
+        dec = p["sched_deg_get"]["decode"]
+        assert dec["dispatches"] >= 1
+    # with 2 concurrent streams the two requests' decode buckets share
+    # dispatches: mean groups per dispatch must exceed 1
+    dec2 = out["points"][1]["sched_deg_get"]["decode"]
+    assert dec2["occupancy_groups"] > 1, dec2

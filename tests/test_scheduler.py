@@ -1,19 +1,35 @@
 """Cross-request batch scheduler: coalescing, correctness of scatter,
-failure propagation, buffer pool back-pressure, admission budget."""
+failure propagation, buffer pool back-pressure, admission budget, and
+the PR-6 multi-verb former (decode/recover verbs, full-bucket immediate
+dispatch, close-with-pending flush, Counter metric semantics)."""
 
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from minio_tpu import bitrot as bitrot_mod
 from minio_tpu.object.codec import Codec
+from minio_tpu.ops import gf256, rs_matrix, rs_ref
 from minio_tpu.parallel.bpool import BytePool
 from minio_tpu.parallel.scheduler import BatchScheduler, requests_budget
 
 HH = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S
+
+
+def _degraded(seed: int, b: int, k: int, m: int, s: int, lost):
+    """(survivors in `used` order, mask, full) for a lost-shard set."""
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (b, k, s), dtype=np.int64
+                        ).astype(np.uint8)
+    full = np.stack([rs_ref.encode(blk, m) for blk in data])
+    mask = sum(1 << i for i in range(k + m) if i not in lost)
+    _dm, used, _missing = rs_matrix.missing_data_matrix(k, m, mask)
+    surv = np.stack([full[:, u] for u in used], axis=1)
+    return surv, mask, full
 
 
 @pytest.fixture()
@@ -192,3 +208,212 @@ def test_scheduler_no_head_of_line_across_geometries(device_codec):
     # post-fix: all drain in one wakeup (~0.4 s + dispatch)
     assert elapsed < 0.4 * len(geos) - 0.05, \
         f"geometry buckets serialized: {elapsed:.2f}s"
+
+
+# ---------------------------------------------------------------------------
+# PR 6: multi-verb former
+# ---------------------------------------------------------------------------
+
+def test_full_bucket_dispatches_immediately(device_codec):
+    """A bucket already holding >= max_batch blocks must dispatch NOW,
+    not after the grace window (the grace-window stall fix): with a
+    5 s window, resolution must arrive orders of magnitude sooner."""
+    codec = Codec(4, 2, 4 * 256)
+    data = np.random.default_rng(21).integers(
+        0, 256, (4, 4, 256), dtype=np.uint8)
+    # pre-warm the device program outside the timed window
+    codec.encode_and_hash_batch(data, HH)
+    sched = BatchScheduler(max_batch=4, max_wait=5.0)
+    try:
+        t0 = time.perf_counter()
+        out = sched.encode_and_hash(codec, data, HH)
+        elapsed = time.perf_counter() - t0
+        assert out is not None
+        assert elapsed < 2.0, \
+            f"full bucket slept the grace window: {elapsed:.2f}s"
+    finally:
+        sched.close()
+
+
+def test_close_with_pending_flushes_to_cpu_fallback(device_codec):
+    """close() must resolve queued waiters (CPU-route: result None so
+    callers fall back) and JOIN the collector — nobody hangs."""
+    sched = BatchScheduler(max_batch=64, max_wait=30.0)
+    codec = Codec(4, 2, 4 * 128)
+    data = np.zeros((1, 4, 128), np.uint8)
+    fut = sched.submit(codec, data, HH)
+    assert not fut.done()          # parked in the 30 s grace window
+    t0 = time.perf_counter()
+    sched.close()
+    assert fut.result(timeout=5) is None      # CPU fallback, no hang
+    assert time.perf_counter() - t0 < 10
+    assert not sched._thread.is_alive()       # collector joined
+    # post-close submissions decline instantly
+    assert sched.submit(codec, data, HH).result() is None
+
+
+def test_mixed_verb_mixed_geometry_coalescing(device_codec):
+    """Concurrent encode + decode + recover groups of two geometries:
+    same-key groups coalesce into shared dispatches, every verb's
+    scatter is byte-identical to its host oracle."""
+    sched = BatchScheduler(max_batch=64, max_wait=0.2)
+    k, m, s = 4, 2, 256
+    codec = Codec(k, m, k * s)
+    codec6 = Codec(6, 2, 6 * 128)
+    enc_in = [np.random.default_rng(30 + i).integers(
+        0, 256, (2, k, s), dtype=np.int64).astype(np.uint8)
+        for i in range(2)]
+    surv, mask, full = _degraded(31, 2, k, m, s, lost=(1, 4))
+    surv6, mask6, full6 = _degraded(32, 2, 6, 2, 128, lost=(0,))
+    lost_rows = {1, 4}
+    results: dict = {}
+    errs: list = []
+
+    def run(name, fn):
+        try:
+            results[name] = fn()
+        except Exception as e:  # noqa: BLE001
+            errs.append((name, e))
+
+    jobs = {
+        "enc0": lambda: sched.encode_and_hash(codec, enc_in[0], HH),
+        "enc1": lambda: sched.encode_and_hash(codec, enc_in[1], HH),
+        "dec0": lambda: sched.submit_decode(
+            codec, surv, mask, s, HH).result(30),
+        "dec1": lambda: sched.submit_decode(
+            codec, surv, mask, s, HH).result(30),
+        "dec6": lambda: sched.submit_decode(
+            codec6, surv6, mask6, 128, HH).result(30),
+        "rec0": lambda: sched.submit_recover(
+            codec, surv, mask, lost_rows, s, HH).result(30),
+    }
+    threads = [threading.Thread(target=run, args=(nm, fn))
+               for nm, fn in jobs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    sched.close()
+    assert not errs, errs
+    assert set(results) == set(jobs)
+
+    # encode oracle
+    for i, nm in enumerate(("enc0", "enc1")):
+        full_got, _dg = results[nm]
+        assert (full_got == codec.encode_batch(enc_in[i],
+                                               force="numpy")).all()
+    # decode oracle: missing data rows + survivor digests
+    dm, used, missing = rs_matrix.missing_data_matrix(k, m, mask)
+    want = np.stack([gf256.gf_matmul(np.asarray(dm, np.uint8), sv)
+                     for sv in surv])
+    for nm in ("dec0", "dec1"):
+        out, missing_idx, sdig = results[nm]
+        assert tuple(missing_idx) == missing
+        assert (out == want).all()
+        for col, u in enumerate(used):
+            assert sdig[0, col].tobytes() == bitrot_mod.hash_shard(
+                full[0, u].tobytes(), HH)
+    out6, midx6, _ = results["dec6"]
+    assert (out6[:, 0] == full6[:, 0]).all() and midx6 == (0,)
+    # recover oracle: rebuilt rows + their fresh digests
+    rout, idxs, _sdig, odig = results["rec0"]
+    assert tuple(idxs) == tuple(sorted(lost_rows))
+    for r, mi in enumerate(idxs):
+        assert (rout[:, r] == full[:, mi]).all()
+        assert odig[0, r].tobytes() == bitrot_mod.hash_shard(
+            full[0, mi].tobytes(), HH)
+    # the two same-key decode groups shared one fused dispatch
+    st = sched.stats()["verbs"]
+    assert st["decode"]["coalesced"] >= 1
+    assert st["decode"]["batches"] < 3
+    assert st["encode"]["batches"] >= 1
+    assert st["recover"]["batches"] == 1
+
+
+def test_decode_dispatch_error_fans_out_to_all_waiters(device_codec,
+                                                       monkeypatch):
+    """One fused decode dying must surface the SAME error to every
+    waiter that coalesced into it."""
+    from minio_tpu.object import codec as codec_mod
+    sched = BatchScheduler(max_batch=64, max_wait=0.2)
+    k, m, s = 4, 2, 128
+    codec = Codec(k, m, k * s)
+    surv, mask, _full = _degraded(40, 1, k, m, s, lost=(0,))
+
+    def boom(*a, **kw):
+        raise RuntimeError("decode device on fire")
+
+    monkeypatch.setattr(codec_mod.Codec, "verify_and_decode_batch", boom)
+    errs: list = []
+
+    def one():
+        try:
+            sched.submit_decode(codec, surv, mask, s, HH).result(30)
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    sched.close()
+    assert errs == ["decode device on fire"] * 3
+
+
+def test_coalesced_decode_byte_identical_to_serial_cpu(device_codec):
+    """Acceptance pin: shards reconstructed through a COALESCED fused
+    decode are byte-identical to the serial CPU oracle path
+    (gf256 matmul per block, no batching, no device)."""
+    sched = BatchScheduler(max_batch=64, max_wait=0.2)
+    k, m, s = 4, 2, 192
+    codec = Codec(k, m, k * s)
+    outs: list = [None] * 4
+    inputs = []
+    for i in range(4):
+        surv, mask, full = _degraded(50 + i, 2, k, m, s, lost=(2, 5))
+        inputs.append((surv, mask, full))
+
+    def run(i):
+        surv, mask, _ = inputs[i]
+        outs[i] = sched.submit_decode(codec, surv, mask, s, HH
+                                      ).result(30)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    sched.close()
+    for i, (surv, mask, full) in enumerate(inputs):
+        assert outs[i] is not None
+        out, missing_idx, _sdig = outs[i]
+        dm, _used, missing = rs_matrix.missing_data_matrix(k, m, mask)
+        assert tuple(missing_idx) == missing
+        # serial CPU oracle: one host matmul per block
+        for bi in range(surv.shape[0]):
+            want = gf256.gf_matmul(np.asarray(dm, np.uint8), surv[bi])
+            assert out[bi].tobytes() == want.tobytes()
+            for r, mi in enumerate(missing):
+                assert (out[bi, r] == full[bi, mi]).all()
+    assert sched.coalesced >= 1       # they actually shared dispatches
+
+
+def test_sched_totals_exposed_as_prometheus_counters(device_codec):
+    """minio_tpu_sched_batches_total / _coalesced_total are monotonic
+    totals — they must expose as TYPE counter (rate()-able), labelled
+    by verb, not as collector-set gauges."""
+    from minio_tpu.utils import telemetry
+    sched = BatchScheduler(max_batch=64, max_wait=0.05)
+    codec = Codec(4, 2, 4 * 256)
+    data = np.random.default_rng(60).integers(
+        0, 256, (2, 4, 256), dtype=np.uint8)
+    assert sched.encode_and_hash(codec, data, HH) is not None
+    sched.close()
+    text = telemetry.REGISTRY.render()
+    assert "# TYPE minio_tpu_sched_batches_total counter" in text
+    assert "# TYPE minio_tpu_sched_coalesced_total counter" in text
+    assert 'minio_tpu_sched_batches_total{verb="encode"}' in text
+    # occupancy stays a gauge (instantaneous, per-verb labelled)
+    assert "# TYPE minio_tpu_sched_batch_occupancy_groups gauge" in text
