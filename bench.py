@@ -846,6 +846,149 @@ def bench_tier_ab(streams: int = 8, size: int = 4 << 20,
     return out
 
 
+def bench_list_ab(keys: int = 10000, drives: int = 8, parity: int = 2,
+                  page: int = 1000, versions_every: int = 20,
+                  payload_bytes: int = 16) -> dict:
+    """Listing A/B: merge-walk vs persisted bucket metacache.
+
+    One pool on tmpfs seeded with `keys` small objects (a nested
+    prefix every 4th key, an extra version every `versions_every`-th),
+    then per mode:
+
+      * page the whole namespace (max_keys=`page`) and report per-page
+        p50/p99 — the walk mode re-runs the heap merge + per-name
+        quorum metadata read every page, the index mode slices memory;
+      * run one "crawler cycle" (DataUsageCrawler.scan_once plus the
+        noncurrent version-group walks the lifecycle sweep and the
+        tier transition action run) and report wall time + the
+        namespace-walk counter delta — with the index attached the
+        cycle performs ZERO merge walks: the one amortized walk
+        happened at build time (reported separately as build_s).
+
+    The index-served pages are asserted name-identical to the
+    merge-walk pages before timing (the oracle discipline the erasure
+    kernels use)."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.features.lifecycle import iter_version_groups
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.background import DataUsageCrawler
+    from minio_tpu.object.engine import PutOptions
+    from minio_tpu.object.metacache import MetacacheManager, walks_counter
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.object.sets import ErasureSets
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_list_", dir=base)
+    payload = os.urandom(payload_bytes)
+    out: dict = {"config": {"keys": keys, "drives": drives, "m": parity,
+                            "page": page,
+                            "versions_every": versions_every}}
+
+    def walk_totals() -> dict:
+        c = walks_counter()
+        with c._mu:
+            items = dict(c._series)
+        tot = {"merge": 0.0, "index": 0.0}
+        for key, v in items.items():
+            src = dict(key).get("source", "merge")
+            tot[src] = tot.get(src, 0.0) + v
+        return tot
+
+    def pcts(lat: list) -> dict:
+        xs = sorted(lat)
+        return {"p50_ms": round(xs[len(xs) // 2] * 1e3, 3),
+                "p99_ms": round(xs[max(0, int(len(xs) * 0.99) - 1)]
+                                * 1e3, 3)}
+
+    try:
+        zz = ErasureServerSets([ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=1 << 18, enable_mrf=False)],
+            load_topology=False)
+        zz.make_bucket("bench")
+        t0 = time.perf_counter()
+        for i in range(keys):
+            name = f"dir{i % 4}/obj-{i:07d}" if i % 4 else f"obj-{i:07d}"
+            zz.put_object("bench", name, payload)
+            if versions_every and i % versions_every == 0:
+                zz.put_object("bench", name, payload,
+                              opts=PutOptions(versioned=True))
+        out["seed_s"] = round(time.perf_counter() - t0, 2)
+
+        def page_walk() -> tuple[list, list]:
+            lats, names, marker = [], [], ""
+            while True:
+                t0 = time.perf_counter()
+                objs, _pfx, trunc = zz.list_objects("bench", "", marker,
+                                                    "", page)
+                lats.append(time.perf_counter() - t0)
+                names.extend(o.name for o in objs)
+                if not trunc or not objs:
+                    return lats, names
+                marker = objs[-1].name
+
+        crawler = DataUsageCrawler(zz, interval=1e9, persist=False)
+
+        def cycle() -> dict:
+            before = walk_totals()
+            t0 = time.perf_counter()
+            crawler.scan_once()
+            for _ in iter_version_groups(zz, "bench",
+                                         consumer="lifecycle"):
+                pass
+            for _ in iter_version_groups(zz, "bench",
+                                         consumer="transition"):
+                pass
+            wall = time.perf_counter() - t0
+            after = walk_totals()
+            return {"wall_s": round(wall, 3),
+                    "merge_walks": round(after["merge"]
+                                         - before["merge"], 1),
+                    "index_reads": round(after["index"]
+                                         - before["index"], 1)}
+
+        # -- phase A: merge-walk (no index attached) -----------------------
+        walk_lats, walk_names = page_walk()
+        out["walk"] = dict(pcts(walk_lats), pages=len(walk_lats),
+                           cycle=cycle())
+
+        # -- phase B: metacache index --------------------------------------
+        mgr = MetacacheManager(zz, flush_s=0.05).start()
+        zz.attach_metacache(mgr)
+        t0 = time.perf_counter()
+        assert mgr.build("bench")
+        out["build_s"] = round(time.perf_counter() - t0, 2)
+        idx_lats, idx_names = page_walk()
+        if idx_names != walk_names:     # oracle: identical pages
+            raise AssertionError(
+                f"index pages diverged from merge-walk: "
+                f"{len(idx_names)} vs {len(walk_names)} names")
+        out["index"] = dict(pcts(idx_lats), pages=len(idx_lats),
+                            cycle=cycle(),
+                            metacache=mgr.stats())
+        out["index"]["metacache"].pop("buckets", None)
+        out["page_p50_speedup_x"] = round(
+            out["walk"]["p50_ms"] / max(out["index"]["p50_ms"], 1e-9), 2)
+        out["cycle_speedup_x"] = round(
+            out["walk"]["cycle"]["wall_s"]
+            / max(out["index"]["cycle"]["wall_s"], 1e-9), 2)
+    finally:
+        try:
+            # stop the metacache daemon BEFORE its backing tree is
+            # deleted, even when a phase raised
+            zz.close()
+        except Exception:  # noqa: BLE001 — includes zz never assigned
+            pass
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ab-pipeline", action="store_true",
@@ -882,6 +1025,16 @@ def main() -> int:
                     help="tiny 2-point sweep (streams 1,2; 4-block "
                          "objects; 4+2 set) for CI — seconds, not "
                          "minutes")
+    ap.add_argument("--ab-list", action="store_true",
+                    help="run ONLY the listing A/B (merge-walk vs "
+                         "metacache index): page p50/p99 + one "
+                         "crawler-cycle wall time + walk counts")
+    ap.add_argument("--ab-list-keys", type=int,
+                    default=int(os.environ.get("BENCH_LIST_KEYS",
+                                               "10000")))
+    ap.add_argument("--ab-list-smoke", action="store_true",
+                    help="tiny listing A/B (400 keys, 50-key pages) "
+                         "for CI — seconds, not minutes")
     ap.add_argument("--ab-tier", action="store_true",
                     help="run ONLY the tier-transition-throttle A/B "
                          "(foreground PUT p50/p99 with vs without the "
@@ -906,6 +1059,21 @@ def main() -> int:
             "value": top.get("deg_get_gib_s"),
             "unit": "GiB/s",
             "saturation": sat,
+        }))
+        return 0
+
+    if args.ab_list or args.ab_list_smoke:
+        if args.ab_list_smoke:
+            ab = bench_list_ab(keys=400, drives=6, page=50,
+                               versions_every=16)
+        else:
+            ab = bench_list_ab(keys=args.ab_list_keys)
+        print(json.dumps({
+            "metric": "listing page p50 speedup, metacache index vs "
+                      "merge-walk (persisted bucket index A/B)",
+            "value": ab.get("page_p50_speedup_x"),
+            "unit": "x",
+            "list_ab": ab,
         }))
         return 0
 

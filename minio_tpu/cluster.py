@@ -356,6 +356,18 @@ class ClusterNode:
             pass
         self.s3.api.tiers = self.tiers
 
+        # -- bucket metacache (persisted listing index + scanner feed) -----
+        from .object.metacache import MetacacheManager
+        from .object import metacache as _mc
+        self.metacache = None
+        if _mc.enabled() and not self.distributed:
+            # single-node clusters only today: deltas are engine-local,
+            # so writes through a PEER's S3 endpoint would never feed
+            # this node's journal — distributed nodes keep the
+            # merge-walk (README "Listing and the bucket metacache")
+            self.metacache = MetacacheManager(self.object_layer).start()
+            self.object_layer.attach_metacache(self.metacache)
+
         # -- background plane (initAutoHeal + initDataCrawler) -------------
         from .object.background import (DataUsageCrawler, DiskMonitor,
                                         HealScanner)
@@ -489,6 +501,9 @@ class ClusterNode:
         if getattr(self, "heal_scanner", None) is not None:
             self.heal_scanner.close()
             self.heal_scanner = None
+        if getattr(self, "metacache", None) is not None:
+            self.metacache.close()
+            self.metacache = None
         if getattr(self, "update_tracker", None) is not None:
             try:
                 self.update_tracker.flush()

@@ -282,41 +282,72 @@ def noncurrent_sweep_action(bucket_meta_sys, object_layer,
         if not any(r.enabled and r.noncurrent_days for r in lc.rules):
             return
         now = now_fn()
-        marker = ""
-        while True:
-            try:
-                versions = object_layer.list_object_versions(
-                    bucket, "", marker, 1000)
-            except api_errors.ObjectApiError:
+
+        def expire_group(name: str, vs: list) -> None:
+            days = lc.noncurrent_expiry_days(name)
+            if not days:
                 return
-            if not versions:
-                return
-            by_name: dict[str, list] = {}
-            for v in versions:
-                by_name.setdefault(v.name, []).append(v)
-            for name, vs in by_name.items():
-                days = lc.noncurrent_expiry_days(name)
-                if not days:
-                    continue
-                vs.sort(key=lambda v: -v.mod_time)
-                for i in range(1, len(vs)):     # index 0 = current
-                    became_noncurrent = vs[i - 1].mod_time
-                    if became_noncurrent < now - days * 86400:
-                        try:
-                            object_layer.delete_object(
-                                bucket, name,
-                                version_id=vs[i].version_id)
-                        except api_errors.ObjectApiError:
-                            continue
-                        if tiers is not None:
-                            from ..tier.transition import free_remote
-                            free_remote(tiers,
-                                        vs[i].user_defined or {})
-            if len(versions) < 1000:
-                return
-            marker = versions[-1].name
+            vs = sorted(vs, key=lambda v: -v.mod_time)
+            for i in range(1, len(vs)):         # index 0 = current
+                became_noncurrent = vs[i - 1].mod_time
+                if became_noncurrent < now - days * 86400:
+                    try:
+                        object_layer.delete_object(
+                            bucket, name, version_id=vs[i].version_id)
+                    except api_errors.ObjectApiError:
+                        continue
+                    if tiers is not None:
+                        from ..tier.transition import free_remote
+                        free_remote(tiers, vs[i].user_defined or {})
+
+        for name, vs in iter_version_groups(object_layer, bucket,
+                                            consumer="lifecycle"):
+            expire_group(name, vs)
 
     return act
+
+
+def iter_version_groups(object_layer, bucket: str,
+                        consumer: str = "scanner"):
+    """Yield (name, versions) groups of one bucket's whole version
+    history — the shared walk of every version-driven scanner
+    (noncurrent expiry/transition sweeps).
+
+    Prefers the metacache namespace feed (no walk: the index already
+    holds each name's quorum-merged version list); falls back to
+    paging `list_object_versions` with the key/version-id markers,
+    carrying a page-cut group across pages so a name's versions are
+    always seen TOGETHER (a group split across pages would mis-clock
+    which version is current)."""
+    from ..object import api_errors
+    mc = getattr(object_layer, "metacache", None)
+    feed = mc.namespace_feed(bucket, versions=True, consumer=consumer) \
+        if mc is not None else None
+    if feed is not None:
+        yield from feed
+        return
+    from ..object.metacache import walks_counter
+    walks_counter().inc(consumer=consumer, source="merge")
+    marker = vid_marker = ""
+    carry_name: Optional[str] = None
+    carry: list = []
+    while True:
+        try:
+            versions, nkm, nvm, trunc = object_layer.list_object_versions(
+                bucket, "", marker, 1000, vid_marker)
+        except api_errors.ObjectApiError:
+            return
+        for v in versions:
+            if carry_name is not None and v.name != carry_name:
+                yield carry_name, carry
+                carry = []
+            carry_name = v.name
+            carry.append(v)
+        if not trunc:
+            break
+        marker, vid_marker = nkm, nvm
+    if carry_name is not None and carry:
+        yield carry_name, carry
 
 
 def mpu_abort_action(bucket_meta_sys, object_layer, now_fn=time.time):

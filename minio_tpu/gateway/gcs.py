@@ -32,7 +32,7 @@ from typing import Iterator, Optional
 from ..object import api_errors
 from ..object.engine import GetOptions, PutOptions
 from ..object.hash_reader import HashReader
-from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo
+from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo, single_version_page
 from ..s3.credentials import Credentials
 from ..utils.s3client import S3Client
 from .s3 import S3GatewayObjects
@@ -557,10 +557,11 @@ class GCSJsonGatewayObjects:
         return combined, sorted(prefixes), truncated
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", max_keys: int = 1000):
-        objs, _, _ = self.list_objects(bucket, prefix, marker, "",
+                             marker: str = "", max_keys: int = 1000,
+                             version_marker: str = ""):
+        objs, _p, trunc = self.list_objects(bucket, prefix, marker, "",
                                        max_keys)
-        return objs
+        return single_version_page(objs, trunc)
 
     # -- multipart: compose-based (gateway-gcs.go:988-1380) ----------------
 

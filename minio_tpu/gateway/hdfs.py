@@ -21,7 +21,7 @@ from typing import Iterator, Optional
 from ..object import api_errors
 from ..object.engine import GetOptions, PutOptions
 from ..object.hash_reader import HashReader
-from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo
+from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo, single_version_page
 
 
 class WebHDFSError(Exception):
@@ -369,10 +369,11 @@ class HDFSGatewayObjects:
         return objs, prefixes, truncated
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", max_keys: int = 1000):
-        objs, _p, _t = self.list_objects(bucket, prefix, marker,
+                             marker: str = "", max_keys: int = 1000,
+                             version_marker: str = ""):
+        objs, _p, trunc = self.list_objects(bucket, prefix, marker,
                                          max_keys=max_keys)
-        return objs
+        return single_version_page(objs, trunc)
 
     # -- multipart (buffered parts, like the S3-proxy gateway) --------------
 

@@ -54,6 +54,22 @@ MRF_BACKOFF_MAX = float(os.environ.get("MINIO_TPU_MRF_BACKOFF_MAX",
                                        "15.0"))
 
 
+def paged_list_objects(obj, bucket: str):
+    """The scanners' shared merge-walk fallback: every listable object
+    in one bucket, paged through list_objects (1000/page)."""
+    marker = ""
+    while True:
+        try:
+            objs, _, trunc = obj.list_objects(bucket, "", marker, "",
+                                              1000)
+        except api_errors.ObjectApiError:
+            return
+        yield from objs
+        if not trunc or not objs:
+            return
+        marker = objs[-1].name
+
+
 class MRFHealer:
     """Bounded background heal queue with retry + exponential backoff.
 
@@ -481,32 +497,24 @@ class HealScanner(_ScanLoop):
                 for idx, s in snaps if s is not None)
 
         checked = 0
+        mc = getattr(self.obj, "metacache", None)
         for vol in self.obj.list_buckets():
             b = vol.name
             if not changed(b):
                 self.skipped_buckets += 1
                 continue
-            marker = ""
-            while True:
+            for oi in self._bucket_objects(mc, b):
+                if not changed(b, oi.name):
+                    continue
+                self.scanned += 1
+                checked += 1
                 try:
-                    objs, _, trunc = self.obj.list_objects(
-                        b, "", marker, "", 1000)
+                    res = self.obj.heal_object(b, oi.name)
+                    if getattr(res, "disks_healed", 0):
+                        self.healed += res.disks_healed
                 except api_errors.ObjectApiError:
-                    break
-                for oi in objs:
-                    if not changed(b, oi.name):
-                        continue
-                    self.scanned += 1
-                    checked += 1
-                    try:
-                        res = self.obj.heal_object(b, oi.name)
-                        if getattr(res, "disks_healed", 0):
-                            self.healed += res.disks_healed
-                    except api_errors.ObjectApiError:
-                        pass
-                if not trunc or not objs:
-                    break
-                marker = objs[-1].name
+                    pass
+        self._heal_metacache_segments(mc)
         self.last_cycle = pass_cycle
         # every reachable peer's rotated window was covered this pass
         # (pruned or scanned under its hints)
@@ -514,6 +522,34 @@ class HealScanner(_ScanLoop):
             if s is not None:
                 self._peer_covered[idx] = s.cycle - 1
         return checked
+
+    def _bucket_objects(self, mc, bucket: str):
+        """One bucket's listable objects: the metacache namespace feed
+        when available (no walk), else the paged merge-walk."""
+        from .metacache import walks_counter
+        feed = mc.namespace_feed(bucket, consumer="heal") \
+            if mc is not None else None
+        if feed is not None:
+            yield from feed
+            return
+        walks_counter().inc(consumer="heal", source="merge")
+        yield from paged_list_objects(self.obj, bucket)
+
+    def _heal_metacache_segments(self, mc) -> int:
+        """Sweep-heal the index's own manifest/segment objects: they
+        are ordinary erasure-coded objects, but live under the hidden
+        meta bucket the regular bucket walk never visits — without this
+        a replaced drive would never regain its index shards."""
+        if mc is None:
+            return 0
+        healed = 0
+        for key in mc.segment_objects():
+            try:
+                self.obj.heal_object(MINIO_META_BUCKET, key)
+                healed += 1
+            except api_errors.ObjectApiError:
+                continue
+        return healed
 
 
 class DataUsageCrawler(_ScanLoop):
@@ -537,6 +573,8 @@ class DataUsageCrawler(_ScanLoop):
         self._init_loop()
 
     def scan_once(self) -> dict:
+        from .metacache import walks_counter
+        mc = getattr(self.obj, "metacache", None)
         buckets: dict[str, dict] = {}
         for vol in self.obj.list_buckets():
             b = vol.name
@@ -546,24 +584,19 @@ class DataUsageCrawler(_ScanLoop):
                 except Exception:  # noqa: BLE001 — per-bucket
                     pass
             count = size = 0
-            marker = ""
-            while True:
-                try:
-                    objs, _, trunc = self.obj.list_objects(
-                        b, "", marker, "", 1000)
-                except api_errors.ObjectApiError:
-                    break
-                for oi in objs:
-                    count += 1
-                    size += oi.size
-                    for action in self.actions:
-                        try:
-                            action(b, oi)
-                        except Exception:  # noqa: BLE001 — per-object
-                            pass
-                if not trunc or not objs:
-                    break
-                marker = objs[-1].name
+            feed = mc.namespace_feed(b, consumer="crawler") \
+                if mc is not None else None
+            if feed is None:
+                walks_counter().inc(consumer="crawler", source="merge")
+                feed = paged_list_objects(self.obj, b)
+            for oi in feed:
+                count += 1
+                size += oi.size
+                for action in self.actions:
+                    try:
+                        action(b, oi)
+                    except Exception:  # noqa: BLE001 — per-object
+                        pass
             buckets[b] = {"objects": count, "size": size}
         self.usage = {
             "buckets": buckets,

@@ -108,6 +108,12 @@ class ErasureObjects:
         # heal queue instead of waiting for the next scanner sweep
         # (reference maintainMRFList, cmd/erasure-sets.go:1641)
         self.on_degraded_write = None
+        # metacache hook: called (bucket, object) after EVERY successful
+        # namespace mutation (PUT / delete / delete marker / transition
+        # / metadata update / multipart commit) — feeds the persisted
+        # bucket index's delta journal (object/metacache.py). Must never
+        # block: the receiver only appends to a bounded queue.
+        self.on_namespace_change = None
 
     # ------------------------------------------------------------------
     # helpers
@@ -300,6 +306,7 @@ class ErasureObjects:
             # quorum met but some drives missed the write: queue an MRF
             # heal so the object converges back to full redundancy
             self._notify_degraded(bucket, object_name, fi.version_id)
+        self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
     def _encode_stream(self, reader, codec: Codec, writers,
@@ -749,6 +756,7 @@ class ErasureObjects:
             fi.metadata = new_meta
         if any(e is not None for e in errs):
             self._notify_degraded(bucket, object_name, version_id)
+        self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
     def transition_object(self, bucket: str, object_name: str,
@@ -832,6 +840,7 @@ class ErasureObjects:
             fi.data_dir = ""
         if any(e is not None for e in errs):
             self._notify_degraded(bucket, object_name, fi.version_id)
+        self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
     def put_stub_version(self, bucket: str, object_name: str,
@@ -870,6 +879,7 @@ class ErasureObjects:
             metas = [fi.light_copy() for _ in range(len(self.disks))]
             meta.write_unique_file_info(self.disks, bucket, object_name,
                                         metas, write_quorum)
+        self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
     def get_object_info(self, bucket: str, object_name: str,
@@ -1287,6 +1297,7 @@ class ErasureObjects:
                 oi = fi.to_object_info(bucket, object_name)
                 self._flag_degraded_delete(bucket, object_name,
                                            fi.version_id, errs)
+                self._notify_namespace(bucket, object_name)
                 return oi
 
             fi = FileInfo(volume=bucket, name=object_name,
@@ -1303,6 +1314,7 @@ class ErasureObjects:
             if err is not None:
                 raise api_errors.to_object_err(err, bucket, object_name)
         self._flag_degraded_delete(bucket, object_name, version_id, errs)
+        self._notify_namespace(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name,
                           version_id=version_id)
 
@@ -1327,6 +1339,7 @@ class ErasureObjects:
                 raise api_errors.to_object_err(err, bucket, object_name)
         self._flag_degraded_delete(bucket, object_name, fi.version_id,
                                    errs)
+        self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
     def _notify_degraded(self, bucket: str, object_name: str,
@@ -1338,6 +1351,19 @@ class ErasureObjects:
         try:
             self.on_degraded_write(bucket, object_name, version_id)
         except Exception:  # noqa: BLE001 — heal queueing is best-effort
+            pass
+
+    def _notify_namespace(self, bucket: str, object_name: str) -> None:
+        """Best-effort on_namespace_change invocation (the
+        _notify_degraded pattern): every successful namespace mutation
+        reports (bucket, object) so the persisted bucket metacache can
+        journal the delta. Hidden meta buckets never feed the index —
+        the index's own segment writes land there."""
+        if self.on_namespace_change is None or bucket.startswith("."):
+            return
+        try:
+            self.on_namespace_change(bucket, object_name)
+        except Exception:  # noqa: BLE001 — indexing is best-effort
             pass
 
     def _flag_degraded_delete(self, bucket: str, object_name: str,
@@ -1383,6 +1409,8 @@ class ErasureObjects:
                 per_disk, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
             out.append(None if err is None
                        else api_errors.to_object_err(err, bucket, o))
+            if err is None:
+                self._notify_namespace(bucket, o)
         return out
 
     # ------------------------------------------------------------------
@@ -1394,55 +1422,74 @@ class ErasureObjects:
                      ) -> tuple[list[ObjectInfo], list[str], bool]:
         """Returns (objects, common_prefixes, is_truncated)."""
         self.get_bucket_info(bucket)  # existence + quorum check
-        names = self._merged_names(bucket, prefix, marker)
-        objects: list[ObjectInfo] = []
-        prefixes: list[str] = []
-        seen_prefix: set[str] = set()
-        truncated = False
-        for name in names:
-            if marker and name <= marker:
-                continue
-            if delimiter:
-                rest = name[len(prefix):]
-                di = rest.find(delimiter)
-                if di >= 0:
-                    p = prefix + rest[:di + len(delimiter)]
-                    if marker and p <= marker:
-                        continue  # prefix page already returned
-                    if p not in seen_prefix:
-                        seen_prefix.add(p)
-                        prefixes.append(p)
-                        if len(objects) + len(prefixes) >= max_keys + 1:
-                            truncated = True
-                            prefixes = prefixes[:max_keys - len(objects)]
-                            break
-                    continue
+
+        def read_latest(name: str):
             try:
                 fi = self._read_one(bucket, name)
             except api_errors.ObjectApiError:
-                continue
+                return None
             if fi.deleted:
-                continue
-            objects.append(fi.to_object_info(bucket, name))
-            if len(objects) + len(prefixes) >= max_keys + 1:
-                truncated = True
-                objects = objects[:max_keys - len(prefixes)]
-                break
-        return objects, prefixes, truncated
+                return None
+            return fi.to_object_info(bucket, name)
+
+        return paginate_objects(self._merged_names(bucket, prefix, marker),
+                                read_latest, prefix, marker, delimiter,
+                                max_keys)
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", max_keys: int = 1000
-                             ) -> list[ObjectInfo]:
+                             marker: str = "", max_keys: int = 1000,
+                             version_marker: str = ""
+                             ) -> tuple[list[ObjectInfo], str, str, bool]:
+        """One page of the bucket's version history: (versions,
+        next_key_marker, next_version_id_marker, is_truncated).
+
+        A page boundary may fall INSIDE one key's version list — the
+        returned markers make the cut explicit and resumable (the old
+        bare-list form cut mid-object with no truncation signal, so a
+        pager silently lost the key's remaining versions).
+        `version_marker` resumes AFTER that version of `marker` (S3
+        version-id-marker semantics); an unknown version id falls back
+        to the key's whole version list, which can only over-return,
+        never skip."""
         self.get_bucket_info(bucket)
+        if max_keys <= 0:
+            return [], "", "", False
         out: list[ObjectInfo] = []
-        for name in self._merged_names(bucket, prefix, marker):
-            if marker and name <= marker:
-                continue
-            out.extend(fi.to_object_info(bucket, name)
-                       for fi in self._merged_versions(bucket, name))
-            if len(out) >= max_keys:
-                break
-        return out
+        names = self._merged_names(bucket, prefix, marker,
+                                   inclusive=bool(version_marker))
+        for name in names:
+            if marker:
+                if name < marker or (not version_marker
+                                     and name == marker):
+                    continue
+            vers = self.object_versions(bucket, name)
+            if version_marker and name == marker:
+                # "null" is the wire form of the empty (pre-versioning)
+                # version id (xmlgen emits it, clients echo it back)
+                vm = "" if version_marker == "null" else version_marker
+                idx = next((i for i, v in enumerate(vers)
+                            if v.version_id == vm), None)
+                if idx is not None:
+                    vers = vers[idx + 1:]
+            for oi in vers:
+                if len(out) >= max_keys:
+                    # an overflow version was actually SEEN: the page
+                    # is provably truncated, markers point at the cut.
+                    # A null version id rides as the "null" sentinel —
+                    # an empty marker would read as NO marker on resume
+                    # and skip the key's remaining versions
+                    return (out, out[-1].name,
+                            out[-1].version_id or "null", True)
+                out.append(oi)
+        return out, "", "", False
+
+    def object_versions(self, bucket: str, name: str) -> list[ObjectInfo]:
+        """Quorum-merged versions of ONE object as API ObjectInfos,
+        newest first — the per-name unit of list_object_versions, the
+        metacache refresh read, and the pool-local read the rebalance
+        feed path uses."""
+        return [fi.to_object_info(bucket, name)
+                for fi in self._merged_versions(bucket, name)]
 
     def _merged_versions(self, bucket: str, name: str) -> list[FileInfo]:
         """Quorum-merge the per-drive xl.meta version journals of one
@@ -1471,23 +1518,29 @@ class ErasureObjects:
         return merged
 
     def _merged_names(self, bucket: str, prefix: str,
-                      marker: str = "") -> Iterator[str]:
+                      marker: str = "",
+                      inclusive: bool = False) -> Iterator[str]:
         """Lazy lexical merge-walk of object names across drives (the
         reference's startMergeWalks/lexicallySortedEntry,
         cmd/erasure-sets.go:888-1081): each drive streams its own sorted
         walk, a heap merge dedupes, and nothing is materialized — a
         100k-key bucket costs one page, not one set.
 
-        Yields names > marker matching prefix, in order, until the
-        caller stops."""
+        Yields names > marker (>= marker when `inclusive` — the
+        version-marker resume re-enters the marker key itself) matching
+        prefix, in order, until the caller stops."""
         import heapq
 
         # narrow the walk to the deepest directory of the prefix
         dir_part = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+        # drive walks yield strictly > their marker; shortening the
+        # marker by one char re-admits the marker name itself (plus a
+        # few predecessors the caller filters out)
+        walk_marker = marker[:-1] if (inclusive and marker) else marker
 
         def drive_names(d) -> Iterator[str]:
             try:
-                for fi in d.walk(bucket, dir_part, marker):
+                for fi in d.walk(bucket, dir_part, walk_marker):
                     yield fi.name
             except serr.StorageError:
                 return              # drive died mid-walk: its names drop
@@ -1514,6 +1567,52 @@ class ErasureObjects:
     def _read_one(self, bucket: str, object_name: str) -> FileInfo:
         fi, _, _ = self._object_file_info(bucket, object_name)
         return fi
+
+
+def paginate_objects(names, read_latest, prefix: str, marker: str,
+                     delimiter: str, max_keys: int
+                     ) -> tuple[list[ObjectInfo], list[str], bool]:
+    """The single home of the object-listing page shape: delimiter
+    grouping, marker skips, and max_keys truncation over a sorted
+    prefix-matching name stream. Both the merge-walk path
+    (ErasureObjects.list_objects) and the metacache index serve run
+    THIS loop, so index-served pages are shape-identical to the oracle
+    by construction.
+
+    `read_latest(name)` returns the listable ObjectInfo or None (no
+    quorum, or the latest version is a delete marker — either way the
+    name does not count toward the page)."""
+    objects: list[ObjectInfo] = []
+    prefixes: list[str] = []
+    seen_prefix: set[str] = set()
+    truncated = False
+    for name in names:
+        if marker and name <= marker:
+            continue
+        if delimiter:
+            rest = name[len(prefix):]
+            di = rest.find(delimiter)
+            if di >= 0:
+                p = prefix + rest[:di + len(delimiter)]
+                if marker and p <= marker:
+                    continue  # prefix page already returned
+                if p not in seen_prefix:
+                    seen_prefix.add(p)
+                    prefixes.append(p)
+                    if len(objects) + len(prefixes) >= max_keys + 1:
+                        truncated = True
+                        prefixes = prefixes[:max_keys - len(objects)]
+                        break
+                continue
+        oi = read_latest(name)
+        if oi is None:
+            continue
+        objects.append(oi)
+        if len(objects) + len(prefixes) >= max_keys + 1:
+            truncated = True
+            objects = objects[:max_keys - len(prefixes)]
+            break
+    return objects, prefixes, truncated
 
 
 class _UnlockOnClose:

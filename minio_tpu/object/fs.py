@@ -21,7 +21,7 @@ import time
 import uuid as _uuid
 from typing import Iterator, Optional
 
-from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo
+from ..storage.datatypes import ObjectInfo, ObjectPartInfo, VolInfo, single_version_page
 from . import api_errors
 from .engine import GetOptions, PutOptions, _read_full
 from .hash_reader import HashReader
@@ -353,11 +353,14 @@ class FSObjects:
         return objects, prefixes, truncated
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", max_keys: int = 1000
-                             ) -> list[ObjectInfo]:
-        objs, _, _ = self.list_objects(bucket, prefix, marker, "",
-                                       max_keys)
-        return objs
+                             marker: str = "", max_keys: int = 1000,
+                             version_marker: str = ""
+                             ) -> tuple[list[ObjectInfo], str, str, bool]:
+        """FS backend is unversioned: one "version" per key, paged on
+        the key marker alone (the erasure layer's 4-tuple contract)."""
+        objs, _, trunc = self.list_objects(bucket, prefix, marker, "",
+                                           max_keys)
+        return single_version_page(objs, trunc)
 
     # -- multipart ---------------------------------------------------------
 

@@ -1,0 +1,969 @@
+"""Persisted bucket metacache: indexed listings + ONE namespace feed.
+
+The listing/metadata plane was the hottest un-optimized path left after
+the data-path offloads: every ListObjectsV2 page re-ran a lazy heap
+merge-walk across all drives plus a per-name quorum metadata read, and
+the crawler, heal scanner, lifecycle, tiering and rebalance loops each
+re-walked the namespace independently — fine at 10^4 objects, ruinous
+at 10^8. This module is the reference MinIO lineage's metacache pattern
+(cmd/metacache*.go) adapted to this repo's planes:
+
+  * a per-bucket, incrementally maintained index of every object's
+    quorum-merged version list, held sorted in memory and PERSISTED as
+    ordinary erasure-coded objects under
+    ``.minio.sys/buckets/<bucket>/.metacache/`` (sorted key segments +
+    a manifest) — the index itself survives drive loss, reconstructs
+    through the regular GET path, and heals like any object;
+  * fed by PUT/DELETE/delete-marker/transition deltas from the engine
+    write paths (the ``on_degraded_write`` hook pattern:
+    ``engine.on_namespace_change``); the hot path only appends to a
+    bounded journal — it NEVER blocks on index I/O. A background
+    drainer re-reads each touched name's merged versions and applies
+    them, so the index always converges to quorum truth;
+  * listings (`list_objects` / `list_objects_v2` / `list_object_versions`)
+    are served from the index with BOUNDED staleness
+    (``MINIO_TPU_METACACHE_STALENESS_S``): a pending delta older than
+    the bound forces a synchronous journal drain before the page is
+    cut. The merge-walk remains the fallback and the correctness
+    oracle — the page shape is produced by the very same
+    ``engine.paginate_objects`` loop;
+  * a background reconcile walker repairs drift (missed hooks, journal
+    overflow, segment corruption) against the merge-walk;
+  * the index doubles as the SINGLE namespace feed
+    (:meth:`MetacacheManager.namespace_feed`) consumed by
+    DataUsageCrawler, HealScanner, lifecycle sweeps, the tier
+    TransitionWorker actions and the rebalance drain walker — one walk
+    amortized across five subsystems
+    (``minio_tpu_namespace_walks_total`` counts who still walks).
+
+Knobs (README "Listing and the bucket metacache"):
+
+  MINIO_TPU_METACACHE=on|off            master switch (off = exactly the
+                                        old merge-walk behavior)
+  MINIO_TPU_METACACHE_FEED=on|off       scanners consume the index feed
+  MINIO_TPU_METACACHE_STALENESS_S=2.0   serve-time staleness bound
+  MINIO_TPU_METACACHE_FLUSH_S=0.2       journal drain cadence
+  MINIO_TPU_METACACHE_PERSIST_S=30      min seconds between segment writes
+  MINIO_TPU_METACACHE_SEGMENT_KEYS=5000 keys per persisted segment
+  MINIO_TPU_METACACHE_JOURNAL=100000    max pending deltas (overflow
+                                        invalidates the bucket until the
+                                        next reconcile — never a silent
+                                        wrong listing)
+  MINIO_TPU_METACACHE_RECONCILE_S=300   drift-repair walk cadence
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+import uuid as _uuid
+from typing import Iterator, Optional
+
+from ..storage.datatypes import ObjectInfo, ObjectPartInfo
+from ..storage.xl_storage import MINIO_META_BUCKET
+from ..utils import telemetry
+from . import api_errors
+from .engine import paginate_objects
+
+_FORMAT = 1
+
+
+def _flag(name: str, default: str = "on") -> bool:
+    return os.environ.get(name, default).lower() not in (
+        "off", "0", "false", "no")
+
+
+def enabled() -> bool:
+    return _flag("MINIO_TPU_METACACHE")
+
+
+def feed_enabled() -> bool:
+    return enabled() and _flag("MINIO_TPU_METACACHE_FEED")
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def mc_prefix(bucket: str) -> str:
+    return f"buckets/{bucket}/.metacache/"
+
+
+def manifest_key(bucket: str) -> str:
+    return mc_prefix(bucket) + "manifest.json"
+
+
+def _metrics():
+    reg = telemetry.REGISTRY
+    return (
+        reg.counter("minio_tpu_metacache_serves_total",
+                    "Listing pages served from the bucket index"),
+        reg.counter("minio_tpu_metacache_fallbacks_total",
+                    "Listing pages that fell back to the merge-walk"),
+        reg.counter("minio_tpu_metacache_deltas_total",
+                    "Namespace deltas journaled from the write paths"),
+        reg.counter("minio_tpu_metacache_delta_drops_total",
+                    "Deltas dropped on journal overflow (bucket is "
+                    "invalidated until reconciled — never served stale "
+                    "beyond the bound)"),
+        reg.counter("minio_tpu_metacache_sync_drains_total",
+                    "Serve-time synchronous drains forced by the "
+                    "staleness bound"),
+        reg.counter("minio_tpu_metacache_reconcile_repairs_total",
+                    "Index entries repaired by the reconcile walker"),
+        reg.gauge("minio_tpu_metacache_entries",
+                  "Object names currently indexed across buckets"),
+    )
+
+
+def listing_histogram():
+    return telemetry.REGISTRY.histogram(
+        "minio_tpu_listing_page_seconds",
+        "Listing page latency by verb and serving path "
+        "(source=index|walk)")
+
+
+def walks_counter():
+    """Full-namespace walk counter — the A/B's proof that ONE
+    reconcile/build walk replaced the per-subsystem walks. Labelled by
+    consumer (crawler, heal, lifecycle, transition, rebalance,
+    metacache) and source (merge = a real cross-drive walk, index = a
+    feed read)."""
+    return telemetry.REGISTRY.counter(
+        "minio_tpu_namespace_walks_total",
+        "Full-namespace walks by consumer and source")
+
+
+# ---------------------------------------------------------------------------
+# (de)serialization — one compact dict per version
+# ---------------------------------------------------------------------------
+
+def _oi_to_doc(o: ObjectInfo) -> dict:
+    d = {"v": o.version_id, "t": o.mod_time, "s": o.size,
+         "as": o.actual_size, "e": o.etag}
+    if o.delete_marker:
+        d["dm"] = 1
+    if not o.is_latest:
+        d["nl"] = 1
+    if o.content_type:
+        d["ct"] = o.content_type
+    if o.content_encoding:
+        d["ce"] = o.content_encoding
+    if o.storage_class and o.storage_class != "STANDARD":
+        d["sc"] = o.storage_class
+    if o.user_defined:
+        d["ud"] = o.user_defined
+    if o.parts:
+        d["p"] = [[p.number, p.size, p.actual_size, p.etag]
+                  for p in o.parts]
+    if o.data_blocks:
+        d["db"] = o.data_blocks
+    if o.parity_blocks:
+        d["pb"] = o.parity_blocks
+    return d
+
+
+def _doc_to_oi(bucket: str, name: str, d: dict) -> ObjectInfo:
+    return ObjectInfo(
+        bucket=bucket, name=name, version_id=d.get("v", ""),
+        mod_time=d.get("t", 0.0), size=d.get("s", 0),
+        actual_size=d.get("as", 0), etag=d.get("e", ""),
+        delete_marker=bool(d.get("dm")), is_latest=not d.get("nl"),
+        content_type=d.get("ct", ""), content_encoding=d.get("ce", ""),
+        storage_class=d.get("sc", "STANDARD"),
+        user_defined=dict(d.get("ud") or {}),
+        parts=[ObjectPartInfo(number=p[0], size=p[1], actual_size=p[2],
+                              etag=p[3]) for p in d.get("p", [])],
+        data_blocks=d.get("db", 0), parity_blocks=d.get("pb", 0))
+
+
+class _BucketIndex:
+    """In-memory sorted index of one bucket (guarded by the manager's
+    lock): `names` sorted asc, `entries[name]` = quorum-merged versions
+    newest-first (exactly `engine.object_versions` output), plus the
+    persisted-segment map and the dirty set driving incremental segment
+    rewrites."""
+
+    __slots__ = ("bucket", "names", "entries", "state", "invalid",
+                 "dirty", "segments", "gen", "last_persist")
+
+    READY = "ready"
+    BUILDING = "building"
+
+    def __init__(self, bucket: str):
+        self.bucket = bucket
+        self.names: list[str] = []
+        self.entries: dict[str, list[ObjectInfo]] = {}
+        self.state = self.BUILDING
+        # invalid: journal overflowed (a delta was LOST) — listings
+        # fall back until the next reconcile walk restores truth
+        self.invalid = False
+        self.dirty: set[str] = set()
+        # persisted layout: [{"key","first","count"}] sorted by first;
+        # segment i covers [first_i, first_{i+1}); None = never persisted
+        self.segments: Optional[list[dict]] = None
+        self.gen = 0
+        self.last_persist = 0.0
+
+    def apply(self, name: str, versions: list[ObjectInfo]) -> bool:
+        """Install one name's refreshed version list (empty = gone).
+        Returns True when the index actually changed."""
+        have = self.entries.get(name)
+        if versions:
+            if have is None:
+                bisect.insort(self.names, name)
+            elif _same_versions(have, versions):
+                return False
+            self.entries[name] = versions
+        else:
+            if have is None:
+                return False
+            i = bisect.bisect_left(self.names, name)
+            if i < len(self.names) and self.names[i] == name:
+                del self.names[i]
+            del self.entries[name]
+        self.dirty.add(name)
+        return True
+
+
+def _same_versions(a: list[ObjectInfo], b: list[ObjectInfo]) -> bool:
+    if len(a) != len(b):
+        return False
+    return all(x.version_id == y.version_id and x.mod_time == y.mod_time
+               and x.etag == y.etag
+               and x.delete_marker == y.delete_marker
+               and x.user_defined == y.user_defined
+               for x, y in zip(a, b))
+
+
+class MetacacheManager:
+    """Owns every bucket's index, the bounded delta journal, the
+    drain/persist/reconcile daemon, and the serve/feed surface.
+
+    Attach with ``server_sets.attach_metacache(mgr)`` — that points the
+    engines' ``on_namespace_change`` hooks at :meth:`record` and makes
+    the listing paths consult :meth:`serve_list_objects` /
+    :meth:`serve_list_object_versions` (which return None whenever the
+    caller must fall back to the merge-walk)."""
+
+    def __init__(self, object_layer,
+                 staleness_s: Optional[float] = None,
+                 flush_s: Optional[float] = None,
+                 persist_s: Optional[float] = None,
+                 reconcile_s: Optional[float] = None,
+                 segment_keys: Optional[int] = None,
+                 journal_max: Optional[int] = None):
+        self.obj = object_layer
+        self._staleness = staleness_s
+        self._flush_s = flush_s
+        self._persist_s = persist_s
+        self._reconcile_s = reconcile_s
+        self._segment_keys = segment_keys
+        self._journal_max = journal_max
+        self._cond = threading.Condition()
+        # metric families resolved ONCE — record() runs per PUT/DELETE
+        # and must not pay seven registry-lock lookups each call
+        self._m = _metrics()
+        self._indexes: dict[str, _BucketIndex] = {}
+        # pending deltas: bucket -> {name: oldest-enqueue monotonic ts}
+        self._pending: dict[str, dict[str, float]] = {}
+        self._pending_count = 0
+        self._build_q: list[str] = []
+        self._last_reconcile = time.monotonic()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # stats (tests/admin)
+        self.serves = 0
+        self.fallbacks = 0
+        self.deltas = 0
+        self.drops = 0
+        self.sync_drains = 0
+        self.builds = 0
+        self.reconciles = 0
+        self.repairs = 0
+        self.persist_errors = 0
+
+    # -- knobs (env read per call so tests can flip them) ------------------
+
+    def staleness_s(self) -> float:
+        return self._staleness if self._staleness is not None else \
+            _env_f("MINIO_TPU_METACACHE_STALENESS_S", 2.0)
+
+    def flush_s(self) -> float:
+        return self._flush_s if self._flush_s is not None else \
+            _env_f("MINIO_TPU_METACACHE_FLUSH_S", 0.2)
+
+    def persist_s(self) -> float:
+        return self._persist_s if self._persist_s is not None else \
+            _env_f("MINIO_TPU_METACACHE_PERSIST_S", 30.0)
+
+    def reconcile_s(self) -> float:
+        return self._reconcile_s if self._reconcile_s is not None else \
+            _env_f("MINIO_TPU_METACACHE_RECONCILE_S", 300.0)
+
+    def segment_keys(self) -> int:
+        return self._segment_keys if self._segment_keys is not None else \
+            int(_env_f("MINIO_TPU_METACACHE_SEGMENT_KEYS", 5000))
+
+    def journal_max(self) -> int:
+        return self._journal_max if self._journal_max is not None else \
+            int(_env_f("MINIO_TPU_METACACHE_JOURNAL", 100000))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MetacacheManager":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metacache")
+        self._thread.start()
+        return self
+
+    def close(self, flush: bool = True) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if flush:
+            for b, idx in list(self._indexes.items()):
+                if idx.state == _BucketIndex.READY and idx.dirty:
+                    try:
+                        self._persist(b)
+                    except Exception:  # noqa: BLE001 — shutdown path
+                        pass
+
+    # -- hot-path producer -------------------------------------------------
+
+    def record(self, bucket: str, name: str) -> None:
+        """Journal one namespace delta. O(1), never blocks on I/O —
+        this runs inside the PUT/DELETE hot path."""
+        now = time.monotonic()
+        with self._cond:
+            if self._closed:
+                return
+            if self._pending_count >= self.journal_max():
+                # a LOST delta means unbounded staleness: invalidate
+                # the bucket (serves fall back) until reconcile repairs
+                self.drops += 1
+                idx = self._indexes.get(bucket)
+                if idx is not None:
+                    idx.invalid = True
+                self._m[3].inc()
+                return
+            pend = self._pending.setdefault(bucket, {})
+            if name not in pend:
+                pend[name] = now
+                self._pending_count += 1
+            self.deltas += 1
+            self._m[2].inc()
+            self._cond.notify_all()
+
+    # -- the daemon --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait(self.flush_s())
+                if self._closed:
+                    return
+                build = self._build_q.pop(0) if self._build_q else None
+            try:
+                if build is not None:
+                    self.build(build)
+                self._drain_once()
+                self._persist_due()
+                if time.monotonic() - self._last_reconcile \
+                        >= self.reconcile_s():
+                    self._last_reconcile = time.monotonic()
+                    for b in list(self._indexes):
+                        self.reconcile(b)
+            except Exception:  # noqa: BLE001 — the daemon must survive
+                pass
+
+    def _drain_once(self) -> int:
+        """Apply every pending delta (background cadence)."""
+        with self._cond:
+            work: dict[str, list[str]] = {}
+            for b in list(self._pending):
+                idx = self._indexes.get(b)
+                if idx is not None and idx.state != _BucketIndex.READY:
+                    # a build is in flight: its walk may already have
+                    # passed these names — keep them journaled so the
+                    # post-build drain re-reads them (claiming them now
+                    # would lose the delta and go stale unboundedly)
+                    continue
+                work[b] = list(self._pending.pop(b))
+            self._pending_count = sum(len(v)
+                                      for v in self._pending.values())
+        applied = 0
+        for bucket, names in work.items():
+            with self._cond:
+                idx = self._indexes.get(bucket)
+            if idx is None:
+                continue        # never built: a future build reads truth
+            for name in names:
+                self._refresh(bucket, name)
+                applied += 1
+        return applied
+
+    def _refresh(self, bucket: str, name: str) -> None:
+        """Re-read one name's quorum-merged cross-pool versions and
+        install them (runs OUTSIDE the lock — this is the delta's
+        deferred metadata read, off the PUT hot path)."""
+        versions = self._read_versions(bucket, name)
+        with self._cond:
+            idx = self._indexes.get(bucket)
+            if idx is not None:
+                idx.apply(name, versions)
+                self._m[6].set(sum(len(i.names)
+                                      for i in self._indexes.values()))
+
+    def _read_versions(self, bucket: str, name: str) -> list[ObjectInfo]:
+        """One name's cross-pool quorum-merged versions — the layer's
+        own object_versions does the pool dedup + newest-first sort."""
+        try:
+            return self.obj.object_versions(bucket, name)
+        except api_errors.ObjectApiError:
+            return []
+
+    # -- staleness ---------------------------------------------------------
+
+    def _ensure_fresh(self, bucket: str) -> None:
+        """Enforce the staleness bound at serve time: any pending delta
+        older than the bound is drained SYNCHRONOUSLY before a page is
+        cut from the index."""
+        bound = self.staleness_s()
+        with self._cond:
+            pend = self._pending.get(bucket)
+            if not pend:
+                return
+            oldest = min(pend.values())
+            if bound > 0 and time.monotonic() - oldest <= bound:
+                return
+            names = list(pend)
+            del self._pending[bucket]
+            self._pending_count -= len(names)
+            self.sync_drains += 1
+            self._m[4].inc()
+        for name in names:
+            self._refresh(bucket, name)
+
+    # -- build / load / persist / reconcile --------------------------------
+
+    def _walk_names(self, bucket: str) -> set[str]:
+        """One full merge-walk of the bucket's names across every pool
+        and set — THE amortized walk."""
+        walks_counter().inc(consumer="metacache", source="merge")
+        names: set[str] = set()
+        layers = getattr(self.obj, "server_sets", None) or [self.obj]
+        for z in layers:
+            for eng in getattr(z, "sets", [z]):
+                try:
+                    names.update(eng._merged_names(bucket, ""))
+                except api_errors.ObjectApiError:
+                    continue
+        return names
+
+    def build(self, bucket: str) -> bool:
+        """Build (or rebuild) one bucket's index: try the persisted
+        segments first, else a full merge-walk + per-name refresh.
+        Returns True when the bucket is ready afterwards."""
+        try:
+            self.obj.get_bucket_info(bucket)
+        except api_errors.BucketNotFound:
+            self.drop_bucket(bucket, purge=True)
+            return False
+        except api_errors.ObjectApiError:
+            # transient (quorum) failure: keep persisted artifacts
+            self.drop_bucket(bucket)
+            return False
+        with self._cond:
+            idx = self._indexes.get(bucket)
+            if idx is not None and idx.state == _BucketIndex.READY \
+                    and not idx.invalid:
+                return True
+            idx = _BucketIndex(bucket)
+            self._indexes[bucket] = idx
+            drops0 = self.drops
+        self.builds += 1
+        with telemetry.trace("metacache.build", bucket=bucket):
+            if self._load_persisted(bucket, idx):
+                # the persisted snapshot may predate downtime mutations
+                # (and, when the old index overflowed, the lost delta):
+                # presence drift alone cannot prove version freshness —
+                # an overwrite changes versions without changing the
+                # name set — so stay invalid (serves fall back) until
+                # the immediate reconcile has refreshed EVERY name
+                with self._cond:
+                    idx.state = _BucketIndex.READY
+                    idx.invalid = True
+                self.reconcile(bucket)
+                return True
+            names = sorted(self._walk_names(bucket))
+            entries: dict[str, list[ObjectInfo]] = {}
+            for n in names:
+                vers = self._read_versions(bucket, n)
+                if vers:
+                    entries[n] = vers
+            with self._cond:
+                idx.names = sorted(entries)
+                idx.entries = entries
+                idx.state = _BucketIndex.READY
+                # an overflow DURING this walk lost a delta the walk
+                # may already have passed — stay invalid for reconcile
+                idx.invalid = self.drops != drops0
+                idx.dirty = set(idx.names)
+                self._m[6].set(sum(len(i.names)
+                                      for i in self._indexes.values()))
+        return True
+
+    def _load_persisted(self, bucket: str, idx: _BucketIndex) -> bool:
+        """Load manifest + segments written by a previous process. Any
+        read/parse failure (drive loss beyond parity, bitrot the GET
+        path could not reconstruct) abandons the load — the caller
+        rebuilds from the walk, never serves a wrong listing."""
+        try:
+            doc = json.loads(self._get_bytes(manifest_key(bucket)))
+            if doc.get("format") != _FORMAT or doc.get("bucket") != bucket:
+                return False
+            names: list[str] = []
+            entries: dict[str, list[ObjectInfo]] = {}
+            for seg in doc.get("segments", []):
+                payload = json.loads(self._get_bytes(seg["key"]))
+                for name, vdocs in payload:
+                    entries[name] = [_doc_to_oi(bucket, name, d)
+                                     for d in vdocs]
+            names = sorted(entries)
+            with self._cond:
+                idx.names = names
+                idx.entries = entries
+                idx.segments = sorted(doc.get("segments", []),
+                                      key=lambda s: s["first"])
+                idx.gen = int(doc.get("gen", 0))
+                idx.dirty = set()
+        except (api_errors.ObjectApiError, ValueError, KeyError,
+                TypeError, IndexError):
+            return False
+        return True
+
+    def _get_bytes(self, key: str) -> bytes:
+        _info, stream = self.obj.get_object(MINIO_META_BUCKET, key)
+        try:
+            return b"".join(stream)
+        finally:
+            close = getattr(stream, "close", None)
+            if close:
+                close()
+
+    def _persist_due(self) -> None:
+        now = time.monotonic()
+        for bucket, idx in list(self._indexes.items()):
+            if idx.state != _BucketIndex.READY or not idx.dirty:
+                continue
+            if now - idx.last_persist < self.persist_s():
+                continue
+            try:
+                self._persist(bucket)
+            except Exception:  # noqa: BLE001 — retried next interval
+                self.persist_errors += 1
+
+    def _persist(self, bucket: str) -> None:
+        """Write dirty segments + a fresh manifest. Incremental: only
+        segments whose key range contains a dirty name are rewritten;
+        oversized segments split, emptied ones drop. The lock covers
+        only the range math + entry-ref snapshot (version lists are
+        replaced wholesale, never mutated in place) — serialization and
+        the erasure-coded object writes run outside it so record()
+        never stalls behind a persist."""
+        seg_max = self.segment_keys()
+        with self._cond:
+            idx = self._indexes.get(bucket)
+            if idx is None or idx.state != _BucketIndex.READY:
+                return
+            dirty = set(idx.dirty)
+            idx.dirty.clear()
+            names = idx.names
+            old = idx.segments
+            if old is None or not old:
+                keep: list[dict] = []
+                rewrite_ranges = [(0, len(names))]
+                replaced_keys: list[str] = []
+            else:
+                firsts = [s["first"] for s in old]
+                affected: set[int] = set()
+                for dn in dirty:
+                    j = bisect.bisect_right(firsts, dn) - 1
+                    affected.add(max(j, 0))
+                keep = [s for j, s in enumerate(old) if j not in affected]
+                replaced_keys = [old[j]["key"] for j in sorted(affected)]
+                rewrite_ranges = []
+                for j in sorted(affected):
+                    lo = 0 if j == 0 else bisect.bisect_left(
+                        names, firsts[j])
+                    hi = len(names) if j + 1 >= len(old) else \
+                        bisect.bisect_left(names, firsts[j + 1])
+                    rewrite_ranges.append((lo, hi))
+            # copy only the name slices under the lock; the version
+            # lists are resolved lock-free below (apply() replaces
+            # them wholesale, and a name deleted mid-persist simply
+            # drops out of the chunk — reconcile/journal converge it)
+            name_chunks: list[list[str]] = []
+            for lo, hi in rewrite_ranges:
+                chunk_names = names[lo:hi]
+                if not chunk_names and old:
+                    continue            # emptied segment: drop it
+                for c0 in range(0, max(len(chunk_names), 1), seg_max):
+                    name_chunks.append(chunk_names[c0:c0 + seg_max])
+                    if not chunk_names:
+                        break
+            entries = idx.entries
+            gen = idx.gen + 1
+            count = len(names)
+        # (key, [(name, version-list ref)], first, count)
+        chunks: list[tuple[str, list, str, int]] = []
+        for chunk in name_chunks:
+            pairs = [(n, vers) for n in chunk
+                     for vers in [entries.get(n)] if vers]
+            key = (mc_prefix(bucket)
+                   + f"seg-{_uuid.uuid4().hex[:12]}.json")
+            chunks.append((key, pairs,
+                           chunk[0] if chunk else "", len(pairs)))
+        if old is None:
+            # this index never knew its persisted layout (walk rebuild
+            # after a failed load): the stored manifest's segments are
+            # about to become unreferenced — collect them for reclaim
+            try:
+                prior = json.loads(self._get_bytes(manifest_key(bucket)))
+                replaced_keys = [s["key"]
+                                 for s in prior.get("segments", [])]
+            except Exception:  # noqa: BLE001 — no readable prior manifest
+                pass
+        written: list[str] = []
+        try:
+            for key, pairs, _first, _count in chunks:
+                body = json.dumps(
+                    [[n, [_oi_to_doc(o) for o in vers]]
+                     for n, vers in pairs]).encode()
+                self.obj.put_object(MINIO_META_BUCKET, key, body)
+                written.append(key)
+            segments = sorted(
+                keep + [{"key": k, "first": f, "count": c}
+                        for k, _p, f, c in chunks],
+                key=lambda s: s["first"])
+            manifest = json.dumps({
+                "format": _FORMAT, "bucket": bucket, "gen": gen,
+                "updated": time.time(), "count": count,
+                "segments": segments}).encode()
+            self.obj.put_object(MINIO_META_BUCKET, manifest_key(bucket),
+                                manifest)
+        except Exception:
+            with self._cond:
+                idx.dirty |= dirty      # retry next interval
+            # the retry mints fresh uuid keys: reclaim this attempt's
+            # segment objects or they leak unreferenced forever
+            for key in written:
+                try:
+                    self.obj.delete_object(MINIO_META_BUCKET, key)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            raise
+        with self._cond:
+            idx.segments = segments
+            idx.gen = gen
+            idx.last_persist = time.monotonic()
+        # old segment objects are garbage now (manifest no longer
+        # references them) — reclaim best-effort
+        for key in replaced_keys:
+            try:
+                self.obj.delete_object(MINIO_META_BUCKET, key)
+            except Exception:  # noqa: BLE001 — orphans are harmless
+                pass
+
+    def reconcile(self, bucket: str) -> int:
+        """Repair index drift against the merge-walk: names the walk
+        has but the index misses (lost deltas) and names the index has
+        but the walk lost (stale entries) are re-read and fixed. THE
+        periodic amortized walk; also the recovery path after journal
+        overflow or a failed segment load. Returns entries repaired."""
+        with self._cond:
+            idx = self._indexes.get(bucket)
+            if idx is None or idx.state != _BucketIndex.READY:
+                return 0
+            have = set(idx.names)
+            invalid = idx.invalid
+            drops0 = self.drops
+        self.reconciles += 1
+        with telemetry.trace("metacache.reconcile", bucket=bucket):
+            try:
+                walked = self._walk_names(bucket)
+            except Exception:  # noqa: BLE001 — try again next interval
+                return 0
+            if invalid:
+                # a delta was LOST (journal overflow): name-set drift
+                # alone cannot prove freshness — an overwrite changes
+                # versions without changing presence. Refresh EVERY
+                # name before trusting the index again.
+                drift = sorted(walked | have)
+            else:
+                drift = sorted(walked.symmetric_difference(have))
+            for name in drift:
+                self._refresh(bucket, name)
+            with self._cond:
+                # an overflow DURING this walk lost a delta the walk may
+                # have already passed — leave invalid for the next round
+                if self.drops == drops0:
+                    idx.invalid = False
+            if drift:
+                self.repairs += len(drift)
+                self._m[5].inc(len(drift))
+        return len(drift)
+
+    def drop_bucket(self, bucket: str, purge: bool = False) -> None:
+        """Forget a bucket's in-memory state; with ``purge`` also delete
+        the persisted manifest + segments — a DELETEd bucket's index
+        must not be reloadable by a later same-name incarnation."""
+        with self._cond:
+            self._indexes.pop(bucket, None)
+            pend = self._pending.pop(bucket, None)
+            if pend:
+                self._pending_count -= len(pend)
+        if purge:
+            self._purge_persisted(bucket)
+
+    def _purge_persisted(self, bucket: str) -> None:
+        keys: list[str] = []
+        try:
+            doc = json.loads(self._get_bytes(manifest_key(bucket)))
+            keys = [s["key"] for s in doc.get("segments", [])]
+        except Exception:  # noqa: BLE001 — no manifest, nothing to purge
+            pass
+        for key in keys + [manifest_key(bucket)]:
+            try:
+                self.obj.delete_object(MINIO_META_BUCKET, key)
+            except Exception:  # noqa: BLE001 — best-effort reclaim
+                pass
+
+    # -- serving -----------------------------------------------------------
+
+    def _ready_index(self, bucket: str,
+                     build_sync: bool = False) -> Optional[_BucketIndex]:
+        if not enabled():
+            return None
+        with self._cond:
+            idx = self._indexes.get(bucket)
+            ok = idx is not None and idx.state == _BucketIndex.READY \
+                and not idx.invalid
+            if not ok and not build_sync:
+                if bucket not in self._build_q:
+                    self._build_q.append(bucket)
+                    self._cond.notify_all()
+                return None
+        if not ok:
+            if not self.build(bucket):
+                return None
+            with self._cond:
+                idx = self._indexes.get(bucket)
+                if idx is None or idx.state != _BucketIndex.READY \
+                        or idx.invalid:
+                    return None
+        self._ensure_fresh(bucket)
+        return idx
+
+    def _iter_names_chunked(self, idx: _BucketIndex, prefix: str,
+                            marker: str, inclusive: bool = False,
+                            chunk: int = 1024) -> Iterator[str]:
+        """Scan the live index WITHOUT holding the manager lock across
+        the whole page (record() — the PUT hot path — takes the same
+        lock): grab a bounded chunk under the lock, yield it lock-free,
+        re-anchor by bisect on the last yielded name. A concurrent
+        insert/delete lands before or after the anchor exactly like a
+        write racing a merge-walk page."""
+        last, inc = marker, inclusive
+        while True:
+            with self._cond:
+                batch = _slice_names(idx.names, prefix, last, inc, chunk)
+            yield from batch
+            if len(batch) < chunk:
+                return
+            last, inc = batch[-1], False
+
+    def serve_list_objects(self, bucket: str, prefix: str, marker: str,
+                           delimiter: str, max_keys: int):
+        """One list_objects page from the index, or None (caller falls
+        back to the merge-walk). Page shape comes from the SAME
+        paginate_objects loop the engine runs."""
+        idx = self._ready_index(bucket)
+        if idx is None:
+            self.fallbacks += 1
+            self._m[1].inc()
+            return None
+        # existence parity with the merge path: a deleted bucket must
+        # raise BucketNotFound, not serve a stale page
+        self.obj.get_bucket_info(bucket)
+        with telemetry.span("metacache.serve", bucket=bucket,
+                            verb="list"):
+            # lock-free entry reads: dict get is GIL-atomic and apply()
+            # replaces version lists wholesale, never mutates in place
+            entries = idx.entries
+
+            def read_latest(name: str):
+                vers = entries.get(name)
+                if not vers or vers[0].delete_marker:
+                    return None
+                return vers[0]
+
+            page = paginate_objects(
+                self._iter_names_chunked(idx, prefix, marker),
+                read_latest, prefix, marker, delimiter, max_keys)
+        self.serves += 1
+        self._m[0].inc()
+        return page
+
+    def serve_list_object_versions(self, bucket: str, prefix: str,
+                                   marker: str, max_keys: int,
+                                   version_marker: str = ""):
+        """One list_object_versions page (the engine's 4-tuple) from
+        the index, or None to fall back."""
+        idx = self._ready_index(bucket)
+        if idx is None:
+            self.fallbacks += 1
+            self._m[1].inc()
+            return None
+        self.obj.get_bucket_info(bucket)
+        if max_keys <= 0:
+            return [], "", "", False
+        with telemetry.span("metacache.serve", bucket=bucket,
+                            verb="versions"):
+            entries = idx.entries
+            out: list[ObjectInfo] = []
+            for name in self._iter_names_chunked(
+                    idx, prefix, marker,
+                    inclusive=bool(version_marker)):
+                if marker and (name < marker or (
+                        not version_marker and name == marker)):
+                    continue
+                vers = entries.get(name) or []
+                if version_marker and name == marker:
+                    vm = "" if version_marker == "null" \
+                        else version_marker
+                    i = next((j for j, v in enumerate(vers)
+                              if v.version_id == vm), None)
+                    if i is not None:
+                        vers = vers[i + 1:]
+                for oi in vers:
+                    if len(out) >= max_keys:
+                        self.serves += 1
+                        self._m[0].inc()
+                        return (out, out[-1].name,
+                                out[-1].version_id or "null", True)
+                    out.append(oi)
+        self.serves += 1
+        self._m[0].inc()
+        return out, "", "", False
+
+    # -- the namespace feed ------------------------------------------------
+
+    def namespace_feed(self, bucket: str, versions: bool = False,
+                       consumer: str = "feed") -> Optional[Iterator]:
+        """THE shared scanner walk: an iterator over the bucket's
+        indexed namespace — latest listable ObjectInfos, or
+        ``(name, versions)`` pairs with ``versions=True``. Returns None
+        when the feed is unavailable (disabled, or the bucket cannot be
+        built) so consumers fall back to their own merge-walk.
+
+        The first consumer to ask builds the index synchronously —
+        that build IS the one amortized walk; every later consumer
+        reads memory."""
+        if not feed_enabled():
+            return None
+        idx = self._ready_index(bucket, build_sync=True)
+        if idx is None:
+            return None
+        with self._cond:
+            names = list(idx.names)
+            entries = idx.entries
+        walks_counter().inc(consumer=consumer, source="index")
+
+        def it():
+            for n in names:
+                with self._cond:
+                    vers = list(entries.get(n) or ())
+                if not vers:
+                    continue
+                if versions:
+                    yield n, vers
+                else:
+                    if vers[0].delete_marker:
+                        continue
+                    yield vers[0]
+        return it()
+
+    # -- heal surface ------------------------------------------------------
+
+    def segment_objects(self) -> list[str]:
+        """Meta-bucket keys of every live manifest + segment — the heal
+        scanner sweeps these like ordinary objects so the index
+        survives drive replacement."""
+        out: list[str] = []
+        with self._cond:
+            for bucket, idx in self._indexes.items():
+                if idx.segments is None:
+                    continue
+                out.append(manifest_key(bucket))
+                out.extend(s["key"] for s in idx.segments)
+        return out
+
+    # -- tests / admin -----------------------------------------------------
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Apply every pending delta NOW (tests; also the bench's
+        settle step). Returns False when new deltas kept arriving past
+        the deadline."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._drain_once()
+            with self._cond:
+                if not self._pending_count:
+                    return True
+        return False
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "buckets": {b: {"state": i.state, "invalid": i.invalid,
+                                "names": len(i.names), "gen": i.gen,
+                                "dirty": len(i.dirty)}
+                            for b, i in self._indexes.items()},
+                "pending": self._pending_count,
+                "serves": self.serves, "fallbacks": self.fallbacks,
+                "deltas": self.deltas, "drops": self.drops,
+                "sync_drains": self.sync_drains, "builds": self.builds,
+                "reconciles": self.reconciles, "repairs": self.repairs,
+                "persist_errors": self.persist_errors,
+            }
+
+
+def _slice_names(names: list[str], prefix: str, marker: str,
+                 inclusive: bool, k: int) -> list[str]:
+    """Up to ``k`` sorted prefix-matching names starting after (or at,
+    with ``inclusive``) the marker — the index-side analog of the
+    engine's `_merged_names` contract, bounded so the caller never
+    holds the manager lock across a whole-bucket scan."""
+    start = 0
+    if marker and marker >= prefix:
+        start = bisect.bisect_left(names, marker) if inclusive \
+            else bisect.bisect_right(names, marker)
+    elif prefix:
+        start = bisect.bisect_left(names, prefix)
+    out: list[str] = []
+    for i in range(start, len(names)):
+        n = names[i]
+        if prefix and not n.startswith(prefix):
+            break               # sorted: past the prefix range
+        out.append(n)
+        if len(out) >= k:
+            break
+    return out

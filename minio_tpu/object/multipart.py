@@ -352,4 +352,5 @@ class MultipartMixin(ErasureObjects):
                 # (ROADMAP follow-up: on_degraded_write previously fired
                 # only from PUT/delete/metadata)
                 self._notify_degraded(bucket, object_name, fi.version_id)
+            self._notify_namespace(bucket, object_name)
             return fi.to_object_info(bucket, object_name)

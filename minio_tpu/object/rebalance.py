@@ -219,57 +219,85 @@ class Rebalancer:
     def _drain_bucket(self, src, bucket: str, marker: str
                       ) -> tuple[int, int]:
         moved = failed = since_ckpt = 0
-        while not self._stop.is_set():
+        for name, versions in self._bucket_groups(src, bucket, marker):
+            if self._stop.is_set():
+                break
+            self._throttle()
             try:
-                page = src.list_object_versions(bucket, "", marker,
-                                                self.page)
-            except api_errors.ObjectApiError:
-                break                       # bucket vanished mid-drain
-            if not page:
-                break
-            groups = self._group(page, bucket)
-            full_page = len(page) >= self.page
-            if full_page and len(groups) > 1:
-                # the page may have cut the LAST object's version list
-                # short: hold its name for the next page
-                groups.pop()
-            if groups:
-                marker = groups[-1][0]
+                moved_bytes = self._move_object(bucket, name, versions)
+            except Exception:  # noqa: BLE001 — per-object isolation
+                failed += 1
+                self._on_move_failed(bucket, name)
             else:
-                # a full page of filtered-out names (meta internals):
-                # advance past it instead of stalling the sweep
-                if not full_page:
-                    break
-                marker = page[-1].name
-                continue
-            for name, versions in groups:
-                if self._stop.is_set():
-                    break
-                self._throttle()
-                try:
-                    moved_bytes = self._move_object(bucket, name,
-                                                    versions)
-                except Exception:  # noqa: BLE001 — per-object isolation
-                    failed += 1
-                    self._on_move_failed(bucket, name)
-                else:
-                    moved += 1
-                    with self._mu:
-                        self.state["objects_moved"] += 1
-                        self.state["bytes_moved"] += moved_bytes
-                    objects_c, bytes_c, _, _ = _metrics()
-                    objects_c.inc(len(versions), pool=str(self.source))
-                    bytes_c.inc(moved_bytes, pool=str(self.source))
-                self._set(bucket=bucket, marker=name)
-                since_ckpt += 1
-                if since_ckpt >= self.checkpoint_every:
-                    self._save_checkpoint()
-                    since_ckpt = 0
-            if len(page) < self.page:
-                break
+                moved += 1
+                with self._mu:
+                    self.state["objects_moved"] += 1
+                    self.state["bytes_moved"] += moved_bytes
+                objects_c, bytes_c, _, _ = _metrics()
+                objects_c.inc(len(versions), pool=str(self.source))
+                bytes_c.inc(moved_bytes, pool=str(self.source))
+            self._set(bucket=bucket, marker=name)
+            since_ckpt += 1
+            if since_ckpt >= self.checkpoint_every:
+                self._save_checkpoint()
+                since_ckpt = 0
         if since_ckpt:
             self._save_checkpoint()
         return moved, failed
+
+    def _bucket_groups(self, src, bucket: str, marker: str):
+        """(name, source-pool versions) groups in name order after
+        `marker`. The metacache index (when attached) supplies the
+        NAMES — the drain rides the one amortized walk instead of
+        re-walking the namespace per pass — while the version list
+        stays the SOURCE POOL's own quorum read (the index is
+        cluster-wide; only pool-local truth may drive a pool drain).
+        Falls back to marker-paged pool-local version listing, carrying
+        a page-cut group across pages so an object's versions always
+        move together."""
+        feed = None
+        mc = getattr(self.obj, "metacache", None)
+        if mc is not None and bucket != MINIO_META_BUCKET:
+            feed = mc.namespace_feed(bucket, versions=True,
+                                     consumer="rebalance")
+        if feed is not None:
+            for name, _cluster_versions in feed:
+                if self._stop.is_set():
+                    return
+                if marker and name <= marker:
+                    continue
+                try:
+                    vs = src.object_versions(bucket, name)
+                except api_errors.ObjectApiError:
+                    continue
+                if vs:
+                    yield name, vs
+            return
+        from .metacache import walks_counter
+        walks_counter().inc(consumer="rebalance", source="merge")
+        vid_marker = ""
+        carry_name = None
+        carry: list = []
+        while not self._stop.is_set():
+            try:
+                page, nkm, nvm, trunc = src.list_object_versions(
+                    bucket, "", marker, self.page, vid_marker)
+            except api_errors.ObjectApiError:
+                return                  # bucket vanished mid-drain
+            for oi in page:
+                if bucket == MINIO_META_BUCKET and \
+                        oi.name.startswith(META_SKIP_PREFIXES):
+                    continue
+                if carry_name is not None and oi.name != carry_name:
+                    yield carry_name, carry
+                    carry = []
+                carry_name = oi.name
+                carry.append(oi)
+            if not trunc:
+                break
+            marker, vid_marker = nkm, nvm
+        if carry_name is not None and carry and not self._stop.is_set():
+            yield carry_name, carry
 
     def _group(self, page, bucket: str) -> list[tuple[str, list]]:
         """Page of version ObjectInfos -> [(name, versions)] in listing
@@ -292,7 +320,8 @@ class Rebalancer:
             + [MINIO_META_BUCKET]
         for bucket in buckets:
             try:
-                page = src.list_object_versions(bucket, "", "", self.page)
+                page, _, _, _ = src.list_object_versions(bucket, "", "",
+                                                         self.page)
             except api_errors.ObjectApiError:
                 continue
             remaining += len(self._group(page, bucket))
@@ -331,9 +360,7 @@ class Rebalancer:
             # resurrecting them
             try:
                 still = {v.version_id
-                         for v in src.list_object_versions(bucket, name,
-                                                           "", 1000)
-                         if v.name == name}
+                         for v in src.object_versions(bucket, name)}
             except api_errors.ObjectApiError:
                 still = set()
             for oi in sorted(versions, key=lambda o: o.mod_time or 0):
@@ -370,12 +397,10 @@ class Rebalancer:
             z = self.obj.server_sets[i]
             try:
                 if oi.delete_marker or oi.version_id:
-                    # prefix-narrowed: O(versions of this object), not
-                    # O(bucket) — and never blind past a 1000-name page
-                    for v in z.list_object_versions(bucket, name, "",
-                                                    1000):
-                        if v.name == name and \
-                                v.version_id == oi.version_id:
+                    # direct per-name read: O(versions of this object),
+                    # not O(bucket), and never blind past a page cut
+                    for v in z.object_versions(bucket, name):
+                        if v.version_id == oi.version_id:
                             return True
                 else:
                     got = z.get_object_info(bucket, name)

@@ -40,11 +40,23 @@ class ErasureServerSets:
         assert server_sets
         self.server_sets = server_sets
         self._rebalancer = None        # live Rebalancer (rebalance.py)
+        # persisted bucket index (object/metacache.py): when attached,
+        # listings serve from it (merge-walk fallback) and the engines'
+        # namespace-change hooks feed its delta journal
+        self.metacache = None
         if topology is None and load_topology:
             # recover the newest persisted map (highest epoch across
             # pools); a fresh cluster starts all-active at epoch 0
             topology = TopologyStore.load(self)
         self.topology = topology or TopologyMap(len(server_sets))
+
+    def attach_metacache(self, manager) -> None:
+        """Wire the MetacacheManager: every pool's engines journal
+        namespace deltas into it, and the listing paths consult it
+        first (None = fall back to the merge-walk)."""
+        self.metacache = manager
+        for z in self.server_sets:
+            z.on_namespace_change = manager.record
 
     def single_zone(self) -> bool:
         return len(self.server_sets) == 1
@@ -124,6 +136,10 @@ class ErasureServerSets:
                 raise api_errors.BucketNotEmpty(bucket)
         for z in self.server_sets:
             z.delete_bucket(bucket, force=True)
+        if self.metacache is not None:
+            # purge: the persisted index lives in .minio.sys and would
+            # otherwise be reloaded by a recreated same-name bucket
+            self.metacache.drop_bucket(bucket, purge=True)
 
     def bucket_exists(self, bucket: str) -> bool:
         return self.server_sets[0].bucket_exists(bucket)
@@ -403,19 +419,58 @@ class ErasureServerSets:
     def list_objects(self, bucket, prefix="", marker="", delimiter="",
                      max_keys=1000):
         from .sets import merge_listings
+        t0 = time.monotonic()
+        if self.metacache is not None:
+            page = self.metacache.serve_list_objects(
+                bucket, prefix, marker, delimiter, max_keys)
+            if page is not None:
+                self._observe_listing("list", "index", t0)
+                return page
         per_zone = [z.list_objects(bucket, prefix, marker, delimiter,
                                    max_keys)
                     for z in self.server_sets]
-        return merge_listings(per_zone, max_keys)
+        out = merge_listings(per_zone, max_keys)
+        self._observe_listing("list", "walk", t0)
+        return out
 
     def list_object_versions(self, bucket, prefix="", marker="",
-                             max_keys=1000):
+                             max_keys=1000, version_marker=""):
+        from .sets import merge_version_listings
+        t0 = time.monotonic()
+        if self.metacache is not None:
+            page = self.metacache.serve_list_object_versions(
+                bucket, prefix, marker, max_keys, version_marker)
+            if page is not None:
+                self._observe_listing("versions", "index", t0)
+                return page
+        per_zone = [z.list_object_versions(bucket, prefix, marker,
+                                           max_keys, version_marker)
+                    for z in self.server_sets]
+        out = merge_version_listings(per_zone, max_keys)
+        self._observe_listing("versions", "walk", t0)
+        return out
+
+    def object_versions(self, bucket, name):
+        """Cross-pool quorum-merged versions of one object (dedup by
+        version id, newest first)."""
         out = []
+        seen = set()
         for z in self.server_sets:
-            out.extend(z.list_object_versions(bucket, prefix, marker,
-                                              max_keys))
-        out.sort(key=lambda o: (o.name, -o.mod_time))
-        return out[:max_keys]
+            try:
+                for oi in z.object_versions(bucket, name):
+                    if oi.version_id not in seen:
+                        seen.add(oi.version_id)
+                        out.append(oi)
+            except api_errors.ObjectApiError:
+                continue
+        out.sort(key=lambda o: -(o.mod_time or 0))
+        return out
+
+    @staticmethod
+    def _observe_listing(verb: str, source: str, t0: float) -> None:
+        from .metacache import listing_histogram
+        listing_histogram().observe(time.monotonic() - t0, verb=verb,
+                                    source=source)
 
     def storage_info(self) -> dict:
         zones = [z.storage_info() for z in self.server_sets]
@@ -443,6 +498,10 @@ class ErasureServerSets:
             except api_errors.BucketExists:
                 pass
         self.server_sets.append(sets)
+        if self.metacache is not None:
+            # the new pool's engines must feed the index like boot-time
+            # pools, or its writes would be invisible until reconcile
+            sets.on_namespace_change = self.metacache.record
         self.topology.add_pool(POOL_ACTIVE)
         TopologyStore.save(self, self.topology)
         # a drain parked for lack of target capacity can proceed now
@@ -556,5 +615,8 @@ class ErasureServerSets:
         if self._rebalancer is not None:
             self._rebalancer.stop()
             self._rebalancer = None
+        if self.metacache is not None:
+            self.metacache.close()
+            self.metacache = None
         for z in self.server_sets:
             z.close()

@@ -50,6 +50,12 @@ class ErasureSets:
         self._id16 = _uuid.UUID(deployment_id).bytes
         self._closed = False
         self.mrf: Optional[MRFHealer] = None
+        # metacache delta feed: engines report namespace mutations up
+        # through this layer; server_sets (or a test harness) points it
+        # at the MetacacheManager journal (object/metacache.py)
+        self.on_namespace_change = None
+        for s in self.sets:
+            s.on_namespace_change = self._notify_namespace
         if enable_mrf:
             self.mrf = MRFHealer(self._heal_mrf_entry, **(mrf_options or {}))
             for s in self.sets:
@@ -211,6 +217,11 @@ class ErasureSets:
                         version_id: str = "") -> None:
         if self.mrf is not None:
             self.mrf.enqueue(bucket, object_name, version_id)
+
+    def _notify_namespace(self, bucket: str, object_name: str) -> None:
+        cb = self.on_namespace_change
+        if cb is not None:
+            cb(bucket, object_name)
 
     def _heal_mrf_entry(self, bucket: str, object_name: str,
                         version_id: str = ""):
@@ -399,13 +410,17 @@ class ErasureSets:
         return merge_listings(per_set, max_keys)
 
     def list_object_versions(self, bucket, prefix="", marker="",
-                             max_keys=1000):
-        out = []
-        for s in self.sets:
-            out.extend(s.list_object_versions(bucket, prefix, marker,
-                                              max_keys))
-        out.sort(key=lambda o: (o.name, -o.mod_time))
-        return out[:max_keys]
+                             max_keys=1000, version_marker=""):
+        per_set = [s.list_object_versions(bucket, prefix, marker,
+                                          max_keys, version_marker)
+                   for s in self.sets]
+        return merge_version_listings(per_set, max_keys)
+
+    def object_versions(self, bucket: str, name: str):
+        """Quorum-merged versions of one object (newest first) from the
+        set that owns it — the pool-local per-name read of the
+        rebalance/metacache feed paths."""
+        return self.get_hashed_set(name).object_versions(bucket, name)
 
     # ------------------------------------------------------------------
     # info / usage
@@ -430,6 +445,35 @@ class ErasureSets:
                 "online_disks": online, "offline_disks": offline,
                 "sets": len(self.sets),
                 "drives_per_set": len(self.sets[0].disks)}
+
+def merge_version_listings(per_layer: list[tuple], max_keys: int
+                           ) -> tuple[list[ObjectInfo], str, str, bool]:
+    """Merge per-set/per-zone version pages into one `(versions,
+    next_key_marker, next_version_id_marker, is_truncated)` page — the
+    single home of the cross-layer version paging rules. Duplicate
+    (name, version_id) pairs (one object transiently in two pools
+    mid-rebalance) collapse to the first layer's copy; order is
+    (name asc, mod_time desc), stable within ties."""
+    seen: set[tuple[str, str]] = set()
+    merged: list[ObjectInfo] = []
+    any_truncated = False
+    for versions, _nkm, _nvm, trunc in per_layer:
+        any_truncated = any_truncated or trunc
+        for o in versions:
+            key = (o.name, o.version_id)
+            if key not in seen:
+                seen.add(key)
+                merged.append(o)
+    merged.sort(key=lambda o: (o.name, -(o.mod_time or 0)))
+    truncated = any_truncated or len(merged) > max_keys
+    merged = merged[:max_keys]
+    if truncated and merged:
+        # empty (null) version ids ride as the "null" sentinel, like
+        # the engine's markers — see engine.list_object_versions
+        return (merged, merged[-1].name,
+                merged[-1].version_id or "null", True)
+    return merged, "", "", truncated
+
 
 def merge_listings(per_layer: list[tuple[list[ObjectInfo], list[str], bool]],
                    max_keys: int

@@ -1141,12 +1141,16 @@ class S3ApiHandlers:
         delimiter = ctx.query1("delimiter")
         enc = ctx.query1("encoding-type")
         max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
-        versions = self.obj.list_object_versions(bucket, prefix,
-                                                 key_marker, max_keys + 1)
-        trunc = len(versions) > max_keys
-        versions = versions[:max_keys]
-        nkm = versions[-1].name if trunc and versions else ""
-        nvm = versions[-1].version_id if trunc and versions else ""
+        if max_keys == 0:
+            self.obj.get_bucket_info(bucket)
+            versions, nkm, nvm, trunc = [], "", "", False
+        else:
+            # a version-id-marker without a key-marker is meaningless
+            # (S3 rejects it; we ignore it) — and the object layer
+            # handles the "null" wire form of the empty version id
+            versions, nkm, nvm, trunc = self.obj.list_object_versions(
+                bucket, prefix, key_marker, max_keys,
+                vid_marker if key_marker else "")
         return HTTPResponse().with_xml(xmlgen.list_versions_response(
             bucket, prefix, key_marker, vid_marker, delimiter, max_keys,
             enc, versions, [], trunc, nkm, nvm))

@@ -275,3 +275,13 @@ def new_file_info(object_name: str, data_blocks: int,
 
 def now() -> float:
     return _time.time()
+
+
+def single_version_page(objs, truncated):
+    """The list_object_versions 4-tuple for single-version backends
+    (FS, gateways): one "version" per key, paged on the key marker
+    alone — the erasure layer's (versions, NextKeyMarker,
+    NextVersionIdMarker, is_truncated) contract."""
+    if truncated and objs:
+        return objs, objs[-1].name, objs[-1].version_id, True
+    return objs, "", "", truncated

@@ -361,6 +361,7 @@ def noncurrent_transition_action(bucket_meta_sys,
     from ..features.lifecycle import Lifecycle
 
     def act(bucket: str) -> None:
+        from ..features.lifecycle import iter_version_groups
         bm = bucket_meta_sys.get(bucket)
         if not bm.lifecycle_xml:
             return
@@ -372,47 +373,24 @@ def noncurrent_transition_action(bucket_meta_sys,
                    and r.noncurrent_transition_tier for r in lc.rules):
             return
         now = now_fn()
-        marker = ""
-        while True:
-            try:
-                versions = worker.obj.list_object_versions(
-                    bucket, "", marker, 1000)
-            except api_errors.ObjectApiError:
-                return
-            if not versions:
-                return
-            full_page = len(versions) >= 1000
-            names = sorted({v.name for v in versions})
-            if full_page and len(names) > 1:
-                # the page may have cut the LAST key's version list
-                # short (treating its continuation's first entry as
-                # "current" would mis-clock every later version): hold
-                # that key for the next page (the rebalancer's
-                # page-group rule)
-                cut = names.pop()
-                versions = [v for v in versions if v.name != cut]
-                marker = names[-1]
-            else:
-                marker = versions[-1].name
-            by_name: dict[str, list] = {}
-            for v in versions:
-                by_name.setdefault(v.name, []).append(v)
-            for name, vs in by_name.items():
-                days, tier = lc.noncurrent_transition(name)
-                if not days or not tier:
+        # the shared version-group walk (metacache feed when available,
+        # marker-paged merge listing otherwise) always yields a name's
+        # versions TOGETHER — no page-cut mis-clocking
+        for name, vs in iter_version_groups(worker.obj, bucket,
+                                            consumer="transition"):
+            days, tier = lc.noncurrent_transition(name)
+            if not days or not tier:
+                continue
+            vs = sorted(vs, key=lambda v: -v.mod_time)
+            for i in range(1, len(vs)):         # index 0 = current
+                v = vs[i]
+                if v.delete_marker or \
+                        is_transitioned(v.user_defined or {}):
                     continue
-                vs.sort(key=lambda v: -v.mod_time)
-                for i in range(1, len(vs)):     # index 0 = current
-                    v = vs[i]
-                    if v.delete_marker or \
-                            is_transitioned(v.user_defined or {}):
-                        continue
-                    became_noncurrent = vs[i - 1].mod_time
-                    if became_noncurrent < now - days * 86400:
-                        worker.enqueue(bucket, name, v.version_id, tier,
-                                       etag=v.etag)
-            if not full_page:
-                return
+                became_noncurrent = vs[i - 1].mod_time
+                if became_noncurrent < now - days * 86400:
+                    worker.enqueue(bucket, name, v.version_id, tier,
+                                   etag=v.etag)
 
     return act
 

@@ -1,0 +1,29 @@
+"""CI smoke for bench.py --ab-list (tiny listing A/B): must run
+end-to-end inside the tier-1 budget, emit JSON-serializable results,
+prove the index serves pages identical to the merge-walk (the bench
+asserts name-identity itself), beat the walk on page latency, and show
+one crawler cycle doing ZERO merge walks once the index is attached."""
+
+from __future__ import annotations
+
+import json
+
+import bench
+
+
+def test_list_ab_smoke():
+    out = bench.bench_list_ab(keys=150, drives=6, page=25,
+                              versions_every=10)
+    json.dumps(out)                     # BENCH-compatible payload
+    assert out["config"]["keys"] == 150
+    assert out["walk"]["pages"] == out["index"]["pages"] >= 6
+    # the index slices memory; the walk re-runs a heap merge plus a
+    # per-name quorum read per page — even on a loaded CI box the
+    # index page must win clearly (full-size runs show >100x)
+    assert out["page_p50_speedup_x"] > 3.0, out
+    # one amortized walk: the crawler cycle re-walks nothing
+    assert out["walk"]["cycle"]["merge_walks"] >= 3
+    assert out["index"]["cycle"]["merge_walks"] == 0
+    assert out["index"]["cycle"]["index_reads"] >= 3
+    assert out["index"]["metacache"]["fallbacks"] == 0
+    assert out["build_s"] >= 0

@@ -446,7 +446,7 @@ def test_versioned_suspend_and_restore(eng):
     v1 = eng.put_object("bucket", "v", b"v1", opts=PutOptions(versioned=True))
     eng.delete_object("bucket", "v", versioned=True)
     # deleting the delete marker itself restores the object
-    versions = eng.list_object_versions("bucket", "v")
+    versions = eng.list_object_versions("bucket", "v")[0]
     marker = next(v for v in versions if v.delete_marker)
     eng.delete_object("bucket", "v", version_id=marker.version_id)
     oi = eng.get_object_info("bucket", "v")
@@ -469,7 +469,7 @@ def test_list_versions_quorum_ignores_stale_drive(neng):
     neng.delete_object("bucket", "vq", version_id=v1)
     neng.disks[0].offline = False
 
-    vers = neng.list_object_versions("bucket", "vq")
+    vers = neng.list_object_versions("bucket", "vq")[0]
     ids = {v.version_id for v in vers}
     assert ids == {v2, v3}          # stale v1 gone, offline-era writes in
     # newest first

@@ -235,7 +235,7 @@ def test_noncurrent_version_expiry(tmp_path):
     for i in range(3):
         sets.put_object("ncb", "doc", f"v{i}".encode(),
                         opts=PutOptions(versioned=True))
-    assert len(sets.list_object_versions("ncb", prefix="doc")) == 3
+    assert len(sets.list_object_versions("ncb", prefix="doc")[0]) == 3
     # a second key whose LATEST is a delete marker (invisible to
     # object listings)
     sets.put_object("ncb", "gone", b"old",
@@ -254,18 +254,18 @@ def test_noncurrent_version_expiry(tmp_path):
     act = noncurrent_sweep_action(api.bucket_meta, sets,
                                   now_fn=lambda: now + 12 * 3600)
     act("ncb")
-    assert len(sets.list_object_versions("ncb", prefix="doc")) == 3
+    assert len(sets.list_object_versions("ncb", prefix="doc")[0]) == 3
 
     # at +2d they are past NoncurrentDays=1: only the latest survives,
     # and the delete-marker key's data version is purged too
     act2 = noncurrent_sweep_action(api.bucket_meta, sets,
                                    now_fn=lambda: now + 2 * 86400)
     act2("ncb")
-    versions = sets.list_object_versions("ncb", prefix="doc")
+    versions = sets.list_object_versions("ncb", prefix="doc")[0]
     assert len(versions) == 1 and versions[0].is_latest
     _, stream = sets.get_object("ncb", "doc")
     assert b"".join(stream) == b"v2"
-    gone = sets.list_object_versions("ncb", prefix="gone")
+    gone = sets.list_object_versions("ncb", prefix="gone")[0]
     assert all(v.delete_marker for v in gone)
     sets.close()
 

@@ -117,6 +117,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--update", action="store_true",
                     help="rewrite the BASELINE.md failure list from "
                     "this run")
+    ap.add_argument("--json", metavar="PATH",
+                    help="also write a machine-readable report "
+                    "(names added/removed vs BASELINE.md, gate "
+                    "verdict) to PATH, or '-' for stdout — for CI "
+                    "logs")
     args = ap.parse_args(argv)
 
     if args.log:
@@ -132,6 +137,20 @@ def main(argv: list[str] | None = None) -> int:
     baseline = read_baseline()
     new = sorted(current - baseline)
     fixed = sorted(baseline - current)
+    if args.json:
+        import json
+        report = json.dumps({
+            "current_failures": len(current),
+            "baseline_failures": len(baseline),
+            "new": new,
+            "fixed": fixed,
+            "gate": "fail" if new else "pass",
+        }, indent=2)
+        if args.json == "-":
+            print(report)
+        else:
+            with open(args.json, "w") as f:
+                f.write(report + "\n")
     print(f"tier-1 failures: {len(current)} current, "
           f"{len(baseline)} baseline")
     if fixed:
