@@ -989,6 +989,259 @@ def bench_list_ab(keys: int = 10000, drives: int = 8, parity: int = 2,
     return out
 
 
+def bench_select_ab(streams: Sequence[int] = (1, 2, 4, 8),
+                    rows: int = 20000, queries_per_stream: int = 4,
+                    sched_max_wait: float = 0.25) -> dict:
+    """S3 Select A/B: device scan plane vs the CPU row-by-row
+    evaluator at 1..N concurrent SelectObjectContent requests.
+
+    One CSV corpus (`rows` records, mixed numeric/string cells), one
+    predicate-heavy query. Per concurrency point, each of n threads
+    runs `queries_per_stream` Selects:
+
+      * cpu   — s3select.select.event_stream (the oracle),
+      * device — ScanEngine riding a shared BatchScheduler with the
+        kernels FORCED onto the local XLA backend; the scheduler's
+        scan-verb batches/coalesced counter deltas per point prove
+        concurrent requests coalesce into shared launches.
+
+    Device output is asserted byte-identical to the CPU stream before
+    any timing (the erasure kernels' oracle discipline)."""
+    import io
+    import csv as _csv
+    import random as _random
+    import threading
+
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.s3select.select import SelectRequest, event_stream
+    from minio_tpu.scan import ScanEngine
+
+    rng = _random.Random(20240803)
+    buf = io.StringIO()
+    w = _csv.writer(buf)
+    w.writerow(("a", "b", "c", "d"))
+    words = ("x", "zz", "abc", "Par", "x y", "")
+    for i in range(rows):
+        w.writerow((rng.randint(-50, 50), round(rng.uniform(0, 9), 3),
+                    rng.choice(words), i % 7))
+    data = buf.getvalue().encode()
+
+    req = SelectRequest()
+    req.expression = ("SELECT a, b, c FROM S3Object WHERE "
+                      "(a >= 0 AND b < 4.5) OR c LIKE 'x%' "
+                      "OR d BETWEEN 2 AND 3")
+    req.csv_header = "USE"
+
+    was_mode = os.environ.get("MINIO_TPU_SCAN_DEVICE")
+    os.environ["MINIO_TPU_SCAN_DEVICE"] = "force"
+    out: dict = {"config": {"rows": rows, "streams": list(streams),
+                            "queries_per_stream": queries_per_stream,
+                            "expression": req.expression},
+                 "points": []}
+    sched = BatchScheduler(max_wait=sched_max_wait)
+    try:
+        oracle = b"".join(event_stream(req, data))
+        out["config"]["response_bytes"] = len(oracle)
+        eng = ScanEngine(sched)
+        # byte-identity + jit warm BEFORE timing
+        if b"".join(eng.event_stream(req, data)) != oracle:
+            raise AssertionError("device Select diverged from the "
+                                 "CPU evaluator")
+        if eng.device_serves != 1:
+            raise AssertionError(
+                f"device path declined: {eng.fallback_reasons}")
+
+        def run_point(n: int, device: bool) -> dict:
+            engine = ScanEngine(sched) if device else None
+            lats: list[float] = []
+            errs: list[BaseException] = []
+            mu = threading.Lock()
+            barrier = threading.Barrier(n)
+
+            def one() -> None:
+                try:
+                    barrier.wait()
+                    for _ in range(queries_per_stream):
+                        t0 = time.perf_counter()
+                        if device:
+                            body = b"".join(
+                                engine.event_stream(req, data))
+                        else:
+                            body = b"".join(event_stream(req, data))
+                        dt = time.perf_counter() - t0
+                        if body != oracle:
+                            raise AssertionError(
+                                "device Select diverged from the CPU "
+                                "evaluator under concurrency")
+                        with mu:
+                            lats.append(dt)
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    with mu:                # on the main thread below
+                        errs.append(e)
+
+            ts = [threading.Thread(target=one) for _ in range(n)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise errs[0]
+            nq = n * queries_per_stream
+            xs = sorted(lats)
+            point = {
+                "queries": nq,
+                "wall_s": round(wall, 3),
+                "queries_per_s": round(nq / wall, 2),
+                "scanned_mb_s": round(nq * len(data) / wall / 1e6, 1),
+                "p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                "p99_ms": round(xs[max(0, int(len(xs) * .99) - 1)]
+                                * 1e3, 2),
+            }
+            if device:
+                point["device_serves"] = engine.device_serves
+                point["fallbacks"] = engine.fallbacks
+            return point
+
+        for n in streams:
+            before = dict(sched.verb_stats["scan"])
+            dev = run_point(n, device=True)
+            vs = sched.verb_stats["scan"]
+            dev["sched_batches"] = vs["batches"] - before["batches"]
+            dev["sched_coalesced"] = (vs["coalesced"]
+                                      - before["coalesced"])
+            cpu = run_point(n, device=False)
+            out["points"].append({
+                "streams": n, "device": dev, "cpu": cpu,
+                "speedup_x": round(cpu["wall_s"]
+                                   / max(dev["wall_s"], 1e-9), 2)})
+    finally:
+        sched.close()
+        if was_mode is None:
+            os.environ.pop("MINIO_TPU_SCAN_DEVICE", None)
+        else:
+            os.environ["MINIO_TPU_SCAN_DEVICE"] = was_mode
+    out["max_speedup_x"] = max(p["speedup_x"] for p in out["points"])
+    return out
+
+
+def bench_cache_ab(objects: int = 16, size: int = 4 << 20,
+                   gets: int = 200, streams: int = 4,
+                   drives: int = 6, parity: int = 2,
+                   block: int = 1 << 18) -> dict:
+    """Hot-GET A/B: erasure read path with the hot-object read cache
+    off vs on.
+
+    One pool on tmpfs seeded with `objects` objects; `gets` reads from
+    `streams` threads over a hot subset (80/20-ish zipf pick). The
+    cache-on pass wires CacheObjects the way cluster boot does
+    (attach_read_cache + wrapper serving GETs) with a 1-hit admission
+    bar so the second touch of every hot key serves from the cache
+    WITHOUT the shard-read/verify/decode path — proven by the
+    minio_tpu_erasure_get_streams_total counter delta, not just
+    latency. Bytes are asserted identical to the backend read."""
+    import random as _random
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.cache import CacheObjects
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.utils import telemetry
+
+    def decode_streams() -> float:
+        return telemetry.REGISTRY.counter(
+            "minio_tpu_erasure_get_streams_total",
+            "Object read streams served through the erasure "
+            "shard-read/verify/decode path").value()
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_cache_", dir=base)
+    out: dict = {"config": {"objects": objects, "size": size,
+                            "gets": gets, "streams": streams,
+                            "drives": drives, "m": parity}}
+    rng = _random.Random(4096)
+    # 80% of reads land on the hottest 20% of keys
+    hot = max(1, objects // 5)
+    picks = [rng.randrange(hot) if rng.random() < 0.8
+             else rng.randrange(objects) for _ in range(gets)]
+    try:
+        zz = ErasureServerSets([ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)], load_topology=False)
+        zz.make_bucket("bench")
+        payloads = []
+        for i in range(objects):
+            payloads.append(os.urandom(size))
+            zz.put_object("bench", f"o-{i:04d}", payloads[i])
+
+        def run_pass(layer) -> dict:
+            lats: list[float] = []
+            mu = threading.Lock()
+            chunks = [picks[i::streams] for i in range(streams)]
+            barrier = threading.Barrier(streams)
+
+            def one(mine: list) -> None:
+                barrier.wait()
+                for idx in mine:
+                    t0 = time.perf_counter()
+                    _info, s = layer.get_object("bench", f"o-{idx:04d}")
+                    body = b"".join(s)
+                    dt = time.perf_counter() - t0
+                    assert body == payloads[idx]
+                    with mu:
+                        lats.append(dt)
+
+            before = decode_streams()
+            ts = [threading.Thread(target=one, args=(c,))
+                  for c in chunks if c]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            xs = sorted(lats)
+            return {
+                "wall_s": round(wall, 3),
+                "get_gib_s": round(len(lats) * size / wall / (1 << 30),
+                                   3),
+                "p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                "p99_ms": round(xs[max(0, int(len(xs) * .99) - 1)]
+                                * 1e3, 2),
+                "decode_streams": round(decode_streams() - before, 1),
+            }
+
+        out["off"] = run_pass(zz)
+
+        cache = CacheObjects(zz, os.path.join(root, "cache"),
+                             budget_bytes=2 * objects * size,
+                             admit_hits=1)
+        zz.attach_read_cache(cache)
+        out["on"] = run_pass(cache)
+        out["on"]["cache"] = {k: cache.stats()[k] for k in
+                              ("hits", "misses", "fills", "evictions")}
+        out["speedup_x"] = round(out["off"]["wall_s"]
+                                 / max(out["on"]["wall_s"], 1e-9), 2)
+        out["decode_streams_saved"] = round(
+            out["off"]["decode_streams"] - out["on"]["decode_streams"],
+            1)
+    finally:
+        try:
+            zz.close()
+        except Exception:  # noqa: BLE001 — includes zz never assigned
+            pass
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ab-pipeline", action="store_true",
@@ -1035,6 +1288,29 @@ def main() -> int:
     ap.add_argument("--ab-list-smoke", action="store_true",
                     help="tiny listing A/B (400 keys, 50-key pages) "
                          "for CI — seconds, not minutes")
+    ap.add_argument("--ab-select", action="store_true",
+                    help="run ONLY the S3 Select A/B (device scan "
+                         "plane vs CPU evaluator) at 1..N concurrent "
+                         "queries, with scan-verb coalescing counters "
+                         "per point")
+    ap.add_argument("--ab-select-streams",
+                    default=os.environ.get("BENCH_SELECT_STREAMS",
+                                           "1,2,4,8"),
+                    help="comma-separated concurrency points for "
+                         "--ab-select")
+    ap.add_argument("--ab-select-rows", type=int,
+                    default=int(os.environ.get("BENCH_SELECT_ROWS",
+                                               "20000")))
+    ap.add_argument("--ab-select-smoke", action="store_true",
+                    help="tiny Select A/B (2 points, 3000-row corpus) "
+                         "for CI — seconds, not minutes")
+    ap.add_argument("--ab-cache", action="store_true",
+                    help="run ONLY the hot-GET A/B (erasure read path "
+                         "with the hot-object read cache off vs on, "
+                         "decode-stream counter deltas)")
+    ap.add_argument("--ab-cache-smoke", action="store_true",
+                    help="tiny cache A/B (8 x 256 KiB objects, 60 "
+                         "GETs) for CI — seconds, not minutes")
     ap.add_argument("--ab-tier", action="store_true",
                     help="run ONLY the tier-transition-throttle A/B "
                          "(foreground PUT p50/p99 with vs without the "
@@ -1074,6 +1350,39 @@ def main() -> int:
             "value": ab.get("page_p50_speedup_x"),
             "unit": "x",
             "list_ab": ab,
+        }))
+        return 0
+
+    if args.ab_select or args.ab_select_smoke:
+        if args.ab_select_smoke:
+            ab = bench_select_ab(streams=(1, 2), rows=3000,
+                                 queries_per_stream=2)
+        else:
+            ab = bench_select_ab(
+                streams=tuple(int(x) for x in
+                              args.ab_select_streams.split(",") if x),
+                rows=args.ab_select_rows)
+        print(json.dumps({
+            "metric": "S3 Select aggregate speedup, device scan plane "
+                      "vs CPU evaluator (max over concurrency points)",
+            "value": ab.get("max_speedup_x"),
+            "unit": "x",
+            "select_ab": ab,
+        }))
+        return 0
+
+    if args.ab_cache or args.ab_cache_smoke:
+        if args.ab_cache_smoke:
+            ab = bench_cache_ab(objects=8, size=1 << 18, gets=60,
+                                streams=2)
+        else:
+            ab = bench_cache_ab()
+        print(json.dumps({
+            "metric": "hot-GET speedup with the erasure-path "
+                      "hot-object read cache (80/20 workload)",
+            "value": ab.get("speedup_x"),
+            "unit": "x",
+            "cache_ab": ab,
         }))
         return 0
 

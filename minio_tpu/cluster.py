@@ -368,6 +368,28 @@ class ClusterNode:
             self.metacache = MetacacheManager(self.object_layer).start()
             self.object_layer.attach_metacache(self.metacache)
 
+        # -- device scan plane (TPU-offloaded S3 Select) -------------------
+        # wire the handler's ScanEngine onto the shared batch former:
+        # concurrent SelectObjectContent requests coalesce their pages
+        # into single device launches (fourth verb of the scheduler);
+        # same instance, so its serve/fallback stats stay continuous
+        self.s3.api.scan.scheduler = self.scheduler
+
+        # -- hot-object read cache in front of the erasure path ------------
+        from .object import cache as _cache
+        self.read_cache = None
+        if _cache.enabled() and self.spec.drives:
+            default_dir = os.path.join(self.spec.drives[0],
+                                       ".minio.sys", "cache")
+            self.read_cache = _cache.CacheObjects.from_env(
+                self.object_layer, default_dir)
+            # invalidation rides the namespace feed; the S3 surface
+            # serves THROUGH the wrapper (GET/Select hits skip the
+            # erasure decode path entirely); background planes keep
+            # the raw layer — they must never populate the cache
+            self.object_layer.attach_read_cache(self.read_cache)
+            self.s3.api.set_object_layer(self.read_cache)
+
         # -- background plane (initAutoHeal + initDataCrawler) -------------
         from .object.background import (DataUsageCrawler, DiskMonitor,
                                         HealScanner)

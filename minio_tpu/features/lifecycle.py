@@ -333,8 +333,9 @@ def iter_version_groups(object_layer, bucket: str,
     carry: list = []
     while True:
         try:
-            versions, nkm, nvm, trunc = object_layer.list_object_versions(
-                bucket, "", marker, 1000, vid_marker)
+            versions, _pfx, nkm, nvm, trunc = \
+                object_layer.list_object_versions(
+                    bucket, "", marker, 1000, vid_marker)
         except api_errors.ObjectApiError:
             return
         for v in versions:

@@ -370,10 +370,12 @@ class HDFSGatewayObjects:
 
     def list_object_versions(self, bucket: str, prefix: str = "",
                              marker: str = "", max_keys: int = 1000,
-                             version_marker: str = ""):
-        objs, _p, trunc = self.list_objects(bucket, prefix, marker,
-                                         max_keys=max_keys)
-        return single_version_page(objs, trunc)
+                             version_marker: str = "",
+                             delimiter: str = ""):
+        objs, pfx, trunc = self.list_objects(bucket, prefix, marker,
+                                             delimiter,
+                                             max_keys=max_keys)
+        return single_version_page(objs, trunc, pfx)
 
     # -- multipart (buffered parts, like the S3-proxy gateway) --------------
 

@@ -127,6 +127,14 @@ class AdminClient:
         zones nested for server-sets backends)."""
         return self._json("GET", "mrf")
 
+    def metacache_stats(self, bucket: str = "") -> dict:
+        """Bucket metacache state: per-bucket index entries/state/
+        invalid/dirty/generation, pending journal deltas, and the
+        serve/fallback/drop/reconcile counters ({"enabled": False}
+        when the node runs without the index)."""
+        query = {"bucket": bucket} if bucket else None
+        return self._json("GET", "metacache", query)
+
     # -- tiering -----------------------------------------------------------
 
     def add_tier(self, name: str, type_: str, update: bool = False,

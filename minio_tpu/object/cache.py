@@ -24,6 +24,36 @@ Parity with the reference's cache depth (VERDICT r4 #4):
     entry's clock.
 
 Mutations through the wrapper invalidate the whole entry.
+
+Erasure-path wiring (the hot-object read cache of the device scan
+plane): attached at cluster boot in FRONT of ErasureServerSets (like
+``attach_metacache``), a cache hit serves plain GETs and Select scans
+from the local framed entry WITHOUT touching the erasure decode path.
+
+  * **Admission by access frequency** — only objects GET-hit at least
+    ``admit_hits`` times inside ``admit_window_s`` are filled
+    (cmd/disk-cache.go cacheControl + the reference's online/offline
+    gating, driven here by the telemetry access histogram): one-shot
+    bulk reads never churn the watermark LRU.
+  * **Invalidation off the namespace feed** — the engines'
+    ``on_namespace_change`` hook (the metacache's delta feed) fans out
+    to :meth:`CacheObjects.on_namespace_change`, so mutations that
+    bypass the wrapper (lifecycle transition, rebalance, heal,
+    replication, restore) still evict. The per-GET etag check remains
+    the backstop: a stale entry can mis-HIT but never serve wrong
+    bytes.
+  * **Tiering interplay** — a transitioned (stubbed) version evicts on
+    the transition's namespace delta, AND the serve path re-checks the
+    backend metadata: a cached copy never answers a GET that must
+    return ``InvalidObjectState``.
+
+Env (cluster boot; constructor args override):
+  MINIO_TPU_CACHE=on|off            master switch (default off)
+  MINIO_TPU_CACHE_DIR=<path>        entry directory (default
+                                    <first-drive>/.minio.sys/cache)
+  MINIO_TPU_CACHE_BUDGET_BYTES      watermark budget (default 1 GiB)
+  MINIO_TPU_CACHE_ADMIT=2           GETs within the window to admit
+  MINIO_TPU_CACHE_ADMIT_WINDOW_S    frequency window (default 300)
 """
 
 from __future__ import annotations
@@ -34,9 +64,12 @@ import json
 import os
 import shutil
 import threading
+import time
 from typing import Iterator, Optional
 
 from .. import bitrot as bitrot_mod
+from ..storage.datatypes import is_restored, is_transitioned
+from ..utils import telemetry
 from . import api_errors
 from .engine import GetOptions, PutOptions
 
@@ -48,6 +81,88 @@ CACHE_BLOCK = 1 << 20                 # frame payload size
 _ALGO = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256
 _DIG = 32                             # digest bytes per frame
 _FILL_SEQ = itertools.count()         # unique in-flight fill suffixes
+_TRACKER_MAX = 100_000                # bounded access-frequency table
+
+
+def enabled() -> bool:
+    return os.environ.get("MINIO_TPU_CACHE", "off").lower() in (
+        "on", "1", "true", "yes")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _metrics():
+    reg = telemetry.REGISTRY
+    return (
+        reg.counter("minio_tpu_cache_hits_total",
+                    "GET/Select reads served from the hot-object "
+                    "cache (no erasure decode)"),
+        reg.counter("minio_tpu_cache_misses_total",
+                    "Cache lookups that read through to the backend"),
+        reg.counter("minio_tpu_cache_fills_total",
+                    "Entries admitted and filled"),
+        reg.counter("minio_tpu_cache_evictions_total",
+                    "Entry evictions by cause (mutation, namespace "
+                    "delta, watermark purge, bitrot, transition)"),
+        reg.counter("minio_tpu_cache_bitrot_fallbacks_total",
+                    "Corrupt cache frames detected; response continued "
+                    "from the backend"),
+        reg.counter("minio_tpu_cache_admit_rejects_total",
+                    "Misses below the access-frequency admission bar"),
+        reg.histogram("minio_tpu_cache_object_access",
+                      "Access count within the admission window "
+                      "observed at each GET (the admission signal)"),
+    )
+
+
+class AccessTracker:
+    """Decaying per-object GET frequency — the admission signal. An
+    object qualifies once it was read `admit_hits` times within
+    `window_s`; the table is bounded (oldest half dropped on overflow)
+    so hot-key tracking never grows with the namespace."""
+
+    def __init__(self, admit_hits: int, window_s: float):
+        self.admit_hits = max(1, admit_hits)
+        self.window_s = window_s
+        self._mu = threading.Lock()
+        self._t: dict[tuple[str, str], tuple[int, float]] = {}
+
+    def record(self, bucket: str, key: str) -> int:
+        """Count one access; returns the in-window count."""
+        now = time.monotonic()
+        k = (bucket, key)
+        with self._mu:
+            count, first = self._t.get(k, (0, now))
+            if now - first > self.window_s:
+                count, first = 0, now          # window expired: restart
+            count += 1
+            self._t[k] = (count, first)
+            if len(self._t) > _TRACKER_MAX:
+                # overflow runs inside the GET hot path: single-pass
+                # drops of expired then older-half windows — never a
+                # full sort under the lock
+                for cutoff in (now - self.window_s,
+                               now - self.window_s / 2):
+                    self._t = {k: v for k, v in self._t.items()
+                               if v[1] >= cutoff}
+                    if len(self._t) <= _TRACKER_MAX:
+                        break
+                else:
+                    # uniform churn inside the half-window: drop
+                    # arbitrary entries to keep the table bounded
+                    it = iter(self._t)
+                    for k in [next(it)
+                              for _ in range(len(self._t) // 2)]:
+                        del self._t[k]
+        return count
+
+    def admitted(self, count: int) -> bool:
+        return count >= self.admit_hits
 
 
 class CacheObjects:
@@ -56,7 +171,9 @@ class CacheObjects:
 
     def __init__(self, inner, cache_dir: str,
                  budget_bytes: int = DEFAULT_BUDGET,
-                 block_size: int = CACHE_BLOCK):
+                 block_size: int = CACHE_BLOCK,
+                 admit_hits: int = 1,
+                 admit_window_s: float = 300.0):
         self.inner = inner
         self.dir = os.path.abspath(cache_dir)
         os.makedirs(self.dir, exist_ok=True)
@@ -64,7 +181,25 @@ class CacheObjects:
         self.block = block_size
         self.hits = 0
         self.misses = 0
+        self.fills = 0
+        self.evictions = 0
+        self.admit_rejects = 0
+        self.tracker = AccessTracker(admit_hits, admit_window_s)
+        self._m = _metrics()
         self._mu = threading.Lock()
+
+    @classmethod
+    def from_env(cls, inner, default_dir: str) -> "CacheObjects":
+        """The cluster-boot constructor: every knob from the
+        MINIO_TPU_CACHE_* environment."""
+        return cls(
+            inner,
+            os.environ.get("MINIO_TPU_CACHE_DIR") or default_dir,
+            budget_bytes=_env_int("MINIO_TPU_CACHE_BUDGET_BYTES",
+                                  DEFAULT_BUDGET),
+            admit_hits=_env_int("MINIO_TPU_CACHE_ADMIT", 2),
+            admit_window_s=float(os.environ.get(
+                "MINIO_TPU_CACHE_ADMIT_WINDOW_S", "300")))
 
     # everything not overridden passes straight through
     def __getattr__(self, name):
@@ -73,8 +208,11 @@ class CacheObjects:
     # -- entry layout ------------------------------------------------------
 
     def _entry_dir(self, bucket: str, key: str) -> str:
+        # first level keyed by BUCKET so delete_bucket purges one
+        # subtree instead of json-scanning every entry on the node
+        bh = hashlib.sha256(bucket.encode()).hexdigest()[:16]
         h = hashlib.sha256(f"{bucket}/{key}".encode()).hexdigest()
-        return os.path.join(self.dir, h[:2], h)
+        return os.path.join(self.dir, bh, h)
 
     def _load_entry(self, bucket: str, key: str) -> Optional[dict]:
         d = self._entry_dir(bucket, key)
@@ -98,8 +236,21 @@ class CacheObjects:
         except OSError:
             pass
 
-    def _drop(self, bucket: str, key: str) -> None:
-        shutil.rmtree(self._entry_dir(bucket, key), ignore_errors=True)
+    def _drop(self, bucket: str, key: str, cause: str = "mutation"
+              ) -> None:
+        d = self._entry_dir(bucket, key)
+        if os.path.isdir(d):
+            self.evictions += 1
+            self._m[3].inc(cause=cause)
+        shutil.rmtree(d, ignore_errors=True)
+
+    def on_namespace_change(self, bucket: str, key: str) -> None:
+        """The engines' namespace-delta feed (PUT/DELETE/metadata/
+        tier transition/stub — whatever mutated, the entry is stale):
+        evict. Mutations that bypass this wrapper (lifecycle
+        transitions, rebalance moves, heals, replication) reach the
+        cache only through this hook."""
+        self._drop(bucket, key, cause="namespace")
 
     def _drop_range(self, bucket: str, key: str, fname: str) -> None:
         """Remove one corrupt cache file and its meta reference."""
@@ -210,6 +361,8 @@ class CacheObjects:
                 except OSError:
                     pass
             else:
+                self.fills += 1
+                self._m[2].inc()
                 self._commit(bucket, key, info, fname, tmp,
                              file_start, file_start + span_len)
 
@@ -237,7 +390,8 @@ class CacheObjects:
             meta = self._load_entry(bucket, key)
             if meta is None or meta.get("etag") != info.etag:
                 # fresh entry (or a stale generation): ranges reset
-                meta = {"etag": info.etag, "size": info.size,
+                meta = {"bucket": bucket, "key": key,
+                        "etag": info.etag, "size": info.size,
                         "content_type": info.content_type,
                         "user_defined": dict(info.user_defined or {}),
                         "mod_time": info.mod_time, "ranges": []}
@@ -297,6 +451,8 @@ class CacheObjects:
                 if usage <= target:
                     break
                 shutil.rmtree(d, ignore_errors=True)
+                self.evictions += 1
+                self._m[3].inc(cause="watermark")
                 usage -= size
 
     # -- ObjectLayer overrides ---------------------------------------------
@@ -304,15 +460,40 @@ class CacheObjects:
     def get_object(self, bucket: str, key: str, offset: int = 0,
                    length: int = -1,
                    opts: Optional[GetOptions] = None):
+        if bucket.startswith("."):
+            # .minio.sys carries config/index objects mutated by inner
+            # layers the wrapper never sees — never cache them
+            return self.inner.get_object(bucket, key, offset, length,
+                                         opts)
         if opts is not None and getattr(opts, "version_id", ""):
             return self.inner.get_object(bucket, key, offset, length,
                                          opts)
+        count = self.tracker.record(bucket, key)
+        self._m[6].observe(count)
+        meta = self._load_entry(bucket, key)
+        if meta is None and not self.tracker.admitted(count):
+            # common first-touch path: no entry and below the
+            # admission bar — pass straight through WITHOUT the extra
+            # quorum stat (the inner GET stats and gates itself)
+            self.misses += 1
+            self._m[1].inc()
+            self.admit_rejects += 1
+            self._m[5].inc()
+            return self.inner.get_object(bucket, key, offset, length,
+                                         opts)
         info = self.inner.get_object_info(bucket, key, opts)
+        md = info.user_defined or {}
+        if is_transitioned(md) and not is_restored(md):
+            # the data lives in a remote tier with no restored copy: a
+            # cached generation must NOT answer — the backend GET is
+            # the single home of the InvalidObjectState gate
+            self._drop(bucket, key, cause="transition")
+            return self.inner.get_object(bucket, key, offset, length,
+                                         opts)
         want_len = info.size - offset if length < 0 else length
         want_len = max(0, min(want_len, info.size - offset))
         end = offset + want_len
 
-        meta = self._load_entry(bucket, key)
         if meta is not None and meta.get("etag") != info.etag:
             self._drop(bucket, key)     # stale generation
             meta = None
@@ -325,9 +506,18 @@ class CacheObjects:
                                          r["file"], r["start"], offset,
                                          want_len)
                 self.hits += 1
+                self._m[0].inc()
                 self._touch(bucket, key)
                 return info, stream
         self.misses += 1
+        self._m[1].inc()
+        if not self.tracker.admitted(count):
+            # below the access-frequency bar: read through without
+            # filling — one-shot scans must not churn the LRU
+            self.admit_rejects += 1
+            self._m[5].inc()
+            return self.inner.get_object(bucket, key, offset, length,
+                                         opts)
         return self._fill_or_passthrough(bucket, key, info, opts,
                                          offset, want_len)
 
@@ -342,9 +532,18 @@ class CacheObjects:
                                            length):
                 yield piece
                 sent += len(piece)
-        except (api_errors.ObjectApiError, OSError):
-            # OSError: the entry was purged/invalidated under us — the
-            # backend still has the object
+        except (api_errors.ObjectApiError, OSError) as e:
+            # ObjectApiError = a frame failed its bitrot check — real
+            # corruption; OSError = purged under us (already counted
+            # by its watermark/namespace eviction) OR a persistent
+            # I/O error. Only corruption counts as bitrot, but the
+            # range drops either way: on a purge race it is a no-op,
+            # on a bad sector it stops re-opening the bad file on
+            # every later GET. The backend still has the object.
+            if isinstance(e, api_errors.ObjectApiError):
+                self._m[4].inc()
+                self._m[3].inc(cause="bitrot")
+                self.evictions += 1
             self._drop_range(bucket, key, fname)
             if sent < length:
                 _, rest = self.inner.get_object(
@@ -405,6 +604,7 @@ class CacheObjects:
             meta = self._load_entry(bucket, key)
             if meta is None or meta.get("etag") != info.etag:
                 self._write_meta(d, {
+                    "bucket": bucket, "key": key,
                     "etag": info.etag, "size": info.size,
                     "content_type": info.content_type,
                     "user_defined": dict(info.user_defined or {}),
@@ -432,6 +632,17 @@ class CacheObjects:
         return self.inner.update_object_metadata(bucket, key, metadata,
                                                  version_id)
 
+    def delete_bucket(self, bucket: str, force: bool = False):
+        """Purge every entry of the deleted bucket — a recreated
+        same-name bucket must start cold. The entry layout's first
+        level is the bucket hash, so the purge is one rmtree."""
+        out = self.inner.delete_bucket(bucket, force)
+        bh = hashlib.sha256(bucket.encode()).hexdigest()[:16]
+        shutil.rmtree(os.path.join(self.dir, bh), ignore_errors=True)
+        return out
+
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
+                "fills": self.fills, "evictions": self.evictions,
+                "admit_rejects": self.admit_rejects,
                 "usage": self._usage(), "budget": self.budget}

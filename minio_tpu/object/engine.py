@@ -40,7 +40,8 @@ from ..storage.datatypes import (BLOCK_SIZE_V1, RESTORE_EXPIRY_KEY,
                                  TRANSITIONED_OBJECT_KEY,
                                  TRANSITIONED_VERSION_KEY, ChecksumInfo,
                                  FileInfo, ObjectInfo, is_restored,
-                                 is_transitioned, new_file_info, now)
+                                 is_transitioned, last_version_marker,
+                                 new_file_info, now)
 from ..storage.xl_storage import (MINIO_META_BUCKET,
                                   MINIO_META_MULTIPART_BUCKET,
                                   MINIO_META_TMP_BUCKET)
@@ -74,6 +75,21 @@ class PutOptions:
 class GetOptions:
     def __init__(self, version_id: str = ""):
         self.version_id = version_id
+
+
+_GET_STREAMS = None
+
+
+def _get_streams_counter():
+    """Resolved once — the registry lookup takes the global metrics
+    mutex, which the per-GET hot path must not contend on."""
+    global _GET_STREAMS
+    if _GET_STREAMS is None:
+        _GET_STREAMS = telemetry.REGISTRY.counter(
+            "minio_tpu_erasure_get_streams_total",
+            "Object read streams served through the erasure "
+            "shard-read/verify/decode path")
+    return _GET_STREAMS
 
 
 class ErasureObjects:
@@ -983,6 +999,10 @@ class ErasureObjects:
         group is already reading on the prefetch pool (its readers are
         independent streams, so no io_lock is shared across parts)."""
         from ..parallel import pipeline as pl
+        # every erasure read stream counts here — the hot-object read
+        # cache's "hit serves WITHOUT erasure decode" proof is a flat
+        # delta on this counter across a cached GET
+        _get_streams_counter().inc()
         shuffled_disks = meta.shuffle_disks(online, fi.erasure.distribution)
         shuffled_meta = meta.shuffle_parts_metadata(metas,
                                                     fi.erasure.distribution)
@@ -1438,50 +1458,26 @@ class ErasureObjects:
 
     def list_object_versions(self, bucket: str, prefix: str = "",
                              marker: str = "", max_keys: int = 1000,
-                             version_marker: str = ""
-                             ) -> tuple[list[ObjectInfo], str, str, bool]:
+                             version_marker: str = "",
+                             delimiter: str = ""
+                             ) -> tuple[list[ObjectInfo], list[str],
+                                        str, str, bool]:
         """One page of the bucket's version history: (versions,
-        next_key_marker, next_version_id_marker, is_truncated).
+        common_prefixes, next_key_marker, next_version_id_marker,
+        is_truncated) — the page shape lives in paginate_versions, the
+        SAME loop the metacache index serve runs.
 
-        A page boundary may fall INSIDE one key's version list — the
-        returned markers make the cut explicit and resumable (the old
-        bare-list form cut mid-object with no truncation signal, so a
-        pager silently lost the key's remaining versions).
         `version_marker` resumes AFTER that version of `marker` (S3
         version-id-marker semantics); an unknown version id falls back
         to the key's whole version list, which can only over-return,
-        never skip."""
+        never skip. A delimiter rolls keys up into CommonPrefixes like
+        the reference's ListObjectVersions."""
         self.get_bucket_info(bucket)
-        if max_keys <= 0:
-            return [], "", "", False
-        out: list[ObjectInfo] = []
         names = self._merged_names(bucket, prefix, marker,
                                    inclusive=bool(version_marker))
-        for name in names:
-            if marker:
-                if name < marker or (not version_marker
-                                     and name == marker):
-                    continue
-            vers = self.object_versions(bucket, name)
-            if version_marker and name == marker:
-                # "null" is the wire form of the empty (pre-versioning)
-                # version id (xmlgen emits it, clients echo it back)
-                vm = "" if version_marker == "null" else version_marker
-                idx = next((i for i, v in enumerate(vers)
-                            if v.version_id == vm), None)
-                if idx is not None:
-                    vers = vers[idx + 1:]
-            for oi in vers:
-                if len(out) >= max_keys:
-                    # an overflow version was actually SEEN: the page
-                    # is provably truncated, markers point at the cut.
-                    # A null version id rides as the "null" sentinel —
-                    # an empty marker would read as NO marker on resume
-                    # and skip the key's remaining versions
-                    return (out, out[-1].name,
-                            out[-1].version_id or "null", True)
-                out.append(oi)
-        return out, "", "", False
+        return paginate_versions(
+            names, lambda n: self.object_versions(bucket, n),
+            prefix, marker, version_marker, delimiter, max_keys)
 
     def object_versions(self, bucket: str, name: str) -> list[ObjectInfo]:
         """Quorum-merged versions of ONE object as API ObjectInfos,
@@ -1613,6 +1609,77 @@ def paginate_objects(names, read_latest, prefix: str, marker: str,
             objects = objects[:max_keys - len(prefixes)]
             break
     return objects, prefixes, truncated
+
+
+def paginate_versions(names, versions_of, prefix: str, marker: str,
+                      version_marker: str, delimiter: str, max_keys: int
+                      ) -> tuple[list[ObjectInfo], list[str], str, str,
+                                 bool]:
+    """The single home of the versions-listing page shape: delimiter
+    grouping (CommonPrefixes, like the reference's ListObjectVersions),
+    key+version-id marker resume, and max_keys truncation over a sorted
+    prefix-matching name stream. Both the merge-walk path
+    (ErasureObjects.list_object_versions) and the metacache index serve
+    run THIS loop, so index-served pages are shape-identical to the
+    oracle by construction.
+
+    Returns (versions, common_prefixes, next_key_marker,
+    next_version_id_marker, is_truncated). Versions and prefixes each
+    count one entry toward max_keys (S3 semantics). A page boundary may
+    fall INSIDE one key's version list — the markers make the cut
+    explicit and resumable; a cut at a rolled-up prefix sets
+    next_key_marker to the prefix itself (keys under it sort after it,
+    and the `p <= marker` skip on resume collapses them straight back
+    into the already-returned prefix entry). `versions_of(name)`
+    returns the key's quorum-merged versions, newest first."""
+    out: list[ObjectInfo] = []
+    prefixes: list[str] = []
+    seen_prefix: set[str] = set()
+    if max_keys <= 0:
+        return [], [], "", "", False
+    for name in names:
+        if marker:
+            if name < marker or (not version_marker and name == marker):
+                continue
+        if delimiter:
+            rest = name[len(prefix):]
+            di = rest.find(delimiter)
+            if di >= 0:
+                p = prefix + rest[:di + len(delimiter)]
+                if marker and p <= marker:
+                    continue  # prefix page already returned
+                if p not in seen_prefix:
+                    seen_prefix.add(p)
+                    if len(out) + len(prefixes) >= max_keys:
+                        # overflow entry actually seen: provably
+                        # truncated, the cut falls BEFORE this prefix
+                        nkm, nvm = _last_marker(out, prefixes)
+                        return out, prefixes, nkm, nvm, True
+                    prefixes.append(p)
+                continue
+        vers = versions_of(name)
+        if version_marker and name == marker:
+            # "null" is the wire form of the empty (pre-versioning)
+            # version id (xmlgen emits it, clients echo it back)
+            vm = "" if version_marker == "null" else version_marker
+            idx = next((i for i, v in enumerate(vers)
+                        if v.version_id == vm), None)
+            if idx is not None:
+                vers = vers[idx + 1:]
+        for oi in vers:
+            if len(out) + len(prefixes) >= max_keys:
+                # A null version id rides as the "null" sentinel — an
+                # empty marker would read as NO marker on resume and
+                # skip the key's remaining versions
+                nkm, nvm = _last_marker(out, prefixes)
+                return out, prefixes, nkm, nvm, True
+            out.append(oi)
+    return out, prefixes, "", "", False
+
+
+# the single home of the page-cut marker rule (shared with
+# sets.merge_version_listings and the FS/gateway single_version_page)
+_last_marker = last_version_marker
 
 
 class _UnlockOnClose:

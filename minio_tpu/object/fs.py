@@ -354,13 +354,16 @@ class FSObjects:
 
     def list_object_versions(self, bucket: str, prefix: str = "",
                              marker: str = "", max_keys: int = 1000,
-                             version_marker: str = ""
-                             ) -> tuple[list[ObjectInfo], str, str, bool]:
+                             version_marker: str = "",
+                             delimiter: str = ""
+                             ) -> tuple[list[ObjectInfo], list[str],
+                                        str, str, bool]:
         """FS backend is unversioned: one "version" per key, paged on
-        the key marker alone (the erasure layer's 4-tuple contract)."""
-        objs, _, trunc = self.list_objects(bucket, prefix, marker, "",
-                                           max_keys)
-        return single_version_page(objs, trunc)
+        the key marker alone (the erasure layer's 5-tuple contract);
+        the delimiter rolls up through the regular listing."""
+        objs, pfx, trunc = self.list_objects(bucket, prefix, marker,
+                                             delimiter, max_keys)
+        return single_version_page(objs, trunc, pfx)
 
     # -- multipart ---------------------------------------------------------
 

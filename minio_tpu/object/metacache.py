@@ -66,7 +66,7 @@ from ..storage.datatypes import ObjectInfo, ObjectPartInfo
 from ..storage.xl_storage import MINIO_META_BUCKET
 from ..utils import telemetry
 from . import api_errors
-from .engine import paginate_objects
+from .engine import paginate_objects, paginate_versions
 
 _FORMAT = 1
 
@@ -824,45 +824,29 @@ class MetacacheManager:
 
     def serve_list_object_versions(self, bucket: str, prefix: str,
                                    marker: str, max_keys: int,
-                                   version_marker: str = ""):
-        """One list_object_versions page (the engine's 4-tuple) from
-        the index, or None to fall back."""
+                                   version_marker: str = "",
+                                   delimiter: str = ""):
+        """One list_object_versions page (the engine's 5-tuple,
+        CommonPrefixes included) from the index, or None to fall back.
+        Page shape comes from the SAME paginate_versions loop the
+        merge-walk runs."""
         idx = self._ready_index(bucket)
         if idx is None:
             self.fallbacks += 1
             self._m[1].inc()
             return None
         self.obj.get_bucket_info(bucket)
-        if max_keys <= 0:
-            return [], "", "", False
         with telemetry.span("metacache.serve", bucket=bucket,
                             verb="versions"):
             entries = idx.entries
-            out: list[ObjectInfo] = []
-            for name in self._iter_names_chunked(
-                    idx, prefix, marker,
-                    inclusive=bool(version_marker)):
-                if marker and (name < marker or (
-                        not version_marker and name == marker)):
-                    continue
-                vers = entries.get(name) or []
-                if version_marker and name == marker:
-                    vm = "" if version_marker == "null" \
-                        else version_marker
-                    i = next((j for j, v in enumerate(vers)
-                              if v.version_id == vm), None)
-                    if i is not None:
-                        vers = vers[i + 1:]
-                for oi in vers:
-                    if len(out) >= max_keys:
-                        self.serves += 1
-                        self._m[0].inc()
-                        return (out, out[-1].name,
-                                out[-1].version_id or "null", True)
-                    out.append(oi)
+            page = paginate_versions(
+                self._iter_names_chunked(idx, prefix, marker,
+                                         inclusive=bool(version_marker)),
+                lambda n: entries.get(n) or [],
+                prefix, marker, version_marker, delimiter, max_keys)
         self.serves += 1
         self._m[0].inc()
-        return out, "", "", False
+        return page
 
     # -- the namespace feed ------------------------------------------------
 

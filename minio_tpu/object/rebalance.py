@@ -280,7 +280,7 @@ class Rebalancer:
         carry: list = []
         while not self._stop.is_set():
             try:
-                page, nkm, nvm, trunc = src.list_object_versions(
+                page, _pfx, nkm, nvm, trunc = src.list_object_versions(
                     bucket, "", marker, self.page, vid_marker)
             except api_errors.ObjectApiError:
                 return                  # bucket vanished mid-drain
@@ -320,8 +320,8 @@ class Rebalancer:
             + [MINIO_META_BUCKET]
         for bucket in buckets:
             try:
-                page, _, _, _ = src.list_object_versions(bucket, "", "",
-                                                         self.page)
+                page, _, _, _, _ = src.list_object_versions(
+                    bucket, "", "", self.page)
             except api_errors.ObjectApiError:
                 continue
             remaining += len(self._group(page, bucket))

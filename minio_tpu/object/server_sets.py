@@ -44,19 +44,50 @@ class ErasureServerSets:
         # listings serve from it (merge-walk fallback) and the engines'
         # namespace-change hooks feed its delta journal
         self.metacache = None
+        # hot-object read cache (object/cache.py): attached at boot,
+        # invalidated off the same namespace feed
+        self.read_cache = None
+        # ONE namespace-change feed, many consumers: the engines call
+        # _dispatch_namespace_change, which fans out to every attached
+        # listener (metacache journal, read-cache invalidation)
+        self._ns_listeners: list = []
         if topology is None and load_topology:
             # recover the newest persisted map (highest epoch across
             # pools); a fresh cluster starts all-active at epoch 0
             topology = TopologyStore.load(self)
         self.topology = topology or TopologyMap(len(server_sets))
 
+    def _dispatch_namespace_change(self, bucket: str,
+                                   object_name: str) -> None:
+        """Fan one engine namespace delta out to every listener; a
+        broken listener never blocks the others (or the write path)."""
+        for fn in self._ns_listeners:
+            try:
+                fn(bucket, object_name)
+            except Exception:  # noqa: BLE001 — feed is best-effort
+                pass
+
+    def register_namespace_listener(self, fn) -> None:
+        """Subscribe `fn(bucket, object_name)` to the engines' mutation
+        feed and (re)wire every pool's hook at the dispatcher."""
+        if fn not in self._ns_listeners:
+            self._ns_listeners.append(fn)
+        for z in self.server_sets:
+            z.on_namespace_change = self._dispatch_namespace_change
+
     def attach_metacache(self, manager) -> None:
         """Wire the MetacacheManager: every pool's engines journal
         namespace deltas into it, and the listing paths consult it
         first (None = fall back to the merge-walk)."""
         self.metacache = manager
-        for z in self.server_sets:
-            z.on_namespace_change = manager.record
+        self.register_namespace_listener(manager.record)
+
+    def attach_read_cache(self, cache) -> None:
+        """Wire the hot-object read cache's invalidation into the
+        namespace feed (the serving side wraps this layer — see
+        cluster boot)."""
+        self.read_cache = cache
+        self.register_namespace_listener(cache.on_namespace_change)
 
     def single_zone(self) -> bool:
         return len(self.server_sets) == 1
@@ -434,17 +465,20 @@ class ErasureServerSets:
         return out
 
     def list_object_versions(self, bucket, prefix="", marker="",
-                             max_keys=1000, version_marker=""):
+                             max_keys=1000, version_marker="",
+                             delimiter=""):
         from .sets import merge_version_listings
         t0 = time.monotonic()
         if self.metacache is not None:
             page = self.metacache.serve_list_object_versions(
-                bucket, prefix, marker, max_keys, version_marker)
+                bucket, prefix, marker, max_keys, version_marker,
+                delimiter)
             if page is not None:
                 self._observe_listing("versions", "index", t0)
                 return page
         per_zone = [z.list_object_versions(bucket, prefix, marker,
-                                           max_keys, version_marker)
+                                           max_keys, version_marker,
+                                           delimiter)
                     for z in self.server_sets]
         out = merge_version_listings(per_zone, max_keys)
         self._observe_listing("versions", "walk", t0)
@@ -498,10 +532,11 @@ class ErasureServerSets:
             except api_errors.BucketExists:
                 pass
         self.server_sets.append(sets)
-        if self.metacache is not None:
-            # the new pool's engines must feed the index like boot-time
-            # pools, or its writes would be invisible until reconcile
-            sets.on_namespace_change = self.metacache.record
+        if self._ns_listeners:
+            # the new pool's engines must feed the listeners like
+            # boot-time pools, or its writes would be invisible to the
+            # index/cache until reconcile
+            sets.on_namespace_change = self._dispatch_namespace_change
         self.topology.add_pool(POOL_ACTIVE)
         TopologyStore.save(self, self.topology)
         # a drain parked for lack of target capacity can proceed now

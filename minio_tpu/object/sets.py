@@ -18,7 +18,7 @@ import uuid as _uuid
 from typing import Optional
 
 from ..storage import errors as serr
-from ..storage.datatypes import ObjectInfo
+from ..storage.datatypes import ObjectInfo, last_version_marker
 from ..storage.format import (DISTRIBUTION_ALGO_V2, DISTRIBUTION_ALGO_V3,
                               FormatErasureV3, get_format_in_quorum,
                               new_format_erasure_v3)
@@ -410,9 +410,11 @@ class ErasureSets:
         return merge_listings(per_set, max_keys)
 
     def list_object_versions(self, bucket, prefix="", marker="",
-                             max_keys=1000, version_marker=""):
+                             max_keys=1000, version_marker="",
+                             delimiter=""):
         per_set = [s.list_object_versions(bucket, prefix, marker,
-                                          max_keys, version_marker)
+                                          max_keys, version_marker,
+                                          delimiter)
                    for s in self.sets]
         return merge_version_listings(per_set, max_keys)
 
@@ -447,32 +449,55 @@ class ErasureSets:
                 "drives_per_set": len(self.sets[0].disks)}
 
 def merge_version_listings(per_layer: list[tuple], max_keys: int
-                           ) -> tuple[list[ObjectInfo], str, str, bool]:
+                           ) -> tuple[list[ObjectInfo], list[str], str,
+                                      str, bool]:
     """Merge per-set/per-zone version pages into one `(versions,
-    next_key_marker, next_version_id_marker, is_truncated)` page — the
-    single home of the cross-layer version paging rules. Duplicate
-    (name, version_id) pairs (one object transiently in two pools
-    mid-rebalance) collapse to the first layer's copy; order is
-    (name asc, mod_time desc), stable within ties."""
+    common_prefixes, next_key_marker, next_version_id_marker,
+    is_truncated)` page — the single home of the cross-layer version
+    paging rules. Duplicate (name, version_id) pairs (one object
+    transiently in two pools mid-rebalance) collapse to the first
+    layer's copy; order is (name asc, mod_time desc), stable within
+    ties; rolled-up prefixes interleave lexically with the keys and
+    each count one entry toward max_keys (S3 semantics)."""
     seen: set[tuple[str, str]] = set()
-    merged: list[ObjectInfo] = []
+    by_name: dict[str, list[ObjectInfo]] = {}
+    prefixes: set[str] = set()
     any_truncated = False
-    for versions, _nkm, _nvm, trunc in per_layer:
+    for versions, pfx, _nkm, _nvm, trunc in per_layer:
         any_truncated = any_truncated or trunc
+        prefixes.update(pfx)
         for o in versions:
             key = (o.name, o.version_id)
             if key not in seen:
                 seen.add(key)
-                merged.append(o)
-    merged.sort(key=lambda o: (o.name, -(o.mod_time or 0)))
-    truncated = any_truncated or len(merged) > max_keys
-    merged = merged[:max_keys]
-    if truncated and merged:
-        # empty (null) version ids ride as the "null" sentinel, like
-        # the engine's markers — see engine.list_object_versions
-        return (merged, merged[-1].name,
-                merged[-1].version_id or "null", True)
-    return merged, "", "", truncated
+                by_name.setdefault(o.name, []).append(o)
+    # one lexical entry stream: keys (carrying their version lists)
+    # interleaved with rolled-up prefixes, like merge_listings
+    entries = sorted([(n, False) for n in by_name]
+                     + [(p, True) for p in prefixes])
+    out_vers: list[ObjectInfo] = []
+    out_pfx: list[str] = []
+    count = 0
+    truncated = any_truncated
+    for name, is_pfx in entries:
+        if count >= max_keys:
+            truncated = True
+            break
+        if is_pfx:
+            out_pfx.append(name)
+            count += 1
+            continue
+        vers = sorted(by_name[name], key=lambda o: -(o.mod_time or 0))
+        for o in vers:
+            if count >= max_keys:
+                truncated = True
+                break
+            out_vers.append(o)
+            count += 1
+    if truncated and (out_vers or out_pfx):
+        nkm, nvm = last_version_marker(out_vers, out_pfx)
+        return out_vers, out_pfx, nkm, nvm, True
+    return out_vers, out_pfx, "", "", truncated
 
 
 def merge_listings(per_layer: list[tuple[list[ObjectInfo], list[str], bool]],

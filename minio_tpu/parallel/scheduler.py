@@ -12,6 +12,10 @@ device dispatcher covering every fused program of the data path:
   * ``encode``  — fused RS-encode + per-shard bitrot digest (PUT)
   * ``decode``  — fused verify + reconstruct-missing-data (degraded GET)
   * ``recover`` — fused verify + rebuild-rows + re-digest (heal)
+  * ``scan``    — vectorized S3 Select predicate over tokenized pages
+    (scan/kernels.py): concurrent SelectObjectContent requests whose
+    plan signature and page shape match stack their pages into ONE
+    device launch — the analytics-read analog of the PUT coalescing
 
 Concurrent callers hand (B_i, k, S) block groups to the submit_*
 methods; a collector thread coalesces groups with identical
@@ -55,7 +59,7 @@ MAX_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_SCHED_MAX_BATCH", "32"))
 MAX_WAIT_S = float(os.environ.get("MINIO_TPU_SCHED_MAX_WAIT_MS", "3")) / 1e3
 INFLIGHT = max(1, int(os.environ.get("MINIO_TPU_SCHED_INFLIGHT", "2")))
 
-VERBS = ("encode", "decode", "recover")
+VERBS = ("encode", "decode", "recover", "scan")
 
 # live schedulers, summed by the registry collector at exposition time
 _SCHEDULERS: "weakref.WeakSet[BatchScheduler]" = weakref.WeakSet()
@@ -101,10 +105,17 @@ telemetry.REGISTRY.register_collector(_collect_scheduler_metrics)
 
 
 class _Pending:
-    __slots__ = ("data", "event", "out", "error", "span")
+    __slots__ = ("data", "payload", "blocks", "event", "out", "error",
+                 "span")
 
-    def __init__(self, data: np.ndarray):
+    def __init__(self, data: Optional[np.ndarray] = None,
+                 payload=None, blocks: Optional[int] = None):
+        # erasure verbs carry one (B, k, S) array; the scan verb
+        # carries its typed page arrays as an opaque payload — `blocks`
+        # is the occupancy unit either way (erasure blocks / pages)
         self.data = data
+        self.payload = payload
+        self.blocks = int(data.shape[0]) if blocks is None else blocks
         self.event = threading.Event()
         self.out = None
         self.error: Optional[Exception] = None
@@ -180,7 +191,12 @@ class BatchScheduler:
         # keeping `inflight` dispatches airborne overlaps batch N+1's
         # host->device transfer with batch N's compute
         self._inflight = threading.BoundedSemaphore(max(1, inflight))
-        self._pool = ThreadPoolExecutor(max_workers=max(1, inflight),
+        # scan dispatches get their OWN slot: a Select with a fresh
+        # plan signature pays a jax.jit trace+compile (seconds) inside
+        # its dispatch — sharing slots would park latency-critical
+        # erasure PUT/GET batches behind Select compile time
+        self._inflight_scan = threading.BoundedSemaphore(1)
+        self._pool = ThreadPoolExecutor(max_workers=max(1, inflight) + 1,
                                         thread_name_prefix="sched-dispatch")
         self._thread = threading.Thread(target=self._collector,
                                         daemon=True)
@@ -192,7 +208,7 @@ class BatchScheduler:
         with self._mu:
             plists = list(self._buckets.values())
             queued_groups = sum(len(pl) for pl in plists)
-            queued_blocks = sum(p.data.shape[0] for pl in plists
+            queued_blocks = sum(p.blocks for pl in plists
                                 for p in pl)
             return {"queued_groups": queued_groups,
                     "queued_blocks": queued_blocks,
@@ -235,14 +251,17 @@ class BatchScheduler:
         return not _device_is_tpu() and _mesh_active() is None
 
     def _enqueue(self, key: tuple, data: np.ndarray) -> DispatchFuture:
-        p = _Pending(np.ascontiguousarray(data, np.uint8))
+        return self._enqueue_pending(
+            key, _Pending(np.ascontiguousarray(data, np.uint8)))
+
+    def _enqueue_pending(self, key: tuple, p: _Pending) -> DispatchFuture:
         p.span = telemetry.current_span()
-        b = int(p.data.shape[0])
         with self._mu:
             if self._stop:
                 return DispatchFuture()
             self._buckets.setdefault(key, []).append(p)
-            self._bucket_blocks[key] = self._bucket_blocks.get(key, 0) + b
+            self._bucket_blocks[key] = \
+                self._bucket_blocks.get(key, 0) + p.blocks
             self._kick.notify_all()
         return DispatchFuture(p)
 
@@ -284,6 +303,21 @@ class BatchScheduler:
         key = ("recover", codec.k, codec.m, survivors.shape[-1],
                algo.value, (present_mask, frozenset(rows), shard_len))
         return self._enqueue(key, survivors)
+
+    def submit_scan(self, pages) -> DispatchFuture:
+        """Non-blocking device-scan dispatch for one Select request's
+        tokenized page set (scan/pager.Pages): pages bucket by (plan
+        signature, page shape) so concurrent identical queries coalesce
+        into one kernel launch. Resolves to the boolean row mask
+        [B, R], or None (caller falls back to the CPU evaluator)."""
+        from ..scan import kernels as scan_kernels
+        if not scan_kernels.device_allowed():
+            return DispatchFuture()
+        key = ("scan", 0, 0, pages.shape_key(),
+               pages.plan.signature, None)
+        p = _Pending(payload=(pages.plan, pages.arrays),
+                     blocks=pages.n_pages)
+        return self._enqueue_pending(key, p)
 
     def encode_and_hash(self, codec, data: np.ndarray, algo
                         ) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -347,7 +381,7 @@ class BatchScheduler:
         cur: list = []
         n_blocks = 0
         for p in plist:
-            b = p.data.shape[0]
+            b = p.blocks
             if cur and n_blocks + b > cap:
                 groups.append(cur)
                 cur, n_blocks = [], 0
@@ -355,19 +389,22 @@ class BatchScheduler:
             n_blocks += b
         if cur:
             groups.append(cur)
+        sem = self._inflight_scan if key[0] == "scan" \
+            else self._inflight
         for group in groups:
-            self._inflight.acquire()
+            sem.acquire()
             try:
-                self._pool.submit(self._dispatch_group, key, group)
+                self._pool.submit(self._dispatch_group, key, group, sem)
             except BaseException:  # noqa: BLE001 — pool gone (close race)
                 # same contract as the stopping flush: CPU-route (out
                 # stays None) so waiters fall back to their local
                 # paths instead of failing work the host can serve
-                self._inflight.release()
+                sem.release()
                 for p in group:
                     p.event.set()
 
-    def _dispatch_group(self, key: tuple, group: list) -> None:
+    def _dispatch_group(self, key: tuple, group: list,
+                        sem: threading.Semaphore) -> None:
         try:
             self._dispatch_one(key, group)
         except Exception as e:  # noqa: BLE001 — surfaced to every waiter
@@ -376,29 +413,17 @@ class BatchScheduler:
                     p.error = e
                     p.event.set()
         finally:
-            self._inflight.release()
+            sem.release()
 
     def _dispatch_one(self, key: tuple, group: list) -> None:
-        from ..object.codec import Codec
-        from .. import bitrot as bitrot_mod
-        verb, k, m, s, algo_value, extra = key
-        algo = bitrot_mod.BitrotAlgorithm.from_string(algo_value)
-        codec = Codec(k, m, s * k)
-        data = np.concatenate([p.data for p in group], axis=0) \
-            if len(group) > 1 else group[0].data
+        verb = key[0]
         t0_wall, t0 = time.time(), time.perf_counter()
-        if verb == "encode":
-            out = codec.encode_and_hash_batch(data, algo)
-        elif verb == "decode":
-            mask, shard_len = extra
-            out = codec.verify_and_decode_batch(data, mask, shard_len,
-                                                algo)
+        if verb == "scan":
+            out = self._run_scan(group)
         else:
-            mask, rows, shard_len = extra
-            out = codec.verify_and_recover_batch(data, mask, set(rows),
-                                                 shard_len, algo)
+            out = self._run_erasure(key, group)
         dt = time.perf_counter() - t0
-        nb = int(data.shape[0])
+        nb = sum(p.blocks for p in group)
         with self._mu:
             self.batches += 1
             self.coalesced += len(group) - 1
@@ -425,7 +450,7 @@ class BatchScheduler:
             return
         at = 0
         for p in group:
-            b = p.data.shape[0]
+            b = p.blocks
             if verb == "encode":
                 full, digests = out
                 p.out = (full[at:at + b], digests[at:at + b])
@@ -433,12 +458,49 @@ class BatchScheduler:
                 missing, missing_idx, sdig = out
                 p.out = (missing[at:at + b], missing_idx,
                          sdig[at:at + b])
-            else:
+            elif verb == "recover":
                 rec, idxs, sdig, odig = out
                 p.out = (rec[at:at + b], idxs, sdig[at:at + b],
                          odig[at:at + b])
+            else:                                # scan: row masks
+                p.out = out[at:at + b]
             at += b
             p.event.set()
+
+    @staticmethod
+    def _run_erasure(key: tuple, group: list):
+        from ..object.codec import Codec
+        from .. import bitrot as bitrot_mod
+        verb, k, m, s, algo_value, extra = key
+        algo = bitrot_mod.BitrotAlgorithm.from_string(algo_value)
+        codec = Codec(k, m, s * k)
+        data = np.concatenate([p.data for p in group], axis=0) \
+            if len(group) > 1 else group[0].data
+        if verb == "encode":
+            return codec.encode_and_hash_batch(data, algo)
+        if verb == "decode":
+            mask, shard_len = extra
+            return codec.verify_and_decode_batch(data, mask, shard_len,
+                                                 algo)
+        mask, rows, shard_len = extra
+        return codec.verify_and_recover_batch(data, mask, set(rows),
+                                              shard_len, algo)
+
+    @staticmethod
+    def _run_scan(group: list):
+        """One coalesced kernel launch over every member's pages: the
+        plan is identical across the group (the bucket keys on its
+        signature), pages stack along the batch axis."""
+        from ..scan import kernels as scan_kernels
+        plan = group[0].payload[0]
+        if len(group) == 1:
+            arrays = group[0].payload[1]
+        else:
+            names = group[0].payload[1].keys()
+            arrays = {name: np.concatenate(
+                [p.payload[1][name] for p in group], axis=0)
+                for name in names}
+        return scan_kernels.run_batch(plan, arrays)
 
 
 # ---------------------------------------------------------------------------

@@ -276,6 +276,23 @@ class AdminHandlers:
             self._auth(ctx, "admin:Heal")
             fn = getattr(self.api.obj, "mrf_stats", None)
             return self._json(fn() if callable(fn) else {})
+        if sub == "metacache" and m == "GET":
+            # bucket metacache visibility (ROADMAP item 2 `mc.stats()`
+            # remainder): per-bucket index state (entries, building/
+            # ready, invalid, dirty names, generation), pending journal
+            # deltas, and the serve/fallback/drop/reconcile counters —
+            # ?bucket= narrows to one bucket's entry
+            self._auth(ctx, "admin:ServerInfo")
+            mc = getattr(self.api.obj, "metacache", None)
+            if mc is None:
+                return self._json({"enabled": False})
+            st = mc.stats()
+            st["enabled"] = True
+            bucket = ctx.query1("bucket")
+            if bucket:
+                st["buckets"] = {b: v for b, v in st["buckets"].items()
+                                 if b == bucket}
+            return self._json(st)
 
         # -- topology plane: pool states, decommission, rebalance ----------
         if sub == "rebalance" and m == "POST":

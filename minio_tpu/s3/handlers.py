@@ -258,6 +258,12 @@ class S3ApiHandlers:
             "MINIO_COMPRESS_ALGORITHM", "s2").lower()
         self.cors_allow_origin = "*"   # config api.cors_allow_origin
         self.federation = None    # optional BucketFederation (etcd DNS)
+        # device scan plane (scan/): SelectObjectContent rides the
+        # compiled-kernel path with the CPU evaluator as fallback; the
+        # cluster boot swaps in an instance wired to the shared batch
+        # former so concurrent Selects coalesce
+        from ..scan import ScanEngine
+        self.scan = ScanEngine()
         # staging-pressure load shedding: when the pipeline's BytePool
         # rings time out (exhausted), new data writes are shed with
         # SlowDown for `shed_window_s` instead of queueing into a
@@ -1143,17 +1149,18 @@ class S3ApiHandlers:
         max_keys = _parse_max_keys(ctx.query1("max-keys", "1000"))
         if max_keys == 0:
             self.obj.get_bucket_info(bucket)
-            versions, nkm, nvm, trunc = [], "", "", False
+            versions, prefixes, nkm, nvm, trunc = [], [], "", "", False
         else:
             # a version-id-marker without a key-marker is meaningless
             # (S3 rejects it; we ignore it) — and the object layer
             # handles the "null" wire form of the empty version id
-            versions, nkm, nvm, trunc = self.obj.list_object_versions(
-                bucket, prefix, key_marker, max_keys,
-                vid_marker if key_marker else "")
+            versions, prefixes, nkm, nvm, trunc = \
+                self.obj.list_object_versions(
+                    bucket, prefix, key_marker, max_keys,
+                    vid_marker if key_marker else "", delimiter)
         return HTTPResponse().with_xml(xmlgen.list_versions_response(
             bucket, prefix, key_marker, vid_marker, delimiter, max_keys,
-            enc, versions, [], trunc, nkm, nvm))
+            enc, versions, prefixes, trunc, nkm, nvm))
 
     def delete_multiple_objects(self, ctx, bucket) -> HTTPResponse:
         self.authenticate(ctx, "s3:DeleteObject", bucket)
@@ -2082,6 +2089,8 @@ class S3ApiHandlers:
         req = SelectRequest.from_xml(ctx.read_body())
         info = self.obj.get_object_info(bucket, key)
         # decrypt/decompress transparently via the transformed GET path
+        # (self.obj may be the hot-object read cache: a cached Select
+        # source serves without touching the erasure decode path)
         from ..features import crypto as sse
         md = info.user_defined or {}
         if md.get(sse.MK_SSE) or sse.stored_compression(md):
@@ -2097,9 +2106,13 @@ class S3ApiHandlers:
         else:
             _, stream = self.obj.get_object(bucket, key, 0, info.size)
             data = b"".join(stream)
+        # device scan plane: compiled-kernel predicate scan through the
+        # batch former, CPU evaluator as byte-identical fallback
+        body = self.scan.event_stream(req, data) \
+            if self.scan is not None else event_stream(req, data)
         return HTTPResponse(
             headers={"Content-Type": "application/octet-stream"},
-            stream=event_stream(req, data))
+            stream=body)
 
     def _enforce_object_lock(self, ctx, bucket: str, key: str,
                              version_id: str, versioned: bool) -> None:

@@ -315,9 +315,18 @@ def end_message() -> bytes:
 
 def event_stream(req: SelectRequest, data: bytes) -> Iterator[bytes]:
     """Full SelectObjectContent response body."""
+    yield from frame_records(run_select(req, data), len(data))
+
+
+def frame_records(records: Iterator[bytes], data_len: int
+                  ) -> Iterator[bytes]:
+    """THE framing loop (128 KiB Records chunks, Stats over the raw
+    object length, End) — shared with the device scan path
+    (scan/engine.py), whose byte-identity guarantee would otherwise
+    rest on a hand-synced copy."""
     returned = 0
     buf = b""
-    for rec in run_select(req, data):
+    for rec in records:
         buf += rec
         if len(buf) >= 128 * 1024:
             returned += len(buf)
@@ -326,5 +335,5 @@ def event_stream(req: SelectRequest, data: bytes) -> Iterator[bytes]:
     if buf:
         returned += len(buf)
         yield records_message(buf)
-    yield stats_message(len(data), len(data), returned)
+    yield stats_message(data_len, data_len, returned)
     yield end_message()

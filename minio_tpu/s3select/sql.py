@@ -1001,6 +1001,10 @@ class Aggregator:
             if v is None:
                 continue
             st["n"] += 1
+            if e.name == "count":
+                # COUNT needs no min/max/sum — tracking them over a
+                # mixed numeric/string column raised TypeError below
+                continue
             if isinstance(v, _dt.datetime):
                 v = _aware(v)       # MIN/MAX over mixed-zone rows
             n = _num(v)

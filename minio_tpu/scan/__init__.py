@@ -1,0 +1,24 @@
+"""Device scan plane: TPU-offloaded S3 Select.
+
+The paper's delta — offload the data path's byte-crunching to an
+accelerator and overlap it with I/O — applied to the analytics read
+path: a parsed S3 Select query's predicate (and COUNT aggregates) is
+compiled into vectorized JAX kernels over batched fixed-shape pages of
+tokenized CSV/JSON-LINES records, dispatched through the multi-verb
+batch former (``parallel/scheduler.py`` verb ``scan``) so concurrent
+SelectObjectContent requests coalesce into single device launches.
+
+The row-by-row CPU evaluator (``s3select/select.py``) stays the oracle
+AND the fallback: every construct the kernel plan declines — nested
+JSON, unsupported LIKE patterns, SUM/AVG/MIN/MAX aggregates, scalar
+functions in predicates — falls back silently (counted in
+``minio_tpu_scan_fallbacks_total{reason}``), and the framed
+event-stream response is byte-identical either way: selected rows are
+serialized by the SAME ``_emit``/framing code the CPU path uses; the
+device only decides WHICH rows (the scan itself).
+"""
+
+from .engine import ScanEngine
+from .plan import Decline, compile_plan
+
+__all__ = ["ScanEngine", "Decline", "compile_plan"]

@@ -277,11 +277,32 @@ def now() -> float:
     return _time.time()
 
 
-def single_version_page(objs, truncated):
-    """The list_object_versions 4-tuple for single-version backends
+def last_version_marker(versions, prefixes) -> tuple[str, str]:
+    """Resume markers at a versions-page cut — THE single home of the
+    rule (engine.paginate_versions, sets.merge_version_listings, and
+    single_version_page all derive their markers here): the lexically
+    LAST entry emitted (a version or a rolled-up CommonPrefix) is
+    where the next page re-enters. A prefix cut carries no version-id
+    marker — resume starts at the first key after the prefix (a
+    prefix-only page with an empty marker would loop the pager
+    forever). A null version id rides as the "null" sentinel: an
+    empty marker reads as NO marker on resume and would skip the
+    key's remaining versions."""
+    last_v = versions[-1].name if versions else ""
+    last_p = prefixes[-1] if prefixes else ""
+    if last_p > last_v:
+        return last_p, ""
+    return last_v, versions[-1].version_id or "null"
+
+
+def single_version_page(objs, truncated, prefixes=None):
+    """The list_object_versions 5-tuple for single-version backends
     (FS, gateways): one "version" per key, paged on the key marker
-    alone — the erasure layer's (versions, NextKeyMarker,
-    NextVersionIdMarker, is_truncated) contract."""
-    if truncated and objs:
-        return objs, objs[-1].name, objs[-1].version_id, True
-    return objs, "", "", truncated
+    alone — the erasure layer's (versions, CommonPrefixes,
+    NextKeyMarker, NextVersionIdMarker, is_truncated) contract; the
+    backends' list_objects skips prefixes <= marker on resume."""
+    prefixes = prefixes or []
+    if truncated and (objs or prefixes):
+        nkm, nvm = last_version_marker(objs, prefixes)
+        return objs, prefixes, nkm, nvm, True
+    return objs, prefixes, "", "", truncated

@@ -356,6 +356,54 @@ def test_bandwidth_meter_window():
     assert merged["b"]["rx_bps"] == 150.0
 
 
+def test_admin_metacache_stats(tmp_path):
+    """GET /minio/admin/v3/metacache + the madmin accessor: per-bucket
+    index state, pending deltas, serve/fallback counters — and the
+    {"enabled": False} form on a backend without the index."""
+    from minio_tpu.madmin import AdminClient
+    from minio_tpu.object.metacache import MetacacheManager
+    from minio_tpu.object.server_sets import ErasureServerSets
+
+    zz = ErasureServerSets([ErasureSets.from_drives(
+        [str(tmp_path / f"mcd{i}") for i in range(4)], 1, 4, 2,
+        block_size=1 << 16, enable_mrf=False)], load_topology=False)
+    zz.make_bucket("b")
+    for i in range(3):
+        zz.put_object("b", f"k{i}", b"x")
+    mgr = MetacacheManager(zz, staleness_s=0.0).start()
+    zz.attach_metacache(mgr)
+    assert mgr.build("b")
+    zz.list_objects("b", "", "", "", 10)        # one index serve
+    iam = IAMSys(zz, root_cred=CREDS)
+    srv = S3Server(zz, creds=CREDS, region=REGION, iam=iam).start()
+    mount_admin(srv)
+    cli = AdminClient("127.0.0.1", srv.port, CREDS.access_key,
+                      CREDS.secret_key, region=REGION)
+    try:
+        st = cli.metacache_stats()
+        assert st["enabled"] is True
+        assert st["buckets"]["b"]["state"] == "ready"
+        assert st["buckets"]["b"]["invalid"] is False
+        assert st["buckets"]["b"]["names"] == 3
+        assert st["serves"] >= 1 and "pending" in st
+        assert "fallbacks" in st and "drops" in st
+        # ?bucket= narrows to the one bucket
+        narrowed = cli.metacache_stats(bucket="nope")
+        assert narrowed["buckets"] == {}
+        assert cli.metacache_stats(bucket="b")["buckets"].keys() == {"b"}
+    finally:
+        srv.stop()
+        mgr.close()
+        zz.close()
+
+
+def test_admin_metacache_stats_disabled(client):
+    """A backend without the index answers enabled=False."""
+    st, body = client.request("GET", "/minio/admin/v3/metacache")
+    assert st == 200
+    assert json.loads(body) == {"enabled": False}
+
+
 def test_admin_topology_and_rebalance(tmp_path):
     """The topology admin surface end-to-end over live HTTP + madmin:
     GET topology, suspend/resume a pool, start a decommission, poll it

@@ -1,0 +1,52 @@
+"""CI smoke for bench.py --ab-select-smoke / --ab-cache-smoke (tiny
+device-scan and hot-object-cache A/Bs): each must run end-to-end
+inside the tier-1 budget, emit JSON-serializable results, and prove
+the plane's load-bearing claims — the select bench asserts device/CPU
+byte-identity itself before timing, so what's pinned here is that the
+device path actually served (no silent wall-to-wall fallback), that
+concurrent requests coalesced through the scheduler's scan verb, and
+that cache hits provably skipped the erasure decode path."""
+
+from __future__ import annotations
+
+import json
+
+import bench
+
+
+def test_select_ab_smoke():
+    out = bench.bench_select_ab(streams=(1, 2), rows=3000,
+                                queries_per_stream=2)
+    json.dumps(out)                     # BENCH-compatible payload
+    assert out["config"]["rows"] == 3000
+    assert [p["streams"] for p in out["points"]] == [1, 2]
+    for p in out["points"]:
+        dev = p["device"]
+        # every query rode the device plan — the bench raises on any
+        # byte divergence, so serves+no-fallbacks == correctness held
+        assert dev["device_serves"] == dev["queries"], p
+        assert dev["fallbacks"] == 0, p
+        assert dev["sched_batches"] >= 1, p
+    # 2 concurrent streams x 2 queries through one former: fewer
+    # device launches than queries, the coalesced counter rising
+    two = out["points"][-1]["device"]
+    assert two["sched_batches"] < two["queries"], two
+    assert two["sched_coalesced"] >= 1, two
+    assert out["max_speedup_x"] > 0
+
+
+def test_cache_ab_smoke():
+    out = bench.bench_cache_ab(objects=8, size=1 << 18, gets=60,
+                               streams=2)
+    json.dumps(out)                     # BENCH-compatible payload
+    assert out["config"]["objects"] == 8
+    # cache-off: every GET is an erasure decode stream
+    assert out["off"]["decode_streams"] == 60
+    # cache-on: hits serve WITHOUT the shard-read/verify/decode path
+    # (bytes asserted identical inside the bench); with a 1-hit
+    # admission bar over an 80/20 pick the hot set fills once and the
+    # decode counter stops moving
+    assert out["on"]["cache"]["hits"] > 0
+    assert out["on"]["decode_streams"] < 60
+    assert out["decode_streams_saved"] == out["on"]["cache"]["hits"]
+    assert out["speedup_x"] > 0

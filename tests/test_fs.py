@@ -233,3 +233,29 @@ def test_cors_preflight_and_headers(tmp_path):
         conn.close()
     finally:
         srv.stop()
+
+
+def test_fs_versions_prefix_only_pages_resume(fs):
+    """Delimiter versions paging where whole pages are CommonPrefixes:
+    the resume marker must be the rolled-up prefix (an empty or
+    object-derived marker would refetch the same page forever)."""
+    fs.make_bucket("b")
+    for i in range(5):
+        fs.put_object("b", f"dir{i}/x", b"v")
+    fs.put_object("b", "zzz", b"v")
+    seen_prefixes, seen_objs = [], []
+    marker, vmarker = "", ""
+    for _ in range(10):
+        vers, pfx, nkm, nvm, trunc = fs.list_object_versions(
+            "b", marker=marker, version_marker=vmarker,
+            max_keys=2, delimiter="/")
+        seen_prefixes += pfx
+        seen_objs += [v.name for v in vers]
+        if not trunc:
+            break
+        assert nkm, "truncated page must carry a resume marker"
+        marker, vmarker = nkm, nvm
+    else:
+        pytest.fail("versions paging did not terminate")
+    assert seen_prefixes == [f"dir{i}/" for i in range(5)]
+    assert seen_objs == ["zzz"]

@@ -157,6 +157,52 @@ def test_index_delimiter_pages_equal_oracle(zz):
                 assert got[1] == want[1] and got[2] == want[2]
 
 
+def test_index_delimiter_versions_equal_oracle(zz):
+    """Delimiter-aware list_object_versions (satellite): rolled-up
+    CommonPrefixes from the index must equal the merge-walk oracle
+    page-for-page, and paging with the returned markers must replay
+    the one-shot listing exactly — prefix entries included."""
+    mgr = attach(zz)
+    for i in range(18):
+        zz.put_object("b", f"a/{i % 3}/k{i:02d}", b"x",
+                      opts=PutOptions(versioned=(i % 2 == 0)))
+        if i % 4 == 0:
+            zz.put_object("b", f"a/{i % 3}/k{i:02d}", b"y",
+                          opts=PutOptions(versioned=True))
+        zz.put_object("b", f"top{i:02d}", b"z")
+    assert mgr.build("b")
+    for prefix in ("", "a/", "a/1/", "top"):
+        for mk in (1, 2, 5, 1000):
+            got = zz.list_object_versions("b", prefix, "", mk, "", "/")
+            mc, zz.metacache = zz.metacache, None
+            try:
+                want = zz.list_object_versions("b", prefix, "", mk,
+                                               "", "/")
+            finally:
+                zz.metacache = mc
+            assert [(v.name, v.version_id) for v in got[0]] == \
+                [(v.name, v.version_id) for v in want[0]], (prefix, mk)
+            assert got[1] == want[1], (prefix, mk)      # CommonPrefixes
+            assert got[2:] == want[2:], (prefix, mk)    # markers+trunc
+    # paging with delimiter replays the one-shot page exactly
+    one_vers, one_pfx, _, _, trunc = zz.list_object_versions(
+        "b", "", "", 10000, "", "/")
+    assert not trunc and one_pfx == ["a/"]
+    for mk in (1, 2, 3, 7):
+        vers, pfx, marker, vidm = [], [], "", ""
+        while True:
+            page, p, nkm, nvm, tr = zz.list_object_versions(
+                "b", "", marker, mk, vidm, "/")
+            vers.extend((v.name, v.version_id) for v in page)
+            pfx.extend(p)
+            assert len(page) + len(p) <= mk
+            if not tr:
+                break
+            marker, vidm = nkm, nvm
+        assert vers == [(v.name, v.version_id) for v in one_vers], mk
+        assert pfx == one_pfx, mk
+
+
 def test_staleness_bound_delta_becomes_visible(zz):
     """A delta OLDER than the staleness bound must be visible: the
     serve path force-drains the journal instead of cutting a stale
@@ -529,7 +575,7 @@ def test_versions_paging_markers_resume_mid_object(zz):
     for mk in (1, 2, 3, 4, 5):
         got, marker, vidm, rounds = [], "", "", 0
         while True:
-            page, nkm, nvm, trunc = zz.list_object_versions(
+            page, _pfx, nkm, nvm, trunc = zz.list_object_versions(
                 "b", "", marker, mk, vidm)
             got.extend((v.name, v.version_id) for v in page)
             rounds += 1
@@ -557,7 +603,7 @@ def test_versions_paging_equivalence_randomized(zz):
     for mk in (1, 2, 3, 7):
         got, marker, vidm = [], "", ""
         while True:
-            page, nkm, nvm, trunc = zz.list_object_versions(
+            page, _pfx, nkm, nvm, trunc = zz.list_object_versions(
                 "b", "", marker, mk, vidm)
             got.extend((v.name, v.version_id) for v in page)
             if not trunc:

@@ -1,0 +1,496 @@
+"""Device scan plane (minio_tpu/scan/): the randomized property suite
+pinning BYTE-IDENTITY of the framed SelectObjectContent event stream
+between the compiled-kernel device path and the CPU evaluator (the
+oracle), plus fallback-reason accounting, scheduler scan-verb
+coalescing, and the live HTTP endpoint riding the device path."""
+
+from __future__ import annotations
+
+import csv as _csv
+import hashlib
+import http.client
+import io
+import json
+import random
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.s3select import SelectRequest
+from minio_tpu.s3select.select import event_stream
+from minio_tpu.scan import ScanEngine
+from minio_tpu.scan.plan import Decline, compile_plan
+from minio_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _force_device(monkeypatch):
+    # the erasure verbs' test discipline: force the kernels onto
+    # whatever XLA backend is present (CPU in CI)
+    monkeypatch.setenv("MINIO_TPU_SCAN_DEVICE", "force")
+
+
+# ---------------------------------------------------------------------------
+# randomized corpus + query generators (seeded — deterministic in CI)
+# ---------------------------------------------------------------------------
+
+_COLS = ("a", "b", "c", "d")
+# short pool keeps the pager in the narrow width buckets (fewer jit
+# shapes); covers: empty, numeric-looking strings, negatives, floats,
+# spaces, case, multi-byte UTF-8
+_WORDS = ("", "x", "zz", "abc", "x y", "Par", "10", "-3", "0.5",
+          "9", "bé", "Z", "a\nb", "Z\n")
+_NUMS = (0, 1, -3, 25, 30, 2.5, -0.5, 10)
+
+
+def _cell(rng: random.Random):
+    r = rng.random()
+    if r < 0.45:
+        return rng.choice(_NUMS)
+    if r < 0.9:
+        return rng.choice(_WORDS)
+    return None                              # missing / JSON null
+
+
+def _csv_corpus(rng: random.Random, rows: int) -> bytes:
+    out = io.StringIO()
+    w = _csv.writer(out)
+    w.writerow(_COLS)
+    for _ in range(rows):
+        cells = [_cell(rng) for _ in _COLS]
+        w.writerow(["" if v is None else v for v in cells])
+    return out.getvalue().encode()
+
+
+def _json_corpus(rng: random.Random, rows: int) -> bytes:
+    lines = []
+    for _ in range(rows):
+        row = {}
+        for c in _COLS:
+            if rng.random() < 0.15:
+                continue                     # missing key
+            row[c] = _cell(rng)
+        lines.append(json.dumps(row))
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _lit(rng: random.Random) -> str:
+    if rng.random() < 0.5:
+        v = rng.choice(_NUMS)
+        return str(v)
+    return "'" + rng.choice(_WORDS).replace("'", "") + "'"
+
+
+def _side(rng: random.Random) -> str:
+    r = rng.random()
+    if r < 0.45:
+        return rng.choice(_COLS)
+    if r < 0.75:
+        return _lit(rng)
+    # arithmetic over a column and a numeric literal
+    op = rng.choice("+-*/%")
+    return f"({rng.choice(_COLS)} {op} {rng.choice(_NUMS)})"
+
+
+def _pred(rng: random.Random, depth: int) -> str:
+    if depth > 0 and rng.random() < 0.4:
+        kind = rng.choice(("and", "or", "not"))
+        if kind == "not":
+            return f"NOT ({_pred(rng, depth - 1)})"
+        return (f"({_pred(rng, depth - 1)}) {kind.upper()} "
+                f"({_pred(rng, depth - 1)})")
+    kind = rng.random()
+    col = rng.choice(_COLS)
+    if kind < 0.40:
+        op = rng.choice(("=", "!=", "<>", "<", "<=", ">", ">="))
+        return f"{_side(rng)} {op} {_side(rng)}"
+    if kind < 0.55:
+        items = ", ".join(_lit(rng) for _ in range(rng.randint(1, 3)))
+        neg = "NOT " if rng.random() < 0.3 else ""
+        return f"{col} {neg}IN ({items})"
+    if kind < 0.70:
+        neg = "NOT " if rng.random() < 0.3 else ""
+        return f"{col} {neg}BETWEEN {_lit(rng)} AND {_lit(rng)}"
+    if kind < 0.85:
+        neg = " NOT" if rng.random() < 0.3 else ""
+        return f"{col} IS{neg} NULL"
+    needle = rng.choice(("x", "zz", "ab", "P", "0"))
+    pat = rng.choice((needle, f"{needle}%", f"%{needle}",
+                      f"%{needle}%", "%"))
+    neg = "NOT " if rng.random() < 0.3 else ""
+    return f"{col} {neg}LIKE '{pat}'"
+
+
+def _query(rng: random.Random) -> str:
+    r = rng.random()
+    if r < 0.25:
+        proj = "*"
+    elif r < 0.5:
+        proj = ", ".join(rng.sample(_COLS, rng.randint(1, 3)))
+    elif r < 0.65:
+        proj = f"{rng.choice(_COLS)} AS v, {rng.choice(_COLS)}"
+    else:
+        proj = rng.choice(("COUNT(*)",
+                           f"COUNT({rng.choice(_COLS)})",
+                           f"COUNT(*), COUNT({rng.choice(_COLS)})"))
+    q = f"SELECT {proj} FROM S3Object"
+    if rng.random() < 0.8:
+        q += f" WHERE {_pred(rng, 2)}"
+    if rng.random() < 0.25:
+        q += f" LIMIT {rng.randint(1, 40)}"
+    return q
+
+
+def _req(expr: str, fmt: str = "CSV", out: str = "CSV",
+         json_type: str = "LINES") -> SelectRequest:
+    r = SelectRequest()
+    r.expression = expr
+    r.input_format = fmt
+    r.csv_header = "USE"
+    r.output_format = out
+    r.json_type = json_type
+    return r
+
+
+def _pair(req: SelectRequest, data: bytes) -> tuple[ScanEngine, bytes,
+                                                    bytes]:
+    """(engine, device-path bytes, CPU-oracle bytes) for one request."""
+    eng = ScanEngine()
+    dev = b"".join(eng.event_stream(req, data))
+    cpu = b"".join(event_stream(req, data))
+    return eng, dev, cpu
+
+
+# ---------------------------------------------------------------------------
+# the property: framed output identical, device actually serving
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_property_csv_byte_identity(seed):
+    rng = random.Random(1000 + seed)
+    data = _csv_corpus(rng, rng.randint(40, 160))
+    served = 0
+    for _ in range(10):
+        expr = _query(rng)
+        out = "JSON" if rng.random() < 0.3 else "CSV"
+        eng, dev, cpu = _pair(_req(expr, out=out), data)
+        assert dev == cpu, expr
+        served += eng.device_serves
+    # the generator leans supported: the device must carry real traffic
+    assert served >= 5
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_property_json_lines_byte_identity(seed):
+    rng = random.Random(2000 + seed)
+    data = _json_corpus(rng, rng.randint(40, 160))
+    served = 0
+    for _ in range(10):
+        expr = _query(rng)
+        out = "CSV" if rng.random() < 0.3 else "JSON"
+        eng, dev, cpu = _pair(_req(expr, fmt="JSON", out=out), data)
+        assert dev == cpu, expr
+        served += eng.device_serves
+    assert served >= 5
+
+
+def test_semantics_corners_byte_identity():
+    """Deterministic corners the randomizer may miss: numeric-vs-string
+    coercion, division/modulo by zero, negative floor-mod, empty cells,
+    LIMIT mid-chunk, COUNT over nulls."""
+    data = (b"a,b,c,d\n"
+            b"10,9,x,\n"
+            b"-3,0.5,,x y\n"
+            b"0,0,Par,10\n"
+            b"2.5,-0.5,b\xc3\xa9,Z\n")
+    for expr in (
+        "SELECT * FROM S3Object WHERE a < '9'",       # mixed coercion
+        "SELECT * FROM S3Object WHERE a < b",
+        "SELECT * FROM S3Object WHERE (a / b) > 1",   # div by zero row
+        "SELECT * FROM S3Object WHERE (a % 2) = 1",   # negative mod
+        "SELECT a FROM S3Object WHERE c = ''",
+        "SELECT a FROM S3Object WHERE d >= 'Z'",
+        "SELECT * FROM S3Object LIMIT 2",
+        "SELECT COUNT(*), COUNT(c) FROM S3Object WHERE a <= 10",
+        "SELECT a AS x, b FROM S3Object WHERE NOT (a IN (10, '0'))",
+        "SELECT * FROM S3Object WHERE b BETWEEN -1 AND 1",
+        "SELECT * FROM S3Object WHERE c LIKE '%a%' OR d LIKE 'x%'",
+    ):
+        eng, dev, cpu = _pair(_req(expr), data)
+        assert dev == cpu, expr
+        assert eng.device_serves == 1, expr
+
+
+def test_like_newline_and_empty_pattern_byte_identity():
+    """Regex corners the kernel compare can't mirror: LIKE '' is ^$
+    (only the EMPTY cell matches, not every row), and '.'/'$' stop at
+    newlines inside cells — newline-bearing cells must decline to the
+    CPU path, never diverge."""
+    jl = (b'{"c": "abc\\n"}\n{"c": "abc"}\n{"c": ""}\n'
+          b'{"c": "a\\nb"}\n{"c": "xbc"}\n')
+    for expr, fmt, data, served in (
+        ("SELECT c FROM S3Object WHERE c LIKE ''", "CSV",
+         b"c\nabc\n\nxy\n", True),          # empty pattern, no newlines
+        ("SELECT c FROM S3Object WHERE c LIKE 'abc'", "JSON", jl, False),
+        ("SELECT c FROM S3Object WHERE c LIKE '%bc'", "JSON", jl, False),
+        ("SELECT c FROM S3Object WHERE c LIKE '%b%'", "JSON", jl, False),
+        ("SELECT c FROM S3Object WHERE c LIKE '%'", "JSON", jl, False),
+    ):
+        eng, dev, cpu = _pair(_req(expr, fmt=fmt), data)
+        assert dev == cpu, expr
+        if served:
+            assert eng.device_serves == 1, expr
+        else:
+            assert eng.fallback_reasons.get("like-newline"), expr
+
+
+# ---------------------------------------------------------------------------
+# fallback: silent, counted by reason, still byte-identical
+# ---------------------------------------------------------------------------
+
+def _fallback_counter(reason: str) -> float:
+    return telemetry.REGISTRY.counter(
+        "minio_tpu_scan_fallbacks_total",
+        "Device-scan declines by reason (request fell back "
+        "to the CPU evaluator, output identical)").value(reason=reason)
+
+
+def test_unsupported_constructs_fall_back_counted():
+    csv_data = b"a,b\n1,x\n2,y\n"
+    nested = b'{"a": {"deep": 1}, "b": 2}\n{"a": 3, "b": 4}\n'
+    cases = [
+        (_req("SELECT * FROM S3Object WHERE a = 1", fmt="JSON"),
+         nested, "nested"),
+        (_req("SELECT * FROM S3Object WHERE b LIKE 'a_b'"),
+         csv_data, "like-pattern"),
+        (_req("SELECT SUM(a) FROM S3Object"), csv_data, "aggregate"),
+        (_req("SELECT * FROM S3Object WHERE a = 1", fmt="JSON",
+              json_type="DOCUMENT"), b'{"a": 1}', "json-document"),
+        (_req("SELECT * FROM S3Object WHERE b = 'x'"),
+         b"a,b\n1," + b"w" * 200 + b"\n2,x\n", "wide-string"),
+        (_req("SELECT * FROM S3Object WHERE s3object = 'x'"),
+         csv_data, "row-ref"),
+    ]
+    for req, data, reason in cases:
+        before = _fallback_counter(reason)
+        eng, dev, cpu = _pair(req, data)
+        assert dev == cpu, reason
+        assert eng.device_serves == 0 and eng.fallbacks == 1, reason
+        assert eng.fallback_reasons == {reason: 1}
+        assert _fallback_counter(reason) == before + 1
+
+
+def test_bad_sql_error_parity():
+    """A request the parser rejects declines (`sql-error`) and the CPU
+    path reproduces the proper S3 error for the client."""
+    from minio_tpu.s3.s3errors import S3Error
+    eng = ScanEngine()
+    with pytest.raises(S3Error):
+        b"".join(eng.event_stream(
+            _req("SELECT FROM WHERE"), b"a,b\n1,2\n"))
+    assert eng.fallback_reasons == {"sql-error": 1}
+
+
+def test_device_off_falls_back(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_SCAN_DEVICE", "off")
+    eng, dev, cpu = _pair(
+        _req("SELECT * FROM S3Object WHERE a = 1"), b"a\n1\n2\n")
+    assert dev == cpu
+    assert eng.device_serves == 0
+    assert eng.fallback_reasons == {"no-device": 1}
+
+
+def test_plan_signature_separates_literals():
+    """Differing literals compile DIFFERENT bucket signatures (they are
+    baked constants), identical queries share one."""
+    from minio_tpu.s3select import sql as _sql
+    p1 = compile_plan(_sql.parse(
+        "SELECT * FROM S3Object WHERE a = 1"), "CSV")
+    p2 = compile_plan(_sql.parse(
+        "SELECT * FROM S3Object WHERE a = 2"), "CSV")
+    p3 = compile_plan(_sql.parse(
+        "SELECT * FROM S3Object WHERE a = 1"), "CSV")
+    assert p1.signature != p2.signature
+    assert p1.signature == p3.signature
+    with pytest.raises(Decline):
+        compile_plan(_sql.parse("SELECT AVG(a) FROM S3Object"), "CSV")
+
+
+# ---------------------------------------------------------------------------
+# scheduler scan verb: concurrent requests coalesce into one launch
+# ---------------------------------------------------------------------------
+
+def test_concurrent_selects_coalesce_one_launch():
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    rng = random.Random(77)
+    data = _csv_corpus(rng, 120)
+    req = _req("SELECT a, b FROM S3Object WHERE a >= 1 AND b <> ''")
+    cpu = b"".join(event_stream(req, data))
+    # warm the jit cache so the timing window isn't compile-bound
+    warm = ScanEngine()
+    assert b"".join(warm.event_stream(req, data)) == cpu
+    sched = BatchScheduler(max_batch=64, max_wait=0.4)
+    try:
+        eng = ScanEngine(sched)
+        n = 8
+        outs: list = [None] * n
+        barrier = threading.Barrier(n)
+
+        def one(i: int) -> None:
+            barrier.wait()
+            outs[i] = b"".join(eng.event_stream(req, data))
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(o == cpu for o in outs)
+        assert eng.device_serves == n
+        vs = sched.verb_stats["scan"]
+        assert vs["coalesced"] >= 1          # > one request per launch
+        assert vs["batches"] < n
+        assert vs["blocks"] == n             # one page each
+    finally:
+        sched.close()
+
+
+def test_mixed_page_counts_coalesce_correct_slices():
+    """Requests with DIFFERENT page counts but one plan/shape coalesce
+    into a single launch; each must get exactly its own mask slice
+    back (out[at:at+b] distribution + the power-of-two batch pad)."""
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.scan import pager
+    rng = random.Random(79)
+    small = _csv_corpus(rng, 50)                 # 1 page
+    big = _csv_corpus(rng, pager.PAGE_ROWS * 2 + 37)   # 3 pages
+    req = _req("SELECT a, b FROM S3Object WHERE a >= 1 AND b <> ''")
+    oracles = {d: b"".join(event_stream(req, d)) for d in (small, big)}
+    warm = ScanEngine()
+    for d in (small, big):                       # jit-warm both shapes
+        assert b"".join(warm.event_stream(req, d)) == oracles[d]
+    sched = BatchScheduler(max_batch=64, max_wait=0.4)
+    try:
+        eng = ScanEngine(sched)
+        datas = [small, big, big, small]
+        outs: list = [None] * len(datas)
+        barrier = threading.Barrier(len(datas))
+
+        def one(i: int) -> None:
+            barrier.wait()
+            outs[i] = b"".join(eng.event_stream(req, datas[i]))
+
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(len(datas))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i, o in enumerate(outs):
+            assert o == oracles[datas[i]], f"stream {i} wrong slice"
+        assert eng.device_serves == len(datas)
+        vs = sched.verb_stats["scan"]
+        assert vs["coalesced"] >= 1              # mixed B coalesced
+        assert vs["blocks"] == 8                 # 1+3+3+1 pages
+    finally:
+        sched.close()
+
+
+def test_mixed_queries_bucket_separately():
+    """Two different plans submitted concurrently stay in separate
+    buckets (signature in the key) — no cross-contaminated masks."""
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    rng = random.Random(78)
+    data = _csv_corpus(rng, 90)
+    reqs = [_req("SELECT a FROM S3Object WHERE a > 1"),
+            _req("SELECT a FROM S3Object WHERE a <= 1")]
+    oracles = [b"".join(event_stream(r, data)) for r in reqs]
+    sched = BatchScheduler(max_batch=64, max_wait=0.2)
+    try:
+        eng = ScanEngine(sched)
+        outs: list = [None] * 6
+        barrier = threading.Barrier(6)
+
+        def one(i: int) -> None:
+            barrier.wait()
+            outs[i] = b"".join(eng.event_stream(reqs[i % 2], data))
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i, o in enumerate(outs):
+            assert o == oracles[i % 2]
+    finally:
+        sched.close()
+
+
+def test_scheduler_close_falls_back():
+    """A scan riding a CLOSED former CPU-routes (None result -> the
+    Decline('declined') fallback), never hangs or errors."""
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    sched = BatchScheduler(max_batch=64, max_wait=0.1)
+    sched.close()
+    eng = ScanEngine(sched)
+    req = _req("SELECT * FROM S3Object WHERE a = 1")
+    assert b"".join(eng.event_stream(req, b"a\n1\n2\n")) \
+        == b"".join(event_stream(req, b"a\n1\n2\n"))
+    assert eng.fallback_reasons == {"declined": 1}
+
+
+# ---------------------------------------------------------------------------
+# the live endpoint rides the device path
+# ---------------------------------------------------------------------------
+
+def test_select_over_http_device_path(tmp_path):
+    from minio_tpu.object.fs import FSObjects
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+
+    data = (b"name,age,city\n"
+            b"alice,30,paris\n"
+            b"bob,25,london\n"
+            b"carol,35,paris\n")
+    req = _req("SELECT name FROM S3Object WHERE city = 'paris'")
+    oracle = b"".join(event_stream(req, data))
+
+    creds = Credentials("scantest1234", "scansecret1234")
+    fs = FSObjects(str(tmp_path / "scan"))
+    srv = S3Server(fs, creds=creds).start()
+    try:
+        fs.make_bucket("data")
+        fs.put_object("data", "people.csv", data)
+        select_xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<SelectObjectContentRequest>"
+            "<Expression>SELECT name FROM S3Object "
+            "WHERE city = 'paris'</Expression>"
+            "<ExpressionType>SQL</ExpressionType>"
+            "<InputSerialization><CSV>"
+            "<FileHeaderInfo>USE</FileHeaderInfo></CSV>"
+            "</InputSerialization>"
+            "<OutputSerialization><CSV/></OutputSerialization>"
+            "</SelectObjectContentRequest>").encode()
+        path = "/data/people.csv"
+        query = {"select": [""], "select-type": ["2"]}
+        hdrs = {"host": f"127.0.0.1:{srv.port}"}
+        hdrs = sig.sign_v4("POST", path, query, hdrs,
+                           hashlib.sha256(select_xml).hexdigest(),
+                           creds, "us-east-1")
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        qs = urllib.parse.urlencode({k: v[0] for k, v in query.items()})
+        conn.request("POST", f"{path}?{qs}", body=select_xml,
+                     headers=hdrs)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        assert resp.status == 200
+        assert body == oracle                # framed stream, verbatim
+        assert srv.api.scan.device_serves == 1
+        assert srv.api.scan.fallbacks == 0
+    finally:
+        srv.stop()

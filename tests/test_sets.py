@@ -277,7 +277,8 @@ def test_zones_list_object_versions_merge_order(zones):
                                                version_id=v2))
     zones.server_sets[0].put_object("b", "aaa", b"1")
     zones.server_sets[1].put_object("b", "zzz", b"2")
-    out, _nkm, _nvm, _trunc = zones.list_object_versions("b", max_keys=100)
+    out, _pfx, _nkm, _nvm, _trunc = \
+        zones.list_object_versions("b", max_keys=100)
     names = [o.name for o in out]
     assert names == sorted(names)               # name-major order
     split = [(o.version_id, o.mod_time) for o in out
@@ -285,7 +286,7 @@ def test_zones_list_object_versions_merge_order(zones):
     assert [v for v, _ in split] == [v2, v1]    # newest first per name
     assert split[0][1] > split[1][1]
     # max_keys bounds the MERGED stream
-    page3, _, _, trunc3 = zones.list_object_versions("b", max_keys=3)
+    page3, _, _, _, trunc3 = zones.list_object_versions("b", max_keys=3)
     assert len(page3) == 3 and trunc3
 
 
