@@ -34,7 +34,7 @@ from .s3.credentials import Credentials
 from .s3.server import S3Server
 from .storage import errors as serr
 from .storage.xl_storage import XLStorage
-from .utils import ellipses
+from .utils import ellipses, knobs
 
 
 @dataclasses.dataclass
@@ -241,8 +241,7 @@ class ClusterNode:
         # sender's per-peer reload fallback failing too) must not
         # diverge this node forever — refresh the whole cache on an
         # interval like the reference's IAM refresh loop
-        refresh_s = float(os.environ.get("MINIO_TPU_IAM_REFRESH_S",
-                                         "300"))
+        refresh_s = knobs.get_float("MINIO_TPU_IAM_REFRESH_S")
         self._iam_refresh_stop = threading.Event()
 
         def _iam_refresh_loop():

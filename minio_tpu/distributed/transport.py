@@ -26,7 +26,6 @@ import hashlib
 import hmac
 import http.client
 import json
-import os
 import random
 import socket
 import threading
@@ -34,7 +33,7 @@ import time
 import urllib.parse
 from typing import Callable, Optional
 
-from ..utils import backoff_delay, telemetry
+from ..utils import backoff_delay, knobs, telemetry
 
 DEFAULT_TIMEOUT = 30.0
 
@@ -51,15 +50,12 @@ _RPC_OFFLINE_TRIPS = telemetry.REGISTRY.counter(
     "minio_tpu_rpc_offline_trips_total",
     "Peer online->offline transitions")
 HEALTH_PROBE_INTERVAL = 1.0
-HEALTH_PROBE_MAX = float(os.environ.get("MINIO_TPU_PROBE_BACKOFF_MAX",
-                                        "30"))
+HEALTH_PROBE_MAX = knobs.get_float("MINIO_TPU_PROBE_BACKOFF_MAX")
 # retries for idempotent verbs (attempts = retries + 1), inside the
 # per-call deadline
-RPC_RETRIES = int(os.environ.get("MINIO_TPU_RPC_RETRIES", "2"))
-RPC_RETRY_BACKOFF = float(os.environ.get("MINIO_TPU_RPC_RETRY_BACKOFF",
-                                         "0.05"))
-RPC_RETRY_BACKOFF_MAX = float(os.environ.get(
-    "MINIO_TPU_RPC_RETRY_BACKOFF_MAX", "2.0"))
+RPC_RETRIES = knobs.get_int("MINIO_TPU_RPC_RETRIES")
+RPC_RETRY_BACKOFF = knobs.get_float("MINIO_TPU_RPC_RETRY_BACKOFF")
+RPC_RETRY_BACKOFF_MAX = knobs.get_float("MINIO_TPU_RPC_RETRY_BACKOFF_MAX")
 # tolerated clock skew between nodes on token expiry (internode auth
 # must not flap because two hosts' clocks drift a few seconds apart)
 TOKEN_CLOCK_SKEW = 30.0

@@ -118,8 +118,8 @@ class QueueStore:
         self.dir = directory
         self.limit = limit
         if fsync is None:
-            fsync = os.environ.get("MINIO_TPU_QUEUE_FSYNC", "").lower() \
-                in ("1", "on", "true")
+            from ..utils import knobs
+            fsync = knobs.get_bool("MINIO_TPU_QUEUE_FSYNC")
         self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
         self._mu = threading.Lock()

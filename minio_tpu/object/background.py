@@ -25,13 +25,12 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import json
-import os
 import threading
 import time
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..storage import errors as serr
-from ..utils import backoff_delay
+from ..utils import backoff_delay, knobs, lockcheck
 from ..storage.format import read_format_from, write_format_to
 from ..storage.xl_storage import MINIO_META_BUCKET, XLStorage
 from . import api_errors
@@ -46,12 +45,10 @@ DATA_USAGE_OBJECT = "datausage/usage.json"
 # re-probes every 10 s, the transport health probe backs off to 30 s) —
 # with these defaults the schedule spans ~40 s before giving up, so a
 # drive blip heals through MRF instead of always falling to the scanner.
-MRF_QUEUE_SIZE = int(os.environ.get("MINIO_TPU_MRF_QUEUE_SIZE", "10000"))
-MRF_MAX_RETRIES = int(os.environ.get("MINIO_TPU_MRF_MAX_RETRIES", "10"))
-MRF_BACKOFF_BASE = float(os.environ.get("MINIO_TPU_MRF_BACKOFF_BASE",
-                                        "0.05"))
-MRF_BACKOFF_MAX = float(os.environ.get("MINIO_TPU_MRF_BACKOFF_MAX",
-                                       "15.0"))
+MRF_QUEUE_SIZE = knobs.get_int("MINIO_TPU_MRF_QUEUE_SIZE")
+MRF_MAX_RETRIES = knobs.get_int("MINIO_TPU_MRF_MAX_RETRIES")
+MRF_BACKOFF_BASE = knobs.get_float("MINIO_TPU_MRF_BACKOFF_BASE")
+MRF_BACKOFF_MAX = knobs.get_float("MINIO_TPU_MRF_BACKOFF_MAX")
 
 
 def paged_list_objects(obj, bucket: str):
@@ -103,7 +100,7 @@ class MRFHealer:
                              else backoff_base)
         self.backoff_max = (MRF_BACKOFF_MAX if backoff_max is None
                             else backoff_max)
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("mrf.queue")
         self._heap: list[tuple] = []   # (ready_at, seq, b, o, v, attempt)
         self._seq = 0
         # keys currently queued in the heap (dedup)

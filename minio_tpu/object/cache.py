@@ -69,7 +69,7 @@ from typing import Iterator, Optional
 
 from .. import bitrot as bitrot_mod
 from ..storage.datatypes import is_restored, is_transitioned
-from ..utils import telemetry
+from ..utils import knobs, lockcheck, telemetry
 from . import api_errors
 from .engine import GetOptions, PutOptions
 
@@ -85,15 +85,7 @@ _TRACKER_MAX = 100_000                # bounded access-frequency table
 
 
 def enabled() -> bool:
-    return os.environ.get("MINIO_TPU_CACHE", "off").lower() in (
-        "on", "1", "true", "yes")
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+    return knobs.get_bool("MINIO_TPU_CACHE")
 
 
 def _metrics():
@@ -129,7 +121,7 @@ class AccessTracker:
     def __init__(self, admit_hits: int, window_s: float):
         self.admit_hits = max(1, admit_hits)
         self.window_s = window_s
-        self._mu = threading.Lock()
+        self._mu = lockcheck.mutex("cache.tracker")
         self._t: dict[tuple[str, str], tuple[int, float]] = {}
 
     def record(self, bucket: str, key: str) -> int:
@@ -186,7 +178,8 @@ class CacheObjects:
         self.admit_rejects = 0
         self.tracker = AccessTracker(admit_hits, admit_window_s)
         self._m = _metrics()
-        self._mu = threading.Lock()
+        self._mu = lockcheck.mutex("cache.meta")
+        self._purge_mu = lockcheck.mutex("cache.purge")
 
     @classmethod
     def from_env(cls, inner, default_dir: str) -> "CacheObjects":
@@ -194,12 +187,11 @@ class CacheObjects:
         MINIO_TPU_CACHE_* environment."""
         return cls(
             inner,
-            os.environ.get("MINIO_TPU_CACHE_DIR") or default_dir,
-            budget_bytes=_env_int("MINIO_TPU_CACHE_BUDGET_BYTES",
-                                  DEFAULT_BUDGET),
-            admit_hits=_env_int("MINIO_TPU_CACHE_ADMIT", 2),
-            admit_window_s=float(os.environ.get(
-                "MINIO_TPU_CACHE_ADMIT_WINDOW_S", "300")))
+            knobs.get_str("MINIO_TPU_CACHE_DIR") or default_dir,
+            budget_bytes=knobs.get_int("MINIO_TPU_CACHE_BUDGET_BYTES"),
+            admit_hits=knobs.get_int("MINIO_TPU_CACHE_ADMIT"),
+            admit_window_s=knobs.get_float(
+                "MINIO_TPU_CACHE_ADMIT_WINDOW_S"))
 
     # everything not overridden passes straight through
     def __getattr__(self, name):
@@ -255,7 +247,9 @@ class CacheObjects:
     def _drop_range(self, bucket: str, key: str, fname: str) -> None:
         """Remove one corrupt cache file and its meta reference."""
         d = self._entry_dir(bucket, key)
-        with self._mu:
+        # the meta.json read-modify-write IS the shared state the lock
+        # exists for: one small-file rewrite, bounded, no backend I/O
+        with self._mu:  # check: allow(lock-blocking) meta.json RMW critical section (one small file)
             meta = self._load_entry(bucket, key)
             try:
                 os.remove(os.path.join(d, fname))
@@ -264,7 +258,12 @@ class CacheObjects:
             if meta is not None:
                 meta["ranges"] = [r for r in meta.get("ranges", [])
                                   if r["file"] != fname]
-                self._write_meta(d, meta)
+                try:
+                    self._write_meta(d, meta)
+                except OSError:
+                    # entry dir purged under us (watermark/namespace
+                    # eviction) — the drop already happened
+                    pass
 
     # -- framed file I/O ---------------------------------------------------
 
@@ -386,7 +385,8 @@ class CacheObjects:
 
     def _commit_locked(self, bucket, key, info, fname, tmp, d,
                        start, end) -> None:
-        with self._mu:
+        with self._mu:  # check: allow(lock-blocking) meta.json RMW critical section (one small file); caller catches OSError
+
             meta = self._load_entry(bucket, key)
             if meta is None or meta.get("etag") != info.etag:
                 # fresh entry (or a stale generation): ranges reset
@@ -421,7 +421,17 @@ class CacheObjects:
         return total
 
     def _purge_if_needed(self) -> None:
-        with self._mu:
+        """Watermark purge on its OWN serialization lock: the usage
+        walk + rmtrees cover the whole cache tree and must not park
+        fill commits (`_mu`, the meta.json critical section) behind a
+        directory scan. A purge racing a commit is safe — `_commit`
+        tolerates its entry dir vanishing — and a second caller
+        arriving mid-purge simply skips (that purge is already doing
+        the work)."""
+        # check: allow(lock-blocking) non-blocking try-acquire: purge-only serialization, deliberately NOT a with-block (a second purger skips instead of queueing)
+        if not self._purge_mu.acquire(False):
+            return
+        try:
             usage = self._usage()
             if usage < self.budget * HIGH_WATERMARK:
                 return
@@ -454,6 +464,8 @@ class CacheObjects:
                 self.evictions += 1
                 self._m[3].inc(cause="watermark")
                 usage -= size
+        finally:
+            self._purge_mu.release()
 
     # -- ObjectLayer overrides ---------------------------------------------
 
@@ -600,15 +612,20 @@ class CacheObjects:
         under one meta generation."""
         d = self._entry_dir(bucket, key)
         os.makedirs(d, exist_ok=True)
-        with self._mu:
+        with self._mu:  # check: allow(lock-blocking) meta.json RMW critical section (one small file)
             meta = self._load_entry(bucket, key)
             if meta is None or meta.get("etag") != info.etag:
-                self._write_meta(d, {
-                    "bucket": bucket, "key": key,
-                    "etag": info.etag, "size": info.size,
-                    "content_type": info.content_type,
-                    "user_defined": dict(info.user_defined or {}),
-                    "mod_time": info.mod_time, "ranges": []})
+                try:
+                    self._write_meta(d, {
+                        "bucket": bucket, "key": key,
+                        "etag": info.etag, "size": info.size,
+                        "content_type": info.content_type,
+                        "user_defined": dict(info.user_defined or {}),
+                        "mod_time": info.mod_time, "ranges": []})
+                except OSError:
+                    # entry dir purged between makedirs and the write —
+                    # losing the skeleton only skips this fill
+                    pass
 
     def put_object(self, bucket: str, key: str, reader, size: int = -1,
                    opts: Optional[PutOptions] = None):

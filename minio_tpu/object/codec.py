@@ -15,17 +15,15 @@ Both produce byte-identical shards (tests/test_rs_tpu.py oracle checks).
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import numpy as np
 
 from ..ops import gf256, rs_matrix, rs_ref, rs_tpu
-from ..utils import native
+from ..utils import knobs, native
 
 # Batches at least this large go to the device (dispatch+transfer amortized).
-DEVICE_MIN_BYTES = int(os.environ.get("MINIO_TPU_DEVICE_MIN_BYTES",
-                                      str(8 << 20)))
+DEVICE_MIN_BYTES = knobs.get_int("MINIO_TPU_DEVICE_MIN_BYTES")
 
 
 _IS_TPU: Optional[bool] = None
@@ -49,7 +47,7 @@ def _mesh_active():
     (the virtual CPU mesh tests and the driver dryrun), =0 disables.
     (VERDICT r4 #1: the serving stack routes through parallel/mesh.py,
     not only the driver's dryrun.)"""
-    v = os.environ.get("MINIO_TPU_MESH", "")
+    v = knobs.get_str("MINIO_TPU_MESH")
     if v == "0":
         return None
     if v != "1" and not _device_is_tpu():

@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
-from ..utils import stagetimer, telemetry
+from ..utils import knobs, stagetimer, telemetry
 from ..storage.api import StorageAPI
 from ..storage.datatypes import (BLOCK_SIZE_V1, RESTORE_EXPIRY_KEY,
                                  RESTORE_KEY, TRANSITION_COMPLETE,
@@ -50,8 +50,8 @@ from .codec import Codec
 from .hash_reader import HashReader
 from .nslock import NSLockMap
 
-ENCODE_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_ENCODE_BATCH", "8"))
-GET_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_GET_BATCH", "8"))
+ENCODE_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_ENCODE_BATCH")
+GET_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_GET_BATCH")
 
 # Reserved bucket names an S3 client can't touch.
 RESERVED_BUCKETS = (MINIO_META_BUCKET,)
@@ -893,8 +893,12 @@ class ErasureObjects:
             fi.add_object_part(1, info.etag, info.size, info.size)
         with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
             metas = [fi.light_copy() for _ in range(len(self.disks))]
-            meta.write_unique_file_info(self.disks, bucket, object_name,
-                                        metas, write_quorum)
+            online = meta.write_unique_file_info(
+                self.disks, bucket, object_name, metas, write_quorum)
+        if any(d is None for d in online):
+            # quorum met but some drive missed the stub: regain full
+            # redundancy through MRF like every other write verb
+            self._notify_degraded(bucket, object_name, fi.version_id)
         self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
 
@@ -1430,6 +1434,10 @@ class ErasureObjects:
             out.append(None if err is None
                        else api_errors.to_object_err(err, bucket, o))
             if err is None:
+                # quorum-successful delete that left stale state on
+                # some drive still needs the MRF pass, exactly like
+                # the single-key delete path
+                self._flag_degraded_delete(bucket, o, "", per_disk)
                 self._notify_namespace(bucket, o)
         return out
 

@@ -27,12 +27,11 @@ from ..storage import errors as serr
 from ..storage.api import StorageAPI
 from ..storage.datatypes import FileInfo, is_restored, is_transitioned
 from ..storage.xl_storage import MINIO_META_TMP_BUCKET
+from ..utils import knobs
 from . import api_errors, bitrot_io, metadata as meta
 from .engine import ErasureObjects
 
-import os
-
-HEAL_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_HEAL_BATCH", "8"))
+HEAL_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_HEAL_BATCH")
 
 
 @dataclass

@@ -56,7 +56,6 @@ from __future__ import annotations
 
 import bisect
 import json
-import os
 import threading
 import time
 import uuid as _uuid
@@ -64,31 +63,19 @@ from typing import Iterator, Optional
 
 from ..storage.datatypes import ObjectInfo, ObjectPartInfo
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import telemetry
+from ..utils import knobs, lockcheck, telemetry
 from . import api_errors
 from .engine import paginate_objects, paginate_versions
 
 _FORMAT = 1
 
 
-def _flag(name: str, default: str = "on") -> bool:
-    return os.environ.get(name, default).lower() not in (
-        "off", "0", "false", "no")
-
-
 def enabled() -> bool:
-    return _flag("MINIO_TPU_METACACHE")
+    return knobs.get_bool("MINIO_TPU_METACACHE")
 
 
 def feed_enabled() -> bool:
-    return enabled() and _flag("MINIO_TPU_METACACHE_FEED")
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+    return enabled() and knobs.get_bool("MINIO_TPU_METACACHE_FEED")
 
 
 def mc_prefix(bucket: str) -> str:
@@ -266,7 +253,7 @@ class MetacacheManager:
         self._reconcile_s = reconcile_s
         self._segment_keys = segment_keys
         self._journal_max = journal_max
-        self._cond = threading.Condition()
+        self._cond = lockcheck.condition("metacache.cond")
         # metric families resolved ONCE — record() runs per PUT/DELETE
         # and must not pay seven registry-lock lookups each call
         self._m = _metrics()
@@ -293,27 +280,27 @@ class MetacacheManager:
 
     def staleness_s(self) -> float:
         return self._staleness if self._staleness is not None else \
-            _env_f("MINIO_TPU_METACACHE_STALENESS_S", 2.0)
+            knobs.get_float("MINIO_TPU_METACACHE_STALENESS_S")
 
     def flush_s(self) -> float:
         return self._flush_s if self._flush_s is not None else \
-            _env_f("MINIO_TPU_METACACHE_FLUSH_S", 0.2)
+            knobs.get_float("MINIO_TPU_METACACHE_FLUSH_S")
 
     def persist_s(self) -> float:
         return self._persist_s if self._persist_s is not None else \
-            _env_f("MINIO_TPU_METACACHE_PERSIST_S", 30.0)
+            knobs.get_float("MINIO_TPU_METACACHE_PERSIST_S")
 
     def reconcile_s(self) -> float:
         return self._reconcile_s if self._reconcile_s is not None else \
-            _env_f("MINIO_TPU_METACACHE_RECONCILE_S", 300.0)
+            knobs.get_float("MINIO_TPU_METACACHE_RECONCILE_S")
 
     def segment_keys(self) -> int:
         return self._segment_keys if self._segment_keys is not None else \
-            int(_env_f("MINIO_TPU_METACACHE_SEGMENT_KEYS", 5000))
+            knobs.get_int("MINIO_TPU_METACACHE_SEGMENT_KEYS")
 
     def journal_max(self) -> int:
         return self._journal_max if self._journal_max is not None else \
-            int(_env_f("MINIO_TPU_METACACHE_JOURNAL", 100000))
+            knobs.get_int("MINIO_TPU_METACACHE_JOURNAL")
 
     # -- lifecycle ---------------------------------------------------------
 

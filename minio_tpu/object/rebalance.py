@@ -37,13 +37,12 @@ Knobs (README "Topology operations"):
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from ..utils.pressure import ForegroundPressure
 from ..utils.streams import IterStream as _IterStream
 from . import api_errors
@@ -53,14 +52,11 @@ from .topology import POOL_DRAINING, TOPOLOGY_PREFIX
 if TYPE_CHECKING:  # pragma: no cover — typing only
     from .server_sets import ErasureServerSets
 
-CHECKPOINT_EVERY = int(os.environ.get(
-    "MINIO_TPU_REBALANCE_CHECKPOINT_EVERY", "16"))
-PAGE = int(os.environ.get("MINIO_TPU_REBALANCE_PAGE", "256"))
-BACKOFF_S = float(os.environ.get("MINIO_TPU_REBALANCE_BACKOFF_S", "0.05"))
-BACKOFF_MAX_S = float(os.environ.get(
-    "MINIO_TPU_REBALANCE_BACKOFF_MAX_S", "1.0"))
-BACKOFF_TRIES = int(os.environ.get(
-    "MINIO_TPU_REBALANCE_BACKOFF_TRIES", "8"))
+CHECKPOINT_EVERY = knobs.get_int("MINIO_TPU_REBALANCE_CHECKPOINT_EVERY")
+PAGE = knobs.get_int("MINIO_TPU_REBALANCE_PAGE")
+BACKOFF_S = knobs.get_float("MINIO_TPU_REBALANCE_BACKOFF_S")
+BACKOFF_MAX_S = knobs.get_float("MINIO_TPU_REBALANCE_BACKOFF_MAX_S")
+BACKOFF_TRIES = knobs.get_int("MINIO_TPU_REBALANCE_BACKOFF_TRIES")
 
 # meta-bucket prefixes that must NOT migrate: per-pool internals (tmp
 # staging, live multipart sessions, bucket metadata replicated per

@@ -13,8 +13,9 @@ shows up on a dashboard instead of as a silent hang.
 from __future__ import annotations
 
 import queue
-import threading
 from typing import Optional
+
+from ..utils import lockcheck
 
 
 class BytePoolExhausted(Exception):
@@ -29,7 +30,7 @@ class BytePool:
         self.capacity = capacity
         self.waits = 0          # get() calls that had to block
         self.exhausted = 0      # get() calls that timed out
-        self._mu = threading.Lock()
+        self._mu = lockcheck.mutex("bpool.created")
         self._created = 0       # buffers allocated so far (<= capacity)
         self._q: "queue.Queue[bytearray]" = queue.Queue(maxsize=capacity)
 

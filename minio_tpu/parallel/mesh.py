@@ -55,6 +55,7 @@ except ImportError:                       # older jax: check_rep kwarg
 
 from ..ops import rs_matrix, rs_tpu
 from ..models import pipeline
+from ..utils import lockcheck
 
 
 def make_mesh(n_devices: int | None = None, devices=None,
@@ -294,7 +295,7 @@ DISPATCHES = _Dispatches()    # mesh device calls (tests/metrics)
 # A/B), and the same hazard exists for any concurrent direct caller.
 # Real TPU pools keep concurrent dispatch (the scheduler's INFLIGHT
 # overlap): the PjRt TPU client runs concurrent executions safely.
-_DISPATCH_MU = threading.Lock()
+_DISPATCH_MU = lockcheck.mutex("mesh.dispatch")
 _NULL_MU = contextlib.nullcontext()
 
 

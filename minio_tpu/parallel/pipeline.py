@@ -38,12 +38,11 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Sequence
 
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from .bpool import BytePool
 
-ENABLED = os.environ.get("MINIO_TPU_PIPELINE", "on").strip().lower() \
-    not in ("off", "0", "false", "no")
-DEPTH = max(1, int(os.environ.get("MINIO_TPU_PIPELINE_DEPTH", "2")))
+ENABLED = knobs.get_bool("MINIO_TPU_PIPELINE")
+DEPTH = max(1, knobs.get_int("MINIO_TPU_PIPELINE_DEPTH"))
 # staging ring size: the pool is SHARED by every stream of a geometry,
 # so it must scale with the ADMITTED concurrency (each admitted stream
 # keeps ~2 batches in flight) or it throttles aggregate throughput
@@ -51,11 +50,9 @@ DEPTH = max(1, int(os.environ.get("MINIO_TPU_PIPELINE_DEPTH", "2")))
 # fallback for pool rings created before the server computes its
 # admission budget — configure_pool_buffers() re-derives the default
 # from requests_budget() at boot (the env knob always wins).
-_POOL_ENV_SET = "MINIO_TPU_PIPELINE_POOL" in os.environ
-POOL_BUFFERS = max(4, int(os.environ.get(
-    "MINIO_TPU_PIPELINE_POOL", str(2 * (os.cpu_count() or 4)))))
-POOL_TIMEOUT_S = float(os.environ.get(
-    "MINIO_TPU_PIPELINE_POOL_TIMEOUT_S", "60"))
+_POOL_ENV_SET = knobs.is_set("MINIO_TPU_PIPELINE_POOL")
+POOL_BUFFERS = max(4, knobs.get_int("MINIO_TPU_PIPELINE_POOL"))
+POOL_TIMEOUT_S = knobs.get_float("MINIO_TPU_PIPELINE_POOL_TIMEOUT_S")
 
 
 def configure_pool_buffers(requests_budget: int) -> int:

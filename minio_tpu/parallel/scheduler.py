@@ -53,11 +53,11 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils import telemetry
+from ..utils import knobs, lockcheck, telemetry
 
-MAX_BATCH_BLOCKS = int(os.environ.get("MINIO_TPU_SCHED_MAX_BATCH", "32"))
-MAX_WAIT_S = float(os.environ.get("MINIO_TPU_SCHED_MAX_WAIT_MS", "3")) / 1e3
-INFLIGHT = max(1, int(os.environ.get("MINIO_TPU_SCHED_INFLIGHT", "2")))
+MAX_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_SCHED_MAX_BATCH")
+MAX_WAIT_S = knobs.get_float("MINIO_TPU_SCHED_MAX_WAIT_MS") / 1e3
+INFLIGHT = max(1, knobs.get_int("MINIO_TPU_SCHED_INFLIGHT"))
 
 VERBS = ("encode", "decode", "recover", "scan")
 
@@ -177,7 +177,7 @@ class BatchScheduler:
                  inflight: int = INFLIGHT):
         self.max_batch = max_batch
         self.max_wait = max_wait
-        self._mu = threading.Lock()
+        self._mu = lockcheck.mutex("sched.buckets")
         # (verb, k, m, S, algo_value, extra) -> list[_Pending]
         self._buckets: dict[tuple, list[_Pending]] = {}
         self._bucket_blocks: dict[tuple, int] = {}

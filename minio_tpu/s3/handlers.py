@@ -29,6 +29,7 @@ from ..object.engine import GetOptions, PutOptions
 from ..object.hash_reader import HashReader
 from ..object.multipart import CompletePart
 from ..storage.datatypes import ObjectInfo
+from ..utils import knobs
 from ..utils import stagetimer, telemetry
 from ..utils.streams import IterStream as _IterStream
 from . import signature as sig
@@ -227,11 +228,11 @@ class S3ApiHandlers:
         # requests queue here instead). The cluster boot overrides this
         # with the full RAM+CPU budget (requests_budget).
         if max_clients is None:
-            max_clients = int(os.environ.get("MINIO_TPU_MAX_CLIENTS", 0)) \
+            max_clients = knobs.get_int("MINIO_TPU_MAX_CLIENTS") \
                 or max(4, 4 * (os.cpu_count() or 1))
         self._admission = threading.BoundedSemaphore(max_clients)
-        self.request_deadline = float(os.environ.get(
-            "MINIO_TPU_REQUEST_DEADLINE", "10"))
+        self.request_deadline = knobs.get_float(
+            "MINIO_TPU_REQUEST_DEADLINE")
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPool
@@ -270,8 +271,7 @@ class S3ApiHandlers:
         # stalled pipeline. Baselined at construction so pre-existing
         # process-global counters don't trip a fresh handler.
         from ..parallel import pipeline as _pl
-        self.shed_window_s = float(os.environ.get(
-            "MINIO_TPU_SHED_WINDOW_S", "5"))
+        self.shed_window_s = knobs.get_float("MINIO_TPU_SHED_WINDOW_S")
         self._shed_last_exhausted = _pl.pool_pressure()["exhausted"]
         self._shed_until = 0.0
 

@@ -151,6 +151,15 @@ ERROR_TABLE: dict[str, tuple[int, str]] = {
 }
 
 
+# ObjectApiError subclasses that never cross the HTTP boundary: they
+# are consumed by the background planes (MRF retry, scanner sweep)
+# before any handler sees them. The `error-map` check in tools/check
+# requires every api_errors class to be either mapped below or listed
+# here — an unmapped class surfacing as a bare 500 is the bug class
+# this table exists to prevent.
+INTERNAL_ONLY = (oerr.HealFailed,)
+
+
 class S3Error(Exception):
     """An error carrying an explicit S3 error code (raised in handlers)."""
 
@@ -192,6 +201,7 @@ def api_error_from(exc: Exception) -> S3Error:
         (oerr.InvalidObjectState, "InvalidObjectState"),
         (oerr.TierNotFound, "XMinioAdminTierNotFound"),
         (oerr.InvalidETag, "InvalidDigest"),
+        (oerr.ObjectExistsAsDirectory, "MethodNotAllowed"),
         (oerr.MethodNotAllowed, "MethodNotAllowed"),
         (oerr.SignatureDoesNotMatch, "SignatureDoesNotMatch"),
         (oerr.NotImplementedError_, "NotImplemented"),

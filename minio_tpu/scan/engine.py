@@ -23,21 +23,19 @@ Metrics:
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Iterator, Optional
 
 from ..s3select import select as sel
 from ..s3select import sql as _sql
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from . import kernels, pager
 from .plan import Decline, compile_plan
 
 #: device-path input cap: the kernels materialize the decompressed
 #: object as row dicts + padded column pages (~10-40x the raw bytes),
 #: so very large objects stream through the CPU evaluator instead
-MAX_SCAN_BYTES = int(os.environ.get("MINIO_TPU_SCAN_MAX_BYTES",
-                                    str(64 << 20)))
+MAX_SCAN_BYTES = knobs.get_int("MINIO_TPU_SCAN_MAX_BYTES")
 
 
 def _metrics():

@@ -36,25 +36,25 @@ Env:
 from __future__ import annotations
 
 import collections
-import os
 import threading
 from typing import Optional
 
 import numpy as np
+
+from ..utils import knobs
 
 _COMPILE_MU = threading.Lock()
 # (signature, shape) -> jitted fn. Bounded LRU: the signature bakes in
 # query literals, so per-request values (timestamps, uuids) would grow
 # the trace cache without bound on a long-running server.
 _KERNELS: collections.OrderedDict = collections.OrderedDict()
-_KERNEL_CACHE_CAP = int(os.environ.get(
-    "MINIO_TPU_SCAN_KERNEL_CACHE", "64"))
+_KERNEL_CACHE_CAP = knobs.get_int("MINIO_TPU_SCAN_KERNEL_CACHE")
 
 
 def device_allowed() -> bool:
     """Same decline discipline as the erasure verbs: no device, no
     reason to pay the dispatch seam — unless forced (tests/bench)."""
-    mode = os.environ.get("MINIO_TPU_SCAN_DEVICE", "on").lower()
+    mode = knobs.get_str("MINIO_TPU_SCAN_DEVICE").lower()
     if mode in ("off", "0", "false", "no"):
         return False
     try:

@@ -26,18 +26,17 @@ output, because nothing has been emitted yet.
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
+from ..utils import knobs
 from .plan import Decline, ScanPlan
 
 #: rows per page (fixed shape -> stable jit cache, coalesçable pages)
-PAGE_ROWS = max(64, int(os.environ.get("MINIO_TPU_SCAN_PAGE_ROWS",
-                                       "2048")))
+PAGE_ROWS = max(64, knobs.get_int("MINIO_TPU_SCAN_PAGE_ROWS"))
 #: string width buckets; cells wider than the last decline
 _WIDTHS = (8, 16, 32, 64,
-           max(64, int(os.environ.get("MINIO_TPU_SCAN_MAX_STR", "128"))))
+           max(64, knobs.get_int("MINIO_TPU_SCAN_MAX_STR")))
 
 
 def resolve_cell(row: dict, name: str):

@@ -26,7 +26,7 @@ import uuid as _uuid
 from typing import BinaryIO, Iterator, Optional
 
 from .. import bitrot as bitrot_mod
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from . import errors
 from .api import BitrotVerifier, StorageAPI
 from .datatypes import DiskInfo, FileInfo, VolInfo
@@ -147,8 +147,7 @@ class _DirectWriter:
 
 
 def _direct_io_default() -> bool:
-    return os.environ.get("MINIO_TPU_DIRECT_IO", "").lower() in (
-        "1", "on", "true")
+    return knobs.get_bool("MINIO_TPU_DIRECT_IO")
 
 
 class XLStorage(StorageAPI):

@@ -28,7 +28,6 @@ This module wires that flow to this repo's planes:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import xml.etree.ElementTree as ET
@@ -42,17 +41,16 @@ from ..storage.datatypes import (RESTORE_EXPIRY_KEY, RESTORE_KEY,
                                  TRANSITIONED_OBJECT_KEY,
                                  TRANSITIONED_VERSION_KEY, is_restored,
                                  is_transitioned)
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from ..utils.pressure import ForegroundPressure
 from ..utils.streams import IterStream
 from .client import TierClientError, TierObjectNotFound
 from .config import TierManager
 
-QUEUE_SIZE = int(os.environ.get("MINIO_TPU_TIER_QUEUE_SIZE", "10000"))
-BACKOFF_S = float(os.environ.get("MINIO_TPU_TIER_BACKOFF_S", "0.05"))
-BACKOFF_MAX_S = float(os.environ.get("MINIO_TPU_TIER_BACKOFF_MAX_S",
-                                     "1.0"))
-BACKOFF_TRIES = int(os.environ.get("MINIO_TPU_TIER_BACKOFF_TRIES", "8"))
+QUEUE_SIZE = knobs.get_int("MINIO_TPU_TIER_QUEUE_SIZE")
+BACKOFF_S = knobs.get_float("MINIO_TPU_TIER_BACKOFF_S")
+BACKOFF_MAX_S = knobs.get_float("MINIO_TPU_TIER_BACKOFF_MAX_S")
+BACKOFF_TRIES = knobs.get_int("MINIO_TPU_TIER_BACKOFF_TRIES")
 
 
 def _metrics():

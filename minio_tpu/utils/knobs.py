@@ -1,0 +1,357 @@
+"""Declarative registry of every ``MINIO_TPU_*`` tuning knob.
+
+Before this module, ~45 env knobs were scattered as raw
+``os.environ.get("MINIO_TPU_…")`` reads across a dozen modules, each
+with its own parsing idiom (`_env_f`, `_env_int`, `_flag`, inline
+``int(...)``) and a hand-maintained README table that drifted from the
+code. Now every knob is declared HERE — name, type, default, doc — and
+read through the typed getters below. ``tools/check`` enforces the
+discipline two ways:
+
+  * the ``knob-env`` lint rule fails any raw ``MINIO_TPU_*`` environ
+    access outside this module (and any getter call naming an
+    unregistered knob);
+  * ``tools/check/knobtable.py`` regenerates the README knob table from
+    this registry and the drift check fails when the committed table
+    disagrees.
+
+Getters read the ENVIRONMENT at call time (never cached here): tests
+flip knobs with ``monkeypatch.setenv`` and modules that want an
+import-time snapshot simply call the getter at module scope, exactly
+like the old reads. Parse failures fall back to the declared default —
+a typo'd value must degrade to documented behavior, not crash the
+server at boot.
+
+Boolean knobs accept ``on/1/true/yes`` and ``off/0/false/no``
+(case-insensitive); anything else means the default. Defaults may be
+callables (evaluated per read) for host-derived values such as the
+staging-ring size; ``display`` carries the README-facing rendering of
+such defaults ("2×cores", "64 MiB").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Union
+
+__all__ = [
+    "Knob", "KNOBS", "define", "get", "all_knobs",
+    "get_str", "get_int", "get_float", "get_bool", "get_raw", "is_set",
+    "render_table", "TABLE_BEGIN", "TABLE_END",
+]
+
+_TRUE = ("on", "1", "true", "yes")
+_FALSE = ("off", "0", "false", "no")
+
+Default = Union[str, int, float, bool, Callable[[], Union[int, float, str]]]
+
+
+class Knob:
+    """One declared knob: name, type, default, one-line doc."""
+
+    __slots__ = ("name", "type", "default", "doc", "section", "display")
+
+    def __init__(self, name: str, type_: str, default: Default,
+                 doc: str, section: str, display: str = ""):
+        assert name.startswith("MINIO_TPU_"), name
+        assert type_ in ("str", "int", "float", "bool"), type_
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        self.section = section
+        self.display = display
+
+    def resolve_default(self):
+        d = self.default
+        return d() if callable(d) else d
+
+    def default_display(self) -> str:
+        if self.display:
+            return self.display
+        d = self.resolve_default()
+        if self.type == "bool":
+            return "on" if d else "off"
+        return str(d)
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def define(name: str, type_: str, default: Default, doc: str,
+           section: str, display: str = "") -> Knob:
+    assert name not in KNOBS, f"knob {name} declared twice"
+    k = Knob(name, type_, default, doc, section, display)
+    KNOBS[name] = k
+    return k
+
+
+def get(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(f"unregistered knob {name!r} — declare it in "
+                       "minio_tpu/utils/knobs.py") from None
+
+
+def all_knobs() -> List[Knob]:
+    return list(KNOBS.values())
+
+
+# ---------------------------------------------------------------------------
+# typed getters — the ONLY sanctioned MINIO_TPU_* environment reads
+# ---------------------------------------------------------------------------
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw environment value, or None when unset. Registered knobs
+    only (a typo'd name must fail loudly, not silently default)."""
+    get(name)
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    get(name)
+    return name in os.environ
+
+
+def get_str(name: str) -> str:
+    k = get(name)
+    v = os.environ.get(name)
+    return str(k.resolve_default()) if v is None else v
+
+
+def get_int(name: str) -> int:
+    k = get(name)
+    v = os.environ.get(name)
+    if v is not None:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return int(k.resolve_default())
+
+
+def get_float(name: str) -> float:
+    k = get(name)
+    v = os.environ.get(name)
+    if v is not None:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return float(k.resolve_default())
+
+
+def get_bool(name: str) -> bool:
+    k = get(name)
+    v = os.environ.get(name)
+    if v is not None:
+        s = v.strip().lower()
+        if s in _TRUE:
+            return True
+        if s in _FALSE:
+            return False
+    return bool(k.resolve_default())
+
+
+# ---------------------------------------------------------------------------
+# the registry — grouped by plane, in README table order
+# ---------------------------------------------------------------------------
+
+_S = "Data path"
+define("MINIO_TPU_PIPELINE", "bool", True,
+       "`off` selects the serial PUT/GET hot loops", _S)
+define("MINIO_TPU_PIPELINE_DEPTH", "int", 2,
+       "bounded queue depth between pipeline stages", _S)
+define("MINIO_TPU_PIPELINE_POOL", "int",
+       lambda: 2 * (os.cpu_count() or 4),
+       "staging buffers per geometry ring (boot re-derives from the "
+       "admission budget; the env knob wins)", _S, display="2×cores")
+define("MINIO_TPU_PIPELINE_POOL_TIMEOUT_S", "float", 60.0,
+       "staging-buffer wait before the PUT fails loudly", _S)
+define("MINIO_TPU_ENCODE_BATCH", "int", 8,
+       "blocks fused per PUT encode+digest call", _S)
+define("MINIO_TPU_GET_BATCH", "int", 8,
+       "blocks fused per GET verify/decode call", _S)
+define("MINIO_TPU_HEAL_BATCH", "int", 8,
+       "blocks fused per heal recover call", _S)
+define("MINIO_TPU_DEVICE_MIN_BYTES", "int", 8 << 20,
+       "batch bytes below which the codec stays on the host path", _S,
+       display="8 MiB")
+define("MINIO_TPU_MESH", "str", "",
+       "`1` forces mesh dispatch on any multi-device backend, `0` "
+       "disables; default meshes only multi-device TPU pools", _S,
+       display="auto")
+define("MINIO_TPU_DIRECT_IO", "bool", False,
+       "`on` = O_DIRECT shard writes (page-cache bypass; buffered "
+       "fallback where the filesystem refuses)", _S)
+
+_S = "Batch former"
+define("MINIO_TPU_SCHED_MAX_BATCH", "int", 32,
+       "blocks per fused device dispatch", _S)
+define("MINIO_TPU_SCHED_MAX_WAIT_MS", "float", 3.0,
+       "cross-request coalescing grace window, milliseconds", _S)
+define("MINIO_TPU_SCHED_INFLIGHT", "int", 2,
+       "concurrent dispatches in flight (transfer/compute overlap)", _S)
+
+_S = "Server"
+define("MINIO_TPU_MAX_CLIENTS", "int", 0,
+       "admission-gate size; 0 derives it from the RAM+CPU budget", _S,
+       display="auto")
+define("MINIO_TPU_REQUEST_DEADLINE", "float", 10.0,
+       "seconds a request waits on admission before SlowDown", _S)
+define("MINIO_TPU_SHED_WINDOW_S", "float", 5.0,
+       "shed data writes this long after a staging-pool timeout", _S)
+define("MINIO_TPU_IAM_REFRESH_S", "float", 300.0,
+       "full IAM cache refresh interval (bounded staleness)", _S)
+
+_S = "Fault plane"
+define("MINIO_TPU_MRF_QUEUE_SIZE", "int", 10000,
+       "max queued MRF heal entries (overflow drops)", _S)
+define("MINIO_TPU_MRF_MAX_RETRIES", "int", 10,
+       "heal retries before an entry counts failed", _S)
+define("MINIO_TPU_MRF_BACKOFF_BASE", "float", 0.05,
+       "first heal-retry delay, seconds (doubles per retry)", _S)
+define("MINIO_TPU_MRF_BACKOFF_MAX", "float", 15.0,
+       "heal-retry delay cap, seconds (schedule spans ~40 s — past "
+       "the 10 s drive re-probe and the probe backoff)", _S)
+define("MINIO_TPU_RPC_RETRIES", "int", 2,
+       "extra attempts for idempotent RPC verbs", _S)
+define("MINIO_TPU_RPC_RETRY_BACKOFF", "float", 0.05,
+       "first RPC retry delay, seconds", _S)
+define("MINIO_TPU_RPC_RETRY_BACKOFF_MAX", "float", 2.0,
+       "RPC retry delay cap, seconds", _S)
+define("MINIO_TPU_PROBE_BACKOFF_MAX", "float", 30.0,
+       "offline health-probe interval cap, seconds", _S)
+define("MINIO_TPU_CHAOS_SEED", "str", "",
+       "replay a chaos test's exact fault schedule (tests print the "
+       "failing seed)", _S, display="per-test")
+
+_S = "Telemetry"
+define("MINIO_TPU_TRACE_SLOW_MS", "float", 500.0,
+       "span trees at least this slow are always kept", _S)
+define("MINIO_TPU_TRACE_SAMPLE", "float", 0.0,
+       "keep-probability for ordinary (fast, error-free) traces", _S)
+define("MINIO_TPU_TRACE_KEEP", "int", 128,
+       "kept span-tree ring size", _S)
+define("MINIO_TPU_TRACE_MAX_SPANS", "int", 512,
+       "span budget per trace; extras no-op and are counted as "
+       "`spans_dropped`", _S)
+
+_S = "Topology"
+define("MINIO_TPU_REBALANCE_CHECKPOINT_EVERY", "int", 16,
+       "objects moved between drain checkpoints", _S)
+define("MINIO_TPU_REBALANCE_PAGE", "int", 256,
+       "rebalance listing page size", _S)
+define("MINIO_TPU_REBALANCE_BACKOFF_S", "float", 0.05,
+       "first drain backoff when the foreground is busy", _S)
+define("MINIO_TPU_REBALANCE_BACKOFF_MAX_S", "float", 1.0,
+       "drain backoff cap, seconds", _S)
+define("MINIO_TPU_REBALANCE_BACKOFF_TRIES", "int", 8,
+       "busy polls before the drain proceeds anyway", _S)
+
+_S = "Tiering"
+define("MINIO_TPU_TIER_QUEUE_SIZE", "int", 10000,
+       "max queued tier-transition entries", _S)
+define("MINIO_TPU_TIER_BACKOFF_S", "float", 0.05,
+       "first transition backoff when the foreground is busy", _S)
+define("MINIO_TPU_TIER_BACKOFF_MAX_S", "float", 1.0,
+       "transition backoff cap, seconds", _S)
+define("MINIO_TPU_TIER_BACKOFF_TRIES", "int", 8,
+       "busy polls before a transition proceeds anyway", _S)
+
+_S = "Metacache"
+define("MINIO_TPU_METACACHE", "bool", True,
+       "`off` = exactly the old merge-walk listing behavior", _S)
+define("MINIO_TPU_METACACHE_FEED", "bool", True,
+       "scanners consume the index namespace feed", _S)
+define("MINIO_TPU_METACACHE_STALENESS_S", "float", 2.0,
+       "serve-time staleness bound (older deltas drain synchronously)",
+       _S)
+define("MINIO_TPU_METACACHE_FLUSH_S", "float", 0.2,
+       "journal drain cadence, seconds", _S)
+define("MINIO_TPU_METACACHE_PERSIST_S", "float", 30.0,
+       "min seconds between persisted segment writes", _S)
+define("MINIO_TPU_METACACHE_RECONCILE_S", "float", 300.0,
+       "drift-repair walk cadence, seconds", _S)
+define("MINIO_TPU_METACACHE_SEGMENT_KEYS", "int", 5000,
+       "keys per persisted index segment", _S)
+define("MINIO_TPU_METACACHE_JOURNAL", "int", 100000,
+       "max pending deltas (overflow invalidates the bucket until "
+       "reconcile — never a silent wrong listing)", _S)
+
+_S = "Scan plane"
+define("MINIO_TPU_SCAN_DEVICE", "str", "on",
+       "`on` rides the device when one is present, `off` forces the "
+       "CPU evaluator, `force` dispatches even on CPU backends "
+       "(tests/bench)", _S)
+define("MINIO_TPU_SCAN_PAGE_ROWS", "int", 2048,
+       "rows per tokenized column page (fixed shape = stable jit "
+       "cache)", _S)
+define("MINIO_TPU_SCAN_MAX_STR", "int", 128,
+       "widest cacheable string cell; wider cells decline to CPU", _S)
+define("MINIO_TPU_SCAN_KERNEL_CACHE", "int", 64,
+       "bounded LRU of compiled scan kernels (signatures bake in "
+       "query literals)", _S)
+define("MINIO_TPU_SCAN_MAX_BYTES", "int", 64 << 20,
+       "device-path input cap; bigger objects stream via CPU", _S,
+       display="64 MiB")
+
+_S = "Hot-object cache"
+define("MINIO_TPU_CACHE", "bool", False,
+       "master switch for the erasure-aware read cache", _S)
+define("MINIO_TPU_CACHE_DIR", "str", "",
+       "cache entry directory", _S,
+       display="<first-drive>/.minio.sys/cache")
+define("MINIO_TPU_CACHE_BUDGET_BYTES", "int", 1 << 30,
+       "watermark LRU budget", _S, display="1 GiB")
+define("MINIO_TPU_CACHE_ADMIT", "int", 2,
+       "GETs inside the window before an object is admitted", _S)
+define("MINIO_TPU_CACHE_ADMIT_WINDOW_S", "float", 300.0,
+       "access-frequency admission window, seconds", _S)
+
+_S = "Events"
+define("MINIO_TPU_QUEUE_FSYNC", "bool", False,
+       "fsync durable event-queue writes (survives power loss)", _S)
+
+_S = "Lock watchdog"
+define("MINIO_TPU_LOCKCHECK", "bool", False,
+       "instrument named locks: record the cross-thread acquisition "
+       "graph, fail on order cycles (on under the chaos/concurrency "
+       "suites)", _S)
+define("MINIO_TPU_LOCKCHECK_RAISE", "bool", True,
+       "raise LockOrderError at the acquire that closes a cycle "
+       "(off = record only)", _S)
+define("MINIO_TPU_LOCKCHECK_BLOCK_MS", "float", 200.0,
+       "acquire wait above this while holding another lock is flagged "
+       "held-while-blocking", _S)
+define("MINIO_TPU_LOCKCHECK_HELD_MS", "float", 1000.0,
+       "hold duration above this is flagged as a long hold", _S)
+
+del _S
+
+
+# ---------------------------------------------------------------------------
+# README table generator (tools/check/knobtable.py drift-checks this)
+# ---------------------------------------------------------------------------
+
+TABLE_BEGIN = "<!-- knob-table:begin (generated by tools/check/run.py --write-knob-table) -->"
+TABLE_END = "<!-- knob-table:end -->"
+
+
+def render_table() -> str:
+    """The README knob table, grouped by plane — generated, never
+    hand-edited (the `knob-env` drift check pins it)."""
+    lines: List[str] = []
+    section = None
+    for k in KNOBS.values():
+        if k.section != section:
+            section = k.section
+            if lines:
+                lines.append("")
+            lines.append(f"**{section}**")
+            lines.append("")
+            lines.append("| Knob | Default | Effect |")
+            lines.append("|---|---|---|")
+        lines.append(f"| `{k.name}` | {k.default_display()} | {k.doc} |")
+    return "\n".join(lines) + "\n"

@@ -50,7 +50,6 @@ from __future__ import annotations
 import bisect
 import contextvars
 import math
-import os
 import random
 import re
 import threading
@@ -58,6 +57,8 @@ import time
 import uuid
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from . import knobs
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -243,7 +244,8 @@ class MetricsRegistry:
     catch)."""
 
     def __init__(self) -> None:
-        self._mu = threading.Lock()
+        from . import lockcheck
+        self._mu = lockcheck.mutex("telemetry.registry")
         self._families: Dict[str, _Family] = {}
         self._collectors: List[Callable[[], None]] = []
 
@@ -337,14 +339,14 @@ REGISTRY = MetricsRegistry()
 TRACE_HEADER = "x-minio-trace-id"
 SPAN_HEADER = "x-minio-span-id"
 
-SLOW_S = float(os.environ.get("MINIO_TPU_TRACE_SLOW_MS", "500")) / 1e3
-SAMPLE = float(os.environ.get("MINIO_TPU_TRACE_SAMPLE", "0"))
-KEEP = int(os.environ.get("MINIO_TPU_TRACE_KEEP", "128"))
+SLOW_S = knobs.get_float("MINIO_TPU_TRACE_SLOW_MS") / 1e3
+SAMPLE = knobs.get_float("MINIO_TPU_TRACE_SAMPLE")
+KEEP = knobs.get_int("MINIO_TPU_TRACE_KEEP")
 # spans per TRACE cap: a 10 GiB distributed PUT would otherwise
 # materialize one span per block per drive (~100k objects) and the
 # kept ring would pin all of them; past the budget span() returns the
 # no-op and the root counts what was dropped
-MAX_SPANS = int(os.environ.get("MINIO_TPU_TRACE_MAX_SPANS", "512"))
+MAX_SPANS = knobs.get_int("MINIO_TPU_TRACE_MAX_SPANS")
 
 _current: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("minio_tpu_span", default=None)
@@ -619,7 +621,8 @@ class SpanSink:
 
     def __init__(self, capacity: int = KEEP,
                  slow_s: float = SLOW_S, sample: float = SAMPLE):
-        self._mu = threading.Lock()
+        from . import lockcheck
+        self._mu = lockcheck.mutex("telemetry.spans")
         self.capacity = capacity
         self.slow_s = slow_s
         self.sample = sample

@@ -33,6 +33,82 @@ except Exception:
     pass
 
 
+import threading
+import time
+
+import pytest
+
+# test modules that run with the lock-order watchdog ON by default
+# (opt out with MINIO_TPU_LOCKCHECK=off): the suites that actually
+# interleave threads, so a future lock-order inversion fails loudly in
+# tier-1 instead of hanging a production box
+_LOCKCHECK_MODULES = ("test_chaos", "test_concurrency", "test_lockcheck")
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_watchdog(request):
+    mod = request.module.__name__.rpartition(".")[2]
+    # honor every false spelling the knob vocabulary accepts
+    opted_out = os.environ.get("MINIO_TPU_LOCKCHECK", "").strip().lower() \
+        in ("off", "0", "false", "no")
+    if mod not in _LOCKCHECK_MODULES or opted_out:
+        yield
+        return
+    from minio_tpu.utils import lockcheck
+    prev = os.environ.get("MINIO_TPU_LOCKCHECK")
+    os.environ["MINIO_TPU_LOCKCHECK"] = "on"
+    lockcheck.refresh()
+    lockcheck.reset()
+    try:
+        yield
+        # cycles raised on daemon/background threads are swallowed by
+        # their thread loops — surface them here
+        cycles = lockcheck.violations("cycle")
+        assert not cycles, (
+            "lock-order watchdog recorded cycle(s): "
+            + "; ".join(v.detail for v in cycles))
+    finally:
+        if prev is None:
+            os.environ.pop("MINIO_TPU_LOCKCHECK", None)
+        else:
+            os.environ["MINIO_TPU_LOCKCHECK"] = prev
+        lockcheck.refresh()
+        lockcheck.reset()
+
+
+# process-global worker pools that are CREATED lazily and live for the
+# interpreter's lifetime by design (metadata._POOL drive fan-out,
+# pipeline.PREFETCH_POOL) — the leak sentinel must not blame the first
+# test that happens to touch them
+_LONGLIVED_PREFIXES = ("drive-io", "get-prefetch")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_sentinel():
+    """No stray non-daemon threads may survive a test: a leaked
+    scheduler dispatch pool or cluster worker keeps the interpreter
+    alive after pytest finishes and convoys later tests. Fixtures that
+    start workers must close() them. Daemon threads are exempt (all
+    long-running daemons in-tree are daemonized); so are the
+    process-global lazy pools above."""
+    before = set(threading.enumerate())
+    yield
+    def strays():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive() and not t.daemon
+                and not t.name.startswith(_LONGLIVED_PREFIXES)]
+    s = strays()
+    deadline = time.time() + 2.0
+    while s and time.time() < deadline:
+        for t in s:                 # a finishing worker gets a grace join
+            t.join(timeout=0.25)
+        s = strays()
+    assert not s, (
+        "test leaked non-daemon thread(s): "
+        + ", ".join(sorted(t.name for t in s))
+        + " — the owning fixture must close() its workers")
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

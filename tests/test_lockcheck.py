@@ -1,0 +1,334 @@
+"""Lock-order watchdog: cycle detection, blocking flags, and the PR 6
+mesh-dispatch deadlock shape as a regression test.
+
+The conftest autouse fixture runs this module with the watchdog ON
+(MINIO_TPU_LOCKCHECK=on) — the same wiring the chaos/concurrency
+suites get — so these tests also prove that wiring works.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from minio_tpu.utils import lockcheck
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    lockcheck.reset()
+    yield
+    # cycle-producing tests must not trip the module-level watchdog
+    # assert in conftest
+    lockcheck.reset()
+
+
+def _in_thread(fn):
+    box: list = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced by caller
+            box.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(5)
+    assert not t.is_alive(), "helper thread wedged"
+    return box
+
+
+def test_watchdog_enabled_by_conftest():
+    assert lockcheck.enabled()
+
+
+def test_ab_ba_cycle_detected_and_raised():
+    a = lockcheck.mutex("t.A")
+    b = lockcheck.mutex("t.B")
+    with a:
+        with b:
+            pass
+    # opposite nesting on another thread closes the cycle — detected
+    # from the RECORDED graph, no unlucky interleaving required
+    errs = _in_thread(lambda: _nest(b, a))
+    assert len(errs) == 1 and isinstance(errs[0], lockcheck.LockOrderError)
+    msg = str(errs[0])
+    assert "t.A" in msg and "t.B" in msg and "cycle" in msg
+    kinds = [v.kind for v in lockcheck.violations()]
+    assert "cycle" in kinds
+
+
+def _nest(outer, inner):
+    with outer:
+        with inner:
+            pass
+
+
+def test_same_order_never_flags():
+    a = lockcheck.mutex("t.A")
+    b = lockcheck.mutex("t.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert _in_thread(lambda: _nest(a, b)) == []
+    assert lockcheck.violations() == []
+
+
+def test_reentrant_same_role_is_not_a_cycle():
+    r = lockcheck.rlock("t.R")
+    with r:
+        with r:
+            pass
+    assert lockcheck.violations() == []
+
+
+def test_three_lock_cycle_via_path():
+    a, b, c = (lockcheck.mutex(f"t.{n}") for n in "ABC")
+    _nest(a, b)
+    _nest(b, c)
+    errs = _in_thread(lambda: _nest(c, a))
+    assert errs and isinstance(errs[0], lockcheck.LockOrderError)
+    path = lockcheck.violations("cycle")[0].path
+    assert path[0] == path[-1] or set(path) >= {"t.A", "t.B", "t.C"}
+
+
+def test_record_only_mode(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_LOCKCHECK_RAISE", "off")
+    lockcheck.refresh()
+    try:
+        a = lockcheck.mutex("t.A")
+        b = lockcheck.mutex("t.B")
+        _nest(a, b)
+        assert _in_thread(lambda: _nest(b, a)) == []   # recorded, no raise
+        assert lockcheck.violations("cycle")
+    finally:
+        monkeypatch.setenv("MINIO_TPU_LOCKCHECK_RAISE", "on")
+        lockcheck.refresh()
+
+
+def test_held_while_blocking_flagged(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_LOCKCHECK_BLOCK_MS", "50")
+    lockcheck.refresh()
+    try:
+        outer = lockcheck.mutex("t.outer")
+        contended = lockcheck.mutex("t.contended")
+        release = threading.Event()
+        started = threading.Event()
+
+        def holder():
+            with contended:
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        started.wait(5)
+        with outer:                      # holding outer ...
+            threading.Timer(0.2, release.set).start()
+            with contended:              # ... while blocking >50ms here
+                pass
+        t.join(5)
+        kinds = {v.kind for v in lockcheck.violations()}
+        assert "held-while-blocking" in kinds
+        v = lockcheck.violations("held-while-blocking")[0]
+        assert v.lock == "t.contended" and "t.outer" in v.held
+    finally:
+        lockcheck.refresh()
+
+
+def test_long_hold_flagged(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_LOCKCHECK_HELD_MS", "40")
+    lockcheck.refresh()
+    try:
+        m = lockcheck.mutex("t.slowhold")
+        import time
+        with m:
+            time.sleep(0.1)
+        vs = lockcheck.violations("long-hold")
+        assert vs and vs[0].lock == "t.slowhold"
+    finally:
+        lockcheck.refresh()
+
+
+def test_mutex_self_deadlock_flagged_not_hung():
+    """Re-acquiring a held non-reentrant mutex on the same thread is
+    the simplest deadlock — the inner acquire would block forever
+    BEFORE any recording, so the wrapper flags it up front."""
+    m = lockcheck.mutex("t.self")
+    with m:
+        with pytest.raises(lockcheck.LockOrderError, match="self-deadlock"):
+            m.acquire()
+    # releasable and reusable afterwards
+    with m:
+        pass
+    assert any(v.kind == "cycle" and v.lock == "t.self"
+               for v in lockcheck.violations())
+
+
+def test_condition_is_reentrant_like_threading_default():
+    """lockcheck.condition matches threading.Condition()'s default
+    RLock semantics: nested `with cond:` must not deadlock, and a
+    wait() at depth 2 fully releases so another thread can notify."""
+    c = lockcheck.condition("t.recond")
+    with c:
+        with c:                      # reentrant — plain Condition() allows this
+            pass
+    woke = threading.Event()
+
+    def notifier():
+        with c:
+            c.notify_all()
+
+    with c:
+        with c:
+            threading.Timer(0.05, lambda: threading.Thread(
+                target=notifier).start()).start()
+            assert c.wait(5)         # depth-2 wait releases both levels
+            woke.set()
+    assert woke.is_set()
+    assert lockcheck.violations("cycle") == []
+
+
+def test_cycle_rollback_leaves_lock_usable():
+    """A cycle-raising acquire rolls back fully: the same thread's
+    next legitimate acquire of the (free) mutex must not be a
+    spurious self-deadlock."""
+    a = lockcheck.mutex("t.A")
+    b = lockcheck.mutex("t.B")
+    _nest(a, b)
+
+    def ba():
+        with b:
+            try:
+                a.acquire()
+            except lockcheck.LockOrderError:
+                pass
+            # the rollback released the inner lock and cleared owner:
+            # a plain acquire with nothing held must succeed cleanly
+        with a:
+            pass
+
+    assert _in_thread(ba) == []
+
+
+def test_flip_off_mid_hold_does_not_poison_later_runs():
+    """A lock acquired while the watchdog is on and released after
+    refresh(off) must still unwind its held-stack entry — otherwise
+    this thread 'holds' the role forever in later enabled runs."""
+    import os
+    m = lockcheck.mutex("t.flip")
+    other = lockcheck.mutex("t.other")
+    m.acquire()
+    os.environ["MINIO_TPU_LOCKCHECK"] = "off"
+    lockcheck.refresh()
+    m.release()                      # watchdog off: must still pop
+    os.environ["MINIO_TPU_LOCKCHECK"] = "on"
+    lockcheck.refresh()
+    lockcheck.reset()
+    with other:                      # no phantom t.flip -> t.other edge
+        pass
+    assert lockcheck.graph() == {}
+    assert lockcheck.violations() == []
+
+
+def test_condition_wait_drops_hold():
+    """cond.wait releases the underlying lock through the checked
+    protocol: another thread can acquire mid-wait, and no
+    held-while-blocking/long-hold accrues against the waiter."""
+    c = lockcheck.condition("t.cond")
+    entered = threading.Event()
+
+    def waker():
+        entered.wait(5)
+        with c:
+            c.notify_all()
+
+    t = threading.Thread(target=waker)
+    t.start()
+    with c:
+        entered.set()
+        assert c.wait(5)
+    t.join(5)
+    assert lockcheck.violations("cycle") == []
+
+
+def test_disabled_watchdog_records_nothing(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_LOCKCHECK", "off")
+    lockcheck.refresh()
+    try:
+        a = lockcheck.mutex("t.A")
+        b = lockcheck.mutex("t.B")
+        _nest(a, b)
+        assert _in_thread(lambda: _nest(b, a)) == []
+        assert lockcheck.violations() == []
+    finally:
+        monkeypatch.setenv("MINIO_TPU_LOCKCHECK", "on")
+        lockcheck.refresh()
+
+
+# ---------------------------------------------------------------------------
+# the PR 6 regression: concurrent mesh dispatch
+# ---------------------------------------------------------------------------
+
+def test_mesh_dispatch_cycle_shape_regression():
+    """The PR 6 incident shape: the batch former's collector enters the
+    serialized mesh-dispatch critical section while holding scheduler
+    state, and a scheduler-bypass caller inside the dispatch section
+    calls back into scheduler state (stats/occupancy). Before the
+    watchdog this deadlocked an unlucky interleaving of the saturation
+    A/B; now the second nesting order is flagged the FIRST time it is
+    recorded, interleaving or not."""
+    sched_mu = lockcheck.mutex("sched.buckets")
+    dispatch_mu = lockcheck.mutex("mesh.dispatch")
+
+    # thread 1 — the former: scheduler bookkeeping, then device launch
+    def former():
+        with sched_mu:
+            with dispatch_mu:
+                pass                      # mesh_put_batch(...)
+
+    assert _in_thread(former) == []
+
+    # thread 2 — the bypass caller: inside the dispatch guard, reads
+    # scheduler occupancy (stats() takes the scheduler lock)
+    def bypass():
+        with dispatch_mu:
+            with sched_mu:
+                pass                      # scheduler.stats()
+
+    errs = _in_thread(bypass)
+    assert errs and isinstance(errs[0], lockcheck.LockOrderError)
+    v = lockcheck.violations("cycle")[0]
+    assert {"sched.buckets", "mesh.dispatch"} <= set(v.path)
+
+
+def test_real_scheduler_and_metacache_clean_under_watchdog(tmp_path):
+    """In-situ negative test: the instrumented production locks
+    (scheduler buckets/kick, metacache cond, bpool, MRF queue) run a
+    real submit/record/drain workload under the watchdog without a
+    single cycle — the tree's lock orders are consistent."""
+    import numpy as np
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.parallel.bpool import BytePool
+    from minio_tpu.object.codec import Codec
+
+    sched = BatchScheduler(max_batch=8, max_wait=0.001)
+    try:
+        codec = Codec(2, 1, 1 << 12)
+        from minio_tpu import bitrot as bitrot_mod
+        futs = [sched.submit(codec,
+                             np.zeros((1, 2, 1 << 11), np.uint8),
+                             bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256)
+                for _ in range(4)]
+        for f in futs:
+            f.result(5)        # CPU host declines or dispatches — either way resolves
+        pool = BytePool(1 << 10, 2)
+        b1 = pool.get(1)
+        pool.put(b1)
+        sched.stats()
+    finally:
+        sched.close()
+    assert lockcheck.violations("cycle") == []

@@ -1,0 +1,132 @@
+"""check.core — shared machinery of the project-invariant linter.
+
+The linter is AST-based and project-specific: its rules encode the
+invariants review rounds kept re-catching by hand (blocking work under
+hot locks, per-call metric-family resolution, raw env knob reads,
+mutation verbs that forget their hooks, error codes missing from the
+S3 table). Rules live in `rules_ast.py` (per-file) and
+`rules_project.py` (cross-file); `run.py` is the CLI gate.
+
+Suppression: a violation is silenced by a ``# check: allow(rule-id)``
+comment on the SAME line or the line directly above — the comment is
+the inline argument the review would otherwise have to make, so bare
+suppressions without a trailing reason are themselves flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG_ROOT = os.path.join(REPO, "minio_tpu")
+
+RULES = ("lock-blocking", "metrics-hygiene", "knob-env",
+         "hook-coverage", "error-map")
+
+_ALLOW_RE = re.compile(r"#\s*check:\s*allow\(([a-z\-]+(?:\s*,\s*[a-z\-]+)*)\)"
+                       r"(.*)$")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str        # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class Source:
+    """One parsed file: text, AST, and the allow()-comment map."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        # line -> set of allowed rule ids on that line
+        self.allowed: Dict[int, Set[str]] = {}
+        self.bare_allows: List[int] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            self.allowed[i] = rules
+            if not m.group(2).strip():
+                # an allow() with no trailing reason is a suppression
+                # without an argument — the review the comment replaces
+                self.bare_allows.append(i)
+
+    def is_allowed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            if rule in self.allowed.get(ln, ()):
+                return True
+        return False
+
+
+def load_sources(root: str = PKG_ROOT) -> List[Source]:
+    out: List[Source] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            out.append(Source(path, rel, text))
+    return out
+
+
+def dotted(node: ast.AST) -> str:
+    """'os.path.getsize' for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def enclosing_functions(tree: ast.AST) -> Dict[ast.AST, Optional[ast.AST]]:
+    """node -> nearest enclosing FunctionDef/AsyncFunctionDef (None at
+    module/class scope)."""
+    out: Dict[ast.AST, Optional[ast.AST]] = {}
+
+    def walk(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            out[child] = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, child)
+            else:
+                walk(child, fn)
+
+    walk(tree, None)
+    return out
+
+
+def filter_allowed(src: Source, vs: Iterable[Violation]) -> List[Violation]:
+    return [v for v in vs if not src.is_allowed(v.rule, v.line)]

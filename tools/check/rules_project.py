@@ -1,0 +1,234 @@
+"""Cross-file rules: mutation-hook coverage and error-map completeness."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Source, Violation, dotted, str_const
+
+# ---------------------------------------------------------------------------
+# rule: hook-coverage
+# ---------------------------------------------------------------------------
+
+# Engine files whose classes form THE mutation surface (MultipartMixin
+# subclasses ErasureObjects; methods merge into one verb map).
+HOOK_FILES = ("minio_tpu/object/engine.py",
+              "minio_tpu/object/multipart.py")
+HOOK_CLASSES = ("ErasureObjects", "MultipartMixin")
+
+# every successful namespace mutation must reach the metacache/cache
+# delta feed
+NAMESPACE_VERBS = (
+    "put_object", "update_object_metadata", "transition_object",
+    "put_stub_version", "delete_object", "put_delete_marker",
+    "delete_objects", "complete_multipart_upload",
+)
+NAMESPACE_HOOK = "_notify_namespace"
+
+# every quorum-successful-but-degraded write must feed the MRF queue
+DEGRADED_VERBS = (
+    "put_object", "update_object_metadata", "transition_object",
+    "put_stub_version", "delete_object", "put_delete_marker",
+    "delete_objects", "complete_multipart_upload",
+)
+DEGRADED_HOOKS = ("_notify_degraded", "_flag_degraded_delete")
+
+_MAX_DEPTH = 3
+
+
+def _class_methods(sources: List[Source]) -> Dict[str, ast.FunctionDef]:
+    methods: Dict[str, ast.FunctionDef] = {}
+    by_rel = {s.rel: s for s in sources}
+    for rel in HOOK_FILES:
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name in HOOK_CLASSES:
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        methods.setdefault(item.name, item)
+    return methods
+
+
+def _self_calls(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                dotted(node.func.value) == "self":
+            out.add(node.func.attr)
+    return out
+
+
+def _reaches(methods: Dict[str, ast.FunctionDef], start: str,
+             targets: Set[str], depth: int = _MAX_DEPTH) -> bool:
+    seen: Set[str] = set()
+    frontier = {start}
+    for _ in range(depth):
+        nxt: Set[str] = set()
+        for name in frontier:
+            fn = methods.get(name)
+            if fn is None or name in seen:
+                continue
+            seen.add(name)
+            calls = _self_calls(fn)
+            if calls & targets:
+                return True
+            nxt |= calls
+        frontier = nxt - seen
+        if not frontier:
+            return False
+    return False
+
+
+def check_hook_coverage(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    methods = _class_methods(sources)
+    by_rel = {s.rel: s for s in sources}
+
+    def src_of(fn: ast.FunctionDef) -> str:
+        # find which hook file holds this def (line collision is
+        # irrelevant — message only)
+        for rel in HOOK_FILES:
+            src = by_rel.get(rel)
+            if src and any(n is fn for n in ast.walk(src.tree)):
+                return rel
+        return HOOK_FILES[0]
+
+    for verb in NAMESPACE_VERBS:
+        fn = methods.get(verb)
+        if fn is None:
+            out.append(Violation(
+                "hook-coverage", HOOK_FILES[0], 1,
+                f"configured mutation verb {verb}() not found — "
+                "update NAMESPACE_VERBS in tools/check"))
+            continue
+        if not _reaches(methods, verb, {NAMESPACE_HOOK}):
+            out.append(Violation(
+                "hook-coverage", src_of(fn), fn.lineno,
+                f"mutation verb {verb}() never fires "
+                f"{NAMESPACE_HOOK}() — the metacache/cache delta feed "
+                "misses this mutation (stale listings + stale cache)"))
+    for verb in DEGRADED_VERBS:
+        fn = methods.get(verb)
+        if fn is None:
+            continue            # already reported above
+        if not _reaches(methods, verb, set(DEGRADED_HOOKS)):
+            out.append(Violation(
+                "hook-coverage", src_of(fn), fn.lineno,
+                f"write verb {verb}() never fires on_degraded_write "
+                f"(via {' / '.join(DEGRADED_HOOKS)}) — a degraded "
+                "quorum write waits for the scanner instead of MRF"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: error-map
+# ---------------------------------------------------------------------------
+
+API_ERRORS = "minio_tpu/object/api_errors.py"
+S3_ERRORS = "minio_tpu/s3/s3errors.py"
+
+
+def _api_error_classes(src: Source) -> Dict[str, int]:
+    """name -> lineno of every (transitive) ObjectApiError subclass."""
+    bases: Dict[str, List[str]] = {}
+    lines: Dict[str, int] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            bases[node.name] = [dotted(b) for b in node.bases]
+            lines[node.name] = node.lineno
+
+    def is_api_err(name: str, seen: Set[str]) -> bool:
+        if name == "ObjectApiError":
+            return True
+        if name in seen:
+            return False
+        seen.add(name)
+        return any(is_api_err(b, seen) for b in bases.get(name, ()))
+
+    return {n: lines[n] for n in bases
+            if n != "ObjectApiError" and is_api_err(n, set())}
+
+
+def check_error_map(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    by_rel = {s.rel: s for s in sources}
+    api = by_rel.get(API_ERRORS)
+    s3 = by_rel.get(S3_ERRORS)
+    if api is None or s3 is None:
+        return [Violation("error-map", API_ERRORS, 1,
+                          "api_errors.py / s3errors.py not found")]
+    classes = _api_error_classes(api)
+
+    table_keys: Set[str] = set()
+    mapped: Dict[str, str] = {}       # class name -> code
+    internal: Set[str] = set()
+    map_line = 1
+    for node in ast.walk(s3.tree):
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt, value = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, value = node.targets[0], node.value
+        else:
+            continue
+        tname = tgt.id if isinstance(tgt, ast.Name) else ""
+        if tname == "ERROR_TABLE" and isinstance(value, ast.Dict):
+            for k in value.keys:
+                s = str_const(k)
+                if s:
+                    table_keys.add(s)
+        elif tname == "INTERNAL_ONLY" and \
+                isinstance(value, (ast.Tuple, ast.List)):
+            for el in value.elts:
+                d = dotted(el)
+                if d:
+                    internal.add(d.split(".")[-1])
+        elif tname == "mapping" and \
+                isinstance(value, (ast.List, ast.Tuple)):
+            map_line = node.lineno
+            for el in value.elts:
+                if isinstance(el, ast.Tuple) and len(el.elts) == 2:
+                    cls = dotted(el.elts[0])
+                    code = str_const(el.elts[1])
+                    if cls.startswith("oerr.") and code:
+                        mapped[cls.split(".")[-1]] = code
+
+    # `mapping` may be a local inside api_error_from
+    for name, line in sorted(classes.items()):
+        if name not in mapped and name not in internal:
+            out.append(Violation(
+                "error-map", API_ERRORS, line,
+                f"{name} has no api_error_from mapping in s3errors.py "
+                "and is not declared INTERNAL_ONLY — it would surface "
+                "as a 500 InternalError"))
+    for cls, code in sorted(mapped.items()):
+        if code not in table_keys:
+            out.append(Violation(
+                "error-map", S3_ERRORS, map_line,
+                f"mapping for {cls} names code {code!r} which is not "
+                "in ERROR_TABLE"))
+    for name in sorted(internal):
+        if name not in classes:
+            out.append(Violation(
+                "error-map", S3_ERRORS, map_line,
+                f"INTERNAL_ONLY names {name!r} which is not an "
+                "api_errors class"))
+
+    # every literal S3Error("Code") raised anywhere must be in the table
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and node.args:
+                d = dotted(node.func)
+                if d.split(".")[-1] == "S3Error":
+                    code = str_const(node.args[0])
+                    if code and code not in table_keys:
+                        out.append(Violation(
+                            "error-map", src.rel, node.lineno,
+                            f"S3Error({code!r}) — code missing from "
+                            "ERROR_TABLE (clients would get a bare "
+                            "500 with no usable code)"))
+    return out

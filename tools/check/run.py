@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""check/run — the correctness-analysis CI gate.
+
+Runs the project-invariant linter over ``minio_tpu/`` and exits nonzero
+on any violation, mirroring ``tools/tier1_diff.py``'s role for tests:
+
+    python tools/check/run.py              # full gate
+    python tools/check/run.py --json -     # machine-readable report
+    python tools/check/run.py --rule lock-blocking
+    python tools/check/run.py --write-knob-table   # regen README table
+
+Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
+
+  lock-blocking     no disk I/O / RPC / device dispatch / sleeps /
+                    future waits inside `with <mutex>:` in hot modules
+  metrics-hygiene   families resolved at init scope, Counters end in
+                    _total, one kind+help per name, consistent labels
+  knob-env          MINIO_TPU_* env reads only via utils/knobs.py;
+                    getter names must be registered; README table fresh
+  hook-coverage     engine mutation verbs fire on_namespace_change and
+                    on_degraded_write
+  error-map         every api_errors class mapped in s3errors (or
+                    INTERNAL_ONLY); every referenced code in ERROR_TABLE
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __package__ in (None, ""):                     # `python tools/check/run.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from check import core, knobtable, rules_ast, rules_project
+else:
+    from . import core, knobtable, rules_ast, rules_project
+
+
+def _group_by_path(violations):
+    groups = {}
+    for v in violations:
+        groups.setdefault(v.path, []).append(v)
+    return groups
+
+
+def run_checks(rules=None):
+    """All violations after suppression filtering, plus the sources."""
+    sources = core.load_sources()
+    by_rel = {s.rel: s for s in sources}
+    selected = set(rules or core.RULES)
+    vs = []
+    if "lock-blocking" in selected:
+        vs += rules_ast.check_lock_blocking(sources)
+    if "metrics-hygiene" in selected:
+        vs += rules_ast.check_metrics_hygiene(sources)
+    if "knob-env" in selected:
+        registered = set(knobtable.load_knobs().KNOBS)
+        vs += rules_ast.check_knob_env(sources, registered)
+        vs += knobtable.check_drift()
+    if "hook-coverage" in selected:
+        vs += rules_project.check_hook_coverage(sources)
+    if "error-map" in selected:
+        vs += rules_project.check_error_map(sources)
+    out = []
+    for rel, group in _group_by_path(vs).items():
+        src = by_rel.get(rel)
+        out.extend(core.filter_allowed(src, group) if src else group)
+    # a suppression with no stated reason is itself a violation — the
+    # comment IS the inline argument a suppression must make
+    for src in sources:
+        for ln in src.bare_allows:
+            rule = sorted(src.allowed.get(ln, {"lock-blocking"}))[0]
+            if rule in selected:
+                out.append(core.Violation(
+                    rule, src.rel, ln,
+                    "check: allow() without a reason — state the "
+                    "argument inline after the closing paren"))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out, sources
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="check/run")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write a machine-readable report to PATH "
+                    "('-' = stdout) — mirrors tier1_diff.py --json")
+    ap.add_argument("--rule", action="append", choices=core.RULES,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help="regenerate the README knob table from the "
+                    "registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.write_knob_table:
+        changed = knobtable.write_table()
+        print("README knob table "
+              + ("updated" if changed else "already fresh"))
+        return 0
+
+    violations, sources = run_checks(args.rule)
+    per_rule: dict = {}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    if args.json:
+        report = json.dumps({
+            "files_scanned": len(sources),
+            "violations": [v.to_dict() for v in violations],
+            "per_rule": per_rule,
+            "gate": "fail" if violations else "pass",
+        }, indent=2)
+        if args.json == "-":
+            print(report)
+        else:
+            with open(args.json, "w") as f:
+                f.write(report + "\n")
+    for v in violations:
+        print(v)
+    print(f"check: {len(sources)} files, {len(violations)} "
+          f"violation(s)"
+          + (f" ({', '.join(f'{r}={n}' for r, n in sorted(per_rule.items()))})"
+             if per_rule else ""))
+    if violations:
+        return 1
+    print("gate passes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
