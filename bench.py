@@ -846,6 +846,127 @@ def bench_tier_ab(streams: int = 8, size: int = 4 << 20,
     return out
 
 
+def bench_replicate_ab(streams: int = 8, size: int = 4 << 20,
+                       drives: int = 8, parity: int = 2,
+                       preload: int = 48,
+                       block: int = 1 << 20) -> dict:
+    """Foreground-PUT latency with vs without an active replication
+    resync drain (the --ab-rebalance/--ab-tier shape applied to the
+    replication plane): two in-process sites on tmpfs, site A preloaded
+    with resync inventory, identical concurrent PUT rounds timed per-op
+    before and while the resync walker seeds site B. Reports p50/p99
+    per phase, `put_p99_degradation_x` (the shared foreground-pressure
+    throttle keeps it bounded), and the replication lag histogram of
+    the steady-state pushes the foreground PUTs triggered."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.engine import PutOptions
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.replicate import (LayerReplClient, ReplicationPlane,
+                                     SiteTarget, TargetRegistry, new_arn)
+    from minio_tpu.utils import telemetry
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_repl_", dir=base)
+    payload = os.urandom(size)
+    cold_payload = os.urandom(max(size // 2, 1 << 16))
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "drives": drives, "m": parity,
+                            "preload": preload}}
+    try:
+        def mk_site(name: str):
+            sets = ErasureSets.from_drives(
+                [f"{root}/{name}/d{i}" for i in range(drives)], 1,
+                drives, parity, block_size=block, enable_mrf=False)
+            layer = ErasureServerSets([sets], load_topology=False)
+            layer.make_bucket("bench")
+            return layer
+
+        src = mk_site("a")
+        dst = mk_site("b")
+        reg = TargetRegistry(src, site_id="bench-a")
+        plane = ReplicationPlane(src, reg)
+        src.attach_replication(plane)
+        for i in range(preload):                # resync inventory
+            src.put_object("bench", f"cold-{i}", cold_payload,
+                           opts=PutOptions(versioned=True))
+
+        def put_round(prefix: str) -> list[float]:
+            lat: list[float] = []
+            mu = threading.Lock()
+
+            def one(i: int) -> None:
+                t0 = time.perf_counter()
+                src.put_object("bench", f"{prefix}{i}", payload,
+                               opts=PutOptions(versioned=True))
+                dt = time.perf_counter() - t0
+                with mu:
+                    lat.append(dt)
+
+            with cf.ThreadPoolExecutor(max_workers=streams) as ex:
+                list(ex.map(one, range(streams)))
+            return lat
+
+        def pcts(lat: list[float]) -> dict:
+            xs = sorted(lat)
+            return {"p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                    "p99_ms": round(xs[max(0, int(len(xs) * 0.99) - 1)]
+                                    * 1e3, 2)}
+
+        put_round("warm")                        # warm the path
+        baseline = put_round("base") + put_round("base2")
+        out["baseline"] = pcts(baseline)
+
+        # register the target + start the resync drain, then measure
+        # foreground PUTs racing it (their own steady-state pushes ride
+        # the plane concurrently)
+        arn = new_arn("bench")
+        reg.add(SiteTarget(arn=arn, bucket="bench", dest_bucket="bench",
+                           site="bench-b", type="layer"),
+                client=LayerReplClient(dst, "bench", "bench-b"))
+        resync = plane.start_resync(arn, checkpoint_every=1000)
+        during = put_round("dr") + put_round("dr2")
+        out["during_resync"] = pcts(during)
+        out["resync_status_at_measure"] = resync.status()
+        for _ in range(600):
+            if not resync.running():
+                break
+            time.sleep(0.1)
+        plane.drain(120)
+        out["resync_final"] = resync.status()
+        out["plane_final"] = plane.stats()
+        out["put_p99_degradation_x"] = round(
+            out["during_resync"]["p99_ms"]
+            / max(out["baseline"]["p99_ms"], 1e-9), 3)
+        # replication lag histogram (steady-state pushes of the
+        # foreground PUTs): bucketed counts straight off the registry
+        hist = telemetry.REGISTRY.histogram("minio_tpu_repl_lag_seconds")
+        series = None
+        with hist._mu:
+            for _k, s in hist._series.items():
+                series = {"buckets_s": list(hist.buckets),
+                          "counts": list(s.counts),
+                          "count": s.count,
+                          "mean_s": round(s.total / s.count, 4)
+                          if s.count else 0.0}
+        out["lag_histogram"] = series or {}
+        plane.close()
+        src.close()
+        dst.close()
+    finally:
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_list_ab(keys: int = 10000, drives: int = 8, parity: int = 2,
                   page: int = 1000, versions_every: int = 20,
                   payload_bytes: int = 16) -> dict:
@@ -1315,6 +1436,15 @@ def main() -> int:
                     help="run ONLY the tier-transition-throttle A/B "
                          "(foreground PUT p50/p99 with vs without the "
                          "transition worker draining to a tier)")
+    ap.add_argument("--ab-replicate", action="store_true",
+                    help="run ONLY the replication A/B (foreground PUT "
+                         "p50/p99 with vs without an active resync "
+                         "drain to a second in-process site, plus the "
+                         "replication lag histogram)")
+    ap.add_argument("--ab-replicate-smoke", action="store_true",
+                    help="tiny replication A/B (2 streams, 256 KiB "
+                         "objects, 8-key resync) for CI — seconds, "
+                         "not minutes")
     args = ap.parse_args()
 
     if args.saturation or args.saturation_smoke:
@@ -1383,6 +1513,23 @@ def main() -> int:
             "value": ab.get("speedup_x"),
             "unit": "x",
             "cache_ab": ab,
+        }))
+        return 0
+
+    if args.ab_replicate or args.ab_replicate_smoke:
+        if args.ab_replicate_smoke:
+            ab = bench_replicate_ab(streams=2, size=1 << 18, drives=6,
+                                    preload=8, block=1 << 16)
+        else:
+            ab = bench_replicate_ab(streams=min(args.ab_streams, 8),
+                                    size=args.ab_size)
+        print(json.dumps({
+            "metric": "foreground PUT p99 degradation with an active "
+                      "replication resync drain (active-active plane "
+                      "throttle A/B)",
+            "value": ab.get("put_p99_degradation_x"),
+            "unit": "x",
+            "replicate_ab": ab,
         }))
         return 0
 

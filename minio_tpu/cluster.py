@@ -310,7 +310,7 @@ class ClusterNode:
                 pass              # bad endpoint: federation stays off
 
         # -- live bucket features (events, replication, lifecycle) ---------
-        from .features import EventNotifier, ReplicationPool
+        from .features import EventNotifier
         from .features.lifecycle import (crawler_action, mpu_abort_action,
                                          noncurrent_sweep_action)
         # durable event backlog lives under the node's first local
@@ -321,11 +321,26 @@ class ClusterNode:
         self.events = EventNotifier(self.s3.api.bucket_meta,
                                     queue_dir=_evq)
         self.s3.api.events = self.events
-        _rpq = os.path.join(self.spec.drives[0], ".minio.sys",
-                            "replication") if self.spec.drives else None
-        self.replication = ReplicationPool(self.object_layer,
-                                           self.s3.api.bucket_meta,
-                                           queue_dir=_rpq)
+        # active-active replication plane (minio_tpu/replicate/): the
+        # epoch-versioned target registry recovers from every pool
+        # (highest epoch wins — targets survive decommission), the
+        # plane rides the engine namespace-change feed so EVERY
+        # mutation verb reaches the replication queue
+        from .replicate import ReplicationPlane, TargetRegistry
+        self.repl_targets = TargetRegistry(self.object_layer)
+        try:
+            if not self.repl_targets.load():
+                # first boot: persist the minted site id so replicas
+                # pushed before and after a restart carry ONE origin
+                self.repl_targets.save()
+        except Exception:  # noqa: BLE001 — boot proceeds; admin re-adds
+            pass
+        self.replication = ReplicationPlane(self.object_layer,
+                                            self.repl_targets,
+                                            bucket_meta=self.s3.api.
+                                            bucket_meta)
+        self.replication.bandwidth = self.s3.api.bandwidth
+        self.object_layer.attach_replication(self.replication)
         try:
             buckets = [v.name for v in self.object_layer.list_buckets()]
         except Exception as e:  # noqa: BLE001 — boot must proceed, but
@@ -333,7 +348,15 @@ class ClusterNode:
             self.console.log_line(
                 "ERROR", f"replication target mount skipped: {e}")
             buckets = []
-        self.replication.mount_persisted_targets(buckets)
+        # legacy bucket-metadata remote targets mount into the registry
+        for b in buckets:
+            try:
+                for entry in self.s3.api.bucket_meta.get(
+                        b).replication_targets:
+                    entry = dict(entry, source_bucket=b)
+                    self.replication.mount_target_entry(entry)
+            except Exception:  # noqa: BLE001 — per-bucket best effort
+                continue
         # service restart/stop: peers run the same local action the
         # admin endpoint runs — DEFERRED so the RPC reply reaches the
         # broadcaster before this process exec-restarts
@@ -421,6 +444,9 @@ class ClusterNode:
                                           transition_action)
             self.transition_worker = TransitionWorker(
                 self.object_layer, self.tiers).start()
+            # async RestoreObject (202 + background pull) rides the
+            # same worker, throttled with the transitions
+            self.s3.api.restore_worker = self.transition_worker
             # one crawler per cluster (first node), like the reference's
             # leader-ish crawler cadence; usage cache feeds quota and the
             # crawler enforces lifecycle expiry + ILM transitions

@@ -189,7 +189,8 @@ class StorageRPCServer:
     def _renamedata(self, a, b):
         self._disk(a).rename_data(a["src-volume"], a["src-path"],
                                   a["data-dir"], a["dst-volume"],
-                                  a["dst-path"])
+                                  a["dst-path"],
+                                  a.get("version-id", ""))
 
     def _listdir(self, a, b):
         return self._disk(a).list_dir(a["volume"], a.get("dir-path", ""),
@@ -439,11 +440,12 @@ class RemoteStorage(StorageAPI):
         return errs
 
     def rename_data(self, src_volume: str, src_path: str, data_dir: str,
-                    dst_volume: str, dst_path: str) -> None:
+                    dst_volume: str, dst_path: str,
+                    version_id: str = "") -> None:
         self._call("renamedata", {
             "src-volume": src_volume, "src-path": src_path,
             "data-dir": data_dir, "dst-volume": dst_volume,
-            "dst-path": dst_path})
+            "dst-path": dst_path, "version-id": version_id})
 
     # -- files -------------------------------------------------------------
 

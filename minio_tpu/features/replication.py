@@ -1,6 +1,16 @@
-"""Bucket replication: async copy of object mutations to a remote S3
-target (reference cmd/bucket-replication.go replicateObject/mustReplicate
-+ cmd/bucket-targets.go).
+"""LEGACY one-way bucket replication (reference
+cmd/bucket-replication.go replicateObject/mustReplicate +
+cmd/bucket-targets.go).
+
+The production multi-site story now lives in ``minio_tpu/replicate/``:
+bidirectional active-active sync riding the engine namespace-change
+feed, with loop suppression, deterministic conflict resolution,
+version-faithful replay, resync seeding and MRF-style retry — cluster
+boot wires THAT plane. This module remains as (a) the replication
+CONFIG surface (``ReplicationConfig``/``ReplicationRule`` XML parsing,
+which the new plane consults to gate keys per bucket rule) and (b) a
+standalone fire-and-forget copier for embedders that want the simple
+one-way shape.
 
 A replication config (XML) names a destination bucket ARN; a target
 registry maps ARNs to S3 endpoints+credentials. Every PUT/DELETE that

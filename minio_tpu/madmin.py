@@ -273,6 +273,51 @@ class AdminClient:
     def get_bucket_quota(self, bucket: str) -> dict:
         return self._json("GET", "get-bucket-quota", {"bucket": bucket})
 
+    # -- active-active replication (minio_tpu/replicate/) ------------------
+
+    def replicate_status(self) -> dict:
+        """Site id, persisted target registry, plane stats, resync."""
+        return self._json("GET", "replicate")
+
+    def replicate_key_versions(self, bucket: str, key: str) -> dict:
+        """Every version of one key as replayable specs (the peer-sync
+        read HTTPReplClient drives)."""
+        return self._json("GET", "replicate/key",
+                          {"bucket": bucket, "key": key})
+
+    def add_replicate_target(self, bucket: str, host: str, port: int,
+                             dest_bucket: str, access_key: str,
+                             secret_key: str, prefix: str = "",
+                             bw_bps: int = 0, arn: str = "",
+                             update: bool = False) -> str:
+        """Register an active-active wire target; returns its ARN.
+        Updating an existing target requires passing its `arn` back
+        (the server mints a fresh one otherwise, which would register
+        a duplicate instead of replacing)."""
+        doc = {"bucket": bucket, "dest_bucket": dest_bucket,
+               "prefix": prefix, "bw_bps": bw_bps, "type": "s3",
+               "params": {"host": host, "port": port,
+                          "access_key": access_key,
+                          "secret_key": secret_key}}
+        if arn:
+            doc["arn"] = arn
+        out = self._json("PUT", "replicate/target",
+                         {"update": "true"} if update else None,
+                         json.dumps(doc).encode())
+        return out["arn"]
+
+    def remove_replicate_target(self, arn: str) -> None:
+        self._request("DELETE", "replicate/target", {"arn": arn})
+
+    def start_replicate_resync(self, arn: str) -> dict:
+        return self._json("POST", "replicate/resync", {"arn": arn})
+
+    def replicate_resync_status(self) -> dict:
+        return self._json("GET", "replicate/resync")
+
+    def cancel_replicate_resync(self) -> dict:
+        return self._json("DELETE", "replicate/resync")
+
     def set_remote_target(self, bucket: str, host: str, port: int,
                           target_bucket: str, access_key: str,
                           secret_key: str, region: str = "us-east-1"

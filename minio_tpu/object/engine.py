@@ -61,7 +61,8 @@ class PutOptions:
     def __init__(self, metadata: Optional[dict] = None,
                  version_id: str = "", versioned: bool = False,
                  parity: Optional[int] = None,
-                 mod_time: Optional[float] = None):
+                 mod_time: Optional[float] = None,
+                 if_none_newer: bool = False):
         self.metadata = dict(metadata or {})
         self.version_id = version_id
         self.versioned = versioned
@@ -70,6 +71,13 @@ class PutOptions:
         # preserve the object's original Last-Modified instead of
         # stamping the move time
         self.mod_time = mod_time
+        # replication apply of the UNVERSIONED slot: commit only when
+        # no existing null version is (mod_time, version_id)-newer —
+        # evaluated INSIDE the per-key write lock, so a client write
+        # racing the apply can never be clobbered by an older replica
+        # (PreConditionFailed otherwise; the check-then-put a caller
+        # could do itself is a TOCTOU hole)
+        self.if_none_newer = if_none_newer
 
 
 class GetOptions:
@@ -313,6 +321,8 @@ class ErasureObjects:
             with stagetimer.stage("put.lock+commit"):
                 with self.ns.new_lock(
                         f"{bucket}/{object_name}").write_locked():
+                    if opts.if_none_newer:
+                        self._check_none_newer(bucket, object_name, fi)
                     lost = self._commit(shuffled, writers, tmp_id, fi,
                                         bucket, object_name, write_quorum)
         except Exception:
@@ -324,6 +334,27 @@ class ErasureObjects:
             self._notify_degraded(bucket, object_name, fi.version_id)
         self._notify_namespace(bucket, object_name)
         return fi.to_object_info(bucket, object_name)
+
+    def _check_none_newer(self, bucket: str, object_name: str,
+                          fi: FileInfo) -> None:
+        """The if_none_newer commit gate (caller holds the write
+        lock): an existing version in the same slot that wins the
+        deterministic (mod_time, version_id, etag) conflict rule
+        aborts the commit — the replication apply's atomic
+        last-writer-wins. The etag tie-break keeps two sites that
+        wrote DIFFERENT bytes at the same instant convergent (a full
+        tie is identical content, so either copy is fine)."""
+        for cur in self._merged_versions(bucket, object_name):
+            if (cur.version_id or "") != (fi.version_id or ""):
+                continue
+            cur_key = (cur.mod_time or 0, cur.version_id or "",
+                       cur.metadata.get("etag", ""))
+            new_key = (fi.mod_time or 0, fi.version_id or "",
+                       fi.metadata.get("etag", ""))
+            if cur_key >= new_key:
+                raise api_errors.PreConditionFailed(
+                    f"{bucket}/{object_name}: existing version is newer")
+            return
 
     def _encode_stream(self, reader, codec: Codec, writers,
                        write_quorum: int, bucket: str,
@@ -860,7 +891,8 @@ class ErasureObjects:
         return fi.to_object_info(bucket, object_name)
 
     def put_stub_version(self, bucket: str, object_name: str,
-                         info: ObjectInfo) -> ObjectInfo:
+                         info: ObjectInfo,
+                         if_none_newer: bool = False) -> ObjectInfo:
         """Write a transitioned ZERO-DATA stub version from its
         API-facing ObjectInfo — the rebalance copy path for tiered
         objects (there are no local shards to move; only the xl.meta
@@ -892,6 +924,10 @@ class ErasureObjects:
         if not fi.parts:
             fi.add_object_part(1, info.etag, info.size, info.size)
         with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+            if if_none_newer:
+                # the replication apply's unversioned conflict gate —
+                # an older stub replica must not shadow a newer write
+                self._check_none_newer(bucket, object_name, fi)
             metas = [fi.light_copy() for _ in range(len(self.disks))]
             online = meta.write_unique_file_info(
                 self.disks, bucket, object_name, metas, write_quorum)
@@ -1344,15 +1380,18 @@ class ErasureObjects:
 
     def put_delete_marker(self, bucket: str, object_name: str,
                           version_id: str = "",
-                          mod_time: Optional[float] = None) -> ObjectInfo:
+                          mod_time: Optional[float] = None,
+                          metadata: Optional[dict] = None) -> ObjectInfo:
         """Replicate a delete marker with an EXPLICIT version id and mod
         time — the rebalance/replication copy path (delete_object always
         mints fresh ids, which would break version-history fidelity when
-        a marker moves between pools)."""
+        a marker moves between pools). `metadata` carries replication
+        markers (the replica-origin key) on the marker version itself."""
         _k, _m, _, write_quorum = self._default_quorums()
         fi = FileInfo(volume=bucket, name=object_name,
                       version_id=version_id or str(_uuid.uuid4()),
-                      deleted=True, mod_time=mod_time or now())
+                      deleted=True, mod_time=mod_time or now(),
+                      metadata=dict(metadata or {}))
         with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
             _, errs = meta.for_each_disk(
                 self.disks,
@@ -1518,7 +1557,13 @@ class ErasureObjects:
         read_quorum = self.data_shards
         merged = [picks[key] for key, c in counts.items()
                   if c >= read_quorum]
-        merged.sort(key=lambda fi: (fi.mod_time or 0), reverse=True)
+        # deterministic newest-first order: mod time, then version id —
+        # the active-active conflict rule. Two sites that hold the same
+        # version SET (concurrent writers replicated both ways) must
+        # list them identically, including mod-time ties, or the
+        # convergence contract of the replication plane breaks.
+        merged.sort(key=lambda fi: (fi.mod_time or 0, fi.version_id or ""),
+                    reverse=True)
         return merged
 
     def _merged_names(self, bucket: str, prefix: str,

@@ -1,0 +1,205 @@
+"""Version-faithful object writes through the public layer verbs.
+
+One home for the "replay a version EXACTLY" discipline that three
+planes share — the rebalance pool move pioneered it, the replication
+apply and the tier restore now ride the same helper:
+
+  * identity (version id, mod time, etag) is preserved via the
+    engine's explicit-identity write forms (``PutOptions.mod_time``,
+    ``put_delete_marker``, ``complete_multipart_upload``'s
+    version-faithful kwargs);
+  * **part boundaries survive**: a multipart object replays through a
+    real multipart session (one ``put_object_part`` per source part),
+    so the committed part list matches the source and the recomputed
+    multipart etag (md5-of-part-md5s ``-N``) equals the source etag by
+    construction — a remote site's multipart ETag can be compared
+    against the origin byte-for-byte;
+  * a transitioned zero-data stub replays as METADATA
+    (``put_stub_version``) — never a 0-byte data object;
+  * delete markers replay with their version id, mod time and
+    replication-origin metadata.
+
+The wire form (:class:`VersionSpec`) is a plain dict round-trip so the
+replication HTTP client can carry it in one header.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..storage.datatypes import (ObjectInfo, ObjectPartInfo,
+                                 is_restored, is_transitioned)
+from . import api_errors
+from .engine import PutOptions
+from .multipart import CompletePart
+
+
+@dataclasses.dataclass
+class VersionSpec:
+    """Everything needed to re-create one object version elsewhere,
+    minus the bytes themselves."""
+    version_id: str = ""
+    mod_time: float = 0.0
+    etag: str = ""
+    size: int = 0
+    delete_marker: bool = False
+    # user metadata + content-type/content-encoding, internal keys
+    # (transition pointers, replication origin) included
+    metadata: dict = dataclasses.field(default_factory=dict)
+    # [(number, size, actual_size, etag)] — empty/one entry = single part
+    parts: list = dataclasses.field(default_factory=list)
+
+    @property
+    def transitioned_stub(self) -> bool:
+        return is_transitioned(self.metadata) \
+            and not is_restored(self.metadata)
+
+    def to_dict(self) -> dict:
+        return {"v": self.version_id, "t": self.mod_time, "e": self.etag,
+                "s": self.size, "dm": self.delete_marker,
+                "md": dict(self.metadata),
+                "p": [list(p) for p in self.parts]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VersionSpec":
+        return cls(version_id=str(d.get("v", "") or ""),
+                   mod_time=float(d.get("t", 0.0) or 0.0),
+                   etag=str(d.get("e", "") or ""),
+                   size=int(d.get("s", 0) or 0),
+                   delete_marker=bool(d.get("dm", False)),
+                   metadata=dict(d.get("md") or {}),
+                   parts=[tuple(p) for p in (d.get("p") or [])])
+
+
+def spec_of(info: ObjectInfo) -> VersionSpec:
+    """The replayable identity of one version's ObjectInfo."""
+    md = dict(info.user_defined or {})
+    if info.content_type:
+        md["content-type"] = info.content_type
+    if info.content_encoding:
+        md["content-encoding"] = info.content_encoding
+    parts = [(p.number, p.size,
+              p.actual_size if p.actual_size >= 0 else p.size, p.etag)
+             for p in (info.parts or [])]
+    return VersionSpec(version_id=info.version_id or "",
+                       mod_time=info.mod_time, etag=info.etag,
+                       size=info.size,
+                       delete_marker=bool(info.delete_marker),
+                       metadata=md, parts=parts)
+
+
+class _SegmentReader:
+    """Expose exactly `limit` bytes of an underlying reader as one
+    part's stream (the multipart replay carves the concatenated source
+    stream along the recorded part boundaries)."""
+
+    def __init__(self, inner, limit: int):
+        self.inner = inner
+        self.remaining = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        want = self.remaining if n < 0 else min(n, self.remaining)
+        chunk = self.inner.read(want)
+        self.remaining -= len(chunk)
+        return chunk
+
+
+def stub_object_info(bucket: str, name: str, spec: VersionSpec
+                     ) -> ObjectInfo:
+    """ObjectInfo form of a transitioned stub spec — the
+    put_stub_version input (geometry is re-minted by the target set)."""
+    md = dict(spec.metadata)
+    return ObjectInfo(
+        bucket=bucket, name=name, mod_time=spec.mod_time,
+        size=spec.size, etag=spec.etag, version_id=spec.version_id,
+        content_type=md.pop("content-type", ""),
+        content_encoding=md.pop("content-encoding", ""),
+        user_defined=md,
+        parts=[ObjectPartInfo(number=n, size=s, actual_size=a, etag=e)
+               for n, s, a, e in spec.parts])
+
+
+def replay_version(layer, bucket: str, name: str, spec: VersionSpec,
+                   reader=None,
+                   reader_factory: Optional[Callable] = None,
+                   conflict_gate: Optional[bool] = None) -> ObjectInfo:
+    """Write one version at `layer` with full fidelity. `reader` (or
+    the lazily-invoked `reader_factory`) supplies the version's stored
+    bytes for data versions; markers and transitioned stubs need none.
+
+    `conflict_gate` controls the atomic unversioned last-writer-wins
+    commit gate (PutOptions.if_none_newer): default None applies it to
+    every unversioned data replay (the replication-apply contract); a
+    caller legitimately REWRITING the same identity in place — the tier
+    restore over its own stub — passes False. Raises
+    ReplayEtagMismatch when a replay's recomputed etag disagrees with
+    the spec (bytes corrupted in transit)."""
+    gate = (not spec.version_id) if conflict_gate is None \
+        else conflict_gate
+    md = dict(spec.metadata)
+    if spec.delete_marker:
+        return layer.put_delete_marker(bucket, name, spec.version_id,
+                                       spec.mod_time, md)
+    if spec.transitioned_stub:
+        # metadata-only: the remote tier copy stays where it is; the
+        # target must never store (or serve) a 0-byte data object
+        return layer.put_stub_version(bucket, name,
+                                      stub_object_info(bucket, name, spec),
+                                      if_none_newer=gate)
+    if reader is None:
+        if reader_factory is None:
+            raise ValueError("data version replay needs a reader")
+        reader = reader_factory()
+    if len(spec.parts) > 1:
+        return _replay_multipart(layer, bucket, name, spec, reader, md,
+                                 gate)
+    opts = PutOptions(metadata={**md, "etag": spec.etag},
+                      version_id=spec.version_id,
+                      versioned=bool(spec.version_id),
+                      mod_time=spec.mod_time,
+                      # unversioned slot: atomic last-writer-wins under
+                      # the engine's write lock (a concurrent client
+                      # write must never be clobbered by an older
+                      # replica — PreConditionFailed instead)
+                      if_none_newer=gate)
+    return layer.put_object(bucket, name, reader, spec.size, opts)
+
+
+class ReplayEtagMismatch(api_errors.ObjectApiError):
+    """Replayed bytes don't hash to the source version's etag."""
+
+
+def _replay_multipart(layer, bucket: str, name: str, spec: VersionSpec,
+                      reader, md: dict, gate: bool = False) -> ObjectInfo:
+    opts = PutOptions(metadata=md, versioned=bool(spec.version_id))
+    upload_id = layer.new_multipart_upload(bucket, name, opts)
+    try:
+        completes = []
+        for number, size, _actual, part_etag in sorted(spec.parts):
+            pi = layer.put_object_part(bucket, name, upload_id, number,
+                                       _SegmentReader(reader, size), size)
+            if part_etag and pi.etag != part_etag:
+                raise ReplayEtagMismatch(
+                    f"{bucket}/{name} part {number}: got {pi.etag}, "
+                    f"want {part_etag}")
+            completes.append(CompletePart(number, pi.etag))
+        info = layer.complete_multipart_upload(
+            bucket, name, upload_id, completes,
+            version_id=spec.version_id, mod_time=spec.mod_time,
+            # the unversioned slot takes the same atomic conflict gate
+            # the single-part replay uses
+            if_none_newer=gate)
+    except Exception:
+        try:
+            layer.abort_multipart_upload(bucket, name, upload_id)
+        except api_errors.ObjectApiError:
+            pass
+        raise
+    if spec.etag and info.etag != spec.etag:
+        raise ReplayEtagMismatch(
+            f"{bucket}/{name}: multipart etag {info.etag} != source "
+            f"{spec.etag}")
+    return info

@@ -25,7 +25,8 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
-from ..storage.datatypes import ChecksumInfo, FileInfo, ObjectInfo, now
+from ..storage.datatypes import (NULL_VERSION_ID, ChecksumInfo, FileInfo,
+                                 ObjectInfo, now)
 from ..storage.xl_storage import (MINIO_META_MULTIPART_BUCKET,
                                   MINIO_META_TMP_BUCKET)
 from . import api_errors, bitrot_io, metadata as meta
@@ -272,7 +273,19 @@ class MultipartMixin(ErasureObjects):
 
     def complete_multipart_upload(self, bucket: str, object_name: str,
                                   upload_id: str,
-                                  parts: list[CompletePart]) -> ObjectInfo:
+                                  parts: list[CompletePart],
+                                  version_id: str = "",
+                                  mod_time: Optional[float] = None,
+                                  if_none_newer: bool = False
+                                  ) -> ObjectInfo:
+        """`version_id`/`mod_time` are the version-faithful replay form
+        (replication apply + tier restore): the committed object keeps
+        the SOURCE version's identity instead of minting fresh ones, so
+        a multipart object crosses sites with its part boundaries AND
+        its multipart etag intact. `if_none_newer` applies the same
+        atomic unversioned conflict gate the single-part replay uses
+        (PutOptions.if_none_newer). S3 handlers never pass any of
+        them."""
         with self.ns.new_lock(
                 f"{bucket}/{object_name}/{upload_id}").write_locked():
             session_fi = self._check_upload_exists(bucket, object_name,
@@ -305,10 +318,14 @@ class MultipartMixin(ErasureObjects):
             fi = copy.deepcopy(session_fi)
             fi.volume, fi.name = bucket, object_name
             fi.size = total
-            fi.mod_time = now()
+            fi.mod_time = mod_time if mod_time else now()
             fi.parts = final_parts
             fi.metadata["etag"] = etag
-            if fi.metadata.pop("x-minio-internal-versioned", ""):
+            versioned_session = fi.metadata.pop(
+                "x-minio-internal-versioned", "")
+            if version_id:
+                fi.version_id = version_id
+            elif versioned_session:
                 fi.version_id = str(_uuid.uuid4())
             fi.erasure.checksums = [
                 ChecksumInfo(p.number, self.bitrot_algo.value, b"")
@@ -332,13 +349,23 @@ class MultipartMixin(ErasureObjects):
 
             metas = [fi.light_copy() for _ in self.disks]
             with self.ns.new_lock(f"{bucket}/{object_name}").write_locked():
+                if if_none_newer:
+                    # the replication apply's atomic last-writer-wins,
+                    # inside the same lock the commit holds (the
+                    # single-part path's PutOptions.if_none_newer gate)
+                    self._check_none_newer(bucket, object_name, fi)
                 meta.write_unique_file_info(
                     self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
                     write_quorum)
 
                 def rename(i, d):
+                    # name the committed version: the session meta also
+                    # holds the placeholder entry, and a version-
+                    # faithful replay's preserved mod time can sort
+                    # behind it ("latest" would commit the placeholder)
                     d.rename_data(MINIO_META_MULTIPART_BUCKET, path,
-                                  fi.data_dir, bucket, object_name)
+                                  fi.data_dir, bucket, object_name,
+                                  fi.version_id or NULL_VERSION_ID)
 
                 _, errs = meta.for_each_disk(self.disks, rename)
                 err = meta.reduce_write_quorum_errs(

@@ -47,6 +47,9 @@ class ErasureServerSets:
         # hot-object read cache (object/cache.py): attached at boot,
         # invalidated off the same namespace feed
         self.read_cache = None
+        # active-active replication plane (minio_tpu/replicate/):
+        # enqueues off the same namespace feed when attached
+        self.replication = None
         # ONE namespace-change feed, many consumers: the engines call
         # _dispatch_namespace_change, which fans out to every attached
         # listener (metacache journal, read-cache invalidation)
@@ -88,6 +91,15 @@ class ErasureServerSets:
         cluster boot)."""
         self.read_cache = cache
         self.register_namespace_listener(cache.on_namespace_change)
+
+    def attach_replication(self, plane) -> None:
+        """Wire the active-active replication plane into the ONE
+        namespace feed: every engine mutation verb that fires
+        _notify_namespace reaches the replication queue through this
+        listener — no per-handler enqueue call sites to forget (the
+        lint gate's hook-coverage rule pins the whole chain)."""
+        self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
 
     def single_zone(self) -> bool:
         return len(self.server_sets) == 1
@@ -391,6 +403,33 @@ class ErasureServerSets:
             remote_version, expect_etag, expect_mod_time)
 
     # ------------------------------------------------------------------
+    # version-faithful writes (replication apply / rebalance copy)
+    # ------------------------------------------------------------------
+
+    def put_delete_marker(self, bucket, object_name, version_id="",
+                          mod_time=None, metadata=None):
+        """Write a delete marker with explicit identity into an ACTIVE
+        pool (affinity with the pool holding the object's history, like
+        every other write) — the replication-apply marker path."""
+        idx = self.get_zone_idx(bucket, object_name, 1 << 20)
+        return self.server_sets[idx].put_delete_marker(
+            bucket, object_name, version_id, mod_time, metadata)
+
+    def put_stub_version(self, bucket, object_name, info,
+                         if_none_newer=False):
+        """Write a transitioned zero-data stub from its ObjectInfo into
+        an ACTIVE pool — the replication-apply form of the rebalance
+        stub copy (the remote tier copy is never touched)."""
+        idx = self.get_zone_idx(bucket, object_name, 1 << 20)
+        return self.server_sets[idx].put_stub_version(bucket, object_name,
+                                                      info, if_none_newer)
+
+    def latest_file_info(self, bucket, object_name):
+        """Cross-pool newest version's FileInfo, markers included."""
+        _idx, fi = self._zone_for_read(bucket, object_name)
+        return fi
+
+    # ------------------------------------------------------------------
     # multipart: session created in the chosen PUT zone; subsequent calls
     # find the zone owning the uploadID
     # ------------------------------------------------------------------
@@ -438,10 +477,12 @@ class ErasureServerSets:
         return z.get_multipart_info(bucket, object_name, upload_id)
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
+                                  parts, version_id="", mod_time=None,
+                                  if_none_newer=False):
         z = self._zone_of_upload(bucket, object_name, upload_id)
         return z.complete_multipart_upload(bucket, object_name, upload_id,
-                                           parts)
+                                           parts, version_id, mod_time,
+                                           if_none_newer)
 
     # ------------------------------------------------------------------
     # listing
@@ -497,7 +538,10 @@ class ErasureServerSets:
                         out.append(oi)
             except api_errors.ObjectApiError:
                 continue
-        out.sort(key=lambda o: -(o.mod_time or 0))
+        # (mod time, version id) newest first — the deterministic
+        # conflict order shared with the engine quorum merge
+        out.sort(key=lambda o: (o.mod_time or 0, o.version_id or ""),
+                 reverse=True)
         return out
 
     @staticmethod

@@ -339,9 +339,10 @@ class ErasureSets:
             bucket, object_name, version_id, tier, remote_object,
             remote_version, expect_etag, expect_mod_time)
 
-    def put_stub_version(self, bucket, object_name, info):
+    def put_stub_version(self, bucket, object_name, info,
+                         if_none_newer=False):
         return self.get_hashed_set(object_name).put_stub_version(
-            bucket, object_name, info)
+            bucket, object_name, info, if_none_newer)
 
     def has_object_versions(self, bucket, object_name) -> bool:
         return self.get_hashed_set(object_name).has_object_versions(
@@ -352,9 +353,9 @@ class ErasureSets:
             bucket, object_name)
 
     def put_delete_marker(self, bucket, object_name, version_id="",
-                          mod_time=None):
+                          mod_time=None, metadata=None):
         return self.get_hashed_set(object_name).put_delete_marker(
-            bucket, object_name, version_id, mod_time)
+            bucket, object_name, version_id, mod_time, metadata)
 
     # ------------------------------------------------------------------
     # multipart (route by object name)
@@ -393,9 +394,11 @@ class ErasureSets:
             bucket, object_name, upload_id)
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
+                                  parts, version_id="", mod_time=None,
+                                  if_none_newer=False):
         return self.get_hashed_set(object_name).complete_multipart_upload(
-            bucket, object_name, upload_id, parts)
+            bucket, object_name, upload_id, parts, version_id, mod_time,
+            if_none_newer)
 
     # ------------------------------------------------------------------
     # listing (merge across sets; cmd/erasure-sets.go merge walks)
@@ -487,7 +490,13 @@ def merge_version_listings(per_layer: list[tuple], max_keys: int
             out_pfx.append(name)
             count += 1
             continue
-        vers = sorted(by_name[name], key=lambda o: -(o.mod_time or 0))
+        # mod time then version id, newest first — the same
+        # deterministic order the engine's quorum merge uses (the
+        # active-active conflict rule: two sites holding one version
+        # set must page it identically, mod-time ties included)
+        vers = sorted(by_name[name],
+                      key=lambda o: (o.mod_time or 0, o.version_id or ""),
+                      reverse=True)
         for o in vers:
             if count >= max_keys:
                 truncated = True

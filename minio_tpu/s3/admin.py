@@ -488,6 +488,86 @@ class AdminHandlers:
             bucket = ctx.query1("bucket", "")
             return self._json(
                 self.api.bucket_meta.get(bucket).quota or {})
+        if sub == "replicate" and m == "GET":
+            self._auth(ctx, "admin:ReplicationInfo")
+            plane = self._repl_plane()
+            out = {"site": plane.registry.site_id,
+                   "epoch": plane.registry.epoch,
+                   "targets": plane.registry.list(redact=True),
+                   "stats": plane.stats()}
+            rs = plane.resync_status()
+            if rs:
+                out["resync"] = rs
+            return self._json(out)
+        if sub == "replicate/key" and m == "GET":
+            # the peer-sync read: every version of one key as replayable
+            # specs (HTTPReplClient.key_versions' server side)
+            self._auth(ctx, "admin:ReplicationInfo")
+            from ..object import api_errors as oerr
+            from ..object.faithful import spec_of
+            bucket = ctx.query1("bucket", "")
+            key = ctx.query1("key", "")
+            if not bucket or not key:
+                raise S3Error("AdminInvalidArgument",
+                              "bucket and key are required")
+            site = ""
+            repl = self.api.replication
+            if repl is not None and hasattr(repl, "registry"):
+                site = repl.registry.site_id
+            try:
+                versions = self.api.obj.object_versions(bucket, key)
+            except oerr.ObjectApiError:
+                versions = []
+            return self._json({"site": site,
+                               "versions": [spec_of(v).to_dict()
+                                            for v in versions]})
+        if sub == "replicate/target" and m == "PUT":
+            self._auth(ctx, "admin:SetBucketTarget")
+            from ..replicate.targets import (ReplTargetError, SiteTarget,
+                                             new_arn)
+            plane = self._repl_plane()
+            body = json.loads(ctx.read_body().decode() or "{}")
+            if not body.get("bucket"):
+                raise S3Error("AdminInvalidArgument",
+                              "bucket is required")
+            self._require_bucket(body["bucket"])
+            body.setdefault("arn",
+                            new_arn(body.get("dest_bucket")
+                                    or body["bucket"]))
+            try:
+                target = SiteTarget.from_dict(body)
+                plane.registry.add(
+                    target, update=ctx.query1("update") == "true")
+            except ReplTargetError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            return self._json({"arn": target.arn,
+                               "epoch": plane.registry.epoch})
+        if sub == "replicate/target" and m == "DELETE":
+            self._auth(ctx, "admin:SetBucketTarget")
+            from ..replicate.targets import ReplTargetError
+            plane = self._repl_plane()
+            try:
+                plane.remove_target(ctx.query1("arn", ""))
+            except ReplTargetError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            return self._json({})
+        if sub == "replicate/resync" and m == "POST":
+            self._auth(ctx, "admin:ReplicationResync")
+            from ..replicate.client import ReplClientError
+            from ..replicate.targets import ReplTargetError
+            plane = self._repl_plane()
+            try:
+                r = plane.start_resync(ctx.query1("arn", ""))
+            except (ReplClientError, ReplTargetError) as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            return self._json(r.status())
+        if sub == "replicate/resync" and m == "GET":
+            self._auth(ctx, "admin:ReplicationInfo")
+            return self._json(self._repl_plane().resync_status() or {})
+        if sub == "replicate/resync" and m == "DELETE":
+            self._auth(ctx, "admin:ReplicationResync")
+            return self._json(
+                {"canceled": self._repl_plane().cancel_resync()})
         if sub == "set-remote-target" and m == "PUT":
             self._auth(ctx, "admin:SetBucketTarget")
             return self._set_remote_target(ctx)
@@ -509,8 +589,15 @@ class AdminHandlers:
                 bucket).replication_targets if t.get("arn") != arn]
             self.api.bucket_meta.update(bucket,
                                         replication_targets=targets)
-            if self.api.replication is not None:
-                self.api.replication.targets.pop(arn, None)
+            repl = self.api.replication
+            if repl is not None:
+                if hasattr(repl, "remove_target"):
+                    try:
+                        repl.remove_target(arn)
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                else:
+                    repl.targets.pop(arn, None)
             return self._json({})
         if sub == "add-service-account" and m == "PUT":
             self._auth(ctx, "admin:CreateServiceAccount")
@@ -528,6 +615,15 @@ class AdminHandlers:
         if self.api.iam is None:
             raise S3Error("NotImplemented", "IAM is not configured")
         return self.api.iam
+
+    def _repl_plane(self):
+        """The active-active plane (minio_tpu/replicate/); the legacy
+        pool has no registry and no resync surface."""
+        repl = self.api.replication
+        if repl is None or not hasattr(repl, "registry"):
+            raise S3Error("NotImplemented",
+                          "no active-active replication plane")
+        return repl
 
     def _tiers(self):
         if self.api.tiers is None:
@@ -621,7 +717,12 @@ class AdminHandlers:
             bucket).replication_targets) + [entry]
         self.api.bucket_meta.update(bucket, replication_targets=targets)
         if self.api.replication is not None:
-            self.api.replication.mount_target_entry(entry)
+            # the legacy entry's "bucket" is the REMOTE bucket — the
+            # plane's registry needs the SOURCE bucket too, or the
+            # target would watch the wrong namespace (cluster boot's
+            # remount does the same)
+            self.api.replication.mount_target_entry(
+                dict(entry, source_bucket=bucket))
         return self._json({"arn": entry["arn"]})
 
     def _profiling_start(self, kinds: str = "cpu") -> dict:

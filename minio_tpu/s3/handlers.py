@@ -14,6 +14,7 @@ import binascii
 import dataclasses
 import hashlib
 import io
+import json
 import os
 import re
 import threading
@@ -235,8 +236,11 @@ class S3ApiHandlers:
             "MINIO_TPU_REQUEST_DEADLINE")
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
-        self.replication = None   # optional ReplicationPool
+        self.replication = None   # optional ReplicationPlane (or the
+        # legacy ReplicationPool — _notify duck-types the difference)
         self.tiers = None         # optional TierManager (ILM tiering)
+        self.restore_worker = None  # optional TransitionWorker: async
+        # RestoreObject (202 + background tier pull) for large objects
         from .trace import TraceSys
         self.trace = TraceSys()   # request tracing + audit hub
         from ..utils.bandwidth import BandwidthMonitor
@@ -1290,6 +1294,11 @@ class S3ApiHandlers:
     def put_object(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:PutObject", bucket, key)
         self.obj.get_bucket_info(bucket)
+        if ctx.header("x-minio-tpu-repl-spec"):
+            # internal replication apply (the reference's
+            # x-minio-source-* peer headers): a version-faithful write
+            # carrying explicit identity — owner credential only
+            return self._repl_apply(ctx, bucket, key)
         # _put_reader resolves the true payload size (including
         # x-amz-decoded-content-length for aws-chunked streams, where
         # Content-Length covers the chunk framing) — quota must gate on
@@ -1324,6 +1333,46 @@ class S3ApiHandlers:
             headers["x-amz-version-id"] = info.version_id
         self._notify("s3:ObjectCreated:Put", bucket, key)
         return HTTPResponse(headers=headers)
+
+    def _repl_apply(self, ctx, bucket, key) -> HTTPResponse:
+        """Apply one replicated version with full fidelity (identity,
+        part boundaries, markers, transitioned stubs as metadata) —
+        the HTTPReplClient's server side. Owner credential only: the
+        spec header carries internal metadata and explicit version
+        identity no ordinary client may set."""
+        if self.iam is not None and ctx.cred is not None and \
+                not self._is_owner(ctx.cred):
+            raise S3Error("AccessDenied",
+                          "replication apply needs the owner credential")
+        from ..object.faithful import VersionSpec
+        from ..replicate.client import LayerReplClient, ReplClientError
+        try:
+            spec = VersionSpec.from_dict(json.loads(
+                base64.urlsafe_b64decode(
+                    ctx.header("x-minio-tpu-repl-spec").encode())
+                .decode()))
+        except (ValueError, KeyError, TypeError):
+            raise S3Error("InvalidArgument",
+                          "bad replication spec header") from None
+        body = ctx.read_body()
+        if not spec.delete_marker and not spec.transitioned_stub \
+                and len(body) != spec.size:
+            raise S3Error("IncompleteBody")
+        site = ""
+        if self.replication is not None:
+            site = getattr(getattr(self.replication, "registry", None),
+                           "site_id", "")
+        client = LayerReplClient(self.obj, bucket, site)
+        try:
+            result = client.apply_version(
+                key, spec, reader_factory=lambda: io.BytesIO(body))
+        except ReplClientError as e:
+            raise S3Error("InternalError", str(e)) from None
+        if result == "applied":
+            self._notify("s3:ObjectCreated:Replication", bucket, key)
+        return HTTPResponse(
+            body=json.dumps({"result": result}).encode(),
+            headers={"Content-Type": "application/json"})
 
     def _apply_put_transforms(self, ctx, key, reader, size, metadata
                               ) -> tuple:
@@ -1695,6 +1744,32 @@ class S3ApiHandlers:
     def delete_object(self, ctx, bucket, key) -> HTTPResponse:
         self.authenticate(ctx, "s3:DeleteObject", bucket, key)
         self.obj.get_bucket_info(bucket)
+        if ctx.header("x-minio-tpu-repl-purge"):
+            # internal replica prune: remove ONE version outright (no
+            # delete marker), owner credential only — the wire form of
+            # the replication plane's prune step
+            if self.iam is not None and ctx.cred is not None and \
+                    not self._is_owner(ctx.cred):
+                raise S3Error("AccessDenied",
+                              "replica prune needs the owner credential")
+            pvid = ctx.query1("versionId")
+            # object-lock retention binds the prune too: a COMPLIANCE-
+            # locked version must survive replication convergence
+            # exactly like it survives a direct versioned DELETE. The
+            # prune ALWAYS removes a version (never writes a marker),
+            # so the marker exemption must not apply — an empty vid
+            # names the null version explicitly
+            self._enforce_object_lock(ctx, bucket, key, pvid or "null",
+                                      False)
+            try:
+                self.obj.delete_object(
+                    bucket, key,
+                    version_id="" if pvid == "null" else pvid,
+                    versioned=False)
+            except (oerr.ObjectNotFound, oerr.VersionNotFound):
+                pass                    # already converged
+            self._notify("s3:ObjectRemoved:Delete", bucket, key)
+            return HTTPResponse(status=204)
         vid = ctx.query1("versionId")
         versioned = self.bucket_meta.versioning_enabled(bucket)
         self._enforce_object_lock(ctx, bucket, key, vid, versioned)
@@ -1765,10 +1840,34 @@ class S3ApiHandlers:
         if days < 1:
             raise S3Error("InvalidArgument", "restore Days must be >= 1")
         vid = ctx.query1("versionId")
-        from ..tier.transition import restore_object as _restore
+        eff_vid = "" if vid == "null" else vid
+        from ..storage import datatypes as dt
+        from ..tier.transition import (clear_restore_ongoing,
+                                       mark_restore_ongoing,
+                                       restore_object as _restore)
+        info = self.obj.get_object_info(bucket, key,
+                                        GetOptions(version_id=eff_vid))
+        md = info.user_defined or {}
+        if dt.RESTORE_ONGOING in md.get(dt.RESTORE_KEY, ""):
+            raise S3Error("RestoreAlreadyInProgress")
+        async_bytes = knobs.get_int("MINIO_TPU_RESTORE_ASYNC_BYTES")
+        if (self.restore_worker is not None and async_bytes
+                and info.size >= async_bytes and dt.is_transitioned(md)
+                and not dt.is_restored(md)):
+            # large object: answer 202 NOW, run the tier pull in the
+            # background worker (carried-over ROADMAP item) — the
+            # ongoing-request marker makes the state visible to
+            # GET/HEAD and gates duplicate restores
+            mark_restore_ongoing(self.obj, bucket, key, eff_vid)
+            if self.restore_worker.enqueue_restore(
+                    bucket, key, eff_vid or info.version_id, days):
+                self._notify("s3:ObjectRestore:Post", bucket, key)
+                return HTTPResponse(status=202)
+            # worker queue full / stopping: nothing will ever clear the
+            # marker — undo it and serve the restore synchronously
+            clear_restore_ongoing(self.obj, bucket, key, eff_vid)
         out = _restore(self.obj, self.tiers, bucket, key,
-                       version_id="" if vid == "null" else vid,
-                       days=days)
+                       version_id=eff_vid, days=days)
         self._notify("s3:ObjectRestore:Completed", bucket, key)
         return HTTPResponse(
             status=202 if out["status"] == "restored" else 200)
@@ -2276,9 +2375,13 @@ class S3ApiHandlers:
                 tracker.mark(bucket, key)
             except Exception:  # noqa: BLE001 — hints are best-effort
                 pass
-        # async replication rides the same mutation signals
-        # (mustReplicate check happens inside the pool)
-        if self.replication is not None and key:
+        # LEGACY replication pool only: the active-active plane
+        # (minio_tpu/replicate/) rides the engine namespace-change feed
+        # instead, so every mutation verb reaches it without per-
+        # handler call sites (the old hooks here missed bulk delete and
+        # multipart commit)
+        if self.replication is not None and key and \
+                hasattr(self.replication, "on_put"):
             try:
                 if event_name.startswith("s3:ObjectCreated:"):
                     self.replication.on_put(bucket, key)

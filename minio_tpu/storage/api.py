@@ -117,7 +117,13 @@ class StorageAPI(abc.ABC):
 
     @abc.abstractmethod
     def rename_data(self, src_volume: str, src_path: str, data_dir: str,
-                    dst_volume: str, dst_path: str) -> None: ...
+                    dst_volume: str, dst_path: str,
+                    version_id: str = "") -> None:
+        """Commit a staged write. `version_id` names the version being
+        committed (empty = legacy latest-pick) — version-faithful
+        replays stage versions whose mod time sorts behind the
+        session placeholder, so "latest" is not "the one"."""
+        ...
 
     # -- files -------------------------------------------------------------
 

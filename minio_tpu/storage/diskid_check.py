@@ -137,9 +137,9 @@ class DiskIDCheck(StorageAPI):
         return self._call(self.inner.delete_versions, volume, versions)
 
     def rename_data(self, src_volume, src_path, data_dir, dst_volume,
-                    dst_path):
+                    dst_path, version_id=""):
         return self._call(self.inner.rename_data, src_volume, src_path,
-                          data_dir, dst_volume, dst_path)
+                          data_dir, dst_volume, dst_path, version_id)
 
     def list_dir(self, volume, dir_path, count=-1):
         return self._call(self.inner.list_dir, volume, dir_path, count)

@@ -312,10 +312,11 @@ class NaughtyDisk(StorageAPI):
         self.inner.delete_version(volume, path, fi)
 
     def rename_data(self, src_volume: str, src_path: str, data_dir: str,
-                    dst_volume: str, dst_path: str) -> None:
+                    dst_volume: str, dst_path: str,
+                    version_id: str = "") -> None:
         self._begin("rename_data")
         self.inner.rename_data(src_volume, src_path, data_dir,
-                               dst_volume, dst_path)
+                               dst_volume, dst_path, version_id)
 
     # -- files -------------------------------------------------------------
 

@@ -149,6 +149,12 @@ class XLMetaV2:
             entry = {"Type": DELETE_TYPE,
                      "DelObj": {"ID": uv,
                                 "MTime": int(fi.mod_time * 1e9)}}
+            if fi.metadata:
+                # the reference v2 DeleteMarker carries MetaSys for
+                # exactly this: replication state riding on markers
+                # (the replica-origin key) — absent for plain deletes
+                entry["DelObj"]["MetaSys"] = {
+                    k: v.encode() for k, v in fi.metadata.items()}
         else:
             meta_sys: dict[str, bytes] = {}
             meta_user: dict[str, str] = {}
@@ -250,9 +256,17 @@ class XLMetaV2:
         return 0
 
     def sorted_versions(self) -> list[dict]:
-        """Versions newest-first (latest = max ModTime, reference
-        ListVersions)."""
-        return sorted(self.versions, key=self._mod_time_of, reverse=True)
+        """Versions newest-first: (ModTime, version id) descending —
+        the version-id tie-break is the active-active replication
+        plane's deterministic conflict order. Two sites holding the
+        same version set (same-instant writes replicated both ways)
+        must resolve "latest" identically, and mod-time-only ordering
+        would fall back to per-site journal insertion order."""
+        return sorted(
+            self.versions,
+            key=lambda v: (self._mod_time_of(v),
+                           _uuid_str(self._version_id_of(v))),
+            reverse=True)
 
     def to_file_info(self, volume: str, path: str,
                      version_id: str = "") -> FileInfo:
@@ -286,11 +300,14 @@ class XLMetaV2:
         t = v.get("Type")
         if t == DELETE_TYPE:
             d = v["DelObj"]
+            md = {k: (val.decode() if isinstance(val, (bytes, bytearray))
+                      else str(val))
+                  for k, val in (d.get("MetaSys") or {}).items()}
             return FileInfo(
                 volume=volume, name=path,
                 version_id=_uuid_str(bytes(d["ID"])),
                 is_latest=is_latest, deleted=True,
-                mod_time=d["MTime"] / 1e9)
+                mod_time=d["MTime"] / 1e9, metadata=md)
         if t != OBJECT_TYPE:
             raise errors.FileCorrupt(f"xl.meta: unsupported version type {t}")
         o = v["V2Obj"]

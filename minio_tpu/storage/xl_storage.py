@@ -639,18 +639,27 @@ class XLStorage(StorageAPI):
                            meta.dumps())
 
     def rename_data(self, src_volume: str, src_path: str, data_dir: str,
-                    dst_volume: str, dst_path: str) -> None:
-        """Commit a staged write: merge src xl.meta's latest version into
-        dst's journal, move the data dir, drop src (reference RenameData,
-        cmd/xl-storage.go:2041 — the 2-phase-commit finish)."""
+                    dst_volume: str, dst_path: str,
+                    version_id: str = "") -> None:
+        """Commit a staged write: merge the committed version of src's
+        xl.meta into dst's journal, move the data dir, drop src
+        (reference RenameData, cmd/xl-storage.go:2041 — the
+        2-phase-commit finish). `version_id` names the version being
+        committed; without it the latest entry is assumed (correct
+        only when the staged meta holds one version)."""
         with telemetry.span("disk.rename_data"):
             self._rename_data(src_volume, src_path, data_dir,
-                              dst_volume, dst_path)
+                              dst_volume, dst_path, version_id)
 
     def _rename_data(self, src_volume: str, src_path: str, data_dir: str,
-                     dst_volume: str, dst_path: str) -> None:
+                     dst_volume: str, dst_path: str,
+                     version_id: str = "") -> None:
         src_meta = self._read_xl_meta(src_volume, src_path)
-        fi = src_meta.to_file_info(dst_volume, dst_path)
+        # the staged multipart session meta holds the session
+        # placeholder AND the final version — "latest by mod time" is
+        # wrong for version-faithful replays (preserved mod times sort
+        # behind the placeholder), so the commit names its version
+        fi = src_meta.to_file_info(dst_volume, dst_path, version_id)
         try:
             dst_meta = self._read_xl_meta(dst_volume, dst_path)
         except errors.FileNotFound:

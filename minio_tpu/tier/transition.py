@@ -37,7 +37,7 @@ from typing import Optional
 from ..object import api_errors
 from ..object.engine import GetOptions, PutOptions
 from ..storage.datatypes import (RESTORE_EXPIRY_KEY, RESTORE_KEY,
-                                 TRANSITION_TIER_KEY,
+                                 RESTORE_ONGOING, TRANSITION_TIER_KEY,
                                  TRANSITIONED_OBJECT_KEY,
                                  TRANSITIONED_VERSION_KEY, is_restored,
                                  is_transitioned)
@@ -153,6 +153,8 @@ class TransitionWorker:
         self.failed = 0
         self.skipped = 0               # object changed/vanished under us
         self.dropped = 0
+        self.restored = 0              # async RestoreObject pulls done
+        self.restore_failed = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -171,7 +173,20 @@ class TransitionWorker:
 
     def enqueue(self, bucket: str, name: str, version_id: str,
                 tier: str, etag: str = "") -> bool:
-        key = (bucket, name, version_id)
+        return self._enqueue(("move", bucket, name, version_id, tier,
+                              etag))
+
+    def enqueue_restore(self, bucket: str, name: str, version_id: str,
+                        days: int = 1) -> bool:
+        """Queue an ASYNC RestoreObject pull (the 202 path for large
+        objects): the handler marked the version ongoing-request and
+        answers immediately; this worker runs the tier pull off the
+        request thread, throttled like every transition."""
+        return self._enqueue(("restore", bucket, name, version_id,
+                              days, ""))
+
+    def _enqueue(self, entry: tuple) -> bool:
+        key = (entry[0], entry[1], entry[2], entry[3])
         with self._cond:
             if self._stop.is_set() or key in self._pending:
                 return False
@@ -179,7 +194,7 @@ class TransitionWorker:
                 self.dropped += 1
                 return False
             self._pending.add(key)
-            self._queue.append((bucket, name, version_id, tier, etag))
+            self._queue.append(entry)
             self.queued += 1
             self._cond.notify_all()
             return True
@@ -195,7 +210,8 @@ class TransitionWorker:
             return {"pending": len(self._queue) + self._inflight,
                     "queued": self.queued, "moved": self.moved,
                     "failed": self.failed, "skipped": self.skipped,
-                    "dropped": self.dropped}
+                    "dropped": self.dropped, "restored": self.restored,
+                    "restore_failed": self.restore_failed}
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait until every queued entry finished (moved, failed, or
@@ -218,15 +234,21 @@ class TransitionWorker:
                     self._cond.wait()
                 if self._stop.is_set():
                     return
-                bucket, name, vid, tier, etag = self._queue.popleft()
-                self._pending.discard((bucket, name, vid))
+                entry = self._queue.popleft()
+                self._pending.discard((entry[0], entry[1], entry[2],
+                                       entry[3]))
                 self._inflight += 1
             try:
                 self._pressure.throttle(self._stop, self._throttle_base,
                                         BACKOFF_MAX_S, BACKOFF_TRIES)
                 if self._stop.is_set():
                     return
-                self._move_one(bucket, name, vid, tier, etag)
+                if entry[0] == "restore":
+                    self._restore_one(entry[1], entry[2], entry[3],
+                                      entry[4])
+                else:
+                    self._move_one(entry[1], entry[2], entry[3],
+                                   entry[4], entry[5])
             finally:
                 with self._cond:
                     self._inflight -= 1
@@ -315,6 +337,28 @@ class TransitionWorker:
                 pass
             raise
         return info.size
+
+    def _restore_one(self, bucket: str, name: str, vid: str,
+                     days: int) -> None:
+        """One async RestoreObject pull (the handler already marked
+        the version ongoing-request and answered 202). A failed pull
+        CLEARS the ongoing marker — a stuck marker would answer every
+        later restore with RestoreAlreadyInProgress forever."""
+        try:
+            restore_object(self.obj, self.tiers, bucket, name,
+                           version_id=vid, days=days)
+        except (api_errors.ObjectNotFound, api_errors.VersionNotFound,
+                api_errors.MethodNotAllowed):
+            with self._cond:
+                self.skipped += 1       # deleted/markered since the 202
+        except Exception:  # noqa: BLE001 — per-object isolation
+            with self._cond:
+                self.restore_failed += 1
+            clear_restore_ongoing(self.obj, bucket, name, vid)
+            _mrf_enqueue(self.obj, bucket, name)
+        else:
+            with self._cond:
+                self.restored += 1
 
 
 # ---------------------------------------------------------------------------
@@ -491,15 +535,71 @@ def restore_object(object_layer, tiers: TierManager, bucket: str,
         metadata[RESTORE_KEY] = restore_val
         metadata[RESTORE_EXPIRY_KEY] = str(expiry)
         reader = _StrictSizeReader(stream, info.size)
-        put_opts = PutOptions(metadata=metadata,
-                              version_id=info.version_id,
-                              versioned=bool(info.version_id),
-                              mod_time=info.mod_time)
         try:
-            object_layer.put_object(bucket, name, reader, info.size,
-                                    put_opts)
+            if len(info.parts or []) > 1:
+                # multipart stub: replay the recorded part boundaries
+                # (object/faithful.py) so ranged reads and the
+                # multipart etag survive the restore round-trip — a
+                # single-part rewrite would change the stored shape
+                # the next transition/replication compares against
+                from ..object.faithful import replay_version, spec_of
+                spec = spec_of(info)
+                spec.metadata = {k: v for k, v in metadata.items()
+                                 if k != "etag"}
+                # conflict_gate off: the restore REWRITES the same
+                # identity over its own stub (mod time/etag equal —
+                # the replication gate would abort it as a tie)
+                replay_version(object_layer, bucket, name, spec,
+                               reader=reader, conflict_gate=False)
+            else:
+                put_opts = PutOptions(metadata=metadata,
+                                      version_id=info.version_id,
+                                      versioned=bool(info.version_id),
+                                      mod_time=info.mod_time)
+                object_layer.put_object(bucket, name, reader, info.size,
+                                        put_opts)
         finally:
             reader.close()
     _, _, _, restored_c = _metrics()
     restored_c.inc(tier=tier)
     return {"status": "restored", "expiry": expiry}
+
+
+def mark_restore_ongoing(object_layer, bucket: str, name: str,
+                         version_id: str = "") -> None:
+    """Record S3's ``ongoing-request="true"`` restore state on a
+    transitioned version — the async-202 handler path: later GET/HEADs
+    report the ongoing restore, a second RestoreObject answers
+    RestoreAlreadyInProgress, and the background worker's completed
+    pull overwrites this with the final expiry state."""
+    info = object_layer.get_object_info(
+        bucket, name, GetOptions(version_id=version_id))
+    md = dict(info.user_defined or {})
+    md[RESTORE_KEY] = RESTORE_ONGOING
+    md["etag"] = info.etag
+    if info.content_type:
+        md["content-type"] = info.content_type
+    object_layer.update_object_metadata(bucket, name, md,
+                                        version_id=version_id)
+
+
+def clear_restore_ongoing(object_layer, bucket: str, name: str,
+                          version_id: str = "") -> None:
+    """Best-effort removal of the ongoing marker after a FAILED async
+    pull, so the client can retry instead of seeing
+    RestoreAlreadyInProgress forever."""
+    try:
+        info = object_layer.get_object_info(
+            bucket, name, GetOptions(version_id=version_id))
+        md = dict(info.user_defined or {})
+        if RESTORE_ONGOING not in md.get(RESTORE_KEY, ""):
+            return
+        md.pop(RESTORE_KEY, None)
+        md.pop(RESTORE_EXPIRY_KEY, None)
+        md["etag"] = info.etag
+        if info.content_type:
+            md["content-type"] = info.content_type
+        object_layer.update_object_metadata(bucket, name, md,
+                                            version_id=version_id)
+    except api_errors.ObjectApiError:
+        pass

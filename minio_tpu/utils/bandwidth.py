@@ -77,6 +77,61 @@ class BandwidthMonitor:
         return out
 
 
+class TokenBucket:
+    """Thread-safe token-bucket rate limiter (the per-target
+    replication bandwidth budget): `take(n)` blocks until `n` bytes of
+    budget are available, refilled at `rate_bps` with one second of
+    burst. `rate_bps <= 0` means unlimited (take never blocks)."""
+
+    def __init__(self, rate_bps: float, burst_s: float = 1.0):
+        self.rate = float(rate_bps)
+        self.burst = max(self.rate * burst_s, 1.0)
+        self._mu = threading.Lock()
+        self._tokens = self.burst
+        self._last = time.monotonic()
+
+    def set_rate(self, rate_bps: float, burst_s: float = 1.0) -> None:
+        with self._mu:
+            self.rate = float(rate_bps)
+            self.burst = max(self.rate * burst_s, 1.0)
+            self._tokens = min(self._tokens, self.burst)
+
+    def take(self, n: int) -> None:
+        # grant in installments of at most one burst: a single chunk
+        # larger than the burst window (1 MiB blocks under a small
+        # bw_bps) must pace across refills, not livelock waiting for a
+        # token level the cap makes unreachable
+        remaining = n
+        while remaining > 0:
+            with self._mu:
+                if self.rate <= 0:
+                    return
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._last)
+                    * self.rate)
+                self._last = now
+                want = min(remaining, self.burst)
+                if self._tokens >= want:
+                    self._tokens -= want
+                    remaining -= want
+                    continue
+                wait = (want - self._tokens) / self.rate
+            time.sleep(min(wait, 1.0))
+
+    def paced(self, stream, on_bytes=None):
+        """Wrap a chunk iterator: each chunk waits for budget before it
+        flows; `on_bytes(n)` observes the paced bytes (the monitor's
+        record hook)."""
+        def gen():
+            for chunk in stream:
+                self.take(len(chunk))
+                if on_bytes is not None:
+                    on_bytes(len(chunk))
+                yield chunk
+        return gen()
+
+
 def merge_reports(reports: list[dict]) -> dict:
     """Sum per-bucket meters across nodes (cluster-wide view)."""
     merged: dict[str, dict] = {}

@@ -260,6 +260,32 @@ define("MINIO_TPU_TIER_BACKOFF_MAX_S", "float", 1.0,
 define("MINIO_TPU_TIER_BACKOFF_TRIES", "int", 8,
        "busy polls before a transition proceeds anyway", _S)
 
+_S = "Replication"
+define("MINIO_TPU_REPL_WORKERS", "int", 2,
+       "sync workers draining the replication queue", _S)
+define("MINIO_TPU_REPL_QUEUE", "int", 10000,
+       "max queued (bucket, key) sync tasks (overflow drops; the "
+       "resync verb is the backstop)", _S)
+define("MINIO_TPU_REPL_BACKOFF_S", "float", 0.05,
+       "first replication backoff when the foreground is busy", _S)
+define("MINIO_TPU_REPL_BACKOFF_MAX_S", "float", 1.0,
+       "replication backoff cap, seconds", _S)
+define("MINIO_TPU_REPL_BACKOFF_TRIES", "int", 8,
+       "busy polls before a sync proceeds anyway", _S)
+define("MINIO_TPU_REPL_BW_BPS", "int", 0,
+       "default per-target push bandwidth budget, bytes/sec "
+       "(0 = unlimited; a target's own bw_bps wins)", _S,
+       display="unlimited")
+define("MINIO_TPU_REPL_RESYNC_CHECKPOINT_EVERY", "int", 16,
+       "keys pushed between resync checkpoints", _S)
+define("MINIO_TPU_REPL_RESYNC_PAGE", "int", 256,
+       "resync listing page size", _S)
+
+_S = "Tiering (restore)"
+define("MINIO_TPU_RESTORE_ASYNC_BYTES", "int", 64 << 20,
+       "RestoreObject switches to 202 + background tier pull at this "
+       "size (0 = always synchronous)", _S, display="64 MiB")
+
 _S = "Metacache"
 define("MINIO_TPU_METACACHE", "bool", True,
        "`off` = exactly the old merge-walk listing behavior", _S)

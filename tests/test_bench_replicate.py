@@ -1,0 +1,19 @@
+"""CI pin for the replication A/B smoke: `bench.py
+--ab-replicate-smoke` must keep producing its shape (baseline +
+during-resync percentiles, resync completion, the lag histogram) in
+seconds — the gate beside tier1_diff that keeps the bench runnable."""
+
+def test_ab_replicate_smoke_shape():
+    import bench
+    ab = bench.bench_replicate_ab(streams=2, size=1 << 18, drives=6,
+                                  preload=6, block=1 << 16)
+    assert set(ab) >= {"config", "baseline", "during_resync",
+                       "resync_final", "plane_final",
+                       "put_p99_degradation_x", "lag_histogram"}
+    for phase in ("baseline", "during_resync"):
+        assert ab[phase]["p50_ms"] > 0 and ab[phase]["p99_ms"] > 0
+    assert ab["resync_final"]["status"] == "complete"
+    assert ab["resync_final"]["keys_scanned"] >= 6
+    assert ab["plane_final"]["pending"] == 0
+    assert ab["put_p99_degradation_x"] > 0
+    assert ab["lag_histogram"].get("count", 0) >= 1

@@ -424,3 +424,60 @@ def test_runner_single_rule_and_json_stdout(capsys):
     out = capsys.readouterr().out
     doc = json.loads(out[:out.rindex("}") + 1])
     assert doc["gate"] == "pass"
+
+
+def test_replication_chain_rule():
+    """The hook-coverage rule proves every mutation verb reaches the
+    replication queue: feed -> attach_replication ->
+    plane.on_namespace_change -> cluster wiring. Breaking any link
+    fires; the real tree is green (covered by
+    test_hook_rule_green_on_real_tree)."""
+    ok_engine = [_src("minio_tpu/object/engine.py", ENGINE_OK),
+                 _src("minio_tpu/object/multipart.py", MULTIPART_OK)]
+    ss_ok = '''
+class ErasureServerSets:
+    def attach_replication(self, plane):
+        self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+'''
+    plane_ok = '''
+class ReplicationPlane:
+    def on_namespace_change(self, bucket, key):
+        pass
+'''
+    cluster_ok = '''
+def boot(layer, plane):
+    layer.attach_replication(plane)
+'''
+    full = ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py", plane_ok),
+        _src("minio_tpu/cluster.py", cluster_ok)]
+    assert rules_project.check_hook_coverage(full) == []
+
+    # attach loses its register call -> flagged
+    vs = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", '''
+class ErasureServerSets:
+    def attach_replication(self, plane):
+        self.replication = plane
+'''),
+        _src("minio_tpu/replicate/plane.py", plane_ok),
+        _src("minio_tpu/cluster.py", cluster_ok)])
+    assert any("register_namespace_listener" in v.message for v in vs)
+
+    # the plane loses its listener method -> flagged
+    vs2 = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py",
+             "class ReplicationPlane:\n    pass\n"),
+        _src("minio_tpu/cluster.py", cluster_ok)])
+    assert any("on_namespace_change() missing" in v.message for v in vs2)
+
+    # cluster boot forgets to attach -> flagged
+    vs3 = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py", plane_ok),
+        _src("minio_tpu/cluster.py", "def boot(layer):\n    pass\n")])
+    assert any("never calls attach_replication" in v.message
+               for v in vs3)

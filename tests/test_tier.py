@@ -699,3 +699,150 @@ def test_rebalance_moves_transitioned_stub(tmp_path):
         assert oi.etag == info.etag
     finally:
         zz.close()
+
+
+# ---------------------------------------------------------------------------
+# PR 10 satellites: async RestoreObject + part-boundary-preserving restores
+# ---------------------------------------------------------------------------
+
+def _transition_now(zz, tiers, worker, bucket, name, vid=""):
+    """Transition one version through the worker and wait for it."""
+    info = zz.get_object_info(bucket, name, GetOptions(version_id=vid))
+    worker.enqueue(bucket, name, info.version_id, "cold",
+                   etag=info.etag)
+    assert worker.drain(30), worker.stats()
+    return info
+
+
+def test_multipart_restore_preserves_part_boundaries(env):
+    """A transitioned MULTIPART object restores through a real
+    multipart replay: the part list and the multipart etag survive the
+    round-trip (not a single-part rewrite), bytes identical."""
+    from minio_tpu.object.multipart import CompletePart
+    zz, tiers, worker, _tmp = env
+    p1, p2 = b"a" * (5 << 20), b"b" * (1 << 20)
+    up = zz.new_multipart_upload("b", "mpr", PutOptions(versioned=True))
+    e1 = zz.put_object_part("b", "mpr", up, 1, io.BytesIO(p1),
+                            len(p1)).etag
+    e2 = zz.put_object_part("b", "mpr", up, 2, io.BytesIO(p2),
+                            len(p2)).etag
+    info = zz.complete_multipart_upload(
+        "b", "mpr", up, [CompletePart(1, e1), CompletePart(2, e2)])
+    assert info.etag.endswith("-2")
+
+    _transition_now(zz, tiers, worker, "b", "mpr", info.version_id)
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "mpr")
+    stub = zz.get_object_info("b", "mpr")
+    assert [(p.number, p.size) for p in stub.parts] == \
+        [(1, len(p1)), (2, len(p2))]        # stub keeps the shape
+
+    restore_object(zz, tiers, "b", "mpr", version_id=info.version_id)
+    got = zz.get_object_info("b", "mpr")
+    assert got.etag == info.etag            # multipart etag identical
+    assert [(p.number, p.size) for p in got.parts] == \
+        [(1, len(p1)), (2, len(p2))]
+    assert got.version_id == info.version_id
+    assert got.mod_time == info.mod_time
+    oi, stream = zz.get_object("b", "mpr")
+    assert b"".join(stream) == p1 + p2
+    # ranged read across the preserved part boundary
+    _, stream = zz.get_object("b", "mpr", offset=(5 << 20) - 2, length=4)
+    assert b"".join(stream) == b"aabb"
+
+
+def test_async_restore_background_pull_and_ongoing_gate(env):
+    """The async RestoreObject path: mark ongoing + enqueue on the
+    transition worker -> the version stays gated while ongoing, the
+    background pull completes it, and a FAILED pull clears the marker
+    so the client can retry (never RestoreAlreadyInProgress forever)."""
+    from minio_tpu.tier.client import NaughtyTierClient
+    from minio_tpu.tier.transition import (clear_restore_ongoing,
+                                           mark_restore_ongoing)
+    zz, tiers, worker, _tmp = env
+    payload = os.urandom(1 << 18)
+    zz.put_object("b", "bigr", payload, opts=PutOptions(versioned=True))
+    info = _transition_now(zz, tiers, worker, "b", "bigr")
+
+    # the 202 path: handler marks ongoing, worker pulls in background
+    mark_restore_ongoing(zz, "b", "bigr")
+    md = zz.get_object_info("b", "bigr").user_defined
+    assert dt.RESTORE_ONGOING in md.get(dt.RESTORE_KEY, "")
+    with pytest.raises(api_errors.InvalidObjectState):
+        zz.get_object("b", "bigr")          # ongoing != restored
+    assert worker.enqueue_restore("b", "bigr", info.version_id, days=2)
+    assert worker.drain(30), worker.stats()
+    assert worker.stats()["restored"] == 1
+    oi, stream = zz.get_object("b", "bigr")
+    assert b"".join(stream) == payload
+    md = zz.get_object_info("b", "bigr").user_defined
+    assert dt.RESTORE_ONGOING not in md.get(dt.RESTORE_KEY, "")
+
+    # reclaim back to a stub, then a FAILED background pull clears the
+    # ongoing marker instead of wedging future restores
+    stub_md = md
+    zz.transition_object(
+        "b", "bigr", version_id=info.version_id, tier="cold",
+        remote_object=stub_md[dt.TRANSITIONED_OBJECT_KEY],
+        expect_etag=info.etag)
+    naughty = NaughtyTierClient(tiers.client("cold"),
+                                fail_verbs={"get": TierClientError("503")})
+    tiers.set_client("cold", naughty)
+    mark_restore_ongoing(zz, "b", "bigr")
+    assert worker.enqueue_restore("b", "bigr", info.version_id, days=1)
+    assert worker.drain(30), worker.stats()
+    assert worker.stats()["restore_failed"] == 1
+    md = zz.get_object_info("b", "bigr").user_defined
+    assert dt.RESTORE_KEY not in md          # marker cleared: retryable
+    naughty.clear_faults()
+    restore_object(zz, tiers, "b", "bigr", version_id=info.version_id)
+    oi, stream = zz.get_object("b", "bigr")
+    assert b"".join(stream) == payload
+
+
+def test_restore_http_async_202(http_env, monkeypatch):
+    """Over HTTP: a RestoreObject at/above MINIO_TPU_RESTORE_ASYNC_BYTES
+    answers 202 immediately with the pull running on the worker, a
+    duplicate answers RestoreAlreadyInProgress (409) while the marker
+    is up, and the object becomes readable once the background pull
+    lands."""
+    srv, client, tiers, worker, root = http_env
+    tiers.add(TierConfig("cold", "fs", {"path": str(root / "t")}),
+              update=True)
+    monkeypatch.setenv("MINIO_TPU_RESTORE_ASYNC_BYTES", "1024")
+    srv.api.restore_worker = worker
+    try:
+        client.request("PUT", "/asyb")
+        payload = os.urandom(1 << 16)
+        status, headers, _ = client.request("PUT", "/asyb/big",
+                                            body=payload)
+        assert status == 200
+        worker.enqueue("asyb", "big", "", "cold",
+                       etag=headers["etag"].strip('"'))
+        assert worker.drain(30), worker.stats()
+
+        body_xml = b"<RestoreRequest><Days>1</Days></RestoreRequest>"
+        status, _, _ = client.request("POST", "/asyb/big",
+                                      query={"restore": ""},
+                                      body=body_xml)
+        assert status == 202
+        status2, _, body2 = client.request("POST", "/asyb/big",
+                                           query={"restore": ""},
+                                           body=body_xml)
+        # either the pull already landed (200 window-extend) or the
+        # ongoing gate answers RestoreAlreadyInProgress
+        assert status2 in (200, 409), (status2, body2)
+        if status2 == 409:
+            assert b"RestoreAlreadyInProgress" in body2
+        assert worker.drain(30), worker.stats()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            status, headers, body = client.request("GET", "/asyb/big")
+            if status == 200:
+                break
+            time.sleep(0.1)
+        assert status == 200 and body == payload
+        assert 'ongoing-request="false"' in headers.get("x-amz-restore",
+                                                        "")
+    finally:
+        srv.api.restore_worker = None

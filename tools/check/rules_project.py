@@ -26,6 +26,17 @@ NAMESPACE_VERBS = (
 )
 NAMESPACE_HOOK = "_notify_namespace"
 
+# the replication-queue chain: every mutation verb reaches the
+# replication plane THROUGH the namespace feed — verb fires
+# _notify_namespace (checked above), the dispatcher fans out to
+# registered listeners, attach_replication registers the plane's
+# on_namespace_change, and cluster boot attaches the plane. Each link
+# is pinned here so an ad-hoc enqueue refactor (the pre-plane state,
+# which missed bulk delete and multipart commit) can't come back.
+REPL_SERVER_SETS = "minio_tpu/object/server_sets.py"
+REPL_PLANE = "minio_tpu/replicate/plane.py"
+REPL_CLUSTER = "minio_tpu/cluster.py"
+
 # every quorum-successful-but-degraded write must feed the MRF queue
 DEGRADED_VERBS = (
     "put_object", "update_object_metadata", "transition_object",
@@ -122,6 +133,69 @@ def check_hook_coverage(sources: List[Source]) -> List[Violation]:
                 f"write verb {verb}() never fires on_degraded_write "
                 f"(via {' / '.join(DEGRADED_HOOKS)}) — a degraded "
                 "quorum write waits for the scanner instead of MRF"))
+    out.extend(_check_replication_chain(sources))
+    return out
+
+
+def _fn_in_class(src: Source, cls: str, name: str
+                 ) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and \
+                        item.name == name:
+                    return item
+    return None
+
+
+def _calls_method(tree: ast.AST, method: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == method:
+            return True
+    return False
+
+
+def _check_replication_chain(sources: List[Source]) -> List[Violation]:
+    """Prove every mutation verb reaches the replication queue: the
+    namespace feed's verb coverage is checked above; these links pin
+    feed -> plane. Broken link = replication silently misses verbs."""
+    out: List[Violation] = []
+    by_rel = {s.rel: s for s in sources}
+
+    ss = by_rel.get(REPL_SERVER_SETS)
+    if ss is not None:
+        attach = _fn_in_class(ss, "ErasureServerSets",
+                              "attach_replication")
+        if attach is None:
+            out.append(Violation(
+                "hook-coverage", REPL_SERVER_SETS, 1,
+                "ErasureServerSets.attach_replication() missing — the "
+                "replication plane has no way onto the namespace feed"))
+        elif not _calls_method(attach, "register_namespace_listener"):
+            out.append(Violation(
+                "hook-coverage", REPL_SERVER_SETS, attach.lineno,
+                "attach_replication() never calls "
+                "register_namespace_listener() — mutation verbs would "
+                "not reach the replication queue"))
+
+    plane = by_rel.get(REPL_PLANE)
+    if plane is not None:
+        if _fn_in_class(plane, "ReplicationPlane",
+                        "on_namespace_change") is None:
+            out.append(Violation(
+                "hook-coverage", REPL_PLANE, 1,
+                "ReplicationPlane.on_namespace_change() missing — the "
+                "feed listener the attach wires is gone"))
+
+    cluster = by_rel.get(REPL_CLUSTER)
+    if cluster is not None and plane is not None and ss is not None:
+        if not _calls_method(cluster.tree, "attach_replication"):
+            out.append(Violation(
+                "hook-coverage", REPL_CLUSTER, 1,
+                "cluster boot never calls attach_replication() — the "
+                "plane exists but no mutation verb would reach it"))
     return out
 
 
