@@ -1363,6 +1363,298 @@ def bench_cache_ab(objects: int = 16, size: int = 4 << 20,
     return out
 
 
+def bench_edge_ab(streams=(4, 16), size: int = 1 << 20,
+                  rounds: int = 4, idle_conns: int = 400,
+                  idle_ratio: int = 20, drives: int = 6,
+                  parity: int = 2, block: int = 1 << 18) -> dict:
+    """HTTP frontend A/B: the event-loop edge vs the threaded oracle
+    over ONE erasure layer (ISSUE 12 success metric).
+
+    Phase 1 — idle keep-alive capacity: each server holds open
+    keep-alive connections (edge: `idle_conns`, threaded:
+    `idle_conns // idle_ratio` — thread-per-connection makes more
+    unkind to the CI host), reporting RSS delta per connection and the
+    thread-count delta (the edge's stays flat: sockets, not threads).
+    The idle pool stays OPEN through phase 2, so the load runs against
+    a mostly-idle connection population like production.
+
+    Phase 2 — matched load: per streams point, signed HTTP PUT + GET
+    rounds through persistent keep-alive connections; p50/p99 per op
+    for both transports at identical load.
+
+    Phase 3 — shed-before-body probe (edge): the admission gate is
+    pinched to one slot and concurrent header-only PUTs (bodies never
+    sent) must all shed 503 within the deadline — proving the decision
+    precedes the first body byte — with every shed counted in
+    minio_tpu_requests_shed_total{reason}."""
+    import hashlib
+    import http.client
+    import shutil
+    import socket as socket_mod
+    import tempfile
+    import threading
+    import urllib.parse
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.utils import telemetry
+
+    creds = Credentials("benchedgekey1", "benchedgesecret1")
+    region = "us-east-1"
+
+    def rss_kb() -> int:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    def shed_values() -> dict:
+        c = telemetry.REGISTRY.counter("minio_tpu_requests_shed_total")
+        with c._mu:
+            return {dict(k).get("reason", ""): v
+                    for k, v in c._series.items()}
+
+    def signed(method, path, port, payload_hash, extra=None):
+        hdrs = {"host": f"127.0.0.1:{port}"}
+        hdrs.update(extra or {})
+        return sig.sign_v4(method, urllib.parse.quote(path), {}, hdrs,
+                           payload_hash, creds, region)
+
+    def mk_server(layer, edge: bool) -> S3Server:
+        was = os.environ.get("MINIO_TPU_EDGE")
+        os.environ["MINIO_TPU_EDGE"] = "on" if edge else "off"
+        try:
+            return S3Server(layer, creds=creds, region=region).start()
+        finally:
+            if was is None:
+                os.environ.pop("MINIO_TPU_EDGE", None)
+            else:
+                os.environ["MINIO_TPU_EDGE"] = was
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_edge_", dir=base)
+    out: dict = {"config": {"streams": list(streams), "size": size,
+                            "rounds": rounds, "idle_conns": idle_conns,
+                            "idle_ratio": idle_ratio, "drives": drives,
+                            "m": parity}}
+    payload = os.urandom(size)
+    payload_sha = hashlib.sha256(payload).hexdigest()
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)
+
+        def one_server_pass(edge: bool) -> dict:
+            srv = mk_server(sets, edge)
+            tag = "edge" if edge else "threaded"
+            bucket = f"bench-{tag}"
+            port = srv.port
+            res: dict = {}
+            idle: list = []
+            try:
+                st = _http_put(port, f"/{bucket}", b"", signed, creds)
+                assert st == 200, f"bucket create {st}"
+                # untimed warm-up: the first PUT through a cold engine
+                # pays staging-ring/hasher setup — that's the layer's
+                # cost, not the frontend's, and the A/B must not charge
+                # it to whichever transport runs first
+                for w in range(2):
+                    st = _http_put(port, f"/{bucket}/warm-{w}", payload,
+                                   signed, creds)
+                    assert st == 200, f"warm-up put {st}"
+                # -- phase 1: idle keep-alive pool ---------------------
+                target = idle_conns if edge else \
+                    max(idle_conns // idle_ratio, 2)
+                threads0 = threading.active_count()
+                rss0 = rss_kb()
+                for _ in range(target):
+                    s = socket_mod.create_connection(
+                        ("127.0.0.1", port), timeout=30)
+                    # one real (unsigned -> 403) request marks the conn
+                    # established + keep-alive
+                    s.sendall((f"GET / HTTP/1.1\r\nHost: "
+                               f"127.0.0.1:{port}\r\n\r\n").encode())
+                    _read_resp(s)
+                    idle.append(s)
+                res["idle"] = {
+                    "conns": len(idle),
+                    "rss_delta_kb": max(rss_kb() - rss0, 0),
+                    "rss_per_conn_kb": round(
+                        max(rss_kb() - rss0, 0) / max(len(idle), 1), 2),
+                    "threads_delta": threading.active_count() - threads0,
+                }
+                # -- phase 2: matched load over the idle population ----
+                res["points"] = []
+                for n in streams:
+                    lats_put: list = []
+                    lats_get: list = []
+                    mu = threading.Lock()
+                    errs: list = []
+
+                    def worker(sid: int) -> None:
+                        try:
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", port, timeout=60)
+                            for r in range(rounds):
+                                path = f"/{bucket}/o-{sid}-{r}"
+                                hdrs = signed("PUT", path, port,
+                                              payload_sha)
+                                t0 = time.perf_counter()
+                                conn.request("PUT", path, body=payload,
+                                             headers=hdrs)
+                                resp = conn.getresponse()
+                                resp.read()
+                                dt = time.perf_counter() - t0
+                                assert resp.status == 200, resp.status
+                                with mu:
+                                    lats_put.append(dt)
+                            for r in range(rounds):
+                                path = f"/{bucket}/o-{sid}-{r}"
+                                hdrs = signed("GET", path, port,
+                                              sig.UNSIGNED_PAYLOAD)
+                                t0 = time.perf_counter()
+                                conn.request("GET", path, headers=hdrs)
+                                resp = conn.getresponse()
+                                body = resp.read()
+                                dt = time.perf_counter() - t0
+                                assert resp.status == 200 \
+                                    and body == payload
+                                with mu:
+                                    lats_get.append(dt)
+                            conn.close()
+                        except BaseException as e:  # noqa: BLE001
+                            with mu:
+                                errs.append(e)
+
+                    ts = [threading.Thread(target=worker, args=(i,))
+                          for i in range(n)]
+                    t0 = time.perf_counter()
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    wall = time.perf_counter() - t0
+                    if errs:
+                        raise errs[0]
+
+                    def pcts(xs):
+                        xs = sorted(xs)
+                        return {
+                            "p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                            "p99_ms": round(
+                                xs[max(0, int(len(xs) * .99) - 1)]
+                                * 1e3, 2)}
+                    res["points"].append({
+                        "streams": n, "wall_s": round(wall, 3),
+                        "put": pcts(lats_put), "get": pcts(lats_get),
+                        "put_gib_s": round(
+                            len(lats_put) * size / wall / (1 << 30), 3),
+                    })
+                # the idle pool survived the load: a sampled conn still
+                # answers on its original socket
+                probe = idle[len(idle) // 2]
+                probe.sendall((f"GET / HTTP/1.1\r\nHost: "
+                               f"127.0.0.1:{port}\r\n\r\n").encode())
+                status = _read_resp(probe)
+                res["idle"]["alive_after_load"] = status == 403
+            finally:
+                for s in idle:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                srv.stop()
+            return res
+
+        out["edge"] = one_server_pass(edge=True)
+        out["threaded"] = one_server_pass(edge=False)
+        out["idle_conn_ratio_x"] = round(
+            out["edge"]["idle"]["conns"]
+            / max(out["threaded"]["idle"]["conns"], 1), 1)
+        top = out["edge"]["points"][-1]
+        base_top = out["threaded"]["points"][-1]
+        out["put_p99_edge_vs_threaded_x"] = round(
+            top["put"]["p99_ms"] / max(base_top["put"]["p99_ms"], 1e-9),
+            3)
+
+        # -- phase 3: shed-before-body probe on the edge ---------------
+        srv = mk_server(sets, edge=True)
+        try:
+            srv.api.admission.resize(1)
+            srv.api.admission.deadline = 0.1
+            hold = srv.api.admission.admit("GET", "/x/y", {}, {})
+            before = shed_values()
+            refused = 0
+            for _ in range(8):
+                s = socket_mod.create_connection(
+                    ("127.0.0.1", srv.port), timeout=30)
+                s.sendall((f"PUT /{'shedb'}/k HTTP/1.1\r\n"
+                           f"Host: 127.0.0.1:{srv.port}\r\n"
+                           f"Content-Length: {1 << 20}\r\n\r\n"
+                           ).encode())   # body NEVER sent
+                if _read_resp(s) == 503:
+                    refused += 1
+                s.close()
+            hold.release()
+            after = shed_values()
+            out["saturation_sheds"] = {
+                "refused_503": refused,
+                "counter_delta": {
+                    k: after.get(k, 0) - before.get(k, 0)
+                    for k in after
+                    if after.get(k, 0) != before.get(k, 0)},
+                "body_bytes_sent": 0,
+            }
+        finally:
+            srv.stop()
+    finally:
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def _read_resp(sock) -> int:
+    """Read one HTTP response off a raw socket; returns the status."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return 0
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    length = 0
+    for line in lines[1:]:
+        if line.lower().startswith("content-length:"):
+            length = int(line.split(":", 1)[1])
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return status
+
+
+def _http_put(port: int, path: str, body: bytes, signed, creds) -> int:
+    import hashlib
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    hdrs = signed("PUT", path, port, hashlib.sha256(body).hexdigest())
+    conn.request("PUT", path, body=body, headers=hdrs)
+    st = conn.getresponse()
+    st.read()
+    conn.close()
+    return st.status
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ab-pipeline", action="store_true",
@@ -1445,7 +1737,33 @@ def main() -> int:
                     help="tiny replication A/B (2 streams, 256 KiB "
                          "objects, 8-key resync) for CI — seconds, "
                          "not minutes")
+    ap.add_argument("--ab-edge", action="store_true",
+                    help="run ONLY the HTTP frontend A/B (event-loop "
+                         "edge vs threaded oracle): idle keep-alive "
+                         "capacity at flat RSS, PUT/GET p50/p99 at "
+                         "matched load, shed-before-body counters")
+    ap.add_argument("--ab-edge-smoke", action="store_true",
+                    help="tiny edge A/B (2 streams, 256 KiB objects, "
+                         "60 idle conns) for CI — seconds, not minutes")
     args = ap.parse_args()
+
+    if args.ab_edge or args.ab_edge_smoke:
+        if args.ab_edge_smoke:
+            ab = bench_edge_ab(streams=(2,), size=1 << 18, rounds=2,
+                               idle_conns=60, idle_ratio=20, drives=6,
+                               block=1 << 16)
+        else:
+            ab = bench_edge_ab(streams=(4, 16, 32), size=args.ab_size,
+                               idle_conns=2000)
+        print(json.dumps({
+            "metric": "idle keep-alive connections held by the edge "
+                      "per threaded-frontend connection (flat RSS), "
+                      "with PUT/GET p99 at matched load",
+            "value": ab.get("idle_conn_ratio_x"),
+            "unit": "x",
+            "edge_ab": ab,
+        }))
+        return 0
 
     if args.saturation or args.saturation_smoke:
         if args.saturation_smoke:

@@ -276,7 +276,9 @@ class AdminClient:
     # -- active-active replication (minio_tpu/replicate/) ------------------
 
     def replicate_status(self) -> dict:
-        """Site id, persisted target registry, plane stats, resync."""
+        """Site id, persisted target registry, plane stats, resync —
+        plus per-target health under ``targets_status`` (queue depth,
+        oldest-pending age, last-sync timestamp, last observed lag)."""
         return self._json("GET", "replicate")
 
     def replicate_key_versions(self, bucket: str, key: str) -> dict:

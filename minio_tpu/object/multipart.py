@@ -87,11 +87,15 @@ class MultipartMixin(ErasureObjects):
     # -- session lifecycle -------------------------------------------------
 
     def new_multipart_upload(self, bucket: str, object_name: str,
-                             opts: Optional[PutOptions] = None) -> str:
+                             opts: Optional[PutOptions] = None,
+                             upload_id: Optional[str] = None) -> str:
+        """`upload_id` reuses a caller-held id instead of minting one:
+        the decommission drain migrates a LIVE session between pools
+        and the client's id must keep resolving across the move."""
         opts = opts or PutOptions()
         self.get_bucket_info(bucket)
         k, m, _, write_quorum = self._default_quorums(opts.parity)
-        upload_id = str(_uuid.uuid4())
+        upload_id = upload_id or str(_uuid.uuid4())
         path = self._upload_dir(bucket, object_name, upload_id)
 
         from ..storage.datatypes import new_file_info
@@ -213,21 +217,52 @@ class MultipartMixin(ErasureObjects):
                for p in fi.parts if p.number > part_marker]
         return out[:max_parts]
 
-    def list_multipart_uploads(self, bucket: str, object_name: str = ""
-                               ) -> list[dict]:
-        """Uploads in progress (for `object_name` if given): each entry is
-        {"object", "upload_id", "initiated"} read from the session
-        xl.meta (cmd/erasure-multipart.go ListMultipartUploads)."""
-        out: list[dict] = []
+    def read_multipart_part(self, bucket: str, object_name: str,
+                            upload_id: str, part_number: int):
+        """Decode ONE uncommitted session part back into plaintext —
+        the read half of a live-session migration (decommission drains
+        in-flight uploads instead of waiting them out). Returns
+        (PartInfo, chunk iterator); the same verified/reconstructing
+        group readers the GET path uses, pointed at the session's
+        ``part.N`` files under the multipart meta volume."""
+        path = self._upload_dir(bucket, object_name, upload_id)
+        metas, _errs = meta.read_all_file_info(
+            self.disks, MINIO_META_MULTIPART_BUCKET, path)
+        live = [fi for fi in metas if fi is not None]
+        if not live:
+            raise api_errors.InvalidUploadID(upload_id)
+        k = live[0].erasure.data_blocks
+        try:
+            fi = meta.pick_valid_file_info(metas, max(1, k))
+        except api_errors.InsufficientReadQuorum:
+            raise api_errors.InvalidUploadID(upload_id) from None
+        part = next((p for p in fi.parts if p.number == part_number),
+                    None)
+        if part is None:
+            raise api_errors.InvalidPart(part_number)
+        disks = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+        smeta = meta.shuffle_parts_metadata(metas,
+                                            fi.erasure.distribution)
+        codec = self.codec(fi.erasure.data_blocks,
+                           fi.erasure.parity_blocks)
+        info = PartInfo(part.number, part.etag, part.size,
+                        part.actual_size, fi.mod_time)
+        stream = self._read_part(MINIO_META_MULTIPART_BUCKET, path, fi,
+                                 disks, smeta, codec, part, 0, part.size)
+        return info, stream
+
+    def _scan_multipart_sessions(self, sha_dirs=None):
+        """(owner_bucket, object, upload_id, fi) for every session the
+        first healthy disk can list (shared by the per-bucket lister
+        and the decommission sweep — ONE scan implementation to keep
+        in sync). `sha_dirs` narrows the walk to known sha prefixes."""
         for d in self.disks:
             if d is None:
                 continue
             try:
-                if object_name:
-                    sha_dirs = [self._mp_sha_dir(bucket, object_name) + "/"]
-                else:
-                    sha_dirs = d.list_dir(MINIO_META_MULTIPART_BUCKET, "")
-                for sha in sha_dirs:
+                dirs = sha_dirs if sha_dirs is not None else \
+                    d.list_dir(MINIO_META_MULTIPART_BUCKET, "")
+                for sha in dirs:
                     try:
                         ids = d.list_dir(MINIO_META_MULTIPART_BUCKET,
                                          sha.rstrip("/"))
@@ -241,21 +276,62 @@ class MultipartMixin(ErasureObjects):
                                 MINIO_META_MULTIPART_BUCKET, path)
                         except serr.StorageError:
                             continue
-                        if fi.metadata.get("x-minio-internal-bucket",
-                                           bucket) != bucket:
-                            continue  # shared volume holds ALL buckets
-                        out.append({
-                            "object": fi.metadata.get(
-                                "x-minio-internal-object-name",
-                                object_name),
-                            "upload_id": uid,
-                            "initiated": fi.mod_time,
-                        })
-                break
+                        yield (fi.metadata.get(
+                            "x-minio-internal-bucket", ""),
+                            fi.metadata.get(
+                                "x-minio-internal-object-name", ""),
+                            uid, fi)
+                return
             except serr.StorageError:
                 continue
+
+    def list_multipart_uploads(self, bucket: str, object_name: str = ""
+                               ) -> list[dict]:
+        """Uploads in progress (for `object_name` if given): each entry is
+        {"object", "upload_id", "initiated"} read from the session
+        xl.meta (cmd/erasure-multipart.go ListMultipartUploads)."""
+        sha_dirs = [self._mp_sha_dir(bucket, object_name) + "/"] \
+            if object_name else None
+        out: list[dict] = []
+        for owner, obj, uid, fi in \
+                self._scan_multipart_sessions(sha_dirs):
+            # shared volume holds ALL buckets; ownerless (pre-layout)
+            # sessions count toward the requested bucket
+            if (owner or bucket) != bucket:
+                continue
+            out.append({"object": obj or object_name,
+                        "upload_id": uid, "initiated": fi.mod_time})
         out.sort(key=lambda u: (u["object"], u["upload_id"]))
         return out
+
+    def list_all_multipart_uploads(self) -> list[dict]:
+        """Every live session in the shared multipart meta volume,
+        each entry carrying its owning ``bucket`` — ONE volume scan
+        for the decommission sweep instead of a full rescan per
+        bucket."""
+        out = [{"bucket": owner, "object": obj, "upload_id": uid,
+                "initiated": fi.mod_time}
+               for owner, obj, uid, fi in self._scan_multipart_sessions()
+               if owner]               # pre-layout session: no owner
+        out.sort(key=lambda u: (u["bucket"], u["object"],
+                                u["upload_id"]))
+        return out
+
+    def mark_multipart_session(self, bucket: str, object_name: str,
+                               upload_id: str,
+                               extra: dict[str, str]) -> None:
+        """Merge `extra` into the session journal's metadata (the
+        migration-progress marker). Caller holds the session write
+        lock — this writes the journal raw, exactly like the part
+        recorder above."""
+        fi = self._check_upload_exists(bucket, object_name, upload_id)
+        fi.metadata.update(extra)
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        path = self._upload_dir(bucket, object_name, upload_id)
+        metas = [fi.light_copy() for _ in self.disks]
+        meta.write_unique_file_info(
+            self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
+            meta.write_quorum_for(k, m))
 
     def abort_multipart_upload(self, bucket: str, object_name: str,
                                upload_id: str) -> None:

@@ -54,6 +54,7 @@ if TYPE_CHECKING:  # pragma: no cover — typing only
 
 CHECKPOINT_EVERY = knobs.get_int("MINIO_TPU_REBALANCE_CHECKPOINT_EVERY")
 PAGE = knobs.get_int("MINIO_TPU_REBALANCE_PAGE")
+MPU_GRACE_S = knobs.get_float("MINIO_TPU_REBALANCE_MPU_GRACE_S")
 BACKOFF_S = knobs.get_float("MINIO_TPU_REBALANCE_BACKOFF_S")
 BACKOFF_MAX_S = knobs.get_float("MINIO_TPU_REBALANCE_BACKOFF_MAX_S")
 BACKOFF_TRIES = knobs.get_int("MINIO_TPU_REBALANCE_BACKOFF_TRIES")
@@ -93,11 +94,17 @@ class Rebalancer:
                  resume: bool = False,
                  checkpoint_every: Optional[int] = None,
                  page: Optional[int] = None,
-                 busy_fn=None, throttle_s: Optional[float] = None):
+                 busy_fn=None, throttle_s: Optional[float] = None,
+                 mpu_grace_s: Optional[float] = None):
         self.obj = server_sets
         self.source = source
         self.checkpoint_every = checkpoint_every or CHECKPOINT_EVERY
         self.page = page or PAGE
+        # live multipart sessions idle less than this keep their grace;
+        # past it the drain migrates them to an active pool instead of
+        # waiting them out (ROADMAP carried-over item 6)
+        self.mpu_grace_s = MPU_GRACE_S if mpu_grace_s is None \
+            else mpu_grace_s
         # busy probe override (tests); default samples the live
         # scheduler queue + staging-ring waits (utils/pressure.py —
         # shared with the tier transition worker)
@@ -111,6 +118,7 @@ class Rebalancer:
             "pool": source, "status": "pending",
             "bucket": "", "marker": "",
             "objects_moved": 0, "bytes_moved": 0, "objects_failed": 0,
+            "mpu_migrated": 0, "mpu_failed": 0,
             "passes": 0, "started": time.time(), "updated": time.time(),
         }
         if resume:
@@ -209,8 +217,61 @@ class Rebalancer:
             m, f = self._drain_bucket(src, bucket, marker)
             moved += m
             failed += f
+        if not self._stop.is_set():
+            # actively drain LIVE multipart sessions (bounded grace,
+            # then migrate) instead of waiting for clients to finish
+            m, f = self._drain_multipart(src)
+            moved += m
+            failed += f
         remaining = 0 if self._stop.is_set() else self._remaining(src)
         return moved, failed, remaining
+
+    def _drain_multipart(self, src) -> tuple[int, int]:
+        """Migrate the source pool's in-flight multipart sessions to an
+        active pool once their grace expired (``initiated`` tracks the
+        session journal's last write, so an actively-uploading client
+        keeps renewing its grace — but its own next part-write migrates
+        the session anyway via the server-sets draining guard). Failed
+        migrations count + feed the source MRF queue and retry next
+        pass."""
+        moved = failed = 0
+        now = time.time()
+        try:
+            # ONE scan of the shared multipart volume per pass (each
+            # entry carries its owning bucket) — the per-bucket lister
+            # reads every session's xl.meta just to filter
+            uploads = src.list_all_multipart_uploads()
+        except api_errors.ObjectApiError:
+            return 0, 0
+        for up in uploads:
+            if self._stop.is_set():
+                return moved, failed
+            if now - up.get("initiated", 0) < self.mpu_grace_s:
+                continue                # bounded in-flight grace
+            self._throttle()
+            with telemetry.trace("rebalance.migrate_mpu",
+                                 bucket=up["bucket"],
+                                 object=up["object"],
+                                 upload_id=up["upload_id"]):
+                try:
+                    self.obj.migrate_upload(up["bucket"], up["object"],
+                                            up["upload_id"],
+                                            source=self.source)
+                except api_errors.InvalidUploadID:
+                    # the session vanished under us (client completed
+                    # or aborted, or a consumed leftover was purged):
+                    # converged, nothing to count
+                    moved += 1
+                except Exception:  # noqa: BLE001 — per-session
+                    failed += 1    # isolation; MRF heals, next
+                    with self._mu:  # pass retries
+                        self.state["mpu_failed"] += 1
+                    self._on_move_failed(up["bucket"], up["object"])
+                else:
+                    moved += 1
+                    with self._mu:
+                        self.state["mpu_migrated"] += 1
+        return moved, failed
 
     def _drain_bucket(self, src, bucket: str, marker: str
                       ) -> tuple[int, int]:
@@ -310,7 +371,10 @@ class Rebalancer:
         return groups
 
     def _remaining(self, src) -> int:
-        """Movable objects still on the source pool (completion probe)."""
+        """Movable objects still on the source pool (completion probe).
+        Live multipart sessions count too: the drain is not complete
+        until every session migrated (young ones ride their grace
+        through another pass)."""
         remaining = 0
         buckets = [v.name for v in src.list_buckets()] \
             + [MINIO_META_BUCKET]
@@ -321,6 +385,10 @@ class Rebalancer:
             except api_errors.ObjectApiError:
                 continue
             remaining += len(self._group(page, bucket))
+        try:
+            remaining += len(src.list_all_multipart_uploads())
+        except api_errors.ObjectApiError:
+            pass
         return remaining
 
     # ------------------------------------------------------------------

@@ -440,20 +440,179 @@ class ErasureServerSets:
             bucket, object_name, opts)
 
     def _zone_of_upload(self, bucket, object_name, upload_id):
-        for z in self.server_sets:
+        return self.server_sets[
+            self._zone_index_of_upload(bucket, object_name, upload_id)]
+
+    def _zone_index_of_upload(self, bucket, object_name,
+                              upload_id) -> int:
+        """Owning pool of a session. A crash mid-migration can leave
+        the session resolvable in TWO pools (the draining source and
+        its migration target); the first WRITABLE holder wins — at
+        most one exists, so the probe returns at the first writable
+        hit (the common all-active case keeps the old first-resolver
+        cost) and only a drained-out session scans the full list."""
+        first = -1
+        for i, z in enumerate(self.server_sets):
             try:
                 z.list_object_parts(bucket, object_name, upload_id,
                                     max_parts=1)
-                return z
             except api_errors.InvalidUploadID:
                 continue
-        raise api_errors.InvalidUploadID(upload_id)
+            if self.topology.can_write(i):
+                return i
+            if first < 0:
+                first = i
+        if first < 0:
+            raise api_errors.InvalidUploadID(upload_id)
+        return first
+
+    def _writable_upload_zone(self, bucket, object_name,
+                              upload_id) -> int:
+        """The session's pool — migrated to an active pool first when
+        its current home is draining/suspended (decommission stops
+        accepting NEW parts on the leaving pool; the client's uploadID
+        keeps resolving because the migration preserves it)."""
+        idx = self._zone_index_of_upload(bucket, object_name, upload_id)
+        if self.topology.can_write(idx) or self.single_zone():
+            return idx
+        return self.migrate_upload(bucket, object_name, upload_id,
+                                   source=idx)
 
     def put_object_part(self, bucket, object_name, upload_id, part_number,
                         reader, size=-1):
-        z = self._zone_of_upload(bucket, object_name, upload_id)
-        return z.put_object_part(bucket, object_name, upload_id,
-                                 part_number, reader, size)
+        idx = self._writable_upload_zone(bucket, object_name, upload_id)
+        try:
+            return self.server_sets[idx].put_object_part(
+                bucket, object_name, upload_id, part_number, reader,
+                size)
+        except api_errors.InvalidUploadID:
+            # the drain migrated the session between our zone choice
+            # and the write (no bytes consumed yet: the session check
+            # precedes the encode) — re-resolve once
+            z = self._zone_of_upload(bucket, object_name, upload_id)
+            return z.put_object_part(bucket, object_name, upload_id,
+                                     part_number, reader, size)
+
+    def migrate_upload(self, bucket: str, object_name: str,
+                       upload_id: str,
+                       source: Optional[int] = None) -> int:
+        """Move one LIVE multipart session onto an active pool —
+        session metadata, every uploaded part (decoded through the
+        verified GET readers, re-encoded in the target's geometry) and
+        the client-held uploadID all survive. The whole copy+abort
+        holds the SOURCE engine's session write lock (the one
+        put_object_part takes), so a racing part-write either lands
+        before the snapshot or blocks and then re-resolves to the
+        target; a racing second migration loses the lock and returns
+        the converged home. A crash between copy and abort leaves the
+        session in both pools: clients continue on the writable target
+        (_zone_index_of_upload prefers it) and the re-run copies only
+        parts the target LACKS — target parts are authoritative, a
+        stale source copy can never overwrite a newer client write.
+        Returns the target pool index."""
+        from ..utils.streams import IterStream
+        from .engine import PutOptions
+        from .hash_reader import HashReader
+        if source is None:
+            source = self._zone_index_of_upload(bucket, object_name,
+                                                upload_id)
+        import contextlib
+        src = self.server_sets[source]
+        src_engine = src.get_hashed_set(object_name)
+        with contextlib.ExitStack() as stack:
+            # per-pool namespace maps in every current assembly; if
+            # pools ever shared one map this same-named lock would
+            # self-deadlock against the dst part-writes below, so gate
+            # on identity
+            if not any(src_engine.ns is z.get_hashed_set(object_name).ns
+                       for i, z in enumerate(self.server_sets)
+                       if i != source):
+                stack.enter_context(src_engine.ns.new_lock(
+                    f"{bucket}/{object_name}/{upload_id}"
+                ).write_locked())
+            try:
+                session_meta = src.get_multipart_info(
+                    bucket, object_name, upload_id)
+            except api_errors.InvalidUploadID:
+                # lost a migration race: the winner already moved (and
+                # aborted) the source session — converge on its home
+                return self._zone_index_of_upload(bucket, object_name,
+                                                  upload_id)
+            parts = src.list_object_parts(bucket, object_name,
+                                          upload_id, 0, 10000)
+            # a crashed earlier migration may have left the session's
+            # twin on SOME other pool — resume THERE, never re-choose
+            # (re-choosing would mistake the surviving twin for a
+            # consumed upload, or fork the session across three pools)
+            idx = -1
+            have: dict[int, str] = {}
+            for i, z in enumerate(self.server_sets):
+                if i == source:
+                    continue
+                try:
+                    have = {p.part_number: p.etag
+                            for p in z.list_object_parts(
+                                bucket, object_name, upload_id,
+                                0, 10000)}
+                    idx = i
+                    break
+                except api_errors.ObjectApiError:
+                    continue
+            if idx < 0:
+                if session_meta.get("x-minio-internal-migrated"):
+                    # the marker is written only AFTER the target
+                    # session exists; no twin anywhere now means the
+                    # client completed/aborted the migrated upload —
+                    # the source copy is a consumed leftover: purge,
+                    # NEVER resurrect a finished upload as a zombie
+                    src.abort_multipart_upload(bucket, object_name,
+                                               upload_id)
+                    raise api_errors.InvalidUploadID(upload_id)
+                total = sum(p.size for p in parts)
+                idx = self.get_available_zone_idx(
+                    max(total, 1 << 20) * 2)
+                if idx < 0 or idx == source:
+                    raise api_errors.InsufficientWriteQuorum(
+                        "no active pool has room for the session "
+                        "migration")
+                versioned = session_meta.get(
+                    "x-minio-internal-versioned") == "true"
+                user_meta = {k: v for k, v in session_meta.items()
+                             if not k.startswith("x-minio-internal-")}
+                self.server_sets[idx].new_multipart_upload(
+                    bucket, object_name,
+                    opts=PutOptions(metadata=user_meta,
+                                    versioned=versioned),
+                    upload_id=upload_id)
+                # marker AFTER the target session exists, BEFORE the
+                # parts copy: a crash from here on re-runs into the
+                # resume-at-twin path above
+                src.mark_multipart_session(
+                    bucket, object_name, upload_id,
+                    {"x-minio-internal-migrated": "1"})
+            dst = self.server_sets[idx]
+            for p in parts:
+                if p.part_number in have:
+                    continue        # crash-window leftover: dst wins
+                info, stream = src.read_multipart_part(
+                    bucket, object_name, upload_id, p.part_number)
+                reader = IterStream(stream)
+                try:
+                    out = dst.put_object_part(
+                        bucket, object_name, upload_id, p.part_number,
+                        HashReader(reader, p.size,
+                                   actual_size=p.actual_size), p.size)
+                finally:
+                    reader.close()
+                if out.etag != p.etag:
+                    # never silently swap bytes under a client-held
+                    # etag: leave the source authoritative for this
+                    # part and surface the fault (next sweep retries)
+                    raise api_errors.ObjectApiError(
+                        f"migrated part {p.part_number} etag mismatch "
+                        f"({out.etag} != {p.etag})")
+            src.abort_multipart_upload(bucket, object_name, upload_id)
+        return idx
 
     def list_object_parts(self, bucket, object_name, upload_id,
                           part_marker=0, max_parts=1000):
@@ -479,10 +638,13 @@ class ErasureServerSets:
     def complete_multipart_upload(self, bucket, object_name, upload_id,
                                   parts, version_id="", mod_time=None,
                                   if_none_newer=False):
-        z = self._zone_of_upload(bucket, object_name, upload_id)
-        return z.complete_multipart_upload(bucket, object_name, upload_id,
-                                           parts, version_id, mod_time,
-                                           if_none_newer)
+        # a commit is a new write: a session still homed on a draining
+        # pool migrates first so the object lands in an ACTIVE pool
+        # instead of being drained again right after the commit
+        idx = self._writable_upload_zone(bucket, object_name, upload_id)
+        return self.server_sets[idx].complete_multipart_upload(
+            bucket, object_name, upload_id, parts, version_id, mod_time,
+            if_none_newer)
 
     # ------------------------------------------------------------------
     # listing

@@ -361,14 +361,20 @@ class ErasureSets:
     # multipart (route by object name)
     # ------------------------------------------------------------------
 
-    def new_multipart_upload(self, bucket, object_name, opts=None):
+    def new_multipart_upload(self, bucket, object_name, opts=None,
+                             upload_id=None):
         return self.get_hashed_set(object_name).new_multipart_upload(
-            bucket, object_name, opts)
+            bucket, object_name, opts, upload_id=upload_id)
 
     def put_object_part(self, bucket, object_name, upload_id, part_number,
                         reader, size=-1):
         return self.get_hashed_set(object_name).put_object_part(
             bucket, object_name, upload_id, part_number, reader, size)
+
+    def read_multipart_part(self, bucket, object_name, upload_id,
+                            part_number):
+        return self.get_hashed_set(object_name).read_multipart_part(
+            bucket, object_name, upload_id, part_number)
 
     def list_object_parts(self, bucket, object_name, upload_id,
                           part_marker=0, max_parts=1000):
@@ -384,6 +390,19 @@ class ErasureSets:
             out.extend(s.list_multipart_uploads(bucket))
         out.sort(key=lambda u: (u["object"], u["upload_id"]))
         return out
+
+    def list_all_multipart_uploads(self):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_all_multipart_uploads())
+        out.sort(key=lambda u: (u["bucket"], u["object"],
+                                u["upload_id"]))
+        return out
+
+    def mark_multipart_session(self, bucket, object_name, upload_id,
+                               extra):
+        return self.get_hashed_set(object_name).mark_multipart_session(
+            bucket, object_name, upload_id, extra)
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
         return self.get_hashed_set(object_name).abort_multipart_upload(

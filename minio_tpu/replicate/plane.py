@@ -95,9 +95,14 @@ class ReplicationPlane:
             else throttle_s
         self.queue_size = QUEUE_SIZE if queue_size is None else queue_size
         self._cond = threading.Condition()
-        self._queue: deque = deque()
+        self._queue: deque = deque()    # (bucket, key, enqueued_at)
         self._pending: set[tuple[str, str]] = set()
         self._inflight = 0
+        # per-target admin surface (ROADMAP item 4 remainder): queue
+        # depth + oldest-pending age are derived from the live queue on
+        # demand; synced/failed/last-sync/last-lag update as workers
+        # push — the JSON twin of minio_tpu_repl_lag_seconds{target}
+        self._target_stats: dict[str, dict] = {}
         self._stop = threading.Event()
         self._buckets: dict[str, TokenBucket] = {}
         # optional BandwidthMonitor (cluster wires the S3 server's):
@@ -158,7 +163,7 @@ class ReplicationPlane:
                 self.dropped += 1
                 return
             self._pending.add((bucket, key))
-            self._queue.append((bucket, key))
+            self._queue.append((bucket, key, time.time()))
             self.queued += 1
             self._cond.notify_all()
 
@@ -179,6 +184,40 @@ class ReplicationPlane:
                    "skipped": self.skipped, "failed": self.failed_syncs,
                    "pruned": self.pruned, "dropped": self.dropped}
         out["retry"] = self.mrf.stats()
+        return out
+
+    def _target_entry(self, arn: str) -> dict:
+        # caller holds self._cond
+        entry = self._target_stats.get(arn)
+        if entry is None:
+            entry = self._target_stats[arn] = {
+                "synced": 0, "failed": 0,
+                "last_sync": 0.0, "last_lag_s": None}
+        return entry
+
+    def target_status(self) -> dict:
+        """Per-target replication health for the admin plane: live
+        queue depth + oldest-pending age (matching keys still waiting
+        in the sync queue), last successful push timestamp, last
+        observed lag, cumulative synced/failed. The histogram twin is
+        ``minio_tpu_repl_lag_seconds{target}``."""
+        now = time.time()
+        with self._cond:
+            queue_snapshot = list(self._queue)
+            entries = {arn: dict(st)
+                       for arn, st in self._target_stats.items()}
+        out: dict = {}
+        for target in list(self.registry.targets.values()):
+            st = entries.get(target.arn) or {
+                "synced": 0, "failed": 0,
+                "last_sync": 0.0, "last_lag_s": None}
+            matching = [t for b, k, t in queue_snapshot
+                        if b == target.bucket and target.matches(k)]
+            st["queue_depth"] = len(matching)
+            st["oldest_pending_s"] = round(now - min(matching), 3) \
+                if matching else 0.0
+            st["bucket"] = target.bucket
+            out[target.arn] = st
         return out
 
     def drain(self, timeout: float = 30.0) -> bool:
@@ -227,7 +266,7 @@ class ReplicationPlane:
                     self._cond.wait()
                 if self._stop.is_set():
                     return
-                bucket, key = self._queue.popleft()
+                bucket, key, _enq = self._queue.popleft()
                 self._pending.discard((bucket, key))
                 self._inflight += 1
             try:
@@ -254,6 +293,7 @@ class ReplicationPlane:
                     # the retry queue re-drives with backoff
                     with self._cond:
                         self.failed_syncs += 1
+                        self._target_entry(target.arn)["failed"] += 1
                     failed_c.inc()
                     self.mrf.enqueue(bucket, key, target.arn)
 
@@ -399,10 +439,15 @@ class ReplicationPlane:
                 continue
             if result == "applied":
                 pushed += 1
+                lag = max(time.time() - (oi.mod_time or 0), 0.0)
                 with self._cond:
                     self.synced += 1
+                    entry = self._target_entry(target.arn)
+                    entry["synced"] += 1
+                    entry["last_sync"] = time.time()
+                    entry["last_lag_s"] = round(lag, 3)
                 synced_c.inc()
-                lag_h.observe(max(time.time() - (oi.mod_time or 0), 0.0))
+                lag_h.observe(lag, target=target.arn)
             else:
                 with self._cond:
                     self.skipped += 1

@@ -494,7 +494,11 @@ class AdminHandlers:
             out = {"site": plane.registry.site_id,
                    "epoch": plane.registry.epoch,
                    "targets": plane.registry.list(redact=True),
-                   "stats": plane.stats()}
+                   "stats": plane.stats(),
+                   # per-target lag (ROADMAP item 4 remainder): queue
+                   # depth, oldest-pending age, last-sync timestamp —
+                   # the JSON twin of minio_tpu_repl_lag_seconds{target}
+                   "targets_status": plane.target_status()}
             rs = plane.resync_status()
             if rs:
                 out["resync"] = rs

@@ -1,0 +1,35 @@
+"""HTTP edge plane: event-loop frontend + unified admission.
+
+The reference's L1 is a RAM-budgeted concurrency gate (``maxClients``,
+cmd/handler-api.go) in front of an epoll listener (cmd/http/): idle
+keep-alive connections cost a socket, not a thread, and overload is
+shed before the server commits resources to a request. This package is
+that layer for the fork:
+
+  * :mod:`admission` — the ONE place every shed decision is made.
+    ``AdmissionController`` folds the staging-ring exhaustion window,
+    batch-scheduler occupancy, and the RAM/CPU ``maxClients`` budget
+    into a single verdict issued BEFORE any request-body byte is read.
+    The ``tools/check`` ``admission`` lint rule pins the monopoly: a
+    ``SlowDown`` shed or ``requests_shed_total`` increment anywhere
+    else in the tree is an error.
+  * :mod:`dispatch` — the per-request middleware (routing, telemetry
+    spans, latency histograms, trace records) shared by both frontends
+    so they cannot drift.
+  * :mod:`server` — ``EdgeServer``: asyncio event loops (optionally
+    ``SO_REUSEPORT``-sharded) parse request lines + headers and hold
+    idle keep-alive connections at near-zero cost; admitted requests
+    run the unchanged blocking handler layer on a bounded worker pool,
+    reading their bodies zero-copy (``readinto``) into the ``BytePool``
+    staging rings the PUT pipeline owns.
+
+The threaded frontend stays available behind ``MINIO_TPU_EDGE=off`` as
+the escape hatch and correctness oracle (README "HTTP edge and
+admission").
+"""
+
+from .admission import AdmissionController, AdmissionTicket, ShedDecision
+from .server import EdgeServer
+
+__all__ = ["AdmissionController", "AdmissionTicket", "ShedDecision",
+           "EdgeServer"]

@@ -17,7 +17,6 @@ import io
 import json
 import os
 import re
-import threading
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
@@ -41,14 +40,6 @@ from .credentials import Credentials, global_credentials
 from .s3errors import S3Error, api_error_from
 
 MAX_OBJECT_SIZE = 5 * (1 << 40)          # 5 TiB
-
-# requests shed with 503 SlowDown, by trigger: "admission" (the
-# semaphore wait timed out) or "staging" (BytePool exhaustion — the
-# pipeline's staging rings timed out recently, so new writes would
-# stall anyway; shedding them early is the ROADMAP PR-2 follow-up)
-_SHED_TOTAL = telemetry.REGISTRY.counter(
-    "minio_tpu_requests_shed_total",
-    "Requests shed with 503 SlowDown, by reason")
 MAX_PART_SIZE = 5 * (1 << 30)            # 5 GiB
 MIN_PART_SIZE = 5 * (1 << 20)            # 5 MiB
 MAX_PARTS = 10000
@@ -187,13 +178,13 @@ def _parse_range(header: str, size: int) -> Optional[tuple[int, int]]:
 
 
 class _ReleasingStream:
-    """Response-body wrapper that returns its admission slot when the
-    stream is exhausted or closed (whichever comes first)."""
+    """Response-body wrapper that returns its admission ticket when the
+    stream is exhausted or closed (whichever comes first; the ticket's
+    release is idempotent)."""
 
-    def __init__(self, inner, sem: threading.BoundedSemaphore):
+    def __init__(self, inner, ticket):
         self._inner = inner
-        self._sem = sem
-        self._released = False
+        self._ticket = ticket
 
     def __iter__(self):
         try:
@@ -208,9 +199,7 @@ class _ReleasingStream:
             if close is not None:
                 close()
         finally:
-            if not self._released:
-                self._released = True
-                self._sem.release()
+            self._ticket.release()
 
 
 class S3ApiHandlers:
@@ -222,18 +211,14 @@ class S3ApiHandlers:
         self.root_cred = creds or global_credentials()
         self.iam = iam            # optional IAMSys (policy checks + users)
         self.bucket_meta = BucketMetadataSys(object_layer)
-        # Admission gate (cmd/handler-api.go:100 analog). Default is
-        # CPU-proportional: each data-path request runs real erasure and
-        # hashing work, so admitting far more streams than cores only
-        # convoys the GIL and splits the cache working set (excess
-        # requests queue here instead). The cluster boot overrides this
-        # with the full RAM+CPU budget (requests_budget).
-        if max_clients is None:
-            max_clients = knobs.get_int("MINIO_TPU_MAX_CLIENTS") \
-                or max(4, 4 * (os.cpu_count() or 1))
-        self._admission = threading.BoundedSemaphore(max_clients)
-        self.request_deadline = knobs.get_float(
-            "MINIO_TPU_REQUEST_DEADLINE")
+        # The unified admission plane (s3/edge/admission.py): the ONE
+        # place every shed decision — staging window, scheduler
+        # occupancy, the maxClients budget — is made, shared with the
+        # event-loop edge so both frontends refuse identically. The
+        # cluster boot overrides the default gate size with the full
+        # RAM+CPU budget (requests_budget) via set_max_clients().
+        from .edge.admission import AdmissionController
+        self.admission = AdmissionController(max_clients)
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPlane (or the
@@ -269,21 +254,12 @@ class S3ApiHandlers:
         # former so concurrent Selects coalesce
         from ..scan import ScanEngine
         self.scan = ScanEngine()
-        # staging-pressure load shedding: when the pipeline's BytePool
-        # rings time out (exhausted), new data writes are shed with
-        # SlowDown for `shed_window_s` instead of queueing into a
-        # stalled pipeline. Baselined at construction so pre-existing
-        # process-global counters don't trip a fresh handler.
-        from ..parallel import pipeline as _pl
-        self.shed_window_s = knobs.get_float("MINIO_TPU_SHED_WINDOW_S")
-        self._shed_last_exhausted = _pl.pool_pressure()["exhausted"]
-        self._shed_until = 0.0
 
     def set_max_clients(self, n: int) -> None:
         """Re-size the admission gate once topology is known (the
         reference computes maxClients from RAM + drive count,
         cmd/handler-api.go:46-57)."""
-        self._admission = threading.BoundedSemaphore(max(n, 1))
+        self.admission.resize(n)
 
     def set_object_layer(self, object_layer) -> None:
         """Late-bind the ObjectLayer (cluster boot mounts the HTTP routers
@@ -291,6 +267,9 @@ class S3ApiHandlers:
         server also serves peers before newObjectLayer returns)."""
         self.obj = object_layer
         self.bucket_meta.obj = object_layer
+        # the scheduler-occupancy admission signal probes the live
+        # layer's batch formers
+        self.admission.layer = object_layer
 
     # ------------------------------------------------------------------
     # auth
@@ -530,20 +509,22 @@ class S3ApiHandlers:
         # maxClients gate wraps ServeHTTP including the response body
         # (cmd/handler-api.go:100), so a streaming GET holds its slot
         # until the body is fully written (slot released by the
-        # _ReleasingStream when the server closes/exhausts it). Bound
-        # the wait like the reference's deadline: saturated slots must
-        # shed load with 503, not wedge every caller forever. Bind the
-        # semaphore once — set_max_clients may swap self._admission
-        # mid-request, and acquire/release must hit the same object.
-        if self._should_shed(ctx):
-            _SHED_TOTAL.inc(reason="staging")
-            return self._shed_response(
-                ctx, "staging buffers exhausted, retry the request")
-        sem = self._admission
-        if not sem.acquire(timeout=self.request_deadline):
-            _SHED_TOTAL.inc(reason="admission")
-            return self._shed_response(
-                ctx, "server is busy, retry the request")
+        # _ReleasingStream when the server closes/exhausts it). The
+        # event-loop edge admits BEFORE dispatching here (before any
+        # body byte was read) and parks its ticket on the context; the
+        # threaded frontend admits now — its body reader is lazy, so
+        # the decision is still pre-body.
+        from .edge.admission import AdmissionTicket
+        ticket = getattr(ctx, "admission_ticket", None)
+        if ticket is None:
+            got = self.admission.admit(ctx.req.method, ctx.req.path,
+                                       ctx.req.query, ctx.req.headers)
+            if not isinstance(got, AdmissionTicket):
+                # shed: 503 SlowDown + Retry-After + Connection: close
+                # (unloading the server instead of draining a multi-GiB
+                # body into a closing socket)
+                return got.response(ctx.req.path)
+            ticket = got
         release = True
         try:
             try:
@@ -551,50 +532,12 @@ class S3ApiHandlers:
             except Exception as e:  # noqa: BLE001 — map to S3 error XML
                 return self._error_response(ctx, api_error_from(e))
             if resp.stream is not None and not resp.long_poll:
-                resp.stream = _ReleasingStream(resp.stream, sem)
+                resp.stream = _ReleasingStream(resp.stream, ticket)
                 release = False
             return resp
         finally:
             if release:
-                sem.release()
-
-    def _should_shed(self, ctx: RequestContext) -> bool:
-        """True when this request is a data write AND the staging rings
-        reported exhaustion within the shed window. Admitting more
-        writes while the BytePool times out just queues them into a
-        stalled pipeline — shedding with 503 keeps the retry loop on
-        the client, where it belongs (reference maxClients analog,
-        fed by the PR-2 back-pressure counters). Only APIs that
-        actually stage payload bytes shed — metadata ops on object
-        paths (tagging, CompleteMultipartUpload) never touch the
-        BytePool and completing an upload under pressure RELIEVES it."""
-        if ctx.req.method not in ("PUT", "POST"):
-            return False
-        if "/" not in ctx.req.path.lstrip("/"):
-            return False              # bucket-level op, not a data write
-        from .trace import api_name_of
-        if api_name_of(ctx.req.method, ctx.req.path, ctx.req.query,
-                       ctx.req.headers) not in (
-                "PutObject", "UploadPart", "PostObject"):
-            return False
-        import time as _time
-        now = _time.monotonic()
-        from ..parallel import pipeline as _pl
-        exhausted = _pl.pool_pressure()["exhausted"]
-        if exhausted > self._shed_last_exhausted:
-            self._shed_last_exhausted = exhausted
-            self._shed_until = now + self.shed_window_s
-        return now < self._shed_until
-
-    def _shed_response(self, ctx: RequestContext,
-                       message: str) -> HTTPResponse:
-        """503 SlowDown that also CLOSES the connection: shedding must
-        unload the server, and keep-alive hygiene would otherwise
-        drain the full (possibly multi-GiB) request body off the
-        socket at the very moment the server is overloaded."""
-        resp = self._error_response(ctx, S3Error("SlowDown", message))
-        resp.headers["Connection"] = "close"
-        return resp
+                ticket.release()
 
     def _error_response(self, ctx: RequestContext,
                         err: S3Error) -> HTTPResponse:

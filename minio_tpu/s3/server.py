@@ -1,9 +1,20 @@
 """S3 HTTP server — wire transport for the handler layer.
 
 The reference's L1 frontend (cmd/http/, cmd/routers.go) is an epoll Go
-server with a middleware chain; here a threaded stdlib HTTP server feeds
-the same request snapshot into S3ApiHandlers. Streaming: response bodies
-may be chunk iterators (GET path never buffers the whole object).
+server with a middleware chain; here :class:`S3Server` mounts one of
+two transports over the same ``S3ApiHandlers``:
+
+  * the **event-loop edge** (``s3/edge/``, default) — parses headers on
+    an asyncio loop, holds idle keep-alive connections for near-zero
+    cost, and admits each request through the unified
+    ``AdmissionController`` before any body byte is read;
+  * the **threaded frontend** (``MINIO_TPU_EDGE=off``, and always for
+    TLS listeners) — a thread-per-connection stdlib server kept as the
+    escape hatch and correctness oracle.
+
+Both feed the same request snapshot through the same middleware
+(``edge/dispatch.py``). Streaming: response bodies may be chunk
+iterators (GET path never buffers the whole object).
 """
 
 from __future__ import annotations
@@ -12,31 +23,28 @@ import ssl
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import knobs
+from . import signature as sig
+from .credentials import Credentials
+from .edge import EdgeServer
+from .edge.dispatch import finalize_headers, run_request
+from .handlers import HTTPResponse, RequestContext, S3ApiHandlers
+
+SERVER_NAME = "MinIO-TPU"
 
 
 class _DeepBacklogServer(ThreadingHTTPServer):
     """socketserver's default listen backlog is 5: a burst of concurrent
     clients overflows the accept queue and gets connection resets (the
-    reference listener accepts with a deep backlog too)."""
-    request_queue_size = 128
+    reference listener accepts with a deep backlog too). The depth is
+    the MINIO_TPU_REQUEST_QUEUE knob (shared with the edge listeners)."""
     daemon_threads = True
-from typing import Optional
 
-from . import signature as sig
-from ..utils import telemetry
-from .credentials import Credentials
-from .handlers import HTTPResponse, RequestContext, S3ApiHandlers
-
-SERVER_NAME = "MinIO-TPU"
-
-# per-API request latency + time-to-first-byte (reference
-# cmd/metrics.go httpRequestsDuration, labelled by api name)
-_HTTP_DURATION = telemetry.REGISTRY.histogram(
-    "minio_tpu_http_requests_duration_seconds",
-    "Full HTTP request latency (headers to last body byte) per API")
-_HTTP_TTFB = telemetry.REGISTRY.histogram(
-    "minio_tpu_http_ttfb_seconds",
-    "Time to first response byte per API")
+    def __init__(self, *a, **kw):
+        self.request_queue_size = knobs.get_int("MINIO_TPU_REQUEST_QUEUE")
+        super().__init__(*a, **kw)
 
 
 class _BodyReader:
@@ -98,29 +106,19 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
             return ctx
 
         def _respond(self, resp: HTTPResponse) -> None:
-            # CORS (cmd/generic-handlers.go corsHandler): reflect the
-            # allowed origin on every response when the client sent one
-            origin = self.headers.get("Origin")
-            allow = api.cors_allow_origin
-            if origin and allow and \
-                    "Access-Control-Allow-Origin" not in resp.headers:
-                resp.headers["Access-Control-Allow-Origin"] = (
-                    origin if allow == "*" else allow)
-                resp.headers["Access-Control-Expose-Headers"] = (
-                    "ETag, x-amz-version-id, x-amz-request-id")
-            body = resp.body
-            chunked = resp.stream is not None and \
-                "Content-Length" not in resp.headers
-            if resp.headers.get("Connection", "").lower() == "close":
+            # CORS reflection + framing policy shared with the edge
+            # (cmd/generic-handlers.go corsHandler)
+            chunked, wants_close = finalize_headers(
+                api, self.headers.get("Origin"), resp, self.command)
+            if wants_close:
                 # honor a handler-requested close (load shedding): the
                 # socket is being torn down, so the dispatch loop must
                 # also skip draining the request body
                 self.close_connection = True
+            body = resp.body
             self.send_response(resp.status)
             for k, v in resp.headers.items():
                 self.send_header(k, v)
-            if resp.stream is None and "Content-Length" not in resp.headers:
-                self.send_header("Content-Length", str(len(body)))
             if chunked:
                 self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
@@ -182,87 +180,19 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
                 self.send_header("Connection", "close")
                 self.end_headers()
                 return
-            # admin/health/metrics routers get first crack at the path
             ctx = self._snapshot()
-            import time as _time
-            from ..utils import telemetry
-            from .trace import api_name_of
-            api_name = api_name_of(self.command, ctx.req.path,
-                                   ctx.req.query, ctx.req.headers)
-            t0 = _time.perf_counter()
-            status = [500]
-            ttfb = [None]
-
-            root_holder = [None]
-
-            def respond(resp):
-                status[0] = resp.status
-                # TTFB: handler work is done, the status line goes out
-                # now — streaming body time lands in the full duration
-                if ttfb[0] is None:
-                    ttfb[0] = _time.perf_counter() - t0
-                if resp.long_poll and root_holder[0] is not None:
-                    # an idle event stream runs for minutes by design —
-                    # never "slow"
-                    root_holder[0].slow_exempt = True
-                self._respond(resp)
-
-            # root span: covers routing, the handler AND the response
-            # body (a streaming GET's drive reads happen inside it)
-            root_cm = telemetry.trace(api_name, method=self.command,
-                                      path=ctx.req.path)
-            trace_id = ""
-            try:
-                with root_cm as root:
-                    root_holder[0] = root
-                    if api_name in ("Admin", "Health", "Metrics",
-                                    "WebUI"):
-                        # admin surfaces stream on purpose (`mc admin
-                        # trace` idles for its whole window): keeping
-                        # them as "slow" would crowd the spans ring
-                        # with content-free trees. Errors still keep.
-                        root.slow_exempt = True
-                    trace_id = root.trace_id
-                    for prefix, router in extra_routers:
-                        if self.path.startswith(prefix):
-                            resp = router(ctx)
-                            if resp is None:
-                                # router declined (e.g. the web UI owns
-                                # only exact paths under /minio/): keep
-                                # matching later-registered routers
-                                continue
-                            respond(resp)
-                            if resp.status >= 500:
-                                root.error = f"http {resp.status}"
-                            return
-                    respond(api.handle(ctx))
-                    if status[0] >= 500:
-                        root.error = f"http {status[0]}"
-            finally:
-                # keep-alive hygiene: any request-body bytes the handler
-                # didn't consume (auth failure, early error, streaming
-                # trailer) would otherwise be parsed as the next request.
-                # Skipped when the connection is closing anyway (shed
-                # responses) — draining a multi-GiB body into a closing
-                # socket is exactly the load shedding exists to avoid.
-                if not self.close_connection:
-                    ctx.body_stream.drain()
-                dur = _time.perf_counter() - t0
-                try:
-                    _HTTP_DURATION.observe(dur, api=api_name)
-                    if ttfb[0] is not None:
-                        _HTTP_TTFB.observe(ttfb[0], api=api_name)
-                except Exception:  # noqa: BLE001 — telemetry is passive
-                    pass
-                if api.trace is not None:
-                    try:
-                        api.trace.record(
-                            self.command, ctx.req.path, ctx.req.raw_query,
-                            status[0], dur,
-                            caller=self.client_address[0],
-                            api=api_name, trace_id=trace_id)
-                    except Exception:  # noqa: BLE001 — tracing is passive
-                        pass
+            # routing + telemetry + the admission-gated handler all live
+            # in the transport-shared middleware (edge/dispatch.py)
+            run_request(api, extra_routers, ctx, self.command, self.path,
+                        self._respond, caller=self.client_address[0])
+            # keep-alive hygiene: any request-body bytes the handler
+            # didn't consume (auth failure, early error, streaming
+            # trailer) would otherwise be parsed as the next request.
+            # Skipped when the connection is closing anyway (shed
+            # responses) — draining a multi-GiB body into a closing
+            # socket is exactly the load shedding exists to avoid.
+            if not self.close_connection:
+                ctx.body_stream.drain()
 
         def do_OPTIONS(self):
             # CORS preflight
@@ -288,7 +218,7 @@ def _make_handler_class(api: S3ApiHandlers, extra_routers):
 
 
 class S3Server:
-    """Threaded S3 endpoint over an object layer.
+    """S3 endpoint over an object layer — edge or threaded transport.
 
     extra_routers: list of (path_prefix, fn(ctx) -> HTTPResponse) checked
     before S3 routing — used for /minio/admin, /minio/health, metrics.
@@ -302,24 +232,40 @@ class S3Server:
         self.api = S3ApiHandlers(object_layer, region=region, creds=creds,
                                  iam=iam)
         self.extra_routers: list = []
-        self._httpd = _DeepBacklogServer(
-            (address, port),
-            _make_handler_class(self.api, self.extra_routers))
         self.tls = bool(certfile)
-        if certfile:
-            import ssl
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(certfile, keyfile)
-            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
-                                                 server_side=True)
+        self._httpd = None
+        self._edge: Optional[EdgeServer] = None
+        # the edge speaks plaintext only today: TLS listeners keep the
+        # threaded frontend (README "HTTP edge and admission")
+        if knobs.get_bool("MINIO_TPU_EDGE") and not certfile:
+            self._edge = EdgeServer(self.api, self.extra_routers,
+                                    address, port)
+        else:
+            self._httpd = _DeepBacklogServer(
+                (address, port),
+                _make_handler_class(self.api, self.extra_routers))
+            if certfile:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(certfile, keyfile)
+                self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                     server_side=True)
         self._thread: Optional[threading.Thread] = None
 
     @property
+    def edge_enabled(self) -> bool:
+        return self._edge is not None
+
+    @property
     def port(self) -> int:
+        if self._edge is not None:
+            return self._edge.port
         return self._httpd.server_address[1]
 
     @property
     def url(self) -> str:
+        if self._edge is not None:
+            host = self._edge._addr[0]
+            return f"http://{host}:{self._edge.port}"
         host, port = self._httpd.server_address[:2]
         scheme = "https" if self.tls else "http"
         return f"{scheme}://{host}:{port}"
@@ -328,12 +274,18 @@ class S3Server:
         self.extra_routers.append((prefix, fn))
 
     def start(self) -> "S3Server":
+        if self._edge is not None:
+            self._edge.start()
+            return self
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self
 
     def stop(self) -> None:
+        if self._edge is not None:
+            self._edge.stop()
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:

@@ -202,8 +202,34 @@ define("MINIO_TPU_REQUEST_DEADLINE", "float", 10.0,
        "seconds a request waits on admission before SlowDown", _S)
 define("MINIO_TPU_SHED_WINDOW_S", "float", 5.0,
        "shed data writes this long after a staging-pool timeout", _S)
+define("MINIO_TPU_ADMIT_SCHED_QUEUE", "int", 0,
+       "queued device-batch blocks above which data writes shed "
+       "(scheduler-occupancy admission signal; 0 disables)", _S,
+       display="off")
+define("MINIO_TPU_REQUEST_QUEUE", "int", 128,
+       "threaded-listener accept backlog (socketserver "
+       "request_queue_size)", _S)
 define("MINIO_TPU_IAM_REFRESH_S", "float", 300.0,
        "full IAM cache refresh interval (bounded staleness)", _S)
+
+_S = "HTTP edge"
+define("MINIO_TPU_EDGE", "bool", True,
+       "`off` selects the threaded frontend (escape hatch and "
+       "correctness oracle; TLS listeners always use it)", _S)
+define("MINIO_TPU_EDGE_WORKERS", "int", 1,
+       "event-loop threads; >1 binds one SO_REUSEPORT listener per "
+       "loop", _S)
+define("MINIO_TPU_EDGE_MAX_CONNS", "int", 8192,
+       "open-connection budget per edge server; beyond it new "
+       "connections shed 503 before any read", _S)
+define("MINIO_TPU_EDGE_HEADER_S", "float", 10.0,
+       "deadline for a complete request line + headers (slowloris "
+       "partial requests shed at expiry)", _S)
+define("MINIO_TPU_EDGE_IDLE_S", "float", 120.0,
+       "idle keep-alive connection deadline (quiet close)", _S)
+define("MINIO_TPU_EDGE_POOL", "int", 0,
+       "blocking handler worker threads behind the event loop "
+       "(0 = 8×cores + 16)", _S, display="auto")
 
 _S = "Fault plane"
 define("MINIO_TPU_MRF_QUEUE_SIZE", "int", 10000,
@@ -239,6 +265,9 @@ define("MINIO_TPU_TRACE_MAX_SPANS", "int", 512,
        "`spans_dropped`", _S)
 
 _S = "Topology"
+define("MINIO_TPU_REBALANCE_MPU_GRACE_S", "float", 30.0,
+       "live multipart sessions idle less than this get a grace "
+       "before the decommission drain migrates them off the pool", _S)
 define("MINIO_TPU_REBALANCE_CHECKPOINT_EVERY", "int", 16,
        "objects moved between drain checkpoints", _S)
 define("MINIO_TPU_REBALANCE_PAGE", "int", 256,

@@ -1,0 +1,34 @@
+"""CI pin for the HTTP-frontend A/B smoke: `bench.py --ab-edge-smoke`
+must keep producing its shape — the edge holding ≥20× the threaded
+frontend's idle keep-alive connections with NO extra threads, PUT/GET
+percentiles for both transports at matched load, and the
+shed-before-body probe proving every refusal is counted in
+minio_tpu_requests_shed_total{reason} with zero body bytes sent —
+in seconds; the gate beside tier1_diff that keeps the bench runnable."""
+
+
+def test_ab_edge_smoke_shape():
+    import bench
+    ab = bench.bench_edge_ab(streams=(2,), size=1 << 18, rounds=2,
+                             idle_conns=60, idle_ratio=20, drives=6,
+                             block=1 << 16)
+    assert set(ab) >= {"config", "edge", "threaded", "idle_conn_ratio_x",
+                       "put_p99_edge_vs_threaded_x", "saturation_sheds"}
+    # the acceptance pin: >= 20x the threaded frontend's idle conns,
+    # held as sockets (no thread per connection) and still alive after
+    # the load phase ran over them
+    assert ab["idle_conn_ratio_x"] >= 20.0
+    assert ab["edge"]["idle"]["conns"] >= 60
+    assert ab["edge"]["idle"]["threads_delta"] == 0
+    assert ab["edge"]["idle"]["alive_after_load"] is True
+    assert ab["threaded"]["idle"]["alive_after_load"] is True
+    for side in ("edge", "threaded"):
+        for point in ab[side]["points"]:
+            assert point["put"]["p99_ms"] > 0
+            assert point["get"]["p99_ms"] > 0
+    # every saturation shed counted, no body byte read for any of them
+    sheds = ab["saturation_sheds"]
+    assert sheds["refused_503"] >= 1
+    assert sheds["counter_delta"].get("admission", 0) == \
+        sheds["refused_503"]
+    assert sheds["body_bytes_sent"] == 0

@@ -481,3 +481,39 @@ class ErasureServerSets:
         _src("minio_tpu/cluster.py", "def boot(layer):\n    pass\n")])
     assert any("never calls attach_replication" in v.message
                for v in vs3)
+
+
+# ---------------------------------------------------------------------------
+# rule: admission
+# ---------------------------------------------------------------------------
+
+BAD_SHED = '''
+from minio_tpu.s3.s3errors import S3Error
+from minio_tpu.utils import telemetry
+def shed(self, ctx):
+    telemetry.REGISTRY.counter(
+        "minio_tpu_requests_shed_total",
+        "Requests shed").inc(reason="ad-hoc")
+    raise S3Error("SlowDown", "go away")
+'''
+
+
+def test_admission_rule_fires_on_stray_shed():
+    """A SlowDown decision or a requests_shed_total reference outside
+    the AdmissionController module is an error (migrating the
+    handlers' original shed window is what proved this fires)."""
+    vs = rules_ast.check_admission(
+        [_src("minio_tpu/s3/handlers.py", BAD_SHED)])
+    msgs = "\n".join(v.message for v in vs)
+    assert "S3Error(\"SlowDown\")" in msgs
+    assert "requests_shed_total" in msgs
+    assert len(vs) == 2
+
+
+def test_admission_rule_quiet_in_controller_and_on_tree():
+    # the controller module itself is the ONE exempt home
+    assert rules_ast.check_admission(
+        [_src("minio_tpu/s3/edge/admission.py", BAD_SHED)]) == []
+    # the committed tree is clean: the handlers' shed window migrated
+    from check.core import load_sources
+    assert rules_ast.check_admission(load_sources()) == []

@@ -1,0 +1,314 @@
+"""HTTP edge tests: connection hygiene of the event-loop frontend and
+oracle parity with the threaded server (MINIO_TPU_EDGE=off).
+
+The tier-1 pins of ISSUE 12's acceptance list: keep-alive reuse across
+requests, slowloris partial-header sheds without a thread leak (the
+conftest sentinel rides along on every test here), admission sheds
+answered BEFORE any body byte is read with the counter delta proven,
+mid-body client death freeing the staging reservation, and 503
+SlowDown responses carrying Retry-After + close on BOTH transports.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import os
+import socket
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+from minio_tpu.utils import telemetry
+
+CREDS = Credentials("testadminkey", "testadminsecretkey")
+REGION = "us-east-1"
+BLOCK = 1 << 16
+
+
+@pytest.fixture(scope="module")
+def layer(tmp_path_factory):
+    root = tmp_path_factory.mktemp("edgedrives")
+    sets = ErasureSets.from_drives(
+        [str(root / f"d{i}") for i in range(6)], 1, 6, 2,
+        block_size=BLOCK)
+    yield sets
+    sets.close()
+
+
+def _mk_server(layer, **env) -> S3Server:
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return S3Server(layer, creds=CREDS, region=REGION).start()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture()
+def edge_server(layer):
+    srv = _mk_server(layer, MINIO_TPU_EDGE="on")
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(params=["edge", "threaded"])
+def any_server(request, layer):
+    srv = _mk_server(
+        layer,
+        MINIO_TPU_EDGE="on" if request.param == "edge" else "off")
+    assert srv.edge_enabled == (request.param == "edge")
+    yield srv
+    srv.stop()
+
+
+def _signed_headers(method: str, path: str, port: int,
+                    payload_hash: str = sig.UNSIGNED_PAYLOAD,
+                    extra: dict | None = None) -> dict:
+    hdrs = {"host": f"127.0.0.1:{port}"}
+    hdrs.update(extra or {})
+    return sig.sign_v4(method, urllib.parse.quote(path), {}, hdrs,
+                       payload_hash, CREDS, REGION)
+
+
+def _request(port: int, method: str, path: str, body: bytes = b"",
+             sign: bool = True):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    hdrs = _signed_headers(method, path, port,
+                           hashlib.sha256(body).hexdigest()) \
+        if sign else {"host": f"127.0.0.1:{port}"}
+    conn.request(method, urllib.parse.quote(path), body=body,
+                 headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = {k.lower(): v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, headers, data
+
+
+def _read_http_response(sock: socket.socket) -> tuple[int, dict, bytes]:
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for line in lines[1:]:
+        name, _, value = line.partition(":")
+        headers[name.strip().lower()] = value.strip()
+    want = int(headers.get("content-length", 0))
+    while len(rest) < want:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return status, headers, rest[:want], rest[want:]
+
+
+def _shed_value(reason: str) -> float:
+    return telemetry.REGISTRY.counter(
+        "minio_tpu_requests_shed_total").value(reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# keep-alive
+# ---------------------------------------------------------------------------
+
+def test_keepalive_reuse_across_requests(any_server):
+    """One TCP connection serves a whole signed request sequence —
+    bucket create, object PUT, GET, DELETE — without the server
+    closing between requests (http.client raises on a dead reuse)."""
+    port = any_server.port
+    bucket = f"kab-{port}"            # module-shared layer: per-server
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    body = b"edge keep-alive payload " * 64
+
+    def go(method, path, payload=b""):
+        hdrs = _signed_headers(method, path, port,
+                               hashlib.sha256(payload).hexdigest())
+        conn.request(method, path, body=payload, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        assert not resp.will_close, (method, path)
+        return resp.status, data
+
+    assert go("PUT", f"/{bucket}")[0] == 200
+    assert go("PUT", f"/{bucket}/obj", body)[0] == 200
+    st, data = go("GET", f"/{bucket}/obj")
+    assert st == 200 and data == body
+    assert go("DELETE", f"/{bucket}/obj")[0] == 204
+    conn.close()
+
+
+def test_pipelined_requests_carry_over(edge_server):
+    """Two requests written in ONE segment: the loop's leftover buffer
+    must hand the second request over after the first response (the
+    keep-alive re-arm path)."""
+    port = edge_server.port
+    req = (f"GET / HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+           "\r\n").encode()
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=30) as s:
+        s.sendall(req + req)          # unsigned: both answer 403
+        st1, h1, body1, extra = _read_http_response(s)
+        assert st1 == 403 and b"<Error>" in body1
+        if extra:
+            # second response already buffered behind the first
+            class _Pre:
+                def __init__(self, pre, inner):
+                    self.pre, self.inner = pre, inner
+
+                def recv(self, n):
+                    if self.pre:
+                        out, self.pre = self.pre[:n], self.pre[n:]
+                        return out
+                    return self.inner.recv(n)
+            st2, _, body2, _ = _read_http_response(_Pre(extra, s))
+        else:
+            st2, _, body2, _ = _read_http_response(s)
+        assert st2 == 403 and b"<Error>" in body2
+
+
+# ---------------------------------------------------------------------------
+# sheds: before the first body byte, counted, Retry-After + close
+# ---------------------------------------------------------------------------
+
+def test_admission_shed_before_body_byte(edge_server):
+    """The maxClients budget refuses BEFORE reading the body: the
+    client sends headers announcing a 1 MiB body and NOTHING else — a
+    server that waited for body bytes would hang; the edge answers 503
+    with Retry-After + close, and the shed lands in
+    minio_tpu_requests_shed_total{reason="admission"} (the counter
+    delta this ISSUE's acceptance list pins)."""
+    api = edge_server.api
+    api.admission.resize(1)
+    api.admission.deadline = 0.2
+    hold = api.admission.admit("GET", "/held/k", {}, {})
+    before = _shed_value("admission")
+    try:
+        port = edge_server.port
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            head = (f"PUT /shedb/obj HTTP/1.1\r\n"
+                    f"Host: 127.0.0.1:{port}\r\n"
+                    f"Content-Length: {1 << 20}\r\n\r\n").encode()
+            s.sendall(head)           # zero body bytes follow
+            st, headers, body, _ = _read_http_response(s)
+            assert st == 503 and b"SlowDown" in body
+            assert headers.get("connection") == "close"
+            assert int(headers.get("retry-after", 0)) >= 1
+            assert s.recv(16) == b""  # server closed the socket
+        assert _shed_value("admission") == before + 1
+    finally:
+        hold.release()
+        api.admission.deadline = 10.0
+
+
+def test_staging_shed_parity_retry_after_and_close(any_server):
+    """A staging-window shed answers identically on BOTH transports:
+    503 SlowDown XML, Retry-After, Connection: close (the threaded
+    server is the oracle for the edge's shed path)."""
+    api = any_server.api
+    api.admission._shed_until = time.monotonic() + 30.0
+    before = _shed_value("staging")
+    try:
+        st, headers, body = _request(any_server.port, "PUT",
+                                     "/parb/obj", b"x" * 64,
+                                     sign=False)
+        assert st == 503 and b"SlowDown" in body
+        assert headers.get("connection") == "close"
+        assert int(headers.get("retry-after", 0)) >= 1
+        assert _shed_value("staging") == before + 1
+    finally:
+        api.admission._shed_until = 0.0
+
+
+def test_slowloris_partial_header_sheds_not_leaks(layer):
+    """A trickled request line misses the header deadline: the loop
+    sheds it (503 + close, reason="deadline") and the connection count
+    returns to zero — no thread held, and the conftest thread-leak
+    sentinel proves no worker leaked."""
+    srv = _mk_server(layer, MINIO_TPU_EDGE="on",
+                     MINIO_TPU_EDGE_HEADER_S="0.3")
+    try:
+        before = _shed_value("deadline")
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=30) as s:
+            s.sendall(b"PUT /slow/loris HTTP/1.1\r\nHost: tri")
+            t0 = time.monotonic()
+            st, headers, body, _ = _read_http_response(s)
+            assert st == 503 and b"SlowDown" in body
+            assert headers.get("connection") == "close"
+            assert "retry-after" in headers
+            assert 0.2 < time.monotonic() - t0 < 10.0
+            assert s.recv(16) == b""
+        assert _shed_value("deadline") == before + 1
+        deadline = time.monotonic() + 5.0
+        while srv._edge.conn_count() > 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv._edge.conn_count() == 0
+    finally:
+        srv.stop()
+
+
+def test_idle_connection_reaped_quietly(layer):
+    """An idle keep-alive connection past the idle deadline closes
+    WITHOUT a shed (reaping idle sockets is bookkeeping, not load
+    shedding)."""
+    srv = _mk_server(layer, MINIO_TPU_EDGE="on",
+                     MINIO_TPU_EDGE_IDLE_S="0.3")
+    try:
+        before = _shed_value("deadline")
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=30) as s:
+            assert s.recv(16) == b""      # quiet close, no response
+        assert _shed_value("deadline") == before
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# mid-body client death
+# ---------------------------------------------------------------------------
+
+def test_midbody_death_frees_staging_and_slot(edge_server):
+    """A client dying mid-PUT-body must not strand its admission slot
+    or its BytePool staging reservation: after several kills the gate
+    reads zero in-flight and a normal PUT still succeeds (leaked
+    staging buffers would wedge it)."""
+    port = edge_server.port
+    api = edge_server.api
+    size = 1 << 20
+    for _ in range(6):
+        hdrs = _signed_headers("PUT", "/killb/obj", port)
+        hdrs["content-length"] = str(size)
+        head = "PUT /killb/obj HTTP/1.1\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in hdrs.items()) + "\r\n"
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s.sendall(head.encode() + b"z" * (size // 4))
+        s.close()                         # die mid-body
+    deadline = time.monotonic() + 15.0
+    while api.admission.in_use() > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert api.admission.in_use() == 0
+    # staging rings intact: a full-size PUT round-trips
+    _request(port, "PUT", "/killb", sign=True)
+    body = os.urandom(size)
+    st, _, _ = _request(port, "PUT", "/killb/whole", body)
+    assert st == 200
+    st, _, got = _request(port, "GET", "/killb/whole")
+    assert st == 200 and got == body

@@ -573,3 +573,48 @@ def test_null_version_pushes_its_own_bytes_under_versioned_history(
     assert got_null == b"null-era-bytes"
     assert b"".join(B.get_object("b", "mixed")[1]) == b"versioned-bytes"
     _close(planeA, planeB)
+
+
+def test_per_target_lag_surface(tmp_path):
+    """ROADMAP item 4 remainder: the plane reports per-target queue
+    depth, oldest-pending age, last-sync timestamp and last lag — the
+    admin-plane JSON twin of minio_tpu_repl_lag_seconds{target}."""
+    import time as _time
+    A, regA, planeA = _mk_site(tmp_path, "siteA")
+    B, regB, planeB = _mk_site(tmp_path, "siteB")
+    arn_ab, _arn_ba = _pair(regA, A, regB, B)
+
+    t0 = _time.time()
+    A.put_object("b", "lagged", b"x" * 2048,
+                 opts=PutOptions(versioned=True))
+    _settle(planeA, planeB)
+
+    st = planeA.target_status()
+    assert arn_ab in st
+    entry = st[arn_ab]
+    assert entry["bucket"] == "b"
+    assert entry["synced"] >= 1 and entry["failed"] == 0
+    assert entry["last_sync"] >= t0
+    assert entry["last_lag_s"] is not None and entry["last_lag_s"] >= 0
+    assert entry["queue_depth"] == 0 and entry["oldest_pending_s"] == 0.0
+
+    # a queued-but-unsynced key shows up as live depth + pending age
+    planeA._stop.set()                      # park the workers
+    planeA._stop.clear()
+    with planeA._cond:
+        planeA._queue.append(("b", "stuck", _time.time() - 5.0))
+        planeA._pending.add(("b", "stuck"))
+    entry = planeA.target_status()[arn_ab]
+    assert entry["queue_depth"] == 1
+    assert entry["oldest_pending_s"] >= 4.0
+    with planeA._cond:
+        planeA._queue.clear()
+        planeA._pending.clear()
+
+    # the histogram rides a per-target label
+    from minio_tpu.utils import telemetry
+    hist = telemetry.REGISTRY.histogram("minio_tpu_repl_lag_seconds")
+    with hist._mu:
+        labels = [dict(k) for k in hist._series]
+    assert any(lbl.get("target") == arn_ab for lbl in labels)
+    _close(planeA, planeB)

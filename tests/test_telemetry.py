@@ -311,7 +311,9 @@ def test_slowdown_on_staging_pressure(server, client):
                                query={"tagging": ""})
         assert st == 200
     finally:
-        api._shed_until = 0.0          # expire the pressure window
+        # expire the pressure window (the unified admission plane owns
+        # the shed state now)
+        api.admission._shed_until = 0.0
     assert client.request("PUT", "/telb/shedme", body=b"x" * 64)[0] == 200
 
 

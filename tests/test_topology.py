@@ -402,3 +402,148 @@ def test_disk_monitor_covers_post_boot_pool(tmp_path):
     finally:
         mon.close()
         zz.close()
+
+
+# ---------------------------------------------------------------------------
+# decommission drains LIVE multipart sessions (carried-over item 6)
+# ---------------------------------------------------------------------------
+
+def test_decommission_migrates_live_multipart_session(pools):
+    """A session in flight on the draining pool is actively migrated —
+    metadata, uploaded parts and the client-held uploadID all survive,
+    the drain completes without waiting the client out, and the upload
+    finishes normally against the new pool."""
+    zz = pools
+    part = b"p" * (6 << 20)                 # > MIN_PART_SIZE
+    uid = zz.new_multipart_upload("b", "mpu-live")
+    src = zz._zone_index_of_upload("b", "mpu-live", uid)
+    p1 = zz.put_object_part("b", "mpu-live", uid, 1, part, len(part))
+
+    zz.start_decommission(src, mpu_grace_s=0.0, **NEVER_BUSY)
+    wait_status(zz, "complete")
+    # the session left the draining pool, same uploadID
+    dst = zz._zone_index_of_upload("b", "mpu-live", uid)
+    assert dst != src
+    listed = zz.list_object_parts("b", "mpu-live", uid)
+    assert [(p.part_number, p.etag, p.size) for p in listed] == \
+        [(1, p1.etag, len(part))]
+    st = zz.rebalance_status()["rebalance"]
+    assert st["mpu_migrated"] >= 1 and st["mpu_failed"] == 0
+
+    # the client finishes the upload with no idea anything moved
+    from minio_tpu.object.multipart import CompletePart
+    part2 = b"q" * (1 << 20)
+    p2 = zz.put_object_part("b", "mpu-live", uid, 2, part2, len(part2))
+    info = zz.complete_multipart_upload(
+        "b", "mpu-live", uid,
+        [CompletePart(1, p1.etag), CompletePart(2, p2.etag)])
+    assert info.size == len(part) + len(part2)
+    got_info, stream = zz.get_object("b", "mpu-live")
+    body = b"".join(stream)
+    assert body == part + part2
+    # the committed object never landed in the drained pool
+    assert holders(zz, "b", "mpu-live") == [dst]
+
+
+def test_draining_pool_refuses_new_parts_via_migration(pools):
+    """Before the rebalancer even reaches the session, a part-write
+    aimed at a draining pool migrates the session to an active pool
+    and lands there (draining pools stop accepting NEW parts)."""
+    zz = pools
+    part = b"x" * (6 << 20)
+    uid = zz.new_multipart_upload("b", "mpu-guard")
+    src = zz._zone_index_of_upload("b", "mpu-guard", uid)
+    p1 = zz.put_object_part("b", "mpu-guard", uid, 1, part, len(part))
+    # flip the pool draining WITHOUT starting the walker
+    zz.set_pool_state(src, POOL_DRAINING)
+    try:
+        part2 = b"y" * (1 << 20)
+        zz.put_object_part("b", "mpu-guard", uid, 2, part2, len(part2))
+        dst = zz._zone_index_of_upload("b", "mpu-guard", uid)
+        assert dst != src
+        listed = zz.list_object_parts("b", "mpu-guard", uid)
+        assert [p.part_number for p in listed] == [1, 2]
+        assert listed[0].etag == p1.etag
+    finally:
+        zz.set_pool_state(src, POOL_ACTIVE)
+
+
+def test_mpu_migration_crash_window_converges(pools):
+    """A crash between the parts copy and the source abort leaves the
+    session in two pools. Clients continue on the writable target; a
+    re-run copies only what the target lacks and never overwrites a
+    newer client part; and once the client COMPLETES the upload, the
+    sweep purges the stale source leftover instead of resurrecting a
+    zombie session."""
+    zz = pools
+    part = b"m" * (6 << 20)
+    uid = zz.new_multipart_upload("b", "mpu-crash")
+    src = zz._zone_index_of_upload("b", "mpu-crash", uid)
+    p1 = zz.put_object_part("b", "mpu-crash", uid, 1, part, len(part))
+    zz.set_pool_state(src, POOL_DRAINING)
+    try:
+        # crash the migration right before the source abort
+        src_zone = zz.server_sets[src]
+        real_abort = src_zone.abort_multipart_upload
+        calls = []
+
+        def dying_abort(*a, **kw):
+            calls.append(a)
+            raise RuntimeError("crash before source abort")
+
+        src_zone.abort_multipart_upload = dying_abort
+        with pytest.raises(RuntimeError):
+            zz.migrate_upload("b", "mpu-crash", uid, source=src)
+        src_zone.abort_multipart_upload = real_abort
+        # dual-homed now; the writable twin owns client traffic
+        dst = zz._zone_index_of_upload("b", "mpu-crash", uid)
+        assert dst != src and zz.topology.can_write(dst)
+
+        # client overwrites part 1 on the target (newer bytes)
+        newer = b"n" * (6 << 20)
+        p1b = zz.put_object_part("b", "mpu-crash", uid, 1, newer,
+                                 len(newer))
+        # sweep re-run: resumes at the existing twin, target parts win
+        assert zz.migrate_upload("b", "mpu-crash", uid,
+                                 source=src) == dst
+        listed = zz.list_object_parts("b", "mpu-crash", uid)
+        assert [(p.part_number, p.etag) for p in listed] == \
+            [(1, p1b.etag)]
+        # source leftover gone
+        with pytest.raises(api_errors.InvalidUploadID):
+            zz.server_sets[src].list_object_parts("b", "mpu-crash",
+                                                  uid, max_parts=1)
+
+        # consumed-leftover path: crash again, then the client
+        # completes on the target — the sweep must purge, not resurrect
+        uid2 = zz.new_multipart_upload("b", "mpu-done")
+        # route the session onto the ACTIVE pool (dst) so the drained
+        # pool is its migration target? No: create on active, drain
+        # that one instead.
+        s2 = zz._zone_index_of_upload("b", "mpu-done", uid2)
+        q1 = zz.put_object_part("b", "mpu-done", uid2, 1, part,
+                                len(part))
+        if s2 != src:
+            zz.set_pool_state(src, POOL_ACTIVE)
+            zz.set_pool_state(s2, POOL_DRAINING)
+        z2 = zz.server_sets[s2]
+        real_abort2 = z2.abort_multipart_upload
+        z2.abort_multipart_upload = dying_abort
+        with pytest.raises(RuntimeError):
+            zz.migrate_upload("b", "mpu-done", uid2, source=s2)
+        z2.abort_multipart_upload = real_abort2
+        from minio_tpu.object.multipart import CompletePart
+        zz.complete_multipart_upload("b", "mpu-done", uid2,
+                                     [CompletePart(1, q1.etag)])
+        # the stale source leftover is purged as consumed
+        with pytest.raises(api_errors.InvalidUploadID):
+            zz.migrate_upload("b", "mpu-done", uid2, source=s2)
+        with pytest.raises(api_errors.InvalidUploadID):
+            z2.list_object_parts("b", "mpu-done", uid2, max_parts=1)
+        # and the completed object reads back whole
+        _info, stream = zz.get_object("b", "mpu-done")
+        assert b"".join(stream) == part
+    finally:
+        for i in range(len(zz.server_sets)):
+            if zz.topology.state(i) != POOL_ACTIVE:
+                zz.set_pool_state(i, POOL_ACTIVE)

@@ -421,3 +421,41 @@ def check_knob_env(sources: List[Source],
                             f"raw `{name} in os.environ` — use "
                             "knobs.is_set()"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: admission
+# ---------------------------------------------------------------------------
+
+# The ONE module allowed to make shed decisions: every SlowDown
+# construction and every requests_shed_total reference lives here, so
+# the edge, the threaded frontend and the handlers cannot each grow a
+# private shed path that diverges in counters or Retry-After/close
+# semantics (migrating the handlers' original shed window into the
+# controller is what proved this rule fires).
+ADMISSION_MODULE = "minio_tpu/s3/edge/admission.py"
+SHED_COUNTER = "minio_tpu_requests_shed_total"
+
+
+def check_admission(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    for src in sources:
+        if src.rel == ADMISSION_MODULE:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and node.args and \
+                    dotted(node.func).split(".")[-1] == "S3Error" and \
+                    str_const(node.args[0]) == "SlowDown":
+                out.append(Violation(
+                    "admission", src.rel, node.lineno,
+                    "S3Error(\"SlowDown\") constructed outside the "
+                    "AdmissionController — every shed decision must go "
+                    f"through {ADMISSION_MODULE}"))
+            elif isinstance(node, ast.Constant) and \
+                    node.value == SHED_COUNTER:
+                out.append(Violation(
+                    "admission", src.rel, node.lineno,
+                    f"{SHED_COUNTER} referenced outside the "
+                    "AdmissionController — shed accounting has ONE "
+                    f"home, {ADMISSION_MODULE}"))
+    return out

@@ -21,6 +21,8 @@ Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
                     on_degraded_write
   error-map         every api_errors class mapped in s3errors (or
                     INTERNAL_ONLY); every referenced code in ERROR_TABLE
+  admission         SlowDown sheds + requests_shed_total live ONLY in
+                    s3/edge/admission.py (the unified admission plane)
 """
 
 from __future__ import annotations
@@ -63,6 +65,8 @@ def run_checks(rules=None):
         vs += rules_project.check_hook_coverage(sources)
     if "error-map" in selected:
         vs += rules_project.check_error_map(sources)
+    if "admission" in selected:
+        vs += rules_ast.check_admission(sources)
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
