@@ -322,7 +322,9 @@ def bench_cpu_baseline() -> tuple[float, dict]:
 
 
 def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
-                      drives: int = 16, parity: int = 4) -> dict:
+                      drives: int = 16, parity: int = 4,
+                      spans_api: str = "", spans_trace_id: str = ""
+                      ) -> dict:
     """Pipeline on/off A/B on BASELINE config #2 (`streams` concurrent
     `size`-byte PutObject streams, EC 12+4, 1 MiB blocks) through the
     engine data path on tmpfs drives. Per mode: aggregate PUT/GET GiB/s,
@@ -450,8 +452,11 @@ def bench_pipeline_ab(streams: int = 32, size: int = 16 << 20,
                     "telemetry": {
                         "metrics_cumulative": telemetry.REGISTRY
                         .snapshot("minio_tpu_"),
+                        # --spans-api/--spans-trace-id narrow the dump
+                        # with the /spans endpoint's own filters
                         "top_spans": telemetry.SPANS.dump(
-                            5, slowest=True),
+                            5, slowest=True, name=spans_api,
+                            trace_id=spans_trace_id),
                     },
                 }
                 if mode == "pipelined":
@@ -536,6 +541,29 @@ def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
     from minio_tpu.object import codec as codec_mod
     from minio_tpu.object.sets import ErasureSets
     from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.utils import telemetry
+
+    def stage_snap() -> dict:
+        return dict(telemetry.REGISTRY.snapshot(
+            "minio_tpu_device_dispatch_seconds").get(
+            "minio_tpu_device_dispatch_seconds", {}))
+
+    def stage_split(before: dict) -> dict:
+        """Per-verb mean ms per dispatch stage since `before` — the
+        queue/transfer/compute/fetch attribution of ISSUE 13 pillar c,
+        read back from the registry histogram deltas."""
+        split: dict = {}
+        for lk, v in stage_snap().items():
+            b = before.get(lk, {"sum": 0, "count": 0})
+            dc = v["count"] - b["count"]
+            if dc <= 0:
+                continue
+            labels = dict(p.split("=", 1) for p in lk.split(","))
+            split.setdefault(labels.get("verb", "?"), {})[
+                labels.get("stage", "?")] = {
+                "mean_ms": round((v["sum"] - b["sum"]) / dc * 1e3, 3),
+                "n": dc}
+        return split
 
     if force_device is None:
         force_device = not codec_mod._device_is_tpu()
@@ -598,6 +626,7 @@ def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
                 assert n == size, (i, n)
 
             snap = stat_delta(None)
+            sstage = stage_snap() if sched is not None else {}
             t0 = time.perf_counter()
             with cf.ThreadPoolExecutor(max_workers=n_streams) as ex:
                 list(ex.map(put_one, range(n_streams)))
@@ -605,6 +634,8 @@ def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
             res["put_gib_s"] = round(
                 n_streams * size / put_wall / 2**30, 4)
             res["sched_put"] = stat_delta(snap)
+            if sched is not None:
+                res["stages_put"] = stage_split(sstage)
 
             get_one(0)                     # warm the GET path
             t0 = time.perf_counter()
@@ -634,6 +665,7 @@ def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
                         os.remove(f)
             get_one(0)     # warm (compiles the fused decode program)
             snap = stat_delta(None)
+            sstage = stage_snap() if sched is not None else {}
             t0 = time.perf_counter()
             with cf.ThreadPoolExecutor(max_workers=n_streams) as ex:
                 list(ex.map(get_one, range(n_streams)))
@@ -641,6 +673,8 @@ def bench_saturation(streams: Sequence[int] = (1, 2, 4, 8, 16, 32),
                 n_streams * size / (time.perf_counter() - t0) / 2**30,
                 4)
             res["sched_deg_get"] = stat_delta(snap)
+            if sched is not None:
+                res["stages_deg_get"] = stage_split(sstage)
         finally:
             sets.close()
             if sched is not None:
@@ -1620,6 +1654,213 @@ def bench_edge_ab(streams=(4, 16), size: int = 1 << 20,
     return out
 
 
+def bench_obs_ab(streams: int = 8, size: int = 1 << 20,
+                 drives: int = 6, parity: int = 2, block: int = 1 << 18,
+                 node_counts: Sequence[int] = (1, 2, 4, 8),
+                 put_rounds: int = 4, attrib_reps: int = 12,
+                 attrib_batch: int = 8) -> dict:
+    """Observability-plane A/B (ISSUE 13): what the cluster
+    observability layer itself costs.
+
+    Phase 1 — federated-scrape merge latency vs node count: a real
+    node-shaped exposition (this process's live registry render) is
+    merged N-ways through utils/promfed — the exact path the admin
+    ?cluster=1 route runs after its peer fan-out — reporting merge wall
+    time and output size per node count, plus one authenticated HTTP
+    scrape of the single live server's admin /metrics route
+    (local_scrape_*: render + auth + transport floor — the bench's
+    server has no peer plane, so the RPC fan-out itself is not in this
+    number; tests/test_obs.py times the real 2-node federated path).
+
+    Phase 2 — trace-follow overhead on the foreground: concurrent
+    signed HTTP PUT rounds, p50/p99 WITHOUT vs WITH a live ?follow=1
+    subscriber consuming the stream (`mc admin trace` running against
+    a busy box must be near-free).
+
+    Phase 3 — telemetry_overhead_x with dispatch attribution on/off:
+    identical fused encode batches through two BatchSchedulers, one
+    with MINIO_TPU_SCHED_ATTRIB=off — the cost of the stage histograms
+    + stage spans themselves (device route forced so the dispatch path
+    actually runs on CPU-only hosts; warmed, best-of medians).
+    """
+    import concurrent.futures as cf
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+    import urllib.parse
+
+    from minio_tpu import bitrot as bitrot_mod
+    from minio_tpu.madmin import AdminClient
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.admin import mount_admin
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.utils import promfed, telemetry
+
+    creds = Credentials("benchobskey12", "benchobssecret12")
+    region = "us-east-1"
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "node_counts": list(node_counts),
+                            "put_rounds": put_rounds,
+                            "attrib_reps": attrib_reps}}
+
+    def pcts(lat: list[float]) -> dict:
+        lat = sorted(lat)
+        return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(lat[min(int(len(lat) * 0.99),
+                                        len(lat) - 1)] * 1e3, 3)}
+
+    # -- phase 1: merge latency vs node count ---------------------------
+    exposition = telemetry.REGISTRY.render()
+    merge_points = []
+    for n in node_counts:
+        nodes = [(f"node{i}:9000", exposition) for i in range(n)]
+        reps = []
+        merged = ""
+        for _ in range(3):
+            t0 = time.perf_counter()
+            merged = promfed.merge_expositions(nodes)
+            reps.append(time.perf_counter() - t0)
+        merge_points.append({
+            "nodes": n,
+            "merge_ms": round(_median(reps) * 1e3, 3),
+            "input_bytes": n * len(exposition),
+            "output_bytes": len(merged)})
+    out["cluster_scrape"] = {"points": merge_points,
+                             "exposition_bytes": len(exposition)}
+
+    # -- phases 2+3 need a live server / scheduler ----------------------
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_obs_", dir=base)
+    payload = os.urandom(size)
+    was_is_tpu = codec_mod._IS_TPU
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)
+        srv = S3Server(sets, creds=creds, region=region).start()
+        mount_admin(srv)
+        mc = AdminClient("127.0.0.1", srv.port, creds.access_key,
+                         creds.secret_key)
+        try:
+            t0 = time.perf_counter()
+            text = mc.node_metrics()
+            out["cluster_scrape"]["local_scrape_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            out["cluster_scrape"]["local_scrape_bytes"] = len(text)
+
+            def signed(method, path, port, payload_hash, extra=None):
+                hdrs = {"host": f"127.0.0.1:{port}"}
+                hdrs.update(extra or {})
+                return sig.sign_v4(method, urllib.parse.quote(path), {},
+                                   hdrs, payload_hash, creds, region)
+
+            assert _http_put(srv.port, "/bench-obs", b"", signed,
+                             creds) == 200
+            assert _http_put(srv.port, "/bench-obs/warm", payload,
+                             signed, creds) == 200    # engine warm-up
+
+            def put_round(prefix: str) -> list[float]:
+                lat: list[float] = []
+                mu = threading.Lock()
+
+                def one(i: int) -> None:
+                    t0 = time.perf_counter()
+                    st = _http_put(srv.port,
+                                   f"/bench-obs/{prefix}-{i}", payload,
+                                   signed, creds)
+                    dt = time.perf_counter() - t0
+                    assert st == 200, st
+                    with mu:
+                        lat.append(dt)
+
+                for r in range(put_rounds):
+                    with cf.ThreadPoolExecutor(
+                            max_workers=streams) as ex:
+                        list(ex.map(one, range(r * streams,
+                                               (r + 1) * streams)))
+                return lat
+
+            base_lat = put_round("base")
+            stop = threading.Event()
+            consumed = [0]
+
+            def follower() -> None:
+                try:
+                    for _e in mc.trace_follow(timeout=120):
+                        consumed[0] += 1
+                        if stop.is_set():
+                            return
+                except Exception:  # noqa: BLE001 — stream torn at stop
+                    pass
+
+            ft = threading.Thread(target=follower, daemon=True)
+            ft.start()
+            time.sleep(0.3)                 # subscription armed
+            follow_lat = put_round("follow")
+            stop.set()
+            out["trace_follow"] = {
+                "baseline": pcts(base_lat),
+                "with_follow": pcts(follow_lat),
+                "entries_consumed": consumed[0],
+                "put_p99_overhead_x": round(
+                    pcts(follow_lat)["p99_ms"]
+                    / max(pcts(base_lat)["p99_ms"], 1e-9), 3)}
+        finally:
+            srv.stop()
+            sets.close()
+
+        # -- phase 3: attribution on/off ---------------------------------
+        codec_mod._IS_TPU = True            # force the device route so
+        codec_mod.DEVICE_MIN_BYTES = 0      # dispatches actually happen
+        algo = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256
+        k = drives - parity
+        data = np.random.randint(0, 255,
+                                 (attrib_batch, k, block // k),
+                                 dtype=np.uint8)
+        codec = codec_mod.Codec(k, parity, block)
+        attrib_t: dict[str, list[float]] = {"on": [], "off": []}
+        for mode in ("on", "off"):
+            was = os.environ.get("MINIO_TPU_SCHED_ATTRIB")
+            os.environ["MINIO_TPU_SCHED_ATTRIB"] = mode
+            try:
+                sched = BatchScheduler(max_wait=0.001)
+            finally:
+                if was is None:
+                    os.environ.pop("MINIO_TPU_SCHED_ATTRIB", None)
+                else:
+                    os.environ["MINIO_TPU_SCHED_ATTRIB"] = was
+            try:
+                with telemetry.trace(f"bench.obs.attrib.{mode}"):
+                    r = sched.submit(codec, data, algo).result(120)
+                    assert r is not None, "dispatch declined"
+                    for _ in range(attrib_reps):
+                        t0 = time.perf_counter()
+                        sched.submit(codec, data, algo).result(120)
+                        attrib_t[mode].append(
+                            time.perf_counter() - t0)
+            finally:
+                sched.close()
+        on_ms = _median(attrib_t["on"]) * 1e3
+        off_ms = _median(attrib_t["off"]) * 1e3
+        out["attrib"] = {
+            "dispatch_ms_attrib_on": round(on_ms, 3),
+            "dispatch_ms_attrib_off": round(off_ms, 3),
+            "telemetry_overhead_x": round(on_ms / max(off_ms, 1e-9),
+                                          3)}
+    finally:
+        codec_mod._IS_TPU = was_is_tpu
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _read_resp(sock) -> int:
     """Read one HTTP response off a raw socket; returns the status."""
     buf = b""
@@ -1671,6 +1912,12 @@ def main() -> int:
     ap.add_argument("--spans", action="store_true",
                     help="pretty-print the top-5 slowest span trees of "
                          "each A/B config to stderr")
+    ap.add_argument("--spans-api", default="",
+                    help="with --spans: keep only this API's root "
+                         "spans (the /spans?api= filter)")
+    ap.add_argument("--spans-trace-id", default="",
+                    help="with --spans: keep only this trace id (the "
+                         "/spans?trace_id= filter)")
     ap.add_argument("--ab-rebalance", action="store_true",
                     help="run ONLY the rebalance-throttle A/B "
                          "(foreground PUT p50/p99 with vs without an "
@@ -1745,7 +1992,36 @@ def main() -> int:
     ap.add_argument("--ab-edge-smoke", action="store_true",
                     help="tiny edge A/B (2 streams, 256 KiB objects, "
                          "60 idle conns) for CI — seconds, not minutes")
+    ap.add_argument("--ab-obs", action="store_true",
+                    help="run ONLY the observability-plane A/B: "
+                         "federated-scrape merge latency vs node "
+                         "count, trace-follow overhead on foreground "
+                         "PUT p99, dispatch-attribution on/off "
+                         "overhead")
+    ap.add_argument("--ab-obs-smoke", action="store_true",
+                    help="tiny observability A/B (2 streams, 256 KiB "
+                         "objects, 2 node counts) for CI — seconds, "
+                         "not minutes")
     args = ap.parse_args()
+
+    if args.ab_obs or args.ab_obs_smoke:
+        if args.ab_obs_smoke:
+            ab = bench_obs_ab(streams=2, size=1 << 18, drives=6,
+                              node_counts=(1, 2), put_rounds=2,
+                              attrib_reps=4, block=1 << 16)
+        else:
+            ab = bench_obs_ab(streams=min(args.ab_streams, 8),
+                              size=args.ab_size)
+        print(json.dumps({
+            "metric": "foreground PUT p99 overhead with a live "
+                      "cluster trace-follow subscriber attached "
+                      "(observability-plane A/B)",
+            "value": ab.get("trace_follow", {}).get(
+                "put_p99_overhead_x"),
+            "unit": "x",
+            "obs_ab": ab,
+        }))
+        return 0
 
     if args.ab_edge or args.ab_edge_smoke:
         if args.ab_edge_smoke:
@@ -1891,7 +2167,9 @@ def main() -> int:
                 walk(t)
 
     if args.ab_only:
-        ab = bench_pipeline_ab(args.ab_streams, args.ab_size)
+        ab = bench_pipeline_ab(args.ab_streams, args.ab_size,
+                               spans_api=args.spans_api,
+                               spans_trace_id=args.spans_trace_id)
         emit_spans(ab)
         print(json.dumps({
             "metric": "e2e PutObject pipeline A/B "
@@ -1912,7 +2190,9 @@ def main() -> int:
     if args.ab_pipeline or os.environ.get(
             "BENCH_PIPELINE_AB", "1").lower() not in ("0", "false", "no"):
         try:
-            ab = bench_pipeline_ab(args.ab_streams, args.ab_size)
+            ab = bench_pipeline_ab(args.ab_streams, args.ab_size,
+                                   spans_api=args.spans_api,
+                                   spans_trace_id=args.spans_trace_id)
             emit_spans(ab)
         except Exception as e:  # noqa: BLE001 — recorded, not fatal
             ab = {"error": repr(e)}

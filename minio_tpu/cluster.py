@@ -272,6 +272,13 @@ class ClusterNode:
         # -- admin / health / metrics routers ------------------------------
         from .s3.admin import mount_admin
         self.admin = mount_admin(self.s3, self)
+        # cluster observability plane: trace records carry this node's
+        # name, peers pull the full Prometheus exposition for the
+        # federated ?cluster=1 scrape, and follow-mode trace streams
+        # subscribe to this node's live hub over the trace-stream verb
+        self.s3.api.trace.node = self.spec.addr
+        self._peer_rpc.get_metrics_text = self.admin.metrics.local_text
+        self._peer_rpc.trace_hub = self.s3.api.trace.hub
 
         # -- web JSON-RPC control surface (cmd/web-router.go) --------------
         from .s3.web import mount as mount_web

@@ -43,6 +43,12 @@ class PeerRPCServer:
         # transient partition can't diverge a node forever
         self.signal_service: Callable[[str], None] = lambda sig: None
         self.get_metrics: Callable[[], dict] = lambda: {}
+        # federated metrics scrape: this node's full Prometheus text
+        # exposition (the admin ?cluster=1 merge pulls one per peer)
+        self.get_metrics_text: Callable[[], str] = lambda: ""
+        # live trace subscription: the TraceSys pub/sub hub (follow
+        # streams subscribe; None until the cluster wires it)
+        self.trace_hub = None
         self.get_storage_info: Callable[[], dict] = lambda: {}
         self.get_trace: Callable[[], list] = lambda: []
         self.get_bucket_usage: Callable[[], dict] = lambda: {}
@@ -62,8 +68,11 @@ class PeerRPCServer:
         h.register("iam-delta", self._iam_delta)
         h.register("signal", self._signal)
         h.register("metrics", lambda a, b: self.get_metrics())
+        h.register("metrics-text",
+                   lambda a, b: self.get_metrics_text().encode())
         h.register("storage-info", lambda a, b: self.get_storage_info())
         h.register("trace", lambda a, b: self.get_trace())
+        h.register("trace-stream", self._trace_stream)
         h.register("bucket-usage", lambda a, b: self.get_bucket_usage())
         # profiling fan-out (cmd/admin-handlers.go:461-525 peer verbs),
         # console-log ring, OBD bundle (peer-rest-common.go:29-56)
@@ -83,6 +92,35 @@ class PeerRPCServer:
         if self.get_update_tracker is None:
             return {}
         return self.get_update_tracker()
+
+    def _trace_stream(self, args, body):
+        """Live trace subscription (the peer half of a cluster-wide
+        ?follow=1 stream): ND-JSON entries from this node's TraceSys
+        hub as a chunked response. Idle windows emit bare newline
+        heartbeats so a dead subscriber's next write fails and the
+        subscription unwinds instead of leaking; blank lines are
+        skipped by the merging side. `max_s` bounds the stream's life
+        (the caller re-subscribes — a forgotten stream can't pin the
+        hub forever)."""
+        if self.trace_hub is None:
+            return b""
+        try:
+            max_s = float(args.get("max_s", "3600") or 3600)
+        except ValueError:
+            max_s = 3600.0
+        hub = self.trace_hub
+
+        def gen():
+            deadline = time.monotonic() + max(max_s, 1.0)
+            with hub.subscribe() as sub:
+                while time.monotonic() < deadline:
+                    entry = sub.get(timeout=1.0)
+                    if entry is None:
+                        yield b"\n"              # heartbeat
+                        continue
+                    yield (json.dumps(entry) + "\n").encode()
+
+        return gen()
 
     def _profiling_start(self, args, body):
         from ..utils import profiling
@@ -194,6 +232,38 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return {}
 
+    @property
+    def addr(self) -> str:
+        return f"{self.rc.host}:{self.rc.port}"
+
+    def metrics_text(self, deadline: float = 2.0) -> Optional[str]:
+        """This peer's Prometheus text exposition, or None on failure
+        — the federated scrape's per-peer pull, bounded by `deadline`
+        so one dead peer degrades the cluster scrape instead of
+        stalling it."""
+        try:
+            out = self.rc.call("metrics-text", deadline=deadline)
+        except (NetworkError, RPCError):
+            return None
+        try:
+            return out.decode()
+        except UnicodeDecodeError:
+            return None
+
+    def trace_stream(self, max_s: float = 3600.0):
+        """Open this peer's live trace subscription: returns an
+        iterator of entry dicts (ends on peer death / stream close),
+        or None when the peer is unreachable. `.close()` on the
+        returned iterator tears the connection down."""
+        try:
+            resp = self.rc.call("trace-stream",
+                                {"max_s": str(max_s)},
+                                stream_response=True,
+                                deadline=max(max_s, 60.0))
+        except (NetworkError, RPCError):
+            return None
+        return _TraceLineIter(resp, self.addr)
+
     def storage_info(self) -> dict:
         try:
             return self.rc.call_json("storage-info") or {}
@@ -295,6 +365,50 @@ class PeerRPCClient:
         self.rc.close()
 
 
+class _TraceLineIter:
+    """ND-JSON line iterator over a streamed trace-stream response:
+    yields entry dicts, skips heartbeat blanks, ends (never raises) on
+    any transport fault. close() tears down the underlying connection
+    — the unblocking lever the merging side pulls from another
+    thread."""
+
+    def __init__(self, resp, peer: str):
+        self._resp = resp
+        self.peer = peer
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        while not self._closed:
+            try:
+                # readline, not read(n): chunked read(n) waits for n
+                # bytes, and a mostly-idle peer trickles 1-byte
+                # heartbeats — lines must surface as they arrive
+                line = self._resp.readline()
+            except Exception:  # noqa: BLE001 — peer died: end of stream
+                raise StopIteration from None
+            if not line:
+                raise StopIteration
+            if not line.strip():
+                continue                          # heartbeat
+            try:
+                entry = json.loads(line.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(entry, dict):
+                return entry
+        raise StopIteration
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
+
+
 class NotificationSys:
     """Fan-out aggregator over all peer clients (cmd/notification.go):
     each call broadcasts concurrently and returns per-peer results."""
@@ -360,6 +474,58 @@ class NotificationSys:
                 merged.extend(e for e in entries if isinstance(e, dict))
         merged.sort(key=lambda e: e.get("time", ""))
         return merged
+
+    def metrics_text_all(self, deadline: float = 2.0
+                         ) -> list[tuple[str, Optional[str]]]:
+        """One (peer_addr, exposition_text | None) per peer — the
+        federated scrape's fan-out; None marks a peer the caller must
+        count as scrape-failed rather than fail the whole scrape."""
+        results = self._broadcast(
+            lambda p: p.metrics_text(deadline=deadline))
+        return [(p.addr, r if isinstance(r, str) else None)
+                for p, r in zip(self.peers, results)]
+
+    def trace_stream_all(self, max_s: float = 3600.0) -> list:
+        """One live trace-entry iterator per reachable peer (see
+        PeerRPCClient.trace_stream). Subscriptions open concurrently;
+        unreachable peers are simply absent — a follow stream degrades
+        to the nodes it can hear. A peer that answers only AFTER the
+        collection window has its subscription closed by the opener
+        thread itself (nobody else will ever see it — an unclosed late
+        iterator would pin that peer's hub + a worker for max_s)."""
+        results: list = [None] * len(self.peers)
+        mu = threading.Lock()
+        done = [False]
+
+        def run(i: int, p: PeerRPCClient) -> None:
+            r = None
+            try:
+                r = p.trace_stream(max_s=max_s)
+            except Exception:  # noqa: BLE001 — peer absent
+                r = None
+            late = None
+            with mu:
+                if done[0]:
+                    late = r
+                else:
+                    results[i] = r
+            if late is not None:
+                late.close()
+
+        threads = [threading.Thread(target=run, args=(i, p),
+                                    daemon=True)
+                   for i, p in enumerate(self.peers)]
+        for t in threads:
+            t.start()
+        # ONE shared deadline across peers: per-thread join(10) would
+        # stall a follow stream's start ~10s PER black-holed peer
+        end = time.monotonic() + 10
+        for t in threads:
+            t.join(timeout=max(end - time.monotonic(), 0))
+        with mu:
+            done[0] = True
+            return [r for r in results
+                    if isinstance(r, _TraceLineIter)]
 
     def profiling_start_all(self, kinds: str = "cpu") -> list:
         return self._broadcast(lambda p: p.profiling_start(kinds))

@@ -351,6 +351,17 @@ class _StreamedResponse:
             self._conn.close()
             raise NetworkError(f"mid-stream: {e}") from e
 
+    def readline(self) -> bytes:
+        """One line, INCREMENTALLY: read(n) on a chunked response
+        blocks until n bytes accumulate, which on a trickle stream
+        (trace-follow heartbeats) means minutes — readline reads at
+        most one chunk. Empty bytes = end of stream."""
+        try:
+            return self.resp.readline()
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            self._conn.close()
+            raise NetworkError(f"mid-stream: {e}") from e
+
     def close(self) -> None:
         self._conn.close()
 

@@ -90,6 +90,20 @@ class AdminClient:
         return self._request("GET", "", prefix="/minio/prometheus/metrics",
                              sign=False).decode()
 
+    def cluster_metrics(self) -> str:
+        """ONE Prometheus exposition for the whole cluster: the serving
+        node scrapes every peer over RPC and merges (counters summed,
+        gauges carrying a `node` label, histograms bucket-merged). A
+        dead peer degrades the scrape — check
+        `minio_tpu_cluster_scrape_failed_total` in the output."""
+        return self._request("GET", "metrics",
+                             {"cluster": "1"}).decode()
+
+    def node_metrics(self) -> str:
+        """The serving node's own exposition via the authenticated
+        admin route (the anonymous endpoint's SigV4 twin)."""
+        return self._request("GET", "metrics").decode()
+
     # -- heal --------------------------------------------------------------
 
     def heal_start(self, bucket: str = "", prefix: str = "") -> str:
@@ -220,17 +234,83 @@ class AdminClient:
 
     # -- trace / profiling -------------------------------------------------
 
-    def trace(self, count: int = 10, idle: float = 5.0
+    def trace(self, count: int = 10, idle: float = 5.0,
+              api: str = "", errors_only: bool = False
               ) -> Iterator[dict]:
-        """Stream live trace entries (blocks until idle/count)."""
-        data = self._request("GET", "trace", {"count": str(count),
-                                              "idle": str(idle)})
+        """Stream live trace entries (blocks until idle/count).
+        `api` is a comma list of API names to keep; `errors_only`
+        keeps failed calls (HTTP >= 400)."""
+        query = {"count": str(count), "idle": str(idle)}
+        if api:
+            query["api"] = api
+        if errors_only:
+            query["err"] = "1"
+        data = self._request("GET", "trace", query)
         for line in data.splitlines():
             if line.strip():
                 yield json.loads(line)
 
+    def trace_follow(self, count: int = 0, api: str = "",
+                     errors_only: bool = False,
+                     timeout: Optional[float] = None) -> Iterator[dict]:
+        """The `mc admin trace` analog: a LIVE cluster-wide stream —
+        the serving node grafts every peer's records in. Yields entry
+        dicts as they arrive; ends at `count` entries (0 = until the
+        connection drops / `timeout`). Unlike trace(), this reads the
+        chunked response incrementally."""
+        import hashlib as _hl
+        query = {"follow": "1", "count": str(count)}
+        if api:
+            query["api"] = api
+        if errors_only:
+            query["err"] = "1"
+        qs = urllib.parse.urlencode(query)
+        path = f"{ADMIN_PREFIX}/trace"
+        hdrs = sig.sign_v4("GET", path,
+                           {k: [v] for k, v in query.items()},
+                           {"host": f"{self.host}:{self.port}"},
+                           _hl.sha256(b"").hexdigest(), self.creds,
+                           self.region)
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=timeout if timeout is not None else self.timeout)
+        try:
+            conn.request("GET", f"{path}?{qs}", headers=hdrs)
+            resp = conn.getresponse()
+            if resp.status >= 300:
+                raise AdminClientError(
+                    resp.status, {"raw": resp.read().decode(
+                        errors="replace")})
+            sent = 0
+            while True:
+                # readline, not read(n): a chunked read(n) blocks for n
+                # bytes while the stream trickles heartbeats
+                line = resp.readline()
+                if not line:
+                    return
+                if not line.strip():
+                    continue                       # heartbeat
+                yield json.loads(line)
+                sent += 1
+                if count and sent >= count:
+                    return
+        finally:
+            conn.close()
+
     def cluster_trace(self) -> list[dict]:
         return self._json("GET", "trace/cluster")["entries"]
+
+    def spans(self, count: int = 50, sort: str = "recent",
+              api: str = "", trace_id: str = "") -> dict:
+        """Kept span trees (+ keep/drop counters). `api` filters to
+        one API's roots, `trace_id` selects the tree a trace entry
+        named, `sort=slowest` orders by duration."""
+        query = {"count": str(count), "sort": sort}
+        if api:
+            query["api"] = api
+        if trace_id:
+            query["trace_id"] = trace_id
+        return self._json("GET", "spans", query)
 
     def profiling_start(self, profiler_type: str = "cpu") -> dict:
         """profiler_type: comma list of 'cpu' (cProfile) and 'mem'

@@ -170,8 +170,24 @@ class Codec:
             return "sha256"
         return None
 
+    @staticmethod
+    def _staged(stage_cb, outputs):
+        """Compute/fetch boundary for the single-device jit path: wait
+        for the device values (compute), stamp the stage, and let the
+        caller's numpy conversions (fetch = device→host readback) run
+        after. No-op without a callback — the hot path pays nothing."""
+        import time as _time
+        if stage_cb is None:
+            return _time.perf_counter()
+        try:
+            import jax
+            jax.block_until_ready(outputs)
+        except Exception:  # noqa: BLE001 — attribution is passive
+            pass
+        return _time.perf_counter()
+
     def encode_and_hash_batch(self, data: np.ndarray, algo,
-                              *, force: str = ""):
+                              *, force: str = "", stage_cb=None):
         """Fused device path for the PUT hot loop: one program computes
         parity AND every shard's HighwayHash256 digest (the reference's
         Erasure.Encode + streaming-bitrot work, cmd/erasure-encode.go:75 +
@@ -180,33 +196,49 @@ class Codec:
         data: (B, k, S). Returns (full (B, k+m, S), digests (B, k+m, 32))
         as numpy arrays, or None when the batch doesn't route to the
         device or the bitrot algorithm has no device kernel.
+
+        stage_cb(stage, seconds), when given, receives "compute" (device
+        program to completion) and "fetch" (device→host readback +
+        result assembly) timings — the batch scheduler's dispatch
+        attribution. The mesh path reports a single "compute" stage (its
+        sharded programs return host arrays in one step).
         """
+        import time as _time
         kernel = self._device_hash_kernel(algo)
         if kernel is None or self.m == 0:
             return None
         mesh = self._mesh_route(data.nbytes, force)
         if mesh is not None:
             from ..parallel import mesh as pmesh
+            t0 = _time.perf_counter()
             out = pmesh.mesh_encode_and_hash(mesh, data, self.k, self.m,
                                              kernel)
             if out is not None:
+                if stage_cb is not None:
+                    stage_cb("compute", _time.perf_counter() - t0)
                 return out
         path = force or self._route(data.nbytes)
         if path != "device":
             return None
         from ..models.pipeline import put_step
+        t0 = _time.perf_counter()
         parity, digests = put_step(data, self.k, self.m, algo=kernel)
+        t1 = self._staged(stage_cb, (parity, digests))
         # only parity + digests cross back from the device; the k data
         # rows are the caller's own bytes
-        return (np.concatenate([np.asarray(data, np.uint8),
-                                np.asarray(parity)], axis=1),
-                np.asarray(digests))
+        out = (np.concatenate([np.asarray(data, np.uint8),
+                               np.asarray(parity)], axis=1),
+               np.asarray(digests))
+        if stage_cb is not None:
+            stage_cb("compute", t1 - t0)
+            stage_cb("fetch", _time.perf_counter() - t1)
+        return out
 
     # -- fused verify + decode / recover (device) --------------------------
 
     def verify_and_decode_batch(self, survivors: np.ndarray,
                                 present_mask: int, shard_len: int, algo,
-                                *, force: str = ""):
+                                *, force: str = "", stage_cb=None):
         """Fused device path for the degraded-GET hot loop: ONE program
         bitrot-hashes every survivor shard AND reconstructs only the
         missing data rows (models/pipeline.get_step — the device form of
@@ -218,16 +250,20 @@ class Codec:
         to the device / the algorithm has no device kernel / nothing is
         missing (plain verify has no matmul to fuse with).
         """
+        import time as _time
         kernel = self._device_hash_kernel(algo)
         if kernel is None:
             return None
         mesh = self._mesh_route(survivors.nbytes, force)
         if mesh is not None:
             from ..parallel import mesh as pmesh
+            t0 = _time.perf_counter()
             out = pmesh.mesh_verify_and_decode(
                 mesh, survivors, self.k, self.m, present_mask,
                 shard_len, kernel)
             if out is not None:
+                if stage_cb is not None:
+                    stage_cb("compute", _time.perf_counter() - t0)
                 return out
         path = force or self._route(survivors.nbytes)
         if path != "device":
@@ -238,14 +274,20 @@ class Codec:
             return None
         m2 = rs_tpu._bit_expand_cached(dm.tobytes(), dm.shape)
         from ..models.pipeline import get_step
+        t0 = _time.perf_counter()
         out, digests = get_step(survivors, m2, dm.shape[0], self.k,
                                 shard_len, algo=kernel)
-        return np.asarray(out), missing, np.asarray(digests)
+        t1 = self._staged(stage_cb, (out, digests))
+        result = np.asarray(out), missing, np.asarray(digests)
+        if stage_cb is not None:
+            stage_cb("compute", t1 - t0)
+            stage_cb("fetch", _time.perf_counter() - t1)
+        return result
 
     def verify_and_recover_batch(self, survivors: np.ndarray,
                                  present_mask: int, rows: "set[int]",
                                  shard_len: int, algo, *,
-                                 force: str = ""):
+                                 force: str = "", stage_cb=None):
         """Fused device path for heal: verify survivors, rebuild exactly
         the requested lost rows, and digest the rebuilt shards for their
         new bitrot frames (models/pipeline.heal_step).
@@ -253,16 +295,20 @@ class Codec:
         Returns (out (B, R, S), idxs, survivor_digests (B, k, 32),
         out_digests (B, R, 32)) or None when not device-routed.
         """
+        import time as _time
         kernel = self._device_hash_kernel(algo)
         if kernel is None:
             return None
         mesh = self._mesh_route(survivors.nbytes, force)
         if mesh is not None:
             from ..parallel import mesh as pmesh
+            t0 = _time.perf_counter()
             out = pmesh.mesh_verify_and_recover(
                 mesh, survivors, self.k, self.m, present_mask, rows,
                 shard_len, kernel)
             if out is not None:
+                if stage_cb is not None:
+                    stage_cb("compute", _time.perf_counter() - t0)
                 return out
         path = force or self._route(survivors.nbytes)
         if path != "device":
@@ -272,10 +318,16 @@ class Codec:
             return None
         m2 = rs_tpu._bit_expand_cached(rec.tobytes(), rec.shape)
         from ..models.pipeline import heal_step
+        t0 = _time.perf_counter()
         out, sdig, odig = heal_step(survivors, m2, rec.shape[0], self.k,
                                     shard_len, algo=kernel)
-        return (np.asarray(out), idxs, np.asarray(sdig),
-                np.asarray(odig))
+        t1 = self._staged(stage_cb, (out, sdig, odig))
+        result = (np.asarray(out), idxs, np.asarray(sdig),
+                  np.asarray(odig))
+        if stage_cb is not None:
+            stage_cb("compute", t1 - t0)
+            stage_cb("fetch", _time.perf_counter() - t1)
+        return result
 
     def _recover_rows(self, present_mask: int, rows: "set[int]"
                       ) -> tuple[np.ndarray, list[int]]:

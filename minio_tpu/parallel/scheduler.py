@@ -72,11 +72,23 @@ _BATCHES_TOTAL = telemetry.REGISTRY.counter(
 _COALESCED_TOTAL = telemetry.REGISTRY.counter(
     "minio_tpu_sched_coalesced_total",
     "Groups that shared another request's dispatch")
+# dispatch-time attribution (ISSUE 13 pillar c): where a fused device
+# dispatch spends its time, per verb — "queue" (submit -> dispatch
+# start in the former), "transfer" (host batch assembly the dispatch
+# thread performs before launch), "compute" (device program to
+# completion), "fetch" (device->host readback + result assembly).
+# Sub-ms buckets: a dispatch stage on a warm path is 10µs-100ms.
+_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                  0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+_DISPATCH_STAGE_SECONDS = telemetry.REGISTRY.histogram(
+    "minio_tpu_device_dispatch_seconds",
+    "Fused device dispatch stage timings (queue/transfer/compute/"
+    "fetch) per verb", buckets=_STAGE_BUCKETS)
 
 
 def _collect_scheduler_metrics() -> None:
     reg = telemetry.REGISTRY
-    queued_groups = queued_blocks = batches = blocks = 0
+    queued_groups = queued_blocks = batches = blocks = inflight = 0
     verbs: dict[str, list[int]] = {v: [0, 0] for v in VERBS}
     for s in list(_SCHEDULERS):
         st = s.stats()
@@ -84,9 +96,13 @@ def _collect_scheduler_metrics() -> None:
         queued_blocks += st["queued_blocks"]
         batches += st["batches"]
         blocks += st["dispatched_blocks"]
+        inflight += st["inflight"]
         for v, vs in st["verbs"].items():
             verbs[v][0] += vs["batches"]
             verbs[v][1] += vs["coalesced"]
+    reg.gauge("minio_tpu_sched_inflight_dispatches",
+              "Device dispatches currently airborne (transfer/compute "
+              "overlap depth)").set(inflight)
     reg.gauge("minio_tpu_sched_queue_depth",
               "Work groups waiting on the batch former").set(
         queued_groups)
@@ -106,7 +122,7 @@ telemetry.REGISTRY.register_collector(_collect_scheduler_metrics)
 
 class _Pending:
     __slots__ = ("data", "payload", "blocks", "event", "out", "error",
-                 "span")
+                 "span", "t_submit")
 
     def __init__(self, data: Optional[np.ndarray] = None,
                  payload=None, blocks: Optional[int] = None):
@@ -122,6 +138,8 @@ class _Pending:
         # submitter's span: the collector thread is shared across
         # requests, so dispatch spans are attached explicitly
         self.span = None
+        # queue-wait attribution: submit time -> dispatch start
+        self.t_submit = time.perf_counter()
 
 
 class DispatchFuture:
@@ -188,6 +206,11 @@ class BatchScheduler:
         self.dispatched_blocks = 0    # blocks through the device path
         self.verb_stats = {v: {"batches": 0, "coalesced": 0, "blocks": 0}
                            for v in VERBS}
+        # stage attribution (queue/transfer/compute/fetch histograms +
+        # per-dispatch child spans); `off` is the overhead-A/B escape
+        # hatch (bench.py --ab-obs re-measures telemetry_overhead_x)
+        self.attrib = knobs.get_bool("MINIO_TPU_SCHED_ATTRIB")
+        self._airborne = 0            # dispatches currently in flight
         # keeping `inflight` dispatches airborne overlaps batch N+1's
         # host->device transfer with batch N's compute
         self._inflight = threading.BoundedSemaphore(max(1, inflight))
@@ -215,6 +238,7 @@ class BatchScheduler:
                     "batches": self.batches,
                     "coalesced": self.coalesced,
                     "dispatched_blocks": self.dispatched_blocks,
+                    "inflight": self._airborne,
                     "verbs": {v: dict(s)
                               for v, s in self.verb_stats.items()}}
 
@@ -405,6 +429,8 @@ class BatchScheduler:
 
     def _dispatch_group(self, key: tuple, group: list,
                         sem: threading.Semaphore) -> None:
+        with self._mu:
+            self._airborne += 1
         try:
             self._dispatch_one(key, group)
         except Exception as e:  # noqa: BLE001 — surfaced to every waiter
@@ -413,15 +439,23 @@ class BatchScheduler:
                     p.error = e
                     p.event.set()
         finally:
+            with self._mu:
+                self._airborne -= 1
             sem.release()
 
     def _dispatch_one(self, key: tuple, group: list) -> None:
         verb = key[0]
+        attrib = self.attrib
+        # stage -> seconds for this dispatch ("transfer" is filled by
+        # the batch-assembly timer below; "compute"/"fetch" by the
+        # codec/kernel stage callback)
+        stages: dict[str, float] = {}
+        stage_cb = stages.__setitem__ if attrib else None
         t0_wall, t0 = time.time(), time.perf_counter()
         if verb == "scan":
-            out = self._run_scan(group)
+            out = self._run_scan(group, stage_cb)
         else:
-            out = self._run_erasure(key, group)
+            out = self._run_erasure(key, group, stage_cb)
         dt = time.perf_counter() - t0
         nb = sum(p.blocks for p in group)
         with self._mu:
@@ -435,14 +469,40 @@ class BatchScheduler:
         _BATCHES_TOTAL.inc(verb=verb)
         if len(group) > 1:
             _COALESCED_TOTAL.inc(len(group) - 1, verb=verb)
+        # a dispatch that DECLINED to the device (out is None: CPU
+        # routing) must not feed the device-dispatch histogram — a
+        # deviceless box would otherwise fill queue/transfer series
+        # with no matching compute, misattributing time to launches
+        # that never happened
+        if attrib and out is not None:
+            for p in group:
+                _DISPATCH_STAGE_SECONDS.observe(
+                    max(t0 - p.t_submit, 0.0), verb=verb, stage="queue")
+            for stage, sdt in stages.items():
+                _DISPATCH_STAGE_SECONDS.observe(sdt, verb=verb,
+                                                stage=stage)
         for p in group:
             if p.span is not None:
                 # the collector/dispatch threads serve many requests:
                 # attach the dispatch to each submitter's tree as an
-                # externally-timed span
-                telemetry.attach_span(
+                # externally-timed span, with the stage split as its
+                # children — /spans?sort=slowest answers WHERE a slow
+                # PUT/GET/heal/scan went (former queue? transfer?
+                # device compute? readback?)
+                d = telemetry.attach_span(
                     p.span, "sched.dispatch", t0_wall, dt, verb=verb,
                     blocks=nb, coalesced=len(group) - 1)
+                if d is not None and attrib and out is not None:
+                    qw = max(t0 - p.t_submit, 0.0)
+                    telemetry.attach_span(d, "sched.queue",
+                                          t0_wall - qw, qw)
+                    off = t0_wall
+                    for stage in ("transfer", "compute", "fetch"):
+                        sdt = stages.get(stage)
+                        if sdt is not None:
+                            telemetry.attach_span(d, f"sched.{stage}",
+                                                  off, sdt)
+                            off += sdt
         if out is None:
             # CPU routing: let each caller use its own path
             for p in group:
@@ -468,31 +528,39 @@ class BatchScheduler:
             p.event.set()
 
     @staticmethod
-    def _run_erasure(key: tuple, group: list):
+    def _run_erasure(key: tuple, group: list, stage_cb=None):
         from ..object.codec import Codec
         from .. import bitrot as bitrot_mod
         verb, k, m, s, algo_value, extra = key
         algo = bitrot_mod.BitrotAlgorithm.from_string(algo_value)
         codec = Codec(k, m, s * k)
+        t0 = time.perf_counter()
         data = np.concatenate([p.data for p in group], axis=0) \
             if len(group) > 1 else group[0].data
+        if stage_cb is not None:
+            # host-side batch staging: the fused input's assembly into
+            # one contiguous array the device upload reads from
+            stage_cb("transfer", time.perf_counter() - t0)
         if verb == "encode":
-            return codec.encode_and_hash_batch(data, algo)
+            return codec.encode_and_hash_batch(data, algo,
+                                               stage_cb=stage_cb)
         if verb == "decode":
             mask, shard_len = extra
             return codec.verify_and_decode_batch(data, mask, shard_len,
-                                                 algo)
+                                                 algo, stage_cb=stage_cb)
         mask, rows, shard_len = extra
         return codec.verify_and_recover_batch(data, mask, set(rows),
-                                              shard_len, algo)
+                                              shard_len, algo,
+                                              stage_cb=stage_cb)
 
     @staticmethod
-    def _run_scan(group: list):
+    def _run_scan(group: list, stage_cb=None):
         """One coalesced kernel launch over every member's pages: the
         plan is identical across the group (the bucket keys on its
         signature), pages stack along the batch axis."""
         from ..scan import kernels as scan_kernels
         plan = group[0].payload[0]
+        t0 = time.perf_counter()
         if len(group) == 1:
             arrays = group[0].payload[1]
         else:
@@ -500,7 +568,15 @@ class BatchScheduler:
             arrays = {name: np.concatenate(
                 [p.payload[1][name] for p in group], axis=0)
                 for name in names}
-        return scan_kernels.run_batch(plan, arrays)
+        if stage_cb is not None:
+            stage_cb("transfer", time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        out = scan_kernels.run_batch(plan, arrays)
+        if stage_cb is not None:
+            # run_batch returns host arrays: compute + readback land in
+            # one "compute" stage for the scan verb
+            stage_cb("compute", time.perf_counter() - t1)
+        return out
 
 
 # ---------------------------------------------------------------------------

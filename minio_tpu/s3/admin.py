@@ -24,6 +24,7 @@ from . import signature as sig
 # minio_tpu_rpc_*)
 from ..distributed import transport as _transport  # noqa: F401
 from ..parallel import scheduler as _scheduler  # noqa: F401
+from ..utils import knobs, telemetry
 from ..utils import profiling as _profiling  # noqa: F401
 from .handlers import HTTPResponse, RequestContext
 from .s3errors import S3Error
@@ -31,6 +32,14 @@ from .s3errors import S3Error
 ADMIN_PREFIX = "/minio/admin/v3"
 HEALTH_PREFIX = "/minio/health"
 METRICS_PREFIX = "/minio/prometheus/metrics"
+
+# federated-scrape degradation accounting: a peer that missed the
+# per-peer deadline (or is down) costs its samples, never the scrape —
+# this counter is the alert an operator wires to notice
+_SCRAPE_FAILED = telemetry.REGISTRY.counter(
+    "minio_tpu_cluster_scrape_failed_total",
+    "Peer scrapes that failed during a federated ?cluster=1 metrics "
+    "render")
 
 
 class HealSequence:
@@ -95,6 +104,10 @@ class AdminHandlers:
         self.node = node
         self.started = time.time()
         self._heals: dict[str, HealSequence] = {}
+        # the metrics endpoint's handler (mount_admin wires it): the
+        # admin /metrics route and the peer metrics-text verb both
+        # render through it so every surface reports the SAME scrape
+        self.metrics: Optional["MetricsHandler"] = None
 
     # -- auth --------------------------------------------------------------
 
@@ -222,12 +235,29 @@ class AdminHandlers:
                 entries.extend(self.node.notification.trace_all())
             entries.sort(key=lambda e: e.get("time", ""))
             return self._json({"entries": entries[-500:]})
+        if sub == "metrics" and m == "GET":
+            # authenticated metrics scrape; ?cluster=1 federates over
+            # peer RPC into ONE exposition (counters summed, gauges
+            # node-labelled, histograms bucket-merged) — the reference
+            # /minio/v2/metrics/cluster analog
+            self._auth(ctx, "admin:Prometheus")
+            if self.metrics is None:
+                raise S3Error("NotImplemented",
+                              "metrics handler not mounted")
+            if ctx.query1("cluster") == "1" and self.node is not None:
+                text = self.cluster_metrics_text()
+            else:
+                text = self.metrics.local_text()
+            return HTTPResponse(body=text.encode(),
+                                headers={"Content-Type": "text/plain"})
         if sub == "spans" and m == "GET":
             # tail-sampled span trees (errors, slow requests, sampled
             # ordinary traffic), RPC fragments grafted in — the "where
-            # did this slow PUT spend its time" endpoint
+            # did this slow PUT spend its time" endpoint. ?api= keeps
+            # one API's roots (root names ARE api names under the
+            # middleware), ?trace_id= selects the tree a trace-stream
+            # entry named.
             self._auth(ctx, "admin:ServerTrace")
-            from ..utils import telemetry
             try:
                 n = int(ctx.query1("count", "50") or 50)
             except ValueError:
@@ -235,7 +265,9 @@ class AdminHandlers:
                               "bad count") from None
             slowest = ctx.query1("sort", "recent") == "slowest"
             return self._json({
-                "spans": telemetry.SPANS.dump(n, slowest=slowest),
+                "spans": telemetry.SPANS.dump(
+                    n, slowest=slowest, name=ctx.query1("api", ""),
+                    trace_id=ctx.query1("trace_id", "")),
                 "kept_total": telemetry.SPANS.kept_total,
                 "dropped_total": telemetry.SPANS.dropped_total,
                 "slow_threshold_ms": round(
@@ -243,7 +275,18 @@ class AdminHandlers:
                 "sample": telemetry.SPANS.sample,
             })
         if sub == "trace" and m == "GET":
+            # live ND-JSON request records. Default: bounded stream
+            # that ends on idle (PR 3). ?follow=1 is the `mc admin
+            # trace` analog — a long-lived stream with heartbeats, and
+            # (on a cluster node) every PEER's records grafted in via
+            # trace-stream subscriptions, so one client watches the
+            # whole cluster. ?api=PutObject,GetObject and ?err=1
+            # filter; filters apply to peer records too.
             self._auth(ctx, "admin:ServerTrace")
+            follow = ctx.query1("follow", "") in ("1", "true")
+            apis = {a for a in ctx.query1("api", "").split(",") if a} \
+                or None
+            errors_only = ctx.query1("err", "") in ("1", "true")
             try:
                 n = int(ctx.query1("count", "0") or 0)
                 idle = float(ctx.query1("idle", "10") or 10)
@@ -251,10 +294,23 @@ class AdminHandlers:
                 raise S3Error("AdminInvalidArgument",
                               "bad count/idle") from None
             idle = min(max(idle, 1.0), 3600.0)
+            max_s = knobs.get_float("MINIO_TPU_TRACE_FOLLOW_MAX_S")
+            peer_subs = None
+            if follow and self.node is not None:
+                # a CALLABLE: the subscriptions open at the stream's
+                # first iteration, so a response abandoned before its
+                # first chunk never opens peers it cannot close
+                node = self.node
+                peer_subs = (lambda:
+                             node.notification.trace_stream_all(
+                                 max_s=max_s))
             return HTTPResponse(
                 headers={"Content-Type": "application/x-ndjson"},
-                stream=self.api.trace.stream(max_entries=n,
-                                             idle_timeout=idle))
+                stream=self.api.trace.stream(
+                    max_entries=n, idle_timeout=idle, follow=follow,
+                    apis=apis, errors_only=errors_only,
+                    peer_subs=peer_subs, max_s=max_s),
+                long_poll=follow)
 
         if sub == "heal" and m == "POST":
             self._auth(ctx, "admin:Heal")
@@ -839,6 +895,25 @@ class AdminHandlers:
             merged.setdefault(res, []).extend(holders)
         return merged
 
+    def cluster_metrics_text(self) -> str:
+        """The federated scrape: pull every peer's exposition (bounded
+        by the per-peer MINIO_TPU_CLUSTER_SCRAPE_S deadline), count
+        failures, then merge with this node's OWN render — local render
+        runs AFTER the failure counting so the degraded-scrape counter
+        appears in the very response that degraded."""
+        from ..utils import promfed
+        deadline = knobs.get_float("MINIO_TPU_CLUSTER_SCRAPE_S")
+        peers = self.node.notification.metrics_text_all(
+            deadline=deadline) if self.node is not None else []
+        for addr, text in peers:
+            if text is None:
+                _SCRAPE_FAILED.inc(node=addr)
+        local_name = self.node.spec.addr if self.node is not None \
+            else "local"
+        nodes = [(local_name, self.metrics.local_text())]
+        nodes.extend((a, t) for a, t in peers if t is not None)
+        return promfed.merge_expositions(nodes)
+
 
 class HealthHandlers:
     """/minio/health/{live,ready,cluster} (cmd/healthcheck-handler.go)."""
@@ -879,7 +954,6 @@ class MetricsHandler:
     def __init__(self, api, node=None):
         self.api = api
         self.node = node
-        from ..utils import telemetry
         self.reg = telemetry.REGISTRY
 
     def _collect(self) -> None:
@@ -940,13 +1014,20 @@ class MetricsHandler:
                       f"Consecutive failed {name} scans").set(
                         getattr(loop, "consecutive_errors", 0))
 
+    def local_text(self) -> str:
+        """This node's full exposition with the server-scoped refresh
+        applied — what /minio/prometheus/metrics serves, what the admin
+        /metrics route returns, and what the peer `metrics-text` verb
+        hands a federating scraper. One renderer, three surfaces."""
+        return self.reg.render(self._collect)
+
     def route(self, ctx: RequestContext) -> HTTPResponse:
         # _collect runs as this scrape's one-shot collector, NOT a
         # globally registered one: with several servers in one process
         # each metrics endpoint must report ITS api/node values, and a
         # stopped server must stop reporting (registered collectors
         # live as long as the process-global registry)
-        return HTTPResponse(body=self.reg.render(self._collect).encode(),
+        return HTTPResponse(body=self.local_text().encode(),
                             headers={"Content-Type": "text/plain"})
 
 
@@ -954,8 +1035,8 @@ def mount_admin(server, node=None) -> AdminHandlers:
     """Attach admin/health/metrics routers to an S3Server."""
     admin = AdminHandlers(server.api, node)
     server.admin = admin       # reachable from the server handle
+    admin.metrics = MetricsHandler(server.api, node)
     server.register_router(ADMIN_PREFIX, admin.route)
     server.register_router(HEALTH_PREFIX, HealthHandlers(server.api).route)
-    server.register_router(METRICS_PREFIX,
-                           MetricsHandler(server.api, node).route)
+    server.register_router(METRICS_PREFIX, admin.metrics.route)
     return admin

@@ -99,6 +99,9 @@ class ShedDecision:
         resp.with_xml(body)
         resp.headers["Retry-After"] = str(self.retry_after)
         resp.headers["Connection"] = "close"
+        # ride the reason to the trace stream (dispatch.run_request
+        # records it; the edge's loop-side sheds record directly)
+        resp.shed_reason = self.reason
         return resp
 
 

@@ -43,9 +43,11 @@ def run_request(api, extra_routers, ctx, command: str, raw_path: str,
     status = [500]
     ttfb: list = [None]
     root_holder: list = [None]
+    shed_reason = [""]
 
     def _respond(resp) -> None:
         status[0] = resp.status
+        shed_reason[0] = getattr(resp, "shed_reason", "")
         # TTFB: handler work is done, the status line goes out now —
         # streaming body time lands in the full duration
         if ttfb[0] is None:
@@ -96,7 +98,8 @@ def run_request(api, extra_routers, ctx, command: str, raw_path: str,
                 api.trace.record(command, ctx.req.path,
                                  ctx.req.raw_query, status[0], dur,
                                  caller=caller, api=api_name,
-                                 trace_id=trace_id)
+                                 trace_id=trace_id, ttfb_s=ttfb[0],
+                                 shed_reason=shed_reason[0])
             except Exception:  # noqa: BLE001 — tracing is passive
                 pass
     return status[0]

@@ -55,15 +55,37 @@ _ACCEPTED_TOTAL = telemetry.REGISTRY.counter(
 _REQUESTS_TOTAL = telemetry.REGISTRY.counter(
     "minio_tpu_edge_requests_total",
     "Requests parsed and dispatched by the event-loop frontend")
+# event-loop health: how late the loop runs a timer it armed — the
+# single number that says "the loop thread is wedged behind a callback"
+# (a blocking call smuggled onto the loop shows up here long before
+# clients notice). Sampled every MINIO_TPU_EDGE_LAG_S per loop.
+_LOOP_LAG_SECONDS = telemetry.REGISTRY.histogram(
+    "minio_tpu_edge_loop_lag_seconds",
+    "Event-loop timer lag per loop (scheduled vs actual fire time)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
 
 
 def _collect_edge_metrics() -> None:
     srv = _LIVE[0]
-    if srv is not None:
-        telemetry.REGISTRY.gauge(
-            "minio_tpu_edge_open_conns",
-            "Connections currently held by the event-loop frontend"
-        ).set(srv.conn_count())
+    if srv is None:
+        return
+    g = telemetry.REGISTRY.gauge
+    g("minio_tpu_edge_open_conns",
+      "Connections currently held by the event-loop frontend").set(
+        srv.conn_count())
+    st = srv.pool.stats()
+    g("minio_tpu_edge_pool_size",
+      "Bounded worker-pool capacity behind the event loop").set(
+        st["size"])
+    g("minio_tpu_edge_pool_busy",
+      "Edge worker threads currently running a request").set(
+        st["busy"])
+    g("minio_tpu_edge_pool_idle",
+      "Edge worker threads parked waiting for work").set(st["idle"])
+    g("minio_tpu_edge_pool_pending",
+      "Jobs queued for the edge worker pool, not yet picked up").set(
+        st["pending"])
 
 
 _LIVE: list = [None]
@@ -148,6 +170,14 @@ class _WorkerPool:
                 fn(*args)
             except Exception:  # noqa: BLE001 — per-request isolation;
                 pass           # the request's own error paths answered
+
+    def stats(self) -> dict:
+        """Live pool occupancy for the exposition-time collector."""
+        with self._mu:
+            threads = len(self._threads)
+            return {"size": self.size, "threads": threads,
+                    "idle": self._idle, "pending": self._pending,
+                    "busy": max(threads - self._idle, 0)}
 
     def close(self, join_s: float = 2.0) -> None:
         with self._mu:
@@ -252,17 +282,42 @@ class _EdgeLoop(threading.Thread):
                  idx: int):
         super().__init__(daemon=True, name=f"edge-loop-{idx}")
         self.edge = edge
+        self.idx = idx
         self.lsock = lsock
         self.loop = asyncio.new_event_loop()
         self.conns: set = set()
         self._started = threading.Event()
+        self._lag_expected = 0.0
 
     # -- lifecycle -------------------------------------------------------
+
+    def _arm_lag_sampler(self) -> None:
+        """Periodic loop-lag probe: schedule a timer, measure how late
+        the loop actually ran it. Loop-thread stalls (a blocking call
+        that snuck onto the loop, GC pauses, CPU starvation) surface as
+        lag here — the PR 11 edge flew blind on exactly this."""
+        interval = knobs.get_float("MINIO_TPU_EDGE_LAG_S")
+        if interval <= 0:
+            return
+        lbl = str(self.idx)
+
+        def tick() -> None:
+            if self.edge.closed:
+                return
+            now = self.loop.time()
+            _LOOP_LAG_SECONDS.observe(max(now - self._lag_expected, 0.0),
+                                      loop=lbl)
+            self._lag_expected = now + interval
+            self.loop.call_later(interval, tick)
+
+        self._lag_expected = self.loop.time() + interval
+        self.loop.call_later(interval, tick)
 
     def run(self) -> None:
         asyncio.set_event_loop(self.loop)
         self.lsock.setblocking(False)
         self.loop.add_reader(self.lsock.fileno(), self._accept)
+        self._arm_lag_sampler()
         self._started.set()
         try:
             self.loop.run_forever()
@@ -313,6 +368,7 @@ class _EdgeLoop(threading.Thread):
                 # the cheapest possible refusal
                 decision = self.edge.admission.shed(
                     "conns", "connection budget exhausted, retry")
+                self.edge.record_shed("", "/", decision)
                 sock.setblocking(False)
                 conn = _Conn(sock, addr)
                 self.edge.track(conn, +1)
@@ -381,6 +437,7 @@ class _EdgeLoop(threading.Thread):
     def _on_header_deadline(self, conn: _Conn) -> None:
         decision = self.edge.admission.shed(
             "deadline", "request headers not received in time")
+        self.edge.record_shed("", "/", decision)
         self._send_close_raw(
             conn, self.edge.render_response(decision.response("/")))
 
@@ -442,6 +499,8 @@ class _EdgeLoop(threading.Thread):
             decision = self.edge.admission.pre_admit(
                 method, path, query, headers)
             if decision is not None:
+                self.edge.record_shed(method, path, decision,
+                                      query=query, headers=headers)
                 resp = decision.response(path)
                 finalize_headers(self.edge.api, headers.get("origin"),
                                  resp, method)
@@ -582,6 +641,26 @@ class EdgeServer:
     def is_router_path(self, path: str) -> bool:
         return any(path.startswith(prefix)
                    for prefix, _fn in self.extra_routers)
+
+    def record_shed(self, method: str, path: str, decision,
+                    query: Optional[dict] = None,
+                    headers: Optional[dict] = None) -> None:
+        """Trace-record a loop-side refusal (conns/deadline/pre-admit):
+        these never reach the middleware, so the `mc admin trace`
+        stream would otherwise miss exactly the requests an overloaded
+        server refuses. Runs on the loop thread — record() is a lock +
+        a ring append, cheap by design."""
+        trace = getattr(self.api, "trace", None)
+        if trace is None:
+            return
+        try:
+            from ..trace import api_name_of
+            api = api_name_of(method, path, query or {}, headers or {}) \
+                if method else ""
+            trace.record(method, path, "", 503, 0.0, api=api,
+                         shed_reason=decision.reason)
+        except Exception:  # noqa: BLE001 — tracing is passive
+            pass
 
     # -- parsing / rendering ---------------------------------------------
 

@@ -53,6 +53,10 @@ class HTTPResponse:
     body: bytes = b""
     stream: Optional[Iterator[bytes]] = None   # used instead of body if set
     long_poll: bool = False   # idle event stream: exempt from admission
+    # admission-refusal label riding the response so the middleware's
+    # trace record can say WHY a 503 shed happened (set only by
+    # ShedDecision.response — the one shed construction site)
+    shed_reason: str = ""
 
     def with_xml(self, payload: bytes) -> "HTTPResponse":
         self.headers["Content-Type"] = "application/xml"

@@ -140,7 +140,9 @@ class TraceSys:
 
     def record(self, method: str, path: str, query: str, status: int,
                duration_s: float, caller: str = "",
-               api: str = "", trace_id: str = "") -> None:
+               api: str = "", trace_id: str = "",
+               ttfb_s: Optional[float] = None,
+               shed_reason: str = "") -> None:
         entry = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "node": self.node,
@@ -152,6 +154,13 @@ class TraceSys:
             "duration_ms": round(duration_s * 1e3, 3),
             "caller": caller,
         }
+        if ttfb_s is not None:
+            entry["ttfb_ms"] = round(ttfb_s * 1e3, 3)
+        if shed_reason:
+            # which admission signal refused this request (staging /
+            # scheduler / admission / conns / deadline) — the trace
+            # stream's answer to "why is my client seeing 503s"
+            entry["shed_reason"] = shed_reason
         if trace_id:
             # the span-tree key: `mc admin trace` output joins to the
             # /minio/admin/v3/spans dump through this id
@@ -208,16 +217,120 @@ class TraceSys:
 
     # -- admin streaming endpoint -----------------------------------------
 
-    def stream(self, max_entries: int = 0, idle_timeout: float = 10.0):
-        """Yields JSON-line trace entries as they happen (admin /trace);
-        ends after idle_timeout with no traffic or max_entries sent."""
-        sent = 0
-        with self.hub.subscribe() as sub:
-            while True:
-                entry = sub.get(timeout=idle_timeout)
-                if entry is None:
+    @staticmethod
+    def entry_matches(entry: dict, apis: Optional[set] = None,
+                      errors_only: bool = False) -> bool:
+        """The /trace endpoint's filter semantics (`mc admin trace
+        --api ... --errors` analog): `apis` keeps only those API names,
+        `errors_only` keeps failed calls (HTTP >= 400)."""
+        if apis and entry.get("api") not in apis:
+            return False
+        if errors_only and int(entry.get("status", 0) or 0) < 400:
+            return False
+        return True
+
+    @staticmethod
+    def _pump_peer(it, q: "queue.Queue", stop: threading.Event) -> None:
+        """Reader thread for one peer trace subscription: forwards
+        entries into the merge queue until the stream ends or the
+        consumer stops. A full queue drops (a slow follow client must
+        not apply backpressure to a peer's hub)."""
+        try:
+            for entry in it:
+                if stop.is_set():
                     return
-                yield (json.dumps(entry) + "\n").encode()
-                sent += 1
-                if max_entries and sent >= max_entries:
-                    return
+                try:
+                    q.put_nowait(entry)
+                except queue.Full:
+                    pass
+        finally:
+            it.close()
+
+    def stream(self, max_entries: int = 0, idle_timeout: float = 10.0,
+               follow: bool = False, apis: Optional[set] = None,
+               errors_only: bool = False, peer_subs=None,
+               max_s: float = 3600.0):
+        """JSON-line trace entries as they happen (admin /trace).
+
+        Default mode ends after `idle_timeout` with no traffic or
+        `max_entries` sent (the PR 3 behavior). `follow` mode is the
+        `mc admin trace` analog: a long-lived stream that survives idle
+        windows by emitting bare-newline heartbeats — which double as
+        the disconnect detector: a dead client's next heartbeat write
+        fails, unwinding the whole subscription (peers included)
+        instead of leaking a worker. `peer_subs` grafts every node's
+        records into this one stream: a CALLABLE returning the peer
+        iterators (PeerRPCClient trace_stream) — called lazily at the
+        generator's first iteration, so a response abandoned before
+        its first chunk (client reset during the head write) never
+        opens a peer subscription it could not unwind; each iterator
+        gets a daemon pump thread that dies with the stream. `max_s`
+        hard-caps a FOLLOW stream's life (non-follow keeps its
+        idle/count bounds)."""
+        q: "queue.Queue[dict]" = queue.Queue(maxsize=1000)
+        stop = threading.Event()
+
+        def gen():
+            subs = list(peer_subs() if callable(peer_subs)
+                        else peer_subs or [])
+            for it in subs:
+                threading.Thread(target=self._pump_peer,
+                                 args=(it, q, stop), daemon=True,
+                                 name="trace-follow-peer").start()
+            sent = 0
+            now = time.monotonic()
+            deadline = now + max_s if follow else float("inf")
+            last_entry = now
+            last_beat = now
+            try:
+                with self.hub.subscribe() as sub:
+                    while time.monotonic() < deadline:
+                        got = []
+                        if follow or subs:
+                            # heartbeat cadence / peer-queue drain
+                            # need sub-second wakeups
+                            timeout = 0.25
+                        else:
+                            # plain bounded stream: block the whole
+                            # remaining idle window in ONE get (no
+                            # 4 Hz wakeup churn on an idle server)
+                            timeout = (last_entry + idle_timeout
+                                       - time.monotonic())
+                            if timeout <= 0:
+                                return
+                        entry = sub.get(timeout=timeout)
+                        if entry is not None:
+                            got.append(entry)
+                        while True:
+                            try:
+                                got.append(q.get_nowait())
+                            except queue.Empty:
+                                break
+                        now = time.monotonic()
+                        for e in got:
+                            if not self.entry_matches(e, apis,
+                                                      errors_only):
+                                continue
+                            yield (json.dumps(e) + "\n").encode()
+                            # idle counts from the last MATCHED entry:
+                            # steady non-matching traffic must not
+                            # keep a filtered non-follow stream (which
+                            # never writes, so never detects a dead
+                            # client) alive forever
+                            last_entry = now
+                            last_beat = now
+                            sent += 1
+                            if max_entries and sent >= max_entries:
+                                return
+                        if follow:
+                            if now - last_beat >= 1.0:
+                                yield b"\n"       # liveness + hangup probe
+                                last_beat = now
+                        elif now - last_entry >= idle_timeout:
+                            return
+            finally:
+                stop.set()
+                for it in subs:
+                    it.close()
+
+        return gen()

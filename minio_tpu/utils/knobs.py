@@ -194,6 +194,11 @@ define("MINIO_TPU_SCHED_MAX_WAIT_MS", "float", 3.0,
 define("MINIO_TPU_SCHED_INFLIGHT", "int", 2,
        "concurrent dispatches in flight (transfer/compute overlap)", _S)
 
+define("MINIO_TPU_SCHED_ATTRIB", "bool", True,
+       "`off` disables per-dispatch stage attribution (queue/transfer/"
+       "compute/fetch histograms + child spans) — the overhead A/B "
+       "escape hatch", _S)
+
 _S = "Server"
 define("MINIO_TPU_MAX_CLIENTS", "int", 0,
        "admission-gate size; 0 derives it from the RAM+CPU budget", _S,
@@ -230,6 +235,10 @@ define("MINIO_TPU_EDGE_IDLE_S", "float", 120.0,
 define("MINIO_TPU_EDGE_POOL", "int", 0,
        "blocking handler worker threads behind the event loop "
        "(0 = 8×cores + 16)", _S, display="auto")
+define("MINIO_TPU_EDGE_LAG_S", "float", 1.0,
+       "event-loop lag sampler interval (each tick observes how late "
+       "the loop ran it into minio_tpu_edge_loop_lag_seconds; "
+       "0 disables)", _S)
 
 _S = "Fault plane"
 define("MINIO_TPU_MRF_QUEUE_SIZE", "int", 10000,
@@ -263,6 +272,13 @@ define("MINIO_TPU_TRACE_KEEP", "int", 128,
 define("MINIO_TPU_TRACE_MAX_SPANS", "int", 512,
        "span budget per trace; extras no-op and are counted as "
        "`spans_dropped`", _S)
+define("MINIO_TPU_CLUSTER_SCRAPE_S", "float", 2.0,
+       "per-peer deadline for the federated metrics scrape "
+       "(?cluster=1); a peer past it degrades the scrape and counts in "
+       "minio_tpu_cluster_scrape_failed_total", _S)
+define("MINIO_TPU_TRACE_FOLLOW_MAX_S", "float", 3600.0,
+       "hard lifetime cap on a ?follow=1 trace stream (a forgotten "
+       "client cannot hold peer subscriptions forever)", _S)
 
 _S = "Topology"
 define("MINIO_TPU_REBALANCE_MPU_GRACE_S", "float", 30.0,

@@ -592,18 +592,21 @@ def traced_iter(name: str, it, **attrs):
 
 
 def attach_span(parent: Span, name: str, start_wall: float,
-                duration_s: float, **attrs) -> None:
+                duration_s: float, **attrs) -> Optional[Span]:
     """Attach an externally-timed, already-finished span (work done on
     a shared thread no contextvar reaches, e.g. the batch scheduler's
-    collector) under `parent`."""
+    collector) under `parent`. Returns the new span (so the caller can
+    attach stage children under it), or None past the trace's span
+    budget."""
     root = parent.root or parent
     if not root._admit_child():
-        return
+        return None
     sp = Span(name, parent.trace_id, parent_id=parent.span_id,
               attrs=attrs or None, root=root)
     sp.start = start_wall
     sp.duration_s = duration_s
     parent.add_child(sp)
+    return sp
 
 
 def propagating_context() -> Optional[contextvars.Context]:
@@ -688,12 +691,20 @@ class SpanSink:
             target = index.get(f.parent_id, tree)
             target.setdefault("children", []).append(f.to_dict())
 
-    def dump(self, n: int = 50, slowest: bool = False) -> List[dict]:
+    def dump(self, n: int = 50, slowest: bool = False,
+             name: str = "", trace_id: str = "") -> List[dict]:
         """Most recent (or slowest) kept traces as dict trees, with
-        matching fragments grafted in."""
+        matching fragments grafted in. `name` keeps only roots with
+        that span name (the per-API filter: root names ARE api names
+        under the server middleware); `trace_id` selects one trace.
+        Filters apply BEFORE the count cut, so `n` counts matches."""
         with self._mu:
             kept = list(self._kept)
             frags = {tid: list(fs) for tid, fs in self._fragments.items()}
+        if name:
+            kept = [s for s in kept if s.name == name]
+        if trace_id:
+            kept = [s for s in kept if s.trace_id == trace_id]
         if slowest:
             kept.sort(key=lambda s: -s.duration_s)
         else:

@@ -1,0 +1,39 @@
+"""CI smoke for bench.py --ab-obs: the observability-plane A/B must
+run end-to-end inside the tier-1 budget, emit JSON-serializable
+results, and report all three phases — federated-scrape merge latency
+vs node count, trace-follow overhead on foreground PUT p99, and
+dispatch-attribution on/off overhead (telemetry_overhead_x)."""
+
+from __future__ import annotations
+
+import json
+
+import bench
+
+
+def test_obs_ab_smoke():
+    out = bench.bench_obs_ab(streams=2, size=1 << 18, drives=6,
+                             parity=2, block=1 << 16,
+                             node_counts=(1, 2), put_rounds=2,
+                             attrib_reps=3)
+    json.dumps(out)                       # BENCH-compatible payload
+    # phase 1: merge latency points per node count + the single-node
+    # HTTP scrape floor (the real federated path is timed against a
+    # live 2-node cluster in tests/test_obs.py)
+    pts = out["cluster_scrape"]["points"]
+    assert [p["nodes"] for p in pts] == [1, 2]
+    for p in pts:
+        assert p["merge_ms"] >= 0 and p["output_bytes"] > 0
+    assert out["cluster_scrape"]["local_scrape_ms"] > 0
+    assert out["cluster_scrape"]["local_scrape_bytes"] > 0
+    # phase 2: follow subscriber consumed the foreground's records and
+    # the overhead ratio is a sane positive number
+    tf = out["trace_follow"]
+    assert tf["entries_consumed"] >= 1
+    assert tf["baseline"]["p99_ms"] > 0
+    assert tf["put_p99_overhead_x"] > 0
+    # phase 3: both attribution modes dispatched and the ratio exists
+    at = out["attrib"]
+    assert at["dispatch_ms_attrib_on"] > 0
+    assert at["dispatch_ms_attrib_off"] > 0
+    assert at["telemetry_overhead_x"] > 0

@@ -517,3 +517,111 @@ def test_admission_rule_quiet_in_controller_and_on_tree():
     # the committed tree is clean: the handlers' shed window migrated
     from check.core import load_sources
     assert rules_ast.check_admission(load_sources()) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: metrics-hygiene / label cardinality (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+BAD_CARDINALITY = '''
+from ...utils import telemetry
+_OPS = telemetry.REGISTRY.counter("minio_tpu_zz_ops_total", "ops")
+def hot(self, bucket, key, oi):
+    _OPS.inc(bucket=bucket)
+    _OPS.inc(verb=key)
+    telemetry.REGISTRY.histogram(
+        "minio_tpu_zz_seconds", "lat").observe(0.1, target=oi.name)
+'''
+
+GOOD_CARDINALITY = '''
+from ...utils import telemetry
+_OPS = telemetry.REGISTRY.counter("minio_tpu_zz_ops_total", "ops")
+def hot(self, verb, reason):
+    _OPS.inc(verb=verb)
+    _OPS.inc(reason=reason)
+    _OPS.inc(stage="compute")
+    _OPS.inc(path="fallback")        # constant value: bounded
+'''
+
+
+def test_label_cardinality_fires_in_hot_modules():
+    """Raw bucket/object/key names as metric label values in hot-path
+    modules are unbounded cardinality: the key form (bucket=...), the
+    value form (verb=key) and the attribute form (target=oi.name) all
+    fire."""
+    vs = rules_ast.check_label_cardinality(
+        [_src("minio_tpu/object/engine.py", BAD_CARDINALITY)])
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3, vs
+    assert "request-derived 'bucket'" in msgs
+    assert "`key`" in msgs
+    assert "`oi.name`" in msgs
+
+
+ALIAS_CARDINALITY = '''
+from ...utils import telemetry
+g = telemetry.REGISTRY.gauge
+def hot(self, bucket):
+    g("minio_tpu_zz_depth", "d").set(1, bucket=bucket)
+'''
+
+
+def test_label_cardinality_sees_aliased_getters():
+    """`g = REGISTRY.gauge; g("n").set(..., bucket=b)` must fire too —
+    the attribute-only scan's blind spot (review finding)."""
+    vs = rules_ast.check_label_cardinality(
+        [_src("minio_tpu/object/engine.py", ALIAS_CARDINALITY)])
+    assert len(vs) == 1 and "request-derived 'bucket'" in vs[0].message
+
+
+def test_label_cardinality_quiet_on_bounded_and_cold_modules():
+    # bounded vocabularies (verb/reason/stage + constants) stay clean
+    assert rules_ast.check_label_cardinality(
+        [_src("minio_tpu/object/engine.py", GOOD_CARDINALITY)]) == []
+    # the same bad code OUTSIDE a hot-path module is tolerated (the
+    # admin handler's per-bucket usage gauges refresh at exposition
+    # time and clear() on every scrape)
+    assert rules_ast.check_label_cardinality(
+        [_src("minio_tpu/s3/admin.py", BAD_CARDINALITY)]) == []
+    # the committed tree argues every hot-path label bounded
+    from check.core import load_sources
+    assert rules_ast.check_label_cardinality(load_sources()) == []
+
+
+# ---------------------------------------------------------------------------
+# README metrics table (generated; drift gated)
+# ---------------------------------------------------------------------------
+
+def test_metrics_table_covers_registry_and_readme_is_fresh():
+    from check import metricstable
+    fams = metricstable.collect_families()
+    # the core families the telemetry plane registers must be seen by
+    # the static scan (registration sites, not a live render)
+    for fam in ("minio_tpu_http_requests_duration_seconds",
+                "minio_tpu_device_dispatch_seconds",
+                "minio_tpu_requests_shed_total",
+                "minio_tpu_cluster_scrape_failed_total",
+                "minio_tpu_edge_loop_lag_seconds",
+                # registered through getter ALIASES (g = REGISTRY.gauge)
+                # — the attribute-only scan's blind spot, found in
+                # review: the table must see these too
+                "minio_tpu_edge_pool_busy",
+                "minio_disks_online"):
+        assert fam in fams, fam
+    table = metricstable.render_table()
+    for fam in fams:
+        assert fam in table
+    # committed README is fresh (the gate would fail otherwise)
+    assert metricstable.check_drift() == []
+
+
+def test_metrics_table_drift_detected(monkeypatch, tmp_path):
+    from check import metricstable
+    stale = tmp_path / "README.md"
+    with open(metricstable.README, encoding="utf-8") as f:
+        text = f.read()
+    stale.write_text(text.replace(
+        "| counter |", "| gauge |", 1), encoding="utf-8")
+    monkeypatch.setattr(metricstable, "README", str(stale))
+    vs = metricstable.check_drift()
+    assert vs and "drifted" in vs[0].message

@@ -366,6 +366,109 @@ def check_metrics_hygiene(sources: List[Source]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# rule: metrics-hygiene / label cardinality
+# ---------------------------------------------------------------------------
+
+# Hot-path modules whose metric label VALUES must stay bounded: a
+# per-request metric labelled by a raw bucket/object/key name grows one
+# series per distinct name — unbounded registry memory, an exposition
+# whose size scales with the namespace, and a Prometheus server that
+# falls over on the scrape. Bounded labels (verb, api, reason, stage,
+# target, node, kind, source, consumer, tier, pool, loop, path-as-enum)
+# come from small closed vocabularies and stay clean.
+CARDINALITY_HOT_MODULES = LOCK_HOT_MODULES + (
+    "minio_tpu/s3/handlers.py",
+    "minio_tpu/s3/edge/dispatch.py",
+    "minio_tpu/s3/edge/server.py",
+    "minio_tpu/s3/edge/admission.py",
+    "minio_tpu/object/codec.py",
+    "minio_tpu/object/healing.py",
+)
+# label KEYS that name request-derived identifiers: always unbounded,
+# regardless of what expression feeds them
+_UNBOUNDED_LABEL_KEYS = {
+    "bucket", "object", "key", "obj", "etag", "version_id",
+    "upload_id", "prefix", "trace_id", "request_id", "caller",
+}
+# non-constant label VALUE expressions whose terminal name screams
+# request-derived (counter.inc(verb=bucket) is the same bug with a
+# clean key)
+_UNBOUNDED_VALUE_NAMES = _UNBOUNDED_LABEL_KEYS | {"path", "name"}
+
+_METRIC_METHODS = {"inc", "set", "observe"}
+
+
+def check_label_cardinality(sources: List[Source]) -> List[Violation]:
+    """metrics-hygiene sub-rule: in hot-path modules, metric label
+    values must come from bounded vocabularies — raw bucket/object/key
+    names (or any request-derived value) as a label value fails."""
+    out: List[Violation] = []
+    hot = set(CARDINALITY_HOT_MODULES)
+    for src in sources:
+        if src.rel not in hot:
+            continue
+        encl = enclosing_functions(src.tree)
+        # getter aliases (`g = telemetry.REGISTRY.gauge; g("n").set(…)`)
+        # — the attribute-only scan's blind spot; ONE scanner shared
+        # with the metrics table so the lint and the README can never
+        # disagree on which registration sites exist
+        from .metricstable import getter_aliases
+        aliases = getter_aliases(src.tree)
+        # var name (scoped like the hygiene rule) -> metric family
+        var_family: Dict[Tuple[Optional[ast.AST], str], str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in _GETTERS \
+                    and node.value.args:
+                fam = str_const(node.value.args[0])
+                if fam and fam.startswith("minio_"):
+                    var_family[(encl.get(node), node.targets[0].id)] = fam
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS):
+                continue
+            recv = node.func.value
+            fam: Optional[str] = None
+            if isinstance(recv, ast.Call) and recv.args and (
+                    (isinstance(recv.func, ast.Attribute)
+                     and recv.func.attr in _GETTERS)
+                    or (isinstance(recv.func, ast.Name)
+                        and recv.func.id in aliases)):
+                fam = str_const(recv.args[0])
+            elif isinstance(recv, ast.Name):
+                fn = encl.get(node)
+                fam = var_family.get((fn, recv.id)) or \
+                    var_family.get((None, recv.id))
+            if not fam:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                if kw.arg in _UNBOUNDED_LABEL_KEYS:
+                    out.append(Violation(
+                        "metrics-hygiene", src.rel, node.lineno,
+                        f"metric {fam!r} labelled by request-derived "
+                        f"{kw.arg!r} — one series per distinct "
+                        "bucket/object/key is unbounded cardinality; "
+                        "aggregate or drop the label"))
+                    continue
+                if isinstance(kw.value, ast.Constant):
+                    continue            # literal value: bounded
+                d = dotted(kw.value)
+                if d and d.split(".")[-1] in _UNBOUNDED_VALUE_NAMES:
+                    out.append(Violation(
+                        "metrics-hygiene", src.rel, node.lineno,
+                        f"metric {fam!r} label {kw.arg!r} fed by "
+                        f"request-derived value `{d}` — unbounded "
+                        "cardinality in a hot-path module"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rule: knob-env
 # ---------------------------------------------------------------------------
 

@@ -35,9 +35,10 @@ import sys
 if __package__ in (None, ""):                     # `python tools/check/run.py`
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from check import core, knobtable, rules_ast, rules_project
+    from check import (core, knobtable, metricstable, rules_ast,
+                       rules_project)
 else:
-    from . import core, knobtable, rules_ast, rules_project
+    from . import core, knobtable, metricstable, rules_ast, rules_project
 
 
 def _group_by_path(violations):
@@ -57,6 +58,8 @@ def run_checks(rules=None):
         vs += rules_ast.check_lock_blocking(sources)
     if "metrics-hygiene" in selected:
         vs += rules_ast.check_metrics_hygiene(sources)
+        vs += rules_ast.check_label_cardinality(sources)
+        vs += metricstable.check_drift(sources)
     if "knob-env" in selected:
         registered = set(knobtable.load_knobs().KNOBS)
         vs += rules_ast.check_knob_env(sources, registered)
@@ -95,11 +98,20 @@ def main(argv=None) -> int:
     ap.add_argument("--write-knob-table", action="store_true",
                     help="regenerate the README knob table from the "
                     "registry and exit")
+    ap.add_argument("--write-metrics-table", action="store_true",
+                    help="regenerate the README metrics reference "
+                    "table from the registry's registration sites and "
+                    "exit")
     args = ap.parse_args(argv)
 
     if args.write_knob_table:
         changed = knobtable.write_table()
         print("README knob table "
+              + ("updated" if changed else "already fresh"))
+        return 0
+    if args.write_metrics_table:
+        changed = metricstable.write_table()
+        print("README metrics table "
               + ("updated" if changed else "already fresh"))
         return 0
 
