@@ -84,6 +84,20 @@ def _parse(argv: list[str]) -> argparse.Namespace:
     t.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
 
+    f = sub.add_parser("fsck", help="run the crash-consistency "
+                       "auditor against a running node")
+    f.add_argument("--url", default="127.0.0.1:9000",
+                   help="server admin endpoint host:port")
+    f.add_argument("--repair", action="store_true",
+                   help="repair repairable findings (POST mode)")
+    f.add_argument("--bucket", default="",
+                   help="narrow the audit to one bucket")
+    f.add_argument("--tmp-age", type=float, default=None,
+                   help="staged tmp older than this (seconds) counts "
+                   "as a crash leftover; 0 = reap all (quiesced only)")
+    f.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
+
     g = sub.add_parser("gateway", help="serve the S3 API over a "
                        "foreign backend (cmd/gateway-main.go)")
     g.add_argument("kind", choices=("nas", "s3", "azure", "gcs",
@@ -276,11 +290,33 @@ def _run_tier(args, creds: Credentials) -> int:
     return 0
 
 
+def _run_fsck(args, creds: Credentials) -> int:
+    """`minio_tpu fsck` — drive the admin consistency auditor. Exit 0
+    when the tree is clean (or everything repairable was repaired),
+    1 when unrepaired findings remain."""
+    import json as _json
+    from .madmin import AdminClient, AdminClientError
+    from .utils import host_port
+    h, p = host_port(args.url, 9000)
+    cli = AdminClient(h, p, creds.access_key, creds.secret_key,
+                      region=args.region)
+    try:
+        out = cli.fsck(repair=args.repair, bucket=args.bucket,
+                       tmp_age_s=args.tmp_age)
+    except AdminClientError as e:
+        print(f"fsck failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0 if out.get("unrepaired", 0) == 0 else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
     if args.cmd == "gateway":
         return _run_gateway(args, creds)
+    if args.cmd == "fsck":
+        return _run_fsck(args, creds)
     if args.cmd == "decommission":
         return _run_decommission(args, creds)
     if args.cmd == "tier":

@@ -385,6 +385,26 @@ class ClusterNode:
             pass
         self.s3.api.tiers = self.tiers
 
+        # -- boot-time crash-consistency audit (object/fsck.py) ------------
+        # MINIO_TPU_FSCK_BOOT=on: audit every pool and repair what the
+        # last crash left behind (tmp garbage, orphan data dirs, torn
+        # registry copies) BEFORE the scanners/index start trusting the
+        # tree; repairable findings run the same heal/delete verbs the
+        # admin fsck endpoint uses
+        if this == 0 and knobs.get_bool("MINIO_TPU_FSCK_BOOT"):
+            from .object.fsck import run_fsck
+            try:
+                rep = run_fsck(self.object_layer, repair=True,
+                               tiers=self.tiers)
+                if not rep.clean:
+                    self.console.log_line(
+                        "INFO", f"boot fsck: found {rep.counts()}, "
+                        f"repaired {rep.repaired_counts()}, "
+                        f"unrepaired {len(rep.unrepaired)}")
+            except Exception as e:  # noqa: BLE001 — boot must proceed;
+                # the admin endpoint can rerun the audit on demand
+                self.console.log_line("ERROR", f"boot fsck failed: {e}")
+
         # -- bucket metacache (persisted listing index + scanner feed) -----
         from .object.metacache import MetacacheManager
         from .object import metacache as _mc

@@ -106,6 +106,18 @@ class AdminClient:
 
     # -- heal --------------------------------------------------------------
 
+    def fsck(self, repair: bool = False, bucket: str = "",
+             tmp_age_s: Optional[float] = None) -> dict:
+        """Run the crash-consistency auditor; ``repair=True`` also
+        repairs (POST). ``tmp_age_s=0`` reaps ALL staged tmp leftovers
+        (safe only when nothing is in flight)."""
+        q = {}
+        if bucket:
+            q["bucket"] = bucket
+        if tmp_age_s is not None:
+            q["tmp_age"] = str(tmp_age_s)
+        return self._json("POST" if repair else "GET", "fsck", query=q)
+
     def heal_start(self, bucket: str = "", prefix: str = "") -> str:
         out = self._json("POST", "heal",
                          {"bucket": bucket, "prefix": prefix})

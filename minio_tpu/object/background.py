@@ -30,7 +30,7 @@ import time
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..storage import errors as serr
-from ..utils import backoff_delay, knobs, lockcheck
+from ..utils import backoff_delay, crashpoint, knobs, lockcheck
 from ..storage.format import read_format_from, write_format_to
 from ..storage.xl_storage import MINIO_META_BUCKET, XLStorage
 from . import api_errors
@@ -176,6 +176,10 @@ class MRFHealer:
                 self._inflight[key] = False
             done = True
             try:
+                # dequeued, not yet healed: a crash loses only the
+                # retry (the object itself is intact; fsck/scanner
+                # re-finds the degradation)
+                crashpoint.hit("mrf.drain.before_heal")
                 res = self.heal_fn(bucket, obj, vid)
                 if getattr(res, "missing_after", 0):
                     # partial heal: copies are STILL missing (a target

@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
-from ..utils import knobs, stagetimer, telemetry
+from ..utils import crashpoint, knobs, stagetimer, telemetry
 from ..storage.api import StorageAPI
 from ..storage.datatypes import (BLOCK_SIZE_V1, RESTORE_EXPIRY_KEY,
                                  RESTORE_KEY, TRANSITION_COMPLETE,
@@ -699,12 +699,22 @@ class ErasureObjects:
                         c.hash = w.digest()
         disks_for_meta = [d if writers[i] is not None else None
                           for i, d in enumerate(shuffled)]
+        # shard fan-out is durable (in tmp), no metadata exists yet —
+        # a crash here must leave the previous version untouched and
+        # only tmp garbage for fsck to reclaim
+        crashpoint.hit("put.shards.before_meta")
         with stagetimer.stage("put.commit.write_meta"):
             meta.write_unique_file_info(disks_for_meta,
                                         MINIO_META_TMP_BUCKET,
                                         tmp_id, metas, write_quorum)
+        # fully staged, uncommitted: the rename fan-out is the point
+        # of no return
+        crashpoint.hit("put.meta.before_rename")
 
         def rename(i, d):
+            # one hit per drive: arm :<nth> to die with n-1 drives
+            # committed (torn below/at write quorum)
+            crashpoint.hit("put.rename.partial", disk=i)
             d.rename_data(MINIO_META_TMP_BUCKET, tmp_id, fi.data_dir,
                           bucket, object_name)
 

@@ -63,7 +63,7 @@ from typing import Iterator, Optional
 
 from ..storage.datatypes import ObjectInfo, ObjectPartInfo
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import knobs, lockcheck, telemetry
+from ..utils import crashpoint, knobs, lockcheck, telemetry
 from . import api_errors
 from .engine import paginate_objects, paginate_versions
 
@@ -386,6 +386,10 @@ class MetacacheManager:
             self._pending_count = sum(len(v)
                                       for v in self._pending.values())
         applied = 0
+        if work:
+            # claimed deltas die with the process here: acked writes
+            # must still surface via rebuild/reconcile after restart
+            crashpoint.hit("metacache.journal.drain")
         for bucket, names in work.items():
             with self._cond:
                 idx = self._indexes.get(bucket)
@@ -532,7 +536,9 @@ class MetacacheManager:
                 idx.gen = int(doc.get("gen", 0))
                 idx.dirty = set()
         except (api_errors.ObjectApiError, ValueError, KeyError,
-                TypeError, IndexError):
+                TypeError, IndexError, AttributeError):
+            # AttributeError covers a torn manifest whose truncated
+            # prefix still parses as valid non-dict JSON
             return False
         return True
 
@@ -636,10 +642,17 @@ class MetacacheManager:
                      for n, vers in pairs]).encode()
                 self.obj.put_object(MINIO_META_BUCKET, key, body)
                 written.append(key)
+                # one hit per segment (arm :<nth>): segments without a
+                # manifest are the orphan class fsck reclaims
+                crashpoint.hit("metacache.persist.segment")
             segments = sorted(
                 keep + [{"key": k, "first": f, "count": c}
                         for k, _p, f, c in chunks],
                 key=lambda s: s["first"])
+            # every segment landed, the manifest has not: restart
+            # loads the PRIOR manifest (or walk-rebuilds) and this
+            # attempt's segments are orphans
+            crashpoint.hit("metacache.persist.before_manifest")
             manifest = json.dumps({
                 "format": _FORMAT, "bucket": bucket, "gen": gen,
                 "updated": time.time(), "count": count,

@@ -24,6 +24,7 @@ from typing import Optional
 import numpy as np
 
 from .. import bitrot as bitrot_mod
+from ..utils import crashpoint
 from ..storage import errors as serr
 from ..storage.datatypes import (NULL_VERSION_ID, ChecksumInfo, FileInfo,
                                  ObjectInfo, now)
@@ -172,6 +173,10 @@ class MultipartMixin(ErasureObjects):
 
                 # move the staged part into the session's data dir
                 dst = f"{path}/{session_fi.data_dir}/part.{part_number}"
+
+                # staged shards exist, the session journal has never
+                # seen the part — a crash here loses only tmp garbage
+                crashpoint.hit("multipart.part.before_rename")
 
                 def rename(i, d):
                     if writers[i] is None:
@@ -433,8 +438,14 @@ class MultipartMixin(ErasureObjects):
                 meta.write_unique_file_info(
                     self.disks, MINIO_META_MULTIPART_BUCKET, path, metas,
                     write_quorum)
+                # final session meta written, object not yet renamed
+                # into the namespace: the session must survive intact
+                crashpoint.hit("multipart.complete.before_rename")
 
                 def rename(i, d):
+                    # one hit per drive (arm :<nth>): a torn complete
+                    crashpoint.hit("multipart.complete.rename.partial",
+                                   disk=i)
                     # name the committed version: the session meta also
                     # holds the placeholder entry, and a version-
                     # faithful replay's preserved mod time can sort

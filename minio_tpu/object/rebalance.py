@@ -42,7 +42,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import knobs, telemetry
+from ..utils import atomicfile, crashpoint, knobs, telemetry
 from ..utils.pressure import ForegroundPressure
 from ..utils.streams import IterStream as _IterStream
 from . import api_errors
@@ -572,6 +572,9 @@ class Rebalancer:
             if i == self.source:
                 continue
             try:
+                # one hit per pool (arm :<nth>): resume must tolerate
+                # a stale checkpoint (idempotent re-pass) or a torn one
+                crashpoint.hit("rebalance.checkpoint")
                 self.obj.server_sets[i].put_object(
                     MINIO_META_BUCKET, _checkpoint_object(self.source),
                     payload)
@@ -586,8 +589,14 @@ class Rebalancer:
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
                                          _checkpoint_object(pool))
-                doc = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
+                # a crash inside the checkpoint write can leave torn
+                # JSON (or a truncated valid-JSON prefix of the wrong
+                # type): treat it as absent, fall back to the previous
+                # pool's copy / a fresh pass
+                doc = atomicfile.load_json_doc(b"".join(stream))
+            except api_errors.ObjectApiError:
+                continue
+            if doc is None:
                 continue
             if best is None or doc.get("updated", 0) > \
                     best.get("updated", 0):

@@ -743,9 +743,23 @@ class ErasureServerSets:
             # boot-time pools, or its writes would be invisible to the
             # index/cache until reconcile
             sets.on_namespace_change = self._dispatch_namespace_change
-        self.topology.add_pool(POOL_ACTIVE)
+        # boot-time RE-attach must not forget a persisted state: the
+        # map loaded at boot was truncated to the CLI drive list's pool
+        # count, so a node that crashed mid-drain and reboots with
+        # --pool would re-register the draining pool as active and
+        # silently abandon the drain (found by the crash harness).
+        # Adopt the persisted doc's state for this index when it has
+        # one; genuinely new pools still default to active.
+        state = POOL_ACTIVE
+        persisted = TopologyStore.load(self)
+        idx = len(self.server_sets) - 1
+        if persisted is not None and len(persisted.states) > idx \
+                and persisted.epoch >= self.topology.epoch:
+            state = persisted.states[idx]
+        self.topology.add_pool(state)
         TopologyStore.save(self, self.topology)
-        # a drain parked for lack of target capacity can proceed now
+        # a drain parked for lack of target capacity — or adopted as
+        # still-draining above — can proceed now
         self.resume_rebalance_if_pending()
         return len(self.server_sets) - 1
 

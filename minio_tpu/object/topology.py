@@ -40,6 +40,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
+from ..utils import atomicfile, crashpoint
 from . import api_errors
 
 if TYPE_CHECKING:  # pragma: no cover — typing only
@@ -173,6 +174,9 @@ class TopologyStore:
         last: Optional[Exception] = None
         for z in server_sets.server_sets:
             try:
+                # one hit per pool (arm :<nth>): pools left disagreeing
+                # on the epoch must converge on load (highest wins)
+                crashpoint.hit("topology.save.pool")
                 z.put_object(MINIO_META_BUCKET, TOPOLOGY_OBJECT, payload)
                 landed += 1
             except Exception as e:  # noqa: BLE001 — per-pool durability
@@ -190,8 +194,10 @@ class TopologyStore:
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
                                          TOPOLOGY_OBJECT)
-                doc = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
+                doc = atomicfile.load_json_doc(b"".join(stream))
+            except api_errors.ObjectApiError:
+                continue
+            if doc is None:     # torn/truncated copy: other pools win
                 continue
             if best is None or int(doc.get("epoch", 0)) > \
                     int(best.get("epoch", 0)):

@@ -134,11 +134,10 @@ class DataUpdateTracker:
                 f.bits.tobytes()).decode()
                 for c, f in self._history.items() if not f.empty},
         }
-        tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(blob, f)
-        os.replace(tmp, self.path)
+        # shared commit recipe: fsync barriers ride MINIO_TPU_FSYNC
+        from ..utils import atomicfile
+        atomicfile.write_atomic(self.path, json.dumps(blob).encode())
 
     def flush(self) -> None:
         with self._mu:
@@ -151,6 +150,8 @@ class DataUpdateTracker:
                 blob = json.load(f)
         except (OSError, ValueError):
             return
+        if not isinstance(blob, dict):
+            return      # torn write truncated to a non-dict JSON prefix
         self.cycle = int(blob.get("cycle", 1))
         for c, b64 in blob.get("history", {}).items():
             bits = np.frombuffer(base64.b64decode(b64),

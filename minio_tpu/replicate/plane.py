@@ -41,7 +41,7 @@ from ..object import api_errors
 from ..object.background import MRFHealer
 from ..object.engine import GetOptions
 from ..object.faithful import spec_of
-from ..utils import knobs, telemetry
+from ..utils import crashpoint, knobs, telemetry
 from ..utils.bandwidth import TokenBucket
 from ..utils.pressure import ForegroundPressure
 from .client import (ReplClientError, ReplTargetClient,
@@ -430,6 +430,10 @@ class ReplicationPlane:
                 factory = self._reader_factory(bucket, key,
                                                spec.version_id, target)
             try:
+                # spooled and ready, the target has not seen it: a
+                # crash here must leave a retryable queue entry, never
+                # a half-applied replica
+                crashpoint.hit("replicate.push.before_apply")
                 result = client.apply_version(key, spec, factory)
             except api_errors.ObjectApiError:
                 # the version vanished locally between list and read

@@ -26,6 +26,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..object import api_errors
+from ..utils import atomicfile, crashpoint
 from ..storage.xl_storage import MINIO_META_BUCKET
 from ..utils import knobs, telemetry
 from .targets import REPL_PREFIX, TargetRegistry
@@ -209,6 +210,9 @@ class Resyncer:
         layers = getattr(self.obj, "server_sets", None) or [self.obj]
         for z in layers:
             try:
+                # one hit per pool (arm :<nth>): resume re-covers the
+                # un-checkpointed tail idempotently
+                crashpoint.hit("resync.checkpoint")
                 z.put_object(MINIO_META_BUCKET,
                              _checkpoint_object(self.arn), payload)
             except Exception:  # noqa: BLE001 — best-effort per pool
@@ -223,8 +227,12 @@ class Resyncer:
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
                                          _checkpoint_object(arn))
-                doc = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
+                # torn checkpoint (crash mid-write) = absent, never a
+                # boot-path crash
+                doc = atomicfile.load_json_doc(b"".join(stream))
+            except api_errors.ObjectApiError:
+                continue
+            if doc is None:
                 continue
             if best is None or doc.get("updated", 0) > \
                     best.get("updated", 0):

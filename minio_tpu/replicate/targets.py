@@ -24,6 +24,7 @@ import uuid as _uuid
 from typing import Optional
 
 from ..object import api_errors
+from ..utils import atomicfile, crashpoint
 from ..storage.xl_storage import MINIO_META_BUCKET
 
 REPL_PREFIX = "replicate/"
@@ -266,6 +267,8 @@ class TargetRegistry:
         last: Optional[Exception] = None
         for z in pools:
             try:
+                # one hit per pool (arm :<nth>)
+                crashpoint.hit("replicate.registry.save.pool")
                 z.put_object(MINIO_META_BUCKET, TARGETS_OBJECT, payload)
                 landed += 1
             except Exception as e:  # noqa: BLE001 — per-pool durability
@@ -285,8 +288,10 @@ class TargetRegistry:
         for z in self._pools():
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET, TARGETS_OBJECT)
-                doc = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
+                doc = atomicfile.load_json_doc(b"".join(stream))
+            except api_errors.ObjectApiError:
+                continue
+            if doc is None:     # torn/truncated copy: other pools win
                 continue
             if best is None or int(doc.get("epoch", 0)) > \
                     int(best.get("epoch", 0)):

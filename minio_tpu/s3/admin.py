@@ -332,6 +332,25 @@ class AdminHandlers:
             self._auth(ctx, "admin:Heal")
             fn = getattr(self.api.obj, "mrf_stats", None)
             return self._json(fn() if callable(fn) else {})
+        if sub == "fsck" and m in ("GET", "POST"):
+            # crash-consistency auditor (object/fsck.py): GET audits,
+            # POST audits AND repairs (repairable classes feed the
+            # heal/delete/rebuild machinery; lost data is reported).
+            # ?bucket= narrows, ?tmp_age=0 treats ALL staged tmp as
+            # stale (boot/harness mode — nothing can be in flight)
+            self._auth(ctx, "admin:Heal")
+            from ..object.fsck import run_fsck
+            bucket = ctx.query1("bucket")
+            try:
+                age = float(ctx.query1("tmp_age", "-1") or -1)
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad tmp_age") from None
+            report = run_fsck(self.api.obj, repair=(m == "POST"),
+                              tiers=self.api.tiers,
+                              buckets=[bucket] if bucket else None,
+                              tmp_age_s=age if age >= 0 else None)
+            return self._json(report.to_dict())
         if sub == "metacache" and m == "GET":
             # bucket metacache visibility (ROADMAP item 2 `mc.stats()`
             # remainder): per-bucket index state (entries, building/

@@ -26,7 +26,7 @@ import uuid as _uuid
 from typing import BinaryIO, Iterator, Optional
 
 from .. import bitrot as bitrot_mod
-from ..utils import knobs, telemetry
+from ..utils import atomicfile, crashpoint, knobs, telemetry
 from . import errors
 from .api import BitrotVerifier, StorageAPI
 from .datatypes import DiskInfo, FileInfo, VolInfo
@@ -129,6 +129,9 @@ class _DirectWriter:
                             flags & ~os.O_DIRECT)
                 self._flush_exact(
                     memoryview(self._buf)[aligned:self._fill])
+            # O_DIRECT bypasses the page cache for DATA only — file
+            # size/allocation metadata still needs the barrier
+            atomicfile.fsync_file(self.fd)
         finally:
             self._buf.close()
             os.close(self.fd)
@@ -144,6 +147,27 @@ class _DirectWriter:
                 os.close(self.fd)
         except (OSError, AttributeError):
             pass
+
+
+class _SyncedAppender:
+    """Buffered append handle that fsyncs at close — the shard-write
+    barrier under MINIO_TPU_FSYNC (a shard referenced by a committed
+    xl.meta must not evaporate in a power cut)."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def write(self, data) -> int:
+        return self._f.write(data)
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    def close(self) -> None:
+        try:
+            atomicfile.fsync_file(self._f)
+        finally:
+            self._f.close()
 
 
 def _direct_io_default() -> bool:
@@ -329,19 +353,20 @@ class XLStorage(StorageAPI):
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         fp = self._file_path(volume, path)
-        tmp = fp + "." + _uuid.uuid4().hex[:8] + ".tmp"
         try:
             os.makedirs(os.path.dirname(fp), exist_ok=True)
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, fp)  # atomic commit (pkg/safe analog)
+            # torn-write injection context for in-process crash tests:
+            # an armed action receives path=/data= and can commit a
+            # truncated copy to the final name before aborting (what
+            # power loss without the fsync discipline produces)
+            crashpoint.hit("storage.write_all.commit", path=fp,
+                           data=data)
+            # write-temp → (fsync) → rename → (dirsync): MINIO_TPU_FSYNC
+            # turns the barriers on (pkg/safe analog + ALICE safe-rename)
+            atomicfile.write_atomic(fp, data)
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
         except OSError as e:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
             raise errors.FaultyDisk(str(e)) from e
 
     def append_file(self, volume: str, path: str, buf: bytes) -> None:
@@ -353,6 +378,10 @@ class XLStorage(StorageAPI):
                 os.makedirs(os.path.dirname(fp), exist_ok=True)
                 with open(fp, "ab") as f:
                     f.write(buf)
+                    # remote disks stream shards through THIS verb (the
+                    # RPC client has no appender), so the shard-durable-
+                    # before-meta-commit barrier must live here too
+                    atomicfile.fsync_file(f)
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
         except OSError as e:
@@ -389,7 +418,11 @@ class XLStorage(StorageAPI):
                         return _DirectWriter(fp, truncate=False)
                     except OSError:
                         pass      # fs without O_DIRECT: buffered
-            return open(fp, "ab")
+            f = open(fp, "ab")
+            # shard files must be durable BEFORE the xl.meta commit
+            # references them: sync at close under the discipline
+            return _SyncedAppender(f) if atomicfile.fsync_enabled() \
+                else f
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
         except OSError as e:
@@ -441,6 +474,10 @@ class XLStorage(StorageAPI):
                 if size >= 0 and remaining > 0:
                     raise errors.LessData(path)
             finally:
+                if not isinstance(f, _DirectWriter):
+                    # _DirectWriter barriers inside its own close
+                    # (after the unaligned-tail flush)
+                    atomicfile.fsync_file(f)
                 f.close()
         except NotADirectoryError:
             raise errors.FileParentIsFile(fp) from None
@@ -507,6 +544,7 @@ class XLStorage(StorageAPI):
             raise errors.FileNotFound(src_path) from None
         except OSError as e:
             raise errors.FaultyDisk(str(e)) from e
+        atomicfile.fsync_dir(os.path.dirname(dst))
         self._cleanup_empty_parents(src_volume, os.path.dirname(src))
 
     def delete_file(self, volume: str, path: str,
@@ -680,7 +718,11 @@ class XLStorage(StorageAPI):
                 raise errors.FileNotFound(src_path) from None
             except OSError as e:
                 raise errors.FaultyDisk(str(e)) from e
+            atomicfile.fsync_dir(os.path.dirname(dst_data))
 
+        # the single-drive torn window: data dir in place, xl.meta not
+        # yet rewritten — restart-side fsck must reclaim the orphan
+        crashpoint.hit("storage.rename_data.before_meta")
         self.write_all(dst_volume,
                        os.path.join(dst_path, XL_STORAGE_FORMAT_FILE),
                        dst_meta.dumps())

@@ -19,6 +19,7 @@ import time
 from typing import Optional
 
 from ..object import api_errors
+from ..utils import atomicfile, crashpoint
 from ..storage.xl_storage import MINIO_META_BUCKET
 from .client import TierClient, TierClientError, new_tier_client
 
@@ -191,6 +192,8 @@ class TierManager:
         last: Optional[Exception] = None
         for z in pools:
             try:
+                # one hit per pool (arm :<nth>)
+                crashpoint.hit("tier.save.pool")
                 z.put_object(MINIO_META_BUCKET, TIER_CONFIG_OBJECT,
                              payload)
                 landed += 1
@@ -210,8 +213,10 @@ class TierManager:
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
                                          TIER_CONFIG_OBJECT)
-                doc = json.loads(b"".join(stream).decode())
-            except (api_errors.ObjectApiError, ValueError):
+                doc = atomicfile.load_json_doc(b"".join(stream))
+            except api_errors.ObjectApiError:
+                continue
+            if doc is None:     # torn/truncated copy: other pools win
                 continue
             if best is None or int(doc.get("epoch", 0)) > \
                     int(best.get("epoch", 0)):

@@ -385,6 +385,23 @@ _S = "Events"
 define("MINIO_TPU_QUEUE_FSYNC", "bool", False,
        "fsync durable event-queue writes (survives power loss)", _S)
 
+_S = "Crash consistency"
+define("MINIO_TPU_FSYNC", "bool", False,
+       "`on` = fsync barriers on commit paths (fsync before rename, "
+       "directory fsync after; shard files synced at close) — "
+       "power-loss durability at real I/O cost", _S)
+define("MINIO_TPU_CRASHPOINT", "str", "",
+       "`<name>[:<nth>]` hard-exits the process (os._exit 137) at the "
+       "Nth hit of the named crashpoint — the kill/restart harness's "
+       "deterministic crash injector (see README crashpoint table)", _S,
+       display="unset")
+define("MINIO_TPU_FSCK_BOOT", "bool", False,
+       "`on` runs the fsck consistency auditor (repair mode) at "
+       "cluster boot, feeding repairable findings to heal/MRF", _S)
+define("MINIO_TPU_FSCK_TMP_AGE_S", "float", 3600.0,
+       "staged tmp writes older than this count as crash leftovers "
+       "for fsck (younger ones may be in-flight PUTs)", _S)
+
 _S = "Lock watchdog"
 define("MINIO_TPU_LOCKCHECK", "bool", False,
        "instrument named locks: record the cross-thread acquisition "

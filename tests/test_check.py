@@ -625,3 +625,129 @@ def test_metrics_table_drift_detected(monkeypatch, tmp_path):
     monkeypatch.setattr(metricstable, "README", str(stale))
     vs = metricstable.check_drift()
     assert vs and "drifted" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# rule: crashpoint
+# ---------------------------------------------------------------------------
+
+BAD_COMMIT = '''
+class Store:
+    def commit(self, d):
+        # write + rename across >= 2 paths, no crashpoint declared
+        d.write_all("v", "xl.meta", b"m")
+        d.rename_data("tmp", "t", "dd", "b", "o")
+
+    def save_everywhere(self, pools, payload):
+        for z in pools:
+            z.put_object(".minio.sys", "doc.json", payload)
+'''
+
+GOOD_COMMIT = '''
+from ..utils import crashpoint
+
+class Store:
+    def commit(self, d):
+        d.write_all("v", "xl.meta", b"m")
+        crashpoint.hit("put.meta.before_rename")
+        d.rename_data("tmp", "t", "dd", "b", "o")
+
+    def save_everywhere(self, pools, payload):
+        for z in pools:
+            crashpoint.hit("topology.save.pool")
+            z.put_object(".minio.sys", "doc.json", payload)
+
+    def single_write(self, d):
+        d.write_all("v", "doc.json", b"x")      # one path: no window
+
+    def read_side(self, d):
+        return d.read_all("v", "doc.json")
+'''
+
+BAD_HIT_NAMES = '''
+from ..utils import crashpoint
+
+def f(name):
+    crashpoint.hit("not.a.registered.point")
+    crashpoint.hit(name)
+'''
+
+
+def _crash_registered():
+    from check import crashtable
+    return set(crashtable.load_crashpoints().CRASHPOINTS)
+
+
+def test_crashpoint_rule_fires_on_uncovered_commit_windows():
+    src = _src("minio_tpu/object/topology.py", BAD_COMMIT)
+    vs = rules_project.check_crashpoint([src], _crash_registered())
+    msgs = [v.message for v in vs]
+    assert len(vs) == 2
+    assert any("commit" in m and "write" in m for m in msgs)
+    assert any("loop" in m or "persistence" in m for m in msgs)
+
+
+def test_crashpoint_rule_quiet_on_declared_and_cold_modules():
+    good = _src("minio_tpu/object/topology.py", GOOD_COMMIT)
+    assert rules_project.check_crashpoint([good],
+                                          _crash_registered()) == []
+    # same bad shape OUTSIDE the designated commit modules: quiet
+    cold = _src("minio_tpu/s3/handlers.py", BAD_COMMIT)
+    assert rules_project.check_crashpoint([cold],
+                                          _crash_registered()) == []
+
+
+def test_crashpoint_rule_flags_bad_hit_names_everywhere():
+    src = _src("minio_tpu/s3/handlers.py", BAD_HIT_NAMES)
+    vs = rules_project.check_crashpoint([src], _crash_registered())
+    assert len(vs) == 2
+    assert any("unregistered" in v.message for v in vs)
+    assert any("constant" in v.message for v in vs)
+
+
+def test_crashpoint_rule_suppression():
+    suppressed = BAD_COMMIT.replace(
+        "    def commit(self, d):",
+        "    # check: allow(crashpoint) two-phase handled by caller\n"
+        "    def commit(self, d):")
+    src = _src("minio_tpu/object/topology.py", suppressed)
+    from check.core import filter_allowed
+    vs = filter_allowed(src, rules_project.check_crashpoint(
+        [src], _crash_registered()))
+    assert len(vs) == 1          # only save_everywhere still flagged
+
+
+def test_crashpoint_rule_green_on_real_tree():
+    from check.core import load_sources, filter_allowed
+    sources = load_sources()
+    by_rel = {s.rel: s for s in sources}
+    vs = rules_project.check_crashpoint(sources, _crash_registered())
+    out = []
+    for v in vs:
+        src = by_rel.get(v.path)
+        if src is None or not src.is_allowed(v.rule, v.line):
+            out.append(v)
+    assert out == [], [str(v) for v in out]
+
+
+def test_crashpoint_table_covers_registry_and_readme_is_fresh():
+    from check import crashtable
+    mod = crashtable.load_crashpoints()
+    table = mod.render_table()
+    for name in mod.CRASHPOINTS:
+        assert f"`{name}`" in table
+    assert crashtable.check_drift() == []
+
+
+def test_crashpoint_table_drift_detected(tmp_path, monkeypatch):
+    from check import crashtable
+    readme = tmp_path / "README.md"
+    readme.write_text("# x\n\nno markers\n")
+    monkeypatch.setattr(crashtable, "README", str(readme))
+    vs = crashtable.check_drift()
+    assert vs and "markers missing" in vs[0].message
+    mod = crashtable.load_crashpoints()
+    readme.write_text(
+        f"# x\n\n{mod.TABLE_BEGIN}\nstale\n{mod.TABLE_END}\n")
+    vs2 = crashtable.check_drift()
+    assert vs2 and "drifted" in vs2[0].message

@@ -1,0 +1,466 @@
+"""Crash-consistency matrix: real-subprocess SIGKILL/restart tests.
+
+For every registered crashpoint (utils/crashpoint.py) the harness
+(tests/harness/proc.py) boots a REAL ``python -m minio_tpu server``
+process, seeds acknowledged state, restarts armed
+(``MINIO_TPU_CRASHPOINT=<name>[:n]`` → hard ``os._exit`` at the named
+instruction), triggers the covering operation, waits for the process
+to die, reboots clean and asserts the durability contract:
+
+  * every acknowledged write is readable byte-identical;
+  * the crashed operation's object is ABSENT or COMPLETE — never torn;
+  * ``fsck --repair`` converges the tree to zero unrepaired findings
+    and a second audit is fully clean.
+
+The whole matrix is ``slow`` (tier-1 excludes it); ``test_crash_smoke``
+is the 3-point CI subset the tooling satellite pins. The fast tests at
+the bottom assert the matrix COVERS the registry (a new crashpoint
+without a crash test is a test failure, not a silent gap) and pin the
+crashpoint module's own semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from minio_tpu.utils import crashpoint
+from tests.harness.proc import (ACCESS_KEY, CRASH_EXIT_CODE, SECRET_KEY,
+                                ProcNode, expect_request_death)
+
+BUCKET = "bkt"
+SEED = b"s" * 4096
+CRASH_BODY = b"c" * 8192
+PART1 = b"p" * (5 * 1024 * 1024)
+PART2 = b"q" * 1024
+
+# ---------------------------------------------------------------------------
+# matrix scenarios
+# ---------------------------------------------------------------------------
+
+def _put_crash(n: ProcNode) -> None:
+    expect_request_death(lambda: n.put(BUCKET, "crash", CRASH_BODY))
+
+
+def _mpu_crash(n: ProcNode) -> None:
+    expect_request_death(
+        lambda: n.multipart(BUCKET, "mp", [PART1, PART2]))
+
+
+def _metacache_kick(n: ProcNode) -> None:
+    """One acked PUT while no index exists (nothing can crash yet),
+    then a listing serve (builds the index → dirty → persist due) and
+    one more PUT (journals the delta the drainer claims). The armed
+    persist/drain point fires on the BACKGROUND loop — possibly while
+    one of these client calls is still on the wire, so each may die
+    with the server."""
+    n.put(BUCKET, "acked-pre-build", SEED)
+    expect_request_death(lambda: n.list_keys(BUCKET))
+    expect_request_death(lambda: n.put(BUCKET, "during", SEED))
+
+
+def _tier_add(n: ProcNode) -> None:
+    path = os.path.join(n.workdir, "tier1")
+    expect_request_death(
+        lambda: n.admin().add_tier("t1", "fs", path=path))
+
+
+def _repl_target_add(n: ProcNode) -> None:
+    expect_request_death(
+        lambda: n.admin().add_replicate_target(
+            BUCKET, "127.0.0.1", 1, BUCKET, ACCESS_KEY, SECRET_KEY))
+
+
+def _seed_many(n: ProcNode) -> None:
+    for i in range(6):
+        n.put(BUCKET, f"obj{i}", bytes([65 + i]) * 1500)
+
+
+def _start_drain(n: ProcNode) -> None:
+    expect_request_death(lambda: n.admin().start_rebalance(1))
+
+
+def _verify_many(n: ProcNode) -> None:
+    for i in range(6):
+        assert n.get(BUCKET, f"obj{i}") == bytes([65 + i]) * 1500, \
+            f"acked obj{i} lost"
+
+
+def _verify_drain_resumes(n: ProcNode) -> None:
+    """Boot auto-resumes a drain left pending (the pool is still
+    marked draining in the persisted epoch doc)."""
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        st = n.admin().rebalance_status().get("rebalance", {})
+        if st.get("status") in ("complete", "completed"):
+            break
+        assert st.get("status") != "failed", st
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"drain never completed: {st}")
+    _verify_many(n)
+
+
+def _verify_metacache(n: ProcNode) -> None:
+    assert n.get(BUCKET, "acked-pre-build") == SEED, \
+        "write acked before the crash is unreadable after restart"
+    keys = n.list_keys(BUCKET)
+    assert {"seed", "acked-pre-build"} <= set(keys), \
+        f"acked writes missing from the post-restart listing: {keys}"
+    # the in-flight PUT is absent or complete, and the listing agrees
+    # with readability either way (no half-indexed ghost)
+    assert ("during" in keys) == n.exists(BUCKET, "during")
+
+
+_MC_ENV = {"MINIO_TPU_METACACHE_PERSIST_S": "0",
+           "MINIO_TPU_METACACHE_FLUSH_S": "0.05"}
+
+# name → scenario. Keys are crashpoint SPECS (":<nth>" selects the hit
+# for per-disk / per-pool fan-out points).
+CASES = {
+    "put.shards.before_meta": dict(trigger=_put_crash,
+                                   atomic=[("crash", CRASH_BODY)]),
+    "put.meta.before_rename": dict(trigger=_put_crash,
+                                   atomic=[("crash", CRASH_BODY)]),
+    "put.rename.partial:2": dict(trigger=_put_crash,
+                                 atomic=[("crash", CRASH_BODY)]),
+    "storage.rename_data.before_meta": dict(
+        trigger=_put_crash, atomic=[("crash", CRASH_BODY)]),
+    "multipart.part.before_rename": dict(
+        trigger=_mpu_crash, atomic=[("mp", PART1 + PART2)]),
+    "multipart.complete.before_rename": dict(
+        trigger=_mpu_crash, atomic=[("mp", PART1 + PART2)]),
+    "multipart.complete.rename.partial:2": dict(
+        trigger=_mpu_crash, atomic=[("mp", PART1 + PART2)]),
+    "metacache.persist.segment": dict(
+        trigger=_metacache_kick, env=_MC_ENV, wait_exit=90,
+        atomic=[("during", SEED)], verify=_verify_metacache),
+    "metacache.persist.before_manifest": dict(
+        trigger=_metacache_kick, env=_MC_ENV, wait_exit=90,
+        atomic=[("during", SEED)], verify=_verify_metacache),
+    "metacache.journal.drain": dict(
+        trigger=_metacache_kick, env=_MC_ENV, wait_exit=90,
+        atomic=[("during", SEED)], verify=_verify_metacache),
+    "topology.save.pool": dict(pools=2, boot_crash=True),
+    "tier.save.pool": dict(trigger=_tier_add),
+    "replicate.registry.save.pool": dict(trigger=_repl_target_add),
+    "rebalance.checkpoint": dict(
+        pools=2, seed=_seed_many, trigger=_start_drain, wait_exit=120,
+        env={"MINIO_TPU_REBALANCE_CHECKPOINT_EVERY": "1"},
+        verify=_verify_drain_resumes),
+}
+
+# registered points exercised OUTSIDE the subprocess matrix: the
+# two-site tests below (resync/push need a live peer) and the
+# in-process torn-write/MRF tests in tests/test_fsck.py
+COVERED_ELSEWHERE = {
+    "resync.checkpoint": "test_crash.py::test_two_site_resync_crash",
+    "replicate.push.before_apply":
+        "test_crash.py::test_two_site_push_crash",
+    "mrf.drain.before_heal": "test_fsck.py::test_mrf_drain_crash",
+    "storage.write_all.commit":
+        "test_fsck.py::test_torn_write_injection",
+}
+
+SMOKE_POINTS = ("put.meta.before_rename",
+                "multipart.complete.before_rename",
+                "metacache.persist.before_manifest")
+
+
+def run_case(tmp_path, spec: str) -> None:
+    case = CASES[spec]
+    env = case.get("env")
+    n = ProcNode(str(tmp_path), name="n", pools=case.get("pools", 1))
+    try:
+        # phase 1 (unarmed): seed acknowledged state
+        n.start(extra_env=env)
+        n.s3().make_bucket(BUCKET)
+        n.put(BUCKET, "seed", SEED)
+        case.get("seed", lambda node: None)(n)
+        n.stop()
+
+        # phase 2 (armed): trigger, die at the named instruction
+        if case.get("boot_crash"):
+            # the point fires inside boot itself (epoch persist on
+            # pool attach) — no client trigger, just wait for death
+            n.start(crashpoint=spec, extra_env=env, wait=False)
+        else:
+            n.start(crashpoint=spec, extra_env=env)
+            case["trigger"](n)
+        rc = n.wait_exit(case.get("wait_exit", 60))
+        assert rc == CRASH_EXIT_CODE, (rc, n.tail_log())
+
+        # phase 3 (unarmed): restart, assert the durability contract
+        n.start(extra_env=env)
+        assert n.get(BUCKET, "seed") == SEED, \
+            f"{spec}: acknowledged write lost across the crash"
+        for key, body in case.get("atomic", ()):
+            if n.exists(BUCKET, key):
+                got = n.get(BUCKET, key)
+                assert got == body, \
+                    f"{spec}: {key} served TORN ({len(got)} bytes)"
+        case.get("verify", lambda node: None)(n)
+        rep = n.fsck(repair=True)
+        assert rep["unrepaired"] == 0, (spec, rep)
+        rep2 = n.fsck(repair=False)
+        assert rep2["clean"], (spec, rep2)
+        n.stop()
+    finally:
+        n.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", sorted(CASES))
+def test_crash_matrix(tmp_path, spec):
+    run_case(tmp_path, spec)
+
+
+@pytest.mark.slow
+def test_crash_smoke(tmp_path):
+    """The 3-point CI subset (tooling satellite): one PUT commit, one
+    multipart complete, one metacache persist — the cheapest spanning
+    set of the three commit families."""
+    for i, spec in enumerate(SMOKE_POINTS):
+        run_case(tmp_path / str(i), spec)
+
+
+# ---------------------------------------------------------------------------
+# two-process active-active site pair (ROADMAP item 4 remainder)
+# ---------------------------------------------------------------------------
+
+def _counter_total(node: ProcNode, family: str) -> float:
+    total = 0.0
+    for line in node.admin().metrics_text().splitlines():
+        if line.startswith(family) and " " in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _wait_converged(a: ProcNode, b: ProcNode, timeout: float = 90.0
+                    ) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        la, lb = a.listing(BUCKET), b.listing(BUCKET)
+        if la and la == lb:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"sites never converged:\nA={a.listing(BUCKET)}\n"
+        f"B={b.listing(BUCKET)}")
+
+
+def _pair(tmp_path) -> tuple[ProcNode, ProcNode]:
+    a = ProcNode(str(tmp_path / "a"), name="a")
+    b = ProcNode(str(tmp_path / "b"), name="b")
+    return a, b
+
+
+def _add_target(a: ProcNode, b: ProcNode) -> str:
+    return a.admin().add_replicate_target(
+        BUCKET, "127.0.0.1", b.port, BUCKET, ACCESS_KEY, SECRET_KEY)
+
+
+def _resync_to_convergence(a: ProcNode, b: ProcNode, arn: str,
+                           timeout: float = 120.0) -> None:
+    """Start (or restart) the resync and poll until listings match —
+    re-kicking a finished-but-incomplete resync, since a crashed
+    worker loses its in-memory queue by design (resync is the
+    backstop)."""
+    deadline = time.monotonic() + timeout
+    a.admin().start_replicate_resync(arn)
+    while time.monotonic() < deadline:
+        la, lb = a.listing(BUCKET), b.listing(BUCKET)
+        if la and la == lb:
+            return
+        st = a.admin().replicate_resync_status() or {}
+        status = (st or {}).get("status", "")
+        if status in ("completed", "failed", ""):
+            a.admin().start_replicate_resync(arn)
+        time.sleep(1.0)
+    raise AssertionError(
+        f"resync never converged:\nA={a.listing(BUCKET)}\n"
+        f"B={b.listing(BUCKET)}\nstatus={st}")
+
+
+@pytest.mark.slow
+def test_two_site_pair_kill_target_mid_resync(tmp_path):
+    """ROADMAP item 4 remainder: a two-PROCESS site pair over the
+    HTTP replication client under load, the TARGET site SIGKILLed
+    mid-resync; after restart the pair converges to identical
+    listings, replica-write counters stay flat across an extra
+    cycle (loop suppression), and both sites end fsck-clean."""
+    a, b = _pair(tmp_path)
+    try:
+        a.start()
+        b.start()
+        a.s3().make_bucket(BUCKET)
+        b.s3().make_bucket(BUCKET)
+        bodies = {f"k{i:02d}": bytes([48 + i]) * 1500 for i in range(12)}
+        for k, v in bodies.items():
+            a.put(BUCKET, k, v)
+        arn = _add_target(a, b)
+        a.admin().start_replicate_resync(arn)
+        time.sleep(0.4)                       # mid-resync
+        b.kill()                              # SIGKILL the target
+        # load keeps arriving on the surviving site
+        for i in range(12, 16):
+            bodies[f"k{i:02d}"] = bytes([48 + i]) * 1500
+            a.put(BUCKET, f"k{i:02d}", bodies[f"k{i:02d}"])
+        b.start()
+        _resync_to_convergence(a, b, arn)
+        for k, v in bodies.items():
+            assert b.get(BUCKET, k) == v, f"replica {k} diverged"
+        # loop suppression: an EXTRA full cycle pushes nothing — the
+        # replica-write counter across both sites stays flat
+        time.sleep(2.0)                       # let in-flight syncs settle
+        before = (_counter_total(a, "minio_tpu_repl_replica_writes")
+                  + _counter_total(b, "minio_tpu_repl_replica_writes"))
+        a.admin().start_replicate_resync(arn)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = a.admin().replicate_resync_status() or {}
+            if (st or {}).get("status") in ("completed", ""):
+                break
+            time.sleep(0.5)
+        after = (_counter_total(a, "minio_tpu_repl_replica_writes")
+                 + _counter_total(b, "minio_tpu_repl_replica_writes"))
+        assert after == before, \
+            f"extra cycle re-pushed replicas ({before} -> {after})"
+        for node in (a, b):
+            rep = node.fsck(repair=True)
+            assert rep["unrepaired"] == 0, (node.name, rep)
+        a.stop()
+        b.stop()
+    finally:
+        a.close()
+        b.close()
+
+
+def _two_site_source_crash(tmp_path, spec: str, extra_env=None) -> None:
+    """Shared driver: the SOURCE site armed with `spec` dies mid-sync,
+    restarts, and the pair still converges (checkpoint resume / resync
+    backstop), fsck-clean on both sides."""
+    a, b = _pair(tmp_path)
+    try:
+        a.start()
+        b.start()
+        a.s3().make_bucket(BUCKET)
+        b.s3().make_bucket(BUCKET)
+        bodies = {f"k{i:02d}": bytes([48 + i]) * 1500 for i in range(8)}
+        for k, v in bodies.items():
+            a.put(BUCKET, k, v)
+        arn = _add_target(a, b)
+        a.stop()
+
+        a.start(crashpoint=spec, extra_env=extra_env)
+        expect_request_death(
+            lambda: a.admin().start_replicate_resync(arn))
+        rc = a.wait_exit(90)
+        assert rc == CRASH_EXIT_CODE, (rc, a.tail_log())
+
+        a.start(extra_env=extra_env)
+        _resync_to_convergence(a, b, arn)
+        for k, v in bodies.items():
+            assert b.get(BUCKET, k) == v, f"replica {k} diverged"
+        for node in (a, b):
+            rep = node.fsck(repair=True)
+            assert rep["unrepaired"] == 0, (node.name, rep)
+        a.stop()
+        b.stop()
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.slow
+def test_two_site_resync_crash(tmp_path):
+    _two_site_source_crash(
+        tmp_path, "resync.checkpoint",
+        extra_env={"MINIO_TPU_REPL_RESYNC_CHECKPOINT_EVERY": "1"})
+
+
+@pytest.mark.slow
+def test_two_site_push_crash(tmp_path):
+    _two_site_source_crash(tmp_path, "replicate.push.before_apply")
+
+
+# ---------------------------------------------------------------------------
+# fast (tier-1) tests: registry coverage + crashpoint semantics
+# ---------------------------------------------------------------------------
+
+def test_matrix_covers_registry():
+    """Every registered crashpoint has a crash test: either a matrix
+    entry here or a named owner in COVERED_ELSEWHERE. A new hit site
+    without coverage fails THIS fast test, not just the slow tier."""
+    matrix = {spec.split(":")[0] for spec in CASES}
+    covered = matrix | set(COVERED_ELSEWHERE)
+    registered = set(crashpoint.names())
+    assert registered - covered == set(), \
+        f"crashpoints without a crash test: {registered - covered}"
+    assert covered - registered == set(), \
+        f"tests name unregistered crashpoints: {covered - registered}"
+    assert len(registered) >= 12
+
+
+def test_smoke_subset_is_valid():
+    assert set(SMOKE_POINTS) <= set(CASES)
+    assert len(SMOKE_POINTS) == 3
+
+
+def test_crashpoint_arm_nth_and_disarm():
+    crashpoint.disarm()
+    crashpoint.arm("put.meta.before_rename", nth=3)
+    try:
+        crashpoint.hit("put.meta.before_rename")
+        crashpoint.hit("put.rename.partial")        # other name: no-op
+        crashpoint.hit("put.meta.before_rename")
+        assert crashpoint.hits("put.meta.before_rename") == 2
+        with pytest.raises(crashpoint.CrashpointAbort):
+            crashpoint.hit("put.meta.before_rename")
+        # past the Nth hit the point never re-fires (one crash per arm)
+        crashpoint.hit("put.meta.before_rename")
+    finally:
+        crashpoint.disarm()
+    crashpoint.hit("put.meta.before_rename")        # disarmed: no-op
+
+
+def test_crashpoint_env_parse(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_CRASHPOINT",
+                       "put.rename.partial:4")
+    crashpoint.refresh()
+    try:
+        assert crashpoint.armed_name() == "put.rename.partial"
+        for _ in range(3):
+            crashpoint.hit("put.rename.partial")
+        assert crashpoint.hits("put.rename.partial") == 3
+    finally:
+        monkeypatch.delenv("MINIO_TPU_CRASHPOINT")
+        crashpoint.refresh()
+    assert crashpoint.armed_name() is None
+
+
+def test_crashpoint_unregistered_env_never_fires(monkeypatch, capsys):
+    monkeypatch.setenv("MINIO_TPU_CRASHPOINT", "no.such.point")
+    crashpoint.refresh()
+    try:
+        crashpoint.hit("put.meta.before_rename")    # must not fire
+        assert "no.such.point" in capsys.readouterr().err
+    finally:
+        monkeypatch.delenv("MINIO_TPU_CRASHPOINT")
+        crashpoint.refresh()
+
+
+def test_crashpoint_arm_rejects_unregistered():
+    with pytest.raises(KeyError):
+        crashpoint.arm("not.a.point")
+
+
+def test_registry_table_renders():
+    table = crashpoint.render_table()
+    for name in crashpoint.names():
+        assert f"`{name}`" in table

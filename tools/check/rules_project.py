@@ -306,3 +306,128 @@ def check_error_map(sources: List[Source]) -> List[Violation]:
                             "ERROR_TABLE (clients would get a bare "
                             "500 with no usable code)"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: crashpoint
+# ---------------------------------------------------------------------------
+
+# Modules whose functions perform multi-file commits (the designated
+# commit modules): any function here that writes AND renames — or
+# persists more than one document — is a crash window and must declare
+# a registered crashpoint (utils/crashpoint.py) inside it, or argue
+# its exemption with an inline `# check: allow(crashpoint) reason`.
+CRASHPOINT_MODULES = (
+    "minio_tpu/object/engine.py",
+    "minio_tpu/object/multipart.py",
+    "minio_tpu/object/metacache.py",
+    "minio_tpu/object/topology.py",
+    "minio_tpu/object/rebalance.py",
+    "minio_tpu/object/background.py",
+    "minio_tpu/storage/xl_storage.py",
+    "minio_tpu/tier/config.py",
+    "minio_tpu/replicate/targets.py",
+    "minio_tpu/replicate/resync.py",
+    "minio_tpu/replicate/plane.py",
+)
+
+# terminal call names that MOVE a file into its committed place…
+_RENAMEISH = {"rename_data", "rename_file", "replace"}
+# …and that persist a document/shard
+_WRITEISH = {"write_all", "write_unique_file_info", "put_object",
+             "write_metadata", "create_file"}
+
+
+def _terminal(node: ast.Call) -> str:
+    name = dotted(node.func)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _commit_shape(fn: ast.AST) -> Optional[str]:
+    """Classify a function as a multi-file commit window. Returns a
+    human-readable reason, or None."""
+    renames: Set[str] = set()
+    writes = 0
+    write_in_loop = False
+    loops = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While)):
+            loops.append(node)
+    loop_nodes: Set[ast.AST] = set()
+    for lp in loops:
+        for sub in ast.walk(lp):
+            loop_nodes.add(sub)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        t = _terminal(node)
+        if t in _RENAMEISH:
+            renames.add(t)
+        elif t in _WRITEISH:
+            writes += 1
+            if node in loop_nodes:
+                write_in_loop = True
+    if renames and writes:
+        return (f"write ({writes} call(s)) + rename "
+                f"({'/'.join(sorted(renames))})")
+    if writes >= 2:
+        return f"{writes} persistence calls"
+    if write_in_loop:
+        return "persistence call inside a loop"
+    return None
+
+
+def check_crashpoint(sources: List[Source],
+                     registered: Set[str]) -> List[Violation]:
+    """(1) every `crashpoint.hit(<name>)` anywhere names a registered
+    point with a constant string; (2) in the designated commit
+    modules, every function with a multi-file-commit shape contains a
+    hit (or an allow comment)."""
+    out: List[Violation] = []
+    for src in sources:
+        # (1) hit-site hygiene, tree-wide
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func).rsplit(".", 1)[-1] != "hit":
+                continue
+            if not dotted(node.func).endswith("crashpoint.hit"):
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:
+                out.append(Violation(
+                    "crashpoint", src.rel, node.lineno,
+                    "crashpoint.hit() needs a constant name — the "
+                    "registry/table/harness all key on literals"))
+            elif name not in registered:
+                out.append(Violation(
+                    "crashpoint", src.rel, node.lineno,
+                    f"crashpoint.hit({name!r}) names an unregistered "
+                    "point — declare it in "
+                    "minio_tpu/utils/crashpoint.py"))
+        if src.rel not in CRASHPOINT_MODULES:
+            continue
+        # (2) commit windows must declare a point
+        from .core import enclosing_functions
+        enclosing = enclosing_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if enclosing.get(node) is not None:
+                continue        # nested defs audit with their parent
+            shape = _commit_shape(node)
+            if shape is None:
+                continue
+            has_hit = any(
+                isinstance(c, ast.Call)
+                and dotted(c.func).endswith("crashpoint.hit")
+                for c in ast.walk(node))
+            if not has_hit:
+                out.append(Violation(
+                    "crashpoint", src.rel, node.lineno,
+                    f"{node.name}() is a multi-file commit ({shape}) "
+                    "with no crashpoint.hit() — thread a registered "
+                    "point through the window or argue the exemption "
+                    "inline"))
+    return out

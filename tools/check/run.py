@@ -23,6 +23,9 @@ Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
                     INTERNAL_ONLY); every referenced code in ERROR_TABLE
   admission         SlowDown sheds + requests_shed_total live ONLY in
                     s3/edge/admission.py (the unified admission plane)
+  crashpoint        multi-file commits in the designated commit modules
+                    declare a registered crashpoint; hit() names are
+                    registered literals; README crashpoint table fresh
 """
 
 from __future__ import annotations
@@ -35,10 +38,11 @@ import sys
 if __package__ in (None, ""):                     # `python tools/check/run.py`
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from check import (core, knobtable, metricstable, rules_ast,
-                       rules_project)
+    from check import (core, crashtable, knobtable, metricstable,
+                       rules_ast, rules_project)
 else:
-    from . import core, knobtable, metricstable, rules_ast, rules_project
+    from . import (core, crashtable, knobtable, metricstable,
+                   rules_ast, rules_project)
 
 
 def _group_by_path(violations):
@@ -70,6 +74,10 @@ def run_checks(rules=None):
         vs += rules_project.check_error_map(sources)
     if "admission" in selected:
         vs += rules_ast.check_admission(sources)
+    if "crashpoint" in selected:
+        points = set(crashtable.load_crashpoints().CRASHPOINTS)
+        vs += rules_project.check_crashpoint(sources, points)
+        vs += crashtable.check_drift()
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
@@ -102,6 +110,9 @@ def main(argv=None) -> int:
                     help="regenerate the README metrics reference "
                     "table from the registry's registration sites and "
                     "exit")
+    ap.add_argument("--write-crashpoint-table", action="store_true",
+                    help="regenerate the README crashpoint table from "
+                    "the registry and exit")
     args = ap.parse_args(argv)
 
     if args.write_knob_table:
@@ -112,6 +123,11 @@ def main(argv=None) -> int:
     if args.write_metrics_table:
         changed = metricstable.write_table()
         print("README metrics table "
+              + ("updated" if changed else "already fresh"))
+        return 0
+    if args.write_crashpoint_table:
+        changed = crashtable.write_table()
+        print("README crashpoint table "
               + ("updated" if changed else "already fresh"))
         return 0
 
