@@ -1397,6 +1397,206 @@ def bench_cache_ab(objects: int = 16, size: int = 4 << 20,
     return out
 
 
+def bench_gray_ab(objects: int = 16, size: int = 1 << 20,
+                  gets: int = 60, streams: int = 4, drives: int = 6,
+                  parity: int = 2, block: int = 1 << 17,
+                  stall_s: float = 0.5) -> dict:
+    """Gray-failure A/B: PUT/GET tail latency with ONE drive stalling
+    `stall_s` per I/O, the gray-failure plane off vs on.
+
+    OFF = MINIO_TPU_HEDGE/QUORUM_ACK/QUARANTINE all off: every PUT
+    waits out the stalled drive's shard writes and any GET whose read
+    plan includes it waits out the stalled shard read. ON = defaults
+    (tightened floors so the adaptive deadlines bite at bench scale):
+    hedged reads race the staller, PUTs ack at write quorum, and a
+    DiskMonitor health scan walks the drive through suspect →
+    probation → heal-verified re-admission once the stall clears.
+
+    The bench asserts its own acceptance bar: zero acked-write loss
+    after the MRF drain (every object byte-identical with the staller
+    disarmed) and the full quarantine round trip."""
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.background import DiskMonitor
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.storage import XLStorage
+    from minio_tpu.storage.naughty import NaughtyDisk
+    from minio_tpu.utils import healthtrack
+
+    READ_STALLS = ("read_file_stream", "read_file", "read_all")
+    WRITE_STALLS = ("append_file", "create_file", "write_all",
+                    "write_metadata", "rename_data")
+    KNOBS_OFF = {"MINIO_TPU_HEDGE": "off", "MINIO_TPU_QUORUM_ACK": "off",
+                 "MINIO_TPU_QUARANTINE": "off"}
+    KNOBS_ON = {"MINIO_TPU_HEDGE": "on", "MINIO_TPU_QUORUM_ACK": "on",
+                "MINIO_TPU_QUARANTINE": "on",
+                # tightened floors/ceilings: the adaptive deadline must
+                # bite below the injected stall even from a cold start
+                "MINIO_TPU_HEDGE_FLOOR_S": "0.05",
+                "MINIO_TPU_HEDGE_CEIL_S": str(stall_s / 4),
+                "MINIO_TPU_WRITE_STALL_FLOOR_S": "0.1",
+                "MINIO_TPU_WRITE_STALL_CEIL_S": str(stall_s / 2),
+                "MINIO_TPU_QUAR_LATENCY_S": str(stall_s / 2.5),
+                "MINIO_TPU_QUAR_MIN_SAMPLES": "4",
+                "MINIO_TPU_QUAR_PROBATION_S": "0",
+                "MINIO_TPU_QUAR_PROBES": "2"}
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    out: dict = {"config": {"objects": objects, "size": size,
+                            "gets": gets, "streams": streams,
+                            "drives": drives, "m": parity,
+                            "stall_s": stall_s}}
+    saved = {k: os.environ.get(k)
+             for k in set(KNOBS_OFF) | set(KNOBS_ON)}
+    roots: list = []
+
+    def pctls(xs: list) -> dict:
+        s = sorted(xs)
+        return {"p50_ms": round(s[len(s) // 2] * 1e3, 2),
+                "p99_ms": round(s[max(0, int(len(s) * .99) - 1)] * 1e3,
+                                2)}
+
+    def run_pass(env: dict) -> tuple[dict, "ErasureSets", NaughtyDisk,
+                                     list]:
+        for k, v in env.items():
+            os.environ[k] = v
+        healthtrack.TRACKER.reset()
+        root = tempfile.mkdtemp(prefix="bench_gray_", dir=base)
+        roots.append(root)
+        raw = [XLStorage(f"{root}/d{j}") for j in range(drives)]
+        nd = NaughtyDisk(raw[0], enabled=False)
+        drv = [nd] + raw[1:]
+        sets = ErasureSets.from_storage(
+            drv, set_count=1, set_drive_count=drives, parity=parity,
+            block_size=block,
+            mrf_options=dict(max_retries=10, backoff_base=0.02,
+                             backoff_max=0.25))
+        sets.make_bucket("bench")
+        payloads = [os.urandom(size) for _ in range(objects)]
+        nd.stall_verbs = {v: stall_s
+                          for v in READ_STALLS + WRITE_STALLS}
+        nd.arm()
+
+        put_lat: list[float] = []
+        for i, body in enumerate(payloads):
+            t0 = time.perf_counter()
+            sets.put_object("bench", f"o-{i:04d}", body)
+            put_lat.append(time.perf_counter() - t0)
+
+        # the laggard-abandoned shards converge through MRF while the
+        # drive is STILL slow (quarantined drives keep taking writes);
+        # settle that background heal churn so the GET phase measures
+        # steady state instead of heal-lock contention
+        sets.drain_mrf(120.0)
+
+        get_lat: list[float] = []
+        worker_errs: list = []
+        mu = threading.Lock()
+        picks = [i % objects for i in range(gets)]
+        chunks = [picks[i::streams] for i in range(streams)]
+        barrier = threading.Barrier(sum(1 for c in chunks if c))
+
+        def one(mine: list) -> None:
+            barrier.wait()
+            for idx in mine:
+                t0 = time.perf_counter()
+                _info, s = sets.get_object("bench", f"o-{idx:04d}")
+                body = b"".join(s)
+                dt = time.perf_counter() - t0
+                if body != payloads[idx]:
+                    raise AssertionError(f"o-{idx:04d} bytes differ")
+                with mu:
+                    get_lat.append(dt)
+
+        def guarded(mine: list) -> None:
+            # a worker's failure must FAIL the bench, not silently
+            # shrink the sample set while the acceptance claims stand
+            try:
+                one(mine)
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                with mu:
+                    worker_errs.append(e)
+
+        ts = [threading.Thread(target=guarded, args=(c,))
+              for c in chunks if c]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if worker_errs:
+            raise worker_errs[0]
+        res = {"put": pctls(put_lat), "get": pctls(get_lat),
+               "stalls_injected": nd.stats.stalls}
+        return res, sets, nd, payloads
+
+    try:
+        out["off"], sets_off, nd_off, _ = run_pass(KNOBS_OFF)
+        sets_off.close()
+
+        out["on"], sets, nd, payloads = run_pass(KNOBS_ON)
+
+        # quarantine round trip on the ON cluster: the scan convicts
+        # the staller, probation probes fail while it still stalls,
+        # pass once it recovers, and re-admission is heal-verified
+        mon = DiskMonitor(sets, interval=3600)
+        key = healthtrack.disk_key(nd)
+        nd.stall_verbs["disk_info"] = stall_s
+        mon.scan_once()
+        states = [healthtrack.TRACKER.state_of("drive", key)]
+        mon.scan_once()                 # probation probe: still slow
+        states.append(healthtrack.TRACKER.state_of("drive", key))
+        nd.stall_verbs = {}
+        nd.disarm()                     # the gray spell ends
+        for _ in range(4):
+            mon.scan_once()
+            states.append(healthtrack.TRACKER.state_of("drive", key))
+            if states[-1] == healthtrack.STATE_OK:
+                break
+        out["quarantine"] = {"states": states,
+                             "events": list(mon.quarantine_events)}
+        assert states[0] == healthtrack.STATE_SUSPECT, states
+        assert states[-1] == healthtrack.STATE_OK, states
+
+        # zero acked-write loss: MRF converges every laggard-abandoned
+        # shard, then every acked object reads back byte-identical
+        assert sets.drain_mrf(60.0), "MRF did not drain"
+        lost = 0
+        for i, body in enumerate(payloads):
+            _info, s = sets.get_object("bench", f"o-{i:04d}")
+            if b"".join(s) != body:
+                lost += 1
+        out["mrf"] = sets.mrf_stats()
+        out["lost_after_mrf"] = lost
+        assert lost == 0, f"{lost} acked writes lost"
+        sets.close()
+
+        out["get_p99_speedup_x"] = round(
+            out["off"]["get"]["p99_ms"]
+            / max(out["on"]["get"]["p99_ms"], 1e-9), 2)
+        out["put_p99_speedup_x"] = round(
+            out["off"]["put"]["p99_ms"]
+            / max(out["on"]["put"]["p99_ms"], 1e-9), 2)
+        # PUT acks at quorum: the stalled drive no longer binds p99
+        out["put_p99_below_stall"] = \
+            out["on"]["put"]["p99_ms"] < stall_s * 1e3
+    finally:
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for root in roots:
+            shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_edge_ab(streams=(4, 16), size: int = 1 << 20,
                   rounds: int = 4, idle_conns: int = 400,
                   idle_ratio: int = 20, drives: int = 6,
@@ -1992,6 +2192,15 @@ def main() -> int:
     ap.add_argument("--ab-edge-smoke", action="store_true",
                     help="tiny edge A/B (2 streams, 256 KiB objects, "
                          "60 idle conns) for CI — seconds, not minutes")
+    ap.add_argument("--ab-gray", action="store_true",
+                    help="gray-failure A/B: GET/PUT p50/p99 with one "
+                    "drive stalling per I/O, hedging+quorum-ack+"
+                    "quarantine on vs off")
+    ap.add_argument("--ab-gray-stall", type=float, default=0.5,
+                    help="--ab-gray injected per-I/O stall, seconds "
+                    "(default 0.5)")
+    ap.add_argument("--ab-gray-smoke", action="store_true",
+                    help="tiny CI variant of --ab-gray")
     ap.add_argument("--ab-obs", action="store_true",
                     help="run ONLY the observability-plane A/B: "
                          "federated-scrape merge latency vs node "
@@ -2003,6 +2212,24 @@ def main() -> int:
                          "objects, 2 node counts) for CI — seconds, "
                          "not minutes")
     args = ap.parse_args()
+
+    if args.ab_gray or args.ab_gray_smoke:
+        if args.ab_gray_smoke:
+            ab = bench_gray_ab(objects=5, size=1 << 18, gets=20,
+                               streams=4, drives=6, block=1 << 16,
+                               stall_s=0.3)
+        else:
+            ab = bench_gray_ab(stall_s=args.ab_gray_stall)
+        print(json.dumps({
+            "metric": "GET p99 speedup with one drive stalling "
+                      f"{ab['config']['stall_s']}s/I-O, gray-failure "
+                      "plane on vs off (PUT acks at quorum, zero "
+                      "acked-write loss after MRF drain)",
+            "value": ab.get("get_p99_speedup_x"),
+            "unit": "x",
+            "gray_ab": ab,
+        }))
+        return 0
 
     if args.ab_obs or args.ab_obs_smoke:
         if args.ab_obs_smoke:

@@ -14,8 +14,13 @@ Robustness semantics (the failure-plane PR):
     flip `online` — a remote that answered with an error payload
     (RPCError) or sent a malformed response is alive;
   * the offline health probe backs off exponentially (capped at
-    `MINIO_TPU_PROBE_BACKOFF_MAX`) instead of hammering a dead peer
-    once a second forever.
+    `MINIO_TPU_PEER_PROBE_S`) instead of hammering a dead peer once a
+    second forever — and any SUCCESSFUL direct call to the host (from
+    any client in this process) re-admits it immediately, so a peer
+    provably back never stays dark for the rest of a backoff window;
+  * every successful verb feeds the per-peer latency tracker
+    (`minio_tpu_peer_latency_seconds{peer,verb}`) so gray-slow peers
+    are visible on OBD/admin next to the drive health states.
 """
 
 from __future__ import annotations
@@ -33,7 +38,7 @@ import time
 import urllib.parse
 from typing import Callable, Optional
 
-from ..utils import backoff_delay, knobs, telemetry
+from ..utils import backoff_delay, healthtrack, knobs, telemetry
 
 DEFAULT_TIMEOUT = 30.0
 
@@ -50,7 +55,7 @@ _RPC_OFFLINE_TRIPS = telemetry.REGISTRY.counter(
     "minio_tpu_rpc_offline_trips_total",
     "Peer online->offline transitions")
 HEALTH_PROBE_INTERVAL = 1.0
-HEALTH_PROBE_MAX = knobs.get_float("MINIO_TPU_PROBE_BACKOFF_MAX")
+HEALTH_PROBE_MAX = knobs.get_float("MINIO_TPU_PEER_PROBE_S")
 # retries for idempotent verbs (attempts = retries + 1), inside the
 # per-call deadline
 RPC_RETRIES = knobs.get_int("MINIO_TPU_RPC_RETRIES")
@@ -133,6 +138,34 @@ def verify_token(token: str, access_key: str, secret_key: str) -> bool:
     return hmac.compare_digest(want, mac)
 
 
+# every live RestClient per (host, port): a successful call through
+# ANY of them proves the host back, so siblings still sitting out a
+# probe backoff re-admit immediately (the MRFHealer.kick-on-
+# re-admission pattern applied to peers). WeakSets: clients must not
+# outlive their owners just because the registry saw them once.
+_CLIENTS_MU = threading.Lock()
+_CLIENTS: dict = {}
+
+
+def _register_client(c: "RestClient") -> None:
+    import weakref
+    with _CLIENTS_MU:
+        _CLIENTS.setdefault((c.host, c.port),
+                            weakref.WeakSet()).add(c)
+
+
+def _note_host_alive(host: str, port: int,
+                     exclude: Optional["RestClient"] = None) -> None:
+    """A verb against (host, port) just SUCCEEDED: flip every sibling
+    client of that host back online — a host proven alive must not
+    stay dark for the rest of a 30 s probe backoff."""
+    with _CLIENTS_MU:
+        peers = list(_CLIENTS.get((host, port), ()))
+    for c in peers:
+        if c is not exclude and not c._online:
+            c._online = True        # the probe loop exits on this flag
+
+
 class RestClient:
     """One peer endpoint. call() POSTs a verb; on connection failure the
     host is marked offline and a background probe re-enables it."""
@@ -147,6 +180,8 @@ class RestClient:
         self._online = True
         self._mu = threading.Lock()
         self._prober: Optional[threading.Thread] = None
+        self._probe_delay = HEALTH_PROBE_INTERVAL
+        _register_client(self)
         # fault counters (surfaced per drive in the OBD bundle):
         # calls = verbs attempted, net_errors = transport failures
         # observed (per attempt), retries = extra attempts made,
@@ -206,10 +241,21 @@ class RestClient:
                         self.retries += 1
                     _RPC_RETRIES.inc()
                 try:
-                    return self._call_once(verb, args, body,
-                                           stream_response, body_length,
-                                           timeout=min(self.timeout,
-                                                       remaining))
+                    t0 = time.perf_counter()
+                    out = self._call_once(verb, args, body,
+                                          stream_response, body_length,
+                                          timeout=min(self.timeout,
+                                                      remaining))
+                    # feed the gray-failure plane: per-peer latency
+                    # (streamed verbs time the OPEN; the drive-level
+                    # read tracker times the body) — and a successful
+                    # non-probe verb proves the host alive for every
+                    # sibling client still sitting out its backoff
+                    healthtrack.observe_peer(
+                        f"{self.host}:{self.port}", verb,
+                        time.perf_counter() - t0)
+                    _note_host_alive(self.host, self.port, exclude=self)
+                    return out
                 except NetworkError as e:
                     with self._mu:
                         self.net_errors += 1
@@ -298,6 +344,19 @@ class RestClient:
             self._online = False
             self.offline_trips += 1
             _RPC_OFFLINE_TRIPS.inc()
+            # a fresh offline spell probes FAST again even when the
+            # prober thread is reused below (its backoff may have
+            # grown to the cap during an earlier spell)
+            self._probe_delay = HEALTH_PROBE_INTERVAL
+            if self._prober is not None and self._prober.is_alive():
+                # a prober from an earlier offline spell is still in
+                # its backoff sleep (a sibling's success flipped the
+                # flag without joining it): it re-reads _online under
+                # _mu at its loop top and keeps going — spawning
+                # another would stack probers per flap. The prober
+                # clears self._prober under _mu before exiting, so it
+                # cannot be observed alive here AND miss this spell.
+                return
             self._prober = threading.Thread(target=self._probe_loop,
                                             daemon=True)
             self._prober.start()
@@ -306,10 +365,19 @@ class RestClient:
         # exponential backoff (capped): a host that stays dead gets
         # probed ever less often instead of a fixed 1 s hammer; the
         # first probe still fires fast so a blip recovers quickly
-        delay = HEALTH_PROBE_INTERVAL
-        while not self._online:
+        # (mark_offline resets _probe_delay per spell — this thread
+        # may serve several spells back to back). The ONLY exit is the
+        # top-of-loop check under _mu, which also hands the prober
+        # slot back — so mark_offline can never observe a live prober
+        # that has already decided to die (the stuck-offline race).
+        while True:
+            with self._mu:
+                if self._online:
+                    self._prober = None
+                    return
+                delay = self._probe_delay
+                self._probe_delay = min(delay * 2, HEALTH_PROBE_MAX)
             time.sleep(delay * (0.75 + random.random() / 2))
-            delay = min(delay * 2, HEALTH_PROBE_MAX)
             try:
                 conn = http.client.HTTPConnection(self.host, self.port,
                                                   timeout=2.0)
@@ -319,7 +387,9 @@ class RestClient:
                 conn.close()
                 if resp.status in (200, 404):
                     self._online = True
-                    return
+                    # one prober's good news re-admits every sibling;
+                    # the loop top hands the prober slot back
+                    _note_host_alive(self.host, self.port, exclude=self)
             except (OSError, http.client.HTTPException):
                 continue
 

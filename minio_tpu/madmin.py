@@ -437,6 +437,11 @@ class AdminClient:
         """Per-node OBD bundles (drive latency probes, cpu/mem)."""
         return self._json("GET", "obdinfo")["nodes"]
 
+    def drive_health(self) -> dict:
+        """Gray-failure plane snapshot: per-drive/per-peer tracked
+        latency + quarantine states + recent transition events."""
+        return self._json("GET", "drivehealth")
+
     def bandwidth(self) -> dict:
         """Cluster-merged per-bucket byte rates/totals."""
         return self._json("GET", "bandwidth")["buckets"]

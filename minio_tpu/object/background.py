@@ -42,9 +42,10 @@ DATA_USAGE_OBJECT = "datausage/usage.json"
 
 # MRF knobs (documented in README "Fault model & self-healing"). The
 # retry window must OUTLAST the drive-recovery cadence (DiskMonitor
-# re-probes every 10 s, the transport health probe backs off to 30 s) —
-# with these defaults the schedule spans ~40 s before giving up, so a
-# drive blip heals through MRF instead of always falling to the scanner.
+# re-probes every MINIO_TPU_DISK_PROBE_S=10 s, the transport health
+# probe backs off to MINIO_TPU_PEER_PROBE_S=30 s) — with these
+# defaults the schedule spans ~40 s before giving up, so a drive blip
+# heals through MRF instead of always falling to the scanner.
 MRF_QUEUE_SIZE = knobs.get_int("MINIO_TPU_MRF_QUEUE_SIZE")
 MRF_MAX_RETRIES = knobs.get_int("MINIO_TPU_MRF_MAX_RETRIES")
 MRF_BACKOFF_BASE = knobs.get_float("MINIO_TPU_MRF_BACKOFF_BASE")
@@ -303,17 +304,36 @@ class _ScanLoop:
 
 
 class DiskMonitor(_ScanLoop):
-    """Re-admit returning drives; format + sweep-heal fresh ones.
+    """Re-admit returning drives; format + sweep-heal fresh ones; walk
+    slow (gray-failing) drives through quarantine.
 
     Covers every POOL of the cluster, including pools appended after
     boot: ``add_pool`` registers a new pool's drives with the running
     monitor (topology online-expansion follow-up), so a drive that dies
-    in a post-boot pool heals exactly like a boot-time one."""
+    in a post-boot pool heals exactly like a boot-time one.
 
-    def __init__(self, sets: "ErasureSets", interval: float = 10.0):
+    Health states (the gray-failure plane): beyond online/offline, a
+    drive whose tracked read/write latency stays past the quarantine
+    threshold turns **suspect** — excluded from read plans and hedge
+    targets (capacity-permitting) while still written-and-MRF'd. After
+    ``MINIO_TPU_QUAR_PROBATION_S`` it enters **probation**: each scan
+    runs a timed direct probe, and ``MINIO_TPU_QUAR_PROBES``
+    consecutive healthy probes earn a heal-verified re-admission
+    (sweep-heal the set, flip back to ok, kick MRF). A slow probe
+    re-convicts straight back to suspect."""
+
+    def __init__(self, sets: "ErasureSets",
+                 interval: Optional[float] = None):
         self.pools: list["ErasureSets"] = [sets]
-        self.interval = interval
+        self.interval = knobs.get_float("MINIO_TPU_DISK_PROBE_S") \
+            if interval is None else interval
         self.healed_slots: list[tuple[int, int]] = []   # for tests/admin
+        # quarantine bookkeeping for admin/tests, bounded: a drive
+        # flapping every scan for the life of the process must not
+        # grow this without limit
+        from collections import deque
+        self.quarantine_events: "deque[tuple[str, str]]" = deque(
+            maxlen=1000)
         self._init_loop()
 
     @property
@@ -334,6 +354,7 @@ class DiskMonitor(_ScanLoop):
         admitted = 0
         for pool in list(self.pools):
             admitted += self._scan_pool(pool)
+            self._scan_pool_health(pool)
         return admitted
 
     def _scan_pool(self, pool: "ErasureSets") -> int:
@@ -415,6 +436,83 @@ class DiskMonitor(_ScanLoop):
         except Exception:  # noqa: BLE001 — MRF/next sweep will retry
             pass
         return True
+
+    # -- slow-drive quarantine (the gray-failure plane) --------------------
+
+    def _scan_pool_health(self, pool: "ErasureSets") -> None:
+        """One health-evaluation pass: convict slow drives, advance
+        suspects to probation, probe probationers, re-admit after
+        enough healthy probes + a heal-verify sweep."""
+        from ..utils import healthtrack
+        if not healthtrack.quarantine_enabled():
+            return
+        tr = healthtrack.TRACKER
+        for si, eng in enumerate(pool.sets):
+            for d in eng.disks:
+                if d is None:
+                    continue
+                key = healthtrack.disk_key(d)
+                state = tr.state_of("drive", key)
+                if state == healthtrack.STATE_OK:
+                    if tr.should_quarantine("drive", key):
+                        tr.set_state("drive", key,
+                                     healthtrack.STATE_SUSPECT,
+                                     event="suspect")
+                        self.quarantine_events.append((key, "suspect"))
+                    continue
+                if state == healthtrack.STATE_SUSPECT and \
+                        tr.state_age("drive", key) >= knobs.get_float(
+                            "MINIO_TPU_QUAR_PROBATION_S"):
+                    tr.set_state("drive", key,
+                                 healthtrack.STATE_PROBATION,
+                                 event="probation")
+                    self.quarantine_events.append((key, "probation"))
+                    state = healthtrack.STATE_PROBATION
+                if state != healthtrack.STATE_PROBATION:
+                    continue
+                dur, ok = self._probe_drive(d)
+                tr.observe("drive", key, "probe", dur)
+                passed = ok and dur <= tr.quarantine_threshold(
+                    "drive", key)
+                probes_ok = tr.note_probe("drive", key, passed)
+                if not passed:
+                    # still slow: re-convicted straight back to
+                    # suspect (note_probe reset state + dwell)
+                    self.quarantine_events.append((key, "reconvict"))
+                    continue
+                if probes_ok >= \
+                        knobs.get_int("MINIO_TPU_QUAR_PROBES"):
+                    # heal-verified re-admission: the drive took every
+                    # write while quarantined only as MRF hints — sweep
+                    # the set so its copies are provably whole BEFORE
+                    # read plans trust it again
+                    try:
+                        self.heal_set_sweep(si, pool)
+                    except Exception:  # noqa: BLE001 — MRF backstop
+                        pass
+                    # drop the pre-recovery latency evidence: the
+                    # drive took no reads while convicted, so the old
+                    # slow samples would re-convict it on the very
+                    # next scan (perpetual flap + full sweep each
+                    # cycle); re-admission starts a fresh record
+                    tr.clear_samples("drive", key)
+                    tr.set_state("drive", key, healthtrack.STATE_OK,
+                                 event="readmit")
+                    self.quarantine_events.append((key, "readmit"))
+                    if pool.mrf is not None:
+                        pool.mrf.kick()
+
+    @staticmethod
+    def _probe_drive(d) -> tuple[float, bool]:
+        """One timed direct probe against the drive (goes through the
+        full wrapper chain, so injected stalls are felt)."""
+        t0 = time.perf_counter()
+        try:
+            d.disk_info()
+            ok = True
+        except serr.StorageError:
+            ok = False
+        return time.perf_counter() - t0, ok
 
     def heal_set_sweep(self, set_index: int,
                        pool: Optional["ErasureSets"] = None) -> int:

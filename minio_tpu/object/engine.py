@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import bitrot as bitrot_mod
 from ..storage import errors as serr
-from ..utils import crashpoint, knobs, stagetimer, telemetry
+from ..utils import crashpoint, healthtrack, knobs, stagetimer, telemetry
 from ..storage.api import StorageAPI
 from ..storage.datatypes import (BLOCK_SIZE_V1, RESTORE_EXPIRY_KEY,
                                  RESTORE_KEY, TRANSITION_COMPLETE,
@@ -427,6 +427,7 @@ class ErasureObjects:
                     telemetry.span("pipeline.encode",
                                    blocks=item["data"].shape[0]):
                 fut, data = item["fut"], item["data"]
+                # check: allow(deadline) device dispatch; scheduler close() flushes waiters
                 fused = fut.result() if fut is not None else \
                     codec.encode_and_hash_batch(data, self.bitrot_algo)
                 item["rows"] = self._unpack_fused(codec, data, fused)
@@ -657,14 +658,22 @@ class ErasureObjects:
         def write(i: int, w) -> None:
             rows, digs, j = (data, dd, i) if i < k else \
                 (parity, dp, i - k)
+            t0 = time.perf_counter()
             with telemetry.span("disk.shard_write", disk=i, blocks=B):
                 for bi in range(B):
                     w.write_with_digest(rows[bi, j].data,
                                         digs[bi, j].data)
+            healthtrack.observe_disk(w.disk, "write",
+                                     time.perf_counter() - t0)
 
-        _, errs = meta.for_each_disk(
+        # quorum-ack lane: once write-quorum writers are durable, a
+        # laggard past the stall grace is dropped from the fan-out
+        # (and from every later batch via writers[i] = None below) —
+        # its missing shard heals through MRF instead of setting p99
+        _, errs = meta.for_each_disk_quorum(
             list(writers),  # type: ignore[arg-type]
-            write)
+            write, write_quorum, stall_s=healthtrack.write_stall_s(),
+            stage="shard_write")
         for i, e in enumerate(errs):
             if e is not None:
                 writers[i] = None
@@ -684,8 +693,16 @@ class ErasureObjects:
                 raise serr.DiskNotFound(f"writer {i}")
             w.close()  # flushes remaining frames (empty file for 0-byte)
 
+        # the whole commit window rides the quorum-ack lane: a drive
+        # stalling at close/meta/rename must not hold the client ack
+        # once quorum is durable — it is counted into `lost` below and
+        # the object converges back through MRF
+        stall = healthtrack.write_stall_s()
         with stagetimer.stage("put.commit.close_writers"):
-            _, errs = meta.for_each_disk(shuffled, close_writer)
+            _, errs = meta.for_each_disk_quorum(shuffled, close_writer,
+                                                write_quorum,
+                                                stall_s=stall,
+                                                stage="close")
         for i, e in enumerate(errs):
             if e is not None:
                 writers[i] = None
@@ -706,7 +723,8 @@ class ErasureObjects:
         with stagetimer.stage("put.commit.write_meta"):
             meta.write_unique_file_info(disks_for_meta,
                                         MINIO_META_TMP_BUCKET,
-                                        tmp_id, metas, write_quorum)
+                                        tmp_id, metas, write_quorum,
+                                        stall_s=stall)
         # fully staged, uncommitted: the rename fan-out is the point
         # of no return
         crashpoint.hit("put.meta.before_rename")
@@ -718,8 +736,19 @@ class ErasureObjects:
             d.rename_data(MINIO_META_TMP_BUCKET, tmp_id, fi.data_dir,
                           bucket, object_name)
 
+        def renamed_late(_i: int) -> None:
+            # an abandoned rename that eventually LANDS may have laid
+            # an OLDER version over a commit that happened after this
+            # PUT acked — re-queue the MRF check now that it settled,
+            # so the drive is healed against current quorum state
+            self._notify_degraded(bucket, object_name, fi.version_id)
+
         with stagetimer.stage("put.commit.rename"):
-            _, errs = meta.for_each_disk(disks_for_meta, rename)
+            _, errs = meta.for_each_disk_quorum(disks_for_meta, rename,
+                                                write_quorum,
+                                                stall_s=stall,
+                                                stage="rename",
+                                                on_settle=renamed_late)
         err = meta.reduce_write_quorum_errs(
             errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
         if err is not None:
@@ -1113,7 +1142,9 @@ class ErasureObjects:
                                       io_lock: Optional[threading.Lock]
                                       = None,
                                       reader_gen: Optional[tuple]
-                                      = None) -> bool:
+                                      = None,
+                                      benign_missing: frozenset
+                                      = frozenset()) -> bool:
         """Verify deferred frame digests AND reconstruct the degraded
         blocks of a read group. Degraded blocks sharing one
         (present-mask, shard-length) pattern go through a single fused
@@ -1156,7 +1187,13 @@ class ErasureObjects:
         # instead of opening only after the previous bucket resolved
         staged: list[tuple] = []
         for (mask, shard_len), idxs in buckets.items():
-            heal = True
+            # a reconstruct forced by the READ PLAN (quarantine skip /
+            # latency-hedge loser) is not damage: the shards are on
+            # disk, nothing needs healing — only a miss the plan can't
+            # account for flags the degraded-read heal
+            if not {i for i in range(k)
+                    if not (mask >> i) & 1} <= benign_missing:
+                heal = True
             _dm, used, _missing = rs_matrix.missing_data_matrix(
                 k, codec.m, mask)
             stacked = np.stack([
@@ -1176,6 +1213,7 @@ class ErasureObjects:
                 in staged:
             if fut is not None:
                 try:
+                    # check: allow(deadline) device dispatch; scheduler close() flushes waiters
                     fused = fut.result()
                 except Exception:  # noqa: BLE001 — a shared-dispatch
                     # failure must not kill a GET the host can still
@@ -1254,61 +1292,141 @@ class ErasureObjects:
     def _read_group_shards_raw(self, readers, blocks: list,
                                shard_size: int, shard_lens: list,
                                k: int, n: int,
-                               collect_digests: bool = False) -> list:
+                               collect_digests: bool = False,
+                               avoid: frozenset = frozenset(),
+                               benign_sink: Optional[set] = None) -> list:
         """Group form of _read_block_shards_raw: ONE pool task per
         reader streams every block of the group sequentially (the
         frames are adjacent on disk), instead of a k-way fan-out per
         block — GET_BATCH_BLOCKS× fewer pool tasks, and each shard
-        file is read in order. Hedging stays reader-granular: a reader
-        that fails anywhere is dropped and extras re-read the whole
-        group. Returns [(shards, digests, had_errors)] per block."""
+        file is read in order. Returns [(shards, digests, had_errors)]
+        per block.
+
+        This is THE hedged-read state machine (the "Tail at Scale"
+        fix): k primaries launch, and a spare shard read races any
+        primary that either FAILS (error hedge, the original behavior)
+        or outlives the adaptive latency deadline from the health
+        tracker (healthy p95 × K, clamped) — a drive doing 500 ms
+        I/Os no longer holds the whole GET. First k wins; losers are
+        condemned (their stateful streams must never serve a later
+        group) and closed when their abandoned read settles.
+
+        `avoid` holds reader indices the plan deprioritizes (slow-drive
+        quarantine): they sort behind every healthy candidate and are
+        touched only when nothing else can reach k. `benign_sink`
+        collects indices whose shards are missing for PLAN reasons
+        (avoided, or hedge-raced on latency) rather than damage — the
+        verify step must not flag a heal for those."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _fwait
         nb = len(blocks)
         per_reader: list = [None] * n          # i -> [(data, dg)]*nb
-        tried = [False] * n
         had_errors = False
+        errored: set = set()
 
-        def try_read(indices: list[int]) -> None:
-            def read_one(j, r):
-                if r is None or tried[indices[j]]:
-                    raise serr.DiskNotFound(f"reader {indices[j]}")
-                out = []
-                with telemetry.span("disk.shard_read",
-                                    disk=indices[j], blocks=nb):
-                    for b, sl in zip(blocks, shard_lens):
-                        off = b * shard_size
-                        if collect_digests and isinstance(
-                                r, bitrot_io.StreamingBitrotReader):
-                            frames = r.read_frames(off, sl)
-                            out.append((frames[0][1] if frames else b"",
-                                        frames[0][0] if frames else None))
-                        else:
-                            out.append((r.read_at(off, sl), None))
-                return out
+        def read_one(i: int, r) -> list:
+            out = []
+            t0 = time.perf_counter()
+            with telemetry.span("disk.shard_read", disk=i, blocks=nb):
+                for b, sl in zip(blocks, shard_lens):
+                    off = b * shard_size
+                    if collect_digests and isinstance(
+                            r, bitrot_io.StreamingBitrotReader):
+                        frames = r.read_frames(off, sl)
+                        out.append((frames[0][1] if frames else b"",
+                                    frames[0][0] if frames else None))
+                    else:
+                        out.append((r.read_at(off, sl), None))
+            healthtrack.observe_disk(r.disk, "read",
+                                     time.perf_counter() - t0)
+            return out
 
-            results, errs = meta.for_each_disk(
-                [readers[i] for i in indices], read_one)
-            for j, (res, e) in enumerate(zip(results, errs)):
-                i = indices[j]
-                tried[i] = True
-                if e is None and res is not None:
-                    per_reader[i] = res
-                elif e is not None:
-                    readers[i] = None
+        # candidate order: data rows first (their shards join without
+        # a decode), parity next, avoided (suspect/probation) drives
+        # last — the capacity-permitting rule by construction: they
+        # re-enter only when nothing healthier can reach k
+        candidates = [i for i in range(n) if readers[i] is not None]
+        candidates.sort(key=lambda i: (i in avoid, 0 if i < k else 1, i))
+        spares = candidates[k:]
+        inflight: dict = {}
 
-        try_read([i for i in range(k) if readers[i] is not None])
-        got = sum(1 for r in per_reader if r is not None)
-        while got < k:
-            extras = [i for i in range(n)
-                      if readers[i] is not None and not tried[i]]
-            if not extras:
+        def launch(i: int) -> None:
+            inflight[meta.submit_disk_task(read_one, i, readers[i])] = i
+
+        for i in candidates[:k]:
+            launch(i)
+        hedge_s = healthtrack.read_hedge_s()
+        deadline = None if hedge_s is None \
+            else time.monotonic() + hedge_s
+
+        while inflight:
+            got = sum(1 for p in per_reader if p is not None)
+            if got >= k:
                 break
-            had_errors = True
-            try_read(extras[:k - got])
-            got = sum(1 for r in per_reader if r is not None)
+            timeout = None
+            if deadline is not None and spares:
+                timeout = max(deadline - time.monotonic(), 0.0)
+            done, _ = _fwait(set(inflight), timeout=timeout,
+                             return_when=FIRST_COMPLETED)
+            if not done:
+                # latency hedge: every still-missing slot gets a spare
+                # racing it; the deadline re-arms so a second level of
+                # stalls hedges again (spares permitting)
+                need = k - sum(1 for p in per_reader if p is not None)
+                fresh, spares = spares[:need], spares[need:]
+                for i in fresh:
+                    launch(i)
+                    healthtrack.note_hedge("latency")
+                deadline = time.monotonic() + (hedge_s or 0.0)
+                continue
+            for f in done:
+                i = inflight.pop(f)
+                try:
+                    per_reader[i] = f.result(timeout=0)
+                except Exception:  # noqa: BLE001 — reader condemned
+                    readers[i] = None
+                    errored.add(i)
+                    had_errors = True
+                    if spares:
+                        j = spares.pop(0)
+                        launch(j)
+                        healthtrack.note_hedge("error")
+
+        got = sum(1 for p in per_reader if p is not None)
+        if got >= k and inflight:
+            # first-k wins: condemn the losers so no later group reads
+            # their (stateful) streams, and close each one when its
+            # abandoned task settles on the pool
+            for f, i in inflight.items():
+                loser = readers[i]
+                readers[i] = None
+
+                def _close(_f, r=loser):
+                    try:
+                        r.close()
+                    except Exception:  # noqa: BLE001 — abandoned
+                        pass
+                f.add_done_callback(_close)
         if got < k:
             raise api_errors.InsufficientReadQuorum(
                 f"{got} readable shards < k={k}")
-        if any(per_reader[i] is None for i in range(k)):
+        # shards missing because the PLAN skipped or out-raced their
+        # reader (not because the reader failed) are benign: decode
+        # reconstructs them, but nothing on disk needs healing. The
+        # caller may PRE-SEED benign_sink with prior groups' benign
+        # misses (a latency-condemned reader stays out for the whole
+        # part) — those carry forward into this group's verdict too.
+        benign = {i for i in candidates
+                  if per_reader[i] is None and i not in errored}
+        if benign_sink is not None:
+            benign_sink.update(benign)
+            # a reader that REALLY errored this group loses any benign
+            # standing it carried in (avoided earlier, then pressed
+            # into service and failed): that miss is damage
+            benign_sink.difference_update(errored)
+            benign = set(benign_sink)
+        missing_data = {i for i in range(k) if per_reader[i] is None}
+        if missing_data and not missing_data <= benign:
             had_errors = True
 
         out = []
@@ -1325,9 +1443,11 @@ class ErasureObjects:
 
     def _read_block_shards_raw(self, readers, block_num: int,
                                shard_size: int, shard_len: int, k: int,
-                               n: int, collect_digests: bool = False
+                               n: int, collect_digests: bool = False,
+                               avoid: frozenset = frozenset(),
+                               benign_sink: Optional[set] = None
                                ) -> tuple[list, list, bool]:
-        """k-of-n shard reads with hedged extras on failure
+        """k-of-n shard reads with hedged extras on failure OR stall
         (parallelReader, cmd/erasure-decode.go:102-184). Returns
         (shards, expected_digests, had_errors): raw shards (missing
         entries None — at least k present) without reconstructing.
@@ -1338,10 +1458,12 @@ class ErasureObjects:
         the deferred-verify feed for the fused device program.
 
         One hedged-read state machine: this is the single-block form of
-        _read_group_shards_raw."""
+        _read_group_shards_raw, so the heal/rebalance readers that call
+        it ride the same adaptive hedging the GET plan does."""
         return self._read_group_shards_raw(
             readers, [block_num], shard_size, [shard_len], k, n,
-            collect_digests=collect_digests)[0]
+            collect_digests=collect_digests, avoid=avoid,
+            benign_sink=benign_sink)[0]
 
     # ------------------------------------------------------------------
     # DELETE (cmd/erasure-object.go:727-820)
@@ -1810,6 +1932,14 @@ class _PartReadPlan:
         self.readers: Optional[list] = None
         self.part_algo = None
         self.defer_verify = False
+        self.avoid: frozenset = frozenset()
+        # indices whose shards went missing for PLAN reasons in ANY
+        # earlier group (quarantine skip / latency-hedge loser): a
+        # condemned-for-latency reader stays out for the whole part,
+        # and later groups must keep treating its absence as benign —
+        # not as damage to heal (cleared on a quorum-loss rebuild,
+        # which mints fresh readers)
+        self.benign_hist: set = set()
         self.io_lock = threading.Lock()
         self.reader_gen = [0]
         self.heal_required = False
@@ -1855,6 +1985,17 @@ class _PartReadPlan:
         if self.readers is not None:
             return
         self.readers = self._make_readers()
+        # slow-drive quarantine: suspect/probation drives fall to the
+        # BACK of the candidate order (excluded from primaries and
+        # hedge targets) — but only capacity-permitting: with fewer
+        # than k healthy readers the plan keeps everyone in play
+        if healthtrack.quarantine_enabled():
+            sus = {i for i, r in enumerate(self.readers)
+                   if r is not None
+                   and healthtrack.is_suspect_disk(r.disk)}
+            if sus and sum(1 for r in self.readers
+                           if r is not None) - len(sus) >= self.k:
+                self.avoid = frozenset(sus)
         # device-routed groups defer per-frame bitrot verification into
         # the fused verify+decode program (one dispatch hashes AND
         # reconstructs — cmd/erasure-decode.go:111-150's inseparable
@@ -1873,13 +2014,19 @@ class _PartReadPlan:
             and self.codec._route(GET_BATCH_BLOCKS * self.k
                                   * self.shard_size) == "device")
 
-    def read_group(self, blocks: list, geoms: list) -> tuple[list, bool,
-                                                             float]:
+    def read_group(self, blocks: list, geoms: list
+                   ) -> tuple[list, bool, float, frozenset]:
         """One group's raw shard reads, with the quorum-loss →
         per-block-hedged-read degradation unchanged; returns
-        (per-block reads, degraded, read seconds)."""
+        (per-block reads, degraded, read seconds, benign-missing
+        reader indices — plan-caused misses the verify step must not
+        flag a heal for)."""
         t0 = time.perf_counter()
         degraded = False
+        # pre-seeded with earlier groups' plan-caused misses: a reader
+        # condemned by a latency hedge in group 1 stays benign-missing
+        # for every later group of this part
+        benign: set = set(self.benign_hist)
         with self.io_lock, telemetry.span("pipeline.read_group",
                                           blocks=len(blocks)):
             readers = self.readers
@@ -1887,7 +2034,9 @@ class _PartReadPlan:
                 reads = self.eng._read_group_shards_raw(
                     readers, blocks, self.shard_size,
                     [g[3] for g in geoms], self.k, self.n,
-                    collect_digests=self.defer_verify)
+                    collect_digests=self.defer_verify,
+                    avoid=self.avoid, benign_sink=benign)
+                self.benign_hist = set(benign)
             except api_errors.InsufficientReadQuorum:
                 # group-granular hedging can lose quorum where
                 # block-granular recovery still succeeds (distinct
@@ -1900,11 +2049,14 @@ class _PartReadPlan:
                 readers[:] = self._make_readers()
                 self.reader_gen[0] += 1
                 degraded = True
+                benign.clear()      # recovery mode: flag everything
+                self.benign_hist = set()
                 reads = [self.eng._read_block_shards_raw(
                     readers, g[0], self.shard_size, g[3], self.k,
                     self.n, collect_digests=self.defer_verify)
                     for g in geoms]
-        return reads, degraded, time.perf_counter() - t0
+        return reads, degraded, time.perf_counter() - t0, \
+            frozenset(benign)
 
     def _submit(self, spec) -> object:
         """Queue one group's reads on the prefetch pool, carrying the
@@ -1946,11 +2098,16 @@ class _PartReadPlan:
                     self._primed = False
                 if lookahead is not None:
                     t0 = time.perf_counter()
-                    reads, degraded, read_s = lookahead.result()
+                    # the task runs read_group: its shard reads ride
+                    # the hedged state machine, so the deadline lives
+                    # inside the read itself
+                    # check: allow(deadline) task body IS the hedged reader
+                    reads, degraded, read_s, benign = lookahead.result()
                     pl.STATS.record_get_group(
                         True, time.perf_counter() - t0, read_s)
                 else:
-                    reads, degraded, _ = self.read_group(blocks, geoms)
+                    reads, degraded, _, benign = self.read_group(blocks,
+                                                                 geoms)
                     pl.STATS.record_get_group(False)
             # readers-list generation THIS group's frames came from
             # (the N+1 lookahead may rebuild the list mid-verify)
@@ -1977,7 +2134,8 @@ class _PartReadPlan:
                         self.shard_size,
                         self.part_algo or self.eng.bitrot_algo,
                         io_lock=self.io_lock,
-                        reader_gen=(self.reader_gen, gen_at_read)):
+                        reader_gen=(self.reader_gen, gen_at_read),
+                        benign_missing=benign):
                     self.heal_required = True
             with stagetimer.stage("get.join"):
                 out = []
@@ -2004,6 +2162,7 @@ class _PartReadPlan:
         streams)."""
         if self._pending is not None and not self._pending.cancel():
             try:
+                # check: allow(deadline) task body IS the hedged reader
                 self._pending.result()
             except BaseException:  # noqa: BLE001 — abandoned read
                 pass

@@ -383,6 +383,7 @@ class HealMixin(ErasureObjects):
                         in staged:
                     if fut is not None:
                         try:
+                            # check: allow(deadline) device dispatch; scheduler close() flushes waiters
                             fused = fut.result()
                         except Exception:  # noqa: BLE001 — a shared-
                             # dispatch failure must not kill a heal the

@@ -107,7 +107,104 @@ def for_each_disk(disks: Sequence[Optional[StorageAPI]],
     else:
         futures = [_POOL.submit(run, i) for i in range(len(disks))]
     for f in futures:
+        # each task is one drive verb, bounded by the drive/RPC
+        # deadline; fan-outs that must not wait for stragglers ride
+        # for_each_disk_quorum instead
+        # check: allow(deadline) per-drive verb bounded by drive/RPC deadline
         f.result()
+    return results, errs
+
+
+def submit_disk_task(fn, *args):
+    """One task on the shared drive-io pool, carrying the caller's
+    span context (the for_each_disk discipline) — the hedged-read
+    state machine launches per-reader tasks through this so it can
+    wait on them with a deadline instead of joining a whole fan-out."""
+    from ..utils import telemetry
+    if telemetry.current_span() is not None:
+        import contextvars
+        return _POOL.submit(contextvars.copy_context().run, fn, *args)
+    return _POOL.submit(fn, *args)
+
+
+def for_each_disk_quorum(disks: Sequence[Optional[StorageAPI]],
+                         fn: Callable[[int, StorageAPI], object],
+                         quorum: int, stall_s: Optional[float] = None,
+                         stage: str = "write",
+                         on_settle: Optional[Callable[[int], None]]
+                         = None
+                         ) -> tuple[list, list[Optional[Exception]]]:
+    """for_each_disk with quorum-ack semantics: returns once every
+    drive finished OR `quorum` successes are in and the laggards have
+    outlived `stall_s` (measured from fan-out start). Stragglers keep
+    running on the drive-io pool — the bounded background lane — and
+    are reported as serr.StorageStalled so the caller's quorum reduce
+    counts them as missed writes (the MRF degraded-write feed).
+
+    `on_settle(i)` fires when an ABANDONED straggler finally completes
+    (however it ends). Namespace-mutating laggards (a rename) need it:
+    by the time the op lands, the commit lock is long released and a
+    NEWER write may have committed — the callback lets the caller
+    re-queue an MRF check so a late-landing stale op is healed back to
+    quorum state instead of silently de-replicating the newer version.
+
+    stall_s=None (quorum-ack off) degrades to exactly for_each_disk."""
+    if stall_s is None:
+        return for_each_disk(disks, fn)
+    import time as _time
+    from concurrent.futures import FIRST_COMPLETED
+    from concurrent.futures import wait as _fwait
+    from ..utils import healthtrack, telemetry
+
+    results: list = [None] * len(disks)
+    errs: list[Optional[Exception]] = [None] * len(disks)
+    settled = [False] * len(disks)
+    futs: dict = {}
+    traced = telemetry.current_span() is not None
+    if traced:
+        import contextvars
+    for i in range(len(disks)):
+        if disks[i] is None:
+            errs[i] = serr.DiskNotFound(f"drive {i}")
+            settled[i] = True
+            continue
+
+        def run(i=i):
+            return fn(i, disks[i])
+
+        fut = _POOL.submit(contextvars.copy_context().run, run) \
+            if traced else _POOL.submit(run)
+        futs[fut] = i
+    deadline = _time.monotonic() + stall_s
+    while futs:
+        ok = sum(1 for i in range(len(disks))
+                 if settled[i] and errs[i] is None)
+        remaining = deadline - _time.monotonic()
+        if ok >= quorum and remaining <= 0:
+            break
+        # below quorum the wait is unbounded — quorum durability is
+        # the correctness line; each task is itself bounded by its
+        # drive/RPC deadline, so this cannot hang past the slowest
+        # drive's own timeout
+        done, _ = _fwait(set(futs), return_when=FIRST_COMPLETED,
+                         timeout=remaining if ok >= quorum else None)
+        for f in done:
+            i = futs.pop(f)
+            settled[i] = True
+            try:
+                results[i] = f.result(timeout=0)
+            except Exception as e:  # noqa: BLE001 — per-drive isolation
+                errs[i] = e
+    for f, i in futs.items():
+        # abandoned to the background lane: the future keeps the
+        # task (and this slot's eventual completion) alive; nothing
+        # joins it — that is the point
+        errs[i] = serr.StorageStalled(
+            f"drive {i}: {stage} abandoned after {stall_s:.3f}s "
+            "(write quorum already durable)")
+        healthtrack.note_laggard(stage)
+        if on_settle is not None:
+            f.add_done_callback(lambda _f, i=i: on_settle(i))
     return results, errs
 
 
@@ -251,16 +348,20 @@ def eval_disks(disks: Sequence[Optional[StorageAPI]],
 
 def write_unique_file_info(disks: Sequence[Optional[StorageAPI]],
                            bucket: str, prefix: str,
-                           files: Sequence[FileInfo], quorum: int
+                           files: Sequence[FileInfo], quorum: int,
+                           stall_s: Optional[float] = None
                            ) -> list[Optional[StorageAPI]]:
     """Write per-drive xl.meta (Erasure.Index = i+1) to all drives,
     enforcing write quorum (reference writeUniqueFileInfo,
-    cmd/erasure-metadata.go:294)."""
+    cmd/erasure-metadata.go:294). `stall_s` selects the quorum-ack
+    lane: laggard metadata writers past it are abandoned (and counted
+    lost by the caller) once quorum is durable."""
     def write(i: int, d: StorageAPI):
         files[i].erasure.index = i + 1
         d.write_metadata(bucket, prefix, files[i])
 
-    _, errs = for_each_disk(disks, write)
+    _, errs = for_each_disk_quorum(disks, write, quorum,
+                                   stall_s=stall_s, stage="meta")
     err = reduce_write_quorum_errs(errs, OBJECT_OP_IGNORED_ERRS, quorum)
     if err is not None:
         raise err

@@ -24,7 +24,7 @@ from typing import Optional
 import numpy as np
 
 from .. import bitrot as bitrot_mod
-from ..utils import crashpoint
+from ..utils import crashpoint, healthtrack
 from ..storage import errors as serr
 from ..storage.datatypes import (NULL_VERSION_ID, ChecksumInfo, FileInfo,
                                  ObjectInfo, now)
@@ -166,7 +166,14 @@ class MultipartMixin(ErasureObjects):
                         raise serr.DiskNotFound(f"writer {i}")
                     w.close()
 
-                _, errs = meta.for_each_disk(shuffled, close_writer)
+                # quorum-ack: the part upload, like the single-part
+                # PUT, must not wait out a gray drive once quorum is
+                # durable — the laggard's missing shard surfaces as a
+                # rename error at complete and heals through MRF
+                stall = healthtrack.write_stall_s()
+                _, errs = meta.for_each_disk_quorum(
+                    shuffled, close_writer, write_quorum,
+                    stall_s=stall, stage="close")
                 for i, e in enumerate(errs):
                     if e is not None:
                         writers[i] = None
@@ -184,7 +191,9 @@ class MultipartMixin(ErasureObjects):
                     d.rename_file(MINIO_META_TMP_BUCKET, tmp_part,
                                   MINIO_META_MULTIPART_BUCKET, dst)
 
-                _, errs = meta.for_each_disk(shuffled, rename)
+                _, errs = meta.for_each_disk_quorum(
+                    shuffled, rename, write_quorum, stall_s=stall,
+                    stage="rename")
                 err = meta.reduce_write_quorum_errs(
                     errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
                 if err is not None:
@@ -454,7 +463,18 @@ class MultipartMixin(ErasureObjects):
                                   fi.data_dir, bucket, object_name,
                                   fi.version_id or NULL_VERSION_ID)
 
-                _, errs = meta.for_each_disk(self.disks, rename)
+                # quorum-ack commit: a drive stalling mid-rename must
+                # not hold the CompleteMultipartUpload response once
+                # quorum is durable — the laggard lands in `errs` and
+                # feeds MRF below exactly like a failed rename; when an
+                # abandoned rename settles LATE it may have laid an
+                # older version over a newer commit, so it re-queues
+                # the MRF check against then-current quorum state
+                _, errs = meta.for_each_disk_quorum(
+                    self.disks, rename, write_quorum,
+                    stall_s=healthtrack.write_stall_s(), stage="rename",
+                    on_settle=lambda _i: self._notify_degraded(
+                        bucket, object_name, fi.version_id))
                 err = meta.reduce_write_quorum_errs(
                     errs, meta.OBJECT_OP_IGNORED_ERRS, write_quorum)
                 if err is not None:

@@ -203,6 +203,22 @@ class AdminHandlers:
             if self.node is not None:
                 reports.extend(self.node.notification.bandwidth_all())
             return self._json({"buckets": merge_reports(reports)})
+        if sub == "drivehealth" and m == "GET":
+            # the gray-failure plane's state: per-drive / per-peer
+            # latency summaries, quarantine states, recent transitions
+            self._auth(ctx, "admin:OBDInfo")
+            from ..utils import healthtrack
+            events: list = []
+            node = self.node
+            mon = getattr(node, "disk_monitor", None) \
+                if node is not None else None
+            if mon is not None:
+                events = [{"drive": k, "event": e}
+                          for k, e in list(mon.quarantine_events)[-100:]]
+            return self._json({
+                "drives": healthtrack.TRACKER.snapshot("drive"),
+                "peers": healthtrack.TRACKER.snapshot("peer"),
+                "events": events})
         if sub == "obdinfo" and m == "GET":
             self._auth(ctx, "admin:OBDInfo")
             from ..utils.obd import local_obd

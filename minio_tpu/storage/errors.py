@@ -41,6 +41,14 @@ class FaultyDisk(StorageError):
     """I/O error talking to the drive (errFaultyDisk)."""
 
 
+class StorageStalled(StorageError):
+    """Drive op abandoned by the quorum-ack lane: it outlived the
+    write-straggler grace after write quorum was already durable. The
+    op keeps running on the background lane — this error only records
+    that the commit stopped waiting (the caller's quorum reduce counts
+    it as a missed write, feeding the MRF degraded-write queue)."""
+
+
 class DiskFull(StorageError):
     """No space left (errDiskFull)."""
 

@@ -11,11 +11,22 @@ Two programming models compose:
   * **Programmed faults** — ``fail_verbs[verb] = err`` fails every call
     of a verb; ``verb_errors[verb][n] = err`` fails exactly the n-th
     call (the reference's ``errors map[int]error``); ``offline = True``
-    makes every verb raise DiskNotFound until cleared.
+    makes every verb raise DiskNotFound until cleared;
+    ``stall_verbs[verb] = seconds`` stalls every call of a verb and
+    ``verb_stalls[verb][n] = seconds`` stalls exactly the n-th call —
+    the gray-failure injector (the drive ANSWERS, just slowly).
   * **Scheduled faults** — a seeded :class:`FaultSchedule` decides per
     (verb, call#) whether to raise an error, inject latency, flip
     payload bytes (bitrot), truncate a read stream / short-write a
-    payload, or hold the drive offline for an op-count window.
+    payload, hold the drive offline for an op-count window, or stall
+    the call on a heavy-tail duration (``stall_rate``/``stall_s``/
+    ``stall_pareto`` + ``stall_windows`` op-count windows during which
+    EVERY faultable call stalls).
+
+Stalls on ``read_file_stream`` are deferred to the FIRST read of the
+returned stream rather than the open — a gray-failing drive typically
+accepts the request and then takes forever to move bytes, which is
+exactly the shape the hedged reader must race.
 
 Schedule decisions are pure functions of ``(seed, verb, call#)`` — the
 same seed replays the same fault pattern per verb sequence regardless
@@ -73,6 +84,18 @@ class FaultSchedule:
     # [start, end) windows in the drive's TOTAL op count during which the
     # drive is gone (go-offline/come-back transitions)
     offline_windows: tuple = ()
+    # probability a faulted verb call STALLS (answers, slowly): the
+    # duration is `stall_s`, heavy-tailed by `stall_pareto` > 0
+    # (duration = stall_s / (1-u)^pareto, capped at stall_max_s — a
+    # deterministic Pareto-ish tail from the same pure hash)
+    stall_rate: float = 0.0
+    stall_s: float = 0.5
+    stall_pareto: float = 0.0
+    stall_max_s: float = 5.0
+    # [start, end) windows in the TOTAL op count during which every
+    # faultable verb call stalls `stall_s` — a drive that goes gray for
+    # a stretch, then recovers
+    stall_windows: tuple = ()
     # which verbs the error/latency faults apply to
     fault_verbs: tuple = DATA_VERBS
     error_cls: type = serr.FaultyDisk
@@ -104,6 +127,22 @@ class FaultSchedule:
     def offline_at(self, op_no: int) -> bool:
         return any(a <= op_no < b for a, b in self.offline_windows)
 
+    def stall_for(self, verb: str, n: int, op_no: int) -> float:
+        """Stall duration for this call (0.0 = none): the op-count
+        window first, then the seeded per-call roll with its
+        deterministic heavy tail."""
+        if verb in self.fault_verbs and \
+                any(a <= op_no < b for a, b in self.stall_windows):
+            return self.stall_s
+        if verb in self.fault_verbs and self.stall_rate > 0 and \
+                self._roll(verb, n, "stall") < self.stall_rate:
+            if self.stall_pareto > 0:
+                u = self._roll(verb, n, "stall-dur")
+                return min(self.stall_s / max(1.0 - u, 1e-6)
+                           ** self.stall_pareto, self.stall_max_s)
+            return self.stall_s
+        return 0.0
+
     # deterministic "where" for payload mutation
     def fault_offset(self, verb: str, n: int, size: int) -> int:
         if size <= 0:
@@ -119,6 +158,8 @@ class FaultStats:
     bitrot: int = 0
     truncated: int = 0
     offline_hits: int = 0
+    stalls: int = 0
+    stall_s: float = 0.0
     calls: dict = field(default_factory=dict)
 
 
@@ -152,6 +193,29 @@ class _TruncatedStream:
             close()
 
 
+class _StallFirstReadStream:
+    """Defers a stall to the first read of a shard stream: the open
+    returns instantly (the drive 'answered'), the payload takes
+    `dur` seconds to start moving — the gray-failure read shape the
+    hedged reader must race."""
+
+    def __init__(self, inner, dur: float, stall_fn):
+        self._inner = inner
+        self._dur = dur
+        self._stall_fn = stall_fn
+
+    def read(self, n: int = -1) -> bytes:
+        if self._dur > 0:
+            dur, self._dur = self._dur, 0.0
+            self._stall_fn(dur)
+        return self._inner.read(n)
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+
 def _flip_byte(data: bytes, at: int) -> bytes:
     if not data:
         return data
@@ -171,6 +235,9 @@ class NaughtyDisk(StorageAPI):
         self.enabled = enabled
         self.fail_verbs: dict[str, Exception] = {}
         self.verb_errors: dict[str, dict[int, Exception]] = {}
+        # programmed stalls: every call of a verb / exactly the n-th
+        self.stall_verbs: dict[str, float] = {}
+        self.verb_stalls: dict[str, dict[int, float]] = {}
         self.offline = False
         self.stats = FaultStats()
         self.total_ops = 0
@@ -217,7 +284,29 @@ class NaughtyDisk(StorageAPI):
                 with self._mu:
                     self.stats.latency += 1
                 time.sleep(lat)
+        if verb != "read_file_stream":
+            dur = self._stall_duration(verb, n, op, sched)
+            if dur > 0:
+                self._stall(dur)
+        # read_file_stream defers its stall to the first read of the
+        # returned stream (read_file_stream computes it there)
         return n
+
+    def _stall_duration(self, verb: str, n: int, op: int,
+                        sched) -> float:
+        one_shot = self.verb_stalls.get(verb)
+        if one_shot is not None and n in one_shot:
+            return one_shot.pop(n)
+        dur = self.stall_verbs.get(verb, 0.0)
+        if dur <= 0 and sched is not None:
+            dur = sched.stall_for(verb, n, op)
+        return dur
+
+    def _stall(self, dur: float) -> None:
+        with self._mu:
+            self.stats.stalls += 1
+            self.stats.stall_s += dur
+        time.sleep(dur)
 
     def _mangle_read(self, verb: str, n: int, data: bytes) -> bytes:
         sched = self.schedule if self.enabled else None
@@ -354,6 +443,13 @@ class NaughtyDisk(StorageAPI):
                          length: int) -> BinaryIO:
         n = self._begin("read_file_stream")
         stream = self.inner.read_file_stream(volume, path, offset, length)
+        # stalls ride the FIRST read, not the open: a gray drive
+        # accepts the request fast and then dribbles bytes
+        dur = self._stall_duration("read_file_stream", n, self.total_ops,
+                                   self.schedule if self.enabled
+                                   else None)
+        if dur > 0:
+            stream = _StallFirstReadStream(stream, dur, self._stall)
         sched = self.schedule if self.enabled else None
         if sched is None:
             return stream

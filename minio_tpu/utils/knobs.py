@@ -256,11 +256,55 @@ define("MINIO_TPU_RPC_RETRY_BACKOFF", "float", 0.05,
        "first RPC retry delay, seconds", _S)
 define("MINIO_TPU_RPC_RETRY_BACKOFF_MAX", "float", 2.0,
        "RPC retry delay cap, seconds", _S)
-define("MINIO_TPU_PROBE_BACKOFF_MAX", "float", 30.0,
-       "offline health-probe interval cap, seconds", _S)
+define("MINIO_TPU_DISK_PROBE_S", "float", 10.0,
+       "DiskMonitor scan interval: dead-slot re-probes AND slow-drive "
+       "health evaluation run on this cadence", _S)
+define("MINIO_TPU_PEER_PROBE_S", "float", 30.0,
+       "offline peer health-probe backoff cap, seconds (any "
+       "successful direct call re-admits the host immediately)", _S)
 define("MINIO_TPU_CHAOS_SEED", "str", "",
        "replay a chaos test's exact fault schedule (tests print the "
        "failing seed)", _S, display="per-test")
+
+_S = "Gray-failure plane"
+define("MINIO_TPU_LAT_WINDOW", "int", 64,
+       "latency samples retained per (drive/peer, verb) window", _S)
+define("MINIO_TPU_HEDGE", "bool", True,
+       "`off` disables latency-hedged shard reads (error-triggered "
+       "hedging stays)", _S)
+define("MINIO_TPU_HEDGE_K", "float", 3.0,
+       "hedge deadline = healthy read p95 × this", _S)
+define("MINIO_TPU_HEDGE_FLOOR_S", "float", 0.05,
+       "hedge deadline floor, seconds", _S)
+define("MINIO_TPU_HEDGE_CEIL_S", "float", 2.0,
+       "hedge deadline ceiling, seconds (also the cold-start value "
+       "before any latency samples exist)", _S)
+define("MINIO_TPU_QUORUM_ACK", "bool", True,
+       "`off` makes every shard-write fan-out wait for ALL drives "
+       "again instead of acking at write quorum and abandoning "
+       "laggards to the MRF-fed background lane", _S)
+define("MINIO_TPU_WRITE_STALL_K", "float", 4.0,
+       "write-straggler grace = healthy write p95 × this", _S)
+define("MINIO_TPU_WRITE_STALL_FLOOR_S", "float", 0.5,
+       "write-straggler grace floor, seconds", _S)
+define("MINIO_TPU_WRITE_STALL_CEIL_S", "float", 10.0,
+       "write-straggler grace ceiling, seconds (cold-start value)", _S)
+define("MINIO_TPU_QUARANTINE", "bool", True,
+       "`off` disables the slow-drive suspect/probation state machine",
+       _S)
+define("MINIO_TPU_QUAR_LATENCY_S", "float", 0.25,
+       "absolute p95 latency above which a drive turns suspect", _S)
+define("MINIO_TPU_QUAR_RATIO", "float", 8.0,
+       "relative conviction bar: suspect needs p95 above healthy-peer "
+       "p95 × this too (uniformly slow media quarantine nothing)", _S)
+define("MINIO_TPU_QUAR_MIN_SAMPLES", "int", 8,
+       "read/write samples required before a drive can be convicted",
+       _S)
+define("MINIO_TPU_QUAR_PROBATION_S", "float", 15.0,
+       "suspect dwell before probation re-probes begin", _S)
+define("MINIO_TPU_QUAR_PROBES", "int", 3,
+       "consecutive healthy probation probes before the heal-verified "
+       "re-admission", _S)
 
 _S = "Telemetry"
 define("MINIO_TPU_TRACE_SLOW_MS", "float", 500.0,

@@ -71,12 +71,25 @@ def drive_fault_counters(disks) -> list[dict]:
         offline_trips) — what the internode plane actually suffered.
 
     Drives with neither report only their identity; a None slot reports
-    offline. Duck-typed so gateways/FS layers return []."""
+    offline. Duck-typed so gateways/FS layers return [].
+
+    Each entry also carries the gray-failure plane's view: the tracked
+    per-verb latency summary and the quarantine health state, next to
+    the fault counters — the "is it slow" answer beside "is it
+    failing"."""
+    from . import healthtrack
+    tracked = {e["key"]: e for e in healthtrack.TRACKER.snapshot("drive")}
     out: list[dict] = []
     for i, d in enumerate(disks):
         entry: dict = {"index": i,
                        "drive": str(d) if d is not None else None,
                        "online": d is not None}
+        if d is not None:
+            h = tracked.get(healthtrack.disk_key(d))
+            if h is not None:
+                entry["health"] = {"state": h["state"],
+                                   "state_age_s": h["state_age_s"],
+                                   "latency": h["verbs"]}
         cur, hops = d, 0
         while cur is not None and hops < 8:
             hops += 1
@@ -138,4 +151,8 @@ def local_obd(drive_paths: list[str] | None = None,
     }
     if storage_drives is not None:
         out["drive_faults"] = drive_fault_counters(storage_drives)
+    # the gray-failure snapshot: per-peer latency summaries (the
+    # per-drive ones ride each drive_faults entry above)
+    from . import healthtrack
+    out["peer_health"] = healthtrack.TRACKER.snapshot("peer")
     return out

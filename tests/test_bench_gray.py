@@ -1,0 +1,43 @@
+"""CI smoke for bench.py --ab-gray: the gray-failure A/B must run
+end-to-end inside the tier-1 budget, emit a JSON-serializable payload,
+and prove the plane's three claims at smoke scale — GET p99 improves
+with hedging on, PUT acks at quorum below the injected stall, zero
+acked-write loss after the MRF drain, and the stalled drive completes
+the quarantine → probation → re-admission round trip."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.chaos
+
+
+def test_gray_ab_smoke():
+    out = bench.bench_gray_ab(objects=5, size=1 << 18, gets=20,
+                              streams=4, drives=6, block=1 << 16,
+                              stall_s=0.3)
+    json.dumps(out)                     # BENCH-compatible payload
+    assert out["config"]["stall_s"] == 0.3
+    # the injector really fired in BOTH passes
+    assert out["off"]["stalls_injected"] > 0
+    assert out["on"]["stalls_injected"] > 0
+    # tail latency: the full bench shows >= 3x at 0.5 s stalls; at
+    # smoke scale on a loaded CI box we pin a clear win, not the bar
+    assert out["get_p99_speedup_x"] > 2.0, out
+    assert out["put_p99_speedup_x"] > 2.0, out
+    # PUT acks at quorum: the stalled drive no longer binds p99
+    assert out["put_p99_below_stall"] is True
+    assert out["on"]["put"]["p99_ms"] < 300.0
+    # zero acked-write loss once MRF drains (asserted in-bench too)
+    assert out["lost_after_mrf"] == 0
+    # quarantine round trip: convicted while slow, re-admitted after
+    # probation probes + heal verify once the stall cleared
+    states = out["quarantine"]["states"]
+    assert states[0] == "suspect" and states[-1] == "ok"
+    events = [e for _k, e in out["quarantine"]["events"]]
+    assert events[0] == "suspect" and "probation" in events \
+        and events[-1] == "readmit"

@@ -751,3 +751,59 @@ def test_crashpoint_table_drift_detected(tmp_path, monkeypatch):
         f"# x\n\n{mod.TABLE_BEGIN}\nstale\n{mod.TABLE_END}\n")
     vs2 = crashtable.check_drift()
     assert vs2 and "drifted" in vs2[0].message
+
+
+# ---------------------------------------------------------------------------
+# rule: deadline (ISSUE 15 satellite — gray-failure plane)
+# ---------------------------------------------------------------------------
+
+BAD_DEADLINE = '''
+def fan_out(self, futs, sock):
+    for f in futs:
+        f.result()
+    return sock.recv(4096)
+'''
+
+GOOD_DEADLINE = '''
+def fan_out(self, futs, sock):
+    for f in futs:
+        f.result(timeout=5.0)
+    out = [f.result(2.0) for f in futs]
+    # check: allow(deadline) bounded by the hedged reader's own deadline
+    out.append(futs[0].result())
+    return out
+'''
+
+
+def test_deadline_rule_fires_on_bare_waits():
+    vs = rules_ast.check_deadline(
+        [_src("minio_tpu/object/engine.py", BAD_DEADLINE)])
+    msgs = "\n".join(v.message for v in vs)
+    assert "bare unbounded future .result()" in msgs
+    assert ".recv()" in msgs
+    assert len(vs) == 2
+
+
+def test_deadline_rule_quiet_on_bounded_and_cold_modules():
+    from check.core import filter_allowed
+    src = _src("minio_tpu/object/engine.py", GOOD_DEADLINE)
+    # timeout args are clean; the bare one carries its allow() argument
+    assert filter_allowed(src, rules_ast.check_deadline([src])) == []
+    # a module outside the hot list is not scanned at all
+    assert rules_ast.check_deadline(
+        [_src("minio_tpu/utils/telemetry.py", BAD_DEADLINE)]) == []
+
+
+def test_deadline_rule_clean_on_tree():
+    """Every hot-path fan-out in the committed tree either carries a
+    timeout, rides the hedged reader / quorum lane, or argues its
+    bound inline — the satellite's deliverable."""
+    from check.core import filter_allowed, load_sources
+    sources = load_sources()
+    by_rel = {s.rel: s for s in sources}
+    vs = rules_ast.check_deadline(sources)
+    left = []
+    for v in vs:
+        src = by_rel.get(v.path)
+        left.extend(filter_allowed(src, [v]) if src else [v])
+    assert left == []

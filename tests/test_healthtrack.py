@@ -1,0 +1,122 @@
+"""Unit tests for the gray-failure health tracker: windowed
+percentiles, adaptive hedge/stall deadlines (clamps + cold-start
+ceiling), the quarantine decision (absolute + relative bars), the
+suspect → probation → ok state machine, and the metrics surface."""
+
+from __future__ import annotations
+
+import pytest
+
+from minio_tpu.utils import healthtrack as ht
+from minio_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracker():
+    ht.TRACKER.reset()
+    yield
+    ht.TRACKER.reset()
+
+
+def feed(key: str, verb: str, values, kind: str = "drive") -> None:
+    for v in values:
+        ht.TRACKER.observe(kind, key, verb, v)
+
+
+def test_percentile_windowed():
+    feed("d0", "read", [0.001] * 50)
+    p = ht.TRACKER.percentile("drive", "d0", 0.95, verbs=("read",))
+    assert p == pytest.approx(0.001)
+    # the window caps retention: a flood of slow samples displaces old
+    feed("d0", "read", [0.5] * 200)
+    p = ht.TRACKER.percentile("drive", "d0", 0.5, verbs=("read",))
+    assert p == pytest.approx(0.5)
+
+
+def test_healthy_percentile_excludes_suspects_and_self():
+    feed("fast1", "read", [0.001] * 10)
+    feed("fast2", "read", [0.002] * 10)
+    feed("slow", "read", [0.9] * 10)
+    ht.TRACKER.set_state("drive", "slow", ht.STATE_SUSPECT)
+    p = ht.TRACKER.healthy_percentile("drive", 0.95, verbs=("read",))
+    assert p is not None and p < 0.01
+    # exclude= leaves the named entity's samples out too
+    p2 = ht.TRACKER.healthy_percentile("drive", 0.95, verbs=("read",),
+                                       exclude="fast2")
+    assert p2 == pytest.approx(0.001)
+
+
+def test_hedge_deadline_clamps(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_HEDGE_K", "3")
+    monkeypatch.setenv("MINIO_TPU_HEDGE_FLOOR_S", "0.05")
+    monkeypatch.setenv("MINIO_TPU_HEDGE_CEIL_S", "2.0")
+    # cold start: no samples -> ceiling (never hedge spuriously)
+    assert ht.read_hedge_s() == pytest.approx(2.0)
+    # healthy p95 * K below the floor -> floor
+    feed("d0", "read", [0.001] * 20)
+    assert ht.read_hedge_s() == pytest.approx(0.05)
+    # in-range -> p95 * K
+    ht.TRACKER.reset()
+    feed("d0", "read", [0.1] * 20)
+    assert ht.read_hedge_s() == pytest.approx(0.3, rel=0.1)
+    # off switch
+    monkeypatch.setenv("MINIO_TPU_HEDGE", "off")
+    assert ht.read_hedge_s() is None
+
+
+def test_write_stall_deadline(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_WRITE_STALL_CEIL_S", "10")
+    assert ht.write_stall_s() == pytest.approx(10.0)   # cold ceiling
+    monkeypatch.setenv("MINIO_TPU_QUORUM_ACK", "off")
+    assert ht.write_stall_s() is None
+
+
+def test_should_quarantine_absolute_bar(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_QUAR_LATENCY_S", "0.25")
+    monkeypatch.setenv("MINIO_TPU_QUAR_MIN_SAMPLES", "8")
+    feed("slow", "read", [0.5] * 6)
+    # below the sample bar: no conviction on thin evidence
+    assert not ht.TRACKER.should_quarantine("drive", "slow")
+    feed("slow", "read", [0.5] * 4)
+    assert ht.TRACKER.should_quarantine("drive", "slow")
+    feed("fine", "read", [0.01] * 20)
+    assert not ht.TRACKER.should_quarantine("drive", "fine")
+
+
+def test_relative_bar_spares_uniformly_slow_media(monkeypatch):
+    """Every drive slow (cheap medium): nobody is an outlier, nobody
+    quarantines — the relative ratio raises the threshold."""
+    monkeypatch.setenv("MINIO_TPU_QUAR_LATENCY_S", "0.05")
+    monkeypatch.setenv("MINIO_TPU_QUAR_MIN_SAMPLES", "8")
+    monkeypatch.setenv("MINIO_TPU_QUAR_RATIO", "8")
+    for d in ("a", "b", "c"):
+        feed(d, "read", [0.1] * 12)
+    assert not ht.TRACKER.should_quarantine("drive", "a")
+    # now one drive is 10x its peers: convicted
+    feed("gray", "read", [1.0] * 12)
+    assert ht.TRACKER.should_quarantine("drive", "gray")
+
+
+def test_probe_state_machine():
+    ht.TRACKER.set_state("drive", "d0", ht.STATE_PROBATION)
+    assert ht.TRACKER.note_probe("drive", "d0", True) == 1
+    assert ht.TRACKER.note_probe("drive", "d0", True) == 2
+    # a failed probe re-convicts: back to suspect, count reset
+    assert ht.TRACKER.note_probe("drive", "d0", False) == 0
+    assert ht.TRACKER.state_of("drive", "d0") == ht.STATE_SUSPECT
+
+
+def test_snapshot_and_gauge_exposition():
+    feed("d0", "read", [0.002] * 5)
+    feed("p0", "peer-verb", [0.004] * 3, kind="peer")
+    ht.TRACKER.set_state("drive", "d0", ht.STATE_SUSPECT)
+    snap = ht.TRACKER.snapshot()
+    kinds = {(e["kind"], e["key"]) for e in snap}
+    assert ("drive", "d0") in kinds and ("peer", "p0") in kinds
+    d0 = next(e for e in snap if e["key"] == "d0")
+    assert d0["state"] == ht.STATE_SUSPECT
+    assert d0["verbs"]["read"]["n"] == 5
+    text = telemetry.REGISTRY.render()
+    assert 'minio_tpu_drive_health{disk="d0"} 1' in text
+    assert "minio_tpu_drive_latency_seconds_bucket" in text
+    assert "minio_tpu_peer_latency_seconds_count" in text

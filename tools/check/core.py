@@ -26,7 +26,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 PKG_ROOT = os.path.join(REPO, "minio_tpu")
 
 RULES = ("lock-blocking", "metrics-hygiene", "knob-env",
-         "hook-coverage", "error-map", "admission", "crashpoint")
+         "hook-coverage", "error-map", "admission", "crashpoint",
+         "deadline")
 
 _ALLOW_RE = re.compile(r"#\s*check:\s*allow\(([a-z\-]+(?:\s*,\s*[a-z\-]+)*)\)"
                        r"(.*)$")

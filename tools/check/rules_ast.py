@@ -562,3 +562,56 @@ def check_admission(sources: List[Source]) -> List[Violation]:
                     "AdmissionController — shed accounting has ONE "
                     f"home, {ADMISSION_MODULE}"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: deadline
+# ---------------------------------------------------------------------------
+
+# Hot-path fan-out modules: every shard fan-out / internode wait here
+# sits under per-request traffic, so a bare unbounded `.result()` (or
+# raw socket `.recv`) lets ONE gray drive or peer hold a whole
+# GET/PUT — the exact tail-latency hole the hedged reader and the
+# quorum-ack lane exist to close. A wait is clean when it carries a
+# timeout argument, rides the hedged reader / for_each_disk_quorum, or
+# argues its bound inline via `# check: allow(deadline) <reason>`.
+DEADLINE_HOT_MODULES = (
+    "minio_tpu/object/engine.py",
+    "minio_tpu/object/metadata.py",
+    "minio_tpu/object/multipart.py",
+    "minio_tpu/object/healing.py",
+    "minio_tpu/distributed/transport.py",
+    "minio_tpu/distributed/storage_rpc.py",
+    "minio_tpu/distributed/peer_rpc.py",
+)
+
+_UNBOUNDED_WAIT_ATTRS = {"recv", "recv_into"}
+
+
+def check_deadline(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    hot = set(DEADLINE_HOT_MODULES)
+    for src in sources:
+        if src.rel not in hot:
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr == "result":
+                bounded = bool(node.args) or any(
+                    kw.arg == "timeout" for kw in node.keywords)
+                if not bounded:
+                    out.append(Violation(
+                        "deadline", src.rel, node.lineno,
+                        "bare unbounded future .result() on a "
+                        "hot-path fan-out — pass a timeout, ride the "
+                        "hedged reader / for_each_disk_quorum lane, "
+                        "or argue the bound inline"))
+            elif attr in _UNBOUNDED_WAIT_ATTRS:
+                out.append(Violation(
+                    "deadline", src.rel, node.lineno,
+                    f"raw socket .{attr}() on a hot-path module — "
+                    "set a socket timeout and argue the bound inline"))
+    return out

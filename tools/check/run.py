@@ -26,6 +26,9 @@ Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
   crashpoint        multi-file commits in the designated commit modules
                     declare a registered crashpoint; hit() names are
                     registered literals; README crashpoint table fresh
+  deadline          hot-path shard fan-outs / internode waits carry an
+                    explicit deadline or ride the hedged reader /
+                    quorum-ack lane (bare .result()/recv flagged)
 """
 
 from __future__ import annotations
@@ -78,6 +81,8 @@ def run_checks(rules=None):
         points = set(crashtable.load_crashpoints().CRASHPOINTS)
         vs += rules_project.check_crashpoint(sources, points)
         vs += crashtable.check_drift()
+    if "deadline" in selected:
+        vs += rules_ast.check_deadline(sources)
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
