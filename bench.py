@@ -1597,6 +1597,121 @@ def bench_gray_ab(objects: int = 16, size: int = 1 << 20,
     return out
 
 
+def bench_partition_ab(peers: int = 3, rounds: int = 20,
+                       deadline: float = 1.0,
+                       payload_kb: int = 32) -> dict:
+    """Partition-tolerance A/B: cluster-wide metrics-scrape fan-out
+    latency in three phases — baseline, one peer partitioned away,
+    healed — over an in-process peer mesh driven by NaughtyNet.
+
+    The acceptance bar (asserted here, not just reported): under the
+    partition every fan-out stays bounded by the scrape DEADLINE (the
+    cut peer fails at the injected dial, then sheds without dialing —
+    never a TCP connect/read timeout), the reachable peers keep
+    serving, and the healed mesh returns to the full merge at
+    baseline-shaped latency."""
+    import threading as _threading  # noqa: F401 — parity with siblings
+
+    from minio_tpu.distributed import membership
+    from minio_tpu.distributed.naughtynet import NET
+    from minio_tpu.distributed.peer_rpc import (NotificationSys,
+                                                PeerRPCClient,
+                                                PeerRPCServer)
+    from minio_tpu.distributed.transport import RPCServer
+
+    ak, sk = "benchak", "benchsecret12345"
+    exposition = "".join(
+        f"# HELP bench_fake_{i} synthetic series\n"
+        f"bench_fake_{i}{{peer=\"x\"}} {i}\n"
+        for i in range(max(1, payload_kb * 1024 // 48)))
+
+    def pctls(xs: list) -> dict:
+        s = sorted(xs)
+        return {"p50_ms": round(s[len(s) // 2] * 1e3, 2),
+                "p99_ms": round(s[max(0, int(len(s) * .99) - 1)] * 1e3,
+                                2)}
+
+    out: dict = {"config": {"peers": peers, "rounds": rounds,
+                            "deadline_s": deadline,
+                            "payload_kb": payload_kb}}
+    NET.reset()
+    membership.TRACKER.reset()
+    hosts, clients = [], []
+    victim_id = ""
+    try:
+        for i in range(peers):
+            host = RPCServer().start()
+            nid = f"127.0.0.1:{host.port}"
+            srv = PeerRPCServer(ak, sk, node_id=nid)
+            srv.get_metrics_text = lambda: exposition
+            host.mount(srv.handler)
+            hosts.append(host)
+            clients.append(PeerRPCClient("127.0.0.1", host.port, ak, sk,
+                                         timeout=10.0,
+                                         node_id="bench-observer"))
+            if i == 0:
+                victim_id = nid
+        ns = NotificationSys(clients)
+
+        def phase(n: int) -> tuple[list, int, int]:
+            lat, ok, failed = [], 0, 0
+            for _ in range(n):
+                t0 = time.perf_counter()
+                res = ns.metrics_text_all(deadline=deadline)
+                lat.append(time.perf_counter() - t0)
+                ok += sum(1 for _a, txt in res if txt is not None)
+                failed += sum(1 for _a, txt in res if txt is None)
+            return lat, ok, failed
+
+        base_lat, base_ok, base_failed = phase(rounds)
+        assert base_failed == 0, "baseline scrape must be complete"
+        out["baseline"] = pctls(base_lat)
+
+        NET.partition("bench-observer", victim_id, oneway=True)
+        part_lat, part_ok, part_failed = phase(rounds)
+        out["partitioned"] = pctls(part_lat)
+        out["partitioned"]["scrapes_ok"] = part_ok
+        out["partitioned"]["scrapes_failed"] = part_failed
+        out["net_stats"] = dict(NET.stats)
+        # the cut peer failed every round; the rest kept serving
+        assert part_failed == rounds, \
+            f"cut peer must fail every round ({part_failed}/{rounds})"
+        assert part_ok == rounds * (peers - 1), \
+            "reachable peers must keep serving under the partition"
+        # bounded degradation: every degraded fan-out finished within
+        # the scrape deadline (+ scheduling slack) — the failure is the
+        # injected dial error + offline shed, never a TCP timeout
+        worst = max(part_lat)
+        assert worst < deadline + 1.0, \
+            f"degraded fan-out took {worst:.2f}s — TCP-timeout " \
+            "territory, not deadline-bounded"
+        # after the first refused dial the peer is shed WITHOUT dialing
+        assert NET.stats["blocked"] >= 1
+
+        NET.heal()
+        deadline_mono = time.monotonic() + 20.0
+        while not clients[0].rc.online:
+            if time.monotonic() > deadline_mono:
+                raise AssertionError("victim never re-admitted post-heal")
+            time.sleep(0.25)
+        heal_lat, heal_ok, heal_failed = phase(rounds)
+        assert heal_failed == 0, "healed mesh must restore the full merge"
+        out["healed"] = pctls(heal_lat)
+        out["partition_p99_bounded_by_deadline"] = \
+            out["partitioned"]["p99_ms"] < deadline * 1e3 + 1000.0
+        out["healed_vs_baseline_x"] = round(
+            out["healed"]["p99_ms"]
+            / max(out["baseline"]["p99_ms"], 1e-9), 2)
+    finally:
+        NET.reset()
+        membership.TRACKER.reset()
+        for c in clients:
+            c.close()
+        for h in hosts:
+            h.stop()
+    return out
+
+
 def bench_edge_ab(streams=(4, 16), size: int = 1 << 20,
                   rounds: int = 4, idle_conns: int = 400,
                   idle_ratio: int = 20, drives: int = 6,
@@ -2201,6 +2316,14 @@ def main() -> int:
                     "(default 0.5)")
     ap.add_argument("--ab-gray-smoke", action="store_true",
                     help="tiny CI variant of --ab-gray")
+    ap.add_argument("--ab-partition", action="store_true",
+                    help="partition-tolerance A/B: federated-scrape "
+                    "fan-out p50/p99 baseline vs one peer partitioned "
+                    "away vs healed; asserts the degraded fan-out is "
+                    "bounded by the scrape deadline, not TCP timeouts")
+    ap.add_argument("--ab-partition-smoke", action="store_true",
+                    help="tiny CI variant of --ab-partition (2 peers, "
+                    "6 rounds)")
     ap.add_argument("--ab-obs", action="store_true",
                     help="run ONLY the observability-plane A/B: "
                          "federated-scrape merge latency vs node "
@@ -2228,6 +2351,23 @@ def main() -> int:
             "value": ab.get("get_p99_speedup_x"),
             "unit": "x",
             "gray_ab": ab,
+        }))
+        return 0
+
+    if args.ab_partition or args.ab_partition_smoke:
+        if args.ab_partition_smoke:
+            ab = bench_partition_ab(peers=2, rounds=6, deadline=1.0,
+                                    payload_kb=8)
+        else:
+            ab = bench_partition_ab()
+        print(json.dumps({
+            "metric": "federated-scrape fan-out p99 with one peer "
+                      "partitioned away (deadline-bounded, reachable "
+                      "peers keep serving; heal restores the full "
+                      "merge)",
+            "value": ab["partitioned"]["p99_ms"],
+            "unit": "ms",
+            "partition_ab": ab,
         }))
         return 0
 

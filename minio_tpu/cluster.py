@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Optional
 
+from .distributed import membership
 from .distributed.local_locker import LocalLocker
 from .distributed.lock_rpc import LockRPCClient, LockRPCServer
 from .distributed.peer_rpc import (BootstrapRPCServer, NotificationSys,
@@ -78,6 +79,13 @@ class ClusterNode:
         self.creds = creds
         self.spec = nodes[this]
         self.distributed = len(nodes) > 1
+        # partition-tolerance plane identity: this process speaks as
+        # spec.addr; every RPC carries it + the boot generation so
+        # peers can fence stale per-peer state after a restart.
+        # (In-process multi-node tests boot several ClusterNodes per
+        # process — their handlers/clients carry explicit node_ids
+        # below, which win over this process-level fallback.)
+        membership.set_local_node(self.spec.addr)
 
         all_drives = [(ni, path) for ni, n in enumerate(nodes)
                       for path in n.drives]
@@ -105,10 +113,13 @@ class ClusterNode:
         self.locker = LocalLocker()
         ak, sk = creds.access_key, creds.secret_key
         self._storage_rpc = StorageRPCServer(self.local_drives, ak, sk)
+        self._storage_rpc.handler.node_id = self.spec.addr
         self._lock_rpc = LockRPCServer(self.locker, ak, sk)
+        self._lock_rpc.handler.node_id = self.spec.addr
         self._peer_rpc = PeerRPCServer(ak, sk, node_id=self.spec.addr)
         endpoints = [f"{n.addr}{p}" for n in nodes for p in n.drives]
         self._bootstrap_rpc = BootstrapRPCServer(ak, sk, endpoints)
+        self._bootstrap_rpc.handler.node_id = self.spec.addr
 
         # the S3 server carries every router (reference configureServerHandler)
         self.s3: Optional[S3Server] = None
@@ -148,6 +159,7 @@ class ClusterNode:
             else:
                 rc = RemoteStorage(nodes[ni].host, nodes[ni].port, path,
                                    ak, sk)
+                rc.rc.node_id = self.spec.addr
                 self._remote_clients.append(rc)
                 drives.append(rc)
 
@@ -159,6 +171,7 @@ class ClusterNode:
                     lockers.append(self.locker)
                 else:
                     lc = LockRPCClient(n.host, n.port, ak, sk)
+                    lc.rc.node_id = self.spec.addr
                     self._lock_clients.append(lc)
                     lockers.append(lc)
             ns_lock = DistNSLockMap(lockers, owner=self.spec.addr)
@@ -220,9 +233,31 @@ class ClusterNode:
             lambda b: self.s3.api.bucket_meta.get(b).policy_json
 
         # -- peer control plane hooks --------------------------------------
-        self._peer_clients = [PeerRPCClient(n.host, n.port, ak, sk)
+        self._peer_clients = [PeerRPCClient(n.host, n.port, ak, sk,
+                                            node_id=self.spec.addr)
                               for i, n in enumerate(nodes) if i != this]
         self.notification = NotificationSys(self._peer_clients)
+        # generation fencing, cluster edition: a peer that restarted
+        # (new boot generation) invalidated every grant/subscription it
+        # held for us — transport already clears its healthtrack windows
+        # and offline marker (import-time listener); here the cluster
+        # drops cached replication wire clients so the next replication
+        # op reconnects instead of riding a dead session
+        def _on_peer_restart(peer: str, _old: int, _new: int) -> None:
+            if self.s3 is None:        # shut down: stale listener, no-op
+                return
+            targets = getattr(self, "repl_targets", None)
+            if targets is not None:
+                with targets._mu:
+                    targets._clients.clear()
+            try:
+                self.console.log_line(
+                    "INFO", f"peer {peer} restarted (new generation); "
+                    "stale per-peer state reset")
+            except Exception:  # noqa: BLE001 — console not up yet
+                pass
+
+        membership.TRACKER.add_listener(_on_peer_restart)
         self._peer_rpc.get_locks = self.locker.dump
         self._peer_rpc.get_server_info = lambda: {
             "addr": self.spec.addr,

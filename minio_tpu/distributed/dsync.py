@@ -80,6 +80,30 @@ class DRWMutex:
 
     runlock = unlock
 
+    def check(self) -> bool:
+        """One SYNCHRONOUS refresh round: True while a quorum of
+        lockers still holds this grant. On quorum loss, `lock_lost`
+        latches — the fencing gate a holder returning from a partition
+        must consult before touching the protected resource, because
+        its lease may have expired and been re-granted while it was
+        away. The background refresh loop does the same every
+        REFRESH_INTERVAL; this is the on-demand edition for
+        commit-time fencing and tests."""
+        if not self._uid or self.lock_lost:
+            return False
+        alive = 0
+        for lk in self.lockers:
+            if lk is None:
+                continue
+            try:
+                if lk.refresh(self._uid, self.resources):
+                    alive += 1
+            except Exception:  # noqa: BLE001 — dead locker: no vote
+                pass
+        if alive < quorum_for(len(self.lockers), self._write):
+            self.lock_lost = True
+        return not self.lock_lost
+
     # -- internals ---------------------------------------------------------
 
     def _lock_blocking(self, write: bool, timeout: float,

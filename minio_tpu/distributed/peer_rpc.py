@@ -15,10 +15,18 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..utils import healthtrack, knobs, telemetry
 from .transport import NetworkError, RestClient, RPCError, RPCHandler
 
 PEER_RPC_PREFIX = "/minio/peer/v1"
 BOOTSTRAP_RPC_PREFIX = "/minio/bootstrap/v1"
+
+# per-peer partition sheds: fan-out calls that failed fast because the
+# peer's transport was already marked offline — bounded degradation's
+# "we didn't even dial" counter
+_PARTITION_SHED = telemetry.REGISTRY.counter(
+    "minio_tpu_net_partition_shed_total",
+    "Cross-peer fan-out calls shed fast (peer transport offline)")
 
 
 class PeerRPCServer:
@@ -27,7 +35,8 @@ class PeerRPCServer:
 
     def __init__(self, access_key: str, secret_key: str,
                  node_id: str = ""):
-        self.handler = RPCHandler(PEER_RPC_PREFIX, access_key, secret_key)
+        self.handler = RPCHandler(PEER_RPC_PREFIX, access_key,
+                                  secret_key, node_id=node_id)
         self.node_id = node_id
         self.started = time.time()
         # injectable hooks
@@ -182,11 +191,39 @@ class PeerRPCServer:
 
 class PeerRPCClient:
     def __init__(self, host: str, port: int, access_key: str,
-                 secret_key: str, timeout: float = 5.0):
+                 secret_key: str, timeout: float = 5.0,
+                 node_id: str = ""):
         self.rc = RestClient(host, port, PEER_RPC_PREFIX, access_key,
                              secret_key, timeout=timeout)
+        self.rc.node_id = node_id
+
+    def _shed(self) -> bool:
+        """Fail-fast gate for fan-out verbs: a peer whose transport is
+        already marked offline (partitioned / down) is shed without
+        dialing — counted per peer so a partition window is visible as
+        sheds, not as silent Nones."""
+        if self.rc.online:
+            return False
+        _PARTITION_SHED.inc(peer=self.addr)
+        return True
+
+    def _fanout_deadline(self, default: float) -> float:
+        """Healthtrack-derived deadline tightening: once this peer's
+        observed p99 is known, a fan-out should not wait the full
+        default on it — bounded degradation keys the wait to how the
+        peer actually behaves, floored so a healthy-but-busy peer is
+        not shed on one slow sample."""
+        x = knobs.get_float("MINIO_TPU_PEER_SHED_DEADLINE_X")
+        if x <= 0:
+            return default
+        p99 = healthtrack.TRACKER.percentile("peer", self.addr, 0.99)
+        if p99 is None:
+            return default
+        return max(0.5, min(default, p99 * x))
 
     def server_info(self) -> Optional[dict]:
+        if self._shed():
+            return None
         try:
             return self.rc.call_json("server-info")
         except (NetworkError, RPCError):
@@ -239,10 +276,13 @@ class PeerRPCClient:
     def metrics_text(self, deadline: float = 2.0) -> Optional[str]:
         """This peer's Prometheus text exposition, or None on failure
         — the federated scrape's per-peer pull, bounded by `deadline`
-        so one dead peer degrades the cluster scrape instead of
-        stalling it."""
+        (tightened further by the peer's observed latency) so one dead
+        peer degrades the cluster scrape instead of stalling it."""
+        if self._shed():
+            return None
         try:
-            out = self.rc.call("metrics-text", deadline=deadline)
+            out = self.rc.call("metrics-text",
+                               deadline=self._fanout_deadline(deadline))
         except (NetworkError, RPCError):
             return None
         try:
@@ -255,6 +295,8 @@ class PeerRPCClient:
         iterator of entry dicts (ends on peer death / stream close),
         or None when the peer is unreachable. `.close()` on the
         returned iterator tears the connection down."""
+        if self._shed():
+            return None
         try:
             resp = self.rc.call("trace-stream",
                                 {"max_s": str(max_s)},
@@ -265,12 +307,16 @@ class PeerRPCClient:
         return _TraceLineIter(resp, self.addr)
 
     def storage_info(self) -> dict:
+        if self._shed():
+            return {}
         try:
             return self.rc.call_json("storage-info") or {}
         except (NetworkError, RPCError):
             return {}
 
     def trace(self) -> list:
+        if self._shed():
+            return []
         try:
             return self.rc.call_json("trace") or []
         except (NetworkError, RPCError):
@@ -386,6 +432,7 @@ class _TraceLineIter:
                 # readline, not read(n): chunked read(n) waits for n
                 # bytes, and a mostly-idle peer trickles 1-byte
                 # heartbeats — lines must surface as they arrive
+                # check: allow(deadline) _resp is a _StreamedResponse; it arms the per-read socket deadline itself
                 line = self._resp.readline()
             except Exception:  # noqa: BLE001 — peer died: end of stream
                 raise StopIteration from None

@@ -39,6 +39,7 @@ import urllib.parse
 from typing import Callable, Optional
 
 from ..utils import backoff_delay, healthtrack, knobs, telemetry
+from . import membership, naughtynet
 
 DEFAULT_TIMEOUT = 30.0
 
@@ -166,6 +167,22 @@ def _note_host_alive(host: str, port: int,
             c._online = True        # the probe loop exits on this flag
 
 
+def _on_peer_generation_change(peer: str, old_gen: int,
+                               new_gen: int) -> None:
+    """Generation fencing, transport side: latency evidence and
+    offline markers gathered against the peer's PREVIOUS incarnation
+    must not poison the new one — a restarted peer neither inherits
+    its predecessor's slow-conviction windows nor stays dark for the
+    rest of a probe backoff."""
+    healthtrack.TRACKER.clear_samples("peer", peer)
+    host, _, port = peer.rpartition(":")
+    if host and port.isdigit():
+        _note_host_alive(host, int(port))
+
+
+membership.TRACKER.add_listener(_on_peer_generation_change)
+
+
 class RestClient:
     """One peer endpoint. call() POSTs a verb; on connection failure the
     host is marked offline and a background probe re-enables it."""
@@ -177,6 +194,10 @@ class RestClient:
         self.service_path = service_path.rstrip("/")
         self.access_key, self.secret_key = access_key, secret_key
         self.timeout = timeout
+        # owning node's id for membership headers and naughtynet rule
+        # matching; "" falls back to the process-local identity (one
+        # node per process — the subprocess/deployment case)
+        self.node_id = ""
         self._online = True
         self._mu = threading.Lock()
         self._prober: Optional[threading.Thread] = None
@@ -280,6 +301,18 @@ class RestClient:
     def _call_once(self, verb: str, args: Optional[dict], body,
                    stream_response: bool, body_length: Optional[int],
                    timeout: float):
+        if naughtynet.NET.enabled:
+            # deterministic chaos: a partitioned link fails like an
+            # unreachable host (conn failure → retries → offline), an
+            # armed delay schedule sleeps before the dial
+            act = naughtynet.NET.on_call(
+                self.node_id, f"{self.host}:{self.port}", verb)
+            if act.delay > 0:
+                time.sleep(act.delay)
+            if act.blocked:
+                raise NetworkError(
+                    f"naughtynet: link to {self.host}:{self.port} "
+                    "partitioned", conn_failure=True)
         qs = urllib.parse.urlencode(args or {})
         path = f"{self.service_path}/{verb}" + (f"?{qs}" if qs else "")
         if isinstance(body, (bytes, bytearray, memoryview)):
@@ -296,6 +329,13 @@ class RestClient:
                                        self.secret_key),
             "Content-Length": str(length),
         }
+        # membership: advertise who is calling and which incarnation,
+        # so the serving side positively detects our restarts
+        src_id = self.node_id or membership.local_node()
+        if src_id:
+            headers[membership.NODE_HEADER] = src_id
+        headers[membership.GEN_HEADER] = str(
+            membership.local_generation())
         cur = telemetry.current_span()
         if cur is not None:
             # propagate the trace identity so the serving side joins
@@ -305,6 +345,7 @@ class RestClient:
         try:
             conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
+            self._observe_peer_generation(resp)
             if resp.status != 200:
                 payload = resp.read()
                 conn.close()
@@ -313,6 +354,13 @@ class RestClient:
                 except ValueError:
                     err = None
                 if isinstance(err, dict):
+                    if err.get("kind") == naughtynet.PARTITIONED_KIND:
+                        # server-side injected drop: surface it exactly
+                        # like an unreachable host
+                        raise NetworkError(
+                            f"naughtynet: {self.host}:{self.port} "
+                            "dropped the call (partitioned)",
+                            conn_failure=True)
                     raise RPCError(err.get("kind", "error"),
                                    err.get("message", ""))
                 raise RPCError("http", f"status {resp.status}")
@@ -328,6 +376,20 @@ class RestClient:
             # the host offline (decided by call() after retries)
             raise NetworkError(str(e),
                                conn_failure=_is_conn_failure(e)) from e
+
+    def _observe_peer_generation(self, resp) -> None:
+        """Feed the membership tracker from a response's identity
+        headers — a changed boot generation fires the stale-state
+        fencing listeners (healthtrack windows, offline markers)."""
+        gen = resp.getheader(membership.GEN_HEADER)
+        if not gen:
+            return
+        try:
+            membership.TRACKER.observe(
+                f"{self.host}:{self.port}", int(gen),
+                resp.getheader(membership.NODE_HEADER) or "")
+        except ValueError:
+            pass
 
     def call_json(self, verb: str, args: Optional[dict] = None,
                   payload=None):
@@ -378,10 +440,19 @@ class RestClient:
                 delay = self._probe_delay
                 self._probe_delay = min(delay * 2, HEALTH_PROBE_MAX)
             time.sleep(delay * (0.75 + random.random() / 2))
+            if naughtynet.NET.enabled and naughtynet.NET.blocked(
+                    self.node_id or membership.local_node(),
+                    f"{self.host}:{self.port}"):
+                # the link is (chaos-)partitioned: the probe must not
+                # re-admit a host we cannot actually reach
+                continue
             try:
                 conn = http.client.HTTPConnection(self.host, self.port,
                                                   timeout=2.0)
-                conn.request("GET", self.service_path + "/health")
+                src_id = self.node_id or membership.local_node()
+                conn.request("GET", self.service_path + "/health",
+                             headers={membership.NODE_HEADER: src_id}
+                             if src_id else {})
                 resp = conn.getresponse()
                 resp.read()
                 conn.close()
@@ -407,13 +478,34 @@ class RestClient:
 
 
 class _StreamedResponse:
-    def __init__(self, conn, resp):
+    def __init__(self, conn, resp, read_timeout: Optional[float] = None):
         self._conn = conn
         self.resp = resp
+        # per-READ deadline: a peer that goes silent mid-stream
+        # (partition after headers) must fail the reader, not park it
+        # forever — armed on the socket before every read, so a
+        # trickling-but-alive stream resets it each time
+        self._read_timeout = (
+            knobs.get_float("MINIO_TPU_RPC_STREAM_READ_S")
+            if read_timeout is None else read_timeout)
+
+    def _arm_read_deadline(self) -> None:
+        sock = getattr(self._conn, "sock", None)
+        if sock is not None and self._read_timeout > 0:
+            sock.settimeout(self._read_timeout)
 
     def read(self, n: int = -1) -> bytes:
+        self._arm_read_deadline()
         try:
             return self.resp.read(n)
+        except socket.timeout as e:
+            # the peer went silent past the per-read deadline: that is
+            # an unreachable host, not a malformed response
+            self._conn.close()
+            raise NetworkError(
+                f"mid-stream: read deadline "
+                f"({self._read_timeout:g}s) exceeded",
+                conn_failure=True) from e
         except (OSError, http.client.HTTPException) as e:
             # a mid-stream disconnect is a RETRYABLE transport fault,
             # not a generic storage error — hedged readers re-read from
@@ -426,8 +518,15 @@ class _StreamedResponse:
         blocks until n bytes accumulate, which on a trickle stream
         (trace-follow heartbeats) means minutes — readline reads at
         most one chunk. Empty bytes = end of stream."""
+        self._arm_read_deadline()
         try:
             return self.resp.readline()
+        except socket.timeout as e:
+            self._conn.close()
+            raise NetworkError(
+                f"mid-stream: read deadline "
+                f"({self._read_timeout:g}s) exceeded",
+                conn_failure=True) from e
         except (OSError, http.client.HTTPException, ValueError) as e:
             self._conn.close()
             raise NetworkError(f"mid-stream: {e}") from e
@@ -449,9 +548,14 @@ class RPCHandler:
     serve standalone via serve().
     """
 
-    def __init__(self, prefix: str, access_key: str, secret_key: str):
+    def __init__(self, prefix: str, access_key: str, secret_key: str,
+                 node_id: str = ""):
         self.prefix = prefix.rstrip("/")
         self.access_key, self.secret_key = access_key, secret_key
+        # serving node's id ("" = process-local identity): stamped on
+        # every response so callers track our boot generation, and
+        # matched against inbound naughtynet partition rules
+        self.node_id = node_id
         self._verbs: dict[str, Callable] = {}
         self._stream_verbs: set[str] = set()
 
@@ -464,21 +568,55 @@ class RPCHandler:
         if stream_body:
             self._stream_verbs.add(verb)
 
+    def _identity_headers(self) -> dict:
+        out = {membership.GEN_HEADER: str(membership.local_generation())}
+        nid = self.node_id or membership.local_node()
+        if nid:
+            out[membership.NODE_HEADER] = nid
+        return out
+
     def route(self, ctx) -> "HTTPResponse":
         from ..s3.handlers import HTTPResponse
         path = ctx.req.path
         verb = path[len(self.prefix):].lstrip("/")
+        ident = self._identity_headers()
+        peer_id = ctx.header(membership.NODE_HEADER)
+        if naughtynet.NET.enabled:
+            # inbound chaos: a partitioned caller's verbs (health
+            # probes included) are dropped BEFORE dispatch — the
+            # PARTITIONED_KIND payload maps back to an unreachable-host
+            # failure on the calling side
+            act = naughtynet.NET.on_serve(
+                peer_id, self.node_id or membership.local_node(), verb)
+            if act.delay > 0:
+                time.sleep(act.delay)
+            if act.blocked:
+                return HTTPResponse(status=503, body=json.dumps(
+                    {"kind": naughtynet.PARTITIONED_KIND,
+                     "message": "inbound link partitioned"}).encode(),
+                    headers=ident)
         if verb == "health":
-            return HTTPResponse(body=b"OK")
+            return HTTPResponse(body=b"OK", headers=ident)
         auth = ctx.header("authorization")
         if not (auth.startswith("Bearer ") and verify_token(
                 auth[7:], self.access_key, self.secret_key)):
             return HTTPResponse(status=403, body=json.dumps(
-                {"kind": "auth", "message": "invalid token"}).encode())
+                {"kind": "auth", "message": "invalid token"}).encode(),
+                headers=ident)
+        # membership: a caller advertising a NEW boot generation is a
+        # fresh incarnation — fire the stale-state fencing listeners
+        peer_gen = ctx.header(membership.GEN_HEADER)
+        if peer_id and peer_gen:
+            try:
+                membership.TRACKER.observe(peer_id, int(peer_gen),
+                                           peer_id)
+            except ValueError:
+                pass
         fn = self._verbs.get(verb)
         if fn is None:
             return HTTPResponse(status=404, body=json.dumps(
-                {"kind": "unknown-verb", "message": verb}).encode())
+                {"kind": "unknown-verb", "message": verb}).encode(),
+                headers=ident)
         args = {k: v[0] for k, v in ctx.req.query.items()}
         body = ctx.body_stream if verb in self._stream_verbs \
             else ctx.read_body()
@@ -497,11 +635,12 @@ class RPCHandler:
                 out = fn(args, body)
         except Exception as e:  # noqa: BLE001 — serialize to the caller
             return HTTPResponse(status=500, body=json.dumps(
-                {"kind": type(e).__name__, "message": str(e)}).encode())
+                {"kind": type(e).__name__, "message": str(e)}).encode(),
+                headers=ident)
         if out is None:
-            return HTTPResponse(body=b"")
+            return HTTPResponse(body=b"", headers=ident)
         if isinstance(out, (bytes, bytearray)):
-            return HTTPResponse(body=bytes(out))
+            return HTTPResponse(body=bytes(out), headers=ident)
         if hasattr(out, "__next__") or hasattr(out, "read"):
             # streamed response (big shard reads): chunked on the wire
             if hasattr(out, "read"):
@@ -519,9 +658,17 @@ class RPCHandler:
                         if close is not None:
                             close()
                 out = gen()
-            return HTTPResponse(stream=out)
+            if naughtynet.NET.enabled:
+                # chaos may reset the stream after the first chunk or
+                # go silent when a partition opens mid-stream
+                out = naughtynet.NET.wrap_stream(
+                    peer_id, self.node_id or membership.local_node(),
+                    verb, out)
+            return HTTPResponse(stream=out, headers=ident)
+        headers = {"Content-Type": "application/json"}
+        headers.update(ident)
         return HTTPResponse(body=json.dumps(out).encode(),
-                            headers={"Content-Type": "application/json"})
+                            headers=headers)
 
 
 class RPCServer:

@@ -118,6 +118,14 @@ class AdminClient:
             q["tmp_age"] = str(tmp_age_s)
         return self._json("POST" if repair else "GET", "fsck", query=q)
 
+    def naughtynet(self, payload: dict) -> dict:
+        """Drive the node's network chaos injector (test-only; the node
+        must run with MINIO_TPU_NAUGHTYNET=on). ``payload`` is the
+        distributed/naughtynet admin op: {"op": "partition"|"heal"|
+        "configure"|"arm"|"disarm"|"status"|"reset", ...}."""
+        return self._json("POST", "naughtynet",
+                          body=json.dumps(payload).encode())
+
     def heal_start(self, bucket: str = "", prefix: str = "") -> str:
         out = self._json("POST", "heal",
                          {"bucket": bucket, "prefix": prefix})

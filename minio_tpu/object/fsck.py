@@ -43,7 +43,7 @@ from ..storage.xl_storage import (MINIO_META_BUCKET,
                                   MINIO_META_MULTIPART_BUCKET,
                                   MINIO_META_TMP_BUCKET,
                                   XL_STORAGE_FORMAT_FILE, XLStorage)
-from ..utils import atomicfile, knobs, telemetry
+from ..utils import atomicfile, knobs, regfence, telemetry
 from . import api_errors
 from .metacache import manifest_key, mc_prefix
 
@@ -63,6 +63,7 @@ CLASSES = (
     "dangling_stub",           # transitioned stub whose remote is gone
     "torn_registry",           # unparseable registry/checkpoint JSON copy
     "origin_divergence",       # replication origin markers disagree
+    "registry_epoch_fork",     # same epoch, divergent lineage (split brain)
 )
 
 # registry / checkpoint document prefixes audited per pool (the docs
@@ -189,6 +190,7 @@ def run_fsck(object_layer, repair: bool = False, tiers=None,
             all_buckets = [v.name for v in ss.list_buckets()]
         except api_errors.ObjectApiError:
             all_buckets = []
+        _audit_registry_forks(report, ss)
         for p, pool in enumerate(ss.server_sets):
             _audit_registry_docs(report, ss, p, pool)
             _audit_tmp(report, p, pool, tmp_age_s)
@@ -699,3 +701,78 @@ def _registry_drop(pool, key: str):
         except api_errors.ObjectApiError:
             pass
     return rm
+
+
+def _audit_registry_forks(report: FsckReport, ss) -> None:
+    """Split-brain detection across POOL copies of each lineage-fenced
+    registry doc: two copies claiming the same epoch with different
+    lineage hashes can only come from divergent histories (both sides
+    of a partition committed "the next epoch"). The epoch loaders pick
+    a deterministic winner but never merge — this audit is where the
+    fork becomes VISIBLE, and the repair is the explicit convergence:
+    the highest (epoch, writer, lineage) doc wins everywhere, each
+    losing copy is archived to ``<key>.fork-<lineage>`` in its pool
+    (never deleted — an operator can diff what the losing side
+    committed), then every pool is rewritten with the winner."""
+    pools = ss.server_sets
+    if len(pools) < 2:
+        return
+    keys: set[str] = set()
+    for pool in pools:
+        for prefix in REGISTRY_PREFIXES:
+            try:
+                keys.update(_list_meta_keys(pool, prefix))
+            except api_errors.ObjectApiError:
+                continue
+    for key in sorted(keys):
+        if ".fork-" in key:
+            continue                # archived losers are not re-audited
+        copies: list = []           # (pool_idx, doc, raw)
+        for q, pool in enumerate(pools):
+            try:
+                raw = _get_pool_bytes(pool, key)
+            except api_errors.ObjectApiError:
+                continue
+            doc = atomicfile.load_json_doc(raw)
+            if doc is None:         # torn copies: the torn_registry class
+                continue
+            copies.append((q, doc, raw))
+        docs = [doc for _q, doc, _raw in copies]
+        forks = regfence.find_forks(docs)
+        if not forks:
+            continue
+        winner = regfence.pick_best(docs)
+        win_lineage = str(winner.get("lineage", ""))
+        win_raw = next(raw for _q, doc, raw in copies if doc is winner)
+        forked = {str(d.get("lineage", ""))
+                  for pair in forks for d in pair}
+        losers = []                 # (pool_idx, lineage, raw)
+        seen: set = set()
+        for q, doc, raw in copies:
+            lin = str(doc.get("lineage", ""))
+            if lin == win_lineage or lin not in forked \
+                    or (q, lin) in seen:
+                continue
+            seen.add((q, lin))
+            losers.append((q, lin, raw))
+        if not losers:
+            continue
+
+        def converge(key=key, losers=losers, win_raw=win_raw):
+            for q, lin, raw in losers:
+                pools[q].put_object(MINIO_META_BUCKET,
+                                    f"{key}.fork-{lin}", raw)
+            for pool in pools:
+                pool.put_object(MINIO_META_BUCKET, key, win_raw)
+
+        report.add(Finding(
+            "registry_epoch_fork", losers[0][0], MINIO_META_BUCKET, key,
+            detail=f"epoch {int(winner.get('epoch', 0))} fork: winner "
+                   f"lineage {win_lineage} (writer "
+                   f"{winner.get('writer', '')!r}), "
+                   f"{len(losers)} losing cop"
+                   f"{'y' if len(losers) == 1 else 'ies'} on pool(s) "
+                   f"{sorted({q for q, _l, _r in losers})}; repair "
+                   "archives losers and converges every pool on the "
+                   "winner",
+            _repair=converge))

@@ -40,7 +40,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import atomicfile, crashpoint
+from ..utils import atomicfile, crashpoint, regfence
 from . import api_errors
 
 if TYPE_CHECKING:  # pragma: no cover — typing only
@@ -79,6 +79,12 @@ class TopologyMap:
         states += [POOL_ACTIVE] * (n_pools - len(states))
         self.states = states
         self.updated = time.time()
+        # lineage fencing (split-brain detection): every epoch commit
+        # chains a hash of (parent lineage, epoch, writer) — equal
+        # epochs from divergent histories are a detectable fork
+        self.writer = ""
+        self.parent_lineage = ""
+        self.lineage = ""
 
     # -- queries -----------------------------------------------------------
 
@@ -128,6 +134,7 @@ class TopologyMap:
             self.states[idx] = state
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             return self.epoch
 
     def add_pool(self, state: str = POOL_ACTIVE) -> int:
@@ -137,14 +144,25 @@ class TopologyMap:
             self.states.append(state)
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             return self.epoch
+
+    def _advance_lineage(self) -> None:
+        """Chain the fencing hash for the epoch just committed (caller
+        holds ``_mu``)."""
+        self.parent_lineage = self.lineage
+        self.writer = regfence.default_writer()
+        self.lineage = regfence.lineage(self.parent_lineage,
+                                        self.epoch, self.writer)
 
     # -- (de)serialization -------------------------------------------------
 
     def to_dict(self) -> dict:
         with self._mu:
             return {"epoch": self.epoch, "pools": list(self.states),
-                    "updated": self.updated}
+                    "updated": self.updated, "writer": self.writer,
+                    "parent_lineage": self.parent_lineage,
+                    "lineage": self.lineage}
 
     @classmethod
     def from_dict(cls, doc: dict, n_pools: int) -> "TopologyMap":
@@ -152,6 +170,9 @@ class TopologyMap:
                   for s in doc.get("pools", [])]
         tm = cls(n_pools, epoch=int(doc.get("epoch", 0)), states=states)
         tm.updated = float(doc.get("updated", time.time()))
+        tm.writer = str(doc.get("writer", ""))
+        tm.parent_lineage = str(doc.get("parent_lineage", ""))
+        tm.lineage = str(doc.get("lineage", ""))
         return tm
 
 
@@ -181,15 +202,19 @@ class TopologyStore:
                 landed += 1
             except Exception as e:  # noqa: BLE001 — per-pool durability
                 last = e
-        if landed == 0:
+        need = regfence.write_quorum(len(server_sets.server_sets))
+        if landed < need:
+            # refusing a minority-side epoch bump: a partitioned node
+            # must not commit a registry version most pools never saw
             raise TopologyError(
-                f"topology epoch {tmap.epoch} not persisted to any "
-                f"pool: {last!r}")
+                f"topology epoch {tmap.epoch} persisted to {landed} of "
+                f"{len(server_sets.server_sets)} pool(s), need {need}: "
+                f"{last!r}")
         return landed
 
     @staticmethod
     def load(server_sets: "ErasureServerSets") -> Optional[TopologyMap]:
-        best: Optional[dict] = None
+        docs: list[dict] = []
         for z in server_sets.server_sets:
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
@@ -199,9 +224,11 @@ class TopologyStore:
                 continue
             if doc is None:     # torn/truncated copy: other pools win
                 continue
-            if best is None or int(doc.get("epoch", 0)) > \
-                    int(best.get("epoch", 0)):
-                best = doc
+            docs.append(doc)
+        # deterministic winner across pool copies; same-epoch docs with
+        # different lineage are a FORK — fsck surfaces + repairs it,
+        # load never coin-flips (pick_best ranks identically everywhere)
+        best = regfence.pick_best(docs)
         if best is None:
             return None
         return TopologyMap.from_dict(best, len(server_sets.server_sets))

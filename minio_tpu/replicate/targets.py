@@ -24,7 +24,7 @@ import uuid as _uuid
 from typing import Optional
 
 from ..object import api_errors
-from ..utils import atomicfile, crashpoint
+from ..utils import atomicfile, crashpoint, regfence
 from ..storage.xl_storage import MINIO_META_BUCKET
 
 REPL_PREFIX = "replicate/"
@@ -112,6 +112,19 @@ class TargetRegistry:
         self.site_id = site_id or _uuid.uuid4().hex[:12]
         self.targets: dict[str, SiteTarget] = {}
         self._clients: dict[str, object] = {}
+        # lineage fencing: every epoch commit chains a hash of
+        # (parent lineage, epoch, writer) — see utils/regfence.py
+        self.writer = ""
+        self.parent_lineage = ""
+        self.lineage = ""
+
+    def _advance_lineage(self) -> None:
+        """Chain the fencing hash for the epoch just committed (caller
+        holds ``_mu``)."""
+        self.parent_lineage = self.lineage
+        self.writer = regfence.default_writer()
+        self.lineage = regfence.lineage(self.parent_lineage,
+                                        self.epoch, self.writer)
 
     # ------------------------------------------------------------------
     # CRUD
@@ -140,6 +153,7 @@ class TargetRegistry:
             self.targets[target.arn] = target
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             epoch = self.epoch
         try:
             self.save()
@@ -162,6 +176,7 @@ class TargetRegistry:
             self._clients.pop(arn, None)
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             epoch = self.epoch
         try:
             self.save()
@@ -249,7 +264,10 @@ class TargetRegistry:
             return {"epoch": self.epoch, "updated": self.updated,
                     "site_id": self.site_id,
                     "targets": [t.to_dict()
-                                for t in self.targets.values()]}
+                                for t in self.targets.values()],
+                    "writer": self.writer,
+                    "parent_lineage": self.parent_lineage,
+                    "lineage": self.lineage}
 
     def _pools(self):
         if self.obj is None:
@@ -273,10 +291,13 @@ class TargetRegistry:
                 landed += 1
             except Exception as e:  # noqa: BLE001 — per-pool durability
                 last = e
-        if landed == 0:
+        need = regfence.write_quorum(len(pools))
+        if landed < need:
+            # refusing a minority-side epoch bump (caller rolls back)
             raise ReplTargetError(
-                f"replication targets epoch {self.epoch} not persisted "
-                f"to any pool: {last!r}")
+                f"replication targets epoch {self.epoch} persisted to "
+                f"{landed} of {len(pools)} pool(s), need {need}: "
+                f"{last!r}")
         return landed
 
     def load(self) -> bool:
@@ -284,7 +305,7 @@ class TargetRegistry:
         pools); returns True when a doc was found. Live clients reset —
         wire targets reconstruct lazily, layer targets need
         set_client."""
-        best: Optional[dict] = None
+        docs: list[dict] = []
         for z in self._pools():
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET, TARGETS_OBJECT)
@@ -293,9 +314,10 @@ class TargetRegistry:
                 continue
             if doc is None:     # torn/truncated copy: other pools win
                 continue
-            if best is None or int(doc.get("epoch", 0)) > \
-                    int(best.get("epoch", 0)):
-                best = doc
+            docs.append(doc)
+        # deterministic winner; same-epoch/different-lineage copies are
+        # a fork fsck surfaces — load never coin-flips between them
+        best = regfence.pick_best(docs)
         if best is None:
             return False
         targets = {}
@@ -310,5 +332,8 @@ class TargetRegistry:
             self.updated = float(best.get("updated", time.time()))
             self.site_id = str(best.get("site_id", "")) or self.site_id
             self.targets = targets
+            self.writer = str(best.get("writer", ""))
+            self.parent_lineage = str(best.get("parent_lineage", ""))
+            self.lineage = str(best.get("lineage", ""))
             self._clients.clear()
         return True

@@ -367,6 +367,25 @@ class AdminHandlers:
                               buckets=[bucket] if bucket else None,
                               tmp_age_s=age if age >= 0 else None)
             return self._json(report.to_dict())
+        if sub == "naughtynet" and m == "POST":
+            # test-only network chaos control (distributed/naughtynet):
+            # the proc harness partitions/heals/configures a LIVE node's
+            # fault injector from outside the process. Gated off by
+            # default — a production node must not expose a verb that
+            # severs its own links
+            self._auth(ctx, "admin:ServerUpdate")
+            from ..utils import knobs as _knobs
+            if not _knobs.get_bool("MINIO_TPU_NAUGHTYNET"):
+                raise S3Error(
+                    "NotImplemented",
+                    "network chaos is disabled "
+                    "(MINIO_TPU_NAUGHTYNET=on enables this verb)")
+            from ..distributed import naughtynet as _nn
+            try:
+                payload = json.loads(ctx.read_body().decode() or "{}")
+                return self._json(_nn.handle_admin(payload))
+            except (ValueError, TypeError) as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
         if sub == "metacache" and m == "GET":
             # bucket metacache visibility (ROADMAP item 2 `mc.stats()`
             # remainder): per-bucket index state (entries, building/

@@ -19,7 +19,7 @@ import time
 from typing import Optional
 
 from ..object import api_errors
-from ..utils import atomicfile, crashpoint
+from ..utils import atomicfile, crashpoint, regfence
 from ..storage.xl_storage import MINIO_META_BUCKET
 from .client import TierClient, TierClientError, new_tier_client
 
@@ -73,6 +73,19 @@ class TierManager:
         self.updated = time.time()
         self.tiers: dict[str, TierConfig] = {}
         self._clients: dict[str, TierClient] = {}
+        # lineage fencing: every epoch commit chains a hash of
+        # (parent lineage, epoch, writer) — see utils/regfence.py
+        self.writer = ""
+        self.parent_lineage = ""
+        self.lineage = ""
+
+    def _advance_lineage(self) -> None:
+        """Chain the fencing hash for the epoch just committed (caller
+        holds ``_mu``)."""
+        self.parent_lineage = self.lineage
+        self.writer = regfence.default_writer()
+        self.lineage = regfence.lineage(self.parent_lineage,
+                                        self.epoch, self.writer)
 
     # ------------------------------------------------------------------
     # registry CRUD
@@ -93,6 +106,7 @@ class TierManager:
             self.tiers[cfg.name] = cfg
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             epoch = self.epoch
         try:
             self.save()
@@ -115,6 +129,7 @@ class TierManager:
             self._clients.pop(name, None)
             self.epoch += 1
             self.updated = time.time()
+            self._advance_lineage()
             epoch = self.epoch
         try:
             self.save()
@@ -174,7 +189,10 @@ class TierManager:
     def to_dict(self) -> dict:
         with self._mu:
             return {"epoch": self.epoch, "updated": self.updated,
-                    "tiers": [t.to_dict() for t in self.tiers.values()]}
+                    "tiers": [t.to_dict() for t in self.tiers.values()],
+                    "writer": self.writer,
+                    "parent_lineage": self.parent_lineage,
+                    "lineage": self.lineage}
 
     def _pools(self):
         if self.obj is None:
@@ -199,16 +217,18 @@ class TierManager:
                 landed += 1
             except Exception as e:  # noqa: BLE001 — per-pool durability
                 last = e
-        if landed == 0:
+        need = regfence.write_quorum(len(pools))
+        if landed < need:
+            # refusing a minority-side epoch bump (caller rolls back)
             raise TierConfigError(
-                f"tier config epoch {self.epoch} not persisted to any "
-                f"pool: {last!r}")
+                f"tier config epoch {self.epoch} persisted to {landed} "
+                f"of {len(pools)} pool(s), need {need}: {last!r}")
         return landed
 
     def load(self) -> bool:
         """Recover the newest persisted registry (highest epoch across
         pools); returns True when a doc was found."""
-        best: Optional[dict] = None
+        docs: list[dict] = []
         for z in self._pools():
             try:
                 _, stream = z.get_object(MINIO_META_BUCKET,
@@ -218,9 +238,10 @@ class TierManager:
                 continue
             if doc is None:     # torn/truncated copy: other pools win
                 continue
-            if best is None or int(doc.get("epoch", 0)) > \
-                    int(best.get("epoch", 0)):
-                best = doc
+            docs.append(doc)
+        # deterministic winner; same-epoch/different-lineage copies are
+        # a fork fsck surfaces — load never coin-flips between them
+        best = regfence.pick_best(docs)
         if best is None:
             return False
         tiers = {}
@@ -234,5 +255,8 @@ class TierManager:
             self.epoch = int(best.get("epoch", 0))
             self.updated = float(best.get("updated", time.time()))
             self.tiers = tiers
+            self.writer = str(best.get("writer", ""))
+            self.parent_lineage = str(best.get("parent_lineage", ""))
+            self.lineage = str(best.get("lineage", ""))
             self._clients.clear()
         return True

@@ -306,6 +306,26 @@ define("MINIO_TPU_QUAR_PROBES", "int", 3,
        "consecutive healthy probation probes before the heal-verified "
        "re-admission", _S)
 
+_S = "Partition tolerance"
+define("MINIO_TPU_NAUGHTYNET", "bool", False,
+       "`on` exposes the test-only naughtynet admin verb so harnesses "
+       "can partition a live node's internode transport", _S)
+define("MINIO_TPU_NAUGHTYNET_SEED", "int", 0,
+       "default seed for the naughtynet fault schedule (chaos tests "
+       "print the seed they armed)", _S, display="0")
+define("MINIO_TPU_RPC_STREAM_READ_S", "float", 30.0,
+       "per-read socket deadline on streamed RPC responses: a peer "
+       "that goes silent mid-stream fails the reader instead of "
+       "parking it forever (0 disables)", _S)
+define("MINIO_TPU_REGISTRY_WRITE_QUORUM", "str", "1",
+       "pools an epoch-registry write must land on before the commit "
+       "is acked: a count, or `majority` — below it the write refuses "
+       "instead of bumping the epoch on a minority side", _S)
+define("MINIO_TPU_PEER_SHED_DEADLINE_X", "float", 4.0,
+       "peer fan-out deadline tightening: effective deadline = min("
+       "default, observed peer p99 × this), floored at 0.5 s "
+       "(0 disables the healthtrack-derived tightening)", _S)
+
 _S = "Telemetry"
 define("MINIO_TPU_TRACE_SLOW_MS", "float", 500.0,
        "span trees at least this slow are always kept", _S)

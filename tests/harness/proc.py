@@ -48,16 +48,29 @@ class ProcNode:
 
     def __init__(self, workdir: str, n_drives: int = 4,
                  port: Optional[int] = None, name: str = "node",
-                 fsync: bool = True, pools: int = 1):
+                 fsync: bool = True, pools: int = 1,
+                 cluster_nodes: Optional[list[str]] = None,
+                 this: int = 0,
+                 extra_args: Optional[list[str]] = None):
         self.workdir = str(workdir)
         self.name = name
         self.n_drives = n_drives
         self.pools = pools
         self.port = port or free_port()
         self.fsync = fsync
+        # multi-node form: the full --node spec list (identical on
+        # every node) + this node's index; empty = single-node server
+        self.cluster_nodes = list(cluster_nodes or [])
+        self.this = this
+        self.extra_args = list(extra_args or [])
         self.proc: Optional[subprocess.Popen] = None
         self.log_path = os.path.join(self.workdir, f"{name}.log")
         os.makedirs(self.workdir, exist_ok=True)
+
+    @property
+    def addr(self) -> str:
+        """The node id this process speaks as on the cluster wire."""
+        return f"127.0.0.1:{self.port}"
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -92,8 +105,15 @@ class ProcNode:
               wait: bool = True, timeout: float = 90.0) -> "ProcNode":
         assert self.proc is None or self.proc.poll() is not None, \
             "node already running"
-        cmd = [sys.executable, "-m", "minio_tpu", "server",
-               *self.drives(0), "--address", f"127.0.0.1:{self.port}"]
+        if self.cluster_nodes:
+            cmd = [sys.executable, "-m", "minio_tpu", "server"]
+            for spec in self.cluster_nodes:
+                cmd += ["--node", spec]
+            cmd += ["--this", str(self.this)]
+        else:
+            cmd = [sys.executable, "-m", "minio_tpu", "server",
+                   *self.drives(0), "--address", f"127.0.0.1:{self.port}"]
+        cmd += self.extra_args
         for p in range(1, self.pools):
             base = os.path.join(self.workdir, f"{self.name}p{p}d")
             cmd += ["--pool",
@@ -183,6 +203,18 @@ class ProcNode:
             self.proc.send_signal(signal.SIGKILL)
             self.proc.wait(30)
 
+    def pause(self) -> None:
+        """SIGSTOP — the process freezes mid-flight (a GC-pause /
+        overloaded-VM stand-in): sockets stay open, peers see
+        timeouts, not resets. Pair with resume()."""
+        if self.alive():
+            self.proc.send_signal(signal.SIGSTOP)
+
+    def resume(self) -> None:
+        """SIGCONT a paused node."""
+        if self.alive():
+            self.proc.send_signal(signal.SIGCONT)
+
     def stop(self, timeout: float = 30.0) -> None:
         """Graceful SIGTERM stop (for seeding phases)."""
         if self.alive():
@@ -271,6 +303,11 @@ class ProcNode:
     def fsck(self, repair: bool = True) -> dict:
         return self.admin().fsck(repair=repair, tmp_age_s=0)
 
+    def naughtynet(self, payload: dict) -> dict:
+        """Drive this node's in-process network fault injector (the
+        node must run with MINIO_TPU_NAUGHTYNET=on in extra_env)."""
+        return self.admin().naughtynet(payload)
+
     def list_keys(self, bucket: str) -> list[str]:
         objs, _prefixes, _token = self.s3().list_objects_v2(bucket)
         return sorted(o["key"] for o in objs)
@@ -279,6 +316,58 @@ class ProcNode:
         """(key, size, etag) rows — the convergence-comparison form."""
         objs, _prefixes, _token = self.s3().list_objects_v2(bucket)
         return sorted((o["key"], o["size"], o["etag"]) for o in objs)
+
+
+def make_cluster(workdir: str, n_nodes: int = 2, n_drives: int = 4,
+                 parity: Optional[int] = None,
+                 set_drive_count: int = 0,
+                 extra_args: Optional[list[str]] = None
+                 ) -> list[ProcNode]:
+    """Build (without starting) a real-subprocess multi-node cluster:
+    every node gets the same --node spec list and its own --this index.
+    Drives live under workdir/<name>d<i> exactly like single-node
+    harness runs, so logs and data are inspectable after a failure."""
+    nodes = [ProcNode(workdir, n_drives=n_drives, name=f"n{i}")
+             for i in range(n_nodes)]
+    specs = []
+    for n in nodes:
+        spec = ",".join(n.drives(0))
+        specs.append(f"127.0.0.1:{n.port}={spec}")
+    args = list(extra_args or [])
+    if parity is not None:
+        args += ["--parity", str(parity)]
+    if set_drive_count:
+        args += ["--set-drive-count", str(set_drive_count)]
+    for i, n in enumerate(nodes):
+        n.cluster_nodes = specs
+        n.this = i
+        n.extra_args = args
+    return nodes
+
+
+def partition(a: ProcNode, b: ProcNode, oneway: bool = False) -> None:
+    """Sever the a<->b link on BOTH processes' injectors (each side
+    blocks its own outbound AND refuses the other's inbound — the
+    partition holds regardless of which side initiates a call).
+    ``oneway=True`` models an asymmetric failure: a can reach b, b
+    cannot reach a."""
+    if not oneway:
+        a.naughtynet({"op": "partition", "src": a.addr, "dst": b.addr})
+        b.naughtynet({"op": "partition", "src": a.addr, "dst": b.addr})
+        return
+    # one-way b->a dead: b blocks its outbound to a, a refuses b's
+    # inbound; the a->b direction stays untouched on both sides
+    b.naughtynet({"op": "partition", "src": b.addr, "dst": a.addr,
+                  "oneway": True})
+    a.naughtynet({"op": "partition", "src": b.addr, "dst": a.addr,
+                  "oneway": True})
+
+
+def heal(*nodes: ProcNode) -> None:
+    """Clear every partition rule on the given nodes."""
+    for n in nodes:
+        if n.alive():
+            n.naughtynet({"op": "heal"})
 
 
 def expect_request_death(fn) -> None:

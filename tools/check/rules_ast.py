@@ -587,6 +587,29 @@ DEADLINE_HOT_MODULES = (
 
 _UNBOUNDED_WAIT_ATTRS = {"recv", "recv_into"}
 
+# streamed-RPC body reads: a peer that goes silent after sending its
+# headers parks a bare http resp.read()/readline() forever — the
+# connection timeout only covers the DIAL. Every such read in a hot
+# module must sit in a function that arms a per-read socket deadline
+# (settimeout / _arm_read_deadline) or builds the connection with an
+# explicit timeout (whole-body reads under the request window).
+_STREAM_READ_ATTRS = {"read", "readline"}
+
+
+def _read_deadline_armed(fn) -> bool:
+    if fn is None:
+        return False
+    for c in ast.walk(fn):
+        if not isinstance(c, ast.Call):
+            continue
+        tail = dotted(c.func).rsplit(".", 1)[-1]
+        if tail in ("settimeout", "_arm_read_deadline"):
+            return True
+        if tail == "HTTPConnection" and any(
+                kw.arg == "timeout" for kw in c.keywords):
+            return True
+    return False
+
 
 def check_deadline(sources: List[Source]) -> List[Violation]:
     out: List[Violation] = []
@@ -594,11 +617,24 @@ def check_deadline(sources: List[Source]) -> List[Violation]:
     for src in sources:
         if src.rel not in hot:
             continue
+        enclosing = enclosing_functions(src.tree)
         for node in ast.walk(src.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)):
                 continue
             attr = node.func.attr
+            if attr in _STREAM_READ_ATTRS:
+                recv = dotted(node.func.value)
+                if recv.endswith("resp") and \
+                        not _read_deadline_armed(enclosing.get(node)):
+                    out.append(Violation(
+                        "deadline", src.rel, node.lineno,
+                        f"{recv}.{attr}() without a read deadline — a "
+                        "peer going silent mid-stream parks this "
+                        "forever; arm the socket (settimeout / "
+                        "_arm_read_deadline) or bound the connection, "
+                        "or argue the bound inline"))
+                continue
             if attr == "result":
                 bounded = bool(node.args) or any(
                     kw.arg == "timeout" for kw in node.keywords)

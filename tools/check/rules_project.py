@@ -431,3 +431,71 @@ def check_crashpoint(sources: List[Source],
                     "point through the window or argue the exemption "
                     "inline"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: fencing
+# ---------------------------------------------------------------------------
+
+# The epoch-versioned registries: one doc written to every pool,
+# recovered highest-wins. Without lineage fencing that recovery is a
+# coin flip under a partition (two sides committing "the same" epoch).
+# Every save/load/merge site in these modules must go through
+# utils/regfence (advance the hash chain on bump, quorum-gate the
+# write, pick_best on load) — or argue the exemption inline via
+# `# check: allow(fencing) <reason>`.
+REGFENCE_MODULES = (
+    "minio_tpu/object/topology.py",
+    "minio_tpu/tier/config.py",
+    "minio_tpu/replicate/targets.py",
+)
+
+_REGFENCE_GATE_FNS = ("save", "load")
+
+
+def _calls_regfence(fn: ast.AST) -> bool:
+    for c in ast.walk(fn):
+        if not isinstance(c, ast.Call):
+            continue
+        d = dotted(c.func)
+        if "regfence." in d or d.rsplit(".", 1)[-1] == \
+                "_advance_lineage":
+            return True
+    return False
+
+
+def check_fencing(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    targeted = set(REGFENCE_MODULES)
+    for src in sources:
+        if src.rel not in targeted:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            # (1) registry persistence/recovery goes through regfence
+            if node.name in _REGFENCE_GATE_FNS:
+                if not _calls_regfence(node):
+                    out.append(Violation(
+                        "fencing", src.rel, node.lineno,
+                        f"{node.name}() persists/recovers an epoch "
+                        "registry without utils/regfence — quorum-gate "
+                        "the write (write_quorum) / rank the copies "
+                        "(pick_best), or argue the exemption inline"))
+                continue
+            # (2) every epoch bump advances the lineage hash chain
+            bumps = any(
+                isinstance(c, ast.AugAssign)
+                and isinstance(c.op, ast.Add)
+                and dotted(c.target).endswith(".epoch")
+                for c in ast.walk(node))
+            if bumps and not _calls_regfence(node):
+                out.append(Violation(
+                    "fencing", src.rel, node.lineno,
+                    f"{node.name}() bumps a registry epoch without "
+                    "advancing the lineage chain — equal epochs from "
+                    "divergent histories become an undetectable "
+                    "split-brain; call _advance_lineage() under the "
+                    "same lock or argue the exemption inline"))
+    return out

@@ -28,7 +28,11 @@ Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
                     registered literals; README crashpoint table fresh
   deadline          hot-path shard fan-outs / internode waits carry an
                     explicit deadline or ride the hedged reader /
-                    quorum-ack lane (bare .result()/recv flagged)
+                    quorum-ack lane (bare .result()/recv flagged);
+                    streamed RPC body reads arm a per-read deadline
+  fencing           epoch-registry save/load/bump sites go through
+                    utils/regfence (lineage chain, write quorum,
+                    deterministic pick_best) — split-brain safety
 """
 
 from __future__ import annotations
@@ -83,6 +87,8 @@ def run_checks(rules=None):
         vs += crashtable.check_drift()
     if "deadline" in selected:
         vs += rules_ast.check_deadline(sources)
+    if "fencing" in selected:
+        vs += rules_project.check_fencing(sources)
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
