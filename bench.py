@@ -1397,6 +1397,256 @@ def bench_cache_ab(objects: int = 16, size: int = 4 << 20,
     return out
 
 
+def bench_sse_ab(streams=(1, 2, 4), size: int = 4 << 20,
+                 objects: int = 3, drives: int = 6, parity: int = 2,
+                 block: int = 1 << 17) -> dict:
+    """Encrypted data-path A/B: device-fused cipher+RS+digest PUT (one
+    launch per batch, ops/chacha20_jax inside the batch former) and the
+    fused verify+decipher GET, vs the CPU ChaCha20 fallback.
+
+    Each pass runs every concurrency point: N writers PUT `objects`
+    objects each under DIFFERENT object keys — cross-request coalescing
+    of encrypted batches is exactly what the geometry-keyed scheduler
+    bucket buys — then read everything back through the
+    verify-then-decrypt seam and byte-check against the plaintext.
+    The device pass pins the fused route (TPU flag + DEVICE_MIN_BYTES=0;
+    on a CPU-only host the same XLA programs run on the host backend, so
+    the A/B measures program fusion + batching, not silicon) and reports
+    launch/coalescing counter deltas plus the queue/transfer/compute/
+    fetch dispatch attribution the scheduler histograms collect."""
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.features import crypto as sse
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object import engine as engine_mod
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.parallel.scheduler import BatchScheduler
+    from minio_tpu.utils import telemetry
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    out: dict = {"config": {"streams": list(streams), "size": size,
+                            "objects": objects, "drives": drives,
+                            "m": parity, "block": block},
+                 "cpu": [], "device": []}
+    was_tpu = codec_mod._IS_TPU
+    was_min = codec_mod.DEVICE_MIN_BYTES
+    was_attrib = os.environ.get("MINIO_TPU_SCHED_ATTRIB")
+    was_win = os.environ.get("MINIO_TPU_SSE_DEVICE_MIN_BYTES")
+    os.environ["MINIO_TPU_SCHED_ATTRIB"] = "1"
+    os.environ["MINIO_TPU_SSE_DEVICE_MIN_BYTES"] = "0"
+    pt = os.urandom(size)
+    try:
+        for mode in ("cpu", "device"):
+            codec_mod._IS_TPU = mode == "device"
+            codec_mod.DEVICE_MIN_BYTES = 0 if mode == "device" \
+                else (1 << 60)
+            for ns in streams:
+                root = tempfile.mkdtemp(prefix="bench_sse_", dir=base)
+                sched = BatchScheduler()
+                sets_ = None
+                try:
+                    sets_ = ErasureSets.from_drives(
+                        [f"{root}/d{i}" for i in range(drives)], 1,
+                        drives, parity, block_size=block,
+                        enable_mrf=False, scheduler=sched)
+                    sets_.make_bucket("bench")
+                    oeks = [os.urandom(32) for _ in range(ns)]
+                    bases = [os.urandom(12) for _ in range(ns)]
+                    # jit warmup outside the timed window
+                    sets_.put_object(
+                        "bench", "warm", pt,
+                        opts=engine_mod.PutOptions(
+                            sse_spec=sse.DeviceSSE(oeks[0], bases[0])))
+                    b0, c0 = sched.batches, sched.coalesced
+                    barrier = threading.Barrier(ns)
+                    errs: list = []
+
+                    def put_worker(t: int) -> None:
+                        try:
+                            barrier.wait()
+                            for i in range(objects):
+                                sets_.put_object(
+                                    "bench", f"o-{t}-{i}", pt,
+                                    opts=engine_mod.PutOptions(
+                                        sse_spec=sse.DeviceSSE(
+                                            oeks[t], bases[t])))
+                        except Exception as exc:  # noqa: BLE001
+                            errs.append(exc)
+
+                    ts = [threading.Thread(target=put_worker, args=(t,))
+                          for t in range(ns)]
+                    t0 = time.perf_counter()
+                    for th in ts:
+                        th.start()
+                    for th in ts:
+                        th.join()
+                    put_wall = time.perf_counter() - t0
+                    if errs:
+                        raise errs[0]
+
+                    def get_worker(t: int) -> None:
+                        try:
+                            barrier.wait()
+                            for i in range(objects):
+                                name = f"o-{t}-{i}"
+
+                                def fetch(off, ln, _n=name):
+                                    _, it = sets_.get_object(
+                                        "bench", _n, off, ln)
+                                    return it
+
+                                got = b"".join(sse.chacha_decrypt_ranged(
+                                    fetch, sse.encrypted_size(size),
+                                    oeks[t], bases[t], 0, size))[:size]
+                                assert got == pt, "A/B byte mismatch"
+                        except Exception as exc:  # noqa: BLE001
+                            errs.append(exc)
+
+                    ts = [threading.Thread(target=get_worker, args=(t,))
+                          for t in range(ns)]
+                    t0 = time.perf_counter()
+                    for th in ts:
+                        th.start()
+                    for th in ts:
+                        th.join()
+                    get_wall = time.perf_counter() - t0
+                    if errs:
+                        raise errs[0]
+                    nbytes = ns * objects * size
+                    out[mode].append({
+                        "streams": ns,
+                        "put_gib_s": round(nbytes / put_wall / (1 << 30),
+                                           4),
+                        "get_gib_s": round(nbytes / get_wall / (1 << 30),
+                                           4),
+                        "launches": sched.batches - b0,
+                        "coalesced": sched.coalesced - c0,
+                    })
+                finally:
+                    if sets_ is not None:
+                        sets_.close()
+                    sched.close()
+                    shutil.rmtree(root, ignore_errors=True)
+        # compressed+encrypted at the max concurrency point: the
+        # handler's exact transform chain — the snappy compressor
+        # stays a host stage and its OUTPUT is the plaintext the
+        # engine ciphers in-batch (fused or fallback per mode)
+        from minio_tpu.features.snappy import (SnappyFramedCompress,
+                                               decompress_stream)
+        pt_c = (b"minio tpu sse device data path " * 97)[:4096]
+        pt_c = pt_c * max(1, size // len(pt_c))
+        ns = max(streams)
+        for mode in ("cpu", "device"):
+            codec_mod._IS_TPU = mode == "device"
+            codec_mod.DEVICE_MIN_BYTES = 0 if mode == "device" \
+                else (1 << 60)
+            root = tempfile.mkdtemp(prefix="bench_sse_", dir=base)
+            sched = BatchScheduler()
+            sets_ = None
+            try:
+                sets_ = ErasureSets.from_drives(
+                    [f"{root}/d{i}" for i in range(drives)], 1,
+                    drives, parity, block_size=block,
+                    enable_mrf=False, scheduler=sched)
+                sets_.make_bucket("bench")
+                oeks = [os.urandom(32) for _ in range(ns)]
+                bases = [os.urandom(12) for _ in range(ns)]
+                comp = SnappyFramedCompress()
+                clen = len(comp.update(pt_c) + comp.finalize())
+                barrier = threading.Barrier(ns)
+                errs: list = []
+
+                def cput(t: int) -> None:
+                    try:
+                        barrier.wait()
+                        for i in range(objects):
+                            c = SnappyFramedCompress()
+                            body = c.update(pt_c) + c.finalize()
+                            sets_.put_object(
+                                "bench", f"c-{t}-{i}", body,
+                                opts=engine_mod.PutOptions(
+                                    sse_spec=sse.DeviceSSE(
+                                        oeks[t], bases[t])))
+                    except Exception as exc:  # noqa: BLE001
+                        errs.append(exc)
+
+                ts = [threading.Thread(target=cput, args=(t,))
+                      for t in range(ns)]
+                t0 = time.perf_counter()
+                for th in ts:
+                    th.start()
+                for th in ts:
+                    th.join()
+                put_wall = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+
+                def cget(t: int) -> None:
+                    try:
+                        barrier.wait()
+                        for i in range(objects):
+                            name = f"c-{t}-{i}"
+
+                            def fetch(off, ln, _n=name):
+                                _, it = sets_.get_object(
+                                    "bench", _n, off, ln)
+                                return it
+
+                            ct = sse.chacha_decrypt_ranged(
+                                fetch, sse.encrypted_size(clen),
+                                oeks[t], bases[t], 0, clen)
+                            got = b"".join(decompress_stream(ct))
+                            assert got == pt_c, "A/B byte mismatch"
+                    except Exception as exc:  # noqa: BLE001
+                        errs.append(exc)
+
+                ts = [threading.Thread(target=cget, args=(t,))
+                      for t in range(ns)]
+                t0 = time.perf_counter()
+                for th in ts:
+                    th.start()
+                for th in ts:
+                    th.join()
+                get_wall = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+                nbytes = ns * objects * len(pt_c)   # plaintext rate
+                out[f"{mode}_compressed"] = {
+                    "streams": ns, "ratio": round(len(pt_c) / clen, 2),
+                    "put_gib_s": round(nbytes / put_wall / (1 << 30),
+                                       4),
+                    "get_gib_s": round(nbytes / get_wall / (1 << 30),
+                                       4),
+                }
+            finally:
+                if sets_ is not None:
+                    sets_.close()
+                sched.close()
+                shutil.rmtree(root, ignore_errors=True)
+        snap = telemetry.REGISTRY.snapshot(
+            "minio_tpu_device_dispatch_seconds")
+        out["dispatch_stage_seconds"] = snap.get(
+            "minio_tpu_device_dispatch_seconds", {})
+        last_cpu, last_dev = out["cpu"][-1], out["device"][-1]
+        out["put_speedup_x"] = round(
+            last_dev["put_gib_s"] / max(last_cpu["put_gib_s"], 1e-9), 2)
+        out["get_speedup_x"] = round(
+            last_dev["get_gib_s"] / max(last_cpu["get_gib_s"], 1e-9), 2)
+    finally:
+        codec_mod._IS_TPU = was_tpu
+        codec_mod.DEVICE_MIN_BYTES = was_min
+        for k, v in (("MINIO_TPU_SCHED_ATTRIB", was_attrib),
+                     ("MINIO_TPU_SSE_DEVICE_MIN_BYTES", was_win)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def bench_gray_ab(objects: int = 16, size: int = 1 << 20,
                   gets: int = 60, streams: int = 4, drives: int = 6,
                   parity: int = 2, block: int = 1 << 17,
@@ -2279,6 +2529,12 @@ def main() -> int:
     ap.add_argument("--ab-select-smoke", action="store_true",
                     help="tiny Select A/B (2 points, 3000-row corpus) "
                          "for CI — seconds, not minutes")
+    ap.add_argument("--ab-sse", action="store_true",
+                    help="encrypted PUT+GET A/B: device-fused "
+                    "cipher+RS+digest data path vs the CPU ChaCha20 "
+                    "fallback, with launch/coalescing counters")
+    ap.add_argument("--ab-sse-smoke", action="store_true",
+                    help="tiny CI variant of --ab-sse")
     ap.add_argument("--ab-cache", action="store_true",
                     help="run ONLY the hot-GET A/B (erasure read path "
                          "with the hot-object read cache off vs on, "
@@ -2459,6 +2715,22 @@ def main() -> int:
             "value": ab.get("max_speedup_x"),
             "unit": "x",
             "select_ab": ab,
+        }))
+        return 0
+
+    if args.ab_sse or args.ab_sse_smoke:
+        if args.ab_sse_smoke:
+            ab = bench_sse_ab(streams=(1, 2), size=1 << 18, objects=2,
+                              drives=6, parity=2, block=1 << 16)
+        else:
+            ab = bench_sse_ab()
+        print(json.dumps({
+            "metric": "encrypted PUT throughput, device-fused "
+                      "cipher+RS+digest path vs CPU cipher fallback "
+                      "(max concurrency point)",
+            "value": ab.get("put_speedup_x"),
+            "unit": "x",
+            "sse_ab": ab,
         }))
         return 0
 

@@ -14,10 +14,34 @@ compression. The ETag stays the MD5 of the CLIENT bytes: PutObjReader
 pairs the raw hashing reader with the transformed stream (reference
 PutObjReader, cmd/object-api-utils.go).
 
+Two package ciphers (MINIO_TPU_SSE_CIPHER picks for NEW writes; reads
+dispatch on the per-object X-Minio-Internal-Sse-Cipher record):
+
+  * AES-256-GCM (default, `cryptography`-backed): interleaved
+    ct||tag packages — the original on-disk format, unchanged.
+  * ChaCha20-Poly1305 (`chacha20`, self-contained ops/chacha20_ref +
+    device kernel ops/chacha20_jax): DETACHED tags — the stored stream
+    is the pure ChaCha20 ciphertext (1:1 offsets with the plaintext)
+    followed by a trailer of 16-byte Poly1305 tags, one per package.
+    The detached layout is what lets the PUT batch fuse cipher +
+    RS-encode + bitrot digest into ONE device launch: the kernel
+    produces only keystream XOR, and the host authenticates the
+    device-returned ciphertext (tag trailer) before commit — no
+    laundered auth. Both the CPU transform (ChaChaEncryptor) and the
+    device path (DeviceSSE + models/pipeline.sse_put_step) produce the
+    SAME bytes, so either side can read the other's objects and
+    `MINIO_TPU_SSE_DEVICE=off` is a pure routing switch.
+
+This module owns ALL SSE nonce derivation (base^seq package nonces,
+HMAC per-part bases) and is the only sanctioned caller of the AEAD
+primitives — tools/check's crypto-hygiene rule fails any other module
+that derives an SSE nonce or touches the primitives directly.
+
 Internal metadata keys (never exposed over the API):
     X-Minio-Internal-Sse:             "S3" | "C"
     X-Minio-Internal-Sse-Sealed-Key:  base64(nonce||ct||tag) of the OEK
     X-Minio-Internal-Sse-Iv:          base64 12-byte package nonce base
+    X-Minio-Internal-Sse-Cipher:      "CHACHA20-POLY1305" (absent = AES)
     X-Minio-Internal-Sse-Key-Md5:     SSE-C client key MD5 (verification)
     X-Minio-Internal-compression:     "klauspost/compress/s2" | "zstd"
     X-Minio-Internal-actual-size:     plaintext byte count
@@ -39,6 +63,11 @@ TAG_SIZE = 16
 _AAD = b"minio-tpu-dare-v1"
 
 MK_SSE = "X-Minio-Internal-Sse"
+MK_CIPHER = "X-Minio-Internal-Sse-Cipher"
+
+# MK_CIPHER values; absent means AES (every pre-chacha object)
+CIPHER_AES = "AES256-GCM"
+CIPHER_CHACHA = "CHACHA20-POLY1305"
 MK_SSE_MP = "X-Minio-Internal-Sse-Multipart"
 MK_SEALED = "X-Minio-Internal-Sse-Sealed-Key"
 MK_IV = "X-Minio-Internal-Sse-Iv"
@@ -96,13 +125,41 @@ def encrypted_size(n: int) -> int:
     return n + TAG_SIZE * (-(-n // PKG_SIZE))
 
 
-def seal_key(sealing_key: bytes, oek: bytes) -> bytes:
+def seal_key(sealing_key: bytes, oek: bytes,
+             cipher: str = CIPHER_AES) -> bytes:
+    """Seal the OEK under `sealing_key`; same nonce||ct||tag layout for
+    both ciphers, so MK_SEALED stays one opaque blob."""
     nonce = secrets.token_bytes(12)
+    if cipher == CIPHER_CHACHA:
+        from ..ops import chacha20_ref as _c20
+        ct, tag = _c20.seal_detached(sealing_key, nonce, _AAD, oek)
+        return nonce + ct + tag
     return nonce + _aesgcm(sealing_key).encrypt(nonce, oek, _AAD)
 
 
-def unseal_key(sealing_key: bytes, sealed: bytes) -> bytes:
+def unseal_key(sealing_key: bytes, sealed: bytes,
+               cipher: str = CIPHER_AES) -> bytes:
+    if cipher == CIPHER_CHACHA:
+        from ..ops import chacha20_ref as _c20
+        return _c20.open_detached(sealing_key, sealed[:12], _AAD,
+                                  sealed[12:-TAG_SIZE],
+                                  sealed[-TAG_SIZE:])
     return _aesgcm(sealing_key).decrypt(sealed[:12], sealed[12:], _AAD)
+
+
+def stored_sse_cipher(md: dict) -> str:
+    """The package cipher an encrypted object was written with."""
+    return md.get(MK_CIPHER) or CIPHER_AES
+
+
+def sse_cipher_for_new_writes() -> str:
+    """MINIO_TPU_SSE_CIPHER: `chacha20` opts new writes into the
+    device-fusable ChaCha20-Poly1305 packages; anything else keeps the
+    AES-256-GCM default."""
+    from ..utils import knobs
+    v = knobs.get_str("MINIO_TPU_SSE_CIPHER").strip().lower()
+    return CIPHER_CHACHA if v in ("chacha20", "chacha20-poly1305",
+                                  "chacha") else CIPHER_AES
 
 
 # ---------------------------------------------------------------------------
@@ -170,6 +227,283 @@ def decrypt_stream(chunks: Iterator[bytes], oek: bytes, nonce_base: bytes,
     if buf:
         yield gcm.decrypt(_pkg_nonce(nonce_base, seq), buf,
                           _AAD + seq.to_bytes(8, "little"))
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20-Poly1305 packages (detached tags; device-fusable)
+# ---------------------------------------------------------------------------
+
+def _pkg_aad(seq: int) -> bytes:
+    return _AAD + seq.to_bytes(8, "little")
+
+
+def chacha_ct_len(stored: int) -> tuple[int, int]:
+    """(ciphertext length, package count) of a chacha object from its
+    stored size — stored = ct ‖ 16·npkg tag trailer, and every package
+    but the last is full, so npkg = ceil(stored / (PKG+TAG))."""
+    if stored <= 0:
+        return 0, 0
+    npkg = -(-stored // (PKG_SIZE + TAG_SIZE))
+    return stored - TAG_SIZE * npkg, npkg
+
+
+class ChaChaEncryptor:
+    """ChaCha20-Poly1305 package stream, detached-tag form: update()
+    emits pure ciphertext (offset-preserving), finalize() emits the
+    final partial package plus the tag trailer. The CPU byte-identity
+    oracle of the device path (DeviceSSE produces the same stream)."""
+
+    def __init__(self, oek: bytes, nonce_base: bytes):
+        self._key = oek
+        self._base = nonce_base
+        self._buf = b""
+        self._seq = 0
+        self._tags: list[bytes] = []
+
+    def _seal(self, pt: bytes) -> bytes:
+        from ..ops import chacha20_ref as _c20
+        ct, tag = _c20.seal_detached(
+            self._key, _pkg_nonce(self._base, self._seq),
+            _pkg_aad(self._seq), pt)
+        self._tags.append(tag)
+        self._seq += 1
+        return ct
+
+    def update(self, data: bytes) -> bytes:
+        self._buf += data
+        out = b""
+        while len(self._buf) >= PKG_SIZE:
+            out += self._seal(self._buf[:PKG_SIZE])
+            self._buf = self._buf[PKG_SIZE:]
+        return out
+
+    def finalize(self) -> bytes:
+        out = self._seal(self._buf) if self._buf else b""
+        self._buf = b""
+        return out + b"".join(self._tags)
+
+
+def _sse_device_get() -> bool:
+    """Whether GET decipher batches may launch on the device."""
+    from ..utils import knobs
+    if knobs.get_str("MINIO_TPU_SSE_DEVICE").strip().lower() == "off":
+        return False
+    from ..object.codec import _device_is_tpu, _mesh_active
+    return _device_is_tpu() or _mesh_active() is not None
+
+
+# GET decipher batch width: packages buffered per device launch (64
+# packages = 4 MiB of ciphertext per dispatch)
+_GET_PKG_BATCH = 64
+
+
+def chacha_decrypt_ranged(fetch, stored: int, oek: bytes,
+                          nonce_base: bytes, offset: int,
+                          length: int) -> Iterator[bytes]:
+    """Verify-then-decrypt a plaintext range of one chacha stream.
+
+    fetch(off, len) -> ciphertext-chunk iterator over the STORED bytes
+    (ct ‖ tag trailer) — the engine read seam. Yields plaintext from
+    the covering package boundary (callers trim with their skip/take);
+    every package's Poly1305 tag is checked against the trailer BEFORE
+    its keystream XOR, so corrupt ciphertext surfaces as a clean auth
+    error, never as garbled plaintext. Deciphers in device batches
+    (one ops/chacha20_jax launch per _GET_PKG_BATCH packages) when
+    routed there, byte-identically on the numpy path otherwise.
+    """
+    import hmac as _hmac
+
+    import numpy as np
+
+    from ..ops import chacha20_ref as _c20
+    ct_len, npkg = chacha_ct_len(stored)
+    if length <= 0 or ct_len <= 0:
+        return
+    start_pkg = offset // PKG_SIZE
+    end_pkg = min((offset + length - 1) // PKG_SIZE, npkg - 1)
+    tags = b"".join(fetch(ct_len + start_pkg * TAG_SIZE,
+                          (end_pkg - start_pkg + 1) * TAG_SIZE))
+    coff = start_pkg * PKG_SIZE
+    clen = min(ct_len, (end_pkg + 1) * PKG_SIZE) - coff
+    device = _sse_device_get()
+    kw = np.frombuffer(oek, dtype="<u4")
+
+    def _flush(pkgs: list[bytes], seq0: int) -> Iterator[bytes]:
+        # authenticate FIRST — nothing deciphers until every package
+        # in the batch carries a valid trailer tag
+        for j, pkg in enumerate(pkgs):
+            seq = seq0 + j
+            at = (seq - start_pkg) * TAG_SIZE
+            want = _c20.tag_detached(oek, _pkg_nonce(nonce_base, seq),
+                                     _pkg_aad(seq), pkg)
+            if not _hmac.compare_digest(want, tags[at:at + TAG_SIZE]):
+                from ..s3.s3errors import S3Error
+                raise S3Error("InternalError",
+                              f"SSE package {seq} failed "
+                              "authentication")
+        if device:
+            try:
+                from ..ops import chacha20_jax as _cj
+                width = -(-max(len(p) for p in pkgs) // 64) * 64
+                rows = np.zeros((len(pkgs), width), dtype=np.uint8)
+                for j, pkg in enumerate(pkgs):
+                    rows[j, :len(pkg)] = np.frombuffer(pkg, np.uint8)
+                nn = np.stack([np.frombuffer(
+                    _pkg_nonce(nonce_base, seq0 + j),
+                    dtype="<u4") for j in range(len(pkgs))])
+                out = _cj.xor_packages(
+                    rows, np.broadcast_to(kw, (len(pkgs), 8)), nn)
+                for j, pkg in enumerate(pkgs):
+                    yield out[j, :len(pkg)].tobytes()
+                return
+            except Exception:  # noqa: BLE001 — dispatch error: CPU path
+                pass
+        for j, pkg in enumerate(pkgs):
+            yield _c20.xor_stream(pkg, oek,
+                                  _pkg_nonce(nonce_base, seq0 + j))
+
+    buf = b""
+    seq = start_pkg
+    pend: list[bytes] = []
+    for chunk in fetch(coff, clen):
+        buf += chunk
+        while len(buf) >= PKG_SIZE:
+            pend.append(buf[:PKG_SIZE])
+            buf = buf[PKG_SIZE:]
+            if len(pend) >= _GET_PKG_BATCH:
+                yield from _flush(pend, seq)
+                seq += len(pend)
+                pend = []
+    if buf:
+        pend.append(buf)
+    if pend:
+        yield from _flush(pend, seq)
+
+
+class DeviceSSE:
+    """Per-PUT cipher spec for the fused device data path.
+
+    The engine treats this as an opaque capability object: key/nonce
+    word arrays for the batch former come from batch_params(), the CPU
+    fallback encrypts staging rows in place byte-identically, and
+    every ciphertext byte is absorbed IN STREAM ORDER so the Poly1305
+    tag trailer — computed host-side over the device-produced
+    ciphertext, before commit — can be appended at stream end. All
+    derivation stays inside this class (crypto-hygiene lint)."""
+
+    PKG = PKG_SIZE
+
+    def __init__(self, oek: bytes, nonce_base: bytes):
+        import numpy as np
+        self._key = oek
+        self._base = nonce_base
+        self._kw = np.frombuffer(oek, dtype="<u4")
+        self._bw = np.frombuffer(nonce_base, dtype="<u4")
+        self._tags: list[bytes] = []
+        self._seq = 0
+        self._partial = b""
+
+    # -- batch former / device side ------------------------------------
+
+    def batch_params(self, offset: int, nrows: int, row_bytes: int):
+        """(keys (B, 8), nonces (B, P, 3)) u32 word arrays for a batch
+        of full rows starting at stream offset `offset` (a PKG
+        multiple). These ride the scheduler bucket like survivor masks
+        do; the bucket key carries only their SHAPE, so concurrent
+        PUTs under different keys coalesce."""
+        import numpy as np
+        p = row_bytes // PKG_SIZE
+        keys = np.broadcast_to(self._kw, (nrows, 8)).copy()
+        seqs = (offset // PKG_SIZE
+                + np.arange(nrows * p, dtype=np.uint64).reshape(
+                    nrows, p)).astype(np.uint32)
+        nonces = np.empty((nrows, p, 3), dtype=np.uint32)
+        nonces[:, :, 0] = self._bw[0]
+        nonces[:, :, 1] = self._bw[1]
+        nonces[:, :, 2] = self._bw[2] ^ seqs
+        return keys, nonces
+
+    # -- CPU fallback (byte-identity oracle) ---------------------------
+
+    def cpu_encrypt_rows(self, flat_rows, offset: int) -> None:
+        """In-place ChaCha20 over (B, row_bytes) u8 staging-row views —
+        the decline/dispatch-error fallback, producing the same bytes
+        the device kernel would."""
+        from ..ops import chacha20_ref as _c20
+        b, row_bytes = flat_rows.shape
+        for i in range(b):
+            self.cpu_encrypt_tail(flat_rows[i],
+                                  offset + i * row_bytes)
+
+    def cpu_encrypt_tail(self, row, offset: int) -> None:
+        """In-place ChaCha20 over one row of `len(row)` bytes (full
+        packages + optional final partial) at stream offset `offset`."""
+        from ..ops import chacha20_ref as _c20
+        n = row.shape[0]
+        seq = offset // PKG_SIZE
+        for at in range(0, n, PKG_SIZE):
+            _c20.xor_stream_into(row[at:at + PKG_SIZE], self._key,
+                                 _pkg_nonce(self._base, seq))
+            seq += 1
+
+    # -- host-side authentication (tag trailer) ------------------------
+
+    def _tag(self, pkg: bytes) -> None:
+        from ..ops import chacha20_ref as _c20
+        self._tags.append(_c20.tag_detached(
+            self._key, _pkg_nonce(self._base, self._seq),
+            _pkg_aad(self._seq), pkg))
+        self._seq += 1
+
+    def absorb(self, ct) -> None:
+        """Feed ciphertext in stream order (device output or CPU
+        fallback — the bytes are identical); packages close as they
+        fill and their tags accumulate for the trailer."""
+        mv = memoryview(ct)
+        if self._partial:
+            need = PKG_SIZE - len(self._partial)
+            take = bytes(mv[:need])
+            self._partial += take
+            mv = mv[len(take):]
+            if len(self._partial) == PKG_SIZE:
+                self._tag(self._partial)
+                self._partial = b""
+        full = len(mv) // PKG_SIZE
+        for i in range(full):
+            self._tag(bytes(mv[i * PKG_SIZE:(i + 1) * PKG_SIZE]))
+        rest = mv[full * PKG_SIZE:]
+        if len(rest):
+            self._partial = bytes(rest)
+
+    def trailer(self) -> bytes:
+        """Close the stream: the final partial package's tag plus the
+        full tag trailer the engine appends after the ciphertext."""
+        if self._partial:
+            self._tag(self._partial)
+            self._partial = b""
+        return b"".join(self._tags)
+
+
+def device_sse_allowed(size: int) -> bool:
+    """The QAT-style gate for the fused PUT path: escape hatch
+    (MINIO_TPU_SSE_DEVICE=off), device/capacity presence, and the
+    size window. A False here (or ANY later decline/dispatch error)
+    means the CPU ChaChaEncryptor path — same bytes either way."""
+    from ..utils import knobs
+    if knobs.get_str("MINIO_TPU_SSE_DEVICE").strip().lower() == "off":
+        return False
+    try:
+        from ..object.codec import _device_is_tpu, _mesh_active
+        if not _device_is_tpu() and _mesh_active() is None:
+            return False
+    except Exception:  # noqa: BLE001 — no jax backend: CPU path
+        return False
+    if size < 0:
+        return False
+    if size < knobs.get_int("MINIO_TPU_SSE_DEVICE_MIN_BYTES"):
+        return False
+    max_b = knobs.get_int("MINIO_TPU_SSE_DEVICE_MAX_BYTES")
+    return not (max_b and size > max_b)
 
 
 def decompress_stream(chunks: Iterator[bytes],
@@ -328,15 +662,21 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
                          raw_size: int, metadata: dict,
                          ssec_key: Optional[bytes],
                          sse_s3: bool, kms, compress: bool,
-                         compress_algo: str = COMPRESS_S2):
+                         compress_algo: str = COMPRESS_S2,
+                         cipher: Optional[str] = None,
+                         device_sse: bool = False):
     """Build the transformed reader + metadata for a PUT.
 
-    Returns (reader, size) — size is the stored byte count when
-    computable, else -1. Mutates `metadata` with the internal keys.
+    Returns (reader, size, sse_spec) — size is the stored byte count
+    when computable, else -1; sse_spec is a DeviceSSE for the engine's
+    fused device path (chacha + device_sse=True and gate allows) or
+    None (cipher runs as a CPU transform here). Mutates `metadata`
+    with the internal keys.
     """
     from ..s3.s3errors import S3Error
     transforms: list = []
     size = raw_size
+    spec = None
 
     if compress:
         if compress_algo == COMPRESS_ZSTD:
@@ -349,22 +689,42 @@ def setup_put_transforms(*, key_name: str, raw_reader: HashReader,
         size = -1
 
     if ssec_key is not None or sse_s3:
+        if cipher is None:
+            cipher = sse_cipher_for_new_writes()
         oek, nonce_base = create_sse_seals(metadata, ssec_key, sse_s3,
                                            kms,
-                                           kms_context={"object": key_name})
-        transforms.append(Encryptor(oek, nonce_base))
+                                           kms_context={"object": key_name},
+                                           cipher=cipher)
+        if cipher == CIPHER_CHACHA:
+            # compressed streams fuse too: the compressor stays a host
+            # transform and its output is the "plaintext" the engine
+            # ciphers in-batch (raw_size gates the window — the
+            # compressed stream is no larger in the cases that matter)
+            if device_sse and device_sse_allowed(raw_size):
+                # cipher leaves this chain: the engine fuses it into
+                # the encode launch and appends the tag trailer
+                spec = DeviceSSE(oek, nonce_base)
+            else:
+                transforms.append(ChaChaEncryptor(oek, nonce_base))
+        else:
+            transforms.append(Encryptor(oek, nonce_base))
         if size >= 0:
             size = encrypted_size(size)
 
-    if not transforms:
-        return raw_reader, raw_size
+    if not transforms and spec is None:
+        return raw_reader, raw_size, None
     metadata[MK_ACTUAL] = str(raw_size) if raw_size >= 0 else "-1"
-    return PutObjReader(raw_reader, transforms), size
+    if not transforms:
+        # fused path, nothing else in the chain: the engine reads the
+        # PLAINTEXT and ciphers in-batch; stored size is still known
+        return raw_reader, size, spec
+    return PutObjReader(raw_reader, transforms), size, spec
 
 
 def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
                      sse_s3: bool, kms, multipart: bool = False,
-                     kms_context: Optional[dict] = None
+                     kms_context: Optional[dict] = None,
+                     cipher: Optional[str] = None
                      ) -> Optional[tuple[bytes, bytes]]:
     """Generate + seal a fresh object key into `metadata`; returns
     (object key, nonce base) for callers that wrap a stream now (the
@@ -403,10 +763,15 @@ def create_sse_seals(metadata: dict, ssec_key: Optional[bytes],
                 separators=(",", ":")).encode()).decode()
     else:
         return None
+    if cipher is None:
+        cipher = sse_cipher_for_new_writes()
     oek = secrets.token_bytes(32)
     nonce_base = secrets.token_bytes(12)
-    metadata[MK_SEALED] = base64.b64encode(seal_key(sealing, oek)).decode()
+    metadata[MK_SEALED] = base64.b64encode(
+        seal_key(sealing, oek, cipher)).decode()
     metadata[MK_IV] = base64.b64encode(nonce_base).decode()
+    if cipher == CIPHER_CHACHA:
+        metadata[MK_CIPHER] = CIPHER_CHACHA
     if multipart:
         metadata[MK_SSE_MP] = "true"
     return oek, nonce_base
@@ -457,7 +822,8 @@ def resolve_get_key(info_metadata: dict, header,
             raise S3Error("InternalError", f"KMS decrypt-key: {e}") \
                 from e
     try:
-        oek = unseal_key(sealing, sealed)
+        oek = unseal_key(sealing, sealed,
+                         stored_sse_cipher(info_metadata))
     except Exception:
         raise S3Error("AccessDenied", "unable to unseal object key") \
             from None

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import rs_matrix, rs_tpu
+from ..ops import chacha20_jax, rs_matrix, rs_tpu
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,6 +151,93 @@ def put_step(data: jax.Array, k: int, m: int, shard_len: int = 0,
         digests = highwayhash_jax._hh256_impl(
             rows, shard_len, bytes(key or MAGIC_HIGHWAYHASH_KEY))
     return parity, digests.reshape(b, k + m, 32)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+def sse_put_step(data: jax.Array, keys: jax.Array, nonces: jax.Array,
+                 k: int, m: int, pkg_bytes: int, shard_len: int = 0,
+                 key: bytes = b"", algo: str = "highwayhash"
+                 ) -> tuple[jax.Array, jax.Array]:
+    """One ENCRYPTED PUT device step: ChaCha20-cipher each block, RS-
+    encode the ciphertext, and digest every shard — the tentpole fusion.
+    An encrypted batch costs the same single launch as a plaintext one;
+    the host's only remaining cipher work is the Poly1305 tag trailer
+    over the ciphertext this step returns (no laundered auth).
+
+    data:   (B, k, S) uint8 staged shards whose flat (B, k·S) view holds
+            the plaintext block in its first P·pkg_bytes bytes, zeros
+            after (codec.split pad discipline). Only the plaintext span
+            is ciphered — the keystream is zero-padded to k·S, so pad
+            columns stay zero and the stored stream is byte-identical
+            to the CPU ChaChaEncryptor path.
+    keys:   (B, 8) uint32 per-row ChaCha20 key words; nonces (B, P, 3)
+            uint32 per-row per-package nonce words (features/crypto.
+            DeviceSSE.batch_params — rows of DIFFERENT objects coalesce
+            because the bucket key carries only these arrays' shapes).
+    Returns (full (B, k+m, S) uint8 — ciphertext data shards with
+    parity appended, digests (B, k+m, 32)). Unlike put_step the data
+    rows DO cross back: the caller staged plaintext and must write (and
+    tag) ciphertext.
+    """
+    b, k_, s = data.shape
+    assert k_ == k
+    p = nonces.shape[1]
+    ct_bytes = p * pkg_bytes
+    ks = chacha20_jax.keystream_u8(keys, nonces, ct_bytes, pkg_bytes)
+    if ct_bytes < k * s:
+        ks = jnp.concatenate(
+            [ks, jnp.zeros((b, k * s - ct_bytes), jnp.uint8)], axis=-1)
+    ct = (jnp.asarray(data, jnp.uint8).reshape(b, k * s)
+          ^ ks).reshape(b, k, s)
+    pm = np.asarray(rs_matrix.parity_matrix(k, m))
+    m2 = rs_tpu._bit_expand_cached(pm.tobytes(), pm.shape)
+    parity = rs_tpu._apply_matrix_impl(
+        jnp.asarray(m2), ct, m, k, rs_tpu.default_use_pallas())
+    rows = jnp.concatenate([ct, parity], axis=-2)
+    digests = _hash_rows(rows.reshape(b * (k + m), s),
+                         shard_len or s, key, algo)
+    return rows, digests.reshape(b, k + m, 32)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
+def sse_get_step(survivors: jax.Array, matrix_bits: jax.Array,
+                 keys: jax.Array, nonces: jax.Array, r: int, k: int,
+                 data_src: tuple = (), pkg_bytes: int = 0,
+                 shard_len: int = 0, key: bytes = b"",
+                 algo: str = "highwayhash"
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One ENCRYPTED degraded-GET device step: verify → decode →
+    decipher fused. Reconstructs the missing rows from the survivors,
+    reassembles the kd ciphertext data shards, and XORs the per-package
+    keystream back off — the plaintext block leaves the device in the
+    same launch that verified and decoded it. (Poly1305 package tags
+    still verify host-side against the trailer BEFORE any output of
+    this step is served.)
+
+    data_src: static tuple with one (src, idx) per data shard — src 0
+    takes survivors[:, idx] (shard arrived intact, in decode `used`
+    order), src 1 takes reconstructed[:, idx] (in `missing` order).
+    keys (B, 8) / nonces (B, P, 3): word arrays for the block's
+    packages, plaintext span = P·pkg_bytes of the flat (B, kd·S) view.
+    Returns (plain (B, kd, S) deciphered data shards, missing (B, r, S)
+    reconstructed CIPHERTEXT shards — what a heal would write back,
+    survivor digests (B, k, 32) for host bitrot comparison).
+    """
+    b, k_, s = survivors.shape
+    assert k_ == k
+    out, digests = _reconstruct_and_hash(
+        survivors, matrix_bits, r, k, shard_len, key, algo)
+    kd = len(data_src)
+    stacked = jnp.stack(
+        [survivors[:, i] if src == 0 else out[:, i]
+         for src, i in data_src], axis=1)
+    ct_bytes = nonces.shape[1] * pkg_bytes
+    ks = chacha20_jax.keystream_u8(keys, nonces, ct_bytes, pkg_bytes)
+    if ct_bytes < kd * s:
+        ks = jnp.concatenate(
+            [ks, jnp.zeros((b, kd * s - ct_bytes), jnp.uint8)], axis=-1)
+    plain = (stacked.reshape(b, kd * s) ^ ks).reshape(b, kd, s)
+    return plain, out, digests[:, :k]
 
 
 def _hash_rows(rows: jax.Array, shard_len: int, key: bytes,

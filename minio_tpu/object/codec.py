@@ -234,6 +234,90 @@ class Codec:
             stage_cb("fetch", _time.perf_counter() - t1)
         return out
 
+    def encrypt_encode_and_hash_batch(self, data: np.ndarray, keys,
+                                      nonces, pkg_bytes: int, algo,
+                                      *, force: str = "",
+                                      stage_cb=None):
+        """Fused device path for the ENCRYPTED PUT hot loop: ChaCha20
+        cipher + parity + per-shard digests in one launch
+        (models/pipeline.sse_put_step) — an encrypted batch costs the
+        same single dispatch as a plaintext one.
+
+        data: (B, k, S) staged PLAINTEXT shards; keys (B, 8) / nonces
+        (B, P, 3) u32 word arrays (features/crypto.DeviceSSE.
+        batch_params — P·pkg_bytes plaintext bytes per row). Returns
+        (full (B, k+m, S) — CIPHERTEXT data rows with parity appended,
+        digests (B, k+m, 32)), or None when the batch doesn't route to
+        the device (the caller's CPU cipher path is the oracle). The
+        mesh has no sse program yet, so mesh-routed hosts fall back to
+        the CPU path too.
+        """
+        import time as _time
+        kernel = self._device_hash_kernel(algo)
+        if kernel is None or self.m == 0:
+            return None
+        path = force or self._route(data.nbytes)
+        if path != "device":
+            return None
+        from ..models.pipeline import sse_put_step
+        t0 = _time.perf_counter()
+        full, digests = sse_put_step(data, keys, nonces, self.k,
+                                     self.m, pkg_bytes, algo=kernel)
+        t1 = self._staged(stage_cb, (full, digests))
+        # the data rows DO cross back here: the caller staged plaintext
+        # and must write (and Poly1305-tag) the ciphertext
+        out = np.asarray(full), np.asarray(digests)
+        if stage_cb is not None:
+            stage_cb("compute", t1 - t0)
+            stage_cb("fetch", _time.perf_counter() - t1)
+        return out
+
+    def verify_decode_decrypt_batch(self, survivors: np.ndarray,
+                                    present_mask: int, shard_len: int,
+                                    keys, nonces, pkg_bytes: int, algo,
+                                    *, force: str = "", stage_cb=None):
+        """Fused device path for the ENCRYPTED degraded GET: bitrot-
+        verify survivors, reconstruct the missing data rows, and
+        decipher the reassembled data shards in one launch
+        (models/pipeline.sse_get_step).
+
+        survivors: (B, k, S) in missing_data_matrix `used` order.
+        Returns (plain (B, k, S) deciphered data shards in shard-index
+        order, missing_idx, survivor_digests (B, k, 32)), or None when
+        not device-routed / no device hash kernel / nothing missing.
+        Package tags still verify host-side before any of this output
+        is served (features/crypto.chacha_decrypt_ranged discipline).
+        """
+        import time as _time
+        kernel = self._device_hash_kernel(algo)
+        if kernel is None:
+            return None
+        path = force or self._route(survivors.nbytes)
+        if path != "device":
+            return None
+        dm, used, missing = rs_matrix.missing_data_matrix(
+            self.k, self.m, present_mask)
+        if not missing:
+            return None
+        # static reassembly map: data shard j comes from the survivors
+        # stack (decode `used` order) or the reconstructed rows
+        # (`missing` order)
+        data_src = tuple(
+            (0, used.index(j)) if j in used else (1, missing.index(j))
+            for j in range(self.k))
+        m2 = rs_tpu._bit_expand_cached(dm.tobytes(), dm.shape)
+        from ..models.pipeline import sse_get_step
+        t0 = _time.perf_counter()
+        plain, _ct_missing, digests = sse_get_step(
+            survivors, m2, keys, nonces, dm.shape[0], self.k,
+            data_src, pkg_bytes, shard_len, algo=kernel)
+        t1 = self._staged(stage_cb, (plain, digests))
+        result = np.asarray(plain), missing, np.asarray(digests)
+        if stage_cb is not None:
+            stage_cb("compute", t1 - t0)
+            stage_cb("fetch", _time.perf_counter() - t1)
+        return result
+
     # -- fused verify + decode / recover (device) --------------------------
 
     def verify_and_decode_batch(self, survivors: np.ndarray,

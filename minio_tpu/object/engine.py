@@ -53,6 +53,13 @@ from .nslock import NSLockMap
 ENCODE_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_ENCODE_BATCH")
 GET_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_GET_BATCH")
 
+
+def _sse_pkg() -> int:
+    """features/crypto.PKG_SIZE without a module-level crypto import
+    (crypto pulls optional deps the bare engine must not require)."""
+    from ..features.crypto import PKG_SIZE
+    return PKG_SIZE
+
 # Reserved bucket names an S3 client can't touch.
 RESERVED_BUCKETS = (MINIO_META_BUCKET,)
 
@@ -62,11 +69,17 @@ class PutOptions:
                  version_id: str = "", versioned: bool = False,
                  parity: Optional[int] = None,
                  mod_time: Optional[float] = None,
-                 if_none_newer: bool = False):
+                 if_none_newer: bool = False,
+                 sse_spec=None):
         self.metadata = dict(metadata or {})
         self.version_id = version_id
         self.versioned = versioned
         self.parity = parity
+        # features/crypto.DeviceSSE for the fused cipher+RS+digest PUT
+        # path: the reader then carries PLAINTEXT and the engine
+        # ciphers in-batch, appending the Poly1305 tag trailer at
+        # stream end (None = any cipher ran as a reader transform)
+        self.sse_spec = sse_spec
         # explicit mod time: server-side copies (rebalance pool moves)
         # preserve the object's original Last-Modified instead of
         # stamping the move time
@@ -148,6 +161,15 @@ class ErasureObjects:
         if key not in self._codec_cache:
             self._codec_cache[key] = Codec(k, m, self.block_size)
         return self._codec_cache[key]
+
+    @property
+    def supports_sse_device(self) -> bool:
+        """Whether this layer can run the fused cipher+RS+digest PUT
+        path (PutOptions.sse_spec): the package stream must tile the
+        erasure blocks exactly, so full blocks carry whole ChaCha20
+        packages through the batch former."""
+        from ..features.crypto import PKG_SIZE
+        return self.block_size % PKG_SIZE == 0
 
     def get_disks(self) -> list[Optional[StorageAPI]]:
         return list(self.disks)
@@ -300,7 +322,8 @@ class ErasureObjects:
             try:
                 total = self._encode_stream(reader, codec, writers,
                                             write_quorum, bucket,
-                                            object_name)
+                                            object_name,
+                                            sse=opts.sse_spec)
                 with stagetimer.stage("put.hash_verify"):
                     reader.verify()
             finally:
@@ -358,7 +381,7 @@ class ErasureObjects:
 
     def _encode_stream(self, reader, codec: Codec, writers,
                        write_quorum: int, bucket: str,
-                       object_name: str) -> int:
+                       object_name: str, sse=None) -> int:
         """The PUT hot loop: read blocks, batch-encode, batch-hash,
         fan-out framed writes. Returns total bytes.
 
@@ -368,19 +391,28 @@ class ErasureObjects:
         strictly in sequence on this thread. Streams that fit in ONE
         encode batch stay serial even with the pipeline on — a single
         batch has nothing to overlap, so the stage hand-off would be
-        pure latency."""
+        pure latency.
+
+        With `sse` (a features/crypto.DeviceSSE), the reader carries
+        PLAINTEXT and the cipher fuses into the encode dispatch: full
+        blocks ride the batch former as cipher+RS+digest launches, the
+        Poly1305 tag trailer (computed host-side over the returned
+        ciphertext) lands at stream end, and the returned total is the
+        STORED size (ciphertext + trailer). Any decline or dispatch
+        error drops that batch to the in-place CPU cipher — the bytes
+        on disk are identical either way."""
         from ..parallel import pipeline as pl
         size = getattr(reader, "size", -1)
         if pl.ENABLED and (size < 0
                            or size > ENCODE_BATCH_BLOCKS
                            * self.block_size):
             return self._encode_stream_pipelined(reader, codec, writers,
-                                                 write_quorum)
+                                                 write_quorum, sse=sse)
         return self._encode_stream_serial(reader, codec, writers,
-                                          write_quorum)
+                                          write_quorum, sse=sse)
 
     def _encode_stream_pipelined(self, reader, codec: Codec, writers,
-                                 write_quorum: int) -> int:
+                                 write_quorum: int, sse=None) -> int:
         """The PUT hot loop, overlapped (the fork's async-QAT pattern,
         cmd/erasure-encode.go:113-124, applied to the WHOLE path): a
         ring of BytePool-backed (B, k·S) staging buffers carries three
@@ -423,14 +455,28 @@ class ErasureObjects:
 
         def encode_stage(item):
             t0 = time.perf_counter()
+            if item.get("sse_finish"):
+                # stream end under SSE: encrypt the short tail (if any)
+                # host-side, close the Poly1305 trailer, and re-chunk
+                # ct_tail‖trailer into block-size erasure batches. Runs
+                # on this FIFO stage so every prior batch has absorbed.
+                with stagetimer.stage("put.encode+digest"):
+                    item["rows_multi"] = self._sse_finish_rows(
+                        codec, sse, item["tail"], item["sse_off"])
+                stage_s[1] += time.perf_counter() - t0
+                return item
             with stagetimer.stage("put.encode+digest"), \
                     telemetry.span("pipeline.encode",
                                    blocks=item["data"].shape[0]):
                 fut, data = item["fut"], item["data"]
-                # check: allow(deadline) device dispatch; scheduler close() flushes waiters
-                fused = fut.result() if fut is not None else \
-                    codec.encode_and_hash_batch(data, self.bitrot_algo)
-                item["rows"] = self._unpack_fused(codec, data, fused)
+                if sse is not None:
+                    item["rows"] = self._sse_encode(codec, data, item,
+                                                    fut, sse)
+                else:
+                    # check: allow(deadline) device dispatch; scheduler close() flushes waiters
+                    fused = fut.result() if fut is not None else \
+                        codec.encode_and_hash_batch(data, self.bitrot_algo)
+                    item["rows"] = self._unpack_fused(codec, data, fused)
             stage_s[1] += time.perf_counter() - t0
             return item
 
@@ -439,9 +485,11 @@ class ErasureObjects:
             try:
                 with stagetimer.stage("put.shard_write"), \
                         telemetry.span("pipeline.shard_write"):
-                    rows, parity, dd, dp = item["rows"]
-                    self._write_shards_batch(rows, parity, dd, dp,
-                                             writers, write_quorum)
+                    for rows, parity, dd, dp in (
+                            item["rows_multi"] if "rows_multi" in item
+                            else [item["rows"]]):
+                        self._write_shards_batch(rows, parity, dd, dp,
+                                                 writers, write_quorum)
             finally:
                 recycle(item)
                 stage_s[2] += time.perf_counter() - t0
@@ -456,15 +504,30 @@ class ErasureObjects:
             item's buffer and the caller's finally must not recycle it
             again (a double pool.put would hand one bytearray to two
             later streams)."""
-            nonlocal batches, buf, pipe
+            nonlocal batches, buf, pipe, enc_off
             if pipe is None:
                 pipe = pl.StagePipeline([encode_stage, write_stage],
                                         depth=pl.DEPTH, name="put-pipe",
                                         on_drop=recycle)
             owned, buf = buf, None
-            fut = (self.scheduler.submit(codec, data, self.bitrot_algo)
-                   if self.scheduler is not None else None)
-            pipe.submit({"buf": owned, "data": data, "fut": fut})
+            item = {"buf": owned, "data": data}
+            if sse is not None:
+                # per-row key/nonce word arrays ride the dispatch; the
+                # bucket key carries only their shape, so concurrent
+                # encrypted PUTs coalesce into one launch
+                kn = sse.batch_params(enc_off, data.shape[0], bs)
+                item["sse_kn"], item["sse_off"] = kn, enc_off
+                enc_off += data.shape[0] * bs
+                fut = (self.scheduler.submit(
+                    codec, data, self.bitrot_algo,
+                    sse=(kn[0], kn[1], _sse_pkg()))
+                    if self.scheduler is not None else None)
+            else:
+                fut = (self.scheduler.submit(codec, data,
+                                             self.bitrot_algo)
+                       if self.scheduler is not None else None)
+            item["fut"] = fut
+            pipe.submit(item)
             batches += 1
 
         def acquire():
@@ -481,6 +544,8 @@ class ErasureObjects:
 
         total = 0
         buf = None
+        enc_off = 0       # plaintext stream offset of the next sse batch
+        tail_pt = b""     # short last block (plaintext) under sse
         try:
             buf, arr = acquire()
             nb = 0
@@ -504,6 +569,13 @@ class ErasureObjects:
                             break
                         buf, arr = acquire()
                 else:
+                    if sse is not None:
+                        # short last block under SSE: it joins the tag
+                        # trailer in the finish batches — the pending
+                        # full rows flush below, then the finish runs
+                        # after them in stage FIFO order
+                        tail_pt = bytes(arr[nb][:n])
+                        break
                     # short last block: its shard length differs —
                     # flush the pending full rows first, then the
                     # short block alone (split copies it out of the
@@ -530,9 +602,20 @@ class ErasureObjects:
                 if pipe is None:
                     self._encode_write(codec,
                                        arr[:nb].reshape(nb, k, s_len),
-                                       writers, write_quorum)
+                                       writers, write_quorum,
+                                       sse=sse, sse_off=enc_off)
+                    enc_off += nb * bs
                 else:
                     feed(arr[:nb].reshape(nb, k, s_len))
+            if sse is not None:
+                if pipe is None:
+                    for rows in self._sse_finish_rows(codec, sse,
+                                                      tail_pt, enc_off):
+                        self._write_shards_batch(*rows, writers,
+                                                 write_quorum)
+                else:
+                    pipe.submit({"sse_finish": True, "tail": tail_pt,
+                                 "sse_off": enc_off})
             if pipe is not None:
                 pipe.close()    # join; re-raises the first stage error
         except BaseException:
@@ -546,10 +629,13 @@ class ErasureObjects:
             wall = time.perf_counter() - t_start
             pl.STATS.record_put(wall, sum(stage_s), batches)
             stagetimer.add_overlap("put.pipeline", wall, sum(stage_s))
+        if sse is not None:
+            from ..features.crypto import encrypted_size
+            return encrypted_size(total)   # ciphertext + tag trailer
         return total
 
     def _encode_stream_serial(self, reader, codec: Codec, writers,
-                              write_quorum: int) -> int:
+                              write_quorum: int, sse=None) -> int:
         """The serial PUT hot loop (MINIO_TPU_PIPELINE=off).
 
         Copy discipline (the fork's zero-copy QAT ingest,
@@ -567,12 +653,17 @@ class ErasureObjects:
         # blocks never write into it
         buf = np.zeros((cap, k * s_len), dtype=np.uint8)
         nb = 0
+        enc_off = 0
+        tail_pt = b""
 
         def flush_full(n_rows: int) -> None:
+            nonlocal enc_off
             if n_rows:
                 self._encode_write(codec,
                                    buf[:n_rows].reshape(n_rows, k, s_len),
-                                   writers, write_quorum)
+                                   writers, write_quorum,
+                                   sse=sse, sse_off=enc_off)
+                enc_off += n_rows * bs
 
         while True:
             row = buf[nb]
@@ -587,6 +678,11 @@ class ErasureObjects:
                     flush_full(nb)
                     nb = 0
             else:
+                if sse is not None:
+                    # short last block under SSE joins the tag trailer
+                    # in the finish batches (after flush_full below)
+                    tail_pt = bytes(row[:n])
+                    break
                 # short last block: its shard length differs — encode
                 # the pending full rows first, then it alone
                 flush_full(nb)
@@ -596,6 +692,12 @@ class ErasureObjects:
                 self._encode_write(codec, data, writers, write_quorum)
                 break
         flush_full(nb)
+        if sse is not None:
+            from ..features.crypto import encrypted_size
+            for rows in self._sse_finish_rows(codec, sse, tail_pt,
+                                              enc_off):
+                self._write_shards_batch(*rows, writers, write_quorum)
+            return encrypted_size(total)
         return total
 
     def _unpack_fused(self, codec: Codec, data: np.ndarray, fused
@@ -623,25 +725,95 @@ class ErasureObjects:
         return data, parity, dd, dp
 
     def _encode_write(self, codec: Codec, data: np.ndarray, writers,
-                      write_quorum: int) -> None:
+                      write_quorum: int, sse=None, sse_off: int = 0
+                      ) -> None:
         """Encode+digest one (B, k, S) batch and fan the framed shard
-        writes out — data rows go to the writers as views of `data`."""
+        writes out — data rows go to the writers as views of `data`.
+        With `sse`, the batch rows are PLAINTEXT full blocks starting
+        at stream offset `sse_off` and the cipher fuses in (or falls
+        back to the in-place CPU cipher)."""
         with stagetimer.stage("put.encode+digest"), \
                 telemetry.span("pipeline.encode", blocks=data.shape[0]):
-            # fused device encode+digest when routed there (one program,
-            # one round-trip); the cross-request scheduler coalesces
-            # concurrent PUT streams into shared dispatches
-            if self.scheduler is not None:
-                fused = self.scheduler.encode_and_hash(
-                    codec, data, self.bitrot_algo)
+            if sse is not None:
+                item = {"sse_kn": sse.batch_params(
+                    sse_off, data.shape[0], self.block_size),
+                    "sse_off": sse_off}
+                fut = (self.scheduler.submit(
+                    codec, data, self.bitrot_algo,
+                    sse=(*item["sse_kn"], _sse_pkg()))
+                    if self.scheduler is not None else None)
+                data_rows, parity, dd, dp = self._sse_encode(
+                    codec, data, item, fut, sse)
             else:
-                fused = codec.encode_and_hash_batch(data, self.bitrot_algo)
-            data_rows, parity, dd, dp = self._unpack_fused(codec, data,
-                                                           fused)
+                # fused device encode+digest when routed there (one
+                # program, one round-trip); the cross-request scheduler
+                # coalesces concurrent PUT streams into shared
+                # dispatches
+                if self.scheduler is not None:
+                    fused = self.scheduler.encode_and_hash(
+                        codec, data, self.bitrot_algo)
+                else:
+                    fused = codec.encode_and_hash_batch(data,
+                                                        self.bitrot_algo)
+                data_rows, parity, dd, dp = self._unpack_fused(
+                    codec, data, fused)
         with stagetimer.stage("put.shard_write"), \
                 telemetry.span("pipeline.shard_write"):
             self._write_shards_batch(data_rows, parity, dd, dp, writers,
                                      write_quorum)
+
+    def _sse_encode(self, codec: Codec, data: np.ndarray, item, fut,
+                    sse) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+        """Resolve one SSE batch: fused device cipher+RS+digest result,
+        or — on decline OR dispatch error — the in-place CPU cipher
+        followed by the local encode path (byte-identical either way).
+        Always absorbs the ciphertext into the Poly1305 tag trailer in
+        stream order (the caller runs batches FIFO), so the tags are
+        computed over the bytes actually committed — device output is
+        re-authenticated host-side, never laundered."""
+        bs = self.block_size
+        b_ = data.shape[0]
+        fused = None
+        try:
+            if fut is not None:
+                # check: allow(deadline) device dispatch; scheduler close() flushes waiters
+                fused = fut.result()
+            else:
+                keys, nonces = item["sse_kn"]
+                fused = codec.encrypt_encode_and_hash_batch(
+                    data, keys, nonces, _sse_pkg(), self.bitrot_algo)
+        except Exception:
+            fused = None    # dispatch error → CPU cipher fallback
+        if fused is None:
+            flat = data.reshape(b_, -1)
+            sse.cpu_encrypt_rows(flat[:, :bs], item["sse_off"])
+        rows = self._unpack_fused(codec, data, fused)
+        ct = rows[0]        # (B, k, S): device output or encrypted buf
+        for i in range(b_):
+            sse.absorb(ct[i].reshape(-1)[:bs])
+        return rows
+
+    def _sse_finish_rows(self, codec: Codec, sse, tail_pt: bytes,
+                         off: int) -> list:
+        """Close an SSE stream: encrypt the short plaintext tail (CPU —
+        partial blocks never ride the device), absorb it, close the tag
+        trailer, and chunk ct_tail‖trailer into block-size erasure
+        batches ready for _write_shards_batch. The trailer can exceed
+        one block for huge objects, hence a list."""
+        if tail_pt:
+            arr = np.frombuffer(bytearray(tail_pt), dtype=np.uint8)
+            sse.cpu_encrypt_tail(arr, off)
+            sse.absorb(arr)
+            stream = arr.tobytes() + sse.trailer()
+        else:
+            stream = sse.trailer()
+        out = []
+        bs = self.block_size
+        for at in range(0, len(stream), bs):
+            data = codec.split(stream[at:at + bs])[None, ...]
+            out.append(self._unpack_fused(codec, data, None))
+        return out
 
     def _write_shards_batch(self, data: np.ndarray, parity: np.ndarray,
                             dd: np.ndarray, dp: np.ndarray,

@@ -314,6 +314,13 @@ class MetacacheManager:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        # join the flusher: an in-flight _persist keeps writing segment
+        # objects (staging tmps and all) after the flag flips — callers
+        # (shutdown, fsck-after-close tests) need the drives quiescent
+        # once close() returns
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=10.0)
         if flush:
             for b, idx in list(self._indexes.items()):
                 if idx.state == _BucketIndex.READY and idx.dirty:

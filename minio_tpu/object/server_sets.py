@@ -60,6 +60,11 @@ class ErasureServerSets:
             topology = TopologyStore.load(self)
         self.topology = topology or TopologyMap(len(server_sets))
 
+    @property
+    def supports_sse_device(self) -> bool:
+        return all(getattr(z, "supports_sse_device", False)
+                   for z in self.server_sets)
+
     def _dispatch_namespace_change(self, bucket: str,
                                    object_name: str) -> None:
         """Fan one engine namespace delta out to every listener; a

@@ -65,6 +65,11 @@ class ErasureSets:
                 s.on_degraded_read = self._queue_mrf_heal
                 s.on_degraded_write = self._queue_mrf_heal
 
+    @property
+    def supports_sse_device(self) -> bool:
+        return all(getattr(s, "supports_sse_device", False)
+                   for s in self.sets)
+
     # ------------------------------------------------------------------
     # construction from drives (format bootstrap)
     # ------------------------------------------------------------------

@@ -1,0 +1,122 @@
+"""Batched ChaCha20 keystream-XOR on device (XLA/TPU).
+
+The SSE cipher stage of the fused PUT program (models/pipeline.
+sse_put_step): ChaCha20 is pure add-rotate-xor on a 4×4 u32 state, so
+it vectorizes over 64-byte blocks exactly like ops/highwayhash_jax.py
+vectorizes over hash lanes — the 16 state words become 16 (B, nblocks)
+u32 planes and the 20 rounds run as whole-array ops, one batch of
+erasure blocks per launch.
+
+Shapes follow the package discipline of features/crypto.py: each batch
+row carries P packages of ``pkg_bytes`` plaintext; row i, package p
+encrypts under nonce ``nonces[i, p]`` with the block counter restarting
+at 1 inside every package (counter 0 is the package's Poly1305 one-time
+key, derived HOST-side — tags never launder through this kernel).
+
+Byte-identity oracle: ops/chacha20_ref.keystream / xor_stream
+(tests/test_chacha.py pins both against the RFC 8439 vectors and each
+other). Like the other ops kernels this module computes only what it is
+handed — keys and nonces arrive as pre-derived word arrays from
+features/crypto.py, which owns ALL nonce derivation (crypto-hygiene
+lint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .chacha20_ref import _CONST, _QROUNDS
+
+__all__ = ["keystream_u8", "keystream_xor", "xor_packages"]
+
+
+def _qr(x: list, a: int, b: int, c: int, d: int) -> None:
+    def rotl(v, n):
+        return (v << jnp.uint32(n)) | (v >> jnp.uint32(32 - n))
+    x[a] = x[a] + x[b]
+    x[d] = rotl(x[d] ^ x[a], 16)
+    x[c] = x[c] + x[d]
+    x[b] = rotl(x[b] ^ x[c], 12)
+    x[a] = x[a] + x[b]
+    x[d] = rotl(x[d] ^ x[a], 8)
+    x[c] = x[c] + x[d]
+    x[b] = rotl(x[b] ^ x[c], 7)
+
+
+def _keystream_words(keys: jax.Array, nonces: jax.Array,
+                     nblk: int, pkg_blocks: int) -> jax.Array:
+    """(B, 8) key words + (B, P, 3) nonce words -> (B, nblk, 16) u32
+    output state words (rounds + feed-forward), counter restarting at 1
+    per package."""
+    b = keys.shape[0]
+    pidx = np.arange(nblk) // pkg_blocks            # static gather map
+    ctr = jnp.asarray(1 + np.arange(nblk) % pkg_blocks, jnp.uint32)
+    bn = nonces[:, pidx, :]                          # (B, nblk, 3)
+    init = [jnp.broadcast_to(jnp.uint32(int(_CONST[i])), (b, nblk))
+            for i in range(4)]
+    init += [jnp.broadcast_to(keys[:, i:i + 1], (b, nblk))
+             for i in range(8)]
+    init += [jnp.broadcast_to(ctr[None, :], (b, nblk))]
+    init += [bn[:, :, i] for i in range(3)]
+    state = jnp.stack(init, axis=0)                  # (16, B, nblk)
+
+    # one double round (8 quarter rounds) per fori_loop step: unrolling
+    # all 10 inflates the graph ~1600 sequential ops and costs ~17 s of
+    # XLA compile per shape; the loop body compiles once
+    def _double_round(_, st):
+        x = [st[i] for i in range(16)]
+        for a, b_, c, d in _QROUNDS:
+            _qr(x, a, b_, c, d)
+        return jnp.stack(x, axis=0)
+
+    out = jax.lax.fori_loop(0, 10, _double_round, state) + state
+    return jnp.moveaxis(out, 0, -1)                  # (B, nblk, 16)
+
+
+def keystream_u8(keys: jax.Array, nonces: jax.Array, length: int,
+                 pkg_bytes: int) -> jax.Array:
+    """(B, length) u8 keystream bytes — length = P·pkg_bytes, both
+    64-byte multiples. The traced core the fused pipeline steps splice
+    into their own jit programs (models/pipeline.sse_put_step XORs this
+    against staged plaintext before the RS matmul ever runs)."""
+    if pkg_bytes % 64 or length % pkg_bytes:
+        raise ValueError("package length must be a 64-byte multiple")
+    b = keys.shape[0]
+    nblk = length // 64
+    words = _keystream_words(jnp.asarray(keys, jnp.uint32),
+                             jnp.asarray(nonces, jnp.uint32),
+                             nblk, pkg_bytes // 64)
+    # little-endian serialization: (B, nblk, 16) u32 -> (B, L) u8
+    shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+    return ((words[..., None] >> shifts) & jnp.uint32(0xFF)
+            ).astype(jnp.uint8).reshape(b, length)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def keystream_xor(data: jax.Array, keys: jax.Array, nonces: jax.Array,
+                  pkg_bytes: int) -> jax.Array:
+    """(B, P·pkg_bytes) u8 ⊕ per-package ChaCha20 keystreams.
+
+    data:   (B, L) uint8 with L = P * pkg_bytes (pad partial tails with
+            anything — the caller slices the real length back out).
+    keys:   (B, 8) uint32 — per-row key words (rows from different
+            objects coalesce into one launch carrying their own keys).
+    nonces: (B, P, 3) uint32 — per-row, per-package nonce words.
+    Returns (B, L) uint8 ciphertext (XOR: the same call deciphers).
+    """
+    b, length = data.shape
+    ks = keystream_u8(keys, nonces, length, pkg_bytes)
+    return jnp.asarray(data, jnp.uint8) ^ ks
+
+
+def xor_packages(rows: np.ndarray, keys: np.ndarray,
+                 nonces: np.ndarray) -> np.ndarray:
+    """Host wrapper for the GET decipher batch: (N, Lp) u8 rows (one
+    package each, zero-padded to a 64-byte multiple), (N, 8) key words,
+    (N, 3) nonce words -> (N, Lp) u8 in one launch."""
+    return np.asarray(keystream_xor(rows, keys, nonces[:, None, :],
+                                    rows.shape[1]))

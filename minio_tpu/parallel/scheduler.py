@@ -9,8 +9,11 @@ batches across CONCURRENT requests (BASELINE config #2: 32 concurrent
 PR 2 coalesced the PUT side only; the former is now a MULTI-VERB
 device dispatcher covering every fused program of the data path:
 
-  * ``encode``  — fused RS-encode + per-shard bitrot digest (PUT)
-  * ``decode``  — fused verify + reconstruct-missing-data (degraded GET)
+  * ``encode``  — fused RS-encode + per-shard bitrot digest (PUT);
+    with per-row cipher word arrays (sse=), fused ChaCha20 cipher +
+    RS + digest — an encrypted batch is still ONE launch
+  * ``decode``  — fused verify + reconstruct-missing-data (degraded
+    GET); with sse=, verify + decode + decipher fused
   * ``recover`` — fused verify + rebuild-rows + re-digest (heal)
   * ``scan``    — vectorized S3 Select predicate over tokenized pages
     (scan/kernels.py): concurrent SelectObjectContent requests whose
@@ -289,31 +292,63 @@ class BatchScheduler:
             self._kick.notify_all()
         return DispatchFuture(p)
 
-    def submit(self, codec, data: np.ndarray, algo) -> DispatchFuture:
+    def submit(self, codec, data: np.ndarray, algo,
+               sse=None) -> DispatchFuture:
         """Non-blocking fused encode+digest dispatch: enqueue the
         (B, k, S) group on the batch former and return immediately. The
         future resolves to (full, digests), or to None when the work
         can't ride the device path (the caller falls back to its local
         CPU path) — declined submissions return an already-done
-        future."""
+        future.
+
+        sse = (keys (B, 8), nonces (B, P, 3), pkg_bytes) turns the
+        dispatch into the fused cipher+RS+digest program (codec.
+        encrypt_encode_and_hash_batch): the word arrays ride the batch
+        like survivor masks do, but the bucket key carries only their
+        GEOMETRY (package count + size) — concurrent encrypted PUTs
+        from different objects, under different keys, coalesce into one
+        launch. The resolved `full` then holds CIPHERTEXT data rows."""
         if self._declined(codec, algo):
             return DispatchFuture()
+        if sse is None:
+            key = ("encode", codec.k, codec.m, data.shape[-1],
+                   algo.value, None)
+            return self._enqueue(key, data)
+        keys, nonces, pkg_bytes = sse
         key = ("encode", codec.k, codec.m, data.shape[-1], algo.value,
-               None)
-        return self._enqueue(key, data)
+               ("sse", nonces.shape[1], pkg_bytes))
+        p = _Pending(np.ascontiguousarray(data, np.uint8),
+                     payload=(np.ascontiguousarray(keys, np.uint32),
+                              np.ascontiguousarray(nonces, np.uint32)))
+        return self._enqueue_pending(key, p)
 
     def submit_decode(self, codec, survivors: np.ndarray,
-                      present_mask: int, shard_len: int, algo
-                      ) -> DispatchFuture:
+                      present_mask: int, shard_len: int, algo,
+                      sse=None) -> DispatchFuture:
         """Non-blocking fused verify+decode dispatch for a degraded-GET
         bucket: survivors (B, k, S) stacked in missing_data_matrix
         `used` order. Resolves to (missing, missing_idx,
-        survivor_digests) or None (caller host-decodes)."""
+        survivor_digests) or None (caller host-decodes).
+
+        sse = (keys, nonces, pkg_bytes) requests the fused verify →
+        decode → DECIPHER program (codec.verify_decode_decrypt_batch):
+        the resolved first element is then the deciphered (B, k, S)
+        data-shard stack in shard-index order instead of the missing
+        ciphertext rows."""
         if self._declined(codec, algo):
             return DispatchFuture()
+        if sse is None:
+            key = ("decode", codec.k, codec.m, survivors.shape[-1],
+                   algo.value, (present_mask, shard_len))
+            return self._enqueue(key, survivors)
+        keys, nonces, pkg_bytes = sse
         key = ("decode", codec.k, codec.m, survivors.shape[-1],
-               algo.value, (present_mask, shard_len))
-        return self._enqueue(key, survivors)
+               algo.value, (present_mask, shard_len, "sse",
+                            nonces.shape[1], pkg_bytes))
+        p = _Pending(np.ascontiguousarray(survivors, np.uint8),
+                     payload=(np.ascontiguousarray(keys, np.uint32),
+                              np.ascontiguousarray(nonces, np.uint32)))
+        return self._enqueue_pending(key, p)
 
     def submit_recover(self, codec, survivors: np.ndarray,
                        present_mask: int, rows, shard_len: int, algo
@@ -343,11 +378,11 @@ class BatchScheduler:
                      blocks=pages.n_pages)
         return self._enqueue_pending(key, p)
 
-    def encode_and_hash(self, codec, data: np.ndarray, algo
+    def encode_and_hash(self, codec, data: np.ndarray, algo, sse=None
                         ) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """Blocking fused encode+digest via the shared batch former
-        (submit + wait)."""
-        return self.submit(codec, data, algo).result()
+        (submit + wait); `sse` as in submit()."""
+        return self.submit(codec, data, algo, sse=sse).result()
 
     # -- collector ---------------------------------------------------------
 
@@ -541,10 +576,29 @@ class BatchScheduler:
             # host-side batch staging: the fused input's assembly into
             # one contiguous array the device upload reads from
             stage_cb("transfer", time.perf_counter() - t0)
+
+        def _sse_arrays():
+            # per-row key/nonce word arrays concatenate across the
+            # group exactly like the shard data does
+            if len(group) == 1:
+                return group[0].payload
+            return (np.concatenate([p.payload[0] for p in group]),
+                    np.concatenate([p.payload[1] for p in group]))
+
         if verb == "encode":
+            if extra is not None and extra[0] == "sse":
+                keys, nonces = _sse_arrays()
+                return codec.encrypt_encode_and_hash_batch(
+                    data, keys, nonces, extra[2], algo,
+                    stage_cb=stage_cb)
             return codec.encode_and_hash_batch(data, algo,
                                                stage_cb=stage_cb)
         if verb == "decode":
+            if len(extra) > 2 and extra[2] == "sse":
+                keys, nonces = _sse_arrays()
+                return codec.verify_decode_decrypt_batch(
+                    data, extra[0], extra[1], keys, nonces, extra[4],
+                    algo, stage_cb=stage_cb)
             mask, shard_len = extra
             return codec.verify_and_decode_batch(data, mask, shard_len,
                                                  algo, stage_cb=stage_cb)

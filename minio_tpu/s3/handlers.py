@@ -23,6 +23,7 @@ import xml.etree.ElementTree as ET
 from email.utils import formatdate, parsedate_to_datetime
 from typing import Callable, Iterator, Optional
 
+from ..features import crypto as sse
 from ..object import api_errors as oerr
 from ..object.bucket_metadata import BucketMetadataSys
 from ..object.engine import GetOptions, PutOptions
@@ -242,7 +243,6 @@ class S3ApiHandlers:
         # resolved SSE-S3 object keys per upload (bounds KMS round
         # trips to one per upload, not one per part)
         self._mpu_keys: "OrderedDict[str, tuple]" = OrderedDict()
-        from ..features import crypto as sse
         self.kms = sse.kms_from_env()        # SSE-S3 KMS seam
         self.compression_enabled = os.environ.get(
             "MINIO_COMPRESS", "").lower() in ("on", "true", "1")
@@ -1255,7 +1255,7 @@ class S3ApiHandlers:
         metadata = _extract_metadata(ctx)
         if ctx.header("x-amz-tagging"):
             metadata["X-Amz-Tagging"] = ctx.header("x-amz-tagging")
-        reader, size, sse_headers = self._apply_put_transforms(
+        reader, size, sse_headers, sse_spec = self._apply_put_transforms(
             ctx, key, reader, size, metadata)
         # object lock: explicit headers win; else the bucket default
         from ..features import objectlock as olock
@@ -1269,7 +1269,8 @@ class S3ApiHandlers:
             bucket, key, reader, size,
             PutOptions(metadata=metadata, versioned=versioned,
                        parity=self._parity_for(
-                           ctx.header("x-amz-storage-class"))))
+                           ctx.header("x-amz-storage-class")),
+                       sse_spec=sse_spec))
         # Count the client bytes actually received: `size` is the
         # resolved payload length (decoded length for aws-chunked
         # streams), unlike Content-Length (framing included) or
@@ -1325,20 +1326,23 @@ class S3ApiHandlers:
                               ) -> tuple:
         """Compression + SSE wrapping of the PUT stream (reference
         newS2CompressReader + EncryptRequest wiring,
-        cmd/object-handlers.go:1452-1470)."""
-        from ..features import crypto as sse
+        cmd/object-handlers.go:1452-1470). Returns (reader, size,
+        response headers, sse_spec) — sse_spec rides PutOptions into
+        the engine when the fused device cipher path takes the
+        stream instead of a CPU transform here."""
         ssec_key = sse.parse_ssec_headers(ctx.header)
         sse_s3 = self._sse_s3_requested(ctx, ssec_key)
         compress = (self.compression_enabled
                     and sse.is_compressible(
                         key, metadata.get("content-type", "")))
         if ssec_key is None and not sse_s3 and not compress:
-            return reader, size, {}
-        reader2, size2 = sse.setup_put_transforms(
+            return reader, size, {}, None
+        reader2, size2, spec = sse.setup_put_transforms(
             key_name=key, raw_reader=reader, raw_size=size,
             metadata=metadata, ssec_key=ssec_key, sse_s3=sse_s3,
             kms=self.kms, compress=compress,
-            compress_algo=self._compress_algo())
+            compress_algo=self._compress_algo(),
+            device_sse=getattr(self.obj, "supports_sse_device", False))
         headers = {}
         if sse_s3:
             headers["x-amz-server-side-encryption"] = "AES256"
@@ -1347,7 +1351,7 @@ class S3ApiHandlers:
                 "AES256"
             headers["x-amz-server-side-encryption-customer-key-md5"] = \
                 metadata.get(sse.MK_KEYMD5, "")
-        return reader2, size2, headers
+        return reader2, size2, headers, spec
 
     def _obj_response_headers(self, info: ObjectInfo) -> dict[str, str]:
         from ..storage import datatypes as dt
@@ -1417,7 +1421,6 @@ class S3ApiHandlers:
         if short is not None:
             return HTTPResponse(status=short,
                                 headers=self._obj_response_headers(info))
-        from ..features import crypto as sse
         md = info.user_defined or {}
         if md.get(sse.MK_SSE) or sse.stored_compression(md):
             return self._get_transformed(ctx, bucket, key, info, opts, md)
@@ -1447,7 +1450,6 @@ class S3ApiHandlers:
                                 bucket, stream))
 
     def _compress_algo(self) -> str:
-        from ..features import crypto as sse
         return sse.COMPRESS_ZSTD if self.compression_algorithm == \
             "zstd" else sse.COMPRESS_S2
 
@@ -1457,7 +1459,6 @@ class S3ApiHandlers:
         covering package range / decompress, then trim to the requested
         plaintext range (reference DecryptBlocksRequestR + s2 reader
         stack, cmd/object-api-utils.go:626-697)."""
-        from ..features import crypto as sse
         enc = sse.resolve_get_key(md, ctx.header, self.kms)
         compressed = bool(sse.stored_compression(md))
         actual = self._plain_size(info, md)
@@ -1474,14 +1475,28 @@ class S3ApiHandlers:
         elif compressed:
             # compressed payloads have no random access: decode from the
             # start and skip (the reference's s2 path does the same)
-            _, stream = self.obj.get_object(bucket, key, 0, info.size,
-                                            opts)
-            if enc is not None:
-                stream = sse.decrypt_stream(stream, enc[0], enc[1])
+            if enc is not None and \
+                    sse.stored_sse_cipher(md) == sse.CIPHER_CHACHA:
+                stream = self._chacha_full_stream(bucket, key, info,
+                                                  opts, enc)
+            else:
+                _, stream = self.obj.get_object(bucket, key, 0,
+                                                info.size, opts)
+                if enc is not None:
+                    stream = sse.decrypt_stream(stream, enc[0], enc[1])
             stream = sse.decompress_stream(
                     stream, sse.stored_compression(md)
                     or sse.COMPRESS_ZSTD)
             stream = _skip_take(stream, offset, length)
+        elif sse.stored_sse_cipher(md) == sse.CIPHER_CHACHA:
+            # detached-tag stream: ciphertext offsets match plaintext
+            # 1:1 and the tag trailer sits at the end — the ranged
+            # helper pulls both through the fetch seam and verifies
+            # every covering package BEFORE its keystream XOR
+            stream = sse.chacha_decrypt_ranged(
+                self._obj_fetch(bucket, key, opts), info.size,
+                enc[0], enc[1], offset, length)
+            stream = _skip_take(stream, offset % sse.PKG_SIZE, length)
         else:
             # package-aligned ciphertext range
             pkg_full = sse.PKG_SIZE + sse.TAG_SIZE
@@ -1530,7 +1545,6 @@ class S3ApiHandlers:
         1000-part upload must not make 1000 of them. SSE-C is NEVER
         cached: each part request must present (and re-verify) the
         client's key headers."""
-        from ..features import crypto as sse
         if md.get(sse.MK_SSE) != "S3":
             return sse.resolve_get_key(md, ctx.header, self.kms)
         cache_key = f"{bucket}/{key}/{upload_id}"
@@ -1555,6 +1569,28 @@ class S3ApiHandlers:
                           "supported (use AES256)")
         return True
 
+    def _obj_fetch(self, bucket, key, opts, base: int = 0):
+        """fetch(off, len) -> stored-byte chunk iterator, the read seam
+        chacha_decrypt_ranged pulls ciphertext and tag-trailer ranges
+        through (offset by `base` for a part inside a multipart
+        object)."""
+        def fetch(off, ln):
+            _, st = self.obj.get_object(bucket, key, base + off, ln,
+                                        opts)
+            return st
+        return fetch
+
+    def _chacha_full_stream(self, bucket, key, info, opts, enc
+                            ) -> Iterator[bytes]:
+        """Whole-object verify-then-decrypt of a detached-tag chacha
+        stream (the cipher's plaintext length comes from the stored
+        size — under compression it is the compressed length, which
+        metadata does not record)."""
+        ct_len, _ = sse.chacha_ct_len(info.size)
+        return sse.chacha_decrypt_ranged(
+            self._obj_fetch(bucket, key, opts), info.size,
+            enc[0], enc[1], 0, ct_len)
+
     def _plaintext_stream(self, bucket, key, info, header, opts
                           ) -> tuple[Iterator[bytes], int]:
         """Full plaintext stream + size of a stored object, decrypting
@@ -1564,7 +1600,6 @@ class S3ApiHandlers:
         _get_transformed). `header` is a callable(name, default="")
         supplying SSE-C key headers; without them an SSE-C object
         raises AccessDenied from resolve_get_key."""
-        from ..features import crypto as sse
         md = info.user_defined or {}
         if not (md.get(sse.MK_SSE) or sse.stored_compression(md)):
             _, stream = self.obj.get_object(bucket, key, 0, info.size,
@@ -1576,10 +1611,15 @@ class S3ApiHandlers:
             return (self._mp_decrypt_stream(opts, bucket, key, info,
                                             enc, 0, plain_size),
                     plain_size)
-        _, stream = self.obj.get_object(bucket, key, 0, info.size,
-                                        opts)
-        if enc is not None:
-            stream = sse.decrypt_stream(stream, enc[0], enc[1])
+        if enc is not None and \
+                sse.stored_sse_cipher(md) == sse.CIPHER_CHACHA:
+            stream = self._chacha_full_stream(bucket, key, info, opts,
+                                              enc)
+        else:
+            _, stream = self.obj.get_object(bucket, key, 0, info.size,
+                                            opts)
+            if enc is not None:
+                stream = sse.decrypt_stream(stream, enc[0], enc[1])
         if sse.stored_compression(md):
             stream = sse.decompress_stream(
                     stream, sse.stored_compression(md)
@@ -1605,7 +1645,6 @@ class S3ApiHandlers:
 
     @staticmethod
     def _plain_size(info, md: dict) -> int:
-        from ..features import crypto as sse
         if md.get(sse.MK_SSE_MP) and info.parts:
             return sum(p.actual_size for p in info.parts)
         return int(md.get(sse.MK_ACTUAL, info.size))
@@ -1613,9 +1652,12 @@ class S3ApiHandlers:
     def _mp_decrypt_stream(self, opts, bucket, key, info, enc,
                            offset: int, length: int) -> Iterator[bytes]:
         """Decrypt a multipart-SSE object across part boundaries
-        (DecryptBlocksRequestR's part walk, cmd/encryption-v1.go:356)."""
-        from ..features import crypto as sse
+        (DecryptBlocksRequestR's part walk, cmd/encryption-v1.go:356).
+        Each part is an independent package stream under a per-part
+        nonce — either cipher's layout, per the object's metadata."""
         pkg_full = sse.PKG_SIZE + sse.TAG_SIZE
+        chacha = sse.stored_sse_cipher(info.user_defined or {}) == \
+            sse.CIPHER_CHACHA
 
         def gen():
             remaining = length
@@ -1634,15 +1676,22 @@ class S3ApiHandlers:
                 in_off = want - plain_start
                 in_len = min(remaining, psize - in_off)
                 start_pkg = in_off // sse.PKG_SIZE
-                end_pkg = (in_off + in_len - 1) // sse.PKG_SIZE
-                coff = cipher_start + start_pkg * pkg_full
-                clen = min(csize - start_pkg * pkg_full,
-                           (end_pkg - start_pkg + 1) * pkg_full)
-                _, stream = self.obj.get_object(bucket, key, coff, clen,
-                                                opts)
-                pt = sse.decrypt_stream(
-                    stream, enc[0], sse.part_nonce(enc[1], p.number),
-                    start_seq=start_pkg)
+                if chacha:
+                    pt = sse.chacha_decrypt_ranged(
+                        self._obj_fetch(bucket, key, opts,
+                                        base=cipher_start),
+                        csize, enc[0], sse.part_nonce(enc[1], p.number),
+                        in_off, in_len)
+                else:
+                    end_pkg = (in_off + in_len - 1) // sse.PKG_SIZE
+                    coff = cipher_start + start_pkg * pkg_full
+                    clen = min(csize - start_pkg * pkg_full,
+                               (end_pkg - start_pkg + 1) * pkg_full)
+                    _, stream = self.obj.get_object(bucket, key, coff,
+                                                    clen, opts)
+                    pt = sse.decrypt_stream(
+                        stream, enc[0], sse.part_nonce(enc[1], p.number),
+                        start_seq=start_pkg)
                 yield from _skip_take(pt,
                                       in_off - start_pkg * sse.PKG_SIZE,
                                       in_len)
@@ -1654,7 +1703,6 @@ class S3ApiHandlers:
         return gen()
 
     def _sse_response_headers(self, md: dict) -> dict:
-        from ..features import crypto as sse
         mode = md.get(sse.MK_SSE, "")
         if mode == "S3":
             return {"x-amz-server-side-encryption": "AES256"}
@@ -1674,7 +1722,6 @@ class S3ApiHandlers:
         info = self.obj.get_object_info(bucket, key, opts)
         short = self._check_preconditions(ctx, info)
         headers = self._obj_response_headers(info)
-        from ..features import crypto as sse
         md = info.user_defined or {}
         if md.get(sse.MK_SSE) or sse.stored_compression(md):
             if md.get(sse.MK_SSE) == "C":
@@ -1839,7 +1886,6 @@ class S3ApiHandlers:
         if csnm and csnm.strip('"') == src_info.etag:
             raise S3Error("PreconditionFailed")
         directive = ctx.header("x-amz-metadata-directive", "COPY")
-        from ..features import crypto as sse
         src_md = src_info.user_defined or {}
         src_transformed = bool(src_md.get(sse.MK_SSE)
                                or sse.stored_compression(src_md))
@@ -1890,14 +1936,17 @@ class S3ApiHandlers:
                 plain_stream = iter([b"".join(plain_stream)])
             reader = HashReader(_IterStream(plain_stream), plain_size)
             metadata["etag"] = src_info.etag
-            reader2, size2 = sse.setup_put_transforms(
+            reader2, size2, spec = sse.setup_put_transforms(
                 key_name=key, raw_reader=reader, raw_size=plain_size,
                 metadata=metadata, ssec_key=tgt_ssec, sse_s3=tgt_sse_s3,
-                kms=self.kms, compress=False)
+                kms=self.kms, compress=False,
+                device_sse=getattr(self.obj, "supports_sse_device",
+                                   False))
             versioned = self.bucket_meta.versioning_enabled(bucket)
             info = self.obj.put_object(
                 bucket, key, reader2, size2,
-                PutOptions(metadata=metadata, versioned=versioned))
+                PutOptions(metadata=metadata, versioned=versioned,
+                           sse_spec=spec))
             headers = {}
             if info.version_id and info.version_id != "null":
                 headers["x-amz-version-id"] = info.version_id
@@ -1934,7 +1983,6 @@ class S3ApiHandlers:
         metadata = _extract_metadata(ctx)
         # SSE multipart: seal one object key now; every part encrypts
         # under it with a per-part nonce space
-        from ..features import crypto as sse
         ssec_key = sse.parse_ssec_headers(ctx.header)
         sse_s3 = self._sse_s3_requested(ctx, ssec_key)
         if (ssec_key is not None or sse_s3) and not getattr(
@@ -1968,14 +2016,15 @@ class S3ApiHandlers:
         # plaintext length, aws-chunked included
         self._enforce_quota(bucket, size)
         # SSE upload: encrypt the part under the session's object key
-        from ..features import crypto as sse
         md = self._multipart_meta(bucket, key, upload_id)
         if md.get(sse.MK_SSE):
             enc = self._mpu_sse_key(bucket, key, upload_id, md, ctx)
-            reader = sse.PutObjReader(
-                reader, [sse.Encryptor(enc[0],
-                                       sse.part_nonce(enc[1],
-                                                      part_number))])
+            pnonce = sse.part_nonce(enc[1], part_number)
+            if sse.stored_sse_cipher(md) == sse.CIPHER_CHACHA:
+                transform = sse.ChaChaEncryptor(enc[0], pnonce)
+            else:
+                transform = sse.Encryptor(enc[0], pnonce)
+            reader = sse.PutObjReader(reader, [transform])
             size = -1
         part = self.obj.put_object_part(bucket, key, upload_id,
                                         part_number, reader, size)
@@ -1993,7 +2042,6 @@ class S3ApiHandlers:
             part_number = int(ctx.query1("partNumber"))
         except ValueError:
             raise S3Error("InvalidArgument", "partNumber must be an int")
-        from ..features import crypto as sse
         if self._multipart_meta(bucket, key,
                                 upload_id).get(sse.MK_SSE):
             raise S3Error("NotImplemented",
@@ -2137,21 +2185,9 @@ class S3ApiHandlers:
         # decrypt/decompress transparently via the transformed GET path
         # (self.obj may be the hot-object read cache: a cached Select
         # source serves without touching the erasure decode path)
-        from ..features import crypto as sse
-        md = info.user_defined or {}
-        if md.get(sse.MK_SSE) or sse.stored_compression(md):
-            enc = sse.resolve_get_key(md, ctx.header, self.kms)
-            _, stream = self.obj.get_object(bucket, key, 0, info.size)
-            if enc is not None:
-                stream = sse.decrypt_stream(stream, enc[0], enc[1])
-            if sse.stored_compression(md):
-                stream = sse.decompress_stream(
-                    stream, sse.stored_compression(md)
-                    or sse.COMPRESS_ZSTD)
-            data = b"".join(stream)
-        else:
-            _, stream = self.obj.get_object(bucket, key, 0, info.size)
-            data = b"".join(stream)
+        stream, _size = self._plaintext_stream(bucket, key, info,
+                                               ctx.header, GetOptions())
+        data = b"".join(stream)
         # device scan plane: compiled-kernel predicate scan through the
         # batch former, CPU evaluator as byte-identical fallback
         body = self.scan.event_stream(req, data) \

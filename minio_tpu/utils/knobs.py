@@ -199,6 +199,23 @@ define("MINIO_TPU_SCHED_ATTRIB", "bool", True,
        "compute/fetch histograms + child spans) — the overhead A/B "
        "escape hatch", _S)
 
+_S = "SSE device path"
+define("MINIO_TPU_SSE_CIPHER", "str", "aes-gcm",
+       "package cipher for NEW SSE writes: `aes-gcm` (CPU DARE "
+       "packages) or `chacha20` (ChaCha20-Poly1305, device-fusable); "
+       "reads dispatch on each object's recorded cipher", _S)
+define("MINIO_TPU_SSE_DEVICE", "str", "on",
+       "`off` pins chacha20 SSE to the CPU stage (byte-identical "
+       "stream); `on` fuses cipher+RS+digest into one device launch "
+       "per PUT batch when a device is present", _S)
+define("MINIO_TPU_SSE_DEVICE_MIN_BYTES", "int", 1 << 20,
+       "smallest PUT (stated size) that rides the fused SSE device "
+       "path; smaller or unknown-length streams stay on the CPU "
+       "cipher", _S, display="1 MiB")
+define("MINIO_TPU_SSE_DEVICE_MAX_BYTES", "int", 0,
+       "upper bound of the fused-SSE size window (device-capacity "
+       "guard); 0 = unbounded", _S)
+
 _S = "Server"
 define("MINIO_TPU_MAX_CLIENTS", "int", 0,
        "admission-gate size; 0 derives it from the RAM+CPU budget", _S,

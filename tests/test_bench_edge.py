@@ -19,7 +19,10 @@ def test_ab_edge_smoke_shape():
     # the load phase ran over them
     assert ab["idle_conn_ratio_x"] >= 20.0
     assert ab["edge"]["idle"]["conns"] >= 60
-    assert ab["edge"]["idle"]["threads_delta"] == 0
+    # no thread PER CONNECTION: 60 held conns must not add ~60 threads.
+    # A strict ==0 flakes when an unrelated lazily-started background
+    # thread (engine flusher, MRF lane) races the measurement window.
+    assert ab["edge"]["idle"]["threads_delta"] <= 2
     assert ab["edge"]["idle"]["alive_after_load"] is True
     assert ab["threaded"]["idle"]["alive_after_load"] is True
     for side in ("edge", "threaded"):

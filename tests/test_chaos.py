@@ -582,3 +582,87 @@ def test_chaos_transition_with_naughty_source_drives(tmp_path):
         nd.disarm()
     worker.close()
     sets.close()
+
+
+# ---------------------------------------------------------------------------
+# encrypted shards under bitrot: reconstruct or clean auth error — NEVER
+# silently corrupted plaintext
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("device", [False, True])
+def test_chaos_bitrot_on_encrypted_shards(tmp_path, monkeypatch, device):
+    """NaughtyDisk bitrot under an encrypted object has exactly two
+    legal outcomes: the shard digests catch the flip and the erasure
+    layer reconstructs (plaintext byte-identical), or — when too many
+    drives rot to reconstruct — the read fails with a clean error
+    (erasure quorum or Poly1305 auth). A success that returns WRONG
+    plaintext is the one forbidden outcome, on both cipher paths
+    (device-fused PUT and the CPU fallback)."""
+    from minio_tpu.features import crypto as sse
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object import engine as engine_mod
+
+    if device:
+        monkeypatch.setattr(codec_mod, "_IS_TPU", True)
+        monkeypatch.setattr(codec_mod, "DEVICE_MIN_BYTES", 0)
+        monkeypatch.setenv("MINIO_TPU_SSE_DEVICE_MIN_BYTES", "0")
+    seed = chaos_seed(7801)
+    announce(seed)
+    oek, base = bytes(range(32)), bytes(range(50, 62))
+
+    def decrypt_back(sets, name, n):
+        """Full read path: erasure GET feeds the verify-then-decrypt
+        seam exactly as the S3 handler does."""
+        def fetch(off, ln):
+            _, it = sets.get_object("b", name, off, ln)
+            return it
+        return b"".join(sse.chacha_decrypt_ranged(
+            fetch, sse.encrypted_size(n), oek, base, 0, n))[:n]
+
+    # phase 1: bitrot on <= parity drives -> reconstruct, byte-identical
+    sched = {j: FaultSchedule(seed=seed + j, bitrot_rate=0.35,
+                              fault_verbs=("read_file",
+                                           "read_file_stream"))
+             for j in range(M)}
+    sets, naughty = make_chaos_sets(tmp_path / "lo", sched)
+    datas = {}
+    for i, n in enumerate((1000, BLOCK + 17, 2 * BLOCK + 999)):
+        data = payload(n, seed=seed + i)
+        sets.put_object("b", f"e{i}", data,
+                        opts=engine_mod.PutOptions(
+                            sse_spec=sse.DeviceSSE(oek, base)))
+        datas[f"e{i}"] = data
+    for nd in naughty:
+        nd.arm()
+    for name, data in datas.items():
+        assert decrypt_back(sets, name, len(data)) == data, name
+    for nd in naughty:
+        nd.disarm()
+    sets.close()
+
+    # phase 2: bitrot past parity -> clean failure or correct bytes,
+    # never a silent wrong-plaintext success
+    sched = {j: FaultSchedule(seed=seed + 100 + j, bitrot_rate=1.0,
+                              fault_verbs=("read_file",
+                                           "read_file_stream"))
+             for j in range(M + 1)}
+    sets, naughty = make_chaos_sets(tmp_path / "hi", sched)
+    n = BLOCK + 4321
+    data = payload(n, seed=seed + 9)
+    sets.put_object("b", "hot", data,
+                    opts=engine_mod.PutOptions(
+                        sse_spec=sse.DeviceSSE(oek, base)))
+    for nd in naughty:
+        nd.arm()
+    try:
+        got = decrypt_back(sets, "hot", n)
+    except Exception as exc:  # noqa: BLE001 — ANY clean error is legal
+        # quorum/bitrot error from the erasure layer, or the Poly1305
+        # trailer refusing the corrupt ciphertext: both are clean
+        # failures; the test only forbids garbled plaintext below
+        print(f"clean failure (ok): {type(exc).__name__}: {exc}")
+    else:
+        assert got == data, "silent plaintext corruption leaked through"
+    for nd in naughty:
+        nd.disarm()
+    sets.close()

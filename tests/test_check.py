@@ -807,3 +807,70 @@ def test_deadline_rule_clean_on_tree():
         src = by_rel.get(v.path)
         left.extend(filter_allowed(src, [v]) if src else [v])
     assert left == []
+
+
+# ---------------------------------------------------------------------------
+# rule: crypto-hygiene
+# ---------------------------------------------------------------------------
+
+BAD_CRYPTO = '''
+from ..ops import chacha20_ref
+from ..ops.chacha20_ref import tag_detached
+from ..features.crypto import _pkg_nonce
+
+def rogue_nonce(base, seq):
+    # hand-rolled seq mixing: the exact bug class the rule forbids
+    return _pkg_nonce(base, seq)
+
+def rogue_tag(key, nonce, aad, ct):
+    return tag_detached(key, nonce, aad, ct)
+
+def rogue_xor(data, key, nonce):
+    return chacha20_ref.xor_stream(data, key, nonce)
+'''
+
+GOOD_CRYPTO = '''
+from ..features import crypto as sse
+
+def fine(oek, base, data):
+    enc = sse.ChaChaEncryptor(oek, base)
+    return enc.update(data) + enc.finalize()
+'''
+
+
+def test_crypto_hygiene_fires_on_rogue_primitive_use():
+    vs = rules_project.check_crypto_hygiene(
+        [_src("minio_tpu/s3/handlers.py", BAD_CRYPTO)])
+    msgs = "\n".join(v.message for v in vs)
+    assert "chacha20_ref" in msgs
+    assert "_pkg_nonce" in msgs or "tag_detached" in msgs
+    # 3 rogue imports + 3 rogue calls
+    assert len(vs) >= 5
+
+
+def test_crypto_hygiene_quiet_on_owner_and_consumers():
+    # the owner derives nonces and drives the AEAD reference freely
+    assert rules_project.check_crypto_hygiene(
+        [_src("minio_tpu/features/crypto.py", BAD_CRYPTO)]) == []
+    # the fused device programs may import the jax kernels (keystream
+    # over nonce arrays crypto.py already derived)
+    assert rules_project.check_crypto_hygiene(
+        [_src("minio_tpu/models/pipeline.py",
+              "from ..ops import chacha20_jax\n")]) == []
+    # high-level transform consumers are clean
+    assert rules_project.check_crypto_hygiene(
+        [_src("minio_tpu/s3/handlers.py", GOOD_CRYPTO)]) == []
+
+
+def test_crypto_hygiene_clean_on_tree():
+    """Package nonces are derived only inside features/crypto.py in the
+    committed tree — the satellite's deliverable."""
+    from check.core import filter_allowed, load_sources
+    sources = load_sources()
+    by_rel = {s.rel: s for s in sources}
+    vs = rules_project.check_crypto_hygiene(sources)
+    left = []
+    for v in vs:
+        src = by_rel.get(v.path)
+        left.extend(filter_allowed(src, [v]) if src else [v])
+    assert left == []

@@ -499,3 +499,77 @@ def check_fencing(sources: List[Source]) -> List[Violation]:
                     "split-brain; call _advance_lineage() under the "
                     "same lock or argue the exemption inline"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: crypto-hygiene
+# ---------------------------------------------------------------------------
+
+# SSE package nonces and AEAD primitives have ONE owner. features/crypto.py
+# derives every per-package nonce (_pkg_nonce: base words XOR seq) and is
+# the only module that drives the scalar AEAD reference; a second
+# derivation site is how nonce-reuse bugs are born (two modules disagree
+# on the seq mixing and a keystream repeats under one key). Everything
+# else consumes the high-level transforms crypto.py exports (Encryptor,
+# ChaChaEncryptor, DeviceSSE, chacha_decrypt_ranged, seal/unseal).
+CRYPTO_OWNER = "minio_tpu/features/crypto.py"
+
+# AEAD / nonce-construction primitives nobody else may touch
+CRYPTO_PRIMS = frozenset({
+    "_pkg_nonce", "_pkg_aad", "tag_detached", "seal_detached",
+    "open_detached", "poly1305_mac", "poly1305_key_gen", "xor_stream",
+    "chacha20_block",
+})
+
+# primitive modules and who may import them: the scalar reference is
+# crypto.py-only; the device kernels additionally feed the fused
+# put/get programs in models/pipeline.py (keystream generation over
+# nonce ARRAYS crypto.py already derived — no derivation happens there)
+CHACHA_IMPORTERS = {
+    "chacha20_ref": (CRYPTO_OWNER,),
+    "chacha20_jax": (CRYPTO_OWNER, "minio_tpu/models/pipeline.py"),
+}
+
+# the primitive modules themselves (definitions, not use)
+_CRYPTO_PRIM_FILES = ("minio_tpu/ops/chacha20_ref.py",
+                      "minio_tpu/ops/chacha20_jax.py")
+
+
+def check_crypto_hygiene(sources: List[Source]) -> List[Violation]:
+    out: List[Violation] = []
+    exempt = {CRYPTO_OWNER, *_CRYPTO_PRIM_FILES}
+    for src in sources:
+        if src.rel in exempt:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = {a.name for a in node.names}
+                for prim_mod, allowed in CHACHA_IMPORTERS.items():
+                    if (mod.endswith(prim_mod) or prim_mod in names) \
+                            and src.rel not in allowed:
+                        out.append(Violation(
+                            "crypto-hygiene", src.rel, node.lineno,
+                            f"import of {prim_mod} outside its owner"
+                            f" ({', '.join(allowed)}) — consume the "
+                            "high-level transforms features/crypto.py "
+                            "exports instead of the raw primitives"))
+                hit = names & CRYPTO_PRIMS
+                if hit:
+                    out.append(Violation(
+                        "crypto-hygiene", src.rel, node.lineno,
+                        f"direct import of AEAD/nonce primitive "
+                        f"{sorted(hit)[0]}() — package nonces are "
+                        "derived ONLY inside features/crypto.py; a "
+                        "second derivation site risks nonce reuse"))
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func)
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in CRYPTO_PRIMS:
+                    out.append(Violation(
+                        "crypto-hygiene", src.rel, node.lineno,
+                        f"call to {leaf}() outside features/crypto.py "
+                        "— SSE nonce construction and AEAD primitives "
+                        "have one owner; use the crypto-module "
+                        "transforms (or argue the exemption inline)"))
+    return out

@@ -89,6 +89,8 @@ def run_checks(rules=None):
         vs += rules_ast.check_deadline(sources)
     if "fencing" in selected:
         vs += rules_project.check_fencing(sources)
+    if "crypto-hygiene" in selected:
+        vs += rules_project.check_crypto_hygiene(sources)
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
