@@ -2426,6 +2426,164 @@ def bench_obs_ab(streams: int = 8, size: int = 1 << 20,
     return out
 
 
+def bench_incident_ab(streams: int = 8, size: int = 1 << 20,
+                      drives: int = 6, parity: int = 2,
+                      block: int = 1 << 18, put_rounds: int = 4,
+                      gets: int = 64) -> dict:
+    """Incident-plane A/B (ISSUE 18): what the always-on journal +
+    SLO engine cost the foreground, and how fast the black box closes.
+
+    Phase 1 — foreground overhead: concurrent signed HTTP PUT and GET
+    p50/p99 with MINIO_TPU_EVENTLOG + MINIO_TPU_SLO off, then on (SLO
+    evaluator running). The journal is designed to be always-on in
+    production, so put_p99_overhead_x is the number that must stay
+    ~1.0 (acceptance: <= 1.05).
+
+    Phase 2 — capture latency: with the plane on, a seeded trigger
+    event (drive.probation) is emitted and the wall time until the
+    flight recorder's bundle lands on disk is reported, along with
+    the bundle's journal/span content counts."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+    import urllib.parse
+
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.admin import mount_admin
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.utils import eventlog, incidents, slo
+
+    creds = Credentials("benchinckey123", "benchincsecret1")
+    region = "us-east-1"
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "put_rounds": put_rounds, "gets": gets}}
+
+    def pcts(lat: list[float]) -> dict:
+        lat = sorted(lat)
+        return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(lat[min(int(len(lat) * 0.99),
+                                        len(lat) - 1)] * 1e3, 3)}
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_inc_", dir=base)
+    payload = os.urandom(size)
+    knob_names = ("MINIO_TPU_EVENTLOG", "MINIO_TPU_SLO")
+    saved = {k: os.environ.get(k) for k in knob_names}
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)
+        srv = S3Server(sets, creds=creds, region=region).start()
+        mount_admin(srv)
+        try:
+            def signed(method, path, port, payload_hash, extra=None):
+                hdrs = {"host": f"127.0.0.1:{port}"}
+                hdrs.update(extra or {})
+                return sig.sign_v4(method, urllib.parse.quote(path),
+                                   {}, hdrs, payload_hash, creds,
+                                   region)
+
+            assert _http_put(srv.port, "/bench-inc", b"", signed,
+                             creds) == 200
+            assert _http_put(srv.port, "/bench-inc/warm", payload,
+                             signed, creds) == 200   # engine warm-up
+
+            def put_round(prefix: str) -> list[float]:
+                lat: list[float] = []
+                mu = threading.Lock()
+
+                def one(i: int) -> None:
+                    t0 = time.perf_counter()
+                    st = _http_put(srv.port,
+                                   f"/bench-inc/{prefix}-{i}",
+                                   payload, signed, creds)
+                    dt = time.perf_counter() - t0
+                    assert st == 200, st
+                    with mu:
+                        lat.append(dt)
+
+                for r in range(put_rounds):
+                    with cf.ThreadPoolExecutor(
+                            max_workers=streams) as ex:
+                        list(ex.map(one, range(r * streams,
+                                               (r + 1) * streams)))
+                return lat
+
+            def get_round() -> list[float]:
+                import hashlib
+                import http.client
+                lat: list[float] = []
+                for i in range(gets):
+                    t0 = time.perf_counter()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", srv.port, timeout=60)
+                    hdrs = signed("GET", "/bench-inc/warm", srv.port,
+                                  hashlib.sha256(b"").hexdigest())
+                    conn.request("GET", "/bench-inc/warm",
+                                 headers=hdrs)
+                    resp = conn.getresponse()
+                    resp.read()
+                    conn.close()
+                    assert resp.status == 200, resp.status
+                    lat.append(time.perf_counter() - t0)
+                return lat
+
+            for mode, flag in (("off", "off"), ("on", "on")):
+                for k in knob_names:
+                    os.environ[k] = flag
+                if mode == "on":
+                    slo.ENGINE.ensure_started()
+                out.setdefault("put", {})[mode] = pcts(
+                    put_round(mode))
+                out.setdefault("get", {})[mode] = pcts(get_round())
+            out["put_p99_overhead_x"] = round(
+                out["put"]["on"]["p99_ms"]
+                / max(out["put"]["off"]["p99_ms"], 1e-9), 3)
+            out["get_p99_overhead_x"] = round(
+                out["get"]["on"]["p99_ms"]
+                / max(out["get"]["off"]["p99_ms"], 1e-9), 3)
+
+            # -- phase 2: seeded-fault capture timing ------------------
+            incidents.RECORDER.attach(os.path.join(root, "incidents"))
+            known = {i["id"] for i in incidents.RECORDER.list()}
+            t0 = time.perf_counter()
+            eventlog.emit("drive.probation", drive=f"{root}/d0",
+                          set=0)
+            bundle = None
+            while time.perf_counter() - t0 < 10.0:
+                fresh = [i for i in incidents.RECORDER.list()
+                         if i["id"] not in known]
+                if fresh:
+                    bundle = incidents.RECORDER.get(fresh[0]["id"])
+                    break
+                time.sleep(0.005)
+            capture_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            out["capture"] = {
+                "trigger": "drive.probation",
+                "captured": bundle is not None,
+                "capture_ms": capture_ms,
+                "journal_events": len((bundle or {}).get("events",
+                                                        ())),
+                "slow_spans": len((bundle or {}).get("slow_spans",
+                                                     ())),
+            }
+        finally:
+            srv.stop()
+            sets.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _read_resp(sock) -> int:
     """Read one HTTP response off a raw socket; returns the status."""
     buf = b""
@@ -2590,6 +2748,14 @@ def main() -> int:
                     help="tiny observability A/B (2 streams, 256 KiB "
                          "objects, 2 node counts) for CI — seconds, "
                          "not minutes")
+    ap.add_argument("--ab-incident", action="store_true",
+                    help="run ONLY the incident-plane A/B: foreground "
+                         "PUT/GET p50/p99 with the event journal + "
+                         "SLO engine off vs on, plus seeded-fault "
+                         "capture-to-bundle latency")
+    ap.add_argument("--ab-incident-smoke", action="store_true",
+                    help="tiny incident A/B (2 streams, 256 KiB "
+                         "objects) for CI — seconds, not minutes")
     args = ap.parse_args()
 
     if args.ab_gray or args.ab_gray_smoke:
@@ -2643,6 +2809,24 @@ def main() -> int:
                 "put_p99_overhead_x"),
             "unit": "x",
             "obs_ab": ab,
+        }))
+        return 0
+
+    if args.ab_incident or args.ab_incident_smoke:
+        if args.ab_incident_smoke:
+            ab = bench_incident_ab(streams=2, size=1 << 18, drives=6,
+                                   put_rounds=2, gets=16,
+                                   block=1 << 16)
+        else:
+            ab = bench_incident_ab(streams=min(args.ab_streams, 8),
+                                   size=args.ab_size)
+        print(json.dumps({
+            "metric": "foreground PUT p99 overhead with the event "
+                      "journal + SLO engine on vs off (incident-plane "
+                      "A/B; capture_ms = trigger-to-bundle latency)",
+            "value": ab.get("put_p99_overhead_x"),
+            "unit": "x",
+            "incident_ab": ab,
         }))
         return 0
 

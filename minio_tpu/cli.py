@@ -98,6 +98,18 @@ def _parse(argv: list[str]) -> argparse.Namespace:
     f.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
 
+    i = sub.add_parser("incidents", help="list black-box capture "
+                       "bundles from a running node (or fetch one "
+                       "with --id)")
+    i.add_argument("--url", default="127.0.0.1:9000",
+                   help="server admin endpoint host:port")
+    i.add_argument("--id", default="",
+                   help="fetch one full bundle by incident id")
+    i.add_argument("--cluster", action="store_true",
+                   help="merge every peer's bundle list")
+    i.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
+
     g = sub.add_parser("gateway", help="serve the S3 API over a "
                        "foreign backend (cmd/gateway-main.go)")
     g.add_argument("kind", choices=("nas", "s3", "azure", "gcs",
@@ -310,6 +322,25 @@ def _run_fsck(args, creds: Credentials) -> int:
     return 0 if out.get("unrepaired", 0) == 0 else 1
 
 
+def _run_incidents(args, creds: Credentials) -> int:
+    """`minio_tpu incidents` — list capture bundles (or fetch one
+    with --id); the black box's readback."""
+    import json as _json
+    from .madmin import AdminClient, AdminClientError
+    from .utils import host_port
+    h, p = host_port(args.url, 9000)
+    cli = AdminClient(h, p, creds.access_key, creds.secret_key,
+                      region=args.region)
+    try:
+        out = cli.incident(args.id) if args.id \
+            else {"incidents": cli.incidents(cluster=args.cluster)}
+    except AdminClientError as e:
+        print(f"incidents failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
     creds = _creds()
@@ -317,6 +348,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_gateway(args, creds)
     if args.cmd == "fsck":
         return _run_fsck(args, creds)
+    if args.cmd == "incidents":
+        return _run_incidents(args, creds)
     if args.cmd == "decommission":
         return _run_decommission(args, creds)
     if args.cmd == "tier":

@@ -315,6 +315,41 @@ class ClusterNode:
         self._peer_rpc.get_metrics_text = self.admin.metrics.local_text
         self._peer_rpc.trace_hub = self.s3.api.trace.hub
 
+        # -- incident plane: event journal, SLO engine, flight recorder ----
+        # the journal persists under the first local drive (like the
+        # event-notifier backlog) so transitions survive a restart;
+        # the flight recorder subscribes to it and snapshots
+        # postmortem state on trigger events
+        from .distributed import membership as _membership
+        from .utils import eventlog, healthtrack, incidents, slo
+        if self.spec.drives:
+            eventlog.JOURNAL.attach(
+                os.path.join(self.spec.drives[0], ".minio.sys",
+                             "eventlog"),
+                node=self.spec.addr)
+            incidents.RECORDER.attach(
+                os.path.join(self.spec.drives[0], ".minio.sys",
+                             "incidents"))
+        if knobs.get_bool("MINIO_TPU_SLO"):
+            slo.ENGINE.ensure_started()
+        incidents.RECORDER.add_provider(
+            "healthtrack", lambda: {
+                "drives": healthtrack.TRACKER.snapshot("drive"),
+                "peers": healthtrack.TRACKER.snapshot("peer")})
+        incidents.RECORDER.add_provider(
+            "membership", _membership.TRACKER.snapshot)
+        incidents.RECORDER.add_provider("slo", slo.ENGINE.status)
+        incidents.RECORDER.add_provider(
+            "topology",
+            lambda: self.object_layer.topology.to_dict()
+            if getattr(self.object_layer, "topology", None) is not None
+            else {})
+        self._peer_rpc.event_hub = eventlog.JOURNAL.hub
+        self._peer_rpc.get_events = \
+            lambda: eventlog.JOURNAL.recent(500)
+        self._peer_rpc.list_incidents = incidents.RECORDER.list
+        self._peer_rpc.get_incident = incidents.RECORDER.get
+
         # -- web JSON-RPC control surface (cmd/web-router.go) --------------
         from .s3.web import mount as mount_web
         self.web = mount_web(self.s3)
@@ -598,6 +633,13 @@ class ClusterNode:
         """Idempotent; safe on a partially-booted node."""
         if getattr(self, "_iam_refresh_stop", None) is not None:
             self._iam_refresh_stop.set()
+        # persist the journal tail (flush, not close: in-process test
+        # clusters share the process-global journal across nodes)
+        from .utils import eventlog
+        try:
+            eventlog.JOURNAL.flush()
+        except Exception:  # noqa: BLE001 — best-effort on the way down
+            pass
         if getattr(self, "disk_monitor", None) is not None:
             self.disk_monitor.close()
             self.disk_monitor = None

@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from ..utils import telemetry
+from ..utils import eventlog, telemetry
 
 # request/response header names carrying (node id, generation)
 NODE_HEADER = "x-ntpu-node"
@@ -110,6 +110,8 @@ class MembershipTracker:
             cur.since = time.time()
             listeners = list(self._listeners)
         _GEN_CHANGES.inc()
+        eventlog.emit("membership.generation", peer=peer,
+                      generation=generation)
         for fn in listeners:
             try:
                 fn(peer, old, generation)

@@ -42,7 +42,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
-from ..utils import knobs, telemetry
+from ..utils import eventlog, knobs, telemetry
 from . import membership
 
 # error kind a server-side drop returns; the transport maps it to
@@ -177,6 +177,9 @@ class NaughtyNet:
             if not oneway:
                 self._rules.append(_Rule(b, a, opens, closes))
             self.enabled = True
+        eventlog.emit("net.partition",
+                      rule="oneway" if oneway else "both",
+                      peers=f"{a}|{b}")
 
     def heal(self, a: Optional[str] = None,
              b: Optional[str] = None) -> None:
@@ -185,10 +188,12 @@ class NaughtyNet:
         with self._mu:
             if a is None and b is None:
                 self._rules.clear()
-                return
-            ends = {x for x in (a, b) if x is not None}
-            self._rules = [r for r in self._rules
-                           if not ends & {r.src, r.dst}]
+            else:
+                ends = {x for x in (a, b) if x is not None}
+                self._rules = [r for r in self._rules
+                               if not ends & {r.src, r.dst}]
+        eventlog.emit("net.heal",
+                      peers=f"{a or '*'}|{b or '*'}")
 
     # -- decision points (transport hot path; enabled-flag gated there) ----
 

@@ -58,6 +58,14 @@ class PeerRPCServer:
         # live trace subscription: the TraceSys pub/sub hub (follow
         # streams subscribe; None until the cluster wires it)
         self.trace_hub = None
+        # live event-journal subscription: the EventJournal pub/sub hub
+        # (incident-plane follow streams; None until the cluster wires
+        # it) plus the recent-window / incident readback hooks
+        self.event_hub = None
+        self.get_events: Callable[[], list] = lambda: []
+        self.list_incidents: Callable[[], list] = lambda: []
+        self.get_incident: Callable[[str], Optional[dict]] = \
+            lambda inc_id: None
         self.get_storage_info: Callable[[], dict] = lambda: {}
         self.get_trace: Callable[[], list] = lambda: []
         self.get_bucket_usage: Callable[[], dict] = lambda: {}
@@ -82,6 +90,10 @@ class PeerRPCServer:
         h.register("storage-info", lambda a, b: self.get_storage_info())
         h.register("trace", lambda a, b: self.get_trace())
         h.register("trace-stream", self._trace_stream)
+        h.register("events", lambda a, b: self.get_events())
+        h.register("event-stream", self._event_stream)
+        h.register("incidents", lambda a, b: self.list_incidents())
+        h.register("incident", self._incident)
         h.register("bucket-usage", lambda a, b: self.get_bucket_usage())
         # profiling fan-out (cmd/admin-handlers.go:461-525 peer verbs),
         # console-log ring, OBD bundle (peer-rest-common.go:29-56)
@@ -130,6 +142,35 @@ class PeerRPCServer:
                     yield (json.dumps(entry) + "\n").encode()
 
         return gen()
+
+    def _event_stream(self, args, body):
+        """Live event-journal subscription (the peer half of a
+        cluster-wide /events?follow=1): same ND-JSON + heartbeat +
+        max_s contract as _trace_stream, fed by the EventJournal
+        hub."""
+        if self.event_hub is None:
+            return b""
+        try:
+            max_s = float(args.get("max_s", "3600") or 3600)
+        except ValueError:
+            max_s = 3600.0
+        hub = self.event_hub
+
+        def gen():
+            deadline = time.monotonic() + max(max_s, 1.0)
+            with hub.subscribe() as sub:
+                while time.monotonic() < deadline:
+                    entry = sub.get(timeout=1.0)
+                    if entry is None:
+                        yield b"\n"              # heartbeat
+                        continue
+                    yield (json.dumps(entry) + "\n").encode()
+
+        return gen()
+
+    def _incident(self, args, body):
+        doc = self.get_incident(args.get("id", ""))
+        return doc if isinstance(doc, dict) else {}
 
     def _profiling_start(self, args, body):
         from ..utils import profiling
@@ -305,6 +346,47 @@ class PeerRPCClient:
         except (NetworkError, RPCError):
             return None
         return _TraceLineIter(resp, self.addr)
+
+    def event_stream(self, max_s: float = 3600.0):
+        """Open this peer's live event-journal subscription — same
+        contract as trace_stream (entry-dict iterator or None;
+        `.close()` tears the connection down)."""
+        if self._shed():
+            return None
+        try:
+            resp = self.rc.call("event-stream",
+                                {"max_s": str(max_s)},
+                                stream_response=True,
+                                deadline=max(max_s, 60.0))
+        except (NetworkError, RPCError):
+            return None
+        return _TraceLineIter(resp, self.addr)
+
+    def events(self) -> list:
+        """This peer's recent journal window (?cluster=1 merges)."""
+        if self._shed():
+            return []
+        try:
+            return self.rc.call_json("events") or []
+        except (NetworkError, RPCError):
+            return []
+
+    def incidents(self) -> list:
+        if self._shed():
+            return []
+        try:
+            return self.rc.call_json("incidents") or []
+        except (NetworkError, RPCError):
+            return []
+
+    def incident(self, inc_id: str) -> Optional[dict]:
+        if self._shed():
+            return None
+        try:
+            doc = self.rc.call_json("incident", {"id": inc_id})
+        except (NetworkError, RPCError):
+            return None
+        return doc if isinstance(doc, dict) and doc else None
 
     def storage_info(self) -> dict:
         if self._shed():
@@ -534,12 +616,26 @@ class NotificationSys:
 
     def trace_stream_all(self, max_s: float = 3600.0) -> list:
         """One live trace-entry iterator per reachable peer (see
-        PeerRPCClient.trace_stream). Subscriptions open concurrently;
-        unreachable peers are simply absent — a follow stream degrades
-        to the nodes it can hear. A peer that answers only AFTER the
-        collection window has its subscription closed by the opener
-        thread itself (nobody else will ever see it — an unclosed late
-        iterator would pin that peer's hub + a worker for max_s)."""
+        PeerRPCClient.trace_stream)."""
+        return self._stream_all(
+            lambda p: p.trace_stream(max_s=max_s))
+
+    def event_stream_all(self, max_s: float = 3600.0) -> list:
+        """One live event-journal iterator per reachable peer (see
+        PeerRPCClient.event_stream) — the /events?follow=1&cluster=1
+        fan-out."""
+        return self._stream_all(
+            lambda p: p.event_stream(max_s=max_s))
+
+    def _stream_all(self, open_one: Callable[[PeerRPCClient],
+                                             object]) -> list:
+        """Open one live subscription per reachable peer.
+        Subscriptions open concurrently; unreachable peers are simply
+        absent — a follow stream degrades to the nodes it can hear. A
+        peer that answers only AFTER the collection window has its
+        subscription closed by the opener thread itself (nobody else
+        will ever see it — an unclosed late iterator would pin that
+        peer's hub + a worker for max_s)."""
         results: list = [None] * len(self.peers)
         mu = threading.Lock()
         done = [False]
@@ -547,7 +643,7 @@ class NotificationSys:
         def run(i: int, p: PeerRPCClient) -> None:
             r = None
             try:
-                r = p.trace_stream(max_s=max_s)
+                r = open_one(p)
             except Exception:  # noqa: BLE001 — peer absent
                 r = None
             late = None
@@ -573,6 +669,36 @@ class NotificationSys:
             done[0] = True
             return [r for r in results
                     if isinstance(r, _TraceLineIter)]
+
+    def events_all(self) -> list[dict]:
+        """Cluster-wide recent journal entries, time-ordered (the
+        /events?cluster=1 merge)."""
+        merged: list[dict] = []
+        for entries in self._broadcast(lambda p: p.events()):
+            if isinstance(entries, list):
+                merged.extend(e for e in entries
+                              if isinstance(e, dict))
+        merged.sort(key=lambda e: e.get("ts", 0))
+        return merged
+
+    def incidents_all(self) -> list[dict]:
+        """Cluster-wide incident-bundle summaries, newest first."""
+        merged: list[dict] = []
+        for entries in self._broadcast(lambda p: p.incidents()):
+            if isinstance(entries, list):
+                merged.extend(e for e in entries
+                              if isinstance(e, dict))
+        merged.sort(key=lambda e: e.get("time") or 0, reverse=True)
+        return merged
+
+    def incident_any(self, inc_id: str) -> Optional[dict]:
+        """Fetch one bundle from whichever peer holds it (bundles are
+        node-local; 'retrievable from either node' means asking
+        around)."""
+        for doc in self._broadcast(lambda p: p.incident(inc_id)):
+            if isinstance(doc, dict) and doc:
+                return doc
+        return None
 
     def profiling_start_all(self, kinds: str = "cpu") -> list:
         return self._broadcast(lambda p: p.profiling_start(kinds))

@@ -489,14 +489,20 @@ def device_sse_allowed(size: int) -> bool:
     (MINIO_TPU_SSE_DEVICE=off), device/capacity presence, and the
     size window. A False here (or ANY later decline/dispatch error)
     means the CPU ChaChaEncryptor path — same bytes either way."""
-    from ..utils import knobs
+    from ..utils import eventlog, knobs
     if knobs.get_str("MINIO_TPU_SSE_DEVICE").strip().lower() == "off":
+        eventlog.emit_once("device.decline", stage="sse",
+                           reason="off")
         return False
     try:
         from ..object.codec import _device_is_tpu, _mesh_active
         if not _device_is_tpu() and _mesh_active() is None:
+            eventlog.emit_once("device.decline", stage="sse",
+                               reason="no-device")
             return False
     except Exception:  # noqa: BLE001 — no jax backend: CPU path
+        eventlog.emit_once("device.decline", stage="sse",
+                           reason="no-backend")
         return False
     if size < 0:
         return False

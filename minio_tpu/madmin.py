@@ -278,14 +278,21 @@ class AdminClient:
         dicts as they arrive; ends at `count` entries (0 = until the
         connection drops / `timeout`). Unlike trace(), this reads the
         chunked response incrementally."""
-        import hashlib as _hl
         query = {"follow": "1", "count": str(count)}
         if api:
             query["api"] = api
         if errors_only:
             query["err"] = "1"
+        return self._follow("trace", query, count, timeout)
+
+    def _follow(self, sub: str, query: dict, count: int = 0,
+                timeout: Optional[float] = None) -> Iterator[dict]:
+        """Incremental ND-JSON reader behind the follow streams
+        (trace_follow / events_follow): yields entry dicts as they
+        arrive, skipping heartbeat blanks."""
+        import hashlib as _hl
         qs = urllib.parse.urlencode(query)
-        path = f"{ADMIN_PREFIX}/trace"
+        path = f"{ADMIN_PREFIX}/{sub}"
         hdrs = sig.sign_v4("GET", path,
                            {k: [v] for k, v in query.items()},
                            {"host": f"{self.host}:{self.port}"},
@@ -319,6 +326,51 @@ class AdminClient:
 
     def cluster_trace(self) -> list[dict]:
         return self._json("GET", "trace/cluster")["entries"]
+
+    def events(self, count: int = 0, classes: str = "",
+               subsystems: str = "", severity: str = "",
+               cluster: bool = False) -> list[dict]:
+        """Recent journal entries. `classes`/`subsystems` are comma
+        lists, `severity` a minimum (info/warn/error/crit);
+        `cluster=True` merges every peer's window."""
+        query = {"count": str(count)}
+        if classes:
+            query["class"] = classes
+        if subsystems:
+            query["sub"] = subsystems
+        if severity:
+            query["sev"] = severity
+        if cluster:
+            query["cluster"] = "1"
+        return self._json("GET", "events", query)["events"]
+
+    def events_follow(self, count: int = 0, classes: str = "",
+                      subsystems: str = "", severity: str = "",
+                      timeout: Optional[float] = None
+                      ) -> Iterator[dict]:
+        """LIVE journal stream with peer grafting — the `mc admin
+        events` analog of trace_follow."""
+        query = {"follow": "1", "count": str(count)}
+        if classes:
+            query["class"] = classes
+        if subsystems:
+            query["sub"] = subsystems
+        if severity:
+            query["sev"] = severity
+        return self._follow("events", query, count, timeout)
+
+    def incidents(self, cluster: bool = False) -> list[dict]:
+        """Black-box bundle summaries, newest first."""
+        query = {"cluster": "1"} if cluster else None
+        return self._json("GET", "incidents", query)["incidents"]
+
+    def incident(self, inc_id: str) -> dict:
+        """One full bundle — served by whichever node holds it."""
+        return self._json("GET", "incidents", {"id": inc_id})
+
+    def slo(self) -> dict:
+        """Burn-rate status per objective (the error-budget view)."""
+        return self._json("GET", "slo")
 
     def spans(self, count: int = 50, sort: str = "recent",
               api: str = "", trace_id: str = "") -> dict:

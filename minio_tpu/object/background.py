@@ -30,7 +30,7 @@ import time
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..storage import errors as serr
-from ..utils import backoff_delay, crashpoint, knobs, lockcheck
+from ..utils import backoff_delay, crashpoint, eventlog, knobs, lockcheck
 from ..storage.format import read_format_from, write_format_to
 from ..storage.xl_storage import MINIO_META_BUCKET, XLStorage
 from . import api_errors
@@ -134,7 +134,11 @@ class MRFHealer:
                 # so it requeues once finished (the hint is preserved)
                 self._inflight[key] = True
                 return True
-            return self._push(key, 0)
+            pushed = self._push(key, 0)
+            depth = len(self._heap)
+        if pushed:
+            eventlog.emit("mrf.enqueue", queued=depth)
+        return pushed
 
     def _push(self, key: tuple, attempt: int,
               delay: float = 0.0) -> bool:
@@ -203,6 +207,9 @@ class MRFHealer:
                         # fresh entry so the new damage is covered
                         self._push(key, 0)
                     self._cond.notify_all()
+                if done:
+                    eventlog.emit("mrf.drain", healed=self.healed,
+                                  failed=self.failed)
 
     def _retry(self, key: tuple, attempt: int) -> bool:
         """Requeue with backoff; True when the entry is finished
@@ -459,6 +466,8 @@ class DiskMonitor(_ScanLoop):
                                      healthtrack.STATE_SUSPECT,
                                      event="suspect")
                         self.quarantine_events.append((key, "suspect"))
+                        eventlog.emit("drive.suspect", drive=key,
+                                      set=si)
                     continue
                 if state == healthtrack.STATE_SUSPECT and \
                         tr.state_age("drive", key) >= knobs.get_float(
@@ -467,6 +476,7 @@ class DiskMonitor(_ScanLoop):
                                  healthtrack.STATE_PROBATION,
                                  event="probation")
                     self.quarantine_events.append((key, "probation"))
+                    eventlog.emit("drive.probation", drive=key, set=si)
                     state = healthtrack.STATE_PROBATION
                 if state != healthtrack.STATE_PROBATION:
                     continue
@@ -479,6 +489,7 @@ class DiskMonitor(_ScanLoop):
                     # still slow: re-convicted straight back to
                     # suspect (note_probe reset state + dwell)
                     self.quarantine_events.append((key, "reconvict"))
+                    eventlog.emit("drive.reconvict", drive=key, set=si)
                     continue
                 if probes_ok >= \
                         knobs.get_int("MINIO_TPU_QUAR_PROBES"):
@@ -499,6 +510,7 @@ class DiskMonitor(_ScanLoop):
                     tr.set_state("drive", key, healthtrack.STATE_OK,
                                  event="readmit")
                     self.quarantine_events.append((key, "readmit"))
+                    eventlog.emit("drive.readmit", drive=key, set=si)
                     if pool.mrf is not None:
                         pool.mrf.kick()
 

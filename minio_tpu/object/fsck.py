@@ -43,7 +43,7 @@ from ..storage.xl_storage import (MINIO_META_BUCKET,
                                   MINIO_META_MULTIPART_BUCKET,
                                   MINIO_META_TMP_BUCKET,
                                   XL_STORAGE_FORMAT_FILE, XLStorage)
-from ..utils import atomicfile, knobs, regfence, telemetry
+from ..utils import atomicfile, eventlog, knobs, regfence, telemetry
 from . import api_errors
 from .metacache import manifest_key, mc_prefix
 
@@ -207,6 +207,12 @@ def run_fsck(object_layer, repair: bool = False, tiers=None,
             for f in report.findings:
                 _run_repair(f)
         report.duration_s = time.time() - report.started
+    eventlog.emit("fsck.complete", findings=len(report.findings),
+                  repaired=sum(1 for f in report.findings if f.repaired),
+                  unrepaired=len(report.unrepaired))
+    if report.unrepaired:
+        eventlog.emit("fsck.unrepaired",
+                      findings=len(report.unrepaired))
     return report
 
 

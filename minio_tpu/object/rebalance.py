@@ -42,7 +42,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..storage.xl_storage import MINIO_META_BUCKET
-from ..utils import atomicfile, crashpoint, knobs, telemetry
+from ..utils import atomicfile, crashpoint, eventlog, knobs, telemetry
 from ..utils.pressure import ForegroundPressure
 from ..utils.streams import IterStream as _IterStream
 from . import api_errors
@@ -565,6 +565,8 @@ class Rebalancer:
     def _save_checkpoint(self) -> None:
         with self._mu:
             doc = dict(self.state)
+        eventlog.emit("rebalance.checkpoint", pool=self.source,
+                      objects=doc.get("objects_moved", 0))
         payload = json.dumps(doc).encode()
         # every ACTIVE pool gets a copy: the checkpoint must survive the
         # source pool's removal

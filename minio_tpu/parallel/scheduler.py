@@ -56,7 +56,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils import knobs, lockcheck, telemetry
+from ..utils import eventlog, knobs, lockcheck, telemetry
 
 MAX_BATCH_BLOCKS = knobs.get_int("MINIO_TPU_SCHED_MAX_BATCH")
 MAX_WAIT_S = knobs.get_float("MINIO_TPU_SCHED_MAX_WAIT_MS") / 1e3
@@ -265,8 +265,12 @@ class BatchScheduler:
         if algo not in (bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256,
                         bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S,
                         bitrot_mod.BitrotAlgorithm.SHA256):
+            eventlog.emit_once("device.decline", stage="scheduler",
+                               reason="algo")
             return True
         if codec.m == 0:
+            eventlog.emit_once("device.decline", stage="scheduler",
+                               reason="no-parity")
             return True
         # No device, no reason to queue: without a TPU (or an active
         # multi-device mesh) the dispatch always CPU-routes, so the
@@ -275,7 +279,11 @@ class BatchScheduler:
         # batches still enqueue — coalescing with concurrent streams is
         # what pushes them over the routing threshold.
         from ..object.codec import _device_is_tpu, _mesh_active
-        return not _device_is_tpu() and _mesh_active() is None
+        declined = not _device_is_tpu() and _mesh_active() is None
+        if declined:
+            eventlog.emit_once("device.decline", stage="scheduler",
+                               reason="no-device")
+        return declined
 
     def _enqueue(self, key: tuple, data: np.ndarray) -> DispatchFuture:
         return self._enqueue_pending(

@@ -26,7 +26,7 @@ import time
 from typing import TYPE_CHECKING, Optional
 
 from ..object import api_errors
-from ..utils import atomicfile, crashpoint
+from ..utils import atomicfile, crashpoint, eventlog
 from ..storage.xl_storage import MINIO_META_BUCKET
 from ..utils import knobs, telemetry
 from .targets import REPL_PREFIX, TargetRegistry
@@ -206,6 +206,8 @@ class Resyncer:
     def _save_checkpoint(self) -> None:
         with self._mu:
             doc = dict(self.state)
+        eventlog.emit("resync.checkpoint", target=self.arn,
+                      objects=doc.get("versions_pushed", 0))
         payload = json.dumps(doc).encode()
         layers = getattr(self.obj, "server_sets", None) or [self.obj]
         for z in layers:

@@ -215,10 +215,16 @@ class AdminHandlers:
             if mon is not None:
                 events = [{"drive": k, "event": e}
                           for k, e in list(mon.quarantine_events)[-100:]]
+            from ..utils import eventlog
             return self._json({
                 "drives": healthtrack.TRACKER.snapshot("drive"),
                 "peers": healthtrack.TRACKER.snapshot("peer"),
-                "events": events})
+                "events": events,
+                # journal-backed transition history: replayed from
+                # persisted segments at boot, so convictions survive a
+                # restart (the in-memory deque above does not)
+                "journal": eventlog.JOURNAL.recent(
+                    100, subsystems={"drive", "health"})})
         if sub == "obdinfo" and m == "GET":
             self._auth(ctx, "admin:OBDInfo")
             from ..utils.obd import local_obd
@@ -327,6 +333,100 @@ class AdminHandlers:
                     apis=apis, errors_only=errors_only,
                     peer_subs=peer_subs, max_s=max_s),
                 long_poll=follow)
+        if sub == "events" and m == "GET":
+            # the incident plane's journal. Default: the recent ring
+            # window as JSON (?cluster=1 merges peer windows, deduped
+            # by (node, seq) — in-process test clusters share one
+            # journal). ?follow=1 streams ND-JSON live with peer
+            # grafting — same contract (and lazy-subscription lesson)
+            # as /trace?follow=1. Filters: ?class=a,b ?sub=drive,net
+            # ?sev=warn (minimum severity); they apply to peer
+            # entries too.
+            self._auth(ctx, "admin:ServerTrace")
+            from ..utils import eventlog
+            classes = {c for c in ctx.query1("class", "").split(",")
+                       if c} or None
+            subsys = {s for s in ctx.query1("sub", "").split(",")
+                      if s} or None
+            sev = ctx.query1("sev", "")
+            min_sev = eventlog.sev_rank(sev) if sev else 0
+            follow = ctx.query1("follow", "") in ("1", "true")
+            try:
+                n = int(ctx.query1("count", "0") or 0)
+                idle = float(ctx.query1("idle", "10") or 10)
+            except ValueError:
+                raise S3Error("AdminInvalidArgument",
+                              "bad count/idle") from None
+            if follow:
+                idle = min(max(idle, 1.0), 3600.0)
+                max_s = knobs.get_float(
+                    "MINIO_TPU_EVENTS_FOLLOW_MAX_S")
+                peer_subs = None
+                if self.node is not None:
+                    # a CALLABLE: subscriptions open at the stream's
+                    # first iteration, so a response abandoned before
+                    # its first chunk never opens peers it cannot
+                    # close
+                    node = self.node
+                    peer_subs = (lambda:
+                                 node.notification.event_stream_all(
+                                     max_s=max_s))
+                return HTTPResponse(
+                    headers={"Content-Type":
+                             "application/x-ndjson"},
+                    stream=eventlog.JOURNAL.stream(
+                        max_entries=n, idle_timeout=idle,
+                        follow=True, classes=classes,
+                        subsystems=subsys, min_sev=min_sev,
+                        peer_subs=peer_subs, max_s=max_s),
+                    long_poll=True)
+            entries = eventlog.JOURNAL.recent(n, classes, subsys,
+                                              min_sev)
+            if ctx.query1("cluster") == "1" and self.node is not None:
+                seen = {(e.get("node"), e.get("seq"))
+                        for e in entries}
+                for e in self.node.notification.events_all():
+                    k = (e.get("node"), e.get("seq"))
+                    if k in seen:
+                        continue
+                    if eventlog.JOURNAL.entry_matches(
+                            e, classes, subsys, min_sev):
+                        seen.add(k)
+                        entries.append(e)
+                entries.sort(key=lambda e: e.get("ts", 0))
+            return self._json({"events": entries[-1000:]})
+        if sub == "incidents" and m == "GET":
+            # black-box capture bundles. ?id= fetches one bundle —
+            # asking every peer when it is not local (bundles live on
+            # the node that captured them); default lists summaries,
+            # ?cluster=1 merging peer lists.
+            self._auth(ctx, "admin:OBDInfo")
+            from ..utils import incidents as inc_mod
+            inc_id = ctx.query1("id", "")
+            if inc_id:
+                doc = inc_mod.RECORDER.get(inc_id)
+                if doc is None and self.node is not None:
+                    doc = self.node.notification.incident_any(inc_id)
+                if doc is None:
+                    raise S3Error("AdminInvalidArgument",
+                                  "unknown incident id")
+                return self._json(doc)
+            out = inc_mod.RECORDER.list()
+            if ctx.query1("cluster") == "1" and self.node is not None:
+                have = {i.get("id") for i in out}
+                for i in self.node.notification.incidents_all():
+                    if i.get("id") not in have:
+                        have.add(i.get("id"))
+                        out.append(i)
+                out.sort(key=lambda i: i.get("time") or 0,
+                         reverse=True)
+            return self._json({"incidents": out})
+        if sub == "slo" and m == "GET":
+            # burn-rate status per objective — what `mc admin` would
+            # render as the error-budget dashboard
+            self._auth(ctx, "admin:ServerInfo")
+            from ..utils import slo
+            return self._json(slo.ENGINE.status())
 
         if sub == "heal" and m == "POST":
             self._auth(ctx, "admin:Heal")

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Optional
 
-from ...utils import knobs, telemetry
+from ...utils import eventlog, knobs, telemetry
 
 # requests shed with 503 SlowDown, by trigger: "staging" (BytePool
 # exhaustion window), "scheduler" (device-batch queue saturation),
@@ -264,4 +264,5 @@ class AdmissionController:
         """Record one refusal (the ONLY requests_shed_total increment
         site in the tree) and hand back the decision to serve."""
         _SHED_TOTAL.inc(reason=reason)
+        eventlog.emit("admission.shed", reason=reason)
         return ShedDecision(reason, message, retry_after)

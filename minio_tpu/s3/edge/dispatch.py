@@ -24,6 +24,12 @@ _HTTP_DURATION = telemetry.REGISTRY.histogram(
 _HTTP_TTFB = telemetry.REGISTRY.histogram(
     "minio_tpu_http_ttfb_seconds",
     "Time to first response byte per API")
+# status-class outcomes per API — the availability half of the SLO
+# engine (the duration histogram has no status label on purpose:
+# status×api×buckets would triple the exposition for one consumer)
+_HTTP_RESPONSES = telemetry.REGISTRY.counter(
+    "minio_tpu_http_responses_total",
+    "HTTP responses per API and status class (2xx/3xx/4xx/5xx)")
 
 
 def run_request(api, extra_routers, ctx, command: str, raw_path: str,
@@ -89,6 +95,8 @@ def run_request(api, extra_routers, ctx, command: str, raw_path: str,
         dur = time.perf_counter() - t0
         try:
             _HTTP_DURATION.observe(dur, api=api_name)
+            _HTTP_RESPONSES.inc(api=api_name,
+                                code_class=f"{status[0] // 100}xx")
             if ttfb[0] is not None:
                 _HTTP_TTFB.observe(ttfb[0], api=api_name)
         except Exception:  # noqa: BLE001 — telemetry is passive

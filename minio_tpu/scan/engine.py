@@ -28,7 +28,7 @@ from typing import Iterator, Optional
 
 from ..s3select import select as sel
 from ..s3select import sql as _sql
-from ..utils import knobs, telemetry
+from ..utils import eventlog, knobs, telemetry
 from . import kernels, pager
 from .plan import Decline, compile_plan
 
@@ -106,6 +106,8 @@ class ScanEngine:
         self.fallback_reasons[reason] = \
             self.fallback_reasons.get(reason, 0) + 1
         self._m[1].inc(reason=reason)
+        eventlog.emit_once("device.decline", stage="scan",
+                           reason=reason)
 
     def _try_device(self, req, data: bytes):
         """Returns the device-served frame iterator, or raises Decline.

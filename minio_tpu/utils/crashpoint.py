@@ -167,6 +167,12 @@ define("mrf.drain.before_heal",
        "in the MRF drainer, after dequeuing an entry, before its heal "
        "runs — a crashed drain loses only retries, never objects", _W)
 
+_W = "Event journal (utils/eventlog.py)"
+define("eventlog.persist.segment",
+       "in the journal flusher, before a segment's temp-write→rename "
+       "commit — a crash here must leave the prior segment set "
+       "readable (restart serves the surviving prefix)", _W)
+
 del _W
 
 
@@ -206,7 +212,18 @@ def _parse_env():
         n = int(nth) if nth else 1
     except ValueError:
         n = 1
+    _note_armed(name, n)
     return _Armed(name, n, None)
+
+
+def _note_armed(name: str, nth: int) -> None:
+    """Journal that fault injection is live in this process — incident
+    bundles must distinguish injected faults from organic ones."""
+    try:
+        from . import eventlog
+        eventlog.emit("crashpoint.armed", point=name, nth=nth)
+    except Exception:  # noqa: BLE001 — arming must not depend on the journal
+        pass
 
 
 def refresh() -> None:
@@ -228,6 +245,7 @@ def arm(name: str, nth: int = 1,
                        "in minio_tpu/utils/crashpoint.py")
     with _mu:
         _armed = _Armed(name, nth, action or _raise_abort)
+    _note_armed(name, nth)
 
 
 def arm_exit(name: str, nth: int = 1) -> None:

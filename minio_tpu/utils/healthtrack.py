@@ -40,7 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from . import knobs, telemetry
+from . import eventlog, knobs, telemetry
 
 __all__ = [
     "STATE_OK", "STATE_SUSPECT", "STATE_PROBATION",
@@ -233,6 +233,8 @@ class HealthTracker:
             e.probes_ok = 0
         if event:
             _QUAR.inc(event=event)
+        eventlog.emit("health.transition", kind=kind, target=key,
+                      state=state, event=event)
 
     # -- quarantine policy -------------------------------------------------
 
@@ -294,6 +296,8 @@ class HealthTracker:
             # a flapping drive must be visible as flapping, not as
             # one forever-pending probation
             _QUAR.inc(event="reconvict")
+            eventlog.emit("health.transition", kind=kind, target=key,
+                          state=STATE_SUSPECT, event="reconvict")
         return 0
 
     # -- surfaces ----------------------------------------------------------

@@ -483,6 +483,61 @@ define("MINIO_TPU_FSCK_TMP_AGE_S", "float", 3600.0,
        "staged tmp writes older than this count as crash leftovers "
        "for fsck (younger ones may be in-flight PUTs)", _S)
 
+_S = "Incident plane"
+define("MINIO_TPU_EVENTLOG", "bool", True,
+       "`off` disables the structured event journal (emits drop; the "
+       "overhead A/B escape hatch)", _S)
+define("MINIO_TPU_EVENTLOG_RING", "int", 2048,
+       "in-memory journal ring size (the /events backlog bound)", _S)
+define("MINIO_TPU_EVENTLOG_SEGMENT_EVENTS", "int", 64,
+       "pending events that force an early segment flush", _S)
+define("MINIO_TPU_EVENTLOG_FLUSH_S", "float", 2.0,
+       "journal segment flush cadence, seconds", _S)
+define("MINIO_TPU_EVENTLOG_KEEP_SEGMENTS", "int", 16,
+       "persisted journal segments retained (older ones pruned)", _S)
+define("MINIO_TPU_EVENTS_FOLLOW_MAX_S", "float", 3600.0,
+       "hard lifetime cap on a ?follow=1 event stream (a forgotten "
+       "client cannot hold peer subscriptions forever)", _S)
+define("MINIO_TPU_SLO", "bool", True,
+       "`off` disables the SLO burn-rate engine (gauges stop, no "
+       "breach events)", _S)
+define("MINIO_TPU_SLO_EVAL_S", "float", 5.0,
+       "SLO evaluation cadence, seconds", _S)
+define("MINIO_TPU_SLO_WINDOWS_S", "str", "60,300",
+       "comma-separated burn-rate windows, seconds (multi-window "
+       "alerting: short catches fast burn, long catches slow leaks)",
+       _S)
+define("MINIO_TPU_SLO_AVAIL_TARGET", "float", 99.9,
+       "availability objective, percent of non-5xx responses per API "
+       "class", _S)
+define("MINIO_TPU_SLO_LAT_TARGET", "float", 99.0,
+       "latency objective, percent of requests under the class "
+       "threshold", _S)
+define("MINIO_TPU_SLO_LAT_READ_MS", "float", 250.0,
+       "read-class latency threshold, milliseconds", _S)
+define("MINIO_TPU_SLO_LAT_WRITE_MS", "float", 1000.0,
+       "write-class latency threshold, milliseconds", _S)
+define("MINIO_TPU_SLO_BURN_THRESHOLD", "float", 4.0,
+       "burn rate at which an objective breaches (clears at half "
+       "this — hysteresis stops breach/clear flapping)", _S)
+define("MINIO_TPU_SLO_MIN_SAMPLES", "int", 10,
+       "requests a window must hold before its burn rate can breach "
+       "(a single early 500 must not page)", _S)
+define("MINIO_TPU_INCIDENTS", "bool", True,
+       "`off` disables black-box incident capture", _S)
+define("MINIO_TPU_INCIDENT_KEEP", "int", 16,
+       "incident bundles retained on disk (older ones pruned)", _S)
+define("MINIO_TPU_INCIDENT_DEBOUNCE_S", "float", 30.0,
+       "min seconds between captures for the same trigger class "
+       "(a flapping trigger must not fill the retention window)", _S)
+define("MINIO_TPU_INCIDENT_EVENTS", "str",
+       "slo.breach,drive.probation,net.partition,fsck.unrepaired,"
+       "registry.fork",
+       "comma-separated journal event classes that trigger a capture",
+       _S)
+define("MINIO_TPU_INCIDENT_WINDOW", "int", 256,
+       "journal entries snapshotted into each bundle", _S)
+
 _S = "Lock watchdog"
 define("MINIO_TPU_LOCKCHECK", "bool", False,
        "instrument named locks: record the cross-thread acquisition "

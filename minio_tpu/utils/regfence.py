@@ -33,7 +33,7 @@ from __future__ import annotations
 import hashlib
 from typing import List, Optional, Tuple
 
-from . import knobs
+from . import eventlog, knobs
 
 
 def lineage(parent: str, epoch: int, writer: str) -> str:
@@ -94,6 +94,10 @@ def find_forks(docs: List[dict]) -> List[Tuple[dict, dict]]:
         for other in seen.values():
             out.append((other, d))
         seen[lin] = d
+    if out:
+        eventlog.emit("registry.fork",
+                      epoch=int(out[0][0].get("epoch", 0)),
+                      forks=len(out))
     return out
 
 

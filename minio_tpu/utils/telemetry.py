@@ -158,6 +158,12 @@ class Counter(_Family):
         with self._mu:
             return self._series.get(_label_key(labels), 0)
 
+    def series(self) -> Dict[tuple, float]:
+        """label-key -> value snapshot (the SLO engine aggregates
+        status-class counts across label sets)."""
+        with self._mu:
+            return dict(self._series)
+
 
 class Gauge(_Family):
     """Settable instantaneous value (optionally labelled)."""
@@ -214,6 +220,14 @@ class Histogram(_Family):
         with self._mu:
             s = self._series.get(_label_key(labels))
             return s.count if s is not None else 0
+
+    def series_snapshot(self) -> Dict[tuple, tuple]:
+        """label-key -> (per-bucket counts, sum, count), consistent
+        per series — the SLO engine derives over-threshold fractions
+        from bucket counts without reaching into family internals."""
+        with self._mu:
+            return {key: (list(s.counts), s.total, s.count)
+                    for key, s in self._series.items()}
 
     def _render_series(self, key: tuple, s: "_HistSeries") -> List[str]:
         # snapshot under the family lock: a concurrent observe()

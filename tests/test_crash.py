@@ -162,6 +162,9 @@ COVERED_ELSEWHERE = {
     "mrf.drain.before_heal": "test_fsck.py::test_mrf_drain_crash",
     "storage.write_all.commit":
         "test_fsck.py::test_torn_write_injection",
+    "eventlog.persist.segment":
+        "test_incidents.py::"
+        "test_sigkill_mid_segment_persist_serves_prefix",
 }
 
 SMOKE_POINTS = ("put.meta.before_rename",

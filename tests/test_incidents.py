@@ -1,0 +1,485 @@
+"""SLO burn rates + black-box capture (ISSUE 18, tentpole layers 2+3).
+
+Fast tier: the burn-rate algebra (multi-window baselines, breach /
+clear hysteresis, min-sample gating), the incident recorder's bundle
+shape / retention / debounce, and the end-to-end in-process scenario —
+a seeded NaughtyDisk stall on EVERY drive makes HTTP reads slow, the
+latency objective breaches, and the flight recorder captures a bundle
+with the causal journal window and slow span trees.
+
+Slow tier (real subprocesses): SIGKILL inside the journal's
+segment-persist commit window (restart serves the surviving prefix,
+fsck-clean), and a naughtynet partition on a 2-node cluster driving a
+real SLO breach whose bundle is retrievable via the admin API from
+either node after heal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import os
+import threading
+import time
+
+import pytest
+
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.admin import mount_admin
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.naughty import NaughtyDisk
+from minio_tpu.storage.xl_storage import XLStorage
+from minio_tpu.utils import eventlog, incidents, slo, telemetry
+
+CREDS = Credentials("inctestkey1234", "inctestsecret123")
+REGION = "us-east-1"
+
+READ_STALLS = ("read_file_stream", "read_file", "read_all")
+
+
+def _totals(read=(0, 0, 0), write=(0, 0, 0)) -> dict:
+    return {"read": list(read), "write": list(write)}
+
+
+def _stub_engine(monkeypatch, feed: dict) -> slo.SLOEngine:
+    """Fresh engine whose _collect returns whatever `feed['cls']`
+    holds — the algebra tests drive cumulative totals by hand."""
+    e = slo.SLOEngine()
+    monkeypatch.setattr(
+        e, "_collect", lambda now: slo._Totals(now, {
+            c: list(v) for c, v in feed["cls"].items()}))
+    return e
+
+
+# ---------------------------------------------------------------------------
+# burn-rate algebra
+# ---------------------------------------------------------------------------
+
+def test_api_class_membership():
+    assert slo.api_class("GetObject") == "read"
+    assert slo.api_class("HeadObject") == "read"
+    assert slo.api_class("ListObjectsV2") == "read"
+    assert slo.api_class("PutObject") == "write"
+    assert slo.api_class("DeleteObject") == "write"
+    assert slo.api_class("Admin") is None
+    assert slo.api_class("PeerRPC") is None
+    assert slo.api_class("") is None
+
+
+def test_breach_and_clear_hysteresis(monkeypatch):
+    """5xx spend past the threshold breaches (journal event, status
+    flag); the breach clears only after burn cools to HALF the
+    threshold — and the clear rides the journal too."""
+    monkeypatch.setenv("MINIO_TPU_SLO_WINDOWS_S", "60")
+    monkeypatch.setenv("MINIO_TPU_SLO_MIN_SAMPLES", "10")
+    feed = {"cls": _totals()}
+    e = _stub_engine(monkeypatch, feed)
+    t0 = time.time()
+    seq0 = eventlog.JOURNAL.seq
+    e.evaluate_once(now=t0)
+
+    # 100 read requests, 50 errors, inside one window: burn huge
+    feed["cls"] = _totals(read=(100, 50, 0))
+    st = e.evaluate_once(now=t0 + 61)
+    obj = {o["objective"]: o for o in st["objectives"]}
+    assert obj["read-availability"]["breached"] is True
+    assert obj["read-availability"]["windows"]["60s"]["burn"] > 4
+    assert obj["write-availability"]["breached"] is False
+    breaches = eventlog.JOURNAL.recent(classes={"slo.breach"},
+                                       since_seq=seq0)
+    assert any(b["attrs"]["objective"] == "read-availability"
+               for b in breaches)
+
+    # no new traffic in the next window: burn 0 -> under half the
+    # threshold -> clear (with its journal event)
+    st = e.evaluate_once(now=t0 + 122)
+    obj = {o["objective"]: o for o in st["objectives"]}
+    assert obj["read-availability"]["breached"] is False
+    clears = eventlog.JOURNAL.recent(classes={"slo.clear"},
+                                     since_seq=seq0)
+    assert any(c["attrs"]["objective"] == "read-availability"
+               for c in clears)
+
+
+def test_breach_requires_min_samples(monkeypatch):
+    """Total failure of a trickle must not page: below MIN_SAMPLES in
+    the window there is no breach no matter the ratio."""
+    monkeypatch.setenv("MINIO_TPU_SLO_WINDOWS_S", "60")
+    monkeypatch.setenv("MINIO_TPU_SLO_MIN_SAMPLES", "10")
+    feed = {"cls": _totals()}
+    e = _stub_engine(monkeypatch, feed)
+    t0 = time.time()
+    e.evaluate_once(now=t0)
+    feed["cls"] = _totals(read=(5, 5, 0))       # 100% errors, 5 reqs
+    st = e.evaluate_once(now=t0 + 61)
+    obj = {o["objective"]: o for o in st["objectives"]}
+    assert obj["read-availability"]["breached"] is False
+    assert obj["read-availability"]["windows"]["60s"]["samples"] == 5
+
+
+def test_half_filled_window_never_alerts(monkeypatch):
+    """Until the snapshot ring spans a window there is no baseline —
+    and no burn number at all (a booting node must not page)."""
+    monkeypatch.setenv("MINIO_TPU_SLO_WINDOWS_S", "60")
+    feed = {"cls": _totals(read=(1000, 1000, 0))}
+    e = _stub_engine(monkeypatch, feed)
+    t0 = time.time()
+    e.evaluate_once(now=t0)
+    st = e.evaluate_once(now=t0 + 10)           # only 10s of history
+    obj = {o["objective"]: o for o in st["objectives"]}
+    assert obj["read-availability"]["windows"] == {}
+    assert obj["read-availability"]["breached"] is False
+
+
+def test_latency_objective_uses_bucket_counts(monkeypatch):
+    """The latency objective spends budget on over-threshold requests
+    (the third totals slot) against the latency target's budget."""
+    monkeypatch.setenv("MINIO_TPU_SLO_WINDOWS_S", "60")
+    monkeypatch.setenv("MINIO_TPU_SLO_MIN_SAMPLES", "10")
+    feed = {"cls": _totals()}
+    e = _stub_engine(monkeypatch, feed)
+    t0 = time.time()
+    e.evaluate_once(now=t0)
+    # 100 writes, none failed, 30 over the latency threshold:
+    # burn = 0.3 / 0.01 = 30 >= 4 -> latency breaches, availability not
+    feed["cls"] = _totals(write=(100, 0, 30))
+    st = e.evaluate_once(now=t0 + 61)
+    obj = {o["objective"]: o for o in st["objectives"]}
+    assert obj["write-latency"]["breached"] is True
+    assert obj["write-availability"]["breached"] is False
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+
+def _fresh_recorder(tmp_path) -> incidents.IncidentRecorder:
+    r = incidents.IncidentRecorder()
+    r.attach(str(tmp_path / "incidents"))
+    return r
+
+
+def test_capture_bundle_shape_and_providers(tmp_path):
+    r = _fresh_recorder(tmp_path)
+    try:
+        r.add_provider("good", lambda: {"answer": 42})
+        r.add_provider("dead", lambda: 1 / 0)
+        trig = eventlog.emit("net.partition", rule="both",
+                             peers="x|y")
+        inc_id = r.capture(trig)
+        assert inc_id
+        doc = r.get(inc_id)
+        assert doc["trigger"]["class"] == "net.partition"
+        assert doc["id"] == inc_id and doc["v"] == 1
+        assert any(e["class"] == "net.partition"
+                   for e in doc["events"])
+        assert doc["state"]["good"] == {"answer": 42}
+        assert "ZeroDivisionError" in doc["state"]["dead"]["error"]
+        assert isinstance(doc["slow_spans"], list)
+        assert isinstance(doc["metrics_delta"], dict)
+        # capture itself is journaled
+        caps = eventlog.JOURNAL.recent(classes={"incident.captured"})
+        assert any(c["attrs"]["incident"] == inc_id for c in caps)
+        # summaries list newest-first and carry the trigger class
+        rows = [x for x in r.list() if x["id"] == inc_id]
+        assert rows and rows[0]["trigger"] == "net.partition"
+        # path traversal in the id never escapes the directory
+        assert r.get("../" + inc_id) is None
+    finally:
+        r.stop()
+
+
+def test_capture_retention_prunes_oldest(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_INCIDENT_KEEP", "2")
+    r = _fresh_recorder(tmp_path)
+    try:
+        trig = eventlog.emit("net.partition", rule="both", peers="p|q")
+        ids = [r.capture(trig) for _ in range(4)]
+        assert all(ids)
+        kept = {x["id"] for x in r.list()}
+        assert len(kept) == 2
+        assert ids[-1] in kept and ids[0] not in kept
+    finally:
+        r.stop()
+
+
+def test_trigger_loop_captures_and_debounces(tmp_path):
+    """A registered trigger class landing in the journal produces a
+    bundle without anyone calling capture(); an immediate repeat of
+    the same class is debounced."""
+    r = _fresh_recorder(tmp_path)
+    try:
+        def mine():
+            return [x for x in r.list()
+                    if x["trigger"] == "drive.probation"]
+
+        eventlog.emit("drive.probation", drive="/inc/d0", set=0)
+        deadline = time.monotonic() + 8
+        while not mine() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert mine(), "trigger event never produced a bundle"
+        n = len(mine())
+        eventlog.emit("drive.probation", drive="/inc/d0", set=0)
+        time.sleep(1.0)
+        assert len(mine()) == n, "debounce window did not hold"
+        # a non-trigger class never captures
+        eventlog.emit("net.heal", peers="p|q")
+        time.sleep(0.5)
+        assert not any(x["trigger"] == "net.heal" for x in r.list())
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# end to end in-process: stalled drives -> slow reads -> breach ->
+# black-box bundle
+# ---------------------------------------------------------------------------
+
+def _signed_request(port, method, path, body=b""):
+    hdrs = sig.sign_v4(method, path, {},
+                       {"host": f"127.0.0.1:{port}"},
+                       hashlib.sha256(body).hexdigest(), CREDS, REGION)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path, body=body, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_stalled_reads_breach_slo_and_capture_bundle(tmp_path,
+                                                     monkeypatch):
+    """The incident plane end to end: EVERY drive stalls reads past
+    the latency threshold (hedging cannot dodge an all-gray set), HTTP
+    GETs go slow, the read-latency burn rate trips, the slo.breach
+    event triggers a black-box bundle holding the journal window and
+    at least one slow span tree."""
+    monkeypatch.setenv("MINIO_TPU_SLO_WINDOWS_S", "60")
+    monkeypatch.setenv("MINIO_TPU_SLO_MIN_SAMPLES", "5")
+    drives: list = []
+    naughties: list = []
+    for j in range(4):
+        nd = NaughtyDisk(XLStorage(str(tmp_path / f"d{j}")),
+                         enabled=False)
+        naughties.append(nd)
+        drives.append(nd)
+    sets = ErasureSets.from_storage(drives, set_count=1,
+                                    set_drive_count=4, parity=1,
+                                    block_size=1 << 16)
+    srv = S3Server(sets, creds=CREDS, region=REGION).start()
+    mount_admin(srv)
+    was_spans = (telemetry.SPANS.slow_s, telemetry.SPANS.sample)
+    telemetry.SPANS.configure(sample=1.0)
+    engine = slo.SLOEngine()
+    rec = incidents.IncidentRecorder()
+    rec.attach(str(tmp_path / "incidents"))
+    rec.add_provider("slo", engine.status)
+    try:
+        assert _signed_request(srv.port, "PUT", "/slostall")[0] == 200
+        assert _signed_request(srv.port, "PUT", "/slostall/obj",
+                               body=b"s" * 65536)[0] == 200
+        t0 = time.time()
+        engine.evaluate_once(now=t0)
+        for nd in naughties:
+            nd.stall_verbs = {v: 0.4 for v in READ_STALLS}
+            nd.arm()
+        for _ in range(6):
+            st, body = _signed_request(srv.port, "GET",
+                                       "/slostall/obj")
+            assert st == 200 and len(body) == 65536
+        for nd in naughties:
+            nd.disarm()
+            nd.stall_verbs = {}
+        st = engine.evaluate_once(now=t0 + 61)
+        obj = {o["objective"]: o for o in st["objectives"]}
+        assert obj["read-latency"]["breached"] is True, obj
+        # the recorder heard the breach event and captured
+        deadline = time.monotonic() + 8
+        bundle = None
+        while bundle is None and time.monotonic() < deadline:
+            for row in rec.list():
+                if row["trigger"] == "slo.breach":
+                    bundle = rec.get(row["id"])
+                    break
+            time.sleep(0.1)
+        assert bundle, "breach never produced a bundle"
+        assert bundle["trigger"]["attrs"]["objective"] == \
+            "read-latency"
+        assert bundle["events"], "bundle lost the journal window"
+        assert bundle["slow_spans"], "bundle has no slow span trees"
+        assert bundle["state"]["slo"]["objectives"]
+    finally:
+        rec.stop()
+        telemetry.SPANS.configure(*was_spans)
+        srv.stop()
+        sets.close()
+
+
+# ---------------------------------------------------------------------------
+# real subprocesses: the crash window and the 2-node acceptance run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_mid_segment_persist_serves_prefix(tmp_path):
+    """Arm the eventlog.persist.segment crashpoint: the process dies
+    inside a segment's commit window. Restart replays the SURVIVING
+    segment prefix (earlier fsck.complete events are still served by
+    /events) and the store itself is fsck-clean."""
+    from tests.harness.proc import CRASH_EXIT_CODE, ProcNode
+    from minio_tpu.madmin import AdminClientError
+
+    node = ProcNode(str(tmp_path), n_drives=4, name="evseg")
+    env = {
+        "MINIO_TPU_EVENTLOG_SEGMENT_EVENTS": "1",   # flush per emit
+        "MINIO_TPU_EVENTLOG_FLUSH_S": "120",        # cadence off
+    }
+    node.start(crashpoint="eventlog.persist.segment:4",
+               extra_env=env)
+    try:
+        node.s3().make_bucket("evb")
+        node.put("evb", "obj", b"x" * 4096)
+        # each fsck emits fsck.complete -> kicks a flush -> one
+        # crashpoint hit; the 4th flush dies BEFORE the rename commit
+        for _ in range(8):
+            if not node.alive():
+                break
+            try:
+                node.fsck(repair=False)
+            except (OSError, AdminClientError,
+                    http.client.HTTPException):
+                pass
+            time.sleep(0.3)
+        assert node.wait_exit(30) == CRASH_EXIT_CODE
+        node.start(extra_env=env)          # no crashpoint this time
+        survived = node.admin().events(classes="fsck.complete")
+        assert survived, ("restart serves no pre-crash journal "
+                          "prefix:\n" + node.tail_log())
+        # ... and the torn flush hurt only the journal tail, not data
+        rep = node.fsck(repair=True)
+        assert rep["unrepaired"] == 0, rep
+        assert node.get("evb", "obj") == b"x" * 4096
+    finally:
+        node.close()
+
+
+@pytest.mark.slow
+def test_partition_breach_capture_retrievable_from_either_node(
+        tmp_path):
+    """The ISSUE acceptance run on real subprocesses: a naughtynet
+    partition starves write quorum on a 2-node cluster, failed PUTs
+    burn the write-availability budget, slo.breach triggers a black-
+    box bundle holding journal events from >= 3 subsystems, the
+    breached objective, and >= 1 slow span tree — and after heal the
+    bundle is retrievable via the admin API from EITHER node."""
+    from tests.harness.proc import heal, make_cluster, partition
+    from minio_tpu.madmin import AdminClientError
+    from minio_tpu.utils.s3client import S3ClientError
+
+    env = {
+        "MINIO_TPU_NAUGHTYNET": "on",
+        "MINIO_TPU_SLO_EVAL_S": "0.5",
+        "MINIO_TPU_SLO_WINDOWS_S": "4",
+        "MINIO_TPU_SLO_MIN_SAMPLES": "6",
+        "MINIO_TPU_INCIDENT_DEBOUNCE_S": "1",
+        "MINIO_TPU_EVENTLOG_FLUSH_S": "0.5",
+        "MINIO_TPU_TRACE_SAMPLE": "1.0",
+    }
+    nodes = make_cluster(str(tmp_path), n_nodes=2, n_drives=4,
+                         parity=2)
+    a, b = nodes
+    boot_errs: list = []
+
+    def boot(n):
+        try:
+            n.start(extra_env=env, timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            boot_errs.append((n.name, e))
+
+    threads = [threading.Thread(target=boot, args=(n,))
+               for n in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180.0)
+    assert not boot_errs, f"cluster boot failed: {boot_errs}"
+    try:
+        a.s3().make_bucket("slob")
+        a.put("slob", "warm", b"w" * 4096)
+        # a pre-partition fsck seeds a third subsystem's events into
+        # the journal window the bundle will carry
+        a.fsck(repair=False)
+
+        partition(a, b)
+        # failed PUTs: remote shards unreachable -> lost write quorum.
+        # Concurrent, with a client timeout ABOVE the server's 30s
+        # lock-acquire deadline: every request completes as a server-
+        # counted 5xx, and the whole burst lands inside one SLO
+        # window instead of smearing 12 x 30s sequentially.
+        from minio_tpu.s3.credentials import Credentials
+        from minio_tpu.utils.s3client import S3Client
+        from tests.harness.proc import ACCESS_KEY, SECRET_KEY
+        failures = [0]
+        fail_mu = threading.Lock()
+
+        def try_put(i):
+            cl = S3Client("127.0.0.1", a.port,
+                          Credentials(ACCESS_KEY, SECRET_KEY),
+                          timeout=60.0)
+            try:
+                cl.put_object("slob", f"k{i}", b"f" * 4096)
+            except (S3ClientError, OSError,
+                    http.client.HTTPException):
+                with fail_mu:
+                    failures[0] += 1
+
+        putters = [threading.Thread(target=try_put, args=(i,))
+                   for i in range(12)]
+        for t in putters:
+            t.start()
+        for t in putters:
+            t.join(90.0)
+        assert failures[0] >= 6, "partition never failed writes"
+
+        # the subprocess SLO engine breaches, its recorder captures
+        inc_id = None
+        deadline = time.monotonic() + 60
+        while inc_id is None and time.monotonic() < deadline:
+            try:
+                for row in a.admin().incidents():
+                    if row["trigger"] == "slo.breach":
+                        inc_id = row["id"]
+                        break
+            except (OSError, AdminClientError):
+                pass
+            time.sleep(0.5)
+        assert inc_id, ("no slo.breach bundle captured:\n"
+                        + a.tail_log())
+
+        bundle = a.admin().incident(inc_id)
+        assert bundle["trigger"]["class"] == "slo.breach"
+        assert bundle["trigger"]["attrs"]["objective"].startswith(
+            "write-")
+        subs = {e["sub"] for e in bundle["events"]}
+        assert len(subs) >= 3, subs
+        assert {"net", "slo"} <= subs, subs
+        assert bundle["slow_spans"], "no slow span trees captured"
+
+        heal(a, b)
+        deadline = time.monotonic() + 30
+        via_b = None
+        while via_b is None and time.monotonic() < deadline:
+            try:
+                doc = b.admin().incident(inc_id)
+                if doc and doc.get("id") == inc_id:
+                    via_b = doc
+            except (OSError, AdminClientError):
+                pass
+            time.sleep(0.5)
+        assert via_b, ("bundle not retrievable from the peer after "
+                       "heal:\n" + b.tail_log())
+        assert via_b["trigger"]["class"] == "slo.breach"
+    finally:
+        for n in nodes:
+            n.close()

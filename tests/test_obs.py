@@ -322,9 +322,149 @@ def test_follow_threaded_frontend(tmp_path_factory):
         t.join(timeout=20)
         assert got and got[0]["node"] == b.spec.addr
         _await_no_pumps()
+        # /events?follow=1 parity on the same threaded cluster: the
+        # journal stream must hold across frontends too (ISSUE 18)
+        from minio_tpu.utils import eventlog
+        ev: list = []
+        t2 = threading.Thread(
+            target=lambda: ev.extend(
+                _mc(a).events_follow(count=1, classes="net.heal",
+                                     timeout=60)),
+            daemon=True)
+        t2.start()
+        deadline = time.monotonic() + 10
+        while not _event_pumps() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        _drive_event_until(
+            t2, lambda: eventlog.emit("net.heal", peers="thr|parity"))
+        assert ev and ev[0]["class"] == "net.heal"
+        _await_no_event_pumps()
     finally:
         for n in nodes:
             n.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 2b. live journal streaming — /events?follow=1 (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _event_pumps() -> list:
+    return [t for t in threading.enumerate()
+            if t.name == "event-follow-peer" and t.is_alive()]
+
+
+def _await_no_event_pumps(deadline_s: float = 12.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while _event_pumps() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert not _event_pumps(), (
+        "peer event subscriptions leaked pump threads: "
+        + ", ".join(t.name for t in _event_pumps()))
+
+
+def _drive_event_until(thread, emit_fn, deadline_s: float = 15.0):
+    """Emit on a cadence until the follow consumer finishes — the
+    stream's peer grafts subscribe asynchronously, so a single emit
+    can race the subscription window."""
+    deadline = time.monotonic() + deadline_s
+    while thread.is_alive() and time.monotonic() < deadline:
+        emit_fn()
+        thread.join(timeout=0.3)
+    assert not thread.is_alive(), "events follow never delivered"
+
+
+def test_events_follow_delivers_and_unwinds(cluster):
+    """A /events?follow=1 stream on node A delivers a journal event,
+    grafts peer subscriptions (the pump threads exist while open), and
+    ends at count without leaking them."""
+    from minio_tpu.utils import eventlog
+    a, _b = cluster
+    got: list = []
+    t = threading.Thread(
+        target=lambda: got.extend(
+            _mc(a).events_follow(count=1, classes="net.heal",
+                                 timeout=60)),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not _event_pumps() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert _event_pumps(), "peer event subscription never opened"
+    _drive_event_until(
+        t, lambda: eventlog.emit("net.heal", peers="obs|follow"))
+    assert got and got[0]["class"] == "net.heal"
+    assert got[0]["attrs"]["peers"] == "obs|follow"
+    assert got[0]["sub"] == "net" and "seq" in got[0]
+    _await_no_event_pumps()
+
+
+def test_events_follow_disconnect_frees_workers(cluster):
+    """A client that vanishes mid-/events-follow must unwind the
+    server-side generator (heartbeat write fails -> peer pumps exit) —
+    the PR-12 trace-stream lesson applied to the journal stream."""
+    a, _b = cluster
+    path = "/minio/admin/v3/events"
+    query = {"follow": ["1"]}
+    qs = urllib.parse.urlencode({"follow": "1"})
+    hdrs = sig.sign_v4("GET", path, query,
+                       {"host": f"127.0.0.1:{a.spec.port}"},
+                       hashlib.sha256(b"").hexdigest(), CREDS, REGION)
+    s = socket.create_connection(("127.0.0.1", a.spec.port),
+                                 timeout=10)
+    head = f"GET {path}?{qs} HTTP/1.1\r\n" + "".join(
+        f"{k}: {v}\r\n" for k, v in hdrs.items()) + "\r\n"
+    s.sendall(head.encode())
+    buf = s.recv(4096)                 # headers (+ maybe a heartbeat)
+    assert b"200" in buf.split(b"\r\n", 1)[0]
+    deadline = time.monotonic() + 10
+    while not _event_pumps() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert _event_pumps(), "peer event subscription never opened"
+    s.close()                          # client dies
+    _await_no_event_pumps()
+
+
+def test_events_endpoint_filters_and_cluster_merge(cluster):
+    """The non-follow /events window: class/severity filters apply,
+    and ?cluster=1 merges peer windows WITHOUT duplicating entries —
+    in-process nodes share one journal, so the merge must dedupe by
+    (node, seq)."""
+    from minio_tpu.utils import eventlog
+    a, _b = cluster
+    eventlog.emit("drive.suspect", drive="/obs/d9", set=3)
+    ents = _mc(a).events(classes="drive.suspect")
+    assert any(e["attrs"].get("drive") == "/obs/d9" for e in ents)
+    assert all(e["class"] == "drive.suspect" for e in ents)
+    for e in _mc(a).events(severity="error"):
+        assert e["sev"] in ("error", "crit"), e
+    merged = _mc(a).events(cluster=True, classes="drive.suspect")
+    keys = [(e["node"], e["seq"]) for e in merged]
+    assert len(keys) == len(set(keys)), "cluster merge duplicated"
+    assert any(e["attrs"].get("drive") == "/obs/d9" for e in merged)
+
+
+def test_drivehealth_surfaces_journal(cluster):
+    """Satellite (a): the drivehealth document carries the
+    journal-backed transition history next to the in-memory deque."""
+    from minio_tpu.utils import eventlog
+    a, _b = cluster
+    eventlog.emit("drive.probation", drive="/obs/dh", set=1)
+    doc = _mc(a).drive_health()
+    j = doc.get("journal")
+    assert isinstance(j, list)
+    assert any(e["class"] == "drive.probation"
+               and e["attrs"].get("drive") == "/obs/dh" for e in j)
+    assert all(e["sub"] in ("drive", "health") for e in j)
+
+
+def test_slo_endpoint_reports_objectives(cluster):
+    """GET /slo answers with the burn-rate status document."""
+    a, _b = cluster
+    doc = _mc(a).slo()
+    assert "objectives" in doc and "burn_threshold" in doc
+    names = {o["objective"] for o in doc["objectives"]}
+    assert {"read-availability", "write-availability",
+            "read-latency", "write-latency"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -438,9 +578,16 @@ def test_edge_trace_parity_with_threaded_oracle(layer):
                                        body=b"p" * 100000)[0] == 200
                 ttfb_delta[tag] = edge_dispatch._HTTP_TTFB.count(
                     api="PutObject") - before
-                trees = [t for t in telemetry.SPANS.dump(200)
-                         if t["name"] == "PutObject"
-                         and t.get("attrs", {}).get("path") == path]
+                # the client sees the response a hair before the
+                # server closes (and offers) the root span — poll
+                trees: list = []
+                deadline = time.monotonic() + 5.0
+                while not trees and time.monotonic() < deadline:
+                    trees = [t for t in telemetry.SPANS.dump(200)
+                             if t["name"] == "PutObject"
+                             and t.get("attrs", {}).get("path") == path]
+                    if not trees:
+                        time.sleep(0.05)
                 assert trees, f"no kept PutObject tree for {tag}"
                 roots[tag] = trees[-1]
                 ent = [e for e in srv.api.trace.recent

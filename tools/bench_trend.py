@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""bench_trend — A/B diff of two BENCH_r*.json snapshots.
+
+Each bench round persists one ``BENCH_r<NN>.json`` (``{n, cmd, rc,
+tail, parsed}``); this tool flattens both snapshots' ``parsed`` trees
+to dotted numeric keys and prints a trajectory table, so a perf
+regression between rounds is one command to see and one exit code to
+gate on:
+
+    python tools/bench_trend.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_trend.py --threshold 10 old.json new.json
+    python tools/bench_trend.py --smoke        # self-test, no files
+
+Direction is inferred per key: ``*_ms`` / ``*_s`` / ``*_overhead_x``
+/ ``*_iqr*`` are lower-better (latency, overhead, jitter); everything
+else numeric (``gibs``, ``value``, ``vs_baseline``, counts) is
+higher-better. Exit 1 when any key regresses past ``--threshold``
+percent (default 5); keys present on only one side are listed but
+never gate — a new bench section must not fail the trend check that
+predates it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterator, Tuple
+
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_overhead_x", "_us")
+LOWER_BETTER_TOKENS = ("iqr", "latency", "p50", "p99", "overhead")
+
+
+def flatten(doc: object, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a parsed tree as dotted keys; lists index
+    numerically. Booleans and strings are skipped — the trend is about
+    magnitudes, not flags."""
+    out: Dict[str, float] = {}
+
+    def walk(node: object, key: str) -> None:
+        if isinstance(node, bool):
+            return
+        if isinstance(node, (int, float)):
+            out[key] = float(node)
+            return
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], f"{key}.{k}" if key else str(k))
+            return
+        if isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{key}.{i}" if key else str(i))
+
+    walk(doc, prefix)
+    return out
+
+
+def lower_is_better(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf.endswith(LOWER_BETTER_SUFFIXES):
+        return True
+    return any(t in leaf for t in LOWER_BETTER_TOKENS)
+
+
+def compare(old: Dict[str, float], new: Dict[str, float]
+            ) -> Iterator[Tuple[str, float, float, float, bool]]:
+    """(key, old, new, signed % change where positive = improvement,
+    regressed?) for every shared key — plus one-sided keys with change
+    NaN, never regressed."""
+    for key in sorted(set(old) | set(new)):
+        if key not in old or key not in new:
+            yield key, old.get(key, float("nan")), \
+                new.get(key, float("nan")), float("nan"), False
+            continue
+        a, b = old[key], new[key]
+        if a == 0:
+            yield key, a, b, float("nan"), False
+            continue
+        raw = (b - a) / abs(a) * 100.0
+        gain = -raw if lower_is_better(key) else raw
+        yield key, a, b, gain, gain < 0
+
+
+def run_diff(old_path: str, new_path: str, threshold: float,
+             out=sys.stdout) -> int:
+    with open(old_path) as f:
+        old_doc = json.load(f)
+    with open(new_path) as f:
+        new_doc = json.load(f)
+    old = flatten(old_doc.get("parsed") or {})
+    new = flatten(new_doc.get("parsed") or {})
+    rows = list(compare(old, new))
+    name_w = max([len(k) for k, *_ in rows] + [6])
+    print(f"{'key'.ljust(name_w)}  {'old':>12}  {'new':>12}  "
+          f"{'change':>9}", file=out)
+    failures = []
+    for key, a, b, gain, regressed in rows:
+        if gain != gain:                               # NaN: one-sided
+            mark = "  (one-sided)" if (a != a or b != b) else ""
+            ch = "-"
+        else:
+            ch = f"{gain:+.1f}%"
+            mark = ""
+            if regressed and -gain > threshold:
+                failures.append((key, gain))
+                mark = "  << REGRESSED"
+        fa = "-" if a != a else f"{a:.4g}"
+        fb = "-" if b != b else f"{b:.4g}"
+        print(f"{key.ljust(name_w)}  {fa:>12}  {fb:>12}  {ch:>9}"
+              f"{mark}", file=out)
+    if failures:
+        print(f"\n{len(failures)} key(s) regressed past "
+              f"{threshold:.1f}%:", file=out)
+        for key, gain in failures:
+            print(f"  {key}: {gain:+.1f}%", file=out)
+        return 1
+    print(f"\nno regression past {threshold:.1f}% "
+          f"({len(rows)} keys compared)", file=out)
+    return 0
+
+
+def smoke() -> int:
+    """Self-test on synthetic snapshots (pinned by the fast test
+    suite): an improvement, a regression past threshold, a
+    lower-better key, and a one-sided key."""
+    old = {"parsed": {"value": 10.0, "put_p99_ms": 8.0,
+                      "overhead_x": 1.01, "old_only": 3}}
+    new = {"parsed": {"value": 12.0, "put_p99_ms": 16.0,
+                      "overhead_x": 1.0, "new_only": 4}}
+    o = flatten(old["parsed"])
+    n = flatten(new["parsed"])
+    rows = {k: (a, b, g, r) for k, a, b, g, r in compare(o, n)}
+    assert rows["value"][2] > 0 and not rows["value"][3], rows["value"]
+    assert rows["put_p99_ms"][2] == -100.0 and rows["put_p99_ms"][3]
+    assert rows["overhead_x"][2] > 0 and not rows["overhead_x"][3]
+    assert rows["old_only"][3] is False
+    assert lower_is_better("kernels_ms.put.median_ms")
+    assert lower_is_better("bench.put_p99_overhead_x")
+    assert not lower_is_better("device_info.put_gibs_min_window")
+    print("bench_trend smoke: ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_trend")
+    ap.add_argument("snapshots", nargs="*",
+                    help="OLD.json NEW.json (two BENCH_r*.json files)")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="regression percent that fails the gate "
+                    "(default 5)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the built-in self-test and exit")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if len(args.snapshots) != 2:
+        ap.error("need exactly two snapshot paths (or --smoke)")
+    return run_diff(args.snapshots[0], args.snapshots[1],
+                    args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,7 @@ PKG_ROOT = os.path.join(REPO, "minio_tpu")
 
 RULES = ("lock-blocking", "metrics-hygiene", "knob-env",
          "hook-coverage", "error-map", "admission", "crashpoint",
-         "deadline", "fencing", "crypto-hygiene")
+         "deadline", "fencing", "crypto-hygiene", "eventlog")
 
 _ALLOW_RE = re.compile(r"#\s*check:\s*allow\(([a-z\-]+(?:\s*,\s*[a-z\-]+)*)\)"
                        r"(.*)$")

@@ -1,0 +1,91 @@
+"""README event-class-table generator + drift check.
+
+The table between the ``EVENT_TABLE`` markers in README.md is
+GENERATED from the declarative registry in
+``minio_tpu/utils/eventlog.py`` — never hand-edited (the knob-table
+pattern). The ``eventlog`` lint rule fails when the committed table
+drifts; ``run.py --write-event-table`` regenerates it.
+
+eventlog.py keeps its registry half dependency-free (its pubsub/
+atomicfile/knobs imports are lazy) precisely so it loads standalone
+here — no jax, no package import, no side effects.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import List
+
+from .core import REPO, Violation
+
+EVENTLOG_PATH = os.path.join(REPO, "minio_tpu", "utils", "eventlog.py")
+README = os.path.join(REPO, "README.md")
+
+
+def load_events():
+    spec = importlib.util.spec_from_file_location("_check_eventlog",
+                                                  EVENTLOG_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)          # type: ignore[union-attr]
+    return mod
+
+
+def generated_block(mod=None) -> str:
+    mod = mod or load_events()
+    return (mod.TABLE_BEGIN + "\n\n" + mod.render_table() + "\n"
+            + mod.TABLE_END)
+
+
+def _split_readme(text: str, mod) -> tuple:
+    b, e = mod.TABLE_BEGIN, mod.TABLE_END
+    if b not in text or e not in text:
+        return None
+    head, rest = text.split(b, 1)
+    _, tail = rest.split(e, 1)
+    return head, tail
+
+
+def check_drift() -> List[Violation]:
+    mod = load_events()
+    try:
+        with open(README, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return [Violation("eventlog", "README.md", 1,
+                          "README.md not readable")]
+    parts = _split_readme(text, mod)
+    if parts is None:
+        return [Violation(
+            "eventlog", "README.md", 1,
+            "event-table markers missing — add "
+            f"{mod.TABLE_BEGIN!r} … {mod.TABLE_END!r} and run "
+            "tools/check/run.py --write-event-table")]
+    head, tail = parts
+    current = text[len(head):len(text) - len(tail)]
+    if current.strip() != generated_block(mod).strip():
+        line = head.count("\n") + 1
+        return [Violation(
+            "eventlog", "README.md", line,
+            "event-class table drifted from the registry in "
+            "minio_tpu/utils/eventlog.py — regenerate with "
+            "`python tools/check/run.py --write-event-table`")]
+    return []
+
+
+def write_table() -> bool:
+    """Regenerate the README block in place; returns True on change."""
+    mod = load_events()
+    with open(README, encoding="utf-8") as f:
+        text = f.read()
+    parts = _split_readme(text, mod)
+    if parts is None:
+        raise SystemExit("README.md event-table markers missing — "
+                         f"add {mod.TABLE_BEGIN}\n{mod.TABLE_END}")
+    head, tail = parts
+    new = head + generated_block(mod) + tail
+    if new == text:
+        return False
+    with open(README, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True

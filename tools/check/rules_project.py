@@ -573,3 +573,73 @@ def check_crypto_hygiene(sources: List[Source]) -> List[Violation]:
                         "have one owner; use the crypto-module "
                         "transforms (or argue the exemption inline)"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule: eventlog
+# ---------------------------------------------------------------------------
+
+# attr keys that name per-request / per-object identities (the same
+# vocabulary the label-cardinality sub-rule bans on metric labels): a
+# bounded journal must never carry unbounded attr KEYS
+EVENT_UNBOUNDED_ATTRS = {
+    "bucket", "object", "key", "obj", "etag", "version_id",
+    "upload_id", "prefix", "trace_id", "request_id", "caller",
+}
+
+
+def check_eventlog(sources: List[Source],
+                   registered: Dict[str, tuple]) -> List[Violation]:
+    """Every journal emit — `eventlog.emit(...)` or `JOURNAL.emit(...)`
+    — names a registered event class with a constant string, passes
+    only that class's declared attr keys, and never spreads **kwargs
+    (the registry/table/lint all key on what is visible statically).
+    `registered` maps class name -> declared attr tuple (from
+    eventtable.load_events)."""
+    out: List[Violation] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not (d.endswith("eventlog.emit")
+                    or d.endswith("eventlog.emit_once")
+                    or d.endswith("JOURNAL.emit")):
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:
+                out.append(Violation(
+                    "eventlog", src.rel, node.lineno,
+                    "eventlog.emit() needs a constant event-class "
+                    "name — the registry/table/tests all key on "
+                    "literals"))
+                continue
+            if name not in registered:
+                out.append(Violation(
+                    "eventlog", src.rel, node.lineno,
+                    f"eventlog.emit({name!r}) names an unregistered "
+                    "event class — declare it in "
+                    "minio_tpu/utils/eventlog.py"))
+                continue
+            declared = set(registered[name])
+            for kw in node.keywords:
+                if kw.arg is None:
+                    out.append(Violation(
+                        "eventlog", src.rel, node.lineno,
+                        f"eventlog.emit({name!r}, **kwargs) — attr "
+                        "keys must be visible statically; pass them "
+                        "as explicit keywords"))
+                    continue
+                if kw.arg in EVENT_UNBOUNDED_ATTRS:
+                    out.append(Violation(
+                        "eventlog", src.rel, node.lineno,
+                        f"eventlog.emit({name!r}) attr {kw.arg!r} is "
+                        "in the unbounded label vocabulary — journal "
+                        "attrs must stay bounded"))
+                elif kw.arg not in declared:
+                    out.append(Violation(
+                        "eventlog", src.rel, node.lineno,
+                        f"eventlog.emit({name!r}) passes undeclared "
+                        f"attr {kw.arg!r} — declare it on the event "
+                        "class in minio_tpu/utils/eventlog.py"))
+    return out

@@ -33,6 +33,9 @@ Rules (suppress a line with ``# check: allow(<rule>) <reason>``):
   fencing           epoch-registry save/load/bump sites go through
                     utils/regfence (lineage chain, write quorum,
                     deterministic pick_best) — split-brain safety
+  eventlog          journal emits name a registered event class with
+                    declared, bounded-cardinality attrs; README
+                    event-class table fresh
 """
 
 from __future__ import annotations
@@ -45,11 +48,11 @@ import sys
 if __package__ in (None, ""):                     # `python tools/check/run.py`
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from check import (core, crashtable, knobtable, metricstable,
-                       rules_ast, rules_project)
+    from check import (core, crashtable, eventtable, knobtable,
+                       metricstable, rules_ast, rules_project)
 else:
-    from . import (core, crashtable, knobtable, metricstable,
-                   rules_ast, rules_project)
+    from . import (core, crashtable, eventtable, knobtable,
+                   metricstable, rules_ast, rules_project)
 
 
 def _group_by_path(violations):
@@ -91,6 +94,11 @@ def run_checks(rules=None):
         vs += rules_project.check_fencing(sources)
     if "crypto-hygiene" in selected:
         vs += rules_project.check_crypto_hygiene(sources)
+    if "eventlog" in selected:
+        ev_mod = eventtable.load_events()
+        classes = {name: ec.attrs for name, ec in ev_mod.EVENTS.items()}
+        vs += rules_project.check_eventlog(sources, classes)
+        vs += eventtable.check_drift()
     out = []
     for rel, group in _group_by_path(vs).items():
         src = by_rel.get(rel)
@@ -126,6 +134,9 @@ def main(argv=None) -> int:
     ap.add_argument("--write-crashpoint-table", action="store_true",
                     help="regenerate the README crashpoint table from "
                     "the registry and exit")
+    ap.add_argument("--write-event-table", action="store_true",
+                    help="regenerate the README event-class table "
+                    "from the registry and exit")
     args = ap.parse_args(argv)
 
     if args.write_knob_table:
@@ -141,6 +152,11 @@ def main(argv=None) -> int:
     if args.write_crashpoint_table:
         changed = crashtable.write_table()
         print("README crashpoint table "
+              + ("updated" if changed else "already fresh"))
+        return 0
+    if args.write_event_table:
+        changed = eventtable.write_table()
+        print("README event-class table "
               + ("updated" if changed else "already fresh"))
         return 0
 
