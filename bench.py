@@ -2584,6 +2584,228 @@ def bench_incident_ab(streams: int = 8, size: int = 1 << 20,
     return out
 
 
+def bench_tenants_ab(noisy_streams: int = 8, size: int = 1 << 20,
+                     drives: int = 6, parity: int = 2,
+                     block: int = 1 << 18, polite_ops: int = 24,
+                     max_clients: int = 8,
+                     overhead_rounds: int = 4) -> dict:
+    """Multi-tenant QoS A/B (ISSUE 19): does the weighted-share gate
+    actually protect a polite tenant from a noisy neighbor, and what
+    does the plane cost a lone tenant.
+
+    Phase 1 — isolation: a noisy IAM tenant hammers PUTs on
+    noisy_streams concurrent connections while a polite tenant issues
+    one sequential PUT at a time. With MINIO_TPU_QOS off the polite
+    stream queues behind the noisy flood at the maxClients semaphore;
+    with it on (equal shares) the noisy tenant is bounded to its
+    share of the gate and its excess streams shed 503 SlowDown under
+    reason=tenant, so the polite p99 must drop. isolation_p99_x is
+    polite-p99-off / polite-p99-on (> 1 means the plane helped).
+
+    Phase 2 — lone-tenant overhead: the same concurrent PUT round as
+    the incident A/B, single (root) tenant, QoS off vs on. A lone
+    tenant borrows the whole gate, so put_p99_overhead_x is pure
+    bookkeeping cost (acceptance: <= 1.05)."""
+    import concurrent.futures as cf
+    import shutil
+    import tempfile
+    import threading
+    import urllib.parse
+
+    from minio_tpu.iam.sys import IAMSys
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.s3 import signature as sig
+    from minio_tpu.s3.credentials import Credentials
+    from minio_tpu.s3.qos import Budget
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.utils import telemetry
+
+    creds = Credentials("benchqoskey123", "benchqossecret1")
+    noisy_cred = Credentials("noisytenant123", "noisysecret1234")
+    polite_cred = Credentials("politetenant12", "politesecret123")
+    region = "us-east-1"
+    out: dict = {"config": {"noisy_streams": noisy_streams,
+                            "size": size, "polite_ops": polite_ops,
+                            "max_clients": max_clients}}
+
+    def pcts(lat: list[float]) -> dict:
+        lat = sorted(lat)
+        return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(lat[min(int(len(lat) * 0.99),
+                                        len(lat) - 1)] * 1e3, 3)}
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_qos_", dir=base)
+    payload = os.urandom(size)
+    saved = os.environ.get("MINIO_TPU_QOS")
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)
+        iam = IAMSys(root_cred=creds)
+        iam.add_user(noisy_cred.access_key, noisy_cred.secret_key)
+        iam.add_user(polite_cred.access_key, polite_cred.secret_key)
+        iam.attach_policy("readwrite", user=noisy_cred.access_key)
+        iam.attach_policy("readwrite", user=polite_cred.access_key)
+        srv = S3Server(sets, creds=creds, region=region,
+                       iam=iam).start()
+        srv.api.set_max_clients(max_clients)
+        try:
+            def mk_signed(cred):
+                def signed(method, path, port, payload_hash,
+                           extra=None):
+                    hdrs = {"host": f"127.0.0.1:{port}"}
+                    hdrs.update(extra or {})
+                    return sig.sign_v4(method,
+                                       urllib.parse.quote(path), {},
+                                       hdrs, payload_hash, cred,
+                                       region)
+                return signed
+
+            signed_root = mk_signed(creds)
+            assert _http_put(srv.port, "/bench-qos", b"", signed_root,
+                             creds) == 200
+            assert _http_put(srv.port, "/bench-qos/warm", payload,
+                             signed_root, creds) == 200
+
+            # equal shares: with both tenants active the noisy tenant
+            # is bounded to half the gate and its surplus streams shed
+            srv.api.qos.registry.set_budget(
+                "tenant", Budget(noisy_cred.access_key, share=1.0))
+            srv.api.qos.registry.set_budget(
+                "tenant", Budget(polite_cred.access_key, share=1.0))
+
+            shed_counter = telemetry.REGISTRY.counter(
+                "minio_tpu_requests_shed_total")
+
+            def isolation_phase(mode: str, tag: str) -> dict:
+                os.environ["MINIO_TPU_QOS"] = mode
+                shed0 = shed_counter.value(reason="tenant")
+                stop = threading.Event()
+                mu = threading.Lock()
+                noisy = {"ok": 0, "shed": 0}
+                signed_noisy = mk_signed(noisy_cred)
+                signed_polite = mk_signed(polite_cred)
+
+                def noisy_worker(w: int) -> None:
+                    i = 0
+                    while not stop.is_set():
+                        try:
+                            st = _http_put(
+                                srv.port,
+                                f"/bench-qos/n-{tag}-{w}-{i}",
+                                payload, signed_noisy, noisy_cred)
+                        except OSError:
+                            # the gate refused pre-body and closed the
+                            # socket while this client was still
+                            # streaming the payload — a shed, observed
+                            # as a reset instead of the 503
+                            st = 503
+                        with mu:
+                            if st == 200:
+                                noisy["ok"] += 1
+                            elif st == 503:
+                                noisy["shed"] += 1
+                        i += 1
+
+                threads = [threading.Thread(target=noisy_worker,
+                                            args=(w,), daemon=True)
+                           for w in range(noisy_streams)]
+                for t in threads:
+                    t.start()
+                lat: list[float] = []
+                signed_p = signed_polite
+                for i in range(polite_ops):
+                    t0 = time.perf_counter()
+                    while True:
+                        try:
+                            st = _http_put(srv.port,
+                                           f"/bench-qos/p-{tag}-{i}",
+                                           payload, signed_p,
+                                           polite_cred)
+                        except OSError:
+                            st = 503
+                        if st == 200:
+                            break
+                        assert st == 503, st
+                        time.sleep(0.002)
+                    lat.append(time.perf_counter() - t0)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+                return {"polite": pcts(lat),
+                        "noisy_ok": noisy["ok"],
+                        "noisy_shed": noisy["shed"],
+                        "shed_total_delta": int(
+                            shed_counter.value(reason="tenant")
+                            - shed0)}
+
+            for mode in ("off", "on"):
+                out.setdefault("isolation", {})[mode] = \
+                    isolation_phase(mode, mode)
+            out["isolation_p99_x"] = round(
+                out["isolation"]["off"]["polite"]["p99_ms"]
+                / max(out["isolation"]["on"]["polite"]["p99_ms"],
+                      1e-9), 3)
+            out["noisy_sheds"] = \
+                out["isolation"]["on"]["shed_total_delta"]
+            stats = srv.api.qos.stats()
+            out["tenant_stats"] = {
+                t: {"requests": s["requests"], "shed": s["shed"]}
+                for t, s in stats.items()}
+
+            # -- phase 2: lone-tenant overhead ---------------------
+            def overhead_round(tag: str) -> list[float]:
+                lat: list[float] = []
+                mu = threading.Lock()
+
+                def one(i: int) -> None:
+                    t0 = time.perf_counter()
+                    while True:
+                        try:
+                            st = _http_put(srv.port,
+                                           f"/bench-qos/o-{tag}-{i}",
+                                           payload, signed_root,
+                                           creds)
+                        except OSError:
+                            st = 503
+                        if st == 200:
+                            break
+                        # a 503 here is residual staging pressure from
+                        # the isolation flood; retry like a client would
+                        assert st == 503, st
+                        time.sleep(0.01)
+                    with mu:
+                        lat.append(time.perf_counter() - t0)
+
+                for r in range(overhead_rounds):
+                    with cf.ThreadPoolExecutor(
+                            max_workers=noisy_streams) as ex:
+                        list(ex.map(one,
+                                    range(r * noisy_streams,
+                                          (r + 1) * noisy_streams)))
+                return lat
+
+            for mode in ("off", "on"):
+                os.environ["MINIO_TPU_QOS"] = mode
+                out.setdefault("overhead", {})[mode] = pcts(
+                    overhead_round(mode))
+            out["put_p99_overhead_x"] = round(
+                out["overhead"]["on"]["p99_ms"]
+                / max(out["overhead"]["off"]["p99_ms"], 1e-9), 3)
+        finally:
+            srv.stop()
+            sets.close()
+    finally:
+        if saved is None:
+            os.environ.pop("MINIO_TPU_QOS", None)
+        else:
+            os.environ["MINIO_TPU_QOS"] = saved
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _read_resp(sock) -> int:
     """Read one HTTP response off a raw socket; returns the status."""
     buf = b""
@@ -2756,6 +2978,14 @@ def main() -> int:
     ap.add_argument("--ab-incident-smoke", action="store_true",
                     help="tiny incident A/B (2 streams, 256 KiB "
                          "objects) for CI — seconds, not minutes")
+    ap.add_argument("--ab-tenants", action="store_true",
+                    help="run ONLY the multi-tenant QoS A/B: a noisy "
+                         "tenant on 8 streams vs a polite tenant on "
+                         "1, polite PUT p99 with the plane off vs on "
+                         "(equal shares), plus lone-tenant overhead")
+    ap.add_argument("--ab-tenants-smoke", action="store_true",
+                    help="tiny tenants A/B (2 noisy streams, 256 KiB "
+                         "objects) for CI — seconds, not minutes")
     args = ap.parse_args()
 
     if args.ab_gray or args.ab_gray_smoke:
@@ -2827,6 +3057,27 @@ def main() -> int:
             "value": ab.get("put_p99_overhead_x"),
             "unit": "x",
             "incident_ab": ab,
+        }))
+        return 0
+
+    if args.ab_tenants or args.ab_tenants_smoke:
+        if args.ab_tenants_smoke:
+            ab = bench_tenants_ab(noisy_streams=2, size=1 << 18,
+                                  drives=6, block=1 << 16,
+                                  polite_ops=8, max_clients=2,
+                                  overhead_rounds=2)
+        else:
+            ab = bench_tenants_ab(noisy_streams=min(args.ab_streams,
+                                                    8),
+                                  size=args.ab_size)
+        print(json.dumps({
+            "metric": "polite-tenant PUT p99 with the QoS plane off "
+                      "vs on under a noisy neighbor (isolation_p99_x "
+                      "> 1 = the plane helped; put_p99_overhead_x = "
+                      "lone-tenant cost)",
+            "value": ab.get("isolation_p99_x"),
+            "unit": "x",
+            "tenants_ab": ab,
         }))
         return 0
 

@@ -455,6 +455,15 @@ class ClusterNode:
             pass
         self.s3.api.tiers = self.tiers
 
+        # -- QoS budget registry (s3/qos.py) -------------------------------
+        # same every-pool persistence rule as tiers: recover the newest
+        # budget doc; a missing/torn doc just means default budgets
+        self.s3.api.qos.registry.obj = self.object_layer
+        try:
+            self.s3.api.qos.registry.load()
+        except Exception:  # noqa: BLE001 — boot proceeds on defaults
+            pass
+
         # -- boot-time crash-consistency audit (object/fsck.py) ------------
         # MINIO_TPU_FSCK_BOOT=on: audit every pool and repair what the
         # last crash left behind (tmp garbage, orphan data dirs, torn
@@ -540,7 +549,12 @@ class ClusterNode:
                                           restore_reclaim_action,
                                           transition_action)
             self.transition_worker = TransitionWorker(
-                self.object_layer, self.tiers).start()
+                self.object_layer, self.tiers)
+            # per-tier push budgets come from the QoS registry's
+            # "tier" scope (same doc shape the tenant budgets use)
+            self.transition_worker.budget_lookup = \
+                lambda name: self.s3.api.qos.registry.get("tier", name)
+            self.transition_worker.start()
             # async RestoreObject (202 + background pull) rides the
             # same worker, throttled with the transitions
             self.s3.api.restore_worker = self.transition_worker

@@ -591,6 +591,16 @@ class IAMSys:
                     return c
         return None
 
+    def account_of(self, access_key: str) -> Optional[str]:
+        """The billing/QoS tenant an access key belongs to: service
+        accounts and STS temp creds roll up to their parent user, plain
+        users stand for themselves. None when the key is not registered
+        here (the root credential lives outside the IAM tables)."""
+        cred = self.get_credentials(access_key)
+        if cred is None:
+            return None
+        return cred.parent_user or cred.access_key
+
     def _effective_policy_names(self, access_key: str) -> list[str]:
         names = list(self.user_policy.get(access_key, []))
         for g, info in self.groups.items():

@@ -197,6 +197,28 @@ class AdminClient:
         """Transition-worker queue/throughput counters."""
         return self._json("GET", "tier/stats")
 
+    # -- multi-tenant QoS --------------------------------------------------
+
+    def qos_get(self) -> dict:
+        """QoS plane state: enabled flag, registry epoch, tenant/tier
+        budgets, and live per-tenant stats."""
+        return self._json("GET", "qos")
+
+    def qos_set(self, name: str, scope: str = "tenant",
+                share: float = 0.0, rps: float = 0.0,
+                rx_bps: float = 0.0, tx_bps: float = 0.0) -> dict:
+        """Set (or replace) one tenant/tier budget; 0 means
+        default/unlimited for that dimension."""
+        return self._json("PUT", "qos", None,
+                          json.dumps({"scope": scope, "name": name,
+                                      "share": share, "rps": rps,
+                                      "rx_bps": rx_bps,
+                                      "tx_bps": tx_bps}).encode())
+
+    def qos_remove(self, name: str, scope: str = "tenant") -> dict:
+        return self._json("DELETE", "qos",
+                          {"scope": scope, "name": name})
+
     # -- IAM ---------------------------------------------------------------
 
     def add_user(self, access_key: str, secret_key: str) -> None:

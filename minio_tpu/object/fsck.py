@@ -69,7 +69,7 @@ CLASSES = (
 # registry / checkpoint document prefixes audited per pool (the docs
 # deliberately written to every pool — topology epochs, tier config,
 # replication targets, rebalance/resync checkpoints)
-REGISTRY_PREFIXES = ("topology/", "tier/", "replicate/")
+REGISTRY_PREFIXES = ("topology/", "tier/", "replicate/", "qos/")
 
 _REPL_ORIGIN_KEY = "X-Minio-Internal-replication-origin"
 

@@ -64,7 +64,7 @@ BACKOFF_TRIES = knobs.get_int("MINIO_TPU_REBALANCE_BACKOFF_TRIES")
 # pool) and the topology/checkpoint/tier-config docs themselves
 # (written to every pool on purpose)
 META_SKIP_PREFIXES = ("tmp/", "multipart/", "buckets/", TOPOLOGY_PREFIX,
-                      "tier/", "replicate/")
+                      "tier/", "replicate/", "qos/")
 
 
 def _checkpoint_object(pool: int) -> str:

@@ -594,6 +594,41 @@ class AdminHandlers:
             return self._json(worker.stats() if worker is not None
                               else {})
 
+        # -- multi-tenant QoS plane: budget registry (s3/qos.py) -----------
+        if sub == "qos" and m == "GET":
+            self._auth(ctx, "admin:ListQoS")
+            qos = self.api.qos
+            return self._json({
+                "enabled": qos.enabled(),
+                "epoch": qos.registry.epoch,
+                "tenants": qos.registry.list("tenant"),
+                "tiers": qos.registry.list("tier"),
+                "stats": qos.stats()})
+        if sub == "qos" and m == "PUT":
+            # set (or replace) one tenant/tier budget
+            self._auth(ctx, "admin:SetQoS")
+            from .qos import Budget, QoSConfigError
+            try:
+                body = json.loads(ctx.read_body().decode() or "{}")
+                scope = str(body.pop("scope", "tenant"))
+                budget = Budget.from_dict(body)
+                epoch = self.api.qos.registry.set_budget(scope, budget)
+            except (ValueError, QoSConfigError) as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            return self._json({"scope": scope, "name": budget.name,
+                               "epoch": epoch})
+        if sub == "qos" and m == "DELETE":
+            self._auth(ctx, "admin:SetQoS")
+            from .qos import QoSConfigError
+            scope = ctx.query1("scope", "tenant")
+            name = ctx.query1("name", "")
+            try:
+                epoch = self.api.qos.registry.remove_budget(scope, name)
+            except QoSConfigError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            return self._json({"scope": scope, "name": name,
+                               "epoch": epoch})
+
         # -- config KV (cmd/admin-handlers-config-kv.go) -------------------
         if sub == "get-config" and m == "GET":
             self._auth(ctx, "admin:ConfigUpdate")

@@ -107,7 +107,8 @@ def run_request(api, extra_routers, ctx, command: str, raw_path: str,
                                  ctx.req.raw_query, status[0], dur,
                                  caller=caller, api=api_name,
                                  trace_id=trace_id, ttfb_s=ttfb[0],
-                                 shed_reason=shed_reason[0])
+                                 shed_reason=shed_reason[0],
+                                 tenant=getattr(ctx, "tenant", ""))
             except Exception:  # noqa: BLE001 — tracing is passive
                 pass
     return status[0]

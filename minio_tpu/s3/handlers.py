@@ -79,6 +79,9 @@ class RequestContext:
         # hex digest the client signed over (x-amz-content-sha256);
         # enforced when the body is consumed (isReqAuthenticated analog)
         self.expect_body_sha = ""
+        # QoS tenant the admission ticket resolved ("" = plane off);
+        # confirmed from the verified credential post-auth
+        self.tenant = ""
 
     def query1(self, name: str, default: str = "") -> str:
         v = self.req.query.get(name)
@@ -224,6 +227,15 @@ class S3ApiHandlers:
         # RAM+CPU budget (requests_budget) via set_max_clients().
         from .edge.admission import AdmissionController
         self.admission = AdmissionController(max_clients)
+        # The multi-tenant QoS plane (s3/qos.py): per-tenant shares and
+        # budgets enforced AT the admission gate. The iam lookup is
+        # late-bound — the cluster boot sets self.iam after this
+        # constructor runs. Off by default (MINIO_TPU_QOS).
+        from .qos import QoSPlane, QoSRegistry
+        self.qos = QoSPlane(QoSRegistry(object_layer),
+                            iam_lookup=lambda: self.iam,
+                            root_access_key=self.root_cred.access_key)
+        self.admission.qos = self.qos
         self.events = None        # optional event notifier hook
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPlane (or the
@@ -274,6 +286,8 @@ class S3ApiHandlers:
         # the scheduler-occupancy admission signal probes the live
         # layer's batch formers
         self.admission.layer = object_layer
+        # the QoS budget registry persists to the live layer's pools
+        self.qos.registry.obj = object_layer
 
     # ------------------------------------------------------------------
     # auth
@@ -329,6 +343,8 @@ class S3ApiHandlers:
                                            object_name):
                 raise S3Error("AccessDenied")
             ctx.cred = Credentials()
+            if self.qos.enabled():
+                ctx.tenant = self.qos.tenant_for_cred(None)
             return
         else:
             raise S3Error("SignatureVersionNotSupported")
@@ -346,6 +362,11 @@ class S3ApiHandlers:
                                        object_name,
                                        self._policy_conditions(ctx)):
                 raise S3Error("AccessDenied")
+        # confirm the tenant from the VERIFIED credential (the
+        # admission gate charged the budget of the *claimed* key; a
+        # forged claim never reaches here)
+        if self.qos.enabled():
+            ctx.tenant = self.qos.tenant_for_cred(ctx.cred)
 
     @staticmethod
     def _policy_conditions(ctx: "RequestContext") -> dict:
@@ -529,6 +550,16 @@ class S3ApiHandlers:
                 # body into a closing socket)
                 return got.response(ctx.req.path)
             ticket = got
+        # QoS data-path metering: the ticket carries the tenant the
+        # admission gate resolved; its rx/tx buckets pace the admitted
+        # body and response streams (admission already refused what
+        # should never start — pacing only slows what's over budget)
+        tenant = getattr(ticket, "tenant", "")
+        if tenant:
+            ctx.tenant = tenant
+            if ctx.content_length > 0 and ctx.body_stream is not None:
+                ctx.body_stream = self.qos.paced_body(tenant,
+                                                      ctx.body_stream)
         release = True
         try:
             try:
@@ -536,6 +567,9 @@ class S3ApiHandlers:
             except Exception as e:  # noqa: BLE001 — map to S3 error XML
                 return self._error_response(ctx, api_error_from(e))
             if resp.stream is not None and not resp.long_poll:
+                if tenant:
+                    resp.stream = self.qos.paced_stream(tenant,
+                                                        resp.stream)
                 resp.stream = _ReleasingStream(resp.stream, ticket)
                 release = False
             return resp

@@ -142,7 +142,7 @@ class TraceSys:
                duration_s: float, caller: str = "",
                api: str = "", trace_id: str = "",
                ttfb_s: Optional[float] = None,
-               shed_reason: str = "") -> None:
+               shed_reason: str = "", tenant: str = "") -> None:
         entry = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "node": self.node,
@@ -161,6 +161,10 @@ class TraceSys:
             # scheduler / admission / conns / deadline) — the trace
             # stream's answer to "why is my client seeing 503s"
             entry["shed_reason"] = shed_reason
+        if tenant:
+            # the QoS tenant the request resolved to (plane on only) —
+            # lets `mc admin trace` split traffic per tenant
+            entry["tenant"] = tenant
         if trace_id:
             # the span-tree key: `mc admin trace` output joins to the
             # /minio/admin/v3/spans dump through this id

@@ -42,6 +42,7 @@ from ..storage.datatypes import (RESTORE_EXPIRY_KEY, RESTORE_KEY,
                                  TRANSITIONED_VERSION_KEY, is_restored,
                                  is_transitioned)
 from ..utils import knobs, telemetry
+from ..utils.bandwidth import PacedReader, TokenBucket
 from ..utils.pressure import ForegroundPressure
 from ..utils.streams import IterStream
 from .client import TierClientError, TierObjectNotFound
@@ -66,6 +67,13 @@ def _metrics():
         reg.counter("minio_tpu_tier_restored_total",
                     "RestoreObject pulls completed"),
     )
+
+
+def _throttle_metrics():
+    return telemetry.REGISTRY.counter(
+        "minio_tpu_tier_throttled_total",
+        "Tier pushes stalled by a per-tier QoS budget (request-rate "
+        "waits and byte-pacing stalls)")
 
 
 def _mrf_enqueue(object_layer, bucket: str, name: str) -> bool:
@@ -147,6 +155,11 @@ class TransitionWorker:
         self._inflight = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # per-tier QoS budgets (cluster boot wires this to the QoS
+        # registry's "tier" scope): name -> Budget or None. Pushes
+        # pace through per-tier token buckets built from it.
+        self.budget_lookup = None
+        self._tier_buckets: dict = {}   # tier -> (rps, bps, rps_b, byte_b)
         # stats (admin surface / tests)
         self.queued = 0
         self.moved = 0
@@ -287,6 +300,27 @@ class TransitionWorker:
                     objects_c.inc(tier=tier)
                     bytes_c.inc(moved, tier=tier)
 
+    def _tier_byte_bucket(self, tier: str) -> Optional[TokenBucket]:
+        """Enforce the tier's request-rate budget (blocking — the
+        worker is background, it waits rather than sheds) and return
+        its byte-pacing bucket, or None when the tier has no budget.
+        Buckets rebuild when the registry's rates change."""
+        if self.budget_lookup is None:
+            return None
+        b = self.budget_lookup(tier)
+        rps = float(b.rps) if b is not None else 0.0
+        bps = float(b.tx_bps) if b is not None else 0.0
+        if rps <= 0 and bps <= 0:
+            return None
+        with self._cond:
+            cached = self._tier_buckets.get(tier)
+            if cached is None or cached[0] != rps or cached[1] != bps:
+                cached = (rps, bps, TokenBucket(rps), TokenBucket(bps))
+                self._tier_buckets[tier] = cached
+        if cached[2].take(1) > 0:
+            _throttle_metrics().inc(tier=tier)
+        return cached[3]
+
     def _move(self, bucket: str, name: str, vid: str, tier: str,
               etag: str) -> int:
         """Move ONE version's data to `tier`. Returns bytes moved, or
@@ -294,9 +328,16 @@ class TransitionWorker:
         re-evaluate). Local shards are freed only after the remote
         write verified — a crash anywhere before the stub rewrite
         leaves the object fully readable locally."""
+        # budget gate BEFORE the source stream opens: a paced worker
+        # must not sit on open drive streams while it waits
+        byte_bucket = self._tier_byte_bucket(tier)
         opts = GetOptions(version_id=vid)
         info, stream = self.obj.get_object(bucket, name, opts=opts)
         reader = IterStream(stream)
+        if byte_bucket is not None and byte_bucket.rate > 0:
+            reader = PacedReader(
+                reader, byte_bucket,
+                on_wait=lambda s: _throttle_metrics().inc(tier=tier))
         try:
             md = info.user_defined or {}
             if is_transitioned(md):

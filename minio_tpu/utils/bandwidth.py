@@ -96,16 +96,21 @@ class TokenBucket:
             self.burst = max(self.rate * burst_s, 1.0)
             self._tokens = min(self._tokens, self.burst)
 
-    def take(self, n: int) -> None:
+    def take(self, n: int) -> float:
+        """Block until `n` tokens of budget were consumed; returns the
+        total seconds slept (0.0 when the budget was immediately
+        available — the QoS lag and tier-throttle counters observe
+        this)."""
         # grant in installments of at most one burst: a single chunk
         # larger than the burst window (1 MiB blocks under a small
         # bw_bps) must pace across refills, not livelock waiting for a
         # token level the cap makes unreachable
         remaining = n
+        waited = 0.0
         while remaining > 0:
             with self._mu:
                 if self.rate <= 0:
-                    return
+                    return waited
                 now = time.monotonic()
                 self._tokens = min(
                     self.burst, self._tokens + (now - self._last)
@@ -117,19 +122,98 @@ class TokenBucket:
                     remaining -= want
                     continue
                 wait = (want - self._tokens) / self.rate
-            time.sleep(min(wait, 1.0))
+            wait = min(wait, 1.0)
+            time.sleep(wait)
+            waited += wait
+        return waited
 
-    def paced(self, stream, on_bytes=None):
+    def _refill_locked(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_take(self, n: int) -> float:
+        """Non-blocking take: consume one burst-capped installment of
+        `n` tokens if available NOW and return 0.0; otherwise consume
+        nothing and return the seconds until that installment accrues
+        (the Retry-After hint). The admission plane's refusal probe —
+        the `admission` lint rule confines callers to the
+        AdmissionController and the QoS plane."""
+        if n <= 0:
+            return 0.0
+        with self._mu:
+            if self.rate <= 0:
+                return 0.0
+            self._refill_locked()
+            want = min(n, self.burst)
+            if self._tokens >= want:
+                self._tokens -= want
+                return 0.0
+            return (want - self._tokens) / self.rate
+
+    def peek(self, n: int) -> float:
+        """Like try_take but consumes NOTHING either way: 0.0 when one
+        burst-capped installment of `n` is available now, else the
+        seconds until it accrues. Lets admission refuse a payload whose
+        per-tenant byte budget is exhausted without double-charging the
+        stream pacer that meters the admitted bytes."""
+        if n <= 0:
+            return 0.0
+        with self._mu:
+            if self.rate <= 0:
+                return 0.0
+            self._refill_locked()
+            want = min(n, self.burst)
+            if self._tokens >= want:
+                return 0.0
+            return (want - self._tokens) / self.rate
+
+    def paced(self, stream, on_bytes=None, on_wait=None):
         """Wrap a chunk iterator: each chunk waits for budget before it
         flows; `on_bytes(n)` observes the paced bytes (the monitor's
-        record hook)."""
+        record hook), `on_wait(seconds)` the throttle stalls."""
         def gen():
             for chunk in stream:
-                self.take(len(chunk))
+                waited = self.take(len(chunk))
+                if waited > 0 and on_wait is not None:
+                    on_wait(waited)
                 if on_bytes is not None:
                     on_bytes(len(chunk))
                 yield chunk
         return gen()
+
+
+class PacedReader:
+    """File-like wrapper pacing ``read()`` through a TokenBucket (the
+    request-body twin of ``TokenBucket.paced``): bytes are paid for as
+    they are delivered, ``on_bytes(n)`` observes the metered bytes and
+    ``on_wait(seconds)`` the throttle stalls. An unlimited bucket
+    (rate <= 0) degrades to pure accounting."""
+
+    __slots__ = ("_inner", "_bucket", "_on_bytes", "_on_wait")
+
+    def __init__(self, inner, bucket: TokenBucket,
+                 on_bytes=None, on_wait=None):
+        self._inner = inner
+        self._bucket = bucket
+        self._on_bytes = on_bytes
+        self._on_wait = on_wait
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._inner.read(n)
+        if data:
+            waited = self._bucket.take(len(data))
+            if waited > 0 and self._on_wait is not None:
+                self._on_wait(waited)
+            if self._on_bytes is not None:
+                self._on_bytes(len(data))
+        return data
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
 
 
 def merge_reports(reports: list[dict]) -> dict:

@@ -149,6 +149,9 @@ define("tier.save.pool",
 define("replicate.registry.save.pool",
        "inside TargetRegistry.save's per-pool loop (arm :<nth>) — "
        "pools disagree on the replication-target epoch", _W)
+define("qos.save.pool",
+       "inside QoSRegistry.save's per-pool loop (arm :<nth>) — pools "
+       "disagree on the tenant-budget epoch", _W)
 
 _W = "Background checkpoints"
 define("rebalance.checkpoint",

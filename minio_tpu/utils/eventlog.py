@@ -183,6 +183,14 @@ define("incident.captured", _S, "warn",
        ("trigger", "incident", "events"),
        "The black-box recorder wrote an incident bundle")
 
+_S = "qos"
+define("qos.update", _S, "info", ("epoch", "tenants", "tiers"),
+       "The QoS budget registry committed a new epoch (budget set or "
+       "removed)")
+define("tenant.shed", _S, "warn", ("tenant", "reason"),
+       "A tenant hit its QoS budget and was refused (first shed per "
+       "tenant per debounce window)")
+
 del _S
 
 

@@ -234,6 +234,29 @@ define("MINIO_TPU_REQUEST_QUEUE", "int", 128,
 define("MINIO_TPU_IAM_REFRESH_S", "float", 300.0,
        "full IAM cache refresh interval (bounded staleness)", _S)
 
+_S = "Multi-tenant QoS"
+define("MINIO_TPU_QOS", "bool", False,
+       "enforce per-tenant admission shares and budgets at the "
+       "admission gate (off = byte-identical legacy behavior)", _S)
+define("MINIO_TPU_QOS_DEFAULT_SHARE", "float", 1.0,
+       "admission-share weight for tenants without a registered "
+       "budget", _S)
+define("MINIO_TPU_QOS_DEFAULT_RPS", "float", 0.0,
+       "default per-tenant request-rate budget (requests/s); "
+       "0 = unlimited", _S, display="off")
+define("MINIO_TPU_QOS_DEFAULT_RX_BPS", "float", 0.0,
+       "default per-tenant request-body byte budget (bytes/s); "
+       "0 = unlimited", _S, display="off")
+define("MINIO_TPU_QOS_DEFAULT_TX_BPS", "float", 0.0,
+       "default per-tenant response-body byte budget (bytes/s); "
+       "0 = unlimited", _S, display="off")
+define("MINIO_TPU_QOS_ACTIVE_S", "float", 2.0,
+       "seconds since last request a tenant stays in the active set "
+       "the share math divides the gate across", _S)
+define("MINIO_TPU_QOS_SHED_WINDOW_S", "float", 5.0,
+       "debounce window for tenant.shed journal events (first shed "
+       "per tenant per window)", _S)
+
 _S = "HTTP edge"
 define("MINIO_TPU_EDGE", "bool", True,
        "`off` selects the threaded frontend (escape hatch and "

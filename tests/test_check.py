@@ -519,6 +519,42 @@ def test_admission_rule_quiet_in_controller_and_on_tree():
     assert rules_ast.check_admission(load_sources()) == []
 
 
+BAD_PROBE = '''
+from minio_tpu.utils.bandwidth import TokenBucket
+bucket = TokenBucket(10.0, 10.0)
+def maybe_throttle(ctx):
+    if bucket.try_take(1):
+        return
+    wait = bucket.peek(ctx.content_length)
+    ctx.respond(503, retry_after=wait)
+'''
+
+
+def test_admission_rule_fires_on_stray_budget_probe():
+    """A TokenBucket admission probe (try_take / peek with an amount)
+    outside the admission/QoS plane is a private refusal path in the
+    making — the rule catches the probe itself, before anyone wires
+    it to a 503 (ISSUE 19 satellite)."""
+    vs = rules_ast.check_admission(
+        [_src("minio_tpu/object/engine.py", BAD_PROBE)])
+    msgs = "\n".join(v.message for v in vs)
+    assert "budget probe outside the admission/QoS plane" in msgs
+    assert len(vs) == 2                # try_take AND peek both flagged
+
+
+def test_admission_rule_budget_probe_quiet_in_qos_plane():
+    # the three modules that ARE the plane may probe freely
+    for home in ("minio_tpu/s3/edge/admission.py",
+                 "minio_tpu/s3/qos.py",
+                 "minio_tpu/utils/bandwidth.py"):
+        assert rules_ast.check_admission([_src(home, BAD_PROBE)]) == []
+    # zero-argument .peek() calls (the s3select parser's lookahead)
+    # are NOT budget probes and stay quiet anywhere
+    lookahead = "def parse(tok):\n    return tok.peek()\n"
+    assert rules_ast.check_admission(
+        [_src("minio_tpu/s3select/sql.py", lookahead)]) == []
+
+
 # ---------------------------------------------------------------------------
 # rule: metrics-hygiene / label cardinality (ISSUE 13 satellite)
 # ---------------------------------------------------------------------------

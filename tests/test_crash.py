@@ -73,6 +73,21 @@ def _repl_target_add(n: ProcNode) -> None:
             BUCKET, "127.0.0.1", 1, BUCKET, ACCESS_KEY, SECRET_KEY))
 
 
+def _qos_set(n: ProcNode) -> None:
+    expect_request_death(
+        lambda: n.admin().qos_set("alice", share=2.0, rps=10.0))
+
+
+def _verify_qos_registry(n: ProcNode) -> None:
+    # the interrupted epoch either fully landed or fully rolled away —
+    # and the registry still takes writes afterwards
+    got = n.admin().qos_get()
+    names = {b["name"] for b in got["tenants"]}
+    assert names <= {"alice"}, names
+    epoch = n.admin().qos_set("bob", rps=5.0)["epoch"]
+    assert epoch > got["epoch"]
+
+
 def _seed_many(n: ProcNode) -> None:
     for i in range(6):
         n.put(BUCKET, f"obj{i}", bytes([65 + i]) * 1500)
@@ -146,6 +161,7 @@ CASES = {
     "topology.save.pool": dict(pools=2, boot_crash=True),
     "tier.save.pool": dict(trigger=_tier_add),
     "replicate.registry.save.pool": dict(trigger=_repl_target_add),
+    "qos.save.pool": dict(trigger=_qos_set, verify=_verify_qos_registry),
     "rebalance.checkpoint": dict(
         pools=2, seed=_seed_many, trigger=_start_drain, wait_exit=120,
         env={"MINIO_TPU_REBALANCE_CHECKPOINT_EVERY": "1"},

@@ -60,6 +60,8 @@ EVENT_MATRIX = {
     "incident.captured": {"trigger": "slo.breach",
                           "incident": "inc-1-001-slo-breach",
                           "events": 12},
+    "qos.update": {"epoch": 3, "tenants": 2, "tiers": 1},
+    "tenant.shed": {"tenant": "alice", "reason": "rate"},
 }
 
 

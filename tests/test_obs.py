@@ -576,8 +576,16 @@ def test_edge_trace_parity_with_threaded_oracle(layer):
                                        f"/part-{tag}")[0] == 200
                 assert _signed_request(srv.port, "PUT", path,
                                        body=b"p" * 100000)[0] == 200
-                ttfb_delta[tag] = edge_dispatch._HTTP_TTFB.count(
-                    api="PutObject") - before
+                # the client sees the response a hair before the
+                # server thread reaches the histogram observes in
+                # run_request's finally — poll, don't read instantly
+                hist_deadline = time.monotonic() + 5.0
+                while time.monotonic() < hist_deadline:
+                    ttfb_delta[tag] = edge_dispatch._HTTP_TTFB.count(
+                        api="PutObject") - before
+                    if ttfb_delta[tag]:
+                        break
+                    time.sleep(0.01)
                 # the client sees the response a hair before the
                 # server closes (and offers) the root span — poll
                 trees: list = []

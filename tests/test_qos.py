@@ -1,0 +1,642 @@
+"""Multi-tenant QoS plane (ISSUE 19): tier-1 pins of the tentpole.
+
+  * tenant resolution — root / IAM user / service account / STS temp
+    creds all roll up to the right billing account, from the claimed
+    access key (pre-auth) AND the verified credential (post-auth),
+    over the wire on BOTH frontends;
+  * weighted admission shares — a lone tenant borrows the whole gate,
+    equal shares split it, a bought share moves the bound (unit tests
+    on QoSPlane.admit_slot, no HTTP);
+  * budget refusals answer 503 SlowDown + Retry-After with ZERO body
+    bytes read, and land in requests_shed_total{reason=tenant} plus
+    the per-tenant kind counter;
+  * the budget registry persists to every pool with regfence lineage:
+    restart reloads it, a same-epoch fork is a detected + repaired
+    fsck finding (registry_epoch_fork), never a silent merge;
+  * MINIO_TPU_QOS=off (the default) is byte-identical on the wire and
+    touches no QoS counter — even with budgets registered that would
+    refuse every request if the plane ran;
+  * a noisy tenant flooding through a NaughtyDisk-stalled drive sheds
+    at its own share while the polite tenant's requests all land.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.object.fsck import run_fsck
+from minio_tpu.object.server_sets import ErasureServerSets
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.s3 import signature as sig
+from minio_tpu.s3.credentials import Credentials
+from minio_tpu.s3.qos import (QOS_CONFIG_OBJECT, Budget, QoSConfigError,
+                              QoSPlane, QoSRegistry, claimed_access_key)
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.naughty import NaughtyDisk
+from minio_tpu.storage.xl_storage import MINIO_META_BUCKET, XLStorage
+from minio_tpu.utils import regfence, telemetry
+from minio_tpu.utils.bandwidth import TokenBucket
+
+CREDS = Credentials("qosrootkey123", "qosrootsecret123")
+ALICE = Credentials("alicetenant12", "alicesecret1234")
+BOB = Credentials("bobtenant1234", "bobsecret123456")
+REGION = "us-east-1"
+BLOCK = 1 << 16
+
+
+@pytest.fixture(scope="module")
+def layer(tmp_path_factory):
+    root = tmp_path_factory.mktemp("qosdrives")
+    sets = ErasureSets.from_drives(
+        [str(root / f"d{i}") for i in range(6)], 1, 6, 2,
+        block_size=BLOCK)
+    yield sets
+    sets.close()
+
+
+def _mk_iam() -> IAMSys:
+    iam = IAMSys(root_cred=CREDS)        # in-memory store
+    iam.add_user(ALICE.access_key, ALICE.secret_key)
+    iam.add_user(BOB.access_key, BOB.secret_key)
+    iam.attach_policy("readwrite", user=ALICE.access_key)
+    iam.attach_policy("readwrite", user=BOB.access_key)
+    return iam
+
+
+def _mk_server(layer, iam, **env) -> S3Server:
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return S3Server(layer, creds=CREDS, region=REGION,
+                        iam=iam).start()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture(params=["edge", "threaded"])
+def any_server(request, layer, monkeypatch):
+    # enabled() reads the knob per request, so it must stay set for
+    # the whole test, not just through server construction
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    srv = _mk_server(
+        layer, _mk_iam(),
+        MINIO_TPU_EDGE="on" if request.param == "edge" else "off")
+    assert srv.edge_enabled == (request.param == "edge")
+    yield srv
+    srv.stop()
+
+
+def _signed_headers(cred, method, path, port,
+                    payload_hash, extra=None) -> dict:
+    hdrs = {"host": f"127.0.0.1:{port}"}
+    hdrs.update(extra or {})
+    return sig.sign_v4(method, urllib.parse.quote(path), {}, hdrs,
+                       payload_hash, cred, REGION)
+
+
+def _request(port, cred, method, path, body=b""):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    hdrs = _signed_headers(cred, method, path, port,
+                           hashlib.sha256(body).hexdigest())
+    conn.request(method, urllib.parse.quote(path), body=body,
+                 headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = {k.lower(): v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, headers, data
+
+
+def _read_http_response(sock: socket.socket):
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for line in lines[1:]:
+        name, _, value = line.partition(":")
+        headers[name.strip().lower()] = value.strip()
+    want = int(headers.get("content-length", 0))
+    while len(rest) < want:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return status, headers, rest[:want]
+
+
+def _claim_hdrs(access_key: str) -> dict:
+    """Headers carrying only the CLAIM of an access key (no valid
+    signature) — all the tenant mapper reads pre-auth."""
+    return {"authorization":
+            f"AWS4-HMAC-SHA256 Credential={access_key}/20260807/"
+            f"{REGION}/s3/aws4_request, SignedHeaders=host, "
+            "Signature=0"}
+
+
+def _counter(name: str):
+    return telemetry.REGISTRY.counter(name)
+
+
+def _reqs(tenant: str) -> float:
+    return _counter("minio_tpu_qos_tenant_requests_total").value(
+        tenant=tenant)
+
+
+def _shed_reason(reason: str = "tenant") -> float:
+    return _counter("minio_tpu_requests_shed_total").value(
+        reason=reason)
+
+
+def _shed_kind(tenant: str, kind: str) -> float:
+    return _counter("minio_tpu_qos_tenant_shed_total").value(
+        tenant=tenant, kind=kind)
+
+
+# ---------------------------------------------------------------------------
+# tenant resolution
+# ---------------------------------------------------------------------------
+
+def test_claimed_access_key_parses_every_auth_flavor():
+    ak = "AKIAEXAMPLE12345"
+    v4 = {"authorization":
+          f"AWS4-HMAC-SHA256 Credential={ak}/20260807/us-east-1/s3/"
+          "aws4_request, SignedHeaders=host, Signature=beef"}
+    assert claimed_access_key(v4, {}) == ak
+    v2 = {"authorization": f"AWS {ak}:c2lnbmF0dXJl"}
+    assert claimed_access_key(v2, {}) == ak
+    presigned_v4 = {"X-Amz-Credential":
+                    [f"{ak}/20260807/us-east-1/s3/aws4_request"]}
+    assert claimed_access_key({}, presigned_v4) == ak
+    presigned_v2 = {"AWSAccessKeyId": [ak]}
+    assert claimed_access_key({}, presigned_v2) == ak
+    assert claimed_access_key({}, {}) == ""                # anonymous
+    assert claimed_access_key({"authorization": "AWS4-"}, {}) == ""
+
+
+def test_tenant_resolution_rolls_up_to_parent():
+    iam = _mk_iam()
+    svc = iam.new_service_account(ALICE.access_key, "svcacctalice1",
+                                  "svcsecret123456")
+    sts = iam.assume_role(ALICE)
+    plane = QoSPlane(QoSRegistry(), iam_lookup=lambda: iam,
+                     root_access_key=CREDS.access_key)
+    assert plane.resolve_tenant(CREDS.access_key) == "root"
+    assert plane.resolve_tenant(ALICE.access_key) == ALICE.access_key
+    assert plane.resolve_tenant(svc.access_key) == ALICE.access_key
+    assert plane.resolve_tenant(sts.access_key) == ALICE.access_key
+    assert plane.resolve_tenant("") == "anonymous"
+    assert plane.resolve_tenant("neverregistered") == "unknown"
+    # the post-auth verified-credential path lands on the same tenant
+    assert plane.tenant_for_cred(CREDS) == "root"
+    assert plane.tenant_for_cred(svc) == ALICE.access_key
+    assert plane.tenant_for_cred(sts) == ALICE.access_key
+    assert plane.tenant_for_cred(None) == "anonymous"
+
+
+def test_auth_matrix_on_the_wire(any_server):
+    """Root, a plain IAM user, her service account, and her STS temp
+    creds each land their request on the RIGHT tenant counter — on
+    both frontends (the fixture params them)."""
+    iam = any_server.api.iam
+    svc = iam.new_service_account(ALICE.access_key, "svcacctalice1",
+                                  "svcsecret123456")
+    sts = iam.assume_role(ALICE)
+    port = any_server.port
+    bucket = f"qosm-{port}"
+    assert _request(port, CREDS, "PUT", f"/{bucket}")[0] == 200
+    body = b"qos auth matrix payload " * 8
+    assert _request(port, CREDS, "PUT", f"/{bucket}/obj",
+                    body)[0] == 200
+    for cred, tenant in ((CREDS, "root"),
+                         (ALICE, ALICE.access_key),
+                         (svc, ALICE.access_key),
+                         (sts, ALICE.access_key)):
+        before = _reqs(tenant)
+        st, _hdrs, data = _request(port, cred, "GET",
+                                   f"/{bucket}/obj")
+        assert st == 200 and data == body, cred.access_key
+        assert _reqs(tenant) == before + 1, cred.access_key
+
+
+# ---------------------------------------------------------------------------
+# token-bucket probes (the admission-side TokenBucket extension)
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_probes_charge_and_peek():
+    tb = TokenBucket(10.0)              # 10 tokens/s, burst 10
+    assert tb.try_take(4) == 0.0        # charged
+    assert tb.peek(6) == 0.0            # affordable, NOT charged
+    assert tb.try_take(6) == 0.0        # the peeked tokens still there
+    assert tb.try_take(5) > 0.0         # empty: refused, uncharged
+    assert tb.peek(5) > 0.0             # still refused — peek never took
+    unlimited = TokenBucket(0.0)        # zero rate = no budget
+    assert unlimited.try_take(1 << 30) == 0.0
+    assert unlimited.peek(1 << 30) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# weighted admission shares
+# ---------------------------------------------------------------------------
+
+def _admitting_plane(iam) -> QoSPlane:
+    return QoSPlane(QoSRegistry(), iam_lookup=lambda: iam,
+                    root_access_key=CREDS.access_key)
+
+
+def test_lone_tenant_borrows_the_whole_gate(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    plane = _admitting_plane(_mk_iam())
+    cap, a = 4, ALICE.access_key
+
+    def admit(ak):
+        return plane.admit_slot("GET", "/b/o", {}, _claim_hdrs(ak),
+                                cap)
+
+    for _ in range(cap):                # only tenant active: full gate
+        assert admit(a) == a
+    got = admit(a)                      # slot cap+1 refuses
+    assert not isinstance(got, str)
+    assert got.kind == "share" and got.retry_after >= 1
+    plane.release(a)
+    assert admit(a) == a                # a released slot re-admits
+
+
+def test_equal_shares_split_the_gate(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    plane = _admitting_plane(_mk_iam())
+    cap = 4
+    a, b = ALICE.access_key, BOB.access_key
+
+    def admit(ak):
+        return plane.admit_slot("GET", "/b/o", {}, _claim_hdrs(ak),
+                                cap)
+
+    assert admit(a) == a                # both tenants now active
+    assert admit(b) == b
+    assert admit(a) == a                # alice reaches her half (2/4)
+    got = admit(a)
+    assert not isinstance(got, str) and got.kind == "share"
+    assert admit(b) == b                # bob's own half is untouched
+    got = admit(b)
+    assert not isinstance(got, str)     # ... until he reaches it too
+
+
+def test_bought_share_moves_the_bound(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    iam = _mk_iam()
+    plane = _admitting_plane(iam)
+    cap = 4
+    a, b = ALICE.access_key, BOB.access_key
+    plane.registry.set_budget("tenant", Budget(a, share=3.0))
+
+    def admit(ak):
+        return plane.admit_slot("GET", "/b/o", {}, _claim_hdrs(ak),
+                                cap)
+
+    assert admit(b) == b                # bob active on the default 1.0
+    for _ in range(3):                  # alice's 3-of-4 guarantee
+        assert admit(a) == a
+    got = admit(a)
+    assert not isinstance(got, str) and got.kind == "share"
+    got = admit(b)                      # bob is at his 1-of-4 already
+    assert not isinstance(got, str) and got.kind == "share"
+
+
+# ---------------------------------------------------------------------------
+# budget refusal: 503 before any body byte
+# ---------------------------------------------------------------------------
+
+def test_budget_refusal_reads_zero_body_bytes(any_server):
+    """A drained request-rate budget refuses a PUT announcing a 1 MiB
+    body to which NO body byte is ever sent — a server that waited for
+    the body before deciding would hang here. Both frontends answer
+    503 SlowDown + Retry-After + close, the shed lands in
+    requests_shed_total{reason=tenant} AND the per-tenant kind
+    counter."""
+    port = any_server.port
+    qos = any_server.api.qos
+    qos.registry.set_budget("tenant", Budget(BOB.access_key, rps=0.001))
+    # one cheap request drains bob's single burst token
+    assert _request(port, BOB, "GET", "/")[0] == 200
+    before_global = _shed_reason("tenant")
+    before_kind = _shed_kind(BOB.access_key, "rate")
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=30) as s:
+        auth = _claim_hdrs(BOB.access_key)["authorization"]
+        head = (f"PUT /shedq-{port}/obj HTTP/1.1\r\n"
+                f"Host: 127.0.0.1:{port}\r\n"
+                f"Authorization: {auth}\r\n"
+                f"Content-Length: {1 << 20}\r\n\r\n").encode()
+        s.sendall(head)                 # zero body bytes follow
+        st, headers, body = _read_http_response(s)
+        assert st == 503 and b"SlowDown" in body
+        assert headers.get("connection") == "close"
+        assert int(headers.get("retry-after", 0)) >= 1
+        assert s.recv(16) == b""        # server closed the socket
+    assert _shed_reason("tenant") == before_global + 1
+    assert _shed_kind(BOB.access_key, "rate") == before_kind + 1
+
+
+def test_byte_budget_refuses_oversized_put_pre_body(any_server):
+    """An rx byte budget whose bucket cannot cover the announced
+    Content-Length refuses pre-body (kind=bytes): the 400-byte PUT
+    sheds while a 40-byte PUT (within burst) still lands."""
+    port = any_server.port
+    qos = any_server.api.qos
+    qos.registry.set_budget("tenant",
+                            Budget(ALICE.access_key, rx_bps=100.0))
+    bucket = f"qosb-{port}"
+    assert _request(port, CREDS, "PUT", f"/{bucket}")[0] == 200
+    before_kind = _shed_kind(ALICE.access_key, "bytes")
+    st, _h, _d = _request(port, ALICE, "PUT", f"/{bucket}/small",
+                          b"x" * 40)
+    assert st == 200
+    st, _h, data = _request(port, ALICE, "PUT", f"/{bucket}/big",
+                            b"y" * 400)
+    assert st == 503 and b"SlowDown" in data
+    assert _shed_kind(ALICE.access_key, "bytes") == before_kind + 1
+
+
+# ---------------------------------------------------------------------------
+# registry: persistence, restart, fork
+# ---------------------------------------------------------------------------
+
+def _zones(tmp_path, pools=2):
+    return ErasureServerSets(
+        [ErasureSets.from_drives(
+            [str(tmp_path / f"p{p}d{j}") for j in range(4)], 1, 4, 2,
+            block_size=BLOCK, enable_mrf=False)
+         for p in range(pools)],
+        load_topology=False)
+
+
+def test_registry_persists_and_reloads_across_restart(tmp_path):
+    zz = _zones(tmp_path)
+    try:
+        reg = QoSRegistry(zz)
+        reg.set_budget("tenant", Budget("alice", share=2.0, rps=5.0))
+        reg.set_budget("tier", Budget("WARM", rps=1.0,
+                                      tx_bps=float(1 << 20)))
+        assert reg.epoch == 2
+        fresh = QoSRegistry(zz)          # the restart
+        assert fresh.load()
+        assert fresh.epoch == 2
+        assert fresh.lineage == reg.lineage
+        assert fresh.get("tenant", "alice").share == 2.0
+        assert fresh.get("tier", "WARM").tx_bps == float(1 << 20)
+        reg.remove_budget("tenant", "alice")
+        fresh2 = QoSRegistry(zz)
+        assert fresh2.load()
+        assert fresh2.epoch == 3
+        assert fresh2.get("tenant", "alice") is None
+        with pytest.raises(QoSConfigError):
+            reg.set_budget("nope", Budget("x"))
+        with pytest.raises(QoSConfigError):
+            reg.remove_budget("tenant", "neverwas")
+        with pytest.raises(QoSConfigError):
+            Budget.from_dict({"name": "n", "rps": -1})
+    finally:
+        zz.close()
+
+
+def _qos_fork_doc(epoch: int, writer: str) -> dict:
+    return {"epoch": epoch, "updated": time.time(),
+            "tenants": [{"name": "alice", "share": 2.0, "rps": 0.0,
+                         "rx_bps": 0.0, "tx_bps": 0.0}],
+            "tiers": [], "writer": writer, "parent_lineage": "",
+            "lineage": regfence.lineage("", epoch, writer)}
+
+
+def test_registry_fork_detected_and_repaired_by_fsck(tmp_path):
+    zz = _zones(tmp_path)
+    try:
+        raw_a = json.dumps(_qos_fork_doc(7, "nodeA")).encode()
+        raw_b = json.dumps(_qos_fork_doc(7, "nodeB")).encode()
+        zz.server_sets[0].put_object(MINIO_META_BUCKET,
+                                     QOS_CONFIG_OBJECT, raw_a)
+        zz.server_sets[1].put_object(MINIO_META_BUCKET,
+                                     QOS_CONFIG_OBJECT, raw_b)
+        # load never coin-flips: deterministic winner is nodeB
+        reg = QoSRegistry(zz)
+        assert reg.load()
+        assert (reg.epoch, reg.writer) == (7, "nodeB")
+        # the fork is a detected finding, not a silent merge
+        rep = run_fsck(zz, tmp_age_s=0)
+        forks = [f for f in rep.findings
+                 if f.cls == "registry_epoch_fork"
+                 and f.object == QOS_CONFIG_OBJECT]
+        assert len(forks) == 1
+        assert "nodeB" in forks[0].detail
+        # repair archives the loser and converges every pool
+        rep = run_fsck(zz, repair=True, tmp_age_s=0)
+        assert rep.repaired_counts().get("registry_epoch_fork") == 1
+        from minio_tpu.object.fsck import _get_pool_bytes
+        for pool in zz.server_sets:
+            assert _get_pool_bytes(pool, QOS_CONFIG_OBJECT) == raw_b
+        rep = run_fsck(zz, tmp_age_s=0)
+        assert not [f for f in rep.findings
+                    if f.cls == "registry_epoch_fork"]
+    finally:
+        zz.close()
+
+
+# ---------------------------------------------------------------------------
+# admin surface
+# ---------------------------------------------------------------------------
+
+def test_admin_qos_roundtrip(layer, monkeypatch):
+    """The admin endpoint + madmin SDK drive the registry end to end:
+    set bumps the epoch and shows up in get (and in live stats),
+    remove drops it, a bad budget answers AdminInvalidArgument, and
+    the change journals qos.update."""
+    from minio_tpu.madmin import AdminClient, AdminClientError
+    from minio_tpu.s3.admin import mount_admin
+    from minio_tpu.utils import eventlog
+
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    srv = _mk_server(layer, _mk_iam())
+    mount_admin(srv)
+    try:
+        adm = AdminClient("127.0.0.1", srv.port, CREDS.access_key,
+                          CREDS.secret_key, region=REGION)
+        base = adm.qos_get()
+        assert base["enabled"] is True
+        out = adm.qos_set("alice", share=2.0, rps=50.0)
+        assert out["epoch"] == base["epoch"] + 1
+        out = adm.qos_set("WARM", scope="tier", tx_bps=float(1 << 20))
+        got = adm.qos_get()
+        assert got["epoch"] == base["epoch"] + 2
+        assert {b["name"] for b in got["tenants"]} >= {"alice"}
+        assert {b["name"] for b in got["tiers"]} >= {"WARM"}
+        alice = [b for b in got["tenants"] if b["name"] == "alice"][0]
+        assert alice["share"] == 2.0 and alice["rps"] == 50.0
+        assert "alice" in got["stats"]       # budget names ride stats
+        events = [e for e in eventlog.JOURNAL.recent(50)
+                  if e["class"] == "qos.update"]
+        assert events and events[-1]["attrs"]["epoch"] == got["epoch"]
+        adm.qos_remove("alice")
+        adm.qos_remove("WARM", scope="tier")
+        got = adm.qos_get()
+        assert not [b for b in got["tenants"] if b["name"] == "alice"]
+        with pytest.raises(AdminClientError):
+            adm.qos_set("bad", rps=-1.0)
+        with pytest.raises(AdminClientError):
+            adm.qos_remove("neverwas")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# default-off parity
+# ---------------------------------------------------------------------------
+
+_VOLATILE_HEADERS = ("date", "last-modified", "x-amz-request-id")
+
+
+def _normalized(status, headers, data):
+    kept = {k: v for k, v in headers.items()
+            if k not in _VOLATILE_HEADERS}
+    return status, sorted(kept.items()), data
+
+
+@pytest.mark.parametrize("edge", ["on", "off"])
+def test_default_off_is_byte_identical(layer, edge, monkeypatch):
+    """With MINIO_TPU_QOS unset (the default) the wire behavior is
+    identical to a QoS-on server with no budgets — and budgets that
+    WOULD refuse every alice request are completely inert: all 200,
+    no QoS counter moves, nothing sheds."""
+    srv_off = _mk_server(layer, _mk_iam(), MINIO_TPU_EDGE=edge)
+    srv_on = _mk_server(layer, _mk_iam(), MINIO_TPU_EDGE=edge)
+    try:
+        # poison the off server's registry: rps AND rx budgets that
+        # would shed everything alice does if the plane consulted them
+        srv_off.api.qos.registry.set_budget(
+            "tenant", Budget(ALICE.access_key, rps=0.001, rx_bps=1.0))
+        before_reqs = _reqs(ALICE.access_key)
+        before_shed = _shed_reason("tenant")
+        bucket = "qpar"
+        body = b"parity payload " * 16
+        wire = []
+        # the knob is process-global and read per request, so each
+        # server's phase runs under its own setting
+        for srv, qos in ((srv_off, ""), (srv_on, "on")):
+            if qos:
+                monkeypatch.setenv("MINIO_TPU_QOS", qos)
+            else:
+                monkeypatch.delenv("MINIO_TPU_QOS", raising=False)
+            assert _request(srv.port, CREDS, "PUT",
+                            f"/{bucket}-{srv.port}")[0] == 200
+            for _ in range(3):          # would drain rps=0.001 thrice
+                st, hdrs, data = _request(
+                    srv.port, ALICE, "PUT",
+                    f"/{bucket}-{srv.port}/obj", body)
+                assert st == 200
+            wire.append([
+                _normalized(*_request(srv.port, ALICE, "PUT",
+                                      f"/{bucket}-{srv.port}/obj",
+                                      body)),
+                _normalized(*_request(srv.port, ALICE, "GET",
+                                      f"/{bucket}-{srv.port}/obj")),
+            ])
+        assert wire[0] == wire[1]       # off tree == on tree, no budgets
+        # the off server never consulted the plane: alice's counters
+        # moved ONLY for the on-server requests (5 of the 10)
+        assert _reqs(ALICE.access_key) == before_reqs + 5
+        assert _shed_reason("tenant") == before_shed
+    finally:
+        srv_off.stop()
+        srv_on.stop()
+
+
+# ---------------------------------------------------------------------------
+# noisy-neighbor isolation under a gray drive
+# ---------------------------------------------------------------------------
+
+def test_noisy_tenant_sheds_polite_tenant_lands(tmp_path, monkeypatch):
+    """Capacity 2, equal shares, a NaughtyDisk stalling every write
+    verb: bob floods on two connections, alice PUTs sequentially.
+    The share rule bounds bob to one in-flight slot, so alice's
+    requests all land (never refused for the share), while bob's
+    surplus stream sheds under reason=tenant."""
+    drives: list = [XLStorage(str(tmp_path / f"d{j}"))
+                    for j in range(4)]
+    nd = NaughtyDisk(drives[0], enabled=False)
+    drives[0] = nd
+    sets = ErasureSets.from_storage(drives, set_count=1,
+                                    set_drive_count=4, parity=2,
+                                    block_size=BLOCK)
+    monkeypatch.setenv("MINIO_TPU_QOS", "on")
+    srv = _mk_server(sets, _mk_iam(), MINIO_TPU_EDGE="on")
+    try:
+        srv.api.set_max_clients(2)
+        srv.api.qos.registry.set_budget(
+            "tenant", Budget(ALICE.access_key, share=1.0))
+        srv.api.qos.registry.set_budget(
+            "tenant", Budget(BOB.access_key, share=1.0))
+        assert _request(srv.port, CREDS, "PUT", "/nqos")[0] == 200
+        nd.stall_verbs = {v: 0.05 for v in
+                          ("append_file", "create_file", "write_all",
+                           "write_metadata", "rename_data",
+                           "rename_file")}
+        nd.arm()
+        before_alice = _shed_kind(ALICE.access_key, "share")
+        before_global = _shed_reason("tenant")
+        body = b"n" * (8 << 10)
+        stop = threading.Event()
+
+        def flood(w: int) -> None:
+            i = 0
+            while not stop.is_set():
+                try:
+                    _request(srv.port, BOB, "PUT",
+                             f"/nqos/b-{w}-{i}", body)
+                except OSError:
+                    pass                # refused mid-send: still a shed
+                i += 1
+
+        threads = [threading.Thread(target=flood, args=(w,),
+                                    daemon=True) for w in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(4):          # polite alice, one at a time
+                while True:
+                    st, _h, _d = _request(srv.port, ALICE, "PUT",
+                                          f"/nqos/a-{i}", body)
+                    if st == 200:
+                        break
+                    assert st == 503, st
+                    time.sleep(0.01)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        nd.stall_verbs = {}
+        # bob's surplus stream shed at HIS budget...
+        assert _shed_kind(BOB.access_key, "share") > 0
+        assert _shed_reason("tenant") > before_global
+        # ...while alice was never refused for hers
+        assert _shed_kind(ALICE.access_key, "share") == before_alice
+    finally:
+        srv.stop()
+        sets.close()

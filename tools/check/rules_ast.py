@@ -381,6 +381,7 @@ CARDINALITY_HOT_MODULES = LOCK_HOT_MODULES + (
     "minio_tpu/s3/edge/dispatch.py",
     "minio_tpu/s3/edge/server.py",
     "minio_tpu/s3/edge/admission.py",
+    "minio_tpu/s3/qos.py",
     "minio_tpu/object/codec.py",
     "minio_tpu/object/healing.py",
 )
@@ -539,10 +540,36 @@ def check_knob_env(sources: List[Source],
 ADMISSION_MODULE = "minio_tpu/s3/edge/admission.py"
 SHED_COUNTER = "minio_tpu_requests_shed_total"
 
+# The refusal probes of the tenant QoS plane: TokenBucket.try_take /
+# TokenBucket.peek answer "would this request fit the budget RIGHT
+# NOW" — the only legitimate consumers are the AdmissionController and
+# the QoS plane it consults (plus the bucket implementation itself).
+# A try_take/peek anywhere else is a private shed path in the making:
+# the caller has a refusal in hand and nowhere to route it but its own
+# 503. (Blocking `take()` stays free — pacing is not a refusal.)
+QOS_PROBE_MODULES = (
+    ADMISSION_MODULE,
+    "minio_tpu/s3/qos.py",
+    "minio_tpu/utils/bandwidth.py",
+)
+_QOS_PROBE_ATTRS = ("try_take", "peek")
+
 
 def check_admission(sources: List[Source]) -> List[Violation]:
     out: List[Violation] = []
+    probe_free = set(QOS_PROBE_MODULES)
     for src in sources:
+        if src.rel not in probe_free:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call) and node.args and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _QOS_PROBE_ATTRS:
+                    out.append(Violation(
+                        "admission", src.rel, node.lineno,
+                        f".{node.func.attr}() budget probe outside the "
+                        "admission/QoS plane — a tenant-budget refusal "
+                        "must shed through "
+                        f"{ADMISSION_MODULE}, never a private 503 path"))
         if src.rel == ADMISSION_MODULE:
             continue
         for node in ast.walk(src.tree):

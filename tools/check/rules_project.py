@@ -448,6 +448,7 @@ REGFENCE_MODULES = (
     "minio_tpu/object/topology.py",
     "minio_tpu/tier/config.py",
     "minio_tpu/replicate/targets.py",
+    "minio_tpu/s3/qos.py",
 )
 
 _REGFENCE_GATE_FNS = ("save", "load")
