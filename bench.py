@@ -1001,6 +1001,145 @@ def bench_replicate_ab(streams: int = 8, size: int = 4 << 20,
     return out
 
 
+def bench_notify_ab(streams: int = 8, size: int = 4 << 20,
+                    drives: int = 8, parity: int = 2,
+                    webhook_delay_s: float = 0.05,
+                    block: int = 1 << 20) -> dict:
+    """Foreground-PUT latency with vs without bucket event
+    notifications against a SLOW webhook (the --ab-replicate shape
+    applied to the notification plane): one in-process layer on tmpfs,
+    identical concurrent PUT rounds timed per-op before and after a
+    NotificationConfiguration wires every PUT to a webhook whose every
+    POST stalls `webhook_delay_s`. The plane's bounded queue + worker
+    pool + foreground-pressure throttle must keep the PUT hot path
+    out of the webhook's latency: reports p50/p99 per phase,
+    `put_p99_degradation_x` (the acceptance bound: a dead/slow webhook
+    degrades PUT p99 by <= 5%), the plane's final counters after a
+    full drain (zero events lost), and the delivery-lag histogram."""
+    import concurrent.futures as cf
+    import http.server
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    from minio_tpu.notify import (NotificationPlane, NotifyTarget,
+                                  NotifyTargetRegistry, new_arn)
+    from minio_tpu.object import codec as codec_mod
+    from minio_tpu.object.engine import PutOptions
+    from minio_tpu.object.server_sets import ErasureServerSets
+    from minio_tpu.object.sets import ErasureSets
+    from minio_tpu.utils import telemetry
+
+    was_min_bytes = codec_mod.DEVICE_MIN_BYTES
+    codec_mod.DEVICE_MIN_BYTES = 1 << 60        # host-path isolation
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+    root = tempfile.mkdtemp(prefix="bench_notify_", dir=base)
+    payload = os.urandom(size)
+    out: dict = {"config": {"streams": streams, "size": size,
+                            "drives": drives, "m": parity,
+                            "webhook_delay_s": webhook_delay_s}}
+    received = [0]
+
+    class _SlowHook(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            time.sleep(webhook_delay_s)
+            received[0] += 1
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), _SlowHook)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        sets = ErasureSets.from_drives(
+            [f"{root}/d{i}" for i in range(drives)], 1, drives, parity,
+            block_size=block, enable_mrf=False)
+        layer = ErasureServerSets([sets], load_topology=False)
+        layer.make_bucket("bench")
+        reg = NotifyTargetRegistry(layer)
+        arn = new_arn("bench", "webhook")
+        reg.add(NotifyTarget(arn=arn, type="webhook",
+                             params={"endpoint":
+                                     f"http://127.0.0.1:{port}/",
+                                     "timeout": 5.0}))
+        plane = NotificationPlane(layer, reg,
+                                  queue_dir=f"{root}/notifyq",
+                                  node="bench")
+        layer.attach_notifications(plane)
+
+        def put_round(prefix: str) -> list[float]:
+            lat: list[float] = []
+            mu = threading.Lock()
+
+            def one(i: int) -> None:
+                t0 = time.perf_counter()
+                layer.put_object("bench", f"{prefix}{i}", payload,
+                                 opts=PutOptions(versioned=True))
+                dt = time.perf_counter() - t0
+                with mu:
+                    lat.append(dt)
+
+            with cf.ThreadPoolExecutor(max_workers=streams) as ex:
+                list(ex.map(one, range(streams)))
+            return lat
+
+        def pcts(lat: list[float]) -> dict:
+            xs = sorted(lat)
+            return {"p50_ms": round(xs[len(xs) // 2] * 1e3, 2),
+                    "p99_ms": round(xs[max(0, int(len(xs) * 0.99) - 1)]
+                                    * 1e3, 2)}
+
+        put_round("warm")                        # warm the path
+        baseline = put_round("base") + put_round("base2")
+        out["baseline"] = pcts(baseline)
+
+        # wire every creation to the slow webhook, then measure the
+        # foreground PUTs racing their own event deliveries
+        plane.set_config(
+            "bench",
+            "<NotificationConfiguration><QueueConfiguration>"
+            f"<Queue>{arn}</Queue>"
+            "<Event>s3:ObjectCreated:*</Event>"
+            "</QueueConfiguration></NotificationConfiguration>")
+        during = put_round("dr") + put_round("dr2")
+        out["during_notify"] = pcts(during)
+        out["plane_at_measure"] = plane.stats()
+        assert plane.drain(180), plane.stats()   # zero loss: all land
+        out["plane_final"] = plane.stats()
+        out["webhook_received"] = received[0]
+        out["put_p99_degradation_x"] = round(
+            out["during_notify"]["p99_ms"]
+            / max(out["baseline"]["p99_ms"], 1e-9), 3)
+        # delivery-lag histogram: bucketed counts off the registry
+        hist = telemetry.REGISTRY.histogram(
+            "minio_tpu_notify_lag_seconds")
+        series = None
+        with hist._mu:
+            for _k, s in hist._series.items():
+                series = {"buckets_s": list(hist.buckets),
+                          "counts": list(s.counts),
+                          "count": s.count,
+                          "mean_s": round(s.total / s.count, 4)
+                          if s.count else 0.0}
+        out["lag_histogram"] = series or {}
+        plane.close()
+        layer.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        codec_mod.DEVICE_MIN_BYTES = was_min_bytes
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_list_ab(keys: int = 10000, drives: int = 8, parity: int = 2,
                   page: int = 1000, versions_every: int = 20,
                   payload_bytes: int = 16) -> dict:
@@ -2935,6 +3074,15 @@ def main() -> int:
                     help="tiny replication A/B (2 streams, 256 KiB "
                          "objects, 8-key resync) for CI — seconds, "
                          "not minutes")
+    ap.add_argument("--ab-notify", action="store_true",
+                    help="run ONLY the notification A/B (foreground "
+                         "PUT p50/p99 with vs without every PUT "
+                         "fanning out to a deliberately SLOW webhook, "
+                         "plus the delivery-lag histogram)")
+    ap.add_argument("--ab-notify-smoke", action="store_true",
+                    help="tiny notification A/B (2 streams, 256 KiB "
+                         "objects, 10 ms webhook stall) for CI — "
+                         "seconds, not minutes")
     ap.add_argument("--ab-edge", action="store_true",
                     help="run ONLY the HTTP frontend A/B (event-loop "
                          "edge vs threaded oracle): idle keep-alive "
@@ -3198,6 +3346,23 @@ def main() -> int:
             "value": ab.get("put_p99_degradation_x"),
             "unit": "x",
             "replicate_ab": ab,
+        }))
+        return 0
+
+    if args.ab_notify or args.ab_notify_smoke:
+        if args.ab_notify_smoke:
+            ab = bench_notify_ab(streams=2, size=1 << 18, drives=6,
+                                 webhook_delay_s=0.01, block=1 << 16)
+        else:
+            ab = bench_notify_ab(streams=min(args.ab_streams, 8),
+                                 size=args.ab_size)
+        print(json.dumps({
+            "metric": "foreground PUT p99 degradation with every PUT "
+                      "fanning out to a slow webhook (notification "
+                      "plane isolation A/B)",
+            "value": ab.get("put_p99_degradation_x"),
+            "unit": "x",
+            "notify_ab": ab,
         }))
         return 0
 

@@ -107,6 +107,32 @@ def _parse(argv: list[str]) -> argparse.Namespace:
     q.add_argument("--region", default=os.environ.get(
         "MINIO_REGION", "us-east-1"))
 
+    n = sub.add_parser("notify", help="manage bucket event "
+                       "notification targets (webhook/queue/log)")
+    n.add_argument("action", choices=("status", "add", "rm"))
+    n.add_argument("--url", default="127.0.0.1:9000",
+                   help="server admin endpoint host:port")
+    n.add_argument("--type", default="webhook",
+                   choices=("webhook", "queue", "log"),
+                   help="target type (add)")
+    n.add_argument("--name", default="",
+                   help="ARN id segment (add; random when empty)")
+    n.add_argument("--arn", default="",
+                   help="target ARN (rm, or add --force to update)")
+    n.add_argument("--endpoint", default="",
+                   help="webhook POST URL (add --type webhook)")
+    n.add_argument("--auth-token", default="",
+                   help="webhook bearer token (add --type webhook)")
+    n.add_argument("--timeout", type=float, default=0.0,
+                   help="webhook send timeout, seconds (0 = default)")
+    n.add_argument("--path", default="",
+                   help="event log file (add --type log)")
+    n.add_argument("--force", action="store_true",
+                   help="add: update an existing target in place "
+                   "(needs --arn)")
+    n.add_argument("--region", default=os.environ.get(
+        "MINIO_REGION", "us-east-1"))
+
     f = sub.add_parser("fsck", help="run the crash-consistency "
                        "auditor against a running node")
     f.add_argument("--url", default="127.0.0.1:9000",
@@ -356,6 +382,45 @@ def _run_qos(args, creds: Credentials) -> int:
     return 0
 
 
+def _run_notify(args, creds: Credentials) -> int:
+    """`minio_tpu notify status|add|rm` — drive the admin
+    notification-target registry against a running node."""
+    import json as _json
+    from .madmin import AdminClient, AdminClientError
+    from .utils import host_port
+    h, p = host_port(args.url, 9000)
+    cli = AdminClient(h, p, creds.access_key, creds.secret_key,
+                      region=args.region)
+    try:
+        if args.action == "status":
+            out = cli.notify_status()
+        elif args.action == "rm":
+            if not args.arn:
+                print("notify rm needs --arn", file=sys.stderr)
+                return 2
+            cli.remove_notify_target(args.arn)
+            out = {"removed": args.arn}
+        else:
+            params = {}
+            if args.endpoint:
+                params["endpoint"] = args.endpoint
+            if args.auth_token:
+                params["auth_token"] = args.auth_token
+            if args.timeout:
+                params["timeout"] = args.timeout
+            if args.path:
+                params["path"] = args.path
+            arn = cli.add_notify_target(
+                type=args.type, name=args.name, arn=args.arn,
+                update=args.force, **params)
+            out = {"arn": arn}
+    except AdminClientError as e:
+        print(f"notify {args.action} failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def _run_fsck(args, creds: Credentials) -> int:
     """`minio_tpu fsck` — drive the admin consistency auditor. Exit 0
     when the tree is clean (or everything repairable was repaired),
@@ -410,6 +475,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_tier(args, creds)
     if args.cmd == "qos":
         return _run_qos(args, creds)
+    if args.cmd == "notify":
+        return _run_notify(args, creds)
     kw = dict(parity=args.parity, set_drive_count=args.set_drive_count,
               region=args.region,
               certfile=args.cert or None, keyfile=args.key or None)

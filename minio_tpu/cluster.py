@@ -446,6 +446,40 @@ class ClusterNode:
         self.config.apply(self.s3.api, events=self.events,
                           trace=self.s3.api.trace)
 
+        # -- bucket event notification plane (minio_tpu/notify/) -----------
+        # same epoch-versioned every-pool registry rule as replication
+        # targets; the plane rides the SAME namespace feed, so every
+        # mutation verb reaches the delivery queue. Durable per-target
+        # backlog lives beside the legacy event queue on the first
+        # local drive (pending events survive a restart).
+        from .notify import NotificationPlane, NotifyTargetRegistry
+        self.notify_targets = NotifyTargetRegistry(self.object_layer)
+        try:
+            self.notify_targets.load()
+        except Exception:  # noqa: BLE001 — boot proceeds; admin re-adds
+            pass
+        _nq = os.path.join(self.spec.drives[0], ".minio.sys", "notify",
+                           "queue") if self.spec.drives else None
+        self.notify_plane = NotificationPlane(
+            self.object_layer, self.notify_targets,
+            bucket_meta=self.s3.api.bucket_meta,
+            queue_dir=_nq, node=self.spec.addr,
+            nodes=[n.addr for n in nodes],
+            site_id=self.repl_targets.site_id)
+        # owner-node delivery: non-owners hand the event to the
+        # bucket's owner over the peer control plane (no double-fire
+        # on multi-node clusters); peers' registries reload on admin
+        # target mutations so a target added at any node serves on all
+        _npeers = {p.addr: p for p in self._peer_clients}
+        self.notify_plane.forward_fn = \
+            lambda addr, b, k: (addr in _npeers
+                                and _npeers[addr].notify_event(b, k))
+        self._peer_rpc.notify_event = self.notify_plane.ingest
+        self._peer_rpc.notify_reload = self.notify_targets.load
+        self.notify_plane.reload_peers = self.notification.notify_reload
+        self.object_layer.attach_notifications(self.notify_plane)
+        self.s3.api.notify = self.notify_plane
+
         # -- tiering plane (remote tiers + ILM transitions) ----------------
         from .tier.config import TierManager
         self.tiers = TierManager(self.object_layer)
@@ -681,6 +715,9 @@ class ClusterNode:
         if getattr(self, "replication", None) is not None:
             self.replication.close()
             self.replication = None
+        if getattr(self, "notify_plane", None) is not None:
+            self.notify_plane.close()
+            self.notify_plane = None
         if getattr(self, "scheduler", None) is not None:
             self.scheduler.close()
             self.scheduler = None

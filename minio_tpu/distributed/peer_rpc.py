@@ -74,6 +74,11 @@ class PeerRPCServer:
         # tracker each pass (None until the cluster wires it)
         self.get_update_tracker: Optional[Callable[[], dict]] = None
         self.get_bandwidth: Callable[[], dict] = lambda: {}
+        # bucket event notification plane: owner-node delivery hand-off
+        # (a non-owner forwards the namespace event here) and registry
+        # reload after an admin target mutation elsewhere
+        self.notify_event: Callable[[str, str], None] = lambda b, k: None
+        self.notify_reload: Callable[[], object] = lambda: None
 
         h = self.handler
         h.register("server-info", lambda a, b: {
@@ -108,6 +113,8 @@ class PeerRPCServer:
             "node": self.node_id, "received": len(b)})
         h.register("tracker-rotate", self._tracker_rotate)
         h.register("bandwidth", lambda a, b: self.get_bandwidth())
+        h.register("notify-event", self._notify_event)
+        h.register("notify-reload", lambda a, b: self.notify_reload())
 
     def _tracker_rotate(self, args, body):
         if self.get_update_tracker is None:
@@ -223,6 +230,9 @@ class PeerRPCServer:
     def _reload_bm(self, args, body):
         self.reload_bucket_metadata(args.get("bucket", ""))
 
+    def _notify_event(self, args, body):
+        self.notify_event(args.get("bucket", ""), args.get("key", ""))
+
     def _signal(self, args, body):
         self.signal_service(args.get("sig", ""))
 
@@ -286,6 +296,24 @@ class PeerRPCClient:
     def reload_iam(self) -> bool:
         try:
             self.rc.call("reload-iam")
+            return True
+        except (NetworkError, RPCError):
+            return False
+
+    def notify_event(self, bucket: str, key: str) -> bool:
+        """Hand one namespace event to this peer (the bucket's owner)
+        for notification delivery."""
+        if self._shed():
+            return False
+        try:
+            self.rc.call("notify-event", {"bucket": bucket, "key": key})
+            return True
+        except (NetworkError, RPCError):
+            return False
+
+    def notify_reload(self) -> bool:
+        try:
+            self.rc.call("notify-reload")
             return True
         except (NetworkError, RPCError):
             return False
@@ -570,6 +598,11 @@ class NotificationSys:
 
     def reload_iam(self) -> list:
         return self._broadcast(lambda p: p.reload_iam())
+
+    def notify_reload(self) -> list:
+        """Reload every peer's notification-target registry (after an
+        admin target mutation here — their boot-time loads are stale)."""
+        return self._broadcast(lambda p: p.notify_reload())
 
     def iam_delta(self, pairs: list) -> list:
         """Per-entity IAM propagation: one small RPC per peer carrying

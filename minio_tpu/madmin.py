@@ -494,6 +494,33 @@ class AdminClient:
     def cancel_replicate_resync(self) -> dict:
         return self._json("DELETE", "replicate/resync")
 
+    def notify_status(self) -> dict:
+        """Notification target registry, plane stats, and per-target
+        delivery health under ``targets_status`` (backlog depth,
+        offline window, last delivery lag)."""
+        return self._json("GET", "notify")
+
+    def add_notify_target(self, type: str = "webhook", name: str = "",
+                          arn: str = "", update: bool = False,
+                          **params) -> str:
+        """Register an event notification target; returns its ARN.
+        ``params`` is the type-specific config — ``endpoint`` (and
+        optional ``auth_token``, ``timeout``) for webhooks, ``path``
+        for log targets. Updating an existing target requires passing
+        its ``arn`` back (the server mints a fresh one otherwise)."""
+        doc = {"type": type, "params": params}
+        if name:
+            doc["name"] = name
+        if arn:
+            doc["arn"] = arn
+        out = self._json("PUT", "notify/target",
+                         {"update": "true"} if update else None,
+                         json.dumps(doc).encode())
+        return out["arn"]
+
+    def remove_notify_target(self, arn: str) -> None:
+        self._request("DELETE", "notify/target", {"arn": arn})
+
     def set_remote_target(self, bucket: str, host: str, port: int,
                           target_bucket: str, access_key: str,
                           secret_key: str, region: str = "us-east-1"

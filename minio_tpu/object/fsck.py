@@ -69,7 +69,8 @@ CLASSES = (
 # registry / checkpoint document prefixes audited per pool (the docs
 # deliberately written to every pool — topology epochs, tier config,
 # replication targets, rebalance/resync checkpoints)
-REGISTRY_PREFIXES = ("topology/", "tier/", "replicate/", "qos/")
+REGISTRY_PREFIXES = ("topology/", "tier/", "replicate/", "qos/",
+                     "notify/")
 
 _REPL_ORIGIN_KEY = "X-Minio-Internal-replication-origin"
 

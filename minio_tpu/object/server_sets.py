@@ -50,6 +50,9 @@ class ErasureServerSets:
         # active-active replication plane (minio_tpu/replicate/):
         # enqueues off the same namespace feed when attached
         self.replication = None
+        # bucket event notification plane (minio_tpu/notify/):
+        # classifies + delivers off the same namespace feed
+        self.notifications = None
         # ONE namespace-change feed, many consumers: the engines call
         # _dispatch_namespace_change, which fans out to every attached
         # listener (metacache journal, read-cache invalidation)
@@ -104,6 +107,15 @@ class ErasureServerSets:
         listener — no per-handler enqueue call sites to forget (the
         lint gate's hook-coverage rule pins the whole chain)."""
         self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+
+    def attach_notifications(self, plane) -> None:
+        """Wire the bucket event notification plane into the ONE
+        namespace feed: every engine mutation verb that fires
+        _notify_namespace reaches the notification queue through this
+        listener — no per-handler send call sites to forget (the lint
+        gate's hook-coverage rule pins the whole chain)."""
+        self.notifications = plane
         self.register_namespace_listener(plane.on_namespace_change)
 
     def single_zone(self) -> bool:

@@ -817,6 +817,46 @@ class AdminHandlers:
             self._auth(ctx, "admin:ReplicationResync")
             return self._json(
                 {"canceled": self._repl_plane().cancel_resync()})
+        if sub == "notify" and m == "GET":
+            self._auth(ctx, "admin:ServerInfo")
+            plane = self._notify_plane()
+            return self._json(
+                {"epoch": plane.registry.epoch,
+                 "targets": plane.registry.list(redact=True),
+                 "stats": plane.stats(),
+                 # per-target delivery state: backlog depth, offline
+                 # window, last delivery lag — the JSON twin of
+                 # minio_tpu_notify_lag_seconds{target}
+                 "targets_status": plane.target_status()})
+        if sub == "notify/target" and m == "PUT":
+            self._auth(ctx, "admin:SetBucketTarget")
+            from ..notify.targets import (NotifyTarget, NotifyTargetError,
+                                          new_arn)
+            plane = self._notify_plane()
+            body = json.loads(ctx.read_body().decode() or "{}")
+            body.setdefault("arn", new_arn(body.pop("name", ""),
+                                           body.get("type", "webhook")))
+            try:
+                target = NotifyTarget.from_dict(body)
+                plane.registry.add(
+                    target, update=ctx.query1("update") == "true")
+            except NotifyTargetError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            if plane.reload_peers is not None:
+                plane.reload_peers()
+            return self._json({"arn": target.arn,
+                               "epoch": plane.registry.epoch})
+        if sub == "notify/target" and m == "DELETE":
+            self._auth(ctx, "admin:SetBucketTarget")
+            from ..notify.targets import NotifyTargetError
+            plane = self._notify_plane()
+            try:
+                plane.registry.remove(ctx.query1("arn", ""))
+            except NotifyTargetError as e:
+                raise S3Error("AdminInvalidArgument", str(e)) from None
+            if plane.reload_peers is not None:
+                plane.reload_peers()
+            return self._json({})
         if sub == "set-remote-target" and m == "PUT":
             self._auth(ctx, "admin:SetBucketTarget")
             return self._set_remote_target(ctx)
@@ -873,6 +913,15 @@ class AdminHandlers:
             raise S3Error("NotImplemented",
                           "no active-active replication plane")
         return repl
+
+    def _notify_plane(self):
+        """The bucket event notification plane (minio_tpu/notify/);
+        the legacy config-driven notifier has no target registry."""
+        plane = self.api.notify
+        if plane is None:
+            raise S3Error("NotImplemented",
+                          "no notification plane")
+        return plane
 
     def _tiers(self):
         if self.api.tiers is None:

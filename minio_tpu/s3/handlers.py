@@ -237,6 +237,8 @@ class S3ApiHandlers:
                             root_access_key=self.root_cred.access_key)
         self.admission.qos = self.qos
         self.events = None        # optional event notifier hook
+        self.notify = None        # optional NotificationPlane
+                                  # (minio_tpu/notify/, feed-driven)
         self.usage = None         # optional DataUsageCrawler (quota cache)
         self.replication = None   # optional ReplicationPlane (or the
         # legacy ReplicationPool — _notify duck-types the difference)
@@ -1071,8 +1073,39 @@ class S3ApiHandlers:
                             body=doc.encode())
 
     def put_bucket_notification(self, ctx, bucket):
-        return self._put_xml_config(ctx, bucket, "notification_xml",
-                                    "s3:PutBucketNotification")
+        self.authenticate(ctx, "s3:PutBucketNotification", bucket)
+        self.obj.get_bucket_info(bucket)
+        body = ctx.read_body()
+        try:
+            ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        if self.notify is not None:
+            # the reference rejects configs naming unknown target ARNs
+            # or event names at PUT time (ErrARNNotification /
+            # ErrEventNotification) — a rule that can never fire is a
+            # config error, not a silent no-op. Legacy config-driven
+            # notifier targets stay valid.
+            from ..notify.rules import BucketNotifyConfig, NotifyRuleError
+            try:
+                cfg = BucketNotifyConfig.from_xml(body)
+            except NotifyRuleError as e:
+                raise S3Error("MalformedXML", str(e)) from None
+            known = self.notify.registry.arns()
+            legacy = getattr(self.events, "targets", None) or {}
+            for rule in cfg.rules:
+                if rule.arn not in known and rule.arn not in legacy:
+                    raise S3Error(
+                        "InvalidArgument",
+                        f"unknown notification target ARN {rule.arn}")
+            bad = cfg.unknown_events()
+            if bad:
+                raise S3Error(
+                    "InvalidArgument",
+                    f"unsupported notification event(s): "
+                    f"{', '.join(sorted(set(bad)))}")
+        self.bucket_meta.update(bucket, notification_xml=body.decode())
+        return HTTPResponse()
 
     # --- listings -------------------------------------------------------
 

@@ -152,6 +152,9 @@ define("replicate.registry.save.pool",
 define("qos.save.pool",
        "inside QoSRegistry.save's per-pool loop (arm :<nth>) — pools "
        "disagree on the tenant-budget epoch", _W)
+define("notify.registry.save.pool",
+       "inside NotifyTargetRegistry.save's per-pool loop (arm :<nth>) "
+       "— pools disagree on the notification-target epoch", _W)
 
 _W = "Background checkpoints"
 define("rebalance.checkpoint",
@@ -169,6 +172,10 @@ define("replicate.push.before_apply",
 define("mrf.drain.before_heal",
        "in the MRF drainer, after dequeuing an entry, before its heal "
        "runs — a crashed drain loses only retries, never objects", _W)
+define("notify.queue.persist",
+       "after one event record lands in a target's durable queue, "
+       "before its delivery attempt — a restart must redrive exactly "
+       "this entry (at-least-once, never lost)", _W)
 
 _W = "Event journal (utils/eventlog.py)"
 define("eventlog.persist.segment",

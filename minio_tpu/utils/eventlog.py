@@ -191,6 +191,20 @@ define("tenant.shed", _S, "warn", ("tenant", "reason"),
        "A tenant hit its QoS budget and was refused (first shed per "
        "tenant per debounce window)")
 
+_S = "notify"
+define("notify.update", _S, "info", ("epoch", "targets"),
+       "The notification-target registry committed a new epoch "
+       "(target added or removed)")
+define("notify.offline", _S, "warn", ("target",),
+       "A notification target failed a delivery and entered its "
+       "offline window (first failure per window)")
+define("notify.redrive", _S, "info", ("target", "delivered"),
+       "A recovered notification target drained its persisted event "
+       "backlog")
+define("notify.drop", _S, "warn", ("target",),
+       "An event record was dropped at a full per-target delivery "
+       "queue (bounded backlog overflow)")
+
 del _S
 
 

@@ -489,6 +489,31 @@ _S = "Events"
 define("MINIO_TPU_QUEUE_FSYNC", "bool", False,
        "fsync durable event-queue writes (survives power loss)", _S)
 
+_S = "Notifications"
+define("MINIO_TPU_NOTIFY_WORKERS", "int", 2,
+       "delivery workers draining the notification queue", _S)
+define("MINIO_TPU_NOTIFY_QUEUE", "int", 10000,
+       "max queued (bucket, key) namespace events (overflow drops + "
+       "counts; delivery never blocks a mutation)", _S)
+define("MINIO_TPU_NOTIFY_BACKOFF_S", "float", 0.05,
+       "first delivery backoff when the foreground is busy", _S)
+define("MINIO_TPU_NOTIFY_BACKOFF_MAX_S", "float", 1.0,
+       "delivery backoff cap, seconds", _S)
+define("MINIO_TPU_NOTIFY_BACKOFF_TRIES", "int", 8,
+       "busy polls before a delivery proceeds anyway", _S)
+define("MINIO_TPU_NOTIFY_STORE_LIMIT", "int", 10000,
+       "per-target delivery backlog cap (overflow drops + counts — "
+       "bounded memory/disk against a dead target)", _S)
+define("MINIO_TPU_NOTIFY_OFFLINE_S", "float", 2.0,
+       "offline window after a failed delivery: new events for that "
+       "target queue without burning a send timeout each", _S)
+define("MINIO_TPU_NOTIFY_REDRIVE_S", "float", 5.0,
+       "periodic backlog redrive cadence, seconds", _S)
+define("MINIO_TPU_NOTIFY_REPLICA_EVENTS", "bool", False,
+       "`on` = replica-apply writes fire bucket notifications too "
+       "(reference parity keeps them suppressed: replication does not "
+       "re-fire source events)", _S)
+
 _S = "Crash consistency"
 define("MINIO_TPU_FSYNC", "bool", False,
        "`on` = fsync barriers on commit paths (fsync before rename, "

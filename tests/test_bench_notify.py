@@ -1,0 +1,24 @@
+"""CI pin for the notification A/B smoke: `bench.py
+--ab-notify-smoke` must keep producing its shape (baseline +
+during-notify percentiles, a fully drained plane with zero loss, the
+delivery-lag histogram) in seconds — the gate beside tier1_diff that
+keeps the bench runnable."""
+
+
+def test_ab_notify_smoke_shape():
+    import bench
+    ab = bench.bench_notify_ab(streams=2, size=1 << 18, drives=6,
+                               webhook_delay_s=0.01, block=1 << 16)
+    assert set(ab) >= {"config", "baseline", "during_notify",
+                       "plane_final", "webhook_received",
+                       "put_p99_degradation_x", "lag_histogram"}
+    for phase in ("baseline", "during_notify"):
+        assert ab[phase]["p50_ms"] > 0 and ab[phase]["p99_ms"] > 0
+    # zero loss: the measured PUT rounds (2 streams x 2 rounds) all
+    # reached the webhook once the drain finished
+    assert ab["webhook_received"] >= 4
+    assert ab["plane_final"]["pending"] == 0
+    assert ab["plane_final"]["backlog"] == 0
+    assert ab["plane_final"]["dropped"] == 0
+    assert ab["put_p99_degradation_x"] > 0
+    assert ab["lag_histogram"].get("count", 0) >= 4

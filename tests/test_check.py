@@ -483,6 +483,98 @@ class ErasureServerSets:
                for v in vs3)
 
 
+def test_notify_chain_rule():
+    """The hook-coverage rule proves every mutation verb reaches
+    bucket event notification: feed -> attach_notifications ->
+    NotificationPlane.on_namespace_change -> cluster wiring. Breaking
+    any link fires; absent the notify plane module the chain is out of
+    scope (so fixture trees above stay green)."""
+    ok_engine = [_src("minio_tpu/object/engine.py", ENGINE_OK),
+                 _src("minio_tpu/object/multipart.py", MULTIPART_OK)]
+    ss_ok = '''
+class ErasureServerSets:
+    def attach_replication(self, plane):
+        self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+    def attach_notifications(self, plane):
+        self.notifications = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+'''
+    repl_plane_ok = '''
+class ReplicationPlane:
+    def on_namespace_change(self, bucket, key):
+        pass
+'''
+    notify_plane_ok = '''
+class NotificationPlane:
+    def on_namespace_change(self, bucket, key):
+        pass
+'''
+    cluster_ok = '''
+def boot(layer, repl, notify):
+    layer.attach_replication(repl)
+    layer.attach_notifications(notify)
+'''
+    full = ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py", repl_plane_ok),
+        _src("minio_tpu/notify/plane.py", notify_plane_ok),
+        _src("minio_tpu/cluster.py", cluster_ok)]
+    assert rules_project.check_hook_coverage(full) == []
+
+    # attach_notifications loses its register call -> flagged
+    vs = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", '''
+class ErasureServerSets:
+    def attach_replication(self, plane):
+        self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+    def attach_notifications(self, plane):
+        self.notifications = plane
+'''),
+        _src("minio_tpu/replicate/plane.py", repl_plane_ok),
+        _src("minio_tpu/notify/plane.py", notify_plane_ok),
+        _src("minio_tpu/cluster.py", cluster_ok)])
+    assert any("attach_notifications() never calls "
+               "register_namespace_listener" in v.message for v in vs)
+
+    # attach_notifications gone entirely -> flagged
+    vs1 = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", '''
+class ErasureServerSets:
+    def attach_replication(self, plane):
+        self.replication = plane
+        self.register_namespace_listener(plane.on_namespace_change)
+'''),
+        _src("minio_tpu/replicate/plane.py", repl_plane_ok),
+        _src("minio_tpu/notify/plane.py", notify_plane_ok),
+        _src("minio_tpu/cluster.py", cluster_ok)])
+    assert any("attach_notifications() missing" in v.message
+               for v in vs1)
+
+    # the plane loses its listener method -> flagged
+    vs2 = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py", repl_plane_ok),
+        _src("minio_tpu/notify/plane.py",
+             "class NotificationPlane:\n    pass\n"),
+        _src("minio_tpu/cluster.py", cluster_ok)])
+    assert any("NotificationPlane.on_namespace_change() missing"
+               in v.message for v in vs2)
+
+    # cluster boot forgets to attach -> flagged
+    vs3 = rules_project.check_hook_coverage(ok_engine + [
+        _src("minio_tpu/object/server_sets.py", ss_ok),
+        _src("minio_tpu/replicate/plane.py", repl_plane_ok),
+        _src("minio_tpu/notify/plane.py", notify_plane_ok),
+        _src("minio_tpu/cluster.py", '''
+def boot(layer, repl):
+    layer.attach_replication(repl)
+''')])
+    assert any("never calls attach_notifications" in v.message
+               for v in vs3)
+
+
 # ---------------------------------------------------------------------------
 # rule: admission
 # ---------------------------------------------------------------------------

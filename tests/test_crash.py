@@ -78,6 +78,24 @@ def _qos_set(n: ProcNode) -> None:
         lambda: n.admin().qos_set("alice", share=2.0, rps=10.0))
 
 
+def _notify_target_add(n: ProcNode) -> None:
+    expect_request_death(
+        lambda: n.admin().add_notify_target(
+            endpoint="http://127.0.0.1:1/hook"))
+
+
+def _verify_notify_registry(n: ProcNode) -> None:
+    # the interrupted epoch either fully landed or fully rolled away —
+    # and the registry still takes writes afterwards
+    got = n.admin().notify_status()
+    assert len(got["targets"]) <= 1, got["targets"]
+    arn = n.admin().add_notify_target(name="after",
+                                      endpoint="http://127.0.0.1:1/h2")
+    after = n.admin().notify_status()
+    assert after["epoch"] > got["epoch"]
+    assert arn in {t["arn"] for t in after["targets"]}
+
+
 def _verify_qos_registry(n: ProcNode) -> None:
     # the interrupted epoch either fully landed or fully rolled away —
     # and the registry still takes writes afterwards
@@ -162,6 +180,8 @@ CASES = {
     "tier.save.pool": dict(trigger=_tier_add),
     "replicate.registry.save.pool": dict(trigger=_repl_target_add),
     "qos.save.pool": dict(trigger=_qos_set, verify=_verify_qos_registry),
+    "notify.registry.save.pool": dict(trigger=_notify_target_add,
+                                      verify=_verify_notify_registry),
     "rebalance.checkpoint": dict(
         pools=2, seed=_seed_many, trigger=_start_drain, wait_exit=120,
         env={"MINIO_TPU_REBALANCE_CHECKPOINT_EVERY": "1"},
@@ -181,6 +201,8 @@ COVERED_ELSEWHERE = {
     "eventlog.persist.segment":
         "test_incidents.py::"
         "test_sigkill_mid_segment_persist_serves_prefix",
+    "notify.queue.persist":
+        "test_notify_proc.py::test_queue_persist_crashpoint_kill_replay",
 }
 
 SMOKE_POINTS = ("put.meta.before_rename",

@@ -62,6 +62,11 @@ EVENT_MATRIX = {
                           "events": 12},
     "qos.update": {"epoch": 3, "tenants": 2, "tiers": 1},
     "tenant.shed": {"tenant": "alice", "reason": "rate"},
+    "notify.update": {"epoch": 2, "targets": 1},
+    "notify.offline": {"target": "arn:minio:sqs::hook1:webhook"},
+    "notify.redrive": {"target": "arn:minio:sqs::hook1:webhook",
+                       "delivered": 3},
+    "notify.drop": {"target": "arn:minio:sqs::hook1:webhook"},
 }
 
 

@@ -1,0 +1,573 @@
+"""Bucket event notification plane (minio_tpu/notify/).
+
+The acceptance battery: the reference S3 event record shape is pinned
+per mutation verb (PUT, multipart complete, delete marker, version
+purge, transition, restore) with its exact key sets including the
+``responseElements`` origin metadata; NotificationConfiguration XML
+parses with prefix/suffix/event filters and rejects rules that can
+never fire; the epoch-versioned target registry persists to every
+pool, recovers deterministically, and rolls back a failed save; the
+chaos tier (NaughtyTarget 503 storms / offline windows / mid-POST
+death) loses ZERO events through the durable per-target queue + MRF
+retry; a restart replays the persisted backlog; replica applies are
+suppressed by default; and on multi-node membership only the bucket's
+rendezvous owner delivers (with local fallback when the owner is
+unreachable — a duplicate beats a lost event).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+
+import pytest
+
+from minio_tpu.object import api_errors
+from minio_tpu.object.engine import PutOptions
+from minio_tpu.object.multipart import CompletePart
+from minio_tpu.object.server_sets import ErasureServerSets
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.notify import (BucketNotifyConfig, NaughtyTarget,
+                              NotificationPlane, NotifyRuleError,
+                              NotifyTarget, NotifyTargetError,
+                              NotifyTargetRegistry, new_arn)
+from minio_tpu.notify.plane import _owner_of, render_record
+from minio_tpu.notify.targets import QueueSender
+from minio_tpu.replicate.targets import REPL_ORIGIN_KEY
+from minio_tpu.utils.streams import IterStream
+
+ALL_EVENTS = ("s3:ObjectCreated:*", "s3:ObjectRemoved:*",
+              "s3:ObjectRestore:*", "s3:ObjectTransition:*")
+
+
+def _xml(arn, events=ALL_EVENTS, prefix="", suffix=""):
+    ev = "".join(f"<Event>{e}</Event>" for e in events)
+    flt = ""
+    rules = ""
+    if prefix:
+        rules += ("<FilterRule><Name>prefix</Name>"
+                  f"<Value>{prefix}</Value></FilterRule>")
+    if suffix:
+        rules += ("<FilterRule><Name>suffix</Name>"
+                  f"<Value>{suffix}</Value></FilterRule>")
+    if rules:
+        flt = f"<Filter><S3Key>{rules}</S3Key></Filter>"
+    return ("<NotificationConfiguration><QueueConfiguration>"
+            f"<Queue>{arn}</Queue>{ev}{flt}"
+            "</QueueConfiguration></NotificationConfiguration>")
+
+
+def _mk_layer(root, buckets=("b",), drives=4):
+    sets = ErasureSets.from_drives(
+        [str(root / f"d{i}") for i in range(drives)],
+        set_count=1, set_drive_count=drives, parity=2,
+        block_size=1 << 16)
+    layer = ErasureServerSets([sets], load_topology=False)
+    for b in buckets:
+        layer.make_bucket(b)
+    return layer
+
+
+def _mk_plane(layer, queue_dir=None, **kw):
+    reg = NotifyTargetRegistry(layer)
+    arn = new_arn("t", "queue")
+    reg.add(NotifyTarget(arn=arn, type="queue"))
+    sink = QueueSender(arn)
+    reg.set_sender(arn, sink)
+    plane = NotificationPlane(layer, reg, queue_dir=queue_dir,
+                              busy_fn=lambda: False, **kw)
+    plane.set_config("b", _xml(arn))
+    layer.attach_notifications(plane)
+    return plane, reg, arn, sink
+
+
+def _drain(plane, sink, n, timeout=30.0):
+    assert plane.drain(timeout), plane.stats()
+    assert sink.wait_for(n, timeout), (len(sink.records), plane.stats())
+
+
+# ---------------------------------------------------------------------------
+# record schema: one pinned shape per mutation verb
+# ---------------------------------------------------------------------------
+
+RECORD_KEYS = {"eventVersion", "eventSource", "awsRegion", "eventTime",
+               "eventName", "userIdentity", "requestParameters",
+               "responseElements", "s3"}
+RESPONSE_KEYS = {"x-amz-request-id", "x-minio-origin-node",
+                 "x-minio-origin-site", "x-minio-tier"}
+S3_KEYS = {"s3SchemaVersion", "configurationId", "bucket", "object"}
+OBJECT_KEYS = {"key", "size", "eTag", "versionId", "sequencer"}
+
+
+def _assert_shape(record, event_name, bucket="b", key=None):
+    assert set(record) == {"Records"} and len(record["Records"]) == 1
+    rec = record["Records"][0]
+    assert set(rec) == RECORD_KEYS
+    assert rec["eventVersion"] == "2.0"
+    assert rec["eventSource"] == "minio:s3"
+    assert rec["eventName"] == event_name
+    assert set(rec["responseElements"]) == RESPONSE_KEYS
+    assert set(rec["s3"]) == S3_KEYS
+    assert rec["s3"]["s3SchemaVersion"] == "1.0"
+    assert rec["s3"]["bucket"]["arn"] == f"arn:aws:s3:::{bucket}"
+    obj = rec["s3"]["object"]
+    assert set(obj) == OBJECT_KEYS
+    if key is not None:
+        assert obj["key"] == key
+    # the record is a pure JSON document (webhook-POSTable bytes)
+    json.dumps(record)
+    return rec
+
+
+def test_record_shape_put_and_multipart(tmp_path):
+    """PUT fires s3:ObjectCreated:Put; a multipart commit fires
+    s3:ObjectCreated:CompleteMultipartUpload carrying the multipart
+    etag — each with the full reference key set."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer, node="n1:9000")
+
+    info = layer.put_object("b", "dir/a.txt", b"x" * 64,
+                            opts=PutOptions(versioned=True))
+    _drain(plane, sink, 1)
+    rec = _assert_shape(sink.records[0], "s3:ObjectCreated:Put",
+                        key="dir/a.txt")
+    obj = rec["s3"]["object"]
+    assert obj["size"] == 64
+    assert obj["eTag"] == info.etag
+    assert obj["versionId"] == info.version_id
+    assert rec["responseElements"]["x-minio-origin-node"] == "n1:9000"
+
+    p1, p2 = b"p" * (5 << 20), b"q" * (1 << 20)
+    up = layer.new_multipart_upload("b", "mp", PutOptions())
+    e1 = layer.put_object_part("b", "mp", up, 1,
+                               io.BytesIO(p1), len(p1)).etag
+    e2 = layer.put_object_part("b", "mp", up, 2,
+                               io.BytesIO(p2), len(p2)).etag
+    mi = layer.complete_multipart_upload(
+        "b", "mp", up, [CompletePart(1, e1), CompletePart(2, e2)])
+    _drain(plane, sink, 2)
+    rec = _assert_shape(sink.records[1],
+                        "s3:ObjectCreated:CompleteMultipartUpload",
+                        key="mp")
+    assert rec["s3"]["object"]["eTag"] == mi.etag
+    assert rec["s3"]["object"]["eTag"].endswith("-2")
+    plane.close()
+
+
+def test_record_shape_delete_marker_and_purge(tmp_path):
+    """A versioned delete fires DeleteMarkerCreated (carrying the
+    marker's version id); purging the last version fires
+    ObjectRemoved:Delete with the key gone."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer)
+
+    layer.put_object("b", "doc", b"v1", opts=PutOptions(versioned=True))
+    _drain(plane, sink, 1)
+    layer.delete_object("b", "doc", versioned=True)
+    _drain(plane, sink, 2)
+    rec = _assert_shape(sink.records[1],
+                        "s3:ObjectRemoved:DeleteMarkerCreated",
+                        key="doc")
+    assert rec["s3"]["object"]["versionId"]
+
+    layer.put_object("b", "gone", b"x")
+    _drain(plane, sink, 3)
+    layer.delete_object("b", "gone")
+    _drain(plane, sink, 4)
+    rec = _assert_shape(sink.records[3], "s3:ObjectRemoved:Delete",
+                        key="gone")
+    assert rec["s3"]["object"]["size"] == 0
+    assert rec["s3"]["object"]["eTag"] == ""
+    plane.close()
+
+
+def test_record_shape_transition_and_restore(tmp_path):
+    """Tiering fires ObjectTransition:Complete with x-minio-tier
+    naming the remote tier; a finished restore fires
+    ObjectRestore:Completed (still carrying the tier)."""
+    from minio_tpu.tier.config import TierConfig, TierManager
+    from minio_tpu.tier.transition import restore_object
+
+    layer = _mk_layer(tmp_path / "site")
+    tiers = TierManager(layer)
+    tiers.add(TierConfig("cold", "fs", {"path": str(tmp_path / "tier")}))
+    plane, reg, arn, sink = _mk_plane(layer)
+
+    layer.put_object("b", "arch", b"z" * 4096,
+                     opts=PutOptions(versioned=True))
+    _drain(plane, sink, 1)
+    oi = layer.get_object_info("b", "arch")
+    _, stream = layer.get_object("b", "arch")
+    rd = IterStream(stream)
+    rk = tiers.remote_key("b", "arch", oi.version_id)
+    try:
+        tiers.client("cold").put(rk, rd, oi.size)
+    finally:
+        rd.close()
+    layer.transition_object("b", "arch", version_id=oi.version_id,
+                            tier="cold", remote_object=rk,
+                            expect_etag=oi.etag)
+    _drain(plane, sink, 2)
+    rec = _assert_shape(sink.records[1], "s3:ObjectTransition:Complete",
+                        key="arch")
+    assert rec["responseElements"]["x-minio-tier"] == "cold"
+
+    restore_object(layer, tiers, "b", "arch", version_id=oi.version_id)
+    _drain(plane, sink, 3)
+    rec = _assert_shape(sink.records[2], "s3:ObjectRestore:Completed",
+                        key="arch")
+    assert rec["responseElements"]["x-minio-tier"] == "cold"
+    plane.close()
+
+
+def test_record_origin_site_and_replica_suppression(tmp_path):
+    """A replica apply (REPL_ORIGIN_KEY metadata) fires NO event by
+    default; with replica events on, the record's responseElements
+    carries the ORIGIN site id — never the local one."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer, site_id="siteB")
+
+    layer.put_object("b", "replica", b"x",
+                     opts=PutOptions(metadata={REPL_ORIGIN_KEY: "siteA"}))
+    assert plane.drain(30), plane.stats()
+    assert sink.records == []
+    assert plane.stats()["suppressed"] == 1
+
+    plane.replica_events = True
+    plane.on_namespace_change("b", "replica")
+    _drain(plane, sink, 1)
+    rec = _assert_shape(sink.records[0], "s3:ObjectCreated:Put",
+                        key="replica")
+    assert rec["responseElements"]["x-minio-origin-site"] == "siteA"
+
+    # a local write reports the local site as its origin
+    layer.put_object("b", "local", b"y")
+    _drain(plane, sink, 2)
+    rec = _assert_shape(sink.records[1], "s3:ObjectCreated:Put",
+                        key="local")
+    assert rec["responseElements"]["x-minio-origin-site"] == "siteB"
+    plane.close()
+
+
+def test_render_record_key_is_url_encoded():
+    rec = render_record("s3:ObjectCreated:Put", "b", "a b/c+d")
+    assert rec["Records"][0]["s3"]["object"]["key"] == "a%20b/c%2Bd"
+
+
+# ---------------------------------------------------------------------------
+# rules: NotificationConfiguration parsing + filters
+# ---------------------------------------------------------------------------
+
+def test_rules_parse_filter_and_match():
+    arn1, arn2 = new_arn("one", "queue"), new_arn("two", "webhook")
+    xml = f"""<NotificationConfiguration>
+      <QueueConfiguration>
+        <Queue>{arn1}</Queue>
+        <Event>s3:ObjectCreated:*</Event>
+        <Filter><S3Key>
+          <FilterRule><Name>prefix</Name><Value>img/</Value></FilterRule>
+          <FilterRule><Name>suffix</Name><Value>.jpg</Value></FilterRule>
+        </S3Key></Filter>
+      </QueueConfiguration>
+      <TopicConfiguration>
+        <Topic>{arn2}</Topic>
+        <Event>s3:ObjectRemoved:Delete</Event>
+      </TopicConfiguration>
+    </NotificationConfiguration>"""
+    cfg = BucketNotifyConfig.from_xml(xml)
+    assert cfg.arns() == {arn1, arn2}
+    assert cfg.match("s3:ObjectCreated:Put", "img/x.jpg") == {arn1}
+    assert cfg.match("s3:ObjectCreated:Put", "img/x.png") == set()
+    assert cfg.match("s3:ObjectCreated:Put", "doc/x.jpg") == set()
+    assert cfg.match("s3:ObjectRemoved:Delete", "any") == {arn2}
+    assert cfg.match("s3:ObjectRemoved:DeleteMarkerCreated",
+                     "any") == set()
+    assert cfg.unknown_events() == []
+
+
+def test_rules_reject_malformed():
+    with pytest.raises(NotifyRuleError):
+        BucketNotifyConfig.from_xml("<not-xml")
+    with pytest.raises(NotifyRuleError):       # entry without an ARN
+        BucketNotifyConfig.from_xml(
+            "<NotificationConfiguration><QueueConfiguration>"
+            "<Event>s3:ObjectCreated:*</Event>"
+            "</QueueConfiguration></NotificationConfiguration>")
+    with pytest.raises(NotifyRuleError):       # rule without events
+        BucketNotifyConfig.from_xml(
+            "<NotificationConfiguration><QueueConfiguration>"
+            "<Queue>arn:minio:sqs::x:queue</Queue>"
+            "</QueueConfiguration></NotificationConfiguration>")
+    cfg = BucketNotifyConfig.from_xml(_xml(
+        "arn:minio:sqs::x:queue", events=("s3:ObjectTypo:*",)))
+    assert cfg.unknown_events() == ["s3:ObjectTypo:*"]
+
+
+# ---------------------------------------------------------------------------
+# registry: epoch persistence, recovery, rollback
+# ---------------------------------------------------------------------------
+
+def test_registry_persists_recovers_and_redacts(tmp_path):
+    layer = _mk_layer(tmp_path)
+    reg = NotifyTargetRegistry(layer)
+    arn = new_arn("hook", "webhook")
+    reg.add(NotifyTarget(arn=arn, type="webhook",
+                         params={"endpoint": "http://x/",
+                                 "auth_token": "sekrit"}))
+    reg.add(NotifyTarget(arn=new_arn("q", "queue"), type="queue"))
+    assert reg.epoch == 2
+
+    # secrets never leave the registry redacted surface
+    listed = {t["arn"]: t for t in reg.list(redact=True)}
+    assert listed[arn]["params"]["auth_token"] == "REDACTED"
+
+    fresh = NotifyTargetRegistry(layer)
+    assert fresh.load()
+    assert fresh.epoch == 2
+    assert fresh.arns() == reg.arns()
+    assert fresh.lineage == reg.lineage
+    # the persisted doc keeps the REAL secret (load must round-trip)
+    assert fresh.get(arn).params["auth_token"] == "sekrit"
+
+    fresh.remove(arn)
+    assert fresh.epoch == 3
+    again = NotifyTargetRegistry(layer)
+    assert again.load() and again.epoch == 3
+    assert arn not in again.arns()
+
+
+def test_registry_rolls_back_on_failed_save(tmp_path):
+    layer = _mk_layer(tmp_path)
+    reg = NotifyTargetRegistry(layer)
+    arn = new_arn("a", "queue")
+    reg.add(NotifyTarget(arn=arn, type="queue"))
+
+    def boom(*a, **kw):
+        raise OSError("pool down")
+
+    pools = list(layer.server_sets)
+    saved = [p.put_object for p in pools]
+    for p in pools:
+        p.put_object = boom
+    try:
+        with pytest.raises(NotifyTargetError):
+            reg.add(NotifyTarget(arn=new_arn("b", "queue"),
+                                 type="queue"))
+        with pytest.raises(NotifyTargetError):
+            reg.remove(arn)
+    finally:
+        for p, fn in zip(pools, saved):
+            p.put_object = fn
+    # both mutations rolled back: the map still holds exactly `arn`
+    assert reg.arns() == {arn}
+    assert NotifyTargetRegistry(layer).load() is True
+
+
+def test_registry_validates_specs():
+    reg = NotifyTargetRegistry(None)
+    with pytest.raises(NotifyTargetError):
+        NotifyTarget.from_dict({"type": "webhook"})        # no arn
+    with pytest.raises(NotifyTargetError):
+        NotifyTarget.from_dict({"arn": "a", "type": "nats"})
+    with pytest.raises(NotifyTargetError):                 # no endpoint
+        reg.add(NotifyTarget(arn="a", type="webhook"))
+    with pytest.raises(NotifyTargetError):
+        reg.remove("missing")
+    arn = new_arn("", "queue")
+    assert arn.startswith("arn:minio:sqs::") and arn.endswith(":queue")
+    reg.add(NotifyTarget(arn=arn, type="queue"))
+    with pytest.raises(NotifyTargetError):                 # duplicate
+        reg.add(NotifyTarget(arn=arn, type="queue"))
+    reg.add(NotifyTarget(arn=arn, type="queue"), update=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos: zero loss through storms, offline windows, mid-POST death
+# ---------------------------------------------------------------------------
+
+def test_chaos_503_storm_loses_nothing(tmp_path):
+    """Every send fails for a while (a 503 storm): the durable queue
+    holds the backlog and the MRF retry drains it clean — all events
+    arrive exactly once."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer)
+    naughty = NaughtyTarget(sink, fail_first=6)
+    reg.set_sender(arn, naughty)
+
+    for i in range(8):
+        layer.put_object("b", f"storm/{i}", b"x")
+    _drain(plane, sink, 8, timeout=60)
+    keys = {r["Records"][0]["s3"]["object"]["key"]
+            for r in sink.records}
+    assert keys == {f"storm/{i}" for i in range(8)}
+    assert len(sink.records) == 8              # no duplicates either
+    assert naughty.failures >= 6
+    assert plane.stats()["backlog"] == 0
+    plane.close()
+
+
+def test_chaos_offline_windows_lose_nothing(tmp_path):
+    """Recurring offline windows (every 3rd send opens a 2-failure
+    window): the offline gate parks the backlog, the redrive sweep
+    reprobes, everything arrives."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer)
+    reg.set_sender(arn, NaughtyTarget(sink, offline_every=(3, 2)))
+
+    for i in range(12):
+        layer.put_object("b", f"w/{i}", b"y")
+    _drain(plane, sink, 12, timeout=60)
+    keys = {r["Records"][0]["s3"]["object"]["key"]
+            for r in sink.records}
+    assert keys == {f"w/{i}" for i in range(12)}
+    assert plane.stats()["backlog"] == 0
+    plane.close()
+
+
+def test_chaos_mid_post_death_duplicates_never_loses(tmp_path):
+    """The n-th POST lands but the ack is lost: the plane must retry
+    (the consumer sees a duplicate) — at-least-once, zero loss."""
+    layer = _mk_layer(tmp_path)
+    plane, reg, arn, sink = _mk_plane(layer)
+    reg.set_sender(arn, NaughtyTarget(sink, die_after_send=3))
+
+    for i in range(6):
+        layer.put_object("b", f"dup/{i}", b"z")
+    assert plane.drain(60), plane.stats()
+    keys = {r["Records"][0]["s3"]["object"]["key"]
+            for r in sink.records}
+    assert keys == {f"dup/{i}" for i in range(6)}      # nothing lost
+    assert len(sink.records) >= 6                      # dup allowed
+    assert plane.stats()["backlog"] == 0
+    plane.close()
+
+
+def test_restart_replays_durable_backlog(tmp_path):
+    """Kill/replay without the process harness: a dead target leaves
+    its records in the on-disk queue; a NEW plane over the same queue
+    directory redrives them at boot — zero loss across the restart."""
+    layer = _mk_layer(tmp_path / "site")
+    qdir = str(tmp_path / "queue")
+    plane, reg, arn, sink = _mk_plane(layer, queue_dir=qdir)
+
+    class Dead:
+        def send(self, record):
+            raise ConnectionError("down")
+
+    reg.set_sender(arn, Dead())
+    for i in range(5):
+        layer.put_object("b", f"crash/{i}", b"x")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline \
+            and plane.stats()["backlog"] < 5:
+        time.sleep(0.02)
+    assert plane.stats()["backlog"] == 5, plane.stats()
+    plane.close()
+
+    # "restart": fresh plane, same durable queue, target back up
+    reg.set_sender(arn, sink)
+    plane2 = NotificationPlane(layer, reg, queue_dir=qdir,
+                               busy_fn=lambda: False)
+    plane2.set_config("b", _xml(arn))
+    _drain(plane2, sink, 5, timeout=60)
+    keys = {r["Records"][0]["s3"]["object"]["key"]
+            for r in sink.records}
+    assert keys == {f"crash/{i}" for i in range(5)}
+    assert plane2.stats()["backlog"] == 0
+    plane2.close()
+
+
+# ---------------------------------------------------------------------------
+# ownership: one deliverer per bucket on multi-node membership
+# ---------------------------------------------------------------------------
+
+def test_owner_routing_forwards_and_falls_back(tmp_path):
+    """A non-owner node FORWARDS the event to the bucket's rendezvous
+    owner instead of delivering; when the owner is unreachable it
+    delivers locally (duplicate beats loss). Peer-ingested events
+    deliver without re-resolution."""
+    layer = _mk_layer(tmp_path)
+    nodes = ["10.0.0.1:9000", "10.0.0.2:9000"]
+    owner = _owner_of("b", sorted(nodes))
+    other = next(n for n in nodes if n != owner)
+
+    reg = NotifyTargetRegistry(layer)
+    arn = new_arn("t", "queue")
+    reg.add(NotifyTarget(arn=arn, type="queue"))
+    sink = QueueSender(arn)
+    reg.set_sender(arn, sink)
+    plane = NotificationPlane(layer, reg, node=other, nodes=nodes,
+                              busy_fn=lambda: False)
+    plane.set_config("b", _xml(arn))
+    layer.attach_notifications(plane)
+    assert plane.owner_of("b") == owner
+
+    forwarded = []
+    plane.forward_fn = lambda addr, b, k: (
+        forwarded.append((addr, b, k)) or True)
+    layer.put_object("b", "routed", b"x")
+    assert plane.drain(30), plane.stats()
+    assert forwarded == [(owner, "b", "routed")]
+    assert sink.records == []                  # not delivered here
+    assert plane.stats()["forwarded"] == 1
+
+    # owner down: the forward fails and the event lands locally
+    plane.forward_fn = lambda addr, b, k: False
+    plane.on_namespace_change("b", "routed")
+    _drain(plane, sink, 1)
+    assert plane.stats()["fallback_local"] == 1
+
+    # the owner side: ingest() delivers locally, no re-resolution
+    plane.ingest("b", "ingested")
+    # key never existed -> classified as a delete of a gone key
+    _drain(plane, sink, 2)
+    assert sink.records[1]["Records"][0]["eventName"] == \
+        "s3:ObjectRemoved:Delete"
+    plane.close()
+
+
+def test_owner_hash_is_deterministic_and_stable():
+    nodes = sorted(f"10.0.0.{i}:9000" for i in range(1, 6))
+    owners = {b: _owner_of(b, nodes)
+              for b in ("alpha", "beta", "gamma", "delta")}
+    assert all(o in nodes for o in owners.values())
+    assert owners == {b: _owner_of(b, nodes) for b in owners}
+    # removing one node only moves the buckets it owned
+    survivor_nodes = [n for n in nodes if n != owners["alpha"]]
+    for b, o in owners.items():
+        if o != owners["alpha"]:
+            assert _owner_of(b, survivor_nodes) == o
+
+
+# ---------------------------------------------------------------------------
+# filters on the live plane + config gating
+# ---------------------------------------------------------------------------
+
+def test_plane_honors_prefix_suffix_filters(tmp_path):
+    layer = _mk_layer(tmp_path)
+    reg = NotifyTargetRegistry(layer)
+    arn = new_arn("t", "queue")
+    reg.add(NotifyTarget(arn=arn, type="queue"))
+    sink = QueueSender(arn)
+    reg.set_sender(arn, sink)
+    plane = NotificationPlane(layer, reg, busy_fn=lambda: False)
+    plane.set_config("b", _xml(arn, events=("s3:ObjectCreated:*",),
+                               prefix="img/", suffix=".jpg"))
+    layer.attach_notifications(plane)
+
+    layer.put_object("b", "img/a.jpg", b"1")
+    layer.put_object("b", "img/b.png", b"2")       # suffix miss
+    layer.put_object("b", "doc/c.jpg", b"3")       # prefix miss
+    layer.delete_object("b", "img/a.jpg")          # event-type miss
+    assert plane.drain(30), plane.stats()
+    assert [r["Records"][0]["s3"]["object"]["key"]
+            for r in sink.records] == ["img/a.jpg"]
+
+    # a bucket with no configuration enqueues nothing at all
+    layer.make_bucket("quiet")
+    q0 = plane.stats()["queued"]
+    layer.put_object("quiet", "x", b"y")
+    assert plane.drain(30)
+    assert plane.stats()["queued"] == q0
+    plane.close()

@@ -1,0 +1,185 @@
+"""Notification plane over REAL server processes.
+
+Two pins the in-process suite cannot give:
+
+* **single deliverer per bucket** — on a 2-node cluster only the
+  bucket's rendezvous owner POSTs to the webhook, wherever the
+  mutation landed (the non-owner forwards over the peer control
+  plane): every key arrives exactly once, no double-fire, no loss;
+* **kill/replay at ``notify.queue.persist``** — a process armed to
+  die right after an event record lands in the durable per-target
+  queue (before its delivery attempt) is killed by its own crashpoint;
+  the restarted process redrives EXACTLY that entry at boot
+  (at-least-once across process death, never lost).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from tests.harness.proc import (CRASH_EXIT_CODE, ProcNode, free_port,
+                                make_cluster)
+
+pytestmark = pytest.mark.slow
+
+BUCKET = "evt"
+
+
+class _Receiver:
+    """Webhook sink: one local HTTP server collecting event records."""
+
+    def __init__(self):
+        self.port = free_port()
+        self.records: list[dict] = []
+        self._cond = threading.Condition()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with outer._cond:
+                    outer.records.append(json.loads(body))
+                    outer._cond.notify_all()
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def keys(self) -> list[str]:
+        with self._cond:
+            return [r["Records"][0]["s3"]["object"]["key"]
+                    for r in self.records]
+
+    def wait_for(self, n: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self.records) < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    return False
+            return True
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def _configure(node: ProcNode, arn: str, bucket: str = BUCKET) -> None:
+    xml = ("<NotificationConfiguration><QueueConfiguration>"
+           f"<Queue>{arn}</Queue>"
+           "<Event>s3:ObjectCreated:*</Event>"
+           "<Event>s3:ObjectRemoved:*</Event>"
+           "</QueueConfiguration></NotificationConfiguration>")
+    node.s3()._request("PUT", f"/{bucket}",
+                       query={"notification": ""}, body=xml.encode())
+
+
+def test_two_node_single_deliverer_no_loss(tmp_path):
+    """Writes land on BOTH nodes; the webhook sees every key EXACTLY
+    once — the rendezvous owner is the only deliverer, and the
+    non-owner's forward path carries its share without duplication."""
+    rx = _Receiver()
+    n0, n1 = make_cluster(str(tmp_path), n_nodes=2)
+    boot_errs: list = []
+
+    def boot(n):
+        try:
+            n.start(timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            boot_errs.append((n.name, e))
+
+    try:
+        threads = [threading.Thread(target=boot, args=(n,))
+                   for n in (n0, n1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180.0)
+        assert not boot_errs, f"cluster boot failed: {boot_errs}"
+        n0.s3().make_bucket(BUCKET)
+        arn = n0.admin().add_notify_target(endpoint=rx.url)
+        _configure(n0, arn)
+
+        keys = []
+        for i in range(4):
+            k = f"from-n0/{i}"
+            n0.put(BUCKET, k, b"x" * 256)
+            keys.append(k)
+        for i in range(4):
+            k = f"from-n1/{i}"
+            n1.put(BUCKET, k, b"y" * 256)
+            keys.append(k)
+
+        assert rx.wait_for(len(keys)), \
+            (sorted(rx.keys()), n0.tail_log(), n1.tail_log())
+        time.sleep(1.0)                     # a double-fire would trail
+        got = rx.keys()
+        assert sorted(got) == sorted(keys)  # zero loss, zero dupes
+
+        # exactly one node delivered; the other forwarded its share
+        s0 = n0.admin().notify_status()["stats"]
+        s1 = n1.admin().notify_status()["stats"]
+        assert s0["delivered"] + s1["delivered"] == len(keys)
+        assert (s0["delivered"] == 0) != (s1["delivered"] == 0), (s0, s1)
+        forwarder = s1 if s0["delivered"] else s0
+        assert forwarder["forwarded"] == 4
+        n0.stop()
+        n1.stop()
+    finally:
+        rx.close()
+        n0.close()
+        n1.close()
+
+
+def test_queue_persist_crashpoint_kill_replay(tmp_path):
+    """Armed at ``notify.queue.persist`` the process dies after the
+    event record is durable but before its POST; the restart redrives
+    it at boot — the webhook sees the pre-crash key, nothing is
+    lost."""
+    rx = _Receiver()
+    node = ProcNode(str(tmp_path), name="n0")
+    try:
+        node.start()
+        node.s3().make_bucket(BUCKET)
+        arn = node.admin().add_notify_target(endpoint=rx.url)
+        _configure(node, arn)
+        node.put(BUCKET, "warm", b"w" * 128)
+        assert rx.wait_for(1), node.tail_log()   # pipeline is live
+        node.stop()
+
+        node.start(crashpoint="notify.queue.persist")
+        # delivery is async: the PUT itself usually commits, then the
+        # worker hits the crashpoint while persisting the event
+        try:
+            node.put(BUCKET, "crashed", b"c" * 128)
+        except OSError:
+            pass
+        rc = node.wait_exit(90)
+        assert rc == CRASH_EXIT_CODE, (rc, node.tail_log())
+        assert len(rx.records) == 1              # not delivered yet
+
+        node.start()                             # boot-time redrive
+        assert rx.wait_for(2), (rx.keys(), node.tail_log())
+        assert sorted(rx.keys()) == ["crashed", "warm"]
+        assert node.get(BUCKET, "crashed") == b"c" * 128
+        node.stop()
+    finally:
+        rx.close()
+        node.close()

@@ -585,3 +585,73 @@ def test_signed_body_sha_mismatch_rejected(client, bucket):
     conn.close()
     assert resp.status == 400
     assert b"XAmzContentSHA256Mismatch" in data
+
+
+def test_put_bucket_notification_validated_by_plane(client, server):
+    """With a NotificationPlane attached, PUT ?notification rejects
+    configs naming unknown target ARNs or event names (the reference's
+    ErrARNNotification / ErrEventNotification), accepts valid ones, and
+    keeps the legacy accept-anything behavior when no plane is wired."""
+    status, _, _ = client.request("PUT", "/notifyval")
+    assert status == 200
+    arn = "arn:minio:sqs::hook1:webhook"
+
+    def xml(target_arn=arn, event="s3:ObjectCreated:*"):
+        return (f"<NotificationConfiguration><QueueConfiguration>"
+                f"<Queue>{target_arn}</Queue><Event>{event}</Event>"
+                f"</QueueConfiguration></NotificationConfiguration>"
+                ).encode()
+
+    # no plane attached: any well-formed doc passes (legacy behavior)
+    assert server.api.notify is None
+    status, _, _ = client.request(
+        "PUT", "/notifyval", query={"notification": ""},
+        body=xml("arn:minio:sqs::ghost:webhook"))
+    assert status == 200
+
+    class _Registry:
+        def arns(self):
+            return {arn}
+
+    class _Plane:
+        registry = _Registry()
+
+    server.api.notify = _Plane()
+    try:
+        status, _, body = client.request(
+            "PUT", "/notifyval", query={"notification": ""},
+            body=xml("arn:minio:sqs::ghost:webhook"))
+        assert status == 400
+        assert b"InvalidArgument" in body and b"ghost" in body
+
+        status, _, body = client.request(
+            "PUT", "/notifyval", query={"notification": ""},
+            body=xml(event="s3:ObjectTypo:*"))
+        assert status == 400
+        assert b"InvalidArgument" in body and b"ObjectTypo" in body
+
+        # a rule with no events is structurally invalid, not unknown
+        doc = (f"<NotificationConfiguration><QueueConfiguration>"
+               f"<Queue>{arn}</Queue>"
+               f"</QueueConfiguration></NotificationConfiguration>")
+        status, _, body = client.request(
+            "PUT", "/notifyval", query={"notification": ""},
+            body=doc.encode())
+        assert status == 400
+        assert b"MalformedXML" in body
+
+        status, _, body = client.request(
+            "PUT", "/notifyval", query={"notification": ""},
+            body=b"<NotificationConfiguration")
+        assert status == 400
+        assert b"MalformedXML" in body
+
+        status, _, _ = client.request(
+            "PUT", "/notifyval", query={"notification": ""}, body=xml())
+        assert status == 200
+        status, _, body = client.request(
+            "GET", "/notifyval", query={"notification": ""})
+        assert status == 200
+        assert arn.encode() in body
+    finally:
+        server.api.notify = None

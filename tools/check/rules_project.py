@@ -37,6 +37,9 @@ REPL_SERVER_SETS = "minio_tpu/object/server_sets.py"
 REPL_PLANE = "minio_tpu/replicate/plane.py"
 REPL_CLUSTER = "minio_tpu/cluster.py"
 
+# the notification plane rides the same feed — same chain, same rule
+NOTIFY_PLANE = "minio_tpu/notify/plane.py"
+
 # every quorum-successful-but-degraded write must feed the MRF queue
 DEGRADED_VERBS = (
     "put_object", "update_object_metadata", "transition_object",
@@ -134,6 +137,7 @@ def check_hook_coverage(sources: List[Source]) -> List[Violation]:
                 f"(via {' / '.join(DEGRADED_HOOKS)}) — a degraded "
                 "quorum write waits for the scanner instead of MRF"))
     out.extend(_check_replication_chain(sources))
+    out.extend(_check_notify_chain(sources))
     return out
 
 
@@ -196,6 +200,55 @@ def _check_replication_chain(sources: List[Source]) -> List[Violation]:
                 "hook-coverage", REPL_CLUSTER, 1,
                 "cluster boot never calls attach_replication() — the "
                 "plane exists but no mutation verb would reach it"))
+    return out
+
+
+def _check_notify_chain(sources: List[Source]) -> List[Violation]:
+    """Prove every mutation verb reaches bucket event notification:
+    verb coverage of the feed is checked above; these links pin
+    feed -> NotificationPlane. Broken link = events silently stop
+    for some (or all) mutation verbs. The chain is only enforced when
+    the scanned set carries the plane module (fixture trees that never
+    mention notifications stay out of scope; deleting the real module
+    breaks cluster boot imports long before this rule matters)."""
+    out: List[Violation] = []
+    by_rel = {s.rel: s for s in sources}
+    plane = by_rel.get(NOTIFY_PLANE)
+    if plane is None:
+        return out
+
+    ss = by_rel.get(REPL_SERVER_SETS)
+    if ss is not None:
+        attach = _fn_in_class(ss, "ErasureServerSets",
+                              "attach_notifications")
+        if attach is None:
+            out.append(Violation(
+                "hook-coverage", REPL_SERVER_SETS, 1,
+                "ErasureServerSets.attach_notifications() missing — "
+                "the notification plane has no way onto the namespace "
+                "feed"))
+        elif not _calls_method(attach, "register_namespace_listener"):
+            out.append(Violation(
+                "hook-coverage", REPL_SERVER_SETS, attach.lineno,
+                "attach_notifications() never calls "
+                "register_namespace_listener() — mutation verbs would "
+                "not reach the notification queue"))
+
+    if _fn_in_class(plane, "NotificationPlane",
+                    "on_namespace_change") is None:
+        out.append(Violation(
+            "hook-coverage", NOTIFY_PLANE, 1,
+            "NotificationPlane.on_namespace_change() missing — the "
+            "feed listener the attach wires is gone"))
+
+    cluster = by_rel.get(REPL_CLUSTER)
+    if cluster is not None and ss is not None:
+        if not _calls_method(cluster.tree, "attach_notifications"):
+            out.append(Violation(
+                "hook-coverage", REPL_CLUSTER, 1,
+                "cluster boot never calls attach_notifications() — "
+                "the plane exists but no mutation verb would reach "
+                "it"))
     return out
 
 
@@ -329,6 +382,8 @@ CRASHPOINT_MODULES = (
     "minio_tpu/replicate/targets.py",
     "minio_tpu/replicate/resync.py",
     "minio_tpu/replicate/plane.py",
+    "minio_tpu/notify/targets.py",
+    "minio_tpu/notify/plane.py",
 )
 
 # terminal call names that MOVE a file into its committed place…
@@ -449,6 +504,7 @@ REGFENCE_MODULES = (
     "minio_tpu/tier/config.py",
     "minio_tpu/replicate/targets.py",
     "minio_tpu/s3/qos.py",
+    "minio_tpu/notify/targets.py",
 )
 
 _REGFENCE_GATE_FNS = ("save", "load")
